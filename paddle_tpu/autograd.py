@@ -1,0 +1,3 @@
+"""paddle.autograd namespace: PyLayer + functional autodiff (vjp/jvp/...)."""
+from .core.autograd import PyLayer, PyLayerContext, backward, grad, no_grad  # noqa: F401
+from .autograd_functional import vjp, jvp, jacobian, hessian  # noqa: F401

@@ -1,0 +1,3 @@
+def vjp(*a, **k):
+    raise NotImplementedError("stub")
+jvp = jacobian = hessian = vjp

@@ -1,0 +1,1 @@
+from . import autograd, dispatch, dtype, place, random, tensor  # noqa: F401

@@ -1,0 +1,323 @@
+"""Eager (dygraph) autograd engine.
+
+Reference parity: ``paddle/fluid/imperative/tracer.cc:146`` (TraceOp records
+grad nodes), ``imperative/basic_engine.cc:379`` (queue-driven reverse
+topological walk), ``imperative/gradient_accumulator.cc`` (multi-consumer
+grad summation).
+
+TPU-first design: instead of per-op hand-written grad kernels, every traced
+op gets its VJP from ``jax.vjp`` at record time — one forward pass through
+XLA produces both the outputs and a compiled-on-demand cotangent closure.
+The reverse walk then is pure Python bookkeeping; all math stays on device.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradNode", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled", "backward", "grad", "PyLayer", "PyLayerContext",
+]
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+class _GradModeGuard:
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with _GradModeGuard(self._mode):
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+def no_grad(fn=None):
+    """Context manager / decorator disabling grad recording (paddle.no_grad)."""
+    guard = _GradModeGuard(False)
+    if fn is not None:
+        return guard(fn)
+    return guard
+
+
+def enable_grad(fn=None):
+    guard = _GradModeGuard(True)
+    if fn is not None:
+        return guard(fn)
+    return guard
+
+
+class GradNode:
+    """One recorded op in the dygraph tape.
+
+    ``vjp_fn`` maps output cotangents -> input cotangents (a jax.vjp
+    closure, or a PyLayer backward).  Inputs are held strongly so the
+    graph stays alive while any output is alive (reference: GradOpNode
+    forward refs, ``imperative/tracer.cc:237``).
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "input_requires",
+                 "out_avals", "tuple_output", "_materialize_zeros")
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence,
+                 input_requires: Sequence[bool], out_avals: Sequence,
+                 tuple_output: bool):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.input_requires = list(input_requires)
+        self.out_avals = list(out_avals)  # (shape, dtype) per output
+        self.tuple_output = tuple_output
+        self._materialize_zeros = True
+
+    def __repr__(self):
+        return f"GradNode<{self.name}>"
+
+
+def record(name: str, fn: Callable, tensors: Sequence, arrays: Sequence,
+           out_arrays):
+    """Run ``fn`` on ``arrays`` with VJP capture and wire a GradNode.
+
+    Called by the op dispatcher when grad is enabled and at least one
+    input requires grad.  Returns the forward outputs (already computed
+    by jax.vjp's forward pass).
+    """
+    out, vjp_fn = out_arrays  # computed by caller via jax.vjp
+    tuple_output = isinstance(out, tuple)
+    outs = out if tuple_output else (out,)
+    node = GradNode(
+        name, vjp_fn, tensors,
+        [not t.stop_gradient for t in tensors],
+        [(o.shape, o.dtype) for o in outs],
+        tuple_output,
+    )
+    return node
+
+
+def _accumulate(a, b):
+    if a is None:
+        return b
+    return a + b
+
+
+def _is_float0(g) -> bool:
+    return g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0)
+
+
+def backward(tensor, grad_tensor=None, retain_graph: bool = False):
+    """Reverse-mode walk from ``tensor``; accumulates into leaf ``.grad``.
+
+    Mirrors BasicEngine::Execute (``imperative/basic_engine.cc:379``):
+    dependency-counted queue over grad nodes, gradient accumulation at
+    fan-in points, hooks fired as gradients materialize.
+    """
+    from .tensor import Tensor  # cycle: tensor.py imports this module
+
+    root_node = tensor._grad_node
+    if root_node is None and tensor.stop_gradient:
+        raise RuntimeError(
+            "backward() called on a tensor that does not require grad")
+    if grad_tensor is None:
+        if tensor._data.size != 1:
+            raise RuntimeError(
+                "grad_tensor must be provided for non-scalar backward()")
+        seed = jnp.ones(tensor._data.shape, tensor._data.dtype)
+    else:
+        seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    if root_node is None:
+        # leaf with requires-grad: d(t)/d(t) == seed
+        tensor._accumulate_grad(seed)
+        return
+
+    # --- dependency counting over the reachable subgraph ----------------
+    pending = {}
+    visited = {root_node}
+    stack = [root_node]
+    while stack:
+        n = stack.pop()
+        for t, req in zip(n.inputs, n.input_requires):
+            pn = t._grad_node
+            if pn is not None and req:
+                pending[pn] = pending.get(pn, 0) + 1
+                if pn not in visited:
+                    visited.add(pn)
+                    stack.append(pn)
+
+    # --- queue-driven reverse walk --------------------------------------
+    node_out_grads = {root_node: {tensor._output_index: seed}}
+    ready = deque([root_node])
+    while ready:
+        node = ready.popleft()
+        grads_by_idx = node_out_grads.pop(node, {})
+        cotangents = []
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            g = grads_by_idx.get(i)
+            if g is None:
+                g = jnp.zeros(shape, dtype)
+            cotangents.append(g)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"grad graph for {node.name} already freed; pass "
+                "retain_graph=True to backward() to reuse it")
+        cot = tuple(cotangents) if node.tuple_output else cotangents[0]
+        in_grads = node.vjp_fn(cot)
+        if not isinstance(in_grads, (tuple, list)):
+            in_grads = (in_grads,)
+        if not retain_graph:
+            node.vjp_fn = None
+        for t, req, g in zip(node.inputs, node.input_requires, in_grads):
+            if not req or _is_float0(g):
+                continue
+            for hook in t._hooks:
+                out = hook(g)
+                if out is not None:
+                    g = out._data if isinstance(out, Tensor) else out
+            pn = t._grad_node
+            if pn is None or pn not in visited:
+                t._accumulate_grad(g)
+            else:
+                d = node_out_grads.setdefault(pn, {})
+                d[t._output_index] = _accumulate(d.get(t._output_index), g)
+                pending[pn] -= 1
+                if pending[pn] == 0:
+                    ready.append(pn)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad parity: returns grads of ``outputs`` w.r.t ``inputs``
+    without touching ``.grad`` (implemented by a scoped backward with
+    temporary accumulation buffers)."""
+    from .tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    saved = [(t.grad, t._grad_node) for t in inputs]
+    captured = {}
+
+    hooks_installed = []
+    for idx, t in enumerate(inputs):
+        t.grad = None
+
+        def make_hook(i):
+            def hook(g):
+                captured[i] = _accumulate(captured.get(i), g)
+                return g
+            return hook
+        h = make_hook(idx)
+        t._hooks.append(h)
+        hooks_installed.append((t, h))
+    try:
+        for out, gout in zip(outputs, grad_outputs):
+            backward(out, gout, retain_graph=True if retain_graph else False)
+    finally:
+        for t, h in hooks_installed:
+            t._hooks.remove(h)
+        for t, (g, _) in zip(inputs, saved):
+            t.grad = g
+
+    results = []
+    for i, t in enumerate(inputs):
+        g = captured.get(i)
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                f"input {i} is unreachable from outputs (allow_unused=False)")
+        results.append(None if g is None else Tensor(g, stop_gradient=True))
+    return results
+
+
+# --------------------------------------------------------------------------
+# PyLayer: user-defined autograd function
+# (reference: python/paddle/autograd/py_layer.py)
+# --------------------------------------------------------------------------
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle spells it both ways across versions
+    saved_tensors = saved_tensor
+
+
+class PyLayer:
+    """Custom autograd op: subclass with static ``forward(ctx, ...)`` and
+    ``backward(ctx, *out_grads)``."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .tensor import Tensor
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        tuple_output = isinstance(out, (tuple, list))
+        outs = tuple(out) if tuple_output else (out,)
+
+        requires = [not t.stop_gradient for t in tensor_inputs]
+        if is_grad_enabled() and any(requires):
+            def vjp_fn(cot):
+                cots = cot if isinstance(cot, tuple) else (cot,)
+                cot_tensors = [Tensor(c, stop_gradient=True) for c in cots]
+                with no_grad():
+                    gin = cls.backward(ctx, *cot_tensors)
+                if not isinstance(gin, (tuple, list)):
+                    gin = (gin,)
+                return tuple(
+                    None if g is None else (g._data if isinstance(g, Tensor) else g)
+                    for g in gin)
+
+            node = GradNode(
+                cls.__name__, vjp_fn, tensor_inputs, requires,
+                [(o._data.shape, o._data.dtype) for o in outs], tuple_output)
+            for i, o in enumerate(outs):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._output_index = i
+        return out

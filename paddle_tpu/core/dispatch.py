@@ -1,0 +1,130 @@
+"""Op dispatch + kernel registry.
+
+Reference parity: ``paddle/pten/core/kernel_factory.h:108,225,255`` (kernel
+registry keyed by backend/layout/dtype) and ``imperative/prepared_operator.cc``
+(kernel selection + launch).  On TPU, "kernels" are jax-traceable callables;
+the registry keys (op, backend) where backend is 'xla' (default lowering) or
+'pallas' (hand-written TPU kernel).  Dispatch records autograd via jax.vjp —
+see core/autograd.py.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+
+from . import autograd
+
+__all__ = ["register_kernel", "get_kernel", "dispatch", "KernelKey"]
+
+
+class KernelKey(Tuple):
+    """(op_name, backend)."""
+
+
+_REGISTRY: Dict[Tuple[str, str], Callable] = {}
+_preferred_backend = threading.local()
+
+
+def register_kernel(op_name: str, backend: str = "xla"):
+    """Decorator: register an implementation for (op_name, backend)."""
+    def deco(fn):
+        _REGISTRY[(op_name, backend)] = fn
+        return fn
+    return deco
+
+
+def get_kernel(op_name: str, backend: Optional[str] = None) -> Callable:
+    backend = backend or preferred_backend()
+    fn = _REGISTRY.get((op_name, backend))
+    if fn is None:
+        fn = _REGISTRY.get((op_name, "xla"))
+    if fn is None:
+        raise KeyError(f"no kernel registered for op '{op_name}'")
+    return fn
+
+
+def preferred_backend() -> str:
+    """'pallas' on real TPU unless disabled via FLAGS_use_pallas=0."""
+    val = getattr(_preferred_backend, "value", None)
+    if val is not None:
+        return val
+    from ..utils import flags
+    use_pallas = flags.get_flag("FLAGS_use_pallas")
+    if use_pallas and jax.default_backend() in ("tpu", "axon"):
+        _preferred_backend.value = "pallas"
+    else:
+        _preferred_backend.value = "xla"
+    return _preferred_backend.value
+
+
+def _tensors_of(args):
+    from .tensor import Tensor
+    return [a for a in args if isinstance(a, Tensor)]
+
+
+def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
+    """Run ``fn(*arrays, **kwargs)`` eagerly, recording a GradNode when any
+    input requires grad.  ``tensor_args`` are Tensors (positionally matching
+    fn's array params); kwargs are static non-tensor attrs."""
+    from .tensor import Tensor
+
+    arrays = [t._data for t in tensor_args]
+    needs_grad = autograd.is_grad_enabled() and any(
+        not t.stop_gradient for t in tensor_args)
+
+    if kwargs:
+        closed = functools.partial(fn, **kwargs)
+    else:
+        closed = fn
+
+    if needs_grad:
+        out, vjp_fn = jax.vjp(closed, *arrays)
+        node = autograd.record(op_name, closed, tensor_args, arrays,
+                               (out, vjp_fn))
+    else:
+        out = closed(*arrays)
+        node = None
+
+    tuple_output = isinstance(out, tuple)
+    outs = out if tuple_output else (out,)
+    wrapped = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=(node is None))
+        if node is not None:
+            t._grad_node = node
+            t._output_index = i
+        wrapped.append(t)
+    return tuple(wrapped) if tuple_output else wrapped[0]
+
+
+def defop(op_name: str, n_tensor_args: Optional[int] = None):
+    """Build a user-facing op from an array-level implementation.
+
+    The produced wrapper accepts Tensors (or array-likes) for its first
+    ``n_tensor_args`` positional parameters and static attrs as kwargs.
+    """
+    def deco(fn):
+        register_kernel(op_name, "xla")(fn)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            from .tensor import Tensor, to_tensor
+            kwargs.pop("name", None)
+            n = n_tensor_args if n_tensor_args is not None else len(args)
+            tensors = []
+            for a in args[:n]:
+                tensors.append(a if isinstance(a, Tensor) else to_tensor(a))
+            static = kwargs
+            extra = args[n:]
+            if extra:
+                raise TypeError(
+                    f"{op_name}: positional static attrs not supported; "
+                    "pass them as keywords")
+            impl = get_kernel(op_name)
+            return dispatch(op_name, impl, tensors, static)
+        return wrapper
+    return deco

@@ -1,0 +1,80 @@
+"""Dtype registry.
+
+Parity with ``paddle/fluid/framework/framework.proto:117`` (VarType) —
+string dtypes map onto jax/numpy dtypes.  bfloat16 is the native TPU
+half-precision type (MXU-preferred); float16 maps through but bf16 is
+the framework default for AMP.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["dtype_to_jnp", "canonical_dtype", "float32", "float64", "float16",
+           "bfloat16", "int8", "int16", "int32", "int64", "uint8", "bool_",
+           "complex64", "complex128", "is_floating_dtype", "is_integer_dtype"]
+
+float32 = jnp.float32
+float64 = jnp.float64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+    "float64": jnp.float64, "fp64": jnp.float64, "double": jnp.float64,
+    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "int8": jnp.int8, "int16": jnp.int16, "int32": jnp.int32,
+    "int64": jnp.int64, "long": jnp.int64,
+    "uint8": jnp.uint8, "bool": jnp.bool_,
+    "complex64": jnp.complex64, "complex128": jnp.complex128,
+}
+
+
+def _canonicalize_bitwidth(jdtype):
+    """Without jax x64, 64-bit types silently truncate (with a warning);
+    map them to the 32-bit types XLA will actually use so tensor dtypes
+    are honest.  TPU hardware has no fp64 anyway."""
+    import jax
+    if jax.config.jax_enable_x64:
+        return jdtype
+    return {jnp.int64: jnp.int32, jnp.float64: jnp.float32,
+            jnp.uint64 if hasattr(jnp, "uint64") else None: jnp.uint32,
+            jnp.complex128: jnp.complex64}.get(jdtype, jdtype)
+
+
+def dtype_to_jnp(dtype):
+    """Normalise a user dtype (str | np.dtype | jnp dtype) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _ALIASES:
+            raise ValueError(f"unknown dtype '{dtype}'")
+        return _canonicalize_bitwidth(_ALIASES[key])
+    return _canonicalize_bitwidth(jnp.dtype(dtype).type)
+
+
+def canonical_dtype(dtype) -> str:
+    """Return the canonical string name (paddle style) of a dtype."""
+    if isinstance(dtype, str):
+        dtype = dtype_to_jnp(dtype)
+    return np.dtype(dtype).name if np.dtype(dtype).name != "bfloat16" else "bfloat16"
+
+
+def is_floating_dtype(dtype) -> bool:
+    d = jnp.dtype(dtype_to_jnp(dtype) if isinstance(dtype, str) else dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer_dtype(dtype) -> bool:
+    d = jnp.dtype(dtype_to_jnp(dtype) if isinstance(dtype, str) else dtype)
+    return jnp.issubdtype(d, jnp.integer)

@@ -1,0 +1,192 @@
+"""Device/place abstraction for the TPU-native framework.
+
+Reference parity: ``paddle/fluid/platform/place.h`` (Place variants) and
+``platform/device_context.h:112,468,818`` (DeviceContext / DeviceContextPool).
+
+On TPU the heavy lifting of streams/handles is owned by PJRT + XLA, so a
+"Place" here is the identity of a jax.Device, and the "DeviceContextPool"
+collapses to a small registry mapping places onto live ``jax.Device``
+objects.  No per-device stream plumbing is needed: XLA orders work.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "Place",
+    "CPUPlace",
+    "TPUPlace",
+    "CUDAPinnedPlace",
+    "set_device",
+    "get_device",
+    "device_count",
+    "is_compiled_with_tpu",
+    "DeviceContextPool",
+]
+
+_TPU_BACKENDS = ("tpu", "axon")  # axon = tunneled single-chip TPU platform
+
+
+class Place:
+    """Identity of a physical device: (device_type, device_id)."""
+
+    device_type: str = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    # -- paddle-compatible predicates ------------------------------------
+    def is_cpu_place(self) -> bool:
+        return self.device_type == "cpu"
+
+    def is_tpu_place(self) -> bool:
+        return self.device_type == "tpu"
+
+    def is_gpu_place(self) -> bool:  # no CUDA in this stack
+        return False
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self._device_id == other._device_id
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.device_type, self._device_id))
+
+    def __repr__(self) -> str:
+        return f"Place({self.device_type}:{self._device_id})"
+
+    # -- jax bridge ------------------------------------------------------
+    def jax_device(self) -> Optional[jax.Device]:
+        return DeviceContextPool.instance().device_for(self)
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CUDAPinnedPlace(Place):
+    """Host-pinned staging memory.  On TPU, PJRT manages pinned staging
+    buffers internally; this place exists for API compatibility and maps
+    to host memory."""
+
+    device_type = "cpu_pinned"
+
+    def is_cpu_place(self) -> bool:
+        return True
+
+
+class DeviceContextPool:
+    """Maps Place -> live jax.Device.  Parity with the reference's
+    ``DeviceContextPool`` singleton (``platform/device_context.h:818``),
+    minus streams (XLA's job)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._cache = {}
+
+    @classmethod
+    def instance(cls) -> "DeviceContextPool":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    def device_for(self, place: Place) -> Optional[jax.Device]:
+        key = (place.device_type, place.get_device_id())
+        if key in self._cache:
+            return self._cache[key]
+        dev = None
+        if place.is_cpu_place():
+            try:
+                dev = jax.devices("cpu")[place.get_device_id()]
+            except RuntimeError:
+                dev = None
+        elif place.is_tpu_place():
+            for backend in _TPU_BACKENDS:
+                try:
+                    dev = jax.devices(backend)[place.get_device_id()]
+                    break
+                except RuntimeError:
+                    continue
+        self._cache[key] = dev
+        return dev
+
+
+_state = threading.local()
+
+
+def _default_place() -> Place:
+    backend = jax.default_backend()
+    if backend in _TPU_BACKENDS:
+        return TPUPlace(0)
+    return CPUPlace(0)
+
+
+def set_device(device: str) -> Place:
+    """paddle.set_device parity: accepts 'cpu', 'tpu', 'tpu:1'."""
+    device = device.lower()
+    if ":" in device:
+        kind, _, idx = device.partition(":")
+        idx = int(idx)
+    else:
+        kind, idx = device, 0
+    if kind in ("tpu", "xla", "axon"):
+        place: Place = TPUPlace(idx)
+    elif kind == "cpu":
+        place = CPUPlace(idx)
+    else:
+        raise ValueError(
+            f"device '{device}' not supported; this framework targets 'tpu' and 'cpu'"
+        )
+    _state.place = place
+    return place
+
+
+def get_device() -> str:
+    place = getattr(_state, "place", None) or _default_place()
+    return f"{place.device_type}:{place.get_device_id()}"
+
+
+def _current_place() -> Place:
+    place = getattr(_state, "place", None)
+    if place is None:
+        place = _default_place()
+        _state.place = place
+    return place
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return jax.default_backend() in _TPU_BACKENDS or bool(
+            sum(1 for b in _TPU_BACKENDS if _try_devices(b))
+        )
+    except Exception:
+        return False
+
+
+def _try_devices(backend: str):
+    try:
+        return jax.devices(backend)
+    except RuntimeError:
+        return []

@@ -1,0 +1,143 @@
+"""RNG state management.
+
+Reference parity: ``paddle/fluid/framework/generator.cc`` (per-device RNG
+state) + ``fleet/meta_parallel/parallel_layers/random.py`` (RNG trackers
+for model-parallel dropout).
+
+TPU-first: built on JAX's counter-based PRNG.  Two modes:
+- eager: a stateful Generator splits its key per draw.
+- traced (inside jit): a *functional scope* supplies the key; draws fold a
+  local counter into it, so the same trace with a fresh key gives fresh
+  randomness each step (no baked-in constants).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["Generator", "default_generator", "seed", "get_rng_state",
+           "set_rng_state", "rng_scope", "RNGStatesTracker", "get_rng_tracker"]
+
+_state = threading.local()
+
+
+class _FunctionalScope:
+    __slots__ = ("key", "counter")
+
+    def __init__(self, key):
+        self.key = key
+        self.counter = 0
+
+    def next_key(self):
+        k = jax.random.fold_in(self.key, self.counter)
+        self.counter += 1
+        return k
+
+
+class Generator:
+    def __init__(self, seed_val: int = 0):
+        self._seed = seed_val
+        self._key = jax.random.PRNGKey(seed_val)
+
+    def seed(self, seed_val: int):
+        self._seed = seed_val
+        self._key = jax.random.PRNGKey(seed_val)
+        return self
+
+    manual_seed = seed
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        scope = getattr(_state, "scope", None)
+        if scope is not None:
+            return scope.next_key()
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+default_generator = Generator(0)
+
+
+def seed(seed_val: int):
+    """paddle.seed parity: reseed the global generator."""
+    default_generator.seed(int(seed_val))
+    get_rng_tracker().reset(int(seed_val))
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(key):
+    default_generator.set_state(key)
+
+
+class rng_scope:
+    """Route all random draws in this scope through ``key`` (functional,
+    jit-safe).  Used by the jitted train-step path."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        self._prev = getattr(_state, "scope", None)
+        _state.scope = _FunctionalScope(self._key)
+        return self
+
+    def __exit__(self, *exc):
+        _state.scope = self._prev
+        return False
+
+
+class RNGStatesTracker:
+    """Named RNG streams for model-parallel determinism (reference:
+    parallel_layers/random.py model_parallel_random_seed).  Each named
+    state is an independent key stream; ``rng_state(name)`` temporarily
+    swaps the default generator's stream."""
+
+    def __init__(self):
+        self._states = {}
+
+    def reset(self, base_seed: int = 0):
+        self._states = {}
+        self._base = base_seed
+
+    def add(self, name: str, seed_val: int):
+        if name in self._states:
+            raise ValueError(f"rng state '{name}' already exists")
+        self._states[name] = jax.random.PRNGKey(seed_val)
+
+    def rng_state(self, name: str = "model_parallel_rng"):
+        tracker = self
+
+        class _Guard:
+            def __enter__(self):
+                if name not in tracker._states:
+                    raise ValueError(f"rng state '{name}' not registered")
+                self._saved = default_generator.get_state()
+                default_generator.set_state(tracker._states[name])
+                return self
+
+            def __exit__(self, *exc):
+                tracker._states[name] = default_generator.get_state()
+                default_generator.set_state(self._saved)
+                return False
+        return _Guard()
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _tracker

@@ -1,0 +1,6 @@
+class Model:
+    pass
+def summary(*a, **k):
+    raise NotImplementedError
+def flops(*a, **k):
+    raise NotImplementedError

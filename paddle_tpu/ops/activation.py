@@ -1,0 +1,212 @@
+"""Activation functions.
+
+Reference parity: ``paddle/fluid/operators/activation_op.cc`` (~40
+activations) + softmax ops.  XLA fuses these into surrounding matmuls;
+no hand-written kernels needed except where pallas fusions take over
+(see ops/pallas/).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "sigmoid", "tanh", "softmax",
+    "log_softmax", "silu", "swish", "hardswish", "hardsigmoid", "hardtanh",
+    "hardshrink", "softshrink", "tanhshrink", "leaky_relu", "elu", "celu",
+    "selu", "softplus", "softsign", "mish", "prelu", "rrelu", "glu",
+    "maxout", "thresholded_relu", "log_sigmoid", "gumbel_softmax",
+    "temperature_softmax",
+]
+
+
+def _unary(op_name, fn):
+    def op(x, name=None):
+        return dispatch(op_name, fn, (to_tensor(x),), {})
+    op.__name__ = op_name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+softsign = _unary("softsign", jax.nn.soft_sign)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+mish = _unary("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+tanhshrink = _unary("tanhshrink", lambda a: a - jnp.tanh(a))
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data = out._data
+    return x
+
+
+def gelu(x, approximate=False, name=None):
+    x = to_tensor(x)
+    return dispatch("gelu",
+                    lambda a: jax.nn.gelu(a, approximate=approximate), (x,), {})
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = to_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return dispatch("softmax", lambda a: jax.nn.softmax(a, axis=axis), (x,), {})
+
+
+def temperature_softmax(x, temperature=1.0, axis=-1):
+    x = to_tensor(x)
+    return dispatch("temperature_softmax",
+                    lambda a: jax.nn.softmax(a / temperature, axis=axis), (x,), {})
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = to_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return dispatch("log_softmax",
+                    lambda a: jax.nn.log_softmax(a, axis=axis), (x,), {})
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def hardswish(x, name=None):
+    x = to_tensor(x)
+    return dispatch("hardswish",
+                    lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, (x,), {})
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    x = to_tensor(x)
+    return dispatch("hardsigmoid",
+                    lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), (x,), {})
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    x = to_tensor(x)
+    return dispatch("hardtanh", lambda a: jnp.clip(a, min, max), (x,), {})
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = to_tensor(x)
+    return dispatch("hardshrink",
+                    lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), (x,), {})
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = to_tensor(x)
+    return dispatch(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        (x,), {})
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = to_tensor(x)
+    return dispatch("leaky_relu",
+                    lambda a: jax.nn.leaky_relu(a, negative_slope), (x,), {})
+
+
+def elu(x, alpha=1.0, name=None):
+    x = to_tensor(x)
+    return dispatch("elu", lambda a: jax.nn.elu(a, alpha), (x,), {})
+
+
+def celu(x, alpha=1.0, name=None):
+    x = to_tensor(x)
+    return dispatch("celu", lambda a: jax.nn.celu(a, alpha), (x,), {})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    x = to_tensor(x)
+    return dispatch(
+        "selu",
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), (x,), {})
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    x = to_tensor(x)
+    return dispatch(
+        "softplus",
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jnp.logaddexp(beta * a, 0.0) / beta), (x,), {})
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = to_tensor(x), to_tensor(weight)
+
+    def impl(a, w):
+        if w.size > 1 and a.ndim > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+    return dispatch("prelu", impl, (x, weight), {})
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=False, name=None):
+    x = to_tensor(x)
+    if training:
+        from ..core.random import default_generator
+        key = default_generator.next_key()
+        slope = jax.random.uniform(key, x._data.shape, x._data.dtype,
+                                   lower, upper)
+    else:
+        slope = (lower + upper) / 2.0
+
+    def impl(a):
+        return jnp.where(a >= 0, a, slope * a)
+    return dispatch("rrelu", impl, (x,), {})
+
+
+def glu(x, axis=-1, name=None):
+    x = to_tensor(x)
+
+    def impl(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return dispatch("glu", impl, (x,), {})
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = to_tensor(x)
+
+    def impl(a):
+        shape = list(a.shape)
+        c = shape[axis]
+        shape[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(shape), axis=axis + 1)
+    return dispatch("maxout", impl, (x,), {})
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    x = to_tensor(x)
+    return dispatch("thresholded_relu",
+                    lambda a: jnp.where(a > threshold, a, 0.0), (x,), {})
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..core.random import default_generator
+    x = to_tensor(x)
+    key = default_generator.next_key()
+
+    def impl(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            y_hard = jnp.moveaxis(
+                jax.nn.one_hot(idx, y.shape[axis], dtype=y.dtype), -1, axis)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return dispatch("gumbel_softmax", impl, (x,), {})

@@ -1,0 +1,56 @@
+"""AMP support ops.
+
+Reference parity: ``operators/amp/check_finite_and_unscale_op.cu`` and
+``operators/amp/update_loss_scaling_op.cu`` (dynamic loss-scale state
+machine).  Pure jnp — these run fused inside the optimizer step under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["check_finite_and_unscale", "update_loss_scaling"]
+
+
+def check_finite_and_unscale(xs, scale):
+    """Divide each grad by scale; report if any is non-finite.
+
+    Returns (unscaled_xs, found_inf).
+    """
+    scale_arr = to_tensor(scale)._data
+    found = jnp.asarray(False)
+    outs = []
+    for x in xs:
+        a = to_tensor(x)._data
+        finite = jnp.all(jnp.isfinite(a))
+        found = jnp.logical_or(found, jnp.logical_not(finite))
+        outs.append(Tensor(a / scale_arr))
+    return outs, Tensor(found)
+
+
+def update_loss_scaling(found_inf, prev_loss_scaling, num_good_steps,
+                        num_bad_steps, incr_every_n_steps,
+                        decr_every_n_nan_or_inf, incr_ratio, decr_ratio):
+    """Dynamic loss-scale state machine (pure functional form).
+
+    State: (loss_scaling, good_steps, bad_steps) — all jnp scalars so the
+    whole machine stays on-device and jit-safe.
+    """
+    found = to_tensor(found_inf)._data
+    scale = to_tensor(prev_loss_scaling)._data
+    good = to_tensor(num_good_steps)._data
+    bad = to_tensor(num_bad_steps)._data
+
+    new_bad = jnp.where(found, bad + 1, 0)
+    new_good = jnp.where(found, 0, good + 1)
+
+    should_decr = new_bad >= decr_every_n_nan_or_inf
+    should_incr = new_good >= incr_every_n_steps
+
+    new_scale = jnp.where(should_decr, jnp.maximum(scale * decr_ratio, 1.0),
+                          jnp.where(should_incr, scale * incr_ratio, scale))
+    new_good = jnp.where(should_incr | should_decr, 0, new_good)
+    new_bad = jnp.where(should_incr | should_decr, 0, new_bad)
+    return (Tensor(new_scale), Tensor(new_good.astype(jnp.int32)),
+            Tensor(new_bad.astype(jnp.int32)))

@@ -1,0 +1,429 @@
+"""Convolution / pooling / vision ops.
+
+Reference parity: ``operators/conv_op.*`` (cudnn+gemm paths), pool ops,
+interpolate.  TPU-first: `lax.conv_general_dilated` is the single conv
+primitive — XLA tiles it onto the MXU directly; layout NCHW/NHWC is a
+dimension-numbers annotation, not a data copy.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "max_pool1d", "max_pool2d", "max_pool3d",
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "adaptive_avg_pool1d",
+    "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool2d",
+    "interpolate", "upsample", "pixel_shuffle", "unfold", "grid_sample",
+]
+
+
+def _tuplen(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _conv_dn(nd, channel_last):
+    # dimension numbers for lax.conv_general_dilated
+    if nd == 1:
+        return ("NCW", "OIW", "NCW") if not channel_last else ("NWC", "OIW", "NWC")
+    if nd == 2:
+        return ("NCHW", "OIHW", "NCHW") if not channel_last else ("NHWC", "OIHW", "NHWC")
+    return ("NCDHW", "OIDHW", "NCDHW") if not channel_last else ("NDHWC", "OIDHW", "NDHWC")
+
+
+def _norm_padding(padding, nd, stride, kernel, dilation):
+    """paddle padding: int | list | 'SAME' | 'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding),) * 2] * nd
+    padding = list(padding)
+    if len(padding) == nd:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuplen(stride, nd)
+    dilation = _tuplen(dilation, nd)
+    kernel = weight.shape[2:]
+    pad = _norm_padding(padding, nd, stride, kernel, dilation)
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        _conv_dn(nd, channel_last))
+
+    tensors = [x, weight] + ([bias] if bias is not None else [])
+
+    def impl(a, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[dn.out_spec.index(1) if hasattr(dn, 'out_spec') else
+                   (out.ndim - 1 if channel_last else 1)] = b.size
+            out = out + b.reshape(bshape)
+        return out
+    return dispatch(f"conv{nd}d", impl, tensors, {})
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    x, weight = to_tensor(x), to_tensor(weight)
+    bias = to_tensor(bias) if bias is not None else None
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    x, weight = to_tensor(x), to_tensor(weight)
+    bias = to_tensor(bias) if bias is not None else None
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    x, weight = to_tensor(x), to_tensor(weight)
+    bias = to_tensor(bias) if bias is not None else None
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, nd, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuplen(stride, nd)
+    dilation = _tuplen(dilation, nd)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pads = _norm_padding(padding, nd, stride, weight.shape[2:], dilation)
+    out_pad = _tuplen(output_padding, nd)
+    kernel = weight.shape[2:]
+    # gradient-of-conv formulation: lhs_dilation = stride
+    trans_pads = []
+    for i in range(nd):
+        k = (kernel[i] - 1) * dilation[i] + 1
+        lo = k - 1 - pads[i][0]
+        hi = k - 1 - pads[i][1] + out_pad[i]
+        trans_pads.append((lo, hi))
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        _conv_dn(nd, channel_last))
+    tensors = [x, weight] + ([bias] if bias is not None else [])
+
+    def impl(a, w, *rest):
+        # weight layout (in, out/groups, *k) -> flip spatial + swap io
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        wt = jnp.swapaxes(wt, 0, 1)
+        if groups > 1:
+            # (out/g, in, *k) with in split across groups
+            ci = a.shape[dn.lhs_spec[1]]
+            wt = wt.reshape(groups, wt.shape[0], wt.shape[1], *kernel)
+            wt = jnp.concatenate(list(wt), axis=0)
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1,) * nd, padding=trans_pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[out.ndim - 1 if channel_last else 1] = b.size
+            out = out + b.reshape(bshape)
+        return out
+    return dispatch(f"conv{nd}d_transpose", impl, tensors, {})
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", name=None):
+    x, weight = to_tensor(x), to_tensor(weight)
+    bias = to_tensor(bias) if bias is not None else None
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, df)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW", output_size=None, name=None):
+    x, weight = to_tensor(x), to_tensor(weight)
+    bias = to_tensor(bias) if bias is not None else None
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    x, weight = to_tensor(x), to_tensor(weight)
+    bias = to_tensor(bias) if bias is not None else None
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format)
+
+
+# ---------------------------------------------------------------------------
+# pooling: lax.reduce_window
+# ---------------------------------------------------------------------------
+def _pool(x, kernel, stride, padding, nd, data_format, mode,
+          ceil_mode=False, exclusive=True):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    kernel = _tuplen(kernel, nd)
+    stride = _tuplen(stride if stride is not None else kernel, nd)
+    pads = _norm_padding(padding, nd, stride, kernel, (1,) * nd)
+
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pad_cfg = ([(0, 0)] + list(pads) + [(0, 0)]) if not isinstance(pads, str) else pads
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pad_cfg = ([(0, 0), (0, 0)] + list(pads)) if not isinstance(pads, str) else pads
+
+    def impl(a):
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window, strides,
+                                         pad_cfg)
+        # avg
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add,
+                                       window, strides, pad_cfg)
+        if exclusive and not isinstance(pad_cfg, str):
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides, pad_cfg)
+            return summed / counts
+        denom = 1.0
+        for k in kernel:
+            denom *= k
+        return summed / denom
+    return impl
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    x = to_tensor(x)
+    impl = _pool(x, kernel_size, stride, padding, 2, data_format, "max",
+                 ceil_mode)
+    out = dispatch("max_pool2d", impl, (x,), {})
+    if return_mask:
+        raise NotImplementedError("max_pool2d return_mask on TPU path")
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    x = to_tensor(x)
+    impl = _pool(x, kernel_size, stride, padding, 1, "NCW", "max", ceil_mode)
+    return dispatch("max_pool1d", impl, (x,), {})
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    x = to_tensor(x)
+    impl = _pool(x, kernel_size, stride, padding, 3, data_format, "max",
+                 ceil_mode)
+    return dispatch("max_pool3d", impl, (x,), {})
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    x = to_tensor(x)
+    impl = _pool(x, kernel_size, stride, padding, 1, "NCW", "avg", ceil_mode,
+                 exclusive)
+    return dispatch("avg_pool1d", impl, (x,), {})
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    x = to_tensor(x)
+    impl = _pool(x, kernel_size, stride, padding, 2, data_format, "avg",
+                 ceil_mode, exclusive)
+    return dispatch("avg_pool2d", impl, (x,), {})
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    x = to_tensor(x)
+    impl = _pool(x, kernel_size, stride, padding, 3, data_format, "avg",
+                 ceil_mode, exclusive)
+    return dispatch("avg_pool3d", impl, (x,), {})
+
+
+def _adaptive_avg(x, output_size, nd, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    out_sizes = _tuplen(output_size, nd)
+    spatial_axes = tuple(range(2, 2 + nd)) if not channel_last else tuple(range(1, 1 + nd))
+
+    def impl(a):
+        out = a
+        for ax, osz in zip(spatial_axes, out_sizes):
+            isz = out.shape[ax]
+            if isz % osz == 0:
+                k = isz // osz
+                new_shape = out.shape[:ax] + (osz, k) + out.shape[ax + 1:]
+                out = out.reshape(new_shape).mean(axis=ax + 1)
+            else:
+                # general adaptive bins
+                starts = (np.arange(osz) * isz) // osz
+                ends = ((np.arange(osz) + 1) * isz + osz - 1) // osz
+                pieces = [jnp.take(out, jnp.arange(s, e), axis=ax).mean(
+                    axis=ax, keepdims=True) for s, e in zip(starts, ends)]
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+    return impl
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    x = to_tensor(x)
+    return dispatch("adaptive_avg_pool1d",
+                    _adaptive_avg(x, output_size, 1, "NCW"), (x,), {})
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    x = to_tensor(x)
+    return dispatch("adaptive_avg_pool2d",
+                    _adaptive_avg(x, output_size, 2, data_format), (x,), {})
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    x = to_tensor(x)
+    return dispatch("adaptive_avg_pool3d",
+                    _adaptive_avg(x, output_size, 3, data_format), (x,), {})
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    x = to_tensor(x)
+    out_sizes = _tuplen(output_size, 2)
+
+    def impl(a):
+        out = a
+        for ax, osz in zip((2, 3), out_sizes):
+            isz = out.shape[ax]
+            k = isz // osz
+            new_shape = out.shape[:ax] + (osz, k) + out.shape[ax + 1:]
+            out = out.reshape(new_shape).max(axis=ax + 1)
+        return out
+    return dispatch("adaptive_max_pool2d", impl, (x,), {})
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = to_tensor(x)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    nd = x.ndim - 2
+    spatial = x.shape[1:1 + nd] if channel_last else x.shape[2:2 + nd]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * nd
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    else:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def impl(a):
+        if channel_last:
+            full = (a.shape[0],) + tuple(size) + (a.shape[-1],)
+        else:
+            full = a.shape[:2] + tuple(size)
+        return jax.image.resize(a, full, method=jmode)
+    return dispatch("interpolate", impl, (x,), {})
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = to_tensor(x)
+    r = upscale_factor
+
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return dispatch("pixel_shuffle", impl, (x,), {})
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = to_tensor(x)
+    k = _tuplen(kernel_sizes, 2)
+    s = _tuplen(strides, 2)
+    p = _tuplen(paddings, 2)
+    d = _tuplen(dilations, 2)
+
+    def impl(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        oh = (a.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (a.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                sl = a[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                       j * d[1]: j * d[1] + ow * s[1]: s[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # n, c, k0*k1, oh, ow
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+    return dispatch("unfold", impl, (x,), {})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    x, grid = to_tensor(x), to_tensor(grid)
+
+    def impl(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * (w - 1) / 2
+            iy = (gy + 1) * (h - 1) / 2
+        else:
+            ix = ((gx + 1) * w - 1) / 2
+            iy = ((gy + 1) * h - 1) / 2
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+
+        def sample(xi, yi):
+            xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            out = a[jnp.arange(n)[:, None, None], :, yi_c, xi_c]
+            out = jnp.moveaxis(out, -1, 1)
+            if padding_mode == "zeros":
+                valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
+                out = out * valid[:, None, :, :]
+            return out
+        wa = ((x1 - ix) * (y1 - iy))[:, None]
+        wb = ((x1 - ix) * (iy - y0))[:, None]
+        wc = ((ix - x0) * (y1 - iy))[:, None]
+        wd = ((ix - x0) * (iy - y0))[:, None]
+        if mode == "nearest":
+            return sample(jnp.round(ix), jnp.round(iy))
+        return (sample(x0, y0) * wa + sample(x0, y1) * wb +
+                sample(x1, y0) * wc + sample(x1, y1) * wd)
+    return dispatch("grid_sample", impl, (x, grid), {})

@@ -1,0 +1,197 @@
+"""Tensor creation ops.
+
+Reference parity: fill_constant / gaussian_random / uniform_random /
+range / eye / linspace op kernels under ``paddle/fluid/operators/``.
+All creation lowers straight to jnp (XLA constants / RNG HLOs).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import dtype_to_jnp
+from ..core.random import default_generator
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtype import dtype_to_jnp as _dtype_to_jnp
+
+_int64 = _dtype_to_jnp("int64")
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "eye", "rand", "randn", "randint",
+    "randperm", "uniform", "normal", "bernoulli", "multinomial", "assign",
+    "clone", "diag", "tril", "triu", "meshgrid", "numel",
+]
+
+
+def _dt(dtype, default=jnp.float32):
+    return dtype_to_jnp(dtype) if dtype is not None else default
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.zeros_like(x._data, dtype=_dt(dtype, x._data.dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.ones_like(x._data, dtype=_dt(dtype, x._data.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=_dt(dtype, x._data.dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or "float32"
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.arange(_v(start), _v(end), _v(step), dtype=_dt(dtype, None)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def rand(shape, dtype=None, name=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = default_generator.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high,
+                                     dtype=_dt(dtype, _int64)))
+
+
+def randperm(n, dtype=None, name=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(_dt(dtype, _int64)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else default_generator.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+    else:
+        m, s, shp = mean, std, _shape(shape if shape is not None else (1,))
+    key = default_generator.next_key()
+    return Tensor(jax.random.normal(key, shp) * s + m)
+
+
+def bernoulli(x, name=None):
+    x = to_tensor(x)
+    key = default_generator.next_key()
+    return Tensor(jax.random.bernoulli(key, x._data).astype(x._data.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = to_tensor(x)
+    key = default_generator.next_key()
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(*logits.shape[:-1], num_samples))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(_int64))
+
+
+def assign(x, output=None):
+    from ..core.dispatch import dispatch
+    x = to_tensor(x)
+    out = dispatch("assign", lambda a: a + 0, (x,), {})
+    if output is not None:
+        output.set_value(out._data)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = to_tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, x._data.dtype)
+        return Tensor(base + jnp.diag(x._data - padding_value, k=offset))
+    return Tensor(jnp.diag(x._data, k=offset))
+
+
+def tril(x, diagonal=0, name=None):
+    from ..core.dispatch import dispatch
+    return dispatch("tril", lambda a: jnp.tril(a, diagonal), (to_tensor(x),), {})
+
+
+def triu(x, diagonal=0, name=None):
+    from ..core.dispatch import dispatch
+    return dispatch("triu", lambda a: jnp.triu(a, diagonal), (to_tensor(x),), {})
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [to_tensor(a)._data for a in (args[0] if len(args) == 1 and
+              isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(to_tensor(x)._data.size, dtype=_int64))

@@ -1,0 +1,271 @@
+"""Linear algebra ops — the MXU workhorses.
+
+Reference parity: ``operators/matmul_v2_op.*`` (cuBLAS), ``operators/math/blas.h``
+and the linalg suite (svd/cholesky/eig/...).  On TPU every matmul lowers to
+MXU systolic ops; precision is steered by FLAGS_matmul_precision
+(bf16-in/fp32-accumulate is the hardware default).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtype import dtype_to_jnp as _dtype_to_jnp
+
+_int64 = _dtype_to_jnp("int64")
+from ..utils import flags
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "transpose_matmul", "norm", "dist",
+    "cross", "cholesky", "solve", "triangular_solve", "cholesky_solve",
+    "inverse", "pinv", "svd", "qr", "lu", "eig", "eigh", "eigvals",
+    "eigvalsh", "det", "slogdet", "matrix_rank", "matrix_power",
+    "multi_dot", "histogram", "mv", "lstsq", "cov", "corrcoef", "einsum",
+]
+
+
+def _precision():
+    p = flags.get_flag("FLAGS_matmul_precision")
+    return None if p == "default" else p
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = to_tensor(x), to_tensor(y)
+    prec = _precision()
+
+    def impl(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=prec)
+    return dispatch("matmul", impl, (x, y), {})
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def dot(x, y, name=None):
+    x, y = to_tensor(x), to_tensor(y)
+    return dispatch("dot", lambda a, b: jnp.sum(a * b, axis=-1), (x, y), {})
+
+
+def t(input, name=None):
+    input = to_tensor(input)
+    if input.ndim < 2:
+        return input
+    from .manipulation import transpose
+    return transpose(input, perm=[1, 0])
+
+
+transpose_matmul = t
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = to_tensor(x)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+
+    def impl(a):
+        if p == "fro":
+            ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                                 keepdims=keepdim), 1.0 / p)
+    return dispatch("norm", impl, (x,), {})
+
+
+def dist(x, y, p=2, name=None):
+    x, y = to_tensor(x), to_tensor(y)
+
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == float("-inf"):
+            return jnp.min(d)
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+    return dispatch("dist", impl, (x, y), {})
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = to_tensor(x), to_tensor(y)
+    ax = axis if axis != 9 else next(
+        (i for i, s in enumerate(x.shape) if s == 3), -1)
+    return dispatch("cross", lambda a, b: jnp.cross(a, b, axis=ax), (x, y), {})
+
+
+def _linalg_unary(op_name, fn):
+    def op(x, name=None):
+        return dispatch(op_name, fn, (to_tensor(x),), {})
+    op.__name__ = op_name
+    return op
+
+
+cholesky_impl = lambda a, upper=False: (
+    jnp.linalg.cholesky(a) if not upper
+    else jnp.swapaxes(jnp.linalg.cholesky(jnp.swapaxes(a, -1, -2)), -1, -2))
+
+
+def cholesky(x, upper=False, name=None):
+    x = to_tensor(x)
+    return dispatch("cholesky", lambda a: cholesky_impl(a, upper), (x,), {})
+
+
+def solve(x, y, name=None):
+    x, y = to_tensor(x), to_tensor(y)
+    return dispatch("solve", jnp.linalg.solve, (x, y), {})
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    x, y = to_tensor(x), to_tensor(y)
+
+    def impl(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return dispatch("triangular_solve", impl, (x, y), {})
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = to_tensor(x), to_tensor(y)
+
+    def impl(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return dispatch("cholesky_solve", impl, (x, y), {})
+
+
+inverse = _linalg_unary("inverse", jnp.linalg.inv)
+pinv_impl = jnp.linalg.pinv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    x = to_tensor(x)
+    return dispatch("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                                      hermitian=hermitian), (x,), {})
+
+
+def svd(x, full_matrices=False, name=None):
+    x = to_tensor(x)
+    u, s, vh = jnp.linalg.svd(x._data, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2).conj())
+
+
+def qr(x, mode="reduced", name=None):
+    x = to_tensor(x)
+    out = jnp.linalg.qr(x._data, mode=mode)
+    if mode == "r":
+        return Tensor(out)
+    return Tensor(out[0]), Tensor(out[1])
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = to_tensor(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(x._data)
+    outs = [Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1)]
+    if get_infos:
+        outs.append(Tensor(jnp.zeros((), jnp.int32)))
+    return tuple(outs)
+
+
+def eig(x, name=None):
+    import numpy as np
+    a = np.asarray(to_tensor(x)._data)
+    w, v = np.linalg.eig(a)  # XLA lacks nonsymmetric eig on TPU; host fallback
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = to_tensor(x)
+    w, v = jnp.linalg.eigh(x._data, UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    a = np.asarray(to_tensor(x)._data)
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.linalg.eigvalsh(x._data, UPLO=UPLO))
+
+
+det = _linalg_unary("det", jnp.linalg.det)
+
+
+def slogdet(x, name=None):
+    x = to_tensor(x)
+    sign, logd = jnp.linalg.slogdet(x._data)
+    return Tensor(jnp.stack([sign, logd]))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(x._data, rtol=tol))
+
+
+def matrix_power(x, n, name=None):
+    x = to_tensor(x)
+    return dispatch("matrix_power",
+                    lambda a: jnp.linalg.matrix_power(a, n), (x,), {})
+
+
+def multi_dot(x, name=None):
+    tensors = [to_tensor(t) for t in x]
+    return dispatch("multi_dot", lambda *a: jnp.linalg.multi_dot(a), tensors, {})
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = to_tensor(input)
+    a = input._data
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    hist, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(_int64))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = to_tensor(x), to_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._data, y._data, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = to_tensor(x)
+    fw = to_tensor(fweights)._data if fweights is not None else None
+    aw = to_tensor(aweights)._data if aweights is not None else None
+    return Tensor(jnp.cov(x._data, rowvar=rowvar, ddof=1 if ddof else 0,
+                          fweights=fw, aweights=aw))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.corrcoef(x._data, rowvar=rowvar))
+
+
+def einsum(equation, *operands):
+    tensors = [to_tensor(o) for o in operands]
+    return dispatch("einsum",
+                    lambda *a: jnp.einsum(equation, *a, precision=_precision()),
+                    tensors, {})

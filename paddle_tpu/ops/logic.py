@@ -1,0 +1,86 @@
+"""Comparison / logical / predicate ops (no grads flow through these).
+
+Reference parity: ``operators/controlflow/compare_op.cc``, logical ops,
+isfinite ops (``operators/isfinite_op.cc``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "isnan", "isinf",
+    "isfinite", "is_empty", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not",
+]
+
+
+def _pair(x, y):
+    x = to_tensor(x)
+    y = y if isinstance(y, Tensor) else to_tensor(
+        jnp.asarray(y, dtype=x.dtype) if isinstance(y, (int, float, bool)) else y)
+    return x._data, y._data
+
+
+def _cmp(op_name, fn):
+    def op(x, y, name=None):
+        a, b = _pair(x, y)
+        return Tensor(fn(a, b))
+    op.__name__ = op_name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return Tensor(jnp.logical_not(to_tensor(x)._data))
+
+
+def bitwise_not(x, name=None):
+    return Tensor(jnp.bitwise_not(to_tensor(x)._data))
+
+
+def equal_all(x, y, name=None):
+    a, b = _pair(x, y)
+    return Tensor(jnp.array_equal(a, b))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    a, b = _pair(x, y)
+    return Tensor(jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    a, b = _pair(x, y)
+    return Tensor(jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(to_tensor(x)._data))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(to_tensor(x)._data))
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(to_tensor(x)._data))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(to_tensor(x)._data.size == 0))

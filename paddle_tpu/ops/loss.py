@@ -1,0 +1,328 @@
+"""Loss functional ops.
+
+Reference parity: ``operators/softmax_with_cross_entropy_op.*``,
+cross_entropy / bce / kldiv / smooth_l1 / margin losses, label_smooth.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "label_smooth", "square_error_cost",
+    "sigmoid_focal_loss", "log_loss", "huber_loss", "triplet_margin_loss",
+    "ctc_loss", "one_hot",
+]
+
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def one_hot(x, num_classes, name=None):
+    x = to_tensor(x)
+    return Tensor(jax.nn.one_hot(x._data, num_classes, dtype=jnp.float32))
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input, label = to_tensor(input), to_tensor(label)
+    tensors = [input, label]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(to_tensor(weight))
+
+    def impl(logits, lbl, *w):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else \
+            jnp.log(jnp.maximum(logits, 1e-30))
+        nclass = logits.shape[axis]
+        if soft_label:
+            soft = lbl
+        else:
+            idx = lbl
+            if idx.ndim == logp.ndim and idx.shape[axis] == 1:
+                idx = jnp.squeeze(idx, axis=axis)
+            soft = jax.nn.one_hot(idx, nclass, dtype=logp.dtype, axis=axis)
+        if label_smoothing > 0.0:
+            soft = soft * (1.0 - label_smoothing) + label_smoothing / nclass
+        loss = -jnp.sum(soft * logp, axis=axis)
+        if not soft_label:
+            idx = lbl
+            if idx.ndim == logp.ndim and idx.shape[axis] == 1:
+                idx = jnp.squeeze(idx, axis=axis)
+            valid = (idx != ignore_index)
+            loss = jnp.where(valid, loss, 0.0)
+            if w:
+                loss = loss * jnp.take(w[0], jnp.clip(idx, 0, nclass - 1))
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(
+                    (w[0][jnp.clip(idx, 0, nclass - 1)] if w else
+                     jnp.ones_like(loss)) * valid), 1e-12)
+                return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+    return dispatch("cross_entropy", impl, tensors, {})
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    logits, label = to_tensor(logits), to_tensor(label)
+
+    def impl(lg, lb):
+        sm = jax.nn.softmax(lg, axis=axis)
+        logp = jax.nn.log_softmax(lg, axis=axis)
+        if soft_label:
+            loss = -jnp.sum(lb * logp, axis=axis, keepdims=True)
+        else:
+            idx = lb
+            if idx.ndim == lg.ndim and idx.shape[axis] == 1:
+                idx = jnp.squeeze(idx, axis=axis)
+            oh = jax.nn.one_hot(idx, lg.shape[axis], dtype=logp.dtype, axis=axis)
+            loss = -jnp.sum(oh * logp, axis=axis, keepdims=True)
+            loss = jnp.where(jnp.expand_dims(idx, axis) != ignore_index, loss, 0.0)
+        return (loss, sm)
+    loss, sm = dispatch("softmax_with_cross_entropy", impl, (logits, label), {})
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = to_tensor(input), to_tensor(label)
+    return dispatch("mse_loss",
+                    lambda a, b: _reduce_loss(jnp.square(a - b), reduction),
+                    (input, label), {})
+
+
+def square_error_cost(input, label):
+    input, label = to_tensor(input), to_tensor(label)
+    return dispatch("square_error_cost",
+                    lambda a, b: jnp.square(a - b), (input, label), {})
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = to_tensor(input), to_tensor(label)
+    return dispatch("l1_loss",
+                    lambda a, b: _reduce_loss(jnp.abs(a - b), reduction),
+                    (input, label), {})
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = to_tensor(input), to_tensor(label)
+    tensors = [input, label]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(to_tensor(weight))
+
+    def impl(logp, idx, *w):
+        nclass = logp.shape[1]
+        oh = jax.nn.one_hot(idx, nclass, dtype=logp.dtype, axis=1)
+        loss = -jnp.sum(oh * logp, axis=1)
+        valid = idx != ignore_index
+        wgt = jnp.take(w[0], jnp.clip(idx, 0, nclass - 1)) if w else jnp.ones_like(loss)
+        loss = jnp.where(valid, loss * wgt, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(wgt * valid), 1e-12)
+        return _reduce_loss(loss, reduction)
+    return dispatch("nll_loss", impl, tensors, {})
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    input, label = to_tensor(input), to_tensor(label)
+    tensors = [input, label]
+    if weight is not None:
+        tensors.append(to_tensor(weight))
+
+    def impl(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce_loss(loss, reduction)
+    return dispatch("bce", impl, tensors, {})
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    logit, label = to_tensor(logit), to_tensor(label)
+    tensors = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        tensors.append(to_tensor(weight))
+    if has_pw:
+        tensors.append(to_tensor(pos_weight))
+
+    def impl(z, y, *rest):
+        i = 0
+        w = rest[i] if has_w else None
+        if has_w:
+            i += 1
+        pw = rest[i] if has_pw else None
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)) with pos_weight factor
+        log_sig_pos = -jax.nn.softplus(-z)
+        log_sig_neg = -z - jax.nn.softplus(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig_pos + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig_pos + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+    return dispatch("bce_with_logits", impl, tensors, {})
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    input, label = to_tensor(input), to_tensor(label)
+
+    def impl(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+    return dispatch("kl_div", impl, (input, label), {})
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = to_tensor(input), to_tensor(label)
+
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+    return dispatch("smooth_l1", impl, (input, label), {})
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    input, label = to_tensor(input), to_tensor(label)
+
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce_loss(loss, reduction)
+    return dispatch("huber_loss", impl, (input, label), {})
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    input, other, label = to_tensor(input), to_tensor(other), to_tensor(label)
+
+    def impl(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce_loss(loss, reduction)
+    return dispatch("margin_ranking", impl, (input, other, label), {})
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    input, label = to_tensor(input), to_tensor(label)
+
+    def impl(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce_loss(loss, reduction)
+    return dispatch("hinge_embedding", impl, (input, label), {})
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    input1, input2, label = to_tensor(input1), to_tensor(input2), to_tensor(label)
+
+    def impl(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(loss, reduction)
+    return dispatch("cosine_embedding", impl, (input1, input2, label), {})
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-06, swap=False, reduction="mean", name=None):
+    input, positive, negative = (to_tensor(input), to_tensor(positive),
+                                 to_tensor(negative))
+
+    def impl(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p),
+                                     axis=-1), 1.0 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_an = jnp.minimum(d_an, dist(pos, neg))
+        loss = jnp.maximum(0.0, d_ap - d_an + margin)
+        return _reduce_loss(loss, reduction)
+    return dispatch("triplet_margin", impl, (input, positive, negative), {})
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = to_tensor(label)
+    tensors = [label]
+    if prior_dist is not None:
+        tensors.append(to_tensor(prior_dist))
+
+    def impl(y, *pd):
+        n = y.shape[-1]
+        uniform = pd[0] if pd else 1.0 / n
+        return (1.0 - epsilon) * y + epsilon * uniform
+    return dispatch("label_smooth", impl, tensors, {})
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = to_tensor(logit), to_tensor(label)
+    tensors = [logit, label]
+    if normalizer is not None:
+        tensors.append(to_tensor(normalizer))
+
+    def impl(z, y, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jax.nn.softplus(-z) * y + jax.nn.softplus(z) * (1 - y)
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nrm:
+            loss = loss / nrm[0]
+        return _reduce_loss(loss, reduction)
+    return dispatch("sigmoid_focal", impl, tensors, {})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    input, label = to_tensor(input), to_tensor(label)
+
+    def impl(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return dispatch("log_loss", impl, (input, label), {})
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax's implementation (jax-native forward-backward)."""
+    import optax
+    log_probs = to_tensor(log_probs)  # (T, N, C) paddle layout
+    labels = to_tensor(labels)
+    input_lengths = to_tensor(input_lengths)
+    label_lengths = to_tensor(label_lengths)
+
+    def impl(lp, lb, il, ll):
+        # optax wants (N, T, C) logits + paddings
+        logits = jnp.transpose(lp, (1, 0, 2))
+        t = logits.shape[1]
+        logit_pad = (jnp.arange(t)[None, :] >= il[:, None]).astype(jnp.float32)
+        lmax = lb.shape[1]
+        label_pad = (jnp.arange(lmax)[None, :] >= ll[:, None]).astype(jnp.float32)
+        loss = optax.ctc_loss(logits, logit_pad, lb, label_pad, blank_id=blank)
+        return _reduce_loss(loss, reduction)
+    return dispatch("ctc_loss", impl,
+                    (log_probs, labels, input_lengths, label_lengths), {})

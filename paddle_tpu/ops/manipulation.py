@@ -1,0 +1,470 @@
+"""Shape/layout manipulation ops.
+
+Reference parity: reshape/transpose/concat/split/gather/scatter/... kernels
+under ``paddle/fluid/operators/``.  All are XLA metadata ops or fused
+gathers; autograd recorded via dispatch.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+slice_builtin = builtins.slice
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtype import dtype_to_jnp as _dtype_to_jnp
+
+_int64 = _dtype_to_jnp("int64")
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "concat", "stack", "split", "chunk",
+    "squeeze", "unsqueeze", "flatten", "expand", "expand_as", "tile",
+    "broadcast_to", "gather", "gather_nd", "scatter", "scatter_nd_add",
+    "put_along_axis", "take_along_axis", "index_select", "index_sample",
+    "masked_select", "slice", "strided_slice", "flip", "roll", "rot90",
+    "unbind", "topk", "sort", "argsort", "unique", "unique_consecutive",
+    "nonzero", "where", "pad", "shard_index", "unstack", "repeat_interleave",
+    "moveaxis", "swapaxes", "as_complex", "as_real", "crop", "tensordot",
+    "searchsorted", "bincount", "tolist", "cast",
+]
+
+
+def cast(x, dtype=None, name=None):
+    from ..core.dtype import dtype_to_jnp
+    x = to_tensor(x)
+    jd = dtype_to_jnp(dtype)
+    return dispatch("cast", lambda a: a.astype(jd), (x,), {})
+
+
+def reshape(x, shape, name=None):
+    x = to_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = tuple(int(s) for s in shape)
+    return dispatch("reshape", lambda a: jnp.reshape(a, shape), (x,), {})
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data = out._data
+    return x
+
+
+def transpose(x, perm=None, name=None):
+    x = to_tensor(x)
+    p = tuple(perm) if perm is not None else None
+    return dispatch("transpose", lambda a: jnp.transpose(a, p), (x,), {})
+
+
+def moveaxis(x, source, destination, name=None):
+    x = to_tensor(x)
+    return dispatch("moveaxis",
+                    lambda a: jnp.moveaxis(a, source, destination), (x,), {})
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    x = to_tensor(x)
+    return dispatch("swapaxes", lambda a: jnp.swapaxes(a, axis1, axis2), (x,), {})
+
+
+def concat(x, axis=0, name=None):
+    tensors = [to_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return dispatch("concat", lambda *a: jnp.concatenate(a, axis=axis),
+                    tensors, {})
+
+
+def stack(x, axis=0, name=None):
+    tensors = [to_tensor(t) for t in x]
+    return dispatch("stack", lambda *a: jnp.stack(a, axis=axis), tensors, {})
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = to_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in num_or_sections]
+        residual = dim - builtins.sum(s for s in sizes if s > 0)
+        sizes = [residual if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def impl(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=axis)
+                     for o, s in zip(offsets, sizes))
+    return list(dispatch("split", impl, (x,), {}))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = to_tensor(x)
+    n = x.shape[axis]
+
+    def impl(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return list(dispatch("unbind", impl, (x,), {}))
+
+
+unstack = unbind
+
+
+def squeeze(x, axis=None, name=None):
+    x = to_tensor(x)
+    if isinstance(axis, (list, tuple)):
+        ax = tuple(a for a in axis if x.shape[a] == 1)
+    elif axis is not None:
+        ax = (axis,) if x.shape[axis] == 1 else ()
+    else:
+        ax = None
+
+    def impl(a):
+        if ax == ():
+            return a
+        return jnp.squeeze(a, axis=ax)
+    return dispatch("squeeze", impl, (x,), {})
+
+
+def unsqueeze(x, axis, name=None):
+    x = to_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return dispatch("unsqueeze", lambda a: jnp.expand_dims(a, ax), (x,), {})
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = to_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    new_shape = x.shape[:s] + [-1] + x.shape[e + 1:]
+    return reshape(x, new_shape)
+
+
+def expand(x, shape, name=None):
+    x = to_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = list(shape)
+    # paddle semantics: -1 keeps the original dim
+    xshape = ([1] * (len(shape) - x.ndim)) + x.shape
+    target = tuple(xs if s == -1 else int(s) for s, xs in zip(shape, xshape))
+    return dispatch("expand", lambda a: jnp.broadcast_to(a, target), (x,), {})
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, to_tensor(y).shape)
+
+
+def tile(x, repeat_times, name=None):
+    x = to_tensor(x)
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    reps = tuple(int(r) for r in repeat_times)
+    return dispatch("tile", lambda a: jnp.tile(a, reps), (x,), {})
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = to_tensor(x)
+    r = repeats.tolist() if isinstance(repeats, Tensor) else repeats
+    return dispatch("repeat_interleave",
+                    lambda a: jnp.repeat(a, r, axis=axis), (x,), {})
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = to_tensor(x), to_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def impl(a, idx):
+        return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis)
+    return dispatch("gather", impl, (x, index), {})
+
+
+def gather_nd(x, index, name=None):
+    x, index = to_tensor(x), to_tensor(index)
+
+    def impl(a, idx):
+        comps = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[comps]
+    return dispatch("gather_nd", impl, (x, index), {})
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = to_tensor(x), to_tensor(index), to_tensor(updates)
+
+    def impl(a, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        return a.at[idx].add(upd)
+    return dispatch("scatter", impl, (x, index, updates), {})
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = to_tensor(x), to_tensor(index), to_tensor(updates)
+
+    def impl(a, idx, upd):
+        comps = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[comps].add(upd)
+    return dispatch("scatter_nd_add", impl, (x, index, updates), {})
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    arr, indices = to_tensor(arr), to_tensor(indices)
+    return dispatch("take_along_axis",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+                    (arr, indices), {})
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr, indices = to_tensor(arr), to_tensor(indices)
+    values = to_tensor(values)
+
+    def impl(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        dims = list(range(a.ndim))
+        idxs = jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij")
+        idxs[axis] = i
+        if reduce == "add":
+            return a.at[tuple(idxs)].add(v)
+        if reduce == "multiply":
+            return a.at[tuple(idxs)].multiply(v)
+        return a.at[tuple(idxs)].set(v)
+    return dispatch("put_along_axis", impl, (arr, indices, values), {})
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = to_tensor(x), to_tensor(index)
+    return dispatch("index_select",
+                    lambda a, i: jnp.take(a, i, axis=axis), (x, index), {})
+
+
+def index_sample(x, index):
+    x, index = to_tensor(x), to_tensor(index)
+    return dispatch("index_sample",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=1), (x, index), {})
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (not jittable) — parity note
+    x, mask = to_tensor(x), to_tensor(mask)
+    out = np.asarray(x._data)[np.asarray(mask._data)]
+    return Tensor(jnp.asarray(out))
+
+
+def slice(input, axes, starts, ends):
+    input = to_tensor(input)
+    sl = [slice_builtin(None)] * input.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(s.item()) if isinstance(s, Tensor) else int(s)
+        e = int(e.item()) if isinstance(e, Tensor) else int(e)
+        sl[ax] = slice_builtin(s, e)
+    idx = tuple(sl)
+    return dispatch("slice", lambda a: a[idx], (input,), {})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = to_tensor(x)
+    sl = [slice_builtin(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[ax] = slice_builtin(int(s), int(e), int(st))
+    idx = tuple(sl)
+    return dispatch("strided_slice", lambda a: a[idx], (x,), {})
+
+
+def flip(x, axis, name=None):
+    x = to_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return dispatch("flip", lambda a: jnp.flip(a, ax), (x,), {})
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = to_tensor(x)
+    return dispatch("roll", lambda a: jnp.roll(a, shifts, axis=axis), (x,), {})
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = to_tensor(x)
+    return dispatch("rot90", lambda a: jnp.rot90(a, k, axes), (x,), {})
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = to_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def impl(a):
+        a2 = jnp.moveaxis(a, axis, -1)
+        src = a2 if largest else -a2
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+    vals, idx = dispatch("topk", impl, (x,), {})
+    idx.stop_gradient = True
+    return vals, Tensor(idx._data.astype(_int64))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    x = to_tensor(x)
+
+    def impl(a):
+        out = jnp.sort(a, axis=axis)
+        return jnp.flip(out, axis) if descending else out
+    return dispatch("sort", impl, (x,), {})
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    x = to_tensor(x)
+    out = jnp.argsort(x._data, axis=axis)
+    if descending:
+        out = jnp.flip(out, axis)
+    return Tensor(out.astype(_int64))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = to_tensor(x)
+    res = jnp.unique(np.asarray(x._data), return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    a = np.asarray(to_tensor(x)._data)
+    if axis is None:
+        a = a.reshape(-1)
+    keep = np.concatenate([[True], a[1:] != a[:-1]]) if a.size else np.array([], bool)
+    out = [Tensor(jnp.asarray(a[keep]))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        out.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, a.size))
+        out.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def nonzero(x, as_tuple=False):
+    x = to_tensor(x)
+    idx = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = to_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    x, y = to_tensor(x), to_tensor(y)
+    return dispatch("where", lambda c, a, b: jnp.where(c, a, b),
+                    (condition, x, y), {})
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = to_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle layout: per-dim (before, after) starting from dim 0
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims, torch-style
+        # (last dim first): [l, r, t, b, ...]
+        widths = [(0, 0)] * nd
+        spatial = list(range(nd))[::-1]
+        for i in range(len(pad) // 2):
+            dim = spatial[i]
+            if data_format in ("NCHW", "NCL", "NCDHW") and nd >= 3:
+                dim = nd - 1 - i
+            widths[dim] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def impl(a):
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return dispatch("pad", impl, (x,), {})
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = to_tensor(x)
+    shape = [int(s) for s in (shape or x.shape)]
+    offsets = [int(o) for o in (offsets or [0] * x.ndim)]
+    idx = tuple(slice_builtin(o, o + (s if s != -1 else x.shape[i] - o))
+                for i, (o, s) in enumerate(zip(offsets, shape)))
+    return dispatch("crop", lambda a: a[idx], (x,), {})
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    input = to_tensor(input)
+    size = index_num // nshards
+
+    def impl(a):
+        shard = a // size
+        return jnp.where(shard == shard_id, a % size, ignore_value)
+    return dispatch("shard_index", impl, (input,), {})
+
+
+def as_complex(x, name=None):
+    x = to_tensor(x)
+    return dispatch("as_complex",
+                    lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,), {})
+
+
+def as_real(x, name=None):
+    x = to_tensor(x)
+    return dispatch("as_real",
+                    lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                    (x,), {})
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = to_tensor(x), to_tensor(y)
+    return dispatch("tensordot", lambda a, b: jnp.tensordot(a, b, axes), (x, y), {})
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    s, v = to_tensor(sorted_sequence), to_tensor(values)
+    side = "right" if right else "left"
+    out = jnp.searchsorted(s._data, v._data, side=side)
+    return Tensor(out.astype(jnp.int32 if out_int32 else _int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = to_tensor(x)
+    w = to_tensor(weights)._data if weights is not None else None
+    n = int(np.asarray(x._data).max()) + 1 if x.size else 0
+    length = max(n, minlength)
+    return Tensor(jnp.bincount(x._data, weights=w, length=length))
+
+
+def tolist(x):
+    return to_tensor(x).tolist()

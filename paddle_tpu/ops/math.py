@@ -1,0 +1,226 @@
+"""Elementwise & scalar math ops.
+
+Reference parity: ``paddle/fluid/operators/elementwise/*`` (broadcast
+engine is XLA's job here), activation_op.cc math subset, clip/scale ops.
+Every op dispatches through core.dispatch so eager autograd is recorded.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "matpow", "maximum", "minimum", "fmax", "fmin",
+    "abs", "neg", "reciprocal", "sign", "sqrt", "rsqrt", "square", "exp",
+    "expm1", "log", "log2", "log10", "log1p", "floor", "ceil", "round",
+    "trunc", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "tanh", "asinh", "acosh", "atanh", "atan2", "erf", "erfinv", "clip",
+    "scale", "lerp", "addmm", "stanh", "rad2deg", "deg2rad", "frac",
+    "digamma", "lgamma", "multiply_", "add_", "subtract_", "clip_",
+    "logit", "nan_to_num", "angle", "conj", "real", "imag", "trace",
+    "kron", "outer", "inner", "heaviside", "diff", "logaddexp",
+]
+
+
+def _coerce_pair(x, y):
+    x = to_tensor(x)
+    if not isinstance(y, Tensor):
+        if isinstance(y, (int, float, bool)) and jnp.issubdtype(x.dtype, jnp.floating):
+            y = Tensor(jnp.asarray(y, dtype=x.dtype))
+        else:
+            y = to_tensor(y)
+    return x, y
+
+
+def _unary(op_name, fn):
+    def op(x, name=None):
+        return dispatch(op_name, fn, (to_tensor(x),), {})
+    op.__name__ = op_name
+    op.__qualname__ = op_name
+    op.__doc__ = f"Elementwise {op_name} (XLA lowering)."
+    return op
+
+
+def _binary(op_name, fn):
+    def op(x, y, name=None):
+        x, y = _coerce_pair(x, y)
+        return dispatch(op_name, fn, (x, y), {})
+    op.__name__ = op_name
+    op.__qualname__ = op_name
+    op.__doc__ = f"Broadcasting elementwise {op_name} (XLA lowering)."
+    return op
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+heaviside = _binary("heaviside", jnp.heaviside)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+
+
+def pow(x, y, name=None):
+    x, y = _coerce_pair(x, y)
+    return dispatch("pow", jnp.power, (x, y), {})
+
+
+matpow = pow
+
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+sign = _unary("sign", jnp.sign)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+
+
+def clip(x, min=None, max=None, name=None):
+    x = to_tensor(x)
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return dispatch("clip", lambda a: jnp.clip(a, lo, hi), (x,), {})
+
+
+def clip_(x, min=None, max=None, name=None):
+    out = clip(x, min, max)
+    x._data = out._data
+    return x
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = to_tensor(x)
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def fn(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+    out = dispatch("scale", fn, (x,), {})
+    if act is not None:
+        from . import activation
+        out = getattr(activation, act)(out)
+    return out
+
+
+def lerp(x, y, weight, name=None):
+    x, y = _coerce_pair(x, y)
+    if isinstance(weight, Tensor):
+        return dispatch("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight), {})
+    return dispatch("lerp", lambda a, b: a + weight * (b - a), (x, y), {})
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = to_tensor(input), to_tensor(x), to_tensor(y)
+    return dispatch("addmm",
+                    lambda i, a, b: beta * i + alpha * (a @ b), (input, x, y), {})
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = to_tensor(x)
+    return dispatch("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), (x,), {})
+
+
+def logit(x, eps=None, name=None):
+    x = to_tensor(x)
+
+    def fn(a):
+        p = jnp.clip(a, eps, 1 - eps) if eps else a
+        return jnp.log(p / (1 - p))
+    return dispatch("logit", fn, (x,), {})
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = to_tensor(x)
+    return dispatch("nan_to_num",
+                    lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                             neginf=neginf), (x,), {})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = to_tensor(x)
+    return dispatch("trace",
+                    lambda a: jnp.trace(a, offset, axis1, axis2), (x,), {})
+
+
+def kron(x, y, name=None):
+    x, y = _coerce_pair(x, y)
+    return dispatch("kron", jnp.kron, (x, y), {})
+
+
+def outer(x, y, name=None):
+    x, y = _coerce_pair(x, y)
+    return dispatch("outer", lambda a, b: jnp.outer(a, b), (x, y), {})
+
+
+def inner(x, y, name=None):
+    x, y = _coerce_pair(x, y)
+    return dispatch("inner", jnp.inner, (x, y), {})
+
+
+def diff(x, n=1, axis=-1, name=None):
+    x = to_tensor(x)
+    return dispatch("diff", lambda a: jnp.diff(a, n=n, axis=axis), (x,), {})
+
+
+# -- in-place variants (eager convenience; rebind storage) -----------------
+def add_(x, y, name=None):
+    out = add(x, y)
+    x._data = out._data
+    return x
+
+
+def subtract_(x, y, name=None):
+    out = subtract(x, y)
+    x._data = out._data
+    return x
+
+
+def multiply_(x, y, name=None):
+    out = multiply(x, y)
+    x._data = out._data
+    return x

@@ -1,0 +1,285 @@
+"""Embedding, dropout, attention and misc nn functional ops.
+
+Reference parity: ``operators/lookup_table_v2_op.*`` (embedding),
+``operators/dropout_op.*``, ``operators/fused/fused_attention_op.cu`` and
+``operators/sparse_attention_op.cc`` — on TPU the attention hot path is a
+pallas flash-attention kernel (ops/pallas/flash_attention.py) with an XLA
+fallback here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch, get_kernel, register_kernel
+from ..core.random import default_generator
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "embedding", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "linear", "bilinear", "scaled_dot_product_attention", "sparse_attention",
+    "sequence_mask", "diag_embed", "cosine_similarity", "pairwise_distance",
+    "affine_grid", "npair_loss", "temporal_shift", "class_center_sample",
+]
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = to_tensor(x), to_tensor(weight)
+
+    def impl(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx != padding_idx)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+    return dispatch("embedding", impl, (x, weight), {})
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = to_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return dispatch("dropout_infer", lambda a: a * (1.0 - p), (x,), {})
+        return x
+    key = default_generator.next_key()
+
+    def impl(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return dispatch("dropout", impl, (x,), {})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = to_tensor(x)
+    if not training or p == 0.0:
+        return x
+    key = default_generator.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def impl(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+    return dispatch("alpha_dropout", impl, (x,), {})
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b).  Weight layout (in, out) — reference mul_op/fc."""
+    x, weight = to_tensor(x), to_tensor(weight)
+    tensors = [x, weight] + ([to_tensor(bias)] if bias is not None else [])
+
+    def impl(a, w, *b):
+        out = jnp.matmul(a, w)
+        return out + b[0] if b else out
+    return dispatch("linear", impl, tensors, {})
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = to_tensor(x1), to_tensor(x2), to_tensor(weight)
+    tensors = [x1, x2, weight] + ([to_tensor(bias)] if bias is not None else [])
+
+    def impl(a, b, w, *bs):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out + bs[0] if bs else out
+    return dispatch("bilinear", impl, tensors, {})
+
+
+def _sdpa_xla(q, k, v, *rest, causal=False, scale=None, dropout_p=0.0,
+              dropout_key=None, has_mask=False):
+    """Reference attention math (XLA fused).  q/k/v: (B, S, H, D)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    qh = jnp.swapaxes(q, 1, 2)  # B,H,S,D
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if has_mask:
+        logits = logits + rest[0]
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+register_kernel("scaled_dot_product_attention", "xla")(_sdpa_xla)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    """Inputs (B, S, H, D) paddle-style; pallas flash kernel used on TPU."""
+    query, key, value = to_tensor(query), to_tensor(key), to_tensor(value)
+    tensors = [query, key, value]
+    has_mask = attn_mask is not None
+    if has_mask:
+        tensors.append(to_tensor(attn_mask))
+    dkey = default_generator.next_key() if (dropout_p > 0.0 and training) else None
+    impl = get_kernel("scaled_dot_product_attention")
+
+    import functools
+    fn = functools.partial(impl, causal=is_causal, scale=scale,
+                           dropout_p=dropout_p if training else 0.0,
+                           dropout_key=dkey, has_mask=has_mask)
+    return dispatch("scaled_dot_product_attention", fn, tensors, {})
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention (reference operators/sparse_attention_op.cc:71).
+    TPU path: dense flash attention with the sparsity pattern applied as a
+    mask — XLA/Mosaic handles the skipped blocks; a true block-sparse pallas
+    kernel is a future optimisation."""
+    query, key, value = to_tensor(query), to_tensor(key), to_tensor(value)
+    offs = np.asarray(to_tensor(sparse_csr_offset)._data)
+    cols = np.asarray(to_tensor(sparse_csr_columns)._data)
+
+    def impl(q, k, v):
+        b, h, s, d = q.shape
+        mask = np.zeros((s, s), dtype=bool)
+        row_off = offs.reshape(-1)[: s + 1]
+        col = cols.reshape(-1)
+        for i in range(s):
+            mask[i, col[row_off[i]:row_off[i + 1]]] = True
+        m = jnp.asarray(mask)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        logits = jnp.where(m, logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return dispatch("sparse_attention", impl, (query, key, value), {})
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ..core.dtype import dtype_to_jnp
+    x = to_tensor(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(x._data).max())
+    rng = jnp.arange(maxlen)
+    out = (rng[None, :] < x._data[..., None]).astype(dtype_to_jnp(dtype))
+    return Tensor(out)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    input = to_tensor(input)
+
+    def impl(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        src = list(range(out.ndim))
+        d1, d2 = dim1 % out.ndim, dim2 % out.ndim
+        return jnp.moveaxis(out, [out.ndim - 2, out.ndim - 1], [d1, d2])
+    return dispatch("diag_embed", impl, (input,), {})
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    x1, x2 = to_tensor(x1), to_tensor(x2)
+
+    def impl(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return dispatch("cosine_similarity", impl, (x1, x2), {})
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    x, y = to_tensor(x), to_tensor(y)
+
+    def impl(a, b):
+        d = a - b + epsilon
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1,
+                                 keepdims=keepdim), 1.0 / p)
+    return dispatch("pairwise_distance", impl, (x, y), {})
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    theta = to_tensor(theta)
+    if isinstance(out_shape, Tensor):
+        out_shape = out_shape.tolist()
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def impl(th):
+        if align_corners:
+            xs = jnp.linspace(-1, 1, w)
+            ys = jnp.linspace(-1, 1, h)
+        else:
+            xs = jnp.linspace(-1 + 1.0 / w, 1 - 1.0 / w, w)
+            ys = jnp.linspace(-1 + 1.0 / h, 1 - 1.0 / h, h)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # h,w,3
+        return jnp.einsum("hwk,nak->nhwa", base, th)
+    return dispatch("affine_grid", impl, (theta,), {})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    anchor, positive, labels = (to_tensor(anchor), to_tensor(positive),
+                                to_tensor(labels))
+
+    def impl(a, p, y):
+        y = y.reshape(-1, 1)
+        same = (y == y.T).astype(a.dtype)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        logits = jnp.matmul(a, p.T)
+        logp = jax.nn.log_softmax(logits, axis=1)
+        ce = -jnp.mean(jnp.sum(same * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), axis=1)) +
+                        jnp.mean(jnp.sum(jnp.square(p), axis=1))) * 0.25
+        return ce + reg
+    return dispatch("npair_loss", impl, (anchor, positive, labels), {})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    x = to_tensor(x)
+
+    def impl(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([a[:, 1:, :fold], jnp.zeros_like(a[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(a[:, :1, fold:2 * fold]),
+                                 a[:, :-1, fold:2 * fold]], axis=1)
+        mid = a[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, mid], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return dispatch("temporal_shift", impl, (x,), {})
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample: PS-style sampled softmax not yet on TPU path")

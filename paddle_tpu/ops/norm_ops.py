@@ -1,0 +1,203 @@
+"""Normalization functional ops.
+
+Reference parity: ``operators/layer_norm_op.*``, ``batch_norm_op.*``,
+instance/group norm.  XLA fuses the mean/var/normalize chain; a pallas
+fused variant exists for the transformer hot path (ops/pallas/fused.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
+           "local_response_norm", "normalize", "rms_norm"]
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = to_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    ndim = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - ndim, x.ndim))
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(to_tensor(weight))
+    if has_b:
+        tensors.append(to_tensor(bias))
+
+    def impl(a, *wb):
+        mu = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mu) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+    return dispatch("layer_norm", impl, tensors, {})
+
+
+def rms_norm(x, weight=None, epsilon=1e-06, name=None):
+    x = to_tensor(x)
+    tensors = [x] + ([to_tensor(weight)] if weight is not None else [])
+
+    def impl(a, *w):
+        ms = jnp.mean(jnp.square(a), axis=-1, keepdims=True)
+        out = a * jax.lax.rsqrt(ms + epsilon)
+        return out * w[0] if w else out
+    return dispatch("rms_norm", impl, tensors, {})
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Functional batch norm.  In training mode the *new* running stats are
+    written back into the running_mean/var tensors (in-place rebind, which
+    is capture-safe under the jit train-step path — buffers are read out
+    after tracing)."""
+    x = to_tensor(x)
+    rm, rv = to_tensor(running_mean), to_tensor(running_var)
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    if use_global_stats is None:
+        use_global_stats = not training
+    tensors = [x, rm, rv]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(to_tensor(weight))
+    if has_b:
+        tensors.append(to_tensor(bias))
+
+    bshape = [1] * x.ndim
+    bshape[channel_axis] = x.shape[channel_axis]
+
+    def impl(a, mean_r, var_r, *wb):
+        if use_global_stats:
+            mu, var = mean_r, var_r
+        else:
+            mu = jnp.mean(a, axis=axes)
+            var = jnp.var(a, axis=axes)
+        out = (a - mu.reshape(bshape)) * jax.lax.rsqrt(
+            var.reshape(bshape) + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(bshape)
+        return out
+    out = dispatch("batch_norm", impl, tensors, {})
+
+    if training and not use_global_stats:
+        batch_mean = jnp.mean(x._data, axis=axes)
+        batch_var = jnp.var(x._data, axis=axes)
+        rm._data = momentum * rm._data + (1.0 - momentum) * batch_mean
+        rv._data = momentum * rv._data + (1.0 - momentum) * batch_var
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-05, data_format="NCHW", name=None):
+    x = to_tensor(x)
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(2, x.ndim)) if channel_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(to_tensor(weight))
+    if has_b:
+        tensors.append(to_tensor(bias))
+    bshape = [1] * x.ndim
+    bshape[channel_axis] = x.shape[channel_axis]
+
+    def impl(a, *wb):
+        mu = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mu) * jax.lax.rsqrt(var + eps)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(bshape)
+        return out
+    return dispatch("instance_norm", impl, tensors, {})
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = to_tensor(x)
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(to_tensor(weight))
+    if has_b:
+        tensors.append(to_tensor(bias))
+    channel_last = not data_format.startswith("NC")
+
+    def impl(a, *wb):
+        if channel_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[:2]
+        g = num_groups
+        grouped = a_t.reshape(n, g, c // g, *a_t.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mu = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - mu) * jax.lax.rsqrt(var + epsilon)).reshape(a_t.shape)
+        bshape = [1, c] + [1] * (a_t.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(bshape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return dispatch("group_norm", impl, tensors, {})
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = to_tensor(x)
+
+    def impl(a):
+        sq = jnp.square(a)
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        half = size // 2
+        c = a.shape[ch_axis]
+        pads = [(0, 0)] * a.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + c, axis=ch_axis)
+        denom = jnp.power(k + alpha * acc / size, beta)
+        return a / denom
+    return dispatch("local_response_norm", impl, (x,), {})
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = to_tensor(x)
+
+    def impl(a):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                                keepdims=True), 1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return dispatch("normalize", impl, (x,), {})

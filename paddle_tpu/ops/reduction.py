@@ -1,0 +1,190 @@
+"""Reductions & scans.
+
+Reference parity: ``paddle/fluid/operators/reduce_ops/`` + cum ops +
+arg min/max + logsumexp.  XLA reductions tile onto the VPU natively.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtype import dtype_to_jnp as _dtype_to_jnp
+
+_int64 = _dtype_to_jnp("int64")
+
+__all__ = [
+    "sum", "mean", "max", "min", "prod", "all", "any", "argmax", "argmin",
+    "cumsum", "cumprod", "logsumexp", "logcumsumexp", "amax", "amin",
+    "nansum", "nanmean", "count_nonzero", "median", "quantile", "std",
+    "var", "kthvalue", "mode",
+]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.tolist())
+    return int(axis)
+
+
+def _reduce(op_name, fn):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        x = to_tensor(x)
+        ax = _axis(axis)
+        def impl(a):
+            out = fn(a, axis=ax, keepdims=keepdim)
+            if dtype is not None:
+                from ..core.dtype import dtype_to_jnp
+                out = out.astype(dtype_to_jnp(dtype))
+            return out
+        return dispatch(op_name, impl, (x,), {})
+    op.__name__ = op_name
+    return op
+
+
+sum = _reduce("reduce_sum", jnp.sum)
+mean = _reduce("reduce_mean", jnp.mean)
+prod = _reduce("reduce_prod", jnp.prod)
+amax = _reduce("reduce_amax", jnp.max)
+amin = _reduce("reduce_amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+max = _reduce("reduce_max", jnp.max)
+min = _reduce("reduce_min", jnp.min)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.all(x._data, axis=_axis(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.any(x._data, axis=_axis(axis), keepdims=keepdim))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import dtype_to_jnp
+    x = to_tensor(x)
+    out = jnp.argmax(x._data, axis=_axis(axis), keepdims=keepdim and axis is not None)
+    return Tensor(out.astype(dtype_to_jnp(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import dtype_to_jnp
+    x = to_tensor(x)
+    out = jnp.argmin(x._data, axis=_axis(axis), keepdims=keepdim and axis is not None)
+    return Tensor(out.astype(dtype_to_jnp(dtype)))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = to_tensor(x)
+
+    def impl(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a)
+        return jnp.cumsum(a, axis=int(axis))
+    return dispatch("cumsum", impl, (x,), {})
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = to_tensor(x)
+    return dispatch("cumprod", lambda a: jnp.cumprod(a, axis=dim), (x,), {})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = to_tensor(x)
+    return dispatch("logsumexp",
+                    lambda a: jax.scipy.special.logsumexp(
+                        a, axis=_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = to_tensor(x)
+
+    def impl(a):
+        if axis is None:
+            b = a.reshape(-1)
+            ax = 0
+        else:
+            b, ax = a, int(axis)
+        m = jax.lax.cummax(b, axis=ax)
+        return jnp.log(jnp.cumsum(jnp.exp(b - m), axis=ax)) + m
+    return dispatch("logcumsumexp", impl, (x,), {})
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.count_nonzero(x._data, axis=_axis(axis),
+                                    keepdims=keepdim).astype(_int64))
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    x = to_tensor(x)
+    return dispatch("median",
+                    lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {})
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    x = to_tensor(x)
+    return dispatch("quantile",
+                    lambda a: jnp.quantile(a, jnp.asarray(q), axis=_axis(axis),
+                                           keepdims=keepdim), (x,), {})
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = to_tensor(x)
+    ddof = 1 if unbiased else 0
+    return dispatch("std",
+                    lambda a: jnp.std(a, axis=_axis(axis), ddof=ddof,
+                                      keepdims=keepdim), (x,), {})
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = to_tensor(x)
+    ddof = 1 if unbiased else 0
+    return dispatch("var",
+                    lambda a: jnp.var(a, axis=_axis(axis), ddof=ddof,
+                                      keepdims=keepdim), (x,), {})
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = to_tensor(x)
+    a = jnp.sort(x._data, axis=axis)
+    idx = jnp.argsort(x._data, axis=axis)
+    vals = jnp.take(a, k - 1, axis=axis)
+    inds = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        inds = jnp.expand_dims(inds, axis)
+    return Tensor(vals), Tensor(inds.astype(_int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = to_tensor(x)
+
+    def impl(a):
+        srt = jnp.sort(a, axis=axis)
+        moved = jnp.moveaxis(srt, axis, -1)
+        n = moved.shape[-1]
+        runs = jnp.cumsum(
+            jnp.concatenate([jnp.ones_like(moved[..., :1], dtype=jnp.int32),
+                             (moved[..., 1:] != moved[..., :-1]).astype(jnp.int32)],
+                            axis=-1), axis=-1)
+        # count occurrences of each run id at every position, take the value
+        # at the position whose run is longest
+        counts = jax.vmap(lambda r: jnp.bincount(r, length=n + 1),
+                          in_axes=0)(runs.reshape(-1, n)).reshape(*runs.shape[:-1], n + 1)
+        best_run = jnp.argmax(counts, axis=-1)
+        pos = jnp.argmax(runs == best_run[..., None], axis=-1)
+        vals = jnp.take_along_axis(moved, pos[..., None], axis=-1)[..., 0]
+        return jnp.moveaxis(vals[..., None], -1, axis if keepdim else -1) if keepdim else vals
+    out = impl(x._data)
+    return Tensor(out)

@@ -1,0 +1,16 @@
+"""Dygraph/static mode switch (paddle.enable_static parity)."""
+import threading
+
+_state = threading.local()
+
+
+def in_dynamic_mode() -> bool:
+    return getattr(_state, "dynamic", True)
+
+
+def enable_static():
+    _state.dynamic = False
+
+
+def disable_static():
+    _state.dynamic = True

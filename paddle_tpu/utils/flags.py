@@ -1,0 +1,77 @@
+"""Runtime flag registry.
+
+Reference parity: ``paddle/fluid/platform/flags.cc:48ff``
+(PADDLE_DEFINE_EXPORTED_* gflags) + Python ``get/set_flags``.  Flags are
+importable from env (FLAGS_x=1 python ...) and settable at runtime.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+__all__ = ["define_flag", "get_flag", "set_flags", "get_flags", "all_flags"]
+
+_lock = threading.Lock()
+_FLAGS: Dict[str, Any] = {}
+_DOC: Dict[str, str] = {}
+
+
+def _env_cast(raw: str, default):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def define_flag(name: str, default, doc: str = ""):
+    with _lock:
+        raw = os.environ.get(name)
+        _FLAGS[name] = _env_cast(raw, default) if raw is not None else default
+        _DOC[name] = doc
+
+
+def get_flag(name: str):
+    try:
+        return _FLAGS[name]
+    except KeyError:
+        raise KeyError(f"unknown flag '{name}'") from None
+
+
+def set_flags(flags: Dict[str, Any]):
+    with _lock:
+        for k, v in flags.items():
+            if k not in _FLAGS:
+                raise KeyError(f"unknown flag '{k}'")
+            _FLAGS[k] = v
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {n: get_flag(n) for n in names}
+
+
+def all_flags() -> Dict[str, Any]:
+    return dict(_FLAGS)
+
+
+# -- core flag set (subset of platform/flags.cc most relevant on TPU) ------
+define_flag("FLAGS_use_pallas", True,
+            "prefer hand-written pallas kernels on TPU where registered")
+define_flag("FLAGS_check_nan_inf", False,
+            "check every op output for nan/inf (debug; reference "
+            "framework/details/nan_inf_utils_detail.cc)")
+define_flag("FLAGS_allocator_strategy", "auto_growth",
+            "kept for API parity; PJRT owns TPU HBM allocation")
+define_flag("FLAGS_benchmark", False,
+            "block_until_ready after every op for timing accuracy")
+define_flag("FLAGS_cudnn_deterministic", False, "parity no-op on TPU")
+define_flag("FLAGS_max_inplace_grad_add", 0, "parity no-op")
+define_flag("FLAGS_init_allocated_mem", False, "parity no-op")
+define_flag("FLAGS_default_dtype", "float32", "default floating dtype")
+define_flag("FLAGS_matmul_precision", "default",
+            "jax matmul precision: default|high|highest")

@@ -1,0 +1,18 @@
+"""Test harness config: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's TestDistBase strategy (test_dist_base.py:778) of
+simulating multi-device on one host — here via XLA's host-platform device
+count instead of multi-process NCCL.
+"""
+import os
+import sys
+
+# Must happen before jax backend init.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if repo_root not in sys.path:
+    sys.path.insert(0, repo_root)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
