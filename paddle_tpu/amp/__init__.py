@@ -1,1 +1,235 @@
+"""Automatic mixed precision.
 
+Reference parity: ``python/paddle/amp`` (auto_cast O1/O2 + GradScaler with
+dynamic loss scaling; op lists mirror ``imperative/amp_auto_cast.cc`` and
+``fluid/contrib/mixed_precision/fp16_lists.py``).
+
+TPU-first: the low-precision dtype defaults to **bfloat16** (MXU native,
+no loss scaling strictly required — but the dynamic loss-scale state
+machine is kept for fp16 parity and for parity of semantics).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..ops.amp_ops import check_finite_and_unscale, update_loss_scaling
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate",
+           "WHITE_LIST", "BLACK_LIST"]
+
+# ops that benefit from low precision (MXU ops)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "einsum", "mm", "bmm",
+    "addmm", "scaled_dot_product_attention", "conv2d_transpose",
+}
+# numerically sensitive ops kept in fp32
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square",
+    "reduce_sum", "reduce_mean", "cross_entropy",
+    "softmax_with_cross_entropy", "bce", "bce_with_logits", "nll_loss",
+    "kl_div", "layer_norm", "batch_norm", "instance_norm", "group_norm",
+    "norm", "cumsum", "logsumexp", "softmax", "log_softmax", "erfinv",
+    "rsqrt", "mse_loss",
+}
+
+_state = threading.local()
+
+
+def _amp_state():
+    return getattr(_state, "amp", None)
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level", "white", "black")
+
+    def __init__(self, enable, dtype, level, white, black):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.white = white
+        self.black = black
+
+
+class auto_cast:
+    """Context manager: ops in the white list run in low precision.
+
+    O1: white-list ops cast to amp dtype, black-list kept fp32.
+    O2: everything except black list in amp dtype.
+    """
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16"):
+        from ..core.dtype import dtype_to_jnp
+        self._init_kwargs = dict(enable=enable,
+                                 custom_white_list=custom_white_list,
+                                 custom_black_list=custom_black_list,
+                                 level=level, dtype=dtype)
+        white = set(WHITE_LIST)
+        black = set(BLACK_LIST)
+        if custom_white_list:
+            white |= set(custom_white_list)
+            black -= set(custom_white_list)
+        if custom_black_list:
+            black |= set(custom_black_list)
+            white -= set(custom_black_list)
+        self._new = _AmpState(enable, dtype_to_jnp(dtype), level, white, black)
+
+    def __enter__(self):
+        self._prev = _amp_state()
+        _state.amp = self._new if self._new.enable else None
+        return self
+
+    def __exit__(self, *exc):
+        _state.amp = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with auto_cast(**self._init_kwargs):
+                return fn(*a, **k)
+        return wrapper
+
+
+amp_guard = auto_cast
+
+
+def amp_cast_inputs(op_name: str, arrays):
+    """Called by the dispatcher: cast op inputs per the active amp state.
+    (reference imperative/amp_auto_cast.h:86 AutoCastInputs)."""
+    st = _amp_state()
+    if st is None:
+        return arrays
+    low = st.dtype
+
+    def cast_to(arrs, dt):
+        return [a.astype(dt) if hasattr(a, "dtype") and
+                a.dtype in (jnp.float32, jnp.float16, jnp.bfloat16) and
+                a.dtype != dt else a for a in arrs]
+
+    if st.level == "O2":
+        if op_name in st.black:
+            return cast_to(arrays, jnp.float32)
+        return cast_to(arrays, low)
+    # O1
+    if op_name in st.white:
+        return cast_to(arrays, low)
+    if op_name in st.black:
+        return cast_to(arrays, jnp.float32)
+    # gray: use widest input dtype among float inputs
+    return arrays
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype, enable optimizer
+    master weights (reference mixed_precision/decorator.py:37)."""
+    from ..core.dtype import dtype_to_jnp
+    low = dtype_to_jnp(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    for m in model_list:
+        for p in m.parameters():
+            if p._data.dtype == jnp.float32:
+                p._data = p._data.astype(low)
+    if optimizers is not None:
+        opt_list = optimizers if isinstance(optimizers, (list, tuple)) else \
+            [optimizers]
+        for opt in opt_list:
+            opt._multi_precision = True if master_weight is None else \
+                master_weight
+        if not isinstance(optimizers, (list, tuple)):
+            optimizers = opt_list[0]
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference fluid/dygraph/amp/loss_scaler.py:40
+    AmpScaler; kernels operators/amp/*)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = jnp.asarray(init_loss_scaling, jnp.float32)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = jnp.zeros((), jnp.int32)
+        self._bad = jnp.zeros((), jnp.int32)
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return float(self._scale)
+
+    def scale(self, var):
+        var = to_tensor(var)
+        if not self._enable:
+            return var
+        from ..ops import math as m
+        return m.multiply(var, Tensor(self._scale.astype(var.dtype)))
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._already_unscaled:
+            return
+        params = [p for p in (optimizer._parameter_list or [])
+                  if p.grad is not None]
+        grads = [p.grad for p in params]
+        unscaled, found = check_finite_and_unscale(grads, Tensor(self._scale))
+        self._found_inf = bool(found)
+        self._already_unscaled = True
+        for p, g in zip(params, unscaled):
+            p.grad = g
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        self._already_unscaled = False
+        if not (self._enable and self._dynamic):
+            return
+        new_scale, good, bad = update_loss_scaling(
+            Tensor(jnp.asarray(self._found_inf)), Tensor(self._scale),
+            Tensor(self._good), Tensor(self._bad),
+            self._incr_every_n_steps, self._decr_every_n,
+            self._incr_ratio, self._decr_ratio)
+        self._scale = new_scale._data
+        self._good = good._data
+        self._bad = bad._data
+        self._found_inf = False
+
+    def state_dict(self):
+        return {"scale": float(self._scale), "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n,
+                "good_steps": int(self._good), "bad_steps": int(self._bad)}
+
+    def load_state_dict(self, state):
+        self._scale = jnp.asarray(state["scale"], jnp.float32)
+        self._good = jnp.asarray(state.get("good_steps", 0), jnp.int32)
+        self._bad = jnp.asarray(state.get("bad_steps", 0), jnp.int32)
+
+
+AmpScaler = GradScaler

@@ -1,3 +1,5 @@
-"""paddle.autograd namespace: PyLayer + functional autodiff (vjp/jvp/...)."""
-from .core.autograd import PyLayer, PyLayerContext, backward, grad, no_grad  # noqa: F401
+"""paddle.autograd namespace: PyLayer + functional autodiff."""
+from .core.autograd import (PyLayer, PyLayerContext, backward, grad,  # noqa: F401
+                            no_grad, enable_grad, set_grad_enabled,
+                            is_grad_enabled)
 from .autograd_functional import vjp, jvp, jacobian, hessian  # noqa: F401

@@ -1,3 +1,95 @@
-def vjp(*a, **k):
-    raise NotImplementedError("stub")
-jvp = jacobian = hessian = vjp
+"""Functional autodiff extras (reference python/paddle/autograd/functional.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, to_tensor
+
+__all__ = ["vjp", "jvp", "jacobian", "hessian", "vhp"]
+
+
+def _fn_on_arrays(func):
+    def f(*arrays):
+        tensors = [Tensor(a) for a in arrays]
+        out = func(*tensors)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+    return f
+
+
+def vjp(func, xs, v=None):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [to_tensor(x)._data for x in xs_l]
+    out, vjp_fn = jax.vjp(_fn_on_arrays(func), *arrays)
+    if v is None:
+        v_arr = jnp.ones_like(out) if not isinstance(out, tuple) else \
+            tuple(jnp.ones_like(o) for o in out)
+    else:
+        v_l = v if isinstance(v, (list, tuple)) else [v]
+        v_arr = tuple(to_tensor(t)._data for t in v_l)
+        if not isinstance(out, tuple):
+            v_arr = v_arr[0]
+    grads = vjp_fn(v_arr)
+    wrap = lambda o: Tensor(o) if not isinstance(o, tuple) else \
+        tuple(Tensor(x) for x in o)
+    return wrap(out), [Tensor(g) for g in grads]
+
+
+def jvp(func, xs, v=None):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [to_tensor(x)._data for x in xs_l]
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        v_l = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(to_tensor(t)._data for t in v_l)
+    out, tangent_out = jax.jvp(_fn_on_arrays(func), tuple(arrays), tangents)
+    wrap = lambda o: Tensor(o) if not isinstance(o, tuple) else \
+        tuple(Tensor(x) for x in o)
+    return wrap(out), wrap(tangent_out)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [to_tensor(x)._data for x in xs_l]
+    jac = jax.jacrev(_fn_on_arrays(func), argnums=tuple(range(len(arrays))))(
+        *arrays)
+    if len(arrays) == 1:
+        jac = jac[0] if isinstance(jac, tuple) else jac
+        return Tensor(jac)
+    return tuple(Tensor(j) for j in jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [to_tensor(x)._data for x in xs_l]
+    h = jax.hessian(_fn_on_arrays(func), argnums=tuple(range(len(arrays))))(
+        *arrays)
+    if len(arrays) == 1:
+        h = h[0][0] if isinstance(h, tuple) else h
+        return Tensor(h)
+    return jax.tree_util.tree_map(Tensor, h)
+
+
+def vhp(func, inputs, v=None):
+    """vector-Hessian product for a scalar-output func (reference
+    autograd/functional.py vhp)."""
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    arrays = [to_tensor(x)._data for x in ins]
+    if v is None:
+        vs = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        v_l = v if isinstance(v, (list, tuple)) else [v]
+        vs = tuple(to_tensor(t)._data for t in v_l)
+    f = _fn_on_arrays(func)
+
+    def scalar_f(*a):
+        out = f(*a)
+        return jnp.sum(out)
+    out = f(*arrays)
+    grad_fn = jax.grad(scalar_f, argnums=tuple(range(len(arrays))))
+    _, hvp = jax.jvp(grad_fn, tuple(arrays), vs)
+    hvps = hvp if isinstance(hvp, tuple) else (hvp,)
+    return Tensor(out), [Tensor(h) for h in hvps]

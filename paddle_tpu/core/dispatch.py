@@ -73,6 +73,10 @@ def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
     from .tensor import Tensor
 
     arrays = [t._data for t in tensor_args]
+    # AMP autocast rewrite (reference imperative/tracer.cc:179-185)
+    from ..amp import amp_cast_inputs, _amp_state
+    if _amp_state() is not None:
+        arrays = amp_cast_inputs(op_name, arrays)
     needs_grad = autograd.is_grad_enabled() and any(
         not t.stop_gradient for t in tensor_args)
 

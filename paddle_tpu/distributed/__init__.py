@@ -1,1 +1,3 @@
-
+"""paddle.distributed namespace (built out in distributed/*)."""
+from . import env  # noqa: F401
+from .env import init_parallel_env, get_rank, get_world_size, ParallelEnv  # noqa: F401

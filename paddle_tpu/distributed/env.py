@@ -1,0 +1,89 @@
+"""Distributed environment bookkeeping.
+
+Reference parity: ``python/paddle/distributed/parallel.py`` ParallelEnv
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM) + ``imperative/nccl_context``.
+TPU-first: jax.distributed + jax.process_index/process_count carry the
+multi-host identity; inside shard_map, named mesh axes carry the
+per-device identity (current_data_axis).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["get_rank", "get_world_size", "ParallelEnv", "init_parallel_env",
+           "is_initialized", "current_data_axis", "set_current_data_axis"]
+
+_state = threading.local()
+_initialized = {"v": False}
+
+
+def init_parallel_env():
+    """reference parallel.py:69 init_parallel_env: TCP store + comm init.
+    On TPU: jax.distributed.initialize for multi-host; single-host pods
+    need no bootstrap (ICI is wired by the runtime)."""
+    if _initialized["v"]:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("COORDINATOR_ADDRESS")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "0") or 0)
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    if coord and nprocs > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nprocs, process_id=pid)
+    _initialized["v"] = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized["v"]
+
+
+def get_rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+
+
+def get_world_size() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+
+
+class ParallelEnv:
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return get_rank()
+
+    @property
+    def dev_id(self) -> int:
+        return 0
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+
+# -- shard_map axis plumbing -------------------------------------------------
+def current_data_axis() -> Optional[str]:
+    """The named mesh axis for data parallelism when executing inside a
+    shard_map region (set by the hybrid engine); None in plain eager."""
+    return getattr(_state, "data_axis", None)
+
+
+def set_current_data_axis(axis: Optional[str]):
+    _state.data_axis = axis

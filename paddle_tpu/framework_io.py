@@ -1,4 +1,62 @@
-def save(obj, path, **kw):
-    raise NotImplementedError("stub")
-def load(path, **kw):
-    raise NotImplementedError("stub")
+"""paddle.save / paddle.load.
+
+Reference parity: ``python/paddle/framework/io.py:553,769`` — pickled
+nested structures of numpy-ified tensors, >4GB pickle protocol, separate
+optimizer-state dicts.  Sharded/async checkpointing for meshes lives in
+``distributed.checkpoint`` (orbax-style); this is the single-host format.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Parameter, Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._data),
+                "stop_gradient": obj.stop_gradient, "name": obj.name,
+                "is_parameter": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            import jax.numpy as jnp
+            cls = Parameter if obj.get("is_parameter") else Tensor
+            t = cls(jnp.asarray(obj["data"]))
+            t.stop_gradient = obj.get("stop_gradient", True)
+            if obj.get("name"):
+                t.name = obj["name"]
+            return t
+        return {k: _from_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTOCOL, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    if not os.path.exists(path):
+        raise ValueError(f"checkpoint path '{path}' does not exist")
+    with open(path, "rb") as f:
+        return _from_saveable(pickle.load(f))

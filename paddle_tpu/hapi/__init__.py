@@ -1,1 +1,3 @@
-from .model import Model, summary, flops  # noqa: F401
+from .model import Model  # noqa: F401
+from .summary_mod import summary, flops  # noqa: F401
+from . import callbacks  # noqa: F401

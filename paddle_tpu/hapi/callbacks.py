@@ -1,0 +1,263 @@
+"""hapi callbacks (reference python/paddle/hapi/callbacks.py)."""
+from __future__ import annotations
+
+import numbers
+import os
+import time
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRSchedulerCallback", "VisualDL", "config_callbacks"]
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            def call(*args, **kwargs):
+                for c in self.callbacks:
+                    getattr(c, name)(*args, **kwargs)
+            return call
+        raise AttributeError(name)
+
+    @property
+    def stop_training(self):
+        return any(getattr(c, "stop_training", False)
+                   for c in self.callbacks)
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self._t0 = time.time()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.step = 0
+        self._epoch_t0 = time.time()
+
+    def _fmt(self, logs):
+        parts = []
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                parts.append(f"{k}: {v:.4f}")
+            elif isinstance(v, (list, tuple, np.ndarray)):
+                parts.append(f"{k}: " + ",".join(f"{x:.4f}" for x in
+                                                 np.ravel(v)[:4]))
+        return " - ".join(parts)
+
+    def on_train_batch_end(self, step, logs=None):
+        self.step = step
+        if self.verbose >= 2 and step % self.log_freq == 0:
+            total = self.params.get("steps")
+            print(f"Epoch {self.epoch + 1}/{self.epochs} "
+                  f"step {step}/{total} - {self._fmt(logs)}", flush=True)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose >= 1:
+            dt = time.time() - self._epoch_t0
+            print(f"Epoch {epoch + 1}/{self.epochs} done in {dt:.1f}s "
+                  f"- {self._fmt(logs)}", flush=True)
+
+    def on_eval_end(self, logs=None):
+        if self.verbose >= 1:
+            print(f"Eval - {self._fmt(logs)}", flush=True)
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.stop_training = False
+        if mode == "auto":
+            mode = "min" if "loss" in monitor or "err" in monitor else "max"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(np.ravel(cur)[0]) if isinstance(
+            cur, (list, tuple, np.ndarray)) else float(cur)
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and getattr(self.model, "_save_dir", None):
+                self.model.save(os.path.join(self.model._save_dir,
+                                             "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+                if self.verbose:
+                    print(f"EarlyStopping: stop (best {self.monitor}="
+                          f"{self.best:.5f})")
+
+
+class LRSchedulerCallback(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        assert by_step != by_epoch
+        self.by_step = by_step
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        return getattr(opt, "_lr_scheduler", None) if opt else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if not self.by_step and s is not None:
+            s.step()
+
+
+class VisualDL(Callback):
+    """Scalar logging callback.  VisualDL itself isn't in this image;
+    writes a plain jsonl the dashboard (or any reader) can tail."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+
+    def on_train_begin(self, logs=None):
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._f = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+
+    def on_train_batch_end(self, step, logs=None):
+        import json
+        if self._f and logs:
+            rec = {"step": step}
+            for k, v in logs.items():
+                if isinstance(v, numbers.Number):
+                    rec[k] = float(v)
+            self._f.write(json.dumps(rec) + "\n")
+
+    def on_train_end(self, logs=None):
+        if self._f:
+            self._f.close()
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    if not any(isinstance(c, LRSchedulerCallback) for c in cbks) and \
+            mode == "train":
+        cbks = cbks + [LRSchedulerCallback()]
+    lst = CallbackList(cbks)
+    lst.set_model(model)
+    lst.set_params({"batch_size": batch_size, "epochs": epochs,
+                    "steps": steps, "verbose": verbose,
+                    "metrics": metrics or []})
+    return lst

@@ -1,6 +1,383 @@
+"""paddle.Model — the high-level train/eval/predict API.
+
+Reference parity: ``python/paddle/hapi/model.py:906`` (Model, fit:1556,
+train_batch:1044, Dynamic/StaticGraphAdapter).  TPU-first: instead of two
+adapters, Model has two execution engines:
+
+- **eager**: per-op dispatch with tape autograd (debuggable), and
+- **compiled** (default): ONE jitted XLA train-step threading
+  (params, buffers, opt-state, rng) functionally — this is where MXU
+  utilization comes from.  The compiled step is built once per input
+  signature, mirrorring StaticGraphAdapter's lazily-built Program.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.random import default_generator, rng_scope
+from ..core.tensor import Tensor, to_tensor
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
 class Model:
-    pass
-def summary(*a, **k):
-    raise NotImplementedError
-def flops(*a, **k):
-    raise NotImplementedError
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._use_jit = True
+        self._jit_cache = {}
+        self.stop_training = False
+        self._save_dir = None
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle Metric")
+        self._use_jit = jit
+        self._amp_level = None
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            else:
+                self._amp_level = amp_configs.get("level", "O1")
+        return self
+
+    # ------------------------------------------------------------------
+    # compiled train step
+    # ------------------------------------------------------------------
+    def _build_jit_train_step(self, n_inputs, n_labels):
+        net, opt, loss_fn = self.network, self._optimizer, self._loss
+        amp_level = self._amp_level
+
+        def step(params, buffers, opt_state, key, lr, *data):
+            inputs = [Tensor(a) for a in data[:n_inputs]]
+            labels = [Tensor(a) for a in data[n_inputs:]]
+
+            def loss_of(params):
+                with rng_scope(key), autograd.no_grad():
+                    net.load_functional_state(params, buffers)
+                    if amp_level:
+                        from ..amp import auto_cast
+                        with auto_cast(level=amp_level):
+                            outs = net.forward(*inputs)
+                    else:
+                        outs = net.forward(*inputs)
+                    outs_l = _to_list(outs)
+                    loss = loss_fn(*(outs_l + labels))
+                    new_buffers = {n: b._data for n, b in net.named_buffers()}
+                loss_arr = loss._data if isinstance(loss, Tensor) else loss
+                return loss_arr.astype(jnp.float32), \
+                    ([o._data for o in outs_l], new_buffers)
+
+            (loss, (outs, new_buffers)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_opt_state = opt.functional_apply(
+                params, grads, opt_state, lr)
+            return loss, outs, new_buffers, new_params, new_opt_state
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def _build_jit_eval_step(self, n_inputs, n_labels, with_loss):
+        net, loss_fn = self.network, self._loss
+
+        def step(params, buffers, *data):
+            inputs = [Tensor(a) for a in data[:n_inputs]]
+            labels = [Tensor(a) for a in data[n_inputs:]]
+            with autograd.no_grad():
+                net.load_functional_state(params, buffers)
+                outs = _to_list(net.forward(*inputs))
+                loss = None
+                if with_loss and loss_fn is not None and labels:
+                    l = loss_fn(*(outs + labels))
+                    loss = (l._data if isinstance(l, Tensor) else l)
+            return [o._data for o in outs], loss
+        return jax.jit(step)
+
+    # ------------------------------------------------------------------
+    # batch-level API
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        self.network.train()
+        if self._use_jit and update:
+            return self._train_batch_jit(inputs, labels)
+        return self._train_batch_eager(inputs, labels, update)
+
+    def _train_batch_jit(self, inputs, labels):
+        arrays = [to_tensor(t)._data for t in inputs + labels]
+        sig = ("train", tuple((a.shape, str(a.dtype)) for a in arrays))
+        if sig not in self._jit_cache:
+            self._jit_cache[sig] = self._build_jit_train_step(
+                len(inputs), len(labels))
+        step = self._jit_cache[sig]
+        net, opt = self.network, self._optimizer
+        params, buffers = net.functional_state()
+        if not hasattr(opt, "_fn_state") or opt._fn_state is None:
+            opt._fn_state = opt.functional_init(params)
+        key = default_generator.next_key()
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        try:
+            loss, outs, new_buffers, new_params, new_state = step(
+                params, buffers, opt._fn_state, key, lr, *arrays)
+        except Exception:
+            net.load_functional_state(params, buffers)  # drop leaked tracers
+            raise
+        opt._fn_state = new_state
+        net.load_functional_state(new_params, new_buffers)
+        if opt._lr_scheduler is None and hasattr(opt, "_global_step"):
+            opt._global_step += 1
+        metrics = self._update_metrics(outs, labels)
+        loss_val = float(loss)
+        return self._pack_logs(loss_val, metrics)
+
+    def _train_batch_eager(self, inputs, labels, update=True):
+        net, opt = self.network, self._optimizer
+        if self._amp_level:
+            from ..amp import auto_cast
+            with auto_cast(level=self._amp_level):
+                outs = _to_list(net(*[to_tensor(i) for i in inputs]))
+        else:
+            outs = _to_list(net(*[to_tensor(i) for i in inputs]))
+        losses = self._loss(*(outs + [to_tensor(l) for l in labels]))
+        losses.backward()
+        if update:
+            opt.step()
+            opt.clear_grad()
+        metrics = self._update_metrics([o._data for o in outs], labels)
+        return self._pack_logs(float(losses), metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        self.network.eval()
+        arrays = [to_tensor(t)._data for t in inputs + labels]
+        sig = ("eval", tuple((a.shape, str(a.dtype)) for a in arrays))
+        if sig not in self._jit_cache:
+            self._jit_cache[sig] = self._build_jit_eval_step(
+                len(inputs), len(labels), True)
+        params, buffers = self.network.functional_state()
+        try:
+            outs, loss = self._jit_cache[sig](params, buffers, *arrays)
+        finally:
+            # tracing rebinds layer tensors to tracers; restore concrete
+            self.network.load_functional_state(params, buffers)
+        metrics = self._update_metrics(outs, labels)
+        loss_val = float(loss) if loss is not None else None
+        return self._pack_logs(loss_val, metrics)
+
+    def predict_batch(self, inputs):
+        inputs = _to_list(inputs)
+        self.network.eval()
+        arrays = [to_tensor(t)._data for t in inputs]
+        sig = ("pred", tuple((a.shape, str(a.dtype)) for a in arrays))
+        if sig not in self._jit_cache:
+            self._jit_cache[sig] = self._build_jit_eval_step(
+                len(inputs), 0, False)
+        params, buffers = self.network.functional_state()
+        try:
+            outs, _ = self._jit_cache[sig](params, buffers, *arrays)
+        finally:
+            self.network.load_functional_state(params, buffers)
+        return [np.asarray(o) for o in outs]
+
+    def _update_metrics(self, out_arrays, labels):
+        results = {}
+        for metric in self._metrics:
+            computed = metric.compute(
+                Tensor(out_arrays[0]), *[to_tensor(l) for l in labels])
+            if isinstance(computed, (list, tuple)):
+                res = metric.update(*[c for c in computed])
+            else:
+                res = metric.update(computed)
+            names = metric.name()
+            results[names[0] if isinstance(names, list) else names] = res
+        return results
+
+    def _pack_logs(self, loss, metrics):
+        logs = {}
+        if loss is not None:
+            logs["loss"] = loss
+        logs.update(metrics)
+        return logs
+
+    # ------------------------------------------------------------------
+    # loop-level API
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        self._save_dir = save_dir
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                batch_size=batch_size, steps=steps,
+                                log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=["loss"] + [m.name() for m in
+                                                    self._metrics])
+        cbks.on_train_begin()
+        step_count = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbls = self._split_batch(batch)
+                if accumulate_grad_batches > 1:
+                    # grad accumulation rides the eager tape: backward
+                    # accumulates into .grad, step fires on the boundary
+                    update = (step + 1) % accumulate_grad_batches == 0
+                    self.network.train()
+                    logs = self._train_batch_eager(ins, lbls, update=update)
+                else:
+                    logs = self.train_batch(ins, lbls)
+                cbks.on_train_batch_end(step, logs)
+                step_count += 1
+                if num_iters is not None and step_count >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and epoch % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks,
+                              _inner=True)
+            if cbks.stop_training or self.stop_training:
+                break
+            if num_iters is not None and step_count >= num_iters:
+                break
+        cbks.on_train_end()
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)):
+            n_in = len(self._inputs) if self._inputs else 1
+            if len(batch) <= n_in:
+                return list(batch), []
+            return list(batch[:n_in]), list(batch[n_in:])
+        return [batch], []
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _inner=False):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        cbks = callbacks if _inner else config_callbacks(
+            callbacks, model=self, verbose=verbose, log_freq=log_freq,
+            mode="eval")
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        losses = []
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, lbls = self._split_batch(batch)
+            logs = self.eval_batch(ins, lbls)
+            if "loss" in logs:
+                losses.append(logs["loss"])
+            cbks.on_eval_batch_end(step, logs)
+        final = {}
+        if losses:
+            final["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name()
+            res = m.accumulate()
+            final[names[0] if isinstance(names, list) else names] = res
+        cbks.on_eval_end(final)
+        return final
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # ------------------------------------------------------------------
+    # persistence / introspection
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        from .. import framework_io
+        if training:
+            framework_io.save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                framework_io.save(self._optimizer.state_dict(),
+                                  path + ".pdopt")
+        else:
+            from .. import jit as jit_mod
+            specs = None
+            if self._inputs:
+                specs = self._inputs
+            jit_mod.save(self.network, path, input_spec=specs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import framework_io
+        state = framework_io.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(framework_io.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary_mod import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

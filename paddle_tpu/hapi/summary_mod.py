@@ -1,0 +1,105 @@
+"""Model summary + flops (reference python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["summary", "flops"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print per-layer output shapes + param counts; returns totals."""
+    from .. import ops
+
+    rows = []
+    hooks = []
+
+    def make_hook(name):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            n_params = sum(int(np.prod(p.shape)) for p in
+                           layer.parameters(include_sublayers=False))
+            rows.append((name, type(layer).__name__, shape, n_params))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaves only
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+
+    if input is not None:
+        x = input if isinstance(input, (list, tuple)) else [input]
+    else:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) and \
+            isinstance(input_size[0], (list, tuple)) else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes or "float32"] * len(sizes)
+        x = [ops.creation.zeros(list(s), dt) for s, dt in zip(sizes, dts)]
+    was_training = net.training
+    net.eval()
+    try:
+        net(*x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    width = 76
+    print("-" * width)
+    print(f"{'Layer (type)':<34}{'Output Shape':<26}{'Param #':<12}")
+    print("=" * width)
+    for name, tname, shape, n in rows:
+        print(f"{name + ' (' + tname + ')':<34}{str(shape):<26}{n:<12,}")
+    print("=" * width)
+    print(f"Total params: {total_params:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total_params - trainable:,}")
+    print("-" * width)
+    return {"total_params": total_params, "trainable_params": trainable}
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
+    """Rough flops accounting for the common layer types."""
+    from ..nn.layer import common, conv as conv_mod, norm as norm_mod
+    from .. import ops as ops_mod
+
+    total = [0]
+    hooks = []
+
+    def conv_hook(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+        k = int(np.prod(layer._kernel_size))
+        cin = layer._in_channels // layer._groups
+        total[0] += 2 * k * cin * int(np.prod(out.shape))
+
+    def linear_hook(layer, inputs, outputs):
+        out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+        total[0] += 2 * layer._in_features * int(np.prod(out.shape))
+
+    for _, sub in net.named_sublayers():
+        if isinstance(sub, conv_mod._ConvNd):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+        elif isinstance(sub, common.Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+
+    x = ops_mod.creation.zeros(list(input_size))
+    was_training = net.training
+    net.eval()
+    try:
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

@@ -1,1 +1,2 @@
-
+"""paddle.incubate (LookAhead/ModelAverage + experimental nn)."""
+from . import optimizer_mod as optimizer  # noqa: F401

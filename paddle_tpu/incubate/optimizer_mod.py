@@ -1,0 +1,86 @@
+"""Incubate optimizers (reference python/paddle/incubate/optimizer/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..optimizer.optimizers import Optimizer
+from ..core import autograd
+
+
+class LookAhead(Optimizer):
+    """lookahead wrapper: slow weights sync every k steps."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow = {}
+        self._parameter_list = inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    @autograd.no_grad()
+    def step(self):
+        self.inner.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self._parameter_list or []:
+                key = id(p)
+                slow = self._slow.get(key, p._data)
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[key] = slow
+                p._data = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class ModelAverage(Optimizer):
+    """Maintains an EMA/average of parameters for eval (reference
+    incubate/optimizer/modelaverage.py)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameter_list = list(parameters) if parameters else None
+        self._sums = {}
+        self._counts = {}
+
+    @autograd.no_grad()
+    def step(self):
+        for p in self._parameter_list or []:
+            key = id(p)
+            self._sums[key] = self._sums.get(key, 0.0) + p._data
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            saved = {}
+            for p in self._parameter_list or []:
+                key = id(p)
+                if key in self._sums:
+                    saved[key] = p._data
+                    p._data = self._sums[key] / self._counts[key]
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for p in self._parameter_list or []:
+                        if id(p) in saved:
+                            p._data = saved[id(p)]
+        return guard()
+
+    def restore(self, executor=None):
+        pass

@@ -1,1 +1,182 @@
+"""paddle.metric (reference python/paddle/metric/metrics.py)."""
+from __future__ import annotations
 
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        """Optional device-side pre-reduction before update()."""
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pred = to_tensor(pred)
+        label = to_tensor(label)
+        import jax.numpy as jnp
+        import jax
+        _, idx = jax.lax.top_k(pred._data, self.maxk)
+        lbl = label._data
+        if lbl.ndim == idx.ndim:
+            lbl = jnp.squeeze(lbl, -1) if lbl.shape[-1] == 1 else \
+                jnp.argmax(lbl, -1)
+        correct = (idx == lbl[..., None])
+        return Tensor(correct)
+
+    def update(self, correct, *args):
+        c = np.asarray(to_tensor(correct)._data)
+        num = c.shape[0] if c.ndim > 1 else len(c)
+        accs = []
+        for i, k in enumerate(self.topk):
+            hit = c[..., :k].any(axis=-1).sum()
+            self.total[i] += hit
+            self.count[i] += c.reshape(-1, c.shape[-1]).shape[0]
+            accs.append(float(hit) / max(c.reshape(-1, c.shape[-1]).shape[0], 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        out = [t / max(c, 1e-12) for t, c in zip(self.total, self.count)]
+        return out[0] if len(out) == 1 else out
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(to_tensor(preds)._data).reshape(-1)
+        l = np.asarray(to_tensor(labels)._data).reshape(-1)
+        pred_pos = (p > 0.5).astype(np.int64)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fp += int(((pred_pos == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(to_tensor(preds)._data).reshape(-1)
+        l = np.asarray(to_tensor(labels)._data).reshape(-1)
+        pred_pos = (p > 0.5).astype(np.int64)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fn += int(((pred_pos == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc", *args,
+                 **kwargs):
+        super().__init__()
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(to_tensor(preds)._data)
+        l = np.asarray(to_tensor(labels)._data).reshape(-1)
+        if p.ndim == 2:
+            p = p[:, -1]
+        else:
+            p = p.reshape(-1)
+        bins = np.round(p * self.num_thresholds).astype(np.int64)
+        bins = np.clip(bins, 0, self.num_thresholds)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over thresholds descending
+        pos_cum = np.cumsum(self._stat_pos[::-1])
+        neg_cum = np.cumsum(self._stat_neg[::-1])
+        tpr = pos_cum / tot_pos
+        fpr = neg_cum / tot_neg
+        return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") \
+            else float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference operators/metrics/accuracy_op)."""
+    import jax
+    import jax.numpy as jnp
+    input, label = to_tensor(input), to_tensor(label)
+    _, idx = jax.lax.top_k(input._data, k)
+    lbl = label._data
+    if lbl.ndim == idx.ndim and lbl.shape[-1] == 1:
+        lbl = jnp.squeeze(lbl, -1)
+    hit = (idx == lbl[..., None]).any(axis=-1)
+    return Tensor(jnp.mean(hit.astype(jnp.float32)))

@@ -1,1 +1,22 @@
+"""paddle.nn namespace (reference python/paddle/nn/__init__.py)."""
+from __future__ import annotations
 
+from .layer_base import Layer  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from . import initializer  # noqa: F401
+from . import functional  # noqa: F401
+
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.container import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+
+from . import clip  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+from ..core.tensor import Parameter  # noqa: F401

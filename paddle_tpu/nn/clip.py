@@ -1,0 +1,101 @@
+"""Gradient clipping (reference python/paddle/fluid/clip.py:
+ClipGradByValue/Norm/GlobalNorm).  Used by optimizers via grad_clip=...
+Works on (param, grad) tensor pairs in eager mode and on grad pytrees in
+the jitted path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list of (param, grad Tensor) pairs."""
+        raise NotImplementedError
+
+    def _clip_arrays(self, grads):
+        """Functional form for the jit path: list of arrays -> list."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+    def _clip_arrays(self, grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max)
+                for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return g * scale
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(self._one(g._data))))
+        return out
+
+    def _clip_arrays(self, grads):
+        return [None if g is None else self._one(g) for g in grads]
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _scale(self, arrays):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in arrays if g is not None]
+        if not sq:
+            return None
+        total = jnp.sqrt(jnp.sum(jnp.stack(sq)))
+        return jnp.minimum(self.clip_norm / jnp.maximum(total, 1e-12), 1.0)
+
+    def __call__(self, params_grads):
+        clippable = [g._data for p, g in params_grads
+                     if g is not None and getattr(p, "need_clip", True)]
+        scale = self._scale(clippable)
+        if scale is None:
+            return params_grads
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._data.astype(jnp.float32) * scale)
+                                      .astype(g._data.dtype))))
+        return out
+
+    def _clip_arrays(self, grads):
+        scale = self._scale([g for g in grads if g is not None])
+        if scale is None:
+            return grads
+        return [None if g is None else
+                (g.astype(jnp.float32) * scale).astype(g.dtype)
+                for g in grads]

@@ -1,0 +1,24 @@
+"""paddle.nn.functional namespace — re-exports the functional op surface.
+
+Reference parity: ``python/paddle/nn/functional/`` (activation, common,
+conv, loss, norm, pooling, vision, sparse_attention modules).
+"""
+from __future__ import annotations
+
+# activations
+from ..ops.activation import *  # noqa: F401,F403
+# conv / pool / vision
+from ..ops.conv import *  # noqa: F401,F403
+# norm
+from ..ops.norm_ops import *  # noqa: F401,F403
+# losses
+from ..ops.loss import *  # noqa: F401,F403
+# embedding/dropout/linear/attention
+from ..ops.nn_misc import *  # noqa: F401,F403
+# padding & one-hot style utilities
+from ..ops.manipulation import pad  # noqa: F401
+from ..ops.loss import one_hot  # noqa: F401
+from ..ops.activation import gumbel_softmax  # noqa: F401
+
+# flash attention namespace parity with paddle.nn.functional.flash_attention
+from ..ops.nn_misc import scaled_dot_product_attention as flash_attention  # noqa: F401

@@ -1,0 +1,202 @@
+"""Weight initializers.
+
+Reference parity: ``python/paddle/fluid/initializer.py`` (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA/Kaiming, Bilinear, Assign).
+Each initializer is a callable (shape, dtype) -> jax.Array.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import dtype_to_jnp
+from ..core.random import default_generator
+from ..core.tensor import Tensor
+
+__all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+           "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+           "Assign", "Bilinear", "Orthogonal", "Dirac", "calculate_gain"]
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels (out, in, *spatial) use receptive field size
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+             "conv3d": 1.0, "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+             "selu": 3.0 / 4}
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    return gains.get(nonlinearity, 1.0)
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        return jnp.full(tuple(shape), self.value, dtype_to_jnp(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        key = default_generator.next_key()
+        return self.mean + self.std * jax.random.normal(
+            key, tuple(shape), dtype_to_jnp(dtype))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        key = default_generator.next_key()
+        return self.mean + self.std * jax.random.truncated_normal(
+            key, -2.0, 2.0, tuple(shape), dtype_to_jnp(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32"):
+        key = default_generator.next_key()
+        return jax.random.uniform(key, tuple(shape), dtype_to_jnp(dtype),
+                                  self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fan_in, fan_out = _fans(shape)
+        fan_in = self._fan_in or fan_in
+        fan_out = self._fan_out or fan_out
+        std = self._gain * math.sqrt(2.0 / (fan_in + fan_out))
+        key = default_generator.next_key()
+        return std * jax.random.normal(key, tuple(shape), dtype_to_jnp(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fan_in, fan_out = _fans(shape)
+        fan_in = self._fan_in or fan_in
+        fan_out = self._fan_out or fan_out
+        limit = self._gain * math.sqrt(6.0 / (fan_in + fan_out))
+        key = default_generator.next_key()
+        return jax.random.uniform(key, tuple(shape), dtype_to_jnp(dtype),
+                                  -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity="relu"):
+        self._fan_in = fan_in
+        self._slope = negative_slope
+        self._nl = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fan_in, _ = _fans(shape)
+        fan_in = self._fan_in or fan_in
+        gain = calculate_gain(self._nl, self._slope)
+        std = gain / math.sqrt(fan_in)
+        key = default_generator.next_key()
+        return std * jax.random.normal(key, tuple(shape), dtype_to_jnp(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity="relu"):
+        self._fan_in = fan_in
+        self._slope = negative_slope
+        self._nl = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fan_in, _ = _fans(shape)
+        fan_in = self._fan_in or fan_in
+        gain = calculate_gain(self._nl, self._slope)
+        limit = gain * math.sqrt(3.0 / fan_in)
+        key = default_generator.next_key()
+        return jax.random.uniform(key, tuple(shape), dtype_to_jnp(dtype),
+                                  -limit, limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        arr = self.value._data if isinstance(self.value, Tensor) else \
+            jnp.asarray(self.value, dtype_to_jnp(dtype))
+        return arr.reshape(tuple(shape)).astype(dtype_to_jnp(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for conv_transpose (reference
+    initializer.BilinearInitializer)."""
+
+    def __call__(self, shape, dtype="float32"):
+        c_out, c_in, kh, kw = shape
+        f = math.ceil(kw / 2.0)
+        center = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - abs(og[0] / f - center)) * (1 - abs(og[1] / f - center))
+        weight = np.zeros(shape, dtype=np.float32)
+        for i in range(c_out):
+            weight[i, min(i, c_in - 1)] = filt
+        return jnp.asarray(weight, dtype_to_jnp(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        key = default_generator.next_key()
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        flat = jax.random.normal(key, (max(rows, cols), min(rows, cols)))
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(
+            dtype_to_jnp(dtype))
+
+
+class Dirac(Initializer):
+    def __call__(self, shape, dtype="float32"):
+        out = np.zeros(shape, np.float32)
+        c = min(shape[0], shape[1])
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(c):
+            out[(i, i) + tuple(centers)] = 1.0
+        return jnp.asarray(out, dtype_to_jnp(dtype))

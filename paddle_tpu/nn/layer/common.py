@@ -1,0 +1,253 @@
+"""Common layers: Linear, Embedding, Dropout, Flatten, padding, upsample.
+
+Reference parity: ``python/paddle/nn/layer/common.py``.
+"""
+from __future__ import annotations
+
+from ... import ops
+from ...core.tensor import Tensor
+from ..layer_base import Layer
+from ..param_attr import ParamAttr
+from .. import initializer as I
+
+__all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
+           "AlphaDropout", "Flatten", "Identity", "Upsample",
+           "UpsamplingBilinear2D", "UpsamplingNearest2D", "Pad1D", "Pad2D",
+           "Pad3D", "ZeroPad2D", "Bilinear", "CosineSimilarity",
+           "PairwiseDistance", "Unfold", "PixelShuffle"]
+
+
+class Linear(Layer):
+    """y = xW + b with W: (in_features, out_features) — reference
+    ``python/paddle/nn/layer/common.py`` Linear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        wa = ParamAttr._to_attr(weight_attr)
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=wa,
+            default_initializer=getattr(wa, "initializer", None) or
+            I.XavierNormal())
+        ba = bias_attr
+        if ba is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [out_features], attr=ParamAttr._to_attr(ba), is_bias=True)
+
+    def forward(self, x):
+        return ops.nn_misc.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in={self._in_features}, out={self._out_features}"
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._padding_idx = padding_idx if padding_idx is None or \
+            padding_idx >= 0 else num_embeddings + padding_idx
+        wa = ParamAttr._to_attr(weight_attr)
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=wa,
+            default_initializer=getattr(wa, "initializer", None) or
+            I.Normal(0.0, 1.0))
+        if self._padding_idx is not None:
+            import jax.numpy as jnp
+            self.weight._data = self.weight._data.at[self._padding_idx].set(0.0)
+
+    def forward(self, x):
+        return ops.nn_misc.embedding(x, self.weight,
+                                     padding_idx=self._padding_idx
+                                     if self._padding_idx is not None else None)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        return ops.nn_misc.dropout(x, p=self.p, axis=self.axis,
+                                   training=self.training, mode=self.mode)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.nn_misc.dropout2d(x, p=self.p, training=self.training,
+                                     data_format=self.data_format)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.nn_misc.dropout3d(x, p=self.p, training=self.training,
+                                     data_format=self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return ops.nn_misc.alpha_dropout(x, p=self.p, training=self.training)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        return ops.manipulation.flatten(x, self.start_axis, self.stop_axis)
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, align_mode=0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.align_corners = align_corners
+        self.align_mode = align_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.conv.interpolate(
+            x, size=self.size, scale_factor=self.scale_factor, mode=self.mode,
+            align_corners=self.align_corners, align_mode=self.align_mode,
+            data_format=self.data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__(size, scale_factor, "bilinear", True, 0, data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__(size, scale_factor, "nearest", False, 0, data_format)
+
+
+class _PadNd(Layer):
+    _nd = 2
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.manipulation.pad(x, self.padding, mode=self.mode,
+                                    value=self.value,
+                                    data_format=self.data_format)
+
+
+class Pad1D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCL",
+                 name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad2D(_PadNd):
+    pass
+
+
+class Pad3D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features],
+            attr=ParamAttr._to_attr(weight_attr))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+
+    def forward(self, x1, x2):
+        return ops.nn_misc.bilinear(x1, x2, self.weight, self.bias)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis = axis
+        self.eps = eps
+
+    def forward(self, x1, x2):
+        return ops.nn_misc.cosine_similarity(x1, x2, axis=self.axis,
+                                             eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return ops.nn_misc.pairwise_distance(x, y, self.p, self.epsilon,
+                                             self.keepdim)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return ops.conv.unfold(x, *self.args)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.conv.pixel_shuffle(x, self.upscale_factor, self.data_format)

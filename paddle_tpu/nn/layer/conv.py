@@ -1,0 +1,153 @@
+"""Convolution layers (reference python/paddle/nn/layer/conv.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from ..layer_base import Layer
+from ..param_attr import ParamAttr
+from .. import initializer as I
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose"]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return [int(v)] * n
+    return list(v)
+
+
+class _ConvNd(Layer):
+    _nd = 2
+    _transposed = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 output_padding=0):
+        super().__init__()
+        nd = self._nd
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _ntuple(kernel_size, nd)
+        self._stride = _ntuple(stride, nd)
+        self._padding = padding
+        self._dilation = _ntuple(dilation, nd)
+        self._groups = groups
+        self._data_format = data_format
+        self._padding_mode = padding_mode
+        self._output_padding = output_padding
+        if in_channels % groups != 0:
+            raise ValueError("in_channels must be divisible by groups")
+        if self._transposed:
+            w_shape = [in_channels, out_channels // groups] + self._kernel_size
+        else:
+            w_shape = [out_channels, in_channels // groups] + self._kernel_size
+        wa = ParamAttr._to_attr(weight_attr)
+        fan_in = (in_channels // groups) * int(np.prod(self._kernel_size))
+        self.weight = self.create_parameter(
+            w_shape, attr=wa,
+            default_initializer=getattr(wa, "initializer", None) or
+            I.Normal(0.0, (2.0 / fan_in) ** 0.5))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [out_channels], attr=ParamAttr._to_attr(bias_attr),
+                is_bias=True)
+
+
+class Conv1D(_ConvNd):
+    _nd = 1
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv.conv1d(x, self.weight, self.bias, self._stride,
+                               self._padding, self._dilation, self._groups,
+                               self._data_format)
+
+
+class Conv2D(_ConvNd):
+    _nd = 2
+
+    def forward(self, x):
+        return ops.conv.conv2d(x, self.weight, self.bias, self._stride,
+                               self._padding, self._dilation, self._groups,
+                               self._data_format)
+
+
+class Conv3D(_ConvNd):
+    _nd = 3
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv.conv3d(x, self.weight, self.bias, self._stride,
+                               self._padding, self._dilation, self._groups,
+                               self._data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    _nd = 1
+    _transposed = True
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, output_padding)
+
+    def forward(self, x, output_size=None):
+        return ops.conv.conv1d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._groups, self._dilation,
+            self._data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    _nd = 2
+    _transposed = True
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, output_padding)
+
+    def forward(self, x, output_size=None):
+        return ops.conv.conv2d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._groups, self._dilation,
+            self._data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    _nd = 3
+    _transposed = True
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, output_padding)
+
+    def forward(self, x, output_size=None):
+        return ops.conv.conv3d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._groups, self._dilation,
+            self._data_format)

@@ -1,0 +1,310 @@
+"""Normalization layers (reference python/paddle/nn/layer/norm.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops
+from ...core.tensor import Tensor
+from ..layer_base import Layer
+from ..param_attr import ParamAttr
+from .. import initializer as I
+
+__all__ = ["BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+           "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm1D",
+           "InstanceNorm2D", "InstanceNorm3D", "LocalResponseNorm",
+           "SpectralNorm", "RMSNorm"]
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                [num_features], attr=ParamAttr._to_attr(weight_attr),
+                default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [num_features], attr=ParamAttr._to_attr(bias_attr),
+                is_bias=True)
+        import jax.numpy as jnp
+        self.register_buffer("_mean", Tensor(jnp.zeros([num_features])))
+        self.register_buffer("_variance", Tensor(jnp.ones([num_features])))
+
+    def forward(self, x):
+        return ops.norm_ops.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format,
+            use_global_stats=self._use_global_stats)
+
+
+class BatchNorm(_BatchNormBase):
+    """Legacy fluid.dygraph.BatchNorm signature kept for parity."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-05,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", in_place=False, moving_mean_name=None,
+                 moving_variance_name=None, do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__(num_channels, momentum, epsilon, param_attr,
+                         bias_attr, data_layout,
+                         use_global_stats or None)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            out = getattr(ops.activation, self._act)(out)
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, "NCHW" if data_format == "NCL" else "NHWC"
+                         if data_format == "NLC" else data_format,
+                         use_global_stats)
+        self._orig_format = data_format
+
+    def forward(self, x):
+        return ops.norm_ops.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon,
+            data_format="NCL" if self._orig_format in ("NCL", "NCHW") else "NLC",
+            use_global_stats=self._use_global_stats)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm.  Under pjit/shard_map the batch axis is a
+    named mesh axis; stats are psum-reduced over it (reference
+    sync_batch_norm_op.cu).  In single-device eager mode it behaves like
+    BatchNorm2D."""
+
+    def forward(self, x):
+        from ...distributed import env as dist_env
+        axis = dist_env.current_data_axis()
+        if axis is None:
+            return super().forward(x)
+        import jax
+        import jax.numpy as jnp
+        # inside shard_map: reduce batch stats over the data axis
+        ch_axis = 1 if self._data_format.startswith("NC") else x.ndim - 1
+        axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+        mean = jnp.mean(x._data, axis=axes)
+        meansq = jnp.mean(jnp.square(x._data), axis=axes)
+        mean = jax.lax.pmean(mean, axis)
+        meansq = jax.lax.pmean(meansq, axis)
+        var = meansq - jnp.square(mean)
+        bshape = [1] * x.ndim
+        bshape[ch_axis] = x.shape[ch_axis]
+
+        def impl(a, w, b):
+            out = (a - mean.reshape(bshape)) * jax.lax.rsqrt(
+                var.reshape(bshape) + self._epsilon)
+            if w is not None:
+                out = out * w.reshape(bshape)
+            if b is not None:
+                out = out + b.reshape(bshape)
+            return out
+        from ...core.dispatch import dispatch
+        tensors = [x]
+        if self.weight is not None:
+            tensors.append(self.weight)
+        if self.bias is not None:
+            tensors.append(self.bias)
+
+        def fn(a, *wb):
+            w = wb[0] if self.weight is not None else None
+            b = wb[-1] if self.bias is not None else None
+            return impl(a, w, b)
+        out = dispatch("sync_batch_norm", fn, tensors, {})
+        if self.training:
+            self._mean._data = (self._momentum * self._mean._data +
+                                (1 - self._momentum) * mean)
+            self._variance._data = (self._momentum * self._variance._data +
+                                    (1 - self._momentum) * var)
+        return out
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, cls):
+            out = cls(layer._num_features, layer._momentum, layer._epsilon,
+                      data_format=layer._data_format)
+            if layer.weight is not None:
+                out.weight._data = layer.weight._data
+            if layer.bias is not None:
+                out.bias._data = layer.bias._data
+            out._mean._data = layer._mean._data
+            out._variance._data = layer._variance._data
+        for name, sub in list(layer._sub_layers.items()):
+            converted = cls.convert_sync_batchnorm(sub)
+            if converted is not sub:
+                out.add_sublayer(name, converted)
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                self._normalized_shape, attr=ParamAttr._to_attr(weight_attr),
+                default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                self._normalized_shape, attr=ParamAttr._to_attr(bias_attr),
+                is_bias=True)
+
+    def forward(self, x):
+        return ops.norm_ops.layer_norm(x, self._normalized_shape, self.weight,
+                                       self.bias, self._epsilon)
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [hidden_size], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return ops.norm_ops.rms_norm(x, self.weight, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_channels], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_channels], attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+
+    def forward(self, x):
+        return ops.norm_ops.group_norm(x, self._num_groups, self._epsilon,
+                                       self.weight, self.bias,
+                                       self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                [num_features], attr=ParamAttr._to_attr(weight_attr),
+                default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [num_features], attr=ParamAttr._to_attr(bias_attr),
+                is_bias=True)
+
+    def forward(self, x):
+        return ops.norm_ops.instance_norm(x, weight=self.weight,
+                                          bias=self.bias, eps=self._epsilon,
+                                          data_format=self._data_format)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return ops.norm_ops.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    """Spectral norm of a weight tensor via power iteration (reference
+    operators/spectral_norm_op)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        import jax.numpy as jnp
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.register_buffer("weight_u", Tensor(
+            np.random.normal(0, 1, [h]).astype("float32")))
+        self.register_buffer("weight_v", Tensor(
+            np.random.normal(0, 1, [w]).astype("float32")))
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+        w = weight._data if isinstance(weight, Tensor) else weight
+        mat = jnp.moveaxis(w, self._dim, 0).reshape(w.shape[self._dim], -1)
+        u, v = self.weight_u._data, self.weight_v._data
+        for _ in range(self._power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self._eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self._eps)
+        sigma = u @ mat @ v
+        self.weight_u._data = u
+        self.weight_v._data = v
+        return Tensor(w / sigma)

@@ -1,0 +1,117 @@
+"""Pooling layers (reference python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from ... import ops
+from ..layer_base import Layer
+
+__all__ = ["MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
+           "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool2D"]
+
+
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, return_mask, ceil_mode)
+
+    def forward(self, x):
+        return ops.conv.max_pool1d(x, *self.a)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, return_mask, ceil_mode,
+                  data_format)
+
+    def forward(self, x):
+        return ops.conv.max_pool2d(x, *self.a)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCDHW", name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, return_mask, ceil_mode,
+                  data_format)
+
+    def forward(self, x):
+        return ops.conv.max_pool3d(x, *self.a)
+
+
+class AvgPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, exclusive, ceil_mode)
+
+    def forward(self, x):
+        return ops.conv.avg_pool1d(x, *self.a)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, ceil_mode, exclusive,
+                  divisor_override, data_format)
+
+    def forward(self, x):
+        return ops.conv.avg_pool2d(x, *self.a)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, ceil_mode, exclusive,
+                  divisor_override, data_format)
+
+    def forward(self, x):
+        return ops.conv.avg_pool3d(x, *self.a)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return ops.conv.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.conv.adaptive_avg_pool2d(x, self.output_size,
+                                            self.data_format)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.conv.adaptive_avg_pool3d(x, self.output_size,
+                                            self.data_format)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return ops.conv.adaptive_max_pool2d(x, self.output_size,
+                                            self.return_mask)

@@ -1,0 +1,304 @@
+"""Recurrent layers.
+
+Reference parity: ``python/paddle/nn/layer/rnn.py`` (SimpleRNN/LSTM/GRU +
+cells, reference cudnn rnn_op).  TPU-first: the time loop is a
+``lax.scan`` — one compiled step reused across T, which XLA pipelines;
+no cudnn descriptor machinery.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import ops
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor, to_tensor
+from ..layer_base import Layer
+from ..param_attr import ParamAttr
+from .. import initializer as I
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = to_tensor(batch_ref).shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, tuple):
+            return tuple(Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                         jnp.float32)) for s in shape)
+        return Tensor(jnp.full((batch,) + tuple(shape), init_value,
+                               jnp.float32))
+
+
+def _cell_params(layer, input_size, hidden_size, gates, weight_ih_attr,
+                 weight_hh_attr, bias_ih_attr, bias_hh_attr):
+    std = 1.0 / np.sqrt(hidden_size)
+    u = I.Uniform(-std, std)
+    layer.weight_ih = layer.create_parameter(
+        [gates * hidden_size, input_size],
+        attr=ParamAttr._to_attr(weight_ih_attr), default_initializer=u)
+    layer.weight_hh = layer.create_parameter(
+        [gates * hidden_size, hidden_size],
+        attr=ParamAttr._to_attr(weight_hh_attr), default_initializer=u)
+    layer.bias_ih = None if bias_ih_attr is False else layer.create_parameter(
+        [gates * hidden_size], attr=ParamAttr._to_attr(bias_ih_attr),
+        is_bias=True, default_initializer=u)
+    layer.bias_hh = None if bias_hh_attr is False else layer.create_parameter(
+        [gates * hidden_size], attr=ParamAttr._to_attr(bias_hh_attr),
+        is_bias=True, default_initializer=u)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre = ops.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            pre = pre + self.bias_ih
+        pre = pre + ops.matmul(states, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            pre = pre + self.bias_hh
+        act = ops.activation.tanh if self.activation == "tanh" else \
+            ops.activation.relu
+        h = act(pre)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        h, c = states
+        gates = ops.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            gates = gates + self.bias_ih
+        gates = gates + ops.matmul(h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            gates = gates + self.bias_hh
+        i, f, g, o = ops.manipulation.split(gates, 4, axis=-1)
+        i = ops.activation.sigmoid(i)
+        f = ops.activation.sigmoid(f)
+        g = ops.activation.tanh(g)
+        o = ops.activation.sigmoid(o)
+        new_c = f * c + i * g
+        new_h = o * ops.activation.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states
+        x_gates = ops.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            x_gates = x_gates + self.bias_ih
+        h_gates = ops.matmul(h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            h_gates = h_gates + self.bias_hh
+        xr, xz, xc = ops.manipulation.split(x_gates, 3, axis=-1)
+        hr, hz, hc = ops.manipulation.split(h_gates, 3, axis=-1)
+        r = ops.activation.sigmoid(xr + hr)
+        z = ops.activation.sigmoid(xz + hz)
+        c = ops.activation.tanh(xc + r * hc)
+        new_h = (1.0 - z) * c + z * h
+        return new_h, new_h
+
+
+class RNN(Layer):
+    """Run a cell over time with lax.scan (reference rnn.py RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = to_tensor(inputs)
+        if not self.time_major:
+            inputs_t = ops.manipulation.transpose(inputs, [1, 0, 2])
+        else:
+            inputs_t = inputs
+        if self.is_reverse:
+            inputs_t = ops.manipulation.flip(inputs_t, axis=0)
+        if initial_states is None:
+            batch_axis = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=batch_axis)
+
+        # eager scan in Python keeps autograd simple & correct; under jit
+        # tracing (functional path) XLA unrolls/pipelines it.
+        states = initial_states
+        outs = []
+        for t in range(inputs_t.shape[0]):
+            out, states = self.cell(inputs_t[t], states)
+            outs.append(out)
+        outputs = ops.manipulation.stack(outs, axis=0)
+        if self.is_reverse:
+            outputs = ops.manipulation.flip(outputs, axis=0)
+        if not self.time_major:
+            outputs = ops.manipulation.transpose(outputs, [1, 0, 2])
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (None, None) if initial_states is None else \
+            initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        out = ops.manipulation.concat([out_fw, out_bw], axis=-1)
+        return out, (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    _cell_cls = SimpleRNNCell
+    _cell_args = ()
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None, **cell_kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        from .container import LayerList
+        self.rnns = LayerList()
+        attrs = dict(weight_ih_attr=weight_ih_attr,
+                     weight_hh_attr=weight_hh_attr,
+                     bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+        for layer_i in range(num_layers):
+            in_size = input_size if layer_i == 0 else \
+                hidden_size * self.num_directions
+            if bidirect:
+                self.rnns.append(BiRNN(
+                    self._cell_cls(in_size, hidden_size, **cell_kwargs, **attrs),
+                    self._cell_cls(in_size, hidden_size, **cell_kwargs, **attrs),
+                    time_major))
+            else:
+                self.rnns.append(RNN(
+                    self._cell_cls(in_size, hidden_size, **cell_kwargs, **attrs),
+                    direction == "backward", time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        final_states = []
+        for i, rnn in enumerate(self.rnns):
+            st = None
+            if initial_states is not None:
+                st = self._slice_states(initial_states, i)
+            out, states = rnn(out, st, sequence_length)
+            final_states.append(states)
+            if self.dropout > 0.0 and i < self.num_layers - 1:
+                out = ops.nn_misc.dropout(out, p=self.dropout,
+                                          training=self.training)
+        return out, self._merge_states(final_states)
+
+    def _slice_states(self, initial_states, i):
+        # initial_states: (num_layers*num_directions, batch, hidden) or tuple
+        def pick(s):
+            base = i * self.num_directions
+            if self.num_directions == 2:
+                return (s[base], s[base + 1])
+            return s[base]
+        if isinstance(initial_states, (tuple, list)):
+            h, c = initial_states
+            if self.num_directions == 2:
+                return ((pick(h)[0], pick(c)[0]), (pick(h)[1], pick(c)[1]))
+            return (pick(h), pick(c))
+        return pick(initial_states)
+
+    def _merge_states(self, final_states):
+        # LSTM states are (h, c) pairs; others single h
+        flat_h, flat_c = [], []
+        for st in final_states:
+            items = st if isinstance(st, tuple) and len(st) == 2 and \
+                isinstance(st[0], tuple) else [st]
+            if self.num_directions == 2:
+                for direction_state in st:
+                    self._push(direction_state, flat_h, flat_c)
+            else:
+                self._push(st, flat_h, flat_c)
+        h = ops.manipulation.stack(flat_h, axis=0)
+        if flat_c:
+            c = ops.manipulation.stack(flat_c, axis=0)
+            return (h, c)
+        return h
+
+    @staticmethod
+    def _push(state, flat_h, flat_c):
+        if isinstance(state, tuple):
+            flat_h.append(state[0])
+            flat_c.append(state[1])
+        else:
+            flat_h.append(state)
+
+
+class SimpleRNN(_RNNBase):
+    _cell_cls = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    _cell_cls = LSTMCell
+
+
+class GRU(_RNNBase):
+    _cell_cls = GRUCell

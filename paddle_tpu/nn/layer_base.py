@@ -1,0 +1,355 @@
+"""nn.Layer: the module system.
+
+Reference parity: ``python/paddle/fluid/dygraph/layers.py:81`` (Layer with
+hooks, state_dict, train/eval, parameter registration via __setattr__).
+TPU-first addition: ``functional_state`` / ``load_functional_state`` — a
+pytree view of (params, buffers) that the jitted train-step path threads
+through XLA, so the same Layer object serves both eager and compiled
+execution.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import dtype_to_jnp, canonical_dtype
+from ..core.tensor import Parameter, Tensor, to_tensor
+
+__all__ = ["Layer"]
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, key):
+        self._hooks = hooks
+        self._key = key
+
+    def remove(self):
+        self._hooks.pop(self._key, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        self._dtype = canonical_dtype(dtype)
+        self.training = True
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_counter = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------------
+    # registration
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        bufs = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__() first")
+            params[name] = value
+            subs.pop(name, None) if subs else None
+            if bufs:
+                bufs.pop(name, None)
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            subs[name] = value
+            if params:
+                params.pop(name, None)
+            object.__setattr__(self, name, value)
+        else:
+            if params and name in params and value is None:
+                params.pop(name)
+            if bufs is not None and name in bufs:
+                if isinstance(value, Tensor):
+                    bufs[name] = value
+                else:
+                    bufs.pop(name)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+            object.__setattr__(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        object.__setattr__(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = to_tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        if tensor is not None:
+            object.__setattr__(self, name, tensor)
+
+    def create_parameter(self, shape, attr=None, dtype=None,
+                         is_bias: bool = False, default_initializer=None):
+        from . import initializer as I
+        dtype = dtype or self._dtype
+        init = None
+        if default_initializer is not None:
+            init = default_initializer
+        elif attr is not None and getattr(attr, "initializer", None) is not None:
+            init = attr.initializer
+        else:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        data = init(shape, dtype)
+        p = Parameter(data, trainable=True)
+        if attr is not None and getattr(attr, "name", None):
+            p.name = attr.name
+        if attr is not None and getattr(attr, "learning_rate", None) is not None:
+            p.optimize_attr["learning_rate"] = attr.learning_rate
+        if attr is not None and getattr(attr, "trainable", True) is False:
+            p.stop_gradient = True
+            p.trainable = False
+        if attr is not None:
+            p.regularizer = getattr(attr, "regularizer", None)
+        return p
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else prefix + "." + name), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                sub_prefix = prefix + "." + lname if prefix else lname
+                for n, p in layer.named_parameters(sub_prefix):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (prefix + name if not prefix else prefix + "." + name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                sub_prefix = prefix + "." + lname if prefix else lname
+                yield from layer.named_buffers(sub_prefix)
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            sub_prefix = prefix + "." + name if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(sub_prefix)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        yield from self._sub_layers.values()
+
+    def named_children(self):
+        yield from self._sub_layers.items()
+
+    def apply(self, fn: Callable[["Layer"], None]):
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------------
+    # modes
+    # ------------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for layer in self.children():
+            layer.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for layer in self.children():
+            layer.eval()
+        return self
+
+    # ------------------------------------------------------------------
+    # hooks
+    # ------------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        key = self._hook_counter
+        self._hook_counter += 1
+        self._forward_pre_hooks[key] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, key)
+
+    def register_forward_post_hook(self, hook):
+        key = self._hook_counter
+        self._hook_counter += 1
+        self._forward_post_hooks[key] = hook
+        return HookRemoveHelper(self._forward_post_hooks, key)
+
+    # ------------------------------------------------------------------
+    # call
+    # ------------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   use_hook: bool = True) -> Dict[str, Tensor]:
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[name] = p
+        for name, b in self.named_buffers():
+            leaf = name.rsplit(".", 1)[-1]
+            owner = self._locate(name)
+            if owner is not None and leaf in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def _locate(self, dotted: str) -> Optional["Layer"]:
+        parts = dotted.split(".")[:-1]
+        layer = self
+        for p in parts:
+            layer = layer._sub_layers.get(p)
+            if layer is None:
+                return None
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, value in state_dict.items():
+            if name not in own:
+                unexpected.append(name)
+                continue
+            target = own[name]
+            arr = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+            if tuple(arr.shape) != tuple(target._data.shape):
+                raise ValueError(
+                    f"shape mismatch for '{name}': checkpoint "
+                    f"{tuple(arr.shape)} vs model {tuple(target._data.shape)}")
+            target._data = arr.astype(target._data.dtype)
+        for name in own:
+            if name not in state_dict:
+                missing.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # dtype/device movement
+    # ------------------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        def _move(t):
+            if t is None:
+                return t
+            out = t
+            if device is not None:
+                out = out.to(device)
+            if dtype is not None and jnp.issubdtype(out.dtype, jnp.floating):
+                out = out.astype(dtype)
+            t._data = out._data
+            return t
+        for p in self.parameters():
+            _move(p)
+        for b in self.buffers():
+            _move(b)
+        if dtype is not None:
+            for _, l in self.named_sublayers(include_self=True):
+                l._dtype = canonical_dtype(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # ------------------------------------------------------------------
+    # functional state bridge (jit path)
+    # ------------------------------------------------------------------
+    def functional_state(self):
+        """Return ({name: jax.Array params}, {name: jax.Array buffers})."""
+        params = {n: p._data for n, p in self.named_parameters()}
+        buffers = {n: b._data for n, b in self.named_buffers()}
+        return params, buffers
+
+    def load_functional_state(self, params=None, buffers=None):
+        """Rebind arrays (traced or concrete) into the live tensors."""
+        if params:
+            lookup = dict(self.named_parameters())
+            for n, a in params.items():
+                lookup[n]._data = a
+        if buffers:
+            lookup = dict(self.named_buffers())
+            for n, a in buffers.items():
+                lookup[n]._data = a
+
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def __repr__(self):
+        lines = [self.__class__.__name__ + "("]
+        for name, layer in self._sub_layers.items():
+            rep = repr(layer).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {rep}")
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else \
+            self.__class__.__name__ + "()"

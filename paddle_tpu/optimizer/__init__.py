@@ -1,1 +1,4 @@
-
+"""paddle.optimizer namespace."""
+from .optimizers import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
+                         Adagrad, Adadelta, RMSProp, Lamb, L2Decay)  # noqa: F401
+from . import lr  # noqa: F401

@@ -1,0 +1,492 @@
+"""Optimizers.
+
+Reference parity: ``python/paddle/optimizer/`` + device kernels under
+``paddle/fluid/operators/optimizers/`` (sgd, momentum+nesterov, adam/adamw/
+adamax/lamb w/ multi-precision, adagrad/adadelta/rmsprop).
+
+TPU-first design: each optimizer defines ONE pure function
+``_update(param, grad, state, lr) -> (new_param, new_state)`` over jax
+arrays.  The eager ``step()`` path applies it per parameter with in-place
+rebind; the jitted train-step path threads (params, state) pytrees through
+the same function inside XLA, so optimizer math fuses with the backward
+pass.  Multi-precision (bf16 params + fp32 master weights) mirrors the
+reference's multi_precision kernels.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+
+
+class L2Decay:
+    """weight_decay coefficient wrapper (reference regularizer.L2Decay)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+def _wd_coeff(weight_decay):
+    if weight_decay is None:
+        return 0.0
+    if isinstance(weight_decay, L2Decay):
+        return weight_decay.coeff
+    return float(weight_decay)
+
+
+class Optimizer:
+    _coupled_weight_decay = True  # L2 added to grad (SGD-style); AdamW=False
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._weight_decay = _wd_coeff(weight_decay)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._state: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._master_weights: Dict[int, jnp.ndarray] = {}
+        self._global_step = 0
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate,
+                                                 LRScheduler) else None
+
+    # -- state -------------------------------------------------------------
+    def _init_state_for(self, param_arr) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def _slot(self, p: Parameter):
+        key = id(p)
+        if key not in self._state:
+            arr = p._data
+            if self._multi_precision and arr.dtype in (jnp.bfloat16,
+                                                       jnp.float16):
+                self._master_weights[key] = arr.astype(jnp.float32)
+            self._state[key] = self._init_state_for(
+                self._master_weights.get(key, arr))
+        return self._state[key]
+
+    # -- core pure update --------------------------------------------------
+    def _update(self, param, grad, state, lr):
+        raise NotImplementedError
+
+    # -- eager step --------------------------------------------------------
+    @autograd.no_grad()
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        lr = self.get_lr()
+        pgs = [(p, p.grad) for p in params
+               if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            pgs = self._grad_clip(pgs)
+        for p, g in pgs:
+            if g is None:
+                continue
+            state = self._slot(p)
+            garr = g._data if isinstance(g, Tensor) else g
+            key = id(p)
+            parr = self._master_weights.get(key, p._data)
+            garr = garr.astype(parr.dtype)
+            lr_eff = lr * p.optimize_attr.get("learning_rate", 1.0)
+            if self._weight_decay and self._coupled_weight_decay and \
+                    p.regularizer is None:
+                garr = garr + self._weight_decay * parr
+            new_p, new_state = self._update(parr, garr, state, lr_eff)
+            if key in self._master_weights:
+                self._master_weights[key] = new_p
+                p._data = new_p.astype(p._data.dtype)
+            else:
+                p._data = new_p
+            self._state[key] = new_state
+        self._global_step += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        if loss._grad_node is not None and all(
+                p.grad is None for p in (self._parameter_list or [])):
+            loss.backward()
+        self.step()
+        return None, None
+
+    @autograd.no_grad()
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list or []:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- functional bridge (jit path) --------------------------------------
+    def functional_init(self, params: Dict[str, jnp.ndarray]):
+        """Build an optimizer state pytree for the jitted train step."""
+        state = {n: self._init_state_for(
+            a.astype(jnp.float32) if self._multi_precision and
+            a.dtype in (jnp.bfloat16, jnp.float16) else a)
+            for n, a in params.items()}
+        master = {n: a.astype(jnp.float32) for n, a in params.items()
+                  if self._multi_precision and a.dtype in (jnp.bfloat16,
+                                                           jnp.float16)}
+        return {"slots": state, "master": master,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def functional_apply(self, params, grads, opt_state, lr=None):
+        """Pure: (params, grads, state) -> (new_params, new_state)."""
+        lr = self.get_lr() if lr is None else lr
+        slots = dict(opt_state["slots"])
+        master = dict(opt_state["master"])
+        new_params = {}
+        names = list(params.keys())
+        if self._grad_clip is not None:
+            garrs = self._grad_clip._clip_arrays([grads.get(n) for n in names])
+            grads = dict(zip(names, garrs))
+        for n in names:
+            g = grads.get(n)
+            if g is None:
+                new_params[n] = params[n]
+                continue
+            parr = master.get(n, params[n])
+            g = g.astype(parr.dtype)
+            if self._weight_decay and self._coupled_weight_decay:
+                g = g + self._weight_decay * parr
+            new_p, slots[n] = self._update(parr, g, slots[n], lr)
+            if n in master:
+                master[n] = new_p
+                new_params[n] = new_p.astype(params[n].dtype)
+            else:
+                new_params[n] = new_p
+        return new_params, {"slots": slots, "master": master,
+                            "step": opt_state["step"] + 1}
+
+    # -- checkpointing -----------------------------------------------------
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        for p in self._parameter_list or []:
+            slot = self._state.get(id(p))
+            if slot:
+                for k, v in slot.items():
+                    out[f"{p.name}_{k}"] = Tensor(v)
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._global_step = state_dict.get("global_step", 0)
+        if self._lr_scheduler is not None and "LR_Scheduler" in state_dict:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+        for p in self._parameter_list or []:
+            slot = self._slot(p)
+            for k in list(slot.keys()):
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    slot[k] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    """reference operators/optimizers/sgd_op.cc"""
+
+    def _update(self, param, grad, state, lr):
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    """reference operators/optimizers/momentum_op.h (+nesterov)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_state_for(self, param_arr):
+        return {"velocity": jnp.zeros_like(param_arr)}
+
+    def _update(self, param, grad, state, lr):
+        v = self._momentum * state["velocity"] + grad
+        if self._use_nesterov:
+            new_p = param - lr * (grad + self._momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference operators/optimizers/adam_op.{h,cu}"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state_for(self, param_arr):
+        # beta pows accumulate in f32 regardless of param dtype: bf16
+        # rounds 0.999 to ~0.996 and wrecks early bias correction
+        return {"moment1": jnp.zeros_like(param_arr),
+                "moment2": jnp.zeros_like(param_arr),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m1 = b1 * state["moment1"] + (1 - b1) * grad
+        m2 = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        lr_t = (lr * jnp.sqrt(1 - b2p) / (1 - b1p)).astype(param.dtype)
+        new_p = param - lr_t * m1 / (jnp.sqrt(m2) + eps)
+        return new_p.astype(param.dtype), {"moment1": m1, "moment2": m2,
+                                           "beta1_pow": b1p,
+                                           "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference adamw semantics:
+    python/paddle/optimizer/adamw.py)."""
+
+    _coupled_weight_decay = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decay_names = None
+
+    def _should_decay(self, name):
+        if self._apply_decay_param_fun is None:
+            return True
+        return self._apply_decay_param_fun(name)
+
+    def _update(self, param, grad, state, lr):
+        # decoupled decay happens before the adam update
+        decayed = param * (1.0 - lr * self._wd_for_current) \
+            if self._wd_for_current else param
+        return super()._update(decayed, grad, state, lr)
+
+    # plumbing: _wd_for_current set per-param so apply_decay_param_fun works
+    _wd_for_current = 0.0
+
+    @autograd.no_grad()
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        lr = self.get_lr()
+        pgs = [(p, p.grad) for p in params
+               if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            pgs = self._grad_clip(pgs)
+        for p, g in pgs:
+            state = self._slot(p)
+            key = id(p)
+            parr = self._master_weights.get(key, p._data)
+            garr = (g._data if isinstance(g, Tensor) else g).astype(parr.dtype)
+            self._wd_for_current = self._weight_decay if \
+                self._should_decay(p.name) else 0.0
+            lr_eff = lr * p.optimize_attr.get("learning_rate", 1.0)
+            new_p, new_state = self._update(parr, garr, state, lr_eff)
+            if key in self._master_weights:
+                self._master_weights[key] = new_p
+                p._data = new_p.astype(p._data.dtype)
+            else:
+                p._data = new_p
+            self._state[key] = new_state
+        self._wd_for_current = 0.0
+        self._global_step += 1
+
+    def functional_apply(self, params, grads, opt_state, lr=None):
+        lr = self.get_lr() if lr is None else lr
+        slots = dict(opt_state["slots"])
+        master = dict(opt_state["master"])
+        new_params = {}
+        names = list(params.keys())
+        if self._grad_clip is not None:
+            garrs = self._grad_clip._clip_arrays([grads.get(n) for n in names])
+            grads = dict(zip(names, garrs))
+        for n in names:
+            g = grads.get(n)
+            if g is None:
+                new_params[n] = params[n]
+                continue
+            parr = master.get(n, params[n])
+            g = g.astype(parr.dtype)
+            self._wd_for_current = self._weight_decay if \
+                self._should_decay(n) else 0.0
+            new_p, slots[n] = self._update(parr, g, slots[n], lr)
+            if n in master:
+                master[n] = new_p
+                new_params[n] = new_p.astype(params[n].dtype)
+            else:
+                new_params[n] = new_p
+        self._wd_for_current = 0.0
+        return new_params, {"slots": slots, "master": master,
+                            "step": opt_state["step"] + 1}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state_for(self, param_arr):
+        return {"moment": jnp.zeros_like(param_arr),
+                "inf_norm": jnp.zeros_like(param_arr),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        m = b1 * state["moment"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(grad))
+        step_lr = (lr / (1 - b1p)).astype(param.dtype)
+        new_p = param - step_lr * m / (u + eps)
+        return new_p.astype(param.dtype), {"moment": m, "inf_norm": u,
+                                           "beta1_pow": b1p}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state_for(self, param_arr):
+        return {"moment": jnp.full_like(param_arr, self._init_acc)}
+
+    def _update(self, param, grad, state, lr):
+        acc = state["moment"] + jnp.square(grad)
+        new_p = param - lr * grad / (jnp.sqrt(acc) + self._epsilon)
+        return new_p, {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state_for(self, param_arr):
+        return {"avg_squared_grad": jnp.zeros_like(param_arr),
+                "avg_squared_update": jnp.zeros_like(param_arr)}
+
+    def _update(self, param, grad, state, lr):
+        rho, eps = self._rho, self._epsilon
+        g2 = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(grad)
+        update = -jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(g2 + eps) * grad
+        u2 = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(update)
+        return param + lr * update, {"avg_squared_grad": g2,
+                                     "avg_squared_update": u2}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state_for(self, param_arr):
+        s = {"mean_square": jnp.zeros_like(param_arr),
+             "momentum_acc": jnp.zeros_like(param_arr)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(param_arr)
+        return s
+
+    def _update(self, param, grad, state, lr):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(grad)
+        out_state = {"mean_square": ms}
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+            out_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["momentum_acc"] + lr * grad / denom
+        out_state["momentum_acc"] = mom
+        return param - mom, out_state
+
+
+class Lamb(Optimizer):
+    """reference operators/optimizers/lamb_op.h (layerwise adaptive)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-06, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lamb_wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state_for(self, param_arr):
+        return {"moment1": jnp.zeros_like(param_arr),
+                "moment2": jnp.zeros_like(param_arr),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m1 = b1 * state["moment1"] + (1 - b1) * grad
+        m2 = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        m1_hat = (m1 / (1 - b1p)).astype(param.dtype)
+        m2_hat = (m2 / (1 - b2p)).astype(param.dtype)
+        r = m1_hat / (jnp.sqrt(m2_hat) + eps) + self._lamb_wd * param
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(param)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = param - (lr * trust).astype(param.dtype) * r
+        return new_p.astype(param.dtype), {"moment1": m1, "moment2": m2,
+                                           "beta1_pow": b1p,
+                                           "beta2_pow": b2p}

@@ -1,1 +1,94 @@
+"""paddle.profiler — thin veneer over jax.profiler.
 
+Reference parity: ``python/paddle/fluid/profiler.py`` +
+``platform/profiler.h:216`` (RecordEvent, chrome-trace export).  On TPU
+the device-side tracing (the reference's CUPTI path) is jax.profiler's
+XLA/TPU trace, viewable in TensorBoard/Perfetto.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+__all__ = ["Profiler", "RecordEvent", "profiler", "start_profiler",
+           "stop_profiler"]
+
+_active = {"dir": None}
+
+
+class RecordEvent:
+    """Named host-side span (reference platform/profiler RecordEvent RAII)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ctx.__exit__(*exc)
+        return False
+
+    begin = __enter__
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+
+def start_profiler(state=None, tracer_option=None, log_dir="profile_log"):
+    _active["dir"] = log_dir
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    if _active["dir"] is not None:
+        jax.profiler.stop_trace()
+        _active["dir"] = None
+
+
+@contextlib.contextmanager
+def profiler(state=None, sorted_key=None, profile_path=None,
+             tracer_option=None, log_dir="profile_log"):
+    start_profiler(state, tracer_option, log_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class Profiler:
+    """paddle.profiler.Profiler-style API."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, log_dir="profile_log"):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.time()
+        if not self.timer_only:
+            jax.profiler.start_trace(self.log_dir)
+
+    def stop(self):
+        if not self.timer_only:
+            jax.profiler.stop_trace()
+
+    def step(self, num_samples=None):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, **kw):
+        print(f"[profiler] trace written to {self.log_dir}")

@@ -1,1 +1,20 @@
+"""paddle.static facade.
 
+Reference parity: the 2.x static-graph veneer (Program/Executor/
+program_guard/InputSpec).  TPU-first: a "Program" is a captured python
+callable compiled by XLA; Executor.run feeds/fetches through a jitted
+wrapper.  The full ProgramDesc protobuf machinery is intentionally not
+reproduced — jaxpr/HLO is the IR (see SURVEY.md §7 translation table).
+"""
+from .mode import enable_static, disable_static, in_dynamic_mode  # noqa: F401
+from .program import (Program, default_main_program,  # noqa: F401
+                      default_startup_program, program_guard, data,
+                      Executor, CompiledProgram)
+from ..jit import InputSpec  # noqa: F401
+from .. import nn as _nn  # re-export layer helpers commonly used in static
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core.autograd import grad as _grad
+    return _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)

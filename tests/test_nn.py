@@ -1,0 +1,227 @@
+"""nn.Layer system + layers + optimizers + amp tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestLayerSystem:
+    def test_registration_and_traversal(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        assert len(net.sublayers()) == 2
+
+    def test_train_eval_propagates(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(
+            lambda l, i, o: calls.append(o.shape))
+        net(paddle.ones([3, 2]))
+        assert calls == [[3, 2]]
+        h.remove()
+        net(paddle.ones([3, 2]))
+        assert len(calls) == 1
+
+    def test_state_dict_buffers(self):
+        bn = nn.BatchNorm2D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd and "weight" in sd
+
+    def test_dropout_modes(self):
+        paddle.seed(7)
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100])
+        y = d(x)
+        kept = float((y.numpy() > 0).mean())
+        assert 0.2 < kept < 0.8
+        # upscale keeps expectation
+        assert abs(float(y.numpy().mean()) - 1.0) < 0.35
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+    def test_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="bfloat16")
+        import jax.numpy as jnp
+        assert net.weight.dtype == jnp.bfloat16
+
+
+class TestBatchNormTraining:
+    def test_running_stats_update(self):
+        bn = nn.BatchNorm2D(3, momentum=0.5)
+        x = paddle.randn([8, 3, 4, 4]) * 2 + 5
+        bn.train()
+        bn(x)
+        assert abs(float(bn._mean.numpy().mean()) - 2.5) < 1.0
+        bn.eval()
+        before = bn._mean.numpy().copy()
+        bn(x)
+        np.testing.assert_allclose(bn._mean.numpy(), before)
+
+
+class TestOptimizers:
+    def _quad_problem(self, opt_cls, lr=0.1, steps=60, **kw):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([5.0, -3.0], "float32"),
+                             stop_gradient=False)
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(w._data)
+        opt = opt_cls(learning_rate=lr, parameters=[p], **kw)
+        for _ in range(steps):
+            loss = ((p - paddle.to_tensor([1.0, 2.0])) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return p.numpy()
+
+    @pytest.mark.parametrize("opt_cls,lr", [
+        (paddle.optimizer.SGD, 0.1),
+        (paddle.optimizer.Momentum, 0.05),
+        (paddle.optimizer.Adam, 0.2),
+        (paddle.optimizer.AdamW, 0.2),
+        (paddle.optimizer.RMSProp, 0.1),
+        (paddle.optimizer.Adagrad, 0.8),
+    ])
+    def test_converges(self, opt_cls, lr):
+        final = self._quad_problem(opt_cls, lr=lr)
+        np.testing.assert_allclose(final, [1.0, 2.0], atol=0.2)
+
+    def test_lamb_converges(self):
+        final = self._quad_problem(paddle.optimizer.Lamb, lr=0.15, steps=300,
+                                   lamb_weight_decay=0.0)
+        np.testing.assert_allclose(final, [1.0, 2.0], atol=0.2)
+
+    def test_lr_scheduler(self):
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.zeros(1, "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[p])
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step()
+        sched.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_global_norm_clip(self):
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.zeros(2, "float32"))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p],
+                                   grad_clip=clip)
+        loss = (p * paddle.to_tensor([30.0, 40.0])).sum()
+        loss.backward()
+        opt.step()
+        # grad (30,40) norm 50 -> clipped to (0.6, 0.8); p = -grad*lr
+        np.testing.assert_allclose(p.numpy(), [-0.6, -0.8], rtol=1e-5)
+
+
+class TestAmp:
+    def test_autocast_matmul_bf16(self):
+        import jax.numpy as jnp
+        a = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(level="O1"):
+            out = paddle.matmul(a, a)
+        assert out.dtype == jnp.bfloat16
+        out2 = paddle.matmul(a, a)
+        assert out2.dtype == jnp.float32
+
+    def test_grad_scaler_dynamic(self):
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.ones(2, "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       incr_every_n_steps=1,
+                                       decr_every_n_nan_or_inf=1)
+        loss = (p * p).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)  # unscales then steps
+        np.testing.assert_allclose(p.numpy(), 1 - 0.1 * 2 * 1, rtol=1e-6)
+        assert scaler.get_init_loss_scaling() >= 4.0  # grew after good step
+
+    def test_scaler_skips_on_inf(self):
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.ones(1, "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       decr_every_n_nan_or_inf=1)
+        loss = (p * p).sum()
+        loss.backward()
+        p.grad.set_value(np.array([np.inf], "float32"))
+        before = p.numpy().copy()
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), before)  # step skipped
+        assert float(scaler._scale) == 2.0  # halved
+
+
+class TestCheckpointing:
+    def test_save_load_nested(self, tmp_path):
+        net = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        opt = paddle.optimizer.Adam(parameters=net.parameters())
+        out = net(paddle.ones([2, 3]))
+        out.sum().backward()
+        opt.step()
+        path = str(tmp_path / "model.pdparams")
+        paddle.save({"model": net.state_dict(),
+                     "opt": opt.state_dict()}, path)
+        blob = paddle.load(path)
+        net2 = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        net2.set_state_dict(blob["model"])
+        np.testing.assert_allclose(net2(paddle.ones([2, 3])).numpy(),
+                                   net(paddle.ones([2, 3])).numpy())
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        net = nn.Linear(3, 4)
+        sd = net.state_dict()
+        sd["weight"] = paddle.ones([5, 5])
+        net2 = nn.Linear(3, 4)
+        with pytest.raises(ValueError):
+            net2.set_state_dict(sd)
+
+
+class TestJit:
+    def test_to_static_layer(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        from paddle_tpu import jit
+        static_net = jit.to_static(net)
+        x = paddle.randn([3, 4])
+        eager = net._static_function._fn(x)  # original forward
+        compiled = static_net(x)
+        np.testing.assert_allclose(compiled.numpy(), eager.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        # param update must be visible without retrace staleness
+        net[0].weight.set_value(net[0].weight.numpy() * 0.0)
+        out2 = static_net(x)
+        assert abs(out2.numpy().sum() - compiled.numpy().sum()) > 1e-6 or \
+            np.allclose(net[2].bias.numpy().sum() * 2, out2.numpy().sum(),
+                        rtol=1e-3)
+
+    def test_dataloader(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        ds = TensorDataset([paddle.arange(10, dtype="float32"),
+                            paddle.arange(10, dtype="int32")])
+        dl = DataLoader(ds, batch_size=4, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        assert batches[0][0].shape == [4]
+        dl2 = DataLoader(ds, batch_size=4, num_workers=2)
+        batches2 = list(dl2)
+        np.testing.assert_allclose(batches2[0][0].numpy(),
+                                   batches[0][0].numpy())

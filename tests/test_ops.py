@@ -1,0 +1,303 @@
+"""Per-op parity tests via the OpTest harness (reference test strategy §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestMatmul(OpTest):
+    op_fn = staticmethod(paddle.matmul)
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(0)
+        self.inputs = {"x": rng.rand(3, 4).astype("float32"),
+                       "y": rng.rand(4, 5).astype("float32")}
+        self.attrs = {}
+        self.ref_fn = lambda x, y: x @ y
+        self.grad_inputs = ["x", "y"]
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestMatmulTranspose(OpTest):
+    op_fn = staticmethod(paddle.matmul)
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(1)
+        self.inputs = {"x": rng.rand(4, 3).astype("float32"),
+                       "y": rng.rand(5, 4).astype("float32")}
+        self.attrs = {"transpose_x": True, "transpose_y": True}
+        self.ref_fn = lambda x, y, transpose_x, transpose_y: x.T @ y.T
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    op_fn = staticmethod(paddle.nn.functional.softmax)
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(2)
+        self.inputs = {"x": rng.randn(4, 7).astype("float32")}
+        self.attrs = {"axis": -1}
+        self.ref_fn = lambda x, axis: _softmax_np(x, axis)
+        self.grad_inputs = ["x"]
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        # fp32 central differences on O(1e-3) softmax grads: loose bar
+        self.check_grad(max_relative_error=5e-2)
+
+
+class TestLayerNorm(OpTest):
+    op_fn = staticmethod(
+        lambda x, w, b: paddle.nn.functional.layer_norm(x, 8, w, b))
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(3)
+        self.inputs = {"x": rng.randn(4, 8).astype("float32"),
+                       "w": rng.rand(8).astype("float32"),
+                       "b": rng.rand(8).astype("float32")}
+        self.attrs = {}
+
+        def ref(x, w, b):
+            mu = x.mean(-1, keepdims=True)
+            var = x.var(-1, keepdims=True)
+            return (x - mu) / np.sqrt(var + 1e-5) * w + b
+        self.ref_fn = ref
+        self.grad_inputs = ["x", "w", "b"]
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(max_relative_error=1e-2)
+
+
+class TestSigmoid(OpTest):
+    op_fn = staticmethod(paddle.nn.functional.sigmoid)
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(4)
+        self.inputs = {"x": rng.randn(3, 5).astype("float32")}
+        self.attrs = {}
+        self.ref_fn = lambda x: 1 / (1 + np.exp(-x))
+        self.grad_inputs = ["x"]
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestTanhGrad(OpTest):
+    op_fn = staticmethod(paddle.tanh)
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(5)
+        self.inputs = {"x": rng.randn(6).astype("float32")}
+        self.attrs = {}
+        self.ref_fn = np.tanh
+        self.grad_inputs = ["x"]
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestReduceMean(OpTest):
+    op_fn = staticmethod(paddle.mean)
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(6)
+        self.inputs = {"x": rng.randn(3, 4, 5).astype("float32")}
+        self.attrs = {"axis": 1, "keepdim": False}
+        self.ref_fn = lambda x, axis, keepdim: x.mean(axis=axis,
+                                                      keepdims=keepdim)
+        self.grad_inputs = ["x"]
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestConv2D(OpTest):
+    op_fn = staticmethod(
+        lambda x, w: paddle.nn.functional.conv2d(x, w, padding=1))
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(7)
+        self.inputs = {"x": rng.randn(2, 2, 5, 5).astype("float32"),
+                       "w": rng.randn(3, 2, 3, 3).astype("float32")}
+        self.attrs = {}
+
+        def ref(x, w):
+            n, cin, h, wd = x.shape
+            cout, _, kh, kw = w.shape
+            xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+            out = np.zeros((n, cout, h, wd), np.float64)
+            for b in range(n):
+                for co in range(cout):
+                    for i in range(h):
+                        for j in range(wd):
+                            out[b, co, i, j] = np.sum(
+                                xp[b, :, i:i + kh, j:j + kw] * w[co])
+            return out
+        self.ref_fn = ref
+        self.grad_inputs = ["x", "w"]
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestCrossEntropy(OpTest):
+    op_fn = staticmethod(
+        lambda x, lbl: paddle.nn.functional.cross_entropy(x, lbl))
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(8)
+        self.inputs = {"x": rng.randn(6, 4).astype("float32"),
+                       "lbl": rng.randint(0, 4, (6,)).astype("int64")}
+        self.attrs = {}
+
+        def ref(x, lbl):
+            p = _softmax_np(x)
+            return -np.mean(np.log(p[np.arange(len(lbl)), lbl]))
+        self.ref_fn = ref
+        self.grad_inputs = ["x"]
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(max_relative_error=1e-2)
+
+
+class TestElementwise:
+    def test_broadcast_add(self):
+        a = np.random.rand(3, 1, 5).astype("float32")
+        b = np.random.rand(4, 1).astype("float32")
+        out = paddle.add(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a + b, rtol=1e-6)
+
+    def test_scalar_ops(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        np.testing.assert_allclose((x + 1).numpy(), [2, 3, 4])
+        np.testing.assert_allclose((2 * x).numpy(), [2, 4, 6])
+        np.testing.assert_allclose((1 / x).numpy(), [1, 0.5, 1 / 3],
+                                   rtol=1e-6)
+        np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+        np.testing.assert_allclose((5 - x).numpy(), [4, 3, 2])
+
+    def test_comparison(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        y = paddle.to_tensor([3.0, 2.0, 1.0])
+        assert (x < y).numpy().tolist() == [True, False, False]
+        assert (x == y).numpy().tolist() == [False, True, False]
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = paddle.arange(24, dtype="float32").reshape([2, 3, 4])
+        assert x.shape == [2, 3, 4]
+        y = x.transpose([2, 0, 1])
+        assert y.shape == [4, 2, 3]
+
+    def test_concat_split(self):
+        a = paddle.ones([2, 3])
+        b = paddle.zeros([2, 3])
+        c = paddle.concat([a, b], axis=0)
+        assert c.shape == [4, 3]
+        parts = paddle.split(c, 2, axis=0)
+        np.testing.assert_allclose(parts[0].numpy(), a.numpy())
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(4, 3))
+        idx = paddle.to_tensor([0, 2])
+        g = paddle.gather(x, idx, axis=0)
+        assert g.shape == [2, 3]
+        np.testing.assert_allclose(g.numpy(), x.numpy()[[0, 2]])
+
+    def test_topk_sort(self):
+        x = paddle.to_tensor([[3.0, 1.0, 2.0]])
+        v, i = paddle.topk(x, k=2)
+        np.testing.assert_allclose(v.numpy(), [[3.0, 2.0]])
+        assert i.numpy().tolist() == [[0, 2]]
+
+    def test_where_pad(self):
+        x = paddle.to_tensor([1.0, -1.0])
+        out = paddle.where(x > 0, x, paddle.zeros_like(x))
+        np.testing.assert_allclose(out.numpy(), [1.0, 0.0])
+
+    def test_split_negative_section(self):
+        x = paddle.ones([10, 4])
+        a, b = paddle.split(x, [3, -1], axis=0)
+        assert a.shape == [3, 4] and b.shape == [7, 4]
+
+
+class TestAutogradEngine:
+    def test_chain(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = paddle.tanh(x * x)
+        y.backward()
+        expected = (1 - np.tanh(4.0) ** 2) * 4.0
+        np.testing.assert_allclose(x.grad.numpy(), [expected], rtol=1e-3)
+
+    def test_fan_in_accumulation(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x + x * 2  # dy/dx = 2x + 2 = 8
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(np.asarray(g)))
+        (x * 3).backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [3.0])
+
+    def test_pylayer(self):
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 2
+
+        x = paddle.to_tensor([1.5], stop_gradient=False)
+        y = Double.apply(x)
+        y.backward()
+        np.testing.assert_allclose(y.numpy(), [3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_grad_api_second_use(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x ** 3
+        (g,) = paddle.grad(y, [x], create_graph=False)
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-6)
+        assert x.grad is None
