@@ -273,7 +273,8 @@ class Parameter(Tensor):
     """Trainable tensor owned by an nn.Layer (reference:
     python/paddle/fluid/framework.py Parameter)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+    __slots__ = ("optimize_attr", "regularizer", "need_clip",
+                 "is_distributed", "placements")
 
     def __init__(self, data, trainable: bool = True, name: Optional[str] = None):
         super().__init__(data, stop_gradient=not trainable,
@@ -284,6 +285,9 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.is_distributed = False
+        # TPU-native dist attr: jax PartitionSpec over named mesh axes
+        # (reference auto_parallel interface.py:34 shard_tensor dist_attr).
+        self.placements = None
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

@@ -1,3 +1,24 @@
-"""paddle.distributed namespace (built out in distributed/*)."""
+"""paddle.distributed namespace — TPU-native collectives over named mesh
+axes, hybrid topology, DataParallel, fleet facade, meta-parallel layers.
+
+Reference parity map:
+- collective.py     -> python/paddle/distributed/collective.py + c_* ops
+- topology.py       -> fleet/base/topology.py
+- parallel.py       -> fluid/dygraph/parallel.py DataParallel
+- env.py            -> distributed/parallel.py init_parallel_env
+- fleet/            -> distributed/fleet/
+"""
 from . import env  # noqa: F401
-from .env import init_parallel_env, get_rank, get_world_size, ParallelEnv  # noqa: F401
+from .env import (init_parallel_env, get_rank, get_world_size,  # noqa: F401
+                  ParallelEnv)
+from . import collective  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    broadcast, reduce, scatter, alltoall, all_to_all, reduce_scatter,
+    send, recv, barrier, wait, psum, pmean, ppermute, axis_index,
+    destroy_process_group)
+from . import topology  # noqa: F401
+from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
+                       build_mesh, ParallelMode)
+from .parallel import (DataParallel, shard_batch, param_shardings,  # noqa: F401
+                       apply_param_shardings, scale_loss)

@@ -1,0 +1,149 @@
+"""Data-parallel training over the device mesh.
+
+Reference parity: ``python/paddle/fluid/dygraph/parallel.py:389``
+(DataParallel) + C++ ``imperative/reducer.cc`` (bucketed fused allreduce
+overlapping backward).
+
+TPU-first — and an intentional non-port: the reference needs a Reducer
+because each process owns its own gradient tensors and must fuse/schedule
+NCCL allreduces by hand.  Under XLA SPMD there is nothing to schedule by
+hand: the batch is sharded over the ``dp`` mesh axis, parameters are
+replicated, and the gradient cross-replica sum is a compiler-inserted
+``all-reduce`` that XLA's latency-hiding scheduler already overlaps with
+the backward pass.  DataParallel therefore reduces to (a) holding the
+mesh, (b) sharding inputs, (c) placing parameters by their
+``PartitionSpec`` placements (replicated by default; TP layers set theirs
+— see meta_parallel/mp_layers.py), so the same wrapper drives pure-DP and
+hybrid DP×TP without a code change.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn.layer_base import Layer
+from ..core.tensor import Tensor
+
+__all__ = ["DataParallel", "shard_batch", "param_shardings",
+            "apply_param_shardings", "scale_loss"]
+
+
+def _default_dp_mesh(axis: str = "dp") -> Mesh:
+    devs = jax.devices()
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def shard_batch(arrays, mesh: Mesh, axis: str = "dp"):
+    """Place arrays so dim0 is split across the `axis` mesh axis."""
+    if axis not in mesh.axis_names:
+        return arrays
+    spec = NamedSharding(mesh, P(axis))
+    out = []
+    for a in arrays:
+        arr = getattr(a, "_data", a)
+        n = mesh.shape[axis]
+        if arr.ndim == 0 or arr.shape[0] % n != 0:
+            out.append(jax.device_put(arr, NamedSharding(mesh, P())))
+        else:
+            out.append(jax.device_put(arr, spec))
+    return out
+
+
+def param_shardings(layer: Layer, mesh: Mesh) -> Dict[str, NamedSharding]:
+    """name -> NamedSharding from each Parameter's `placements` dist attr
+    (replicated when unset).  The TPU-native analog of the reference's
+    auto_parallel completion step (distributed/auto_parallel/completion.py):
+    annotations on params, propagation left to GSPMD."""
+    out = {}
+    for name, p in layer.named_parameters():
+        spec = p.placements if p.placements is not None else P()
+        # drop axes the mesh doesn't have (e.g. mp spec on a pure-dp mesh)
+        cleaned = []
+        for entry in (spec if isinstance(spec, tuple) else tuple(spec)):
+            if entry is None or entry in mesh.axis_names:
+                cleaned.append(entry)
+            elif (isinstance(entry, (list, tuple))
+                  and all(e in mesh.axis_names for e in entry)):
+                cleaned.append(tuple(entry))
+            else:
+                cleaned.append(None)
+        out[name] = NamedSharding(mesh, P(*cleaned))
+    return out
+
+
+def apply_param_shardings(layer: Layer, mesh: Mesh):
+    """device_put every parameter/buffer onto the mesh per its placements."""
+    shardings = param_shardings(layer, mesh)
+    lookup = dict(layer.named_parameters())
+    for name, sh in shardings.items():
+        p = lookup[name]
+        p._data = jax.device_put(p._data, sh)
+    rep = NamedSharding(mesh, P())
+    for name, b in layer.named_buffers():
+        b._data = jax.device_put(b._data, rep)
+
+
+def scale_loss(loss, dp_world_size: Optional[int] = None):
+    """reference dygraph/parallel.py scale_loss — divide by dp degree.
+    Under pmean-style grad sync this is a no-op; kept for API parity."""
+    n = dp_world_size or jax.device_count()
+    arr = getattr(loss, "_data", loss)
+    out = arr / n
+    return Tensor(out) if isinstance(loss, Tensor) else out
+
+
+class DataParallel(Layer):
+    """reference dygraph/parallel.py:389.
+
+    Wraps a Layer for mesh-parallel execution.  `forward` delegates to the
+    wrapped layer (eager single-device semantics are unchanged); the jit
+    path (hapi Model / fleet train loops) queries `.mesh` and
+    `.shard_inputs` to lay the batch and parameters onto the mesh, after
+    which XLA inserts the gradient all-reduce the reference's Reducer
+    performed by hand.
+    """
+
+    def __init__(self, layers: Layer, strategy=None,
+                 comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1,
+                 find_unused_parameters: bool = False,
+                 group=None, mesh: Optional[Mesh] = None,
+                 dp_axis: str = "dp"):
+        super().__init__()
+        self._layers = layers
+        self._dp_axis = dp_axis
+        # comm_buffer_size / find_unused_parameters are accepted for API
+        # parity; XLA's scheduler owns fusion & overlap (see module doc).
+        self.find_unused_parameters = find_unused_parameters
+        if mesh is None:
+            if group is not None and getattr(group, "devices", None):
+                mesh = Mesh(np.asarray(group.devices), (dp_axis,))
+            else:
+                mesh = _default_dp_mesh(dp_axis)
+        self.mesh = mesh
+        apply_param_shardings(layers, mesh)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def shard_inputs(self, arrays):
+        return shard_batch(arrays, self.mesh, self._dp_axis)
+
+    def scale_loss(self, loss):
+        return loss  # grads are mean-reduced by sharded-batch jit math
+
+    # reference API parity ------------------------------------------------
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

@@ -130,6 +130,11 @@ class Model:
 
     def _train_batch_jit(self, inputs, labels):
         arrays = [to_tensor(t)._data for t in inputs + labels]
+        if hasattr(self.network, "shard_inputs"):
+            # DataParallel/hybrid wrapper: lay batch onto the mesh; XLA
+            # then emits the cross-replica grad all-reduce (reducer.cc's
+            # job in the reference) during compilation.
+            arrays = self.network.shard_inputs(arrays)
         sig = ("train", tuple((a.shape, str(a.dtype)) for a in arrays))
         if sig not in self._jit_cache:
             self._jit_cache[sig] = self._build_jit_train_step(
@@ -176,6 +181,8 @@ class Model:
         labels = _to_list(labels)
         self.network.eval()
         arrays = [to_tensor(t)._data for t in inputs + labels]
+        if hasattr(self.network, "shard_inputs"):
+            arrays = self.network.shard_inputs(arrays)
         sig = ("eval", tuple((a.shape, str(a.dtype)) for a in arrays))
         if sig not in self._jit_cache:
             self._jit_cache[sig] = self._build_jit_eval_step(
