@@ -270,7 +270,12 @@ def reduce(tensor, dst: int = 0, op: int = ReduceOp.SUM,
     dst_local = g.ranks.index(dst)
 
     def _fn(v):
-        red = _LAX_REDUCE.get(op, lax.psum)(v, g.axis_name)
+        if op == ReduceOp.PROD:
+            red = jnp.prod(lax.all_gather(v, g.axis_name), axis=0)
+        elif op == ReduceOp.AVG:
+            red = lax.pmean(v, g.axis_name)
+        else:
+            red = _LAX_REDUCE[op](v, g.axis_name)
         idx = lax.axis_index(g.axis_name)
         return jnp.where(idx == dst_local, red, v)
 
@@ -311,8 +316,13 @@ def alltoall(in_tensor_list, out_tensor_list=None,
             out = lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0,
                                  tiled=True)
             return _wrap_like(in_tensor_list, out)
-        # eager: rank-stacked (n, n*chunk, ...) on dim0/1? treat dim0=rank,
-        # dim1 split across ranks.
+        if x.shape[0] != g.nranks:
+            raise ValueError(
+                f"eager alltoall expects rank-stacked input with dim0 "
+                f"== group size {g.nranks}, got shape {x.shape}")
+
+        # eager: rank-stacked (n, n*chunk, ...): dim0=rank, each row's
+        # dim0 is split across ranks.
         def _fn(v):
             return lax.all_to_all(v[0], g.axis_name, split_axis=0,
                                   concat_axis=0, tiled=True)[None]
@@ -340,6 +350,10 @@ def reduce_scatter(tensor, tensor_list=None, op: int = ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op: bool = True):
     """reference c_reducescatter_op: reduce then scatter chunks."""
     g = _resolve(group)
+    if op != ReduceOp.SUM:
+        raise NotImplementedError(
+            "reduce_scatter supports ReduceOp.SUM only (XLA "
+            "reduce-scatter is a sum; compose all_reduce+slice otherwise)")
     if tensor_list is not None:
         x = jnp.concatenate([_raw(t) for t in tensor_list], axis=0)
     else:
@@ -352,6 +366,11 @@ def reduce_scatter(tensor, tensor_list=None, op: int = ReduceOp.SUM,
 
     # eager rank-stacked: input (n, n*chunk, ...) with dim0=rank; each
     # rank's row is its full contribution, it gets back its reduced chunk.
+    if x.shape[0] != g.nranks:
+        raise ValueError(
+            f"eager reduce_scatter expects rank-stacked input with dim0 "
+            f"== group size {g.nranks}, got shape {x.shape}")
+
     def _fn2(v):
         # v: (1, n*chunk, ...) local row
         return lax.psum_scatter(v[0], g.axis_name, scatter_dimension=0,

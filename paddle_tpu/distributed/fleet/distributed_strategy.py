@@ -1,0 +1,100 @@
+"""DistributedStrategy — the single config object for all parallelism.
+
+Reference parity: ``python/paddle/distributed/fleet/base/distributed_strategy.py``
+wrapping ``paddle/fluid/framework/distributed_strategy.proto:238-297``.
+The reference stores the strategy in a protobuf so meta-optimizers
+(program rewriters) can be toggled declaratively; here the strategies are
+transform-based wrappers, so a plain attribute bag with the same field
+names is the idiomatic equivalent — no proto round-trip needed.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+__all__ = ["DistributedStrategy"]
+
+
+_HYBRID_DEFAULTS = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                    "sharding_degree": 1, "sep_degree": 1}
+
+
+class DistributedStrategy:
+    """Field names follow distributed_strategy.proto (:37-54 hybrid/
+    sharding configs; :238ff execution toggles)."""
+
+    def __init__(self):
+        # collective / execution
+        self.nccl_comm_num = 1            # ignored: XLA owns comm channels
+        self.sync_nccl_allreduce = False  # ignored: compiler-scheduled
+        self.fuse_all_reduce_ops = True   # ignored: XLA fusion
+        self.fuse_grad_size_in_MB = 32
+        self.find_unused_parameters = False
+        # amp (proto: amp_configs)
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+            "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0,
+            "decr_ratio": 0.5, "use_dynamic_loss_scaling": True,
+            "use_pure_fp16": False, "use_fp16_guard": True,
+            "custom_white_list": [], "custom_black_list": [],
+        }
+        # recompute (proto: recompute_configs)
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        # gradient merge / accumulation
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # hybrid parallel degrees (proto :51-54 hybrid_configs)
+        self.hybrid_configs: Dict[str, Any] = dict(_HYBRID_DEFAULTS)
+        # sharding (proto :37-44 sharding_configs)
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {
+            "sharding_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "dp_degree": 1, "stage": 1, "offload": False,
+        }
+        # pipeline (proto pipeline_configs)
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        # tensor parallel (static-mode parity field)
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        # large-batch / compression strategies (accepted; mapped or no-op)
+        self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01,
+                             "exclude_from_weight_decay": []}
+        self.lars = False
+        self.lars_configs = {}
+        self.dgc = False
+        self.localsgd = False
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.heter_ccl_mode = False
+        # sequence parallel (TPU-build extension; no proto ancestor)
+        self.sep_configs: Dict[str, Any] = {"ring_attention": True}
+
+    # reference API: strategy.hybrid_configs = {...} merges over defaults
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and isinstance(value, dict) \
+                and "hybrid_configs" in self.__dict__:
+            merged = dict(_HYBRID_DEFAULTS)
+            merged.update(self.__dict__["hybrid_configs"])
+            merged.update(value)
+            object.__setattr__(self, key, merged)
+        elif key.endswith("_configs") and isinstance(value, dict) \
+                and key in self.__dict__:
+            merged = dict(self.__dict__[key])
+            merged.update(value)
+            object.__setattr__(self, key, merged)
+        else:
+            object.__setattr__(self, key, value)
+
+    def copy(self) -> "DistributedStrategy":
+        return copy.deepcopy(self)
+
+    def __repr__(self):
+        degrees = {k: v for k, v in self.hybrid_configs.items()
+                   if isinstance(v, int) and v > 1}
+        return f"DistributedStrategy(hybrid={degrees or 'single'})"

@@ -1,0 +1,4 @@
+"""meta_optimizers (reference fleet/meta_optimizers/ — transform wrappers,
+not program rewrites; see hybrid_optimizers module doc)."""
+from .hybrid_optimizers import (HybridParallelOptimizer,  # noqa: F401
+                                DygraphShardingOptimizer)

@@ -1,0 +1,11 @@
+"""meta_parallel — TP/PP layer wrappers (reference fleet/meta_parallel/)."""
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa: F401
+                        RowParallelLinear, ParallelCrossEntropy)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .spmd_pipeline import spmd_pipeline, stack_stage_params  # noqa: F401
+from ....core.random import RNGStatesTracker, get_rng_tracker  # noqa: F401
+
+def get_rng_state_tracker():
+    """reference parallel_layers/random.py get_rng_state_tracker."""
+    return get_rng_tracker()

@@ -1,0 +1,224 @@
+"""Pipeline-parallel training engine (schedule level).
+
+Reference parity: ``python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py:30`` — PipelineParallel.train_batch(:152) driving the
+1F1B schedule (:80, warmup/steady/cooldown at :96-146) over send_v2/
+recv_v2 P2P kernels.
+
+TPU-first: in the single-controller SPMD world every stage lives in one
+process, so the P2P hops are jit-boundary array hand-offs and the 1F1B
+interleaving degenerates to its dependency order: forward a micro-batch
+through the stages, then immediately backward it (one in-flight
+micro-batch — the same peak-activation footprint 1F1B achieves
+per-stage).  Each stage is its own jitted function; the backward stage
+fn recomputes its forward inside ``jax.vjp`` (activation recompute is the
+TPU-native default — reference recompute_optimizer semantics).  The
+fully-compiled whole-pipeline path (ppermute inside one XLA program) is
+``spmd_pipeline.py``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....core import autograd
+from ....core.random import default_generator, rng_scope
+from ....core.tensor import Tensor, to_tensor
+from ....nn.layer_base import Layer
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+def _stage_state(pipe: PipelineLayer, stage: int) -> Dict[str, jnp.ndarray]:
+    out = {}
+    for i, (layer, _) in enumerate(pipe.get_stage_items(stage)):
+        if isinstance(layer, Layer):
+            for n, p in layer.named_parameters():
+                out[f"s{stage}.l{i}.{n}"] = p._data
+    return out
+
+
+def _load_stage_state(pipe: PipelineLayer, stage: int, state):
+    for i, (layer, _) in enumerate(pipe.get_stage_items(stage)):
+        if isinstance(layer, Layer):
+            for n, p in layer.named_parameters():
+                key = f"s{stage}.l{i}.{n}"
+                if key in state:
+                    p._data = state[key]
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        cfg = strategy.pipeline_configs if strategy is not None else {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.micro_batch_size = cfg.get("micro_batch_size", None)
+        self.schedule_mode = cfg.get("schedule_mode", "1F1B")
+        self.num_stages = layers.num_stages
+        self._jit_cache = {}
+        self.total_loss = None
+
+    # -- stage fns ---------------------------------------------------------
+    def _make_fwd(self, stage: int):
+        pipe = self._layers
+
+        def fwd(state, x, key):
+            run = pipe.stage_forward_fn(stage)
+            with rng_scope(key), autograd.no_grad():
+                _load_stage_state(pipe, stage, state)
+                y = run(Tensor(x))
+            return y._data if isinstance(y, Tensor) else y
+        return fwd
+
+    def _make_last(self, stage: int, loss_fn):
+        pipe = self._layers
+
+        def last(state, x, label, key):
+            def loss_of(state, x):
+                run = pipe.stage_forward_fn(stage)
+                with rng_scope(key), autograd.no_grad():
+                    _load_stage_state(pipe, stage, state)
+                    y = run(Tensor(x))
+                    loss = loss_fn(y, Tensor(label))
+                arr = loss._data if isinstance(loss, Tensor) else loss
+                return jnp.mean(arr.astype(jnp.float32))
+            (loss), (gstate, gx) = jax.value_and_grad(
+                loss_of, argnums=(0, 1))(state, x)
+            return loss, gstate, gx
+        return last
+
+    def _make_bwd(self, stage: int):
+        fwd = self._make_fwd(stage)
+
+        def bwd(state, x, gy, key):
+            y, vjp = jax.vjp(lambda s, xx: fwd(s, xx, key), state, x)
+            gstate, gx = vjp(gy)
+            return gstate, gx
+        return bwd
+
+    def _get_jit(self, kind, stage, loss_fn=None):
+        key = (kind, stage)
+        if key not in self._jit_cache:
+            if kind == "fwd":
+                self._jit_cache[key] = jax.jit(self._make_fwd(stage))
+            elif kind == "last":
+                self._jit_cache[key] = jax.jit(self._make_last(stage,
+                                                               loss_fn))
+            else:
+                self._jit_cache[key] = jax.jit(self._make_bwd(stage))
+        return self._jit_cache[key]
+
+    # -- schedule ----------------------------------------------------------
+    def forward_backward_pipeline(self, data, labels, loss_fn):
+        """1F1B dependency order: per micro-batch fwd(all stages) then
+        bwd(all stages), grads accumulated across micro-batches
+        (reference :80 forward_backward_pipeline)."""
+        S = self.num_stages
+        m = self.accumulate_steps
+        batch = np.asarray(data)
+        if batch.shape[0] % m != 0:
+            raise ValueError(
+                f"batch size {batch.shape[0]} not divisible by "
+                f"accumulate_steps {m} (reference pipeline requires "
+                "micro_batch_size * accumulate_steps == batch)")
+        xs = np.array_split(batch, m)
+        ys = np.array_split(np.asarray(labels), m)
+        states = [_stage_state(self._layers, s) for s in range(S)]
+        grads = [jax.tree.map(jnp.zeros_like, st) for st in states]
+        total_loss = 0.0
+        try:
+            for mb in range(m):
+                keys = [default_generator.next_key() for _ in range(S)]
+                acts = [jnp.asarray(xs[mb])]
+                for s in range(S - 1):
+                    acts.append(self._get_jit("fwd", s)(states[s], acts[-1],
+                                                        keys[s]))
+                loss, gS, gx = self._get_jit("last", S - 1, loss_fn)(
+                    states[S - 1], acts[-1], jnp.asarray(ys[mb]),
+                    keys[S - 1])
+                grads[S - 1] = jax.tree.map(jnp.add, grads[S - 1], gS)
+                for s in range(S - 2, -1, -1):
+                    gs, gx = self._get_jit("bwd", s)(states[s], acts[s], gx,
+                                                     keys[s])
+                    grads[s] = jax.tree.map(jnp.add, grads[s], gs)
+                total_loss += float(loss)
+        finally:
+            # tracing rebinds live Parameters to tracers; restore the
+            # concrete snapshot even if a stage fn raises
+            for s in range(S):
+                _load_stage_state(self._layers, s, states[s])
+        # mean over micro-batches (reference broadcasts final loss)
+        scale = 1.0 / m
+        grads = [jax.tree.map(lambda g: g * scale, gr) for gr in grads]
+        self.total_loss = total_loss / m
+        return states, grads
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """reference pipeline_parallel.py:152."""
+        if isinstance(data, (list, tuple)):
+            inputs, labels = data
+        else:
+            raise ValueError("train_batch expects (inputs, labels)")
+        inputs = getattr(to_tensor(inputs), "_data", inputs)
+        labels = getattr(to_tensor(labels), "_data", labels)
+        loss_fn = self._layers._loss_fn
+        if loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        states, grads = self.forward_backward_pipeline(inputs, labels,
+                                                       loss_fn)
+        flat_params = {}
+        flat_grads = {}
+        for s in range(self.num_stages):
+            flat_params.update(states[s])
+            flat_grads.update(grads[s])
+        # SharedLayerDesc: one Parameter shows up in several stages under
+        # different keys — sum its per-stage grads and update once
+        # (reference allreduce_shared_weight_gradients,
+        # pipeline_parallel.py:147).
+        id2key, alias = {}, {}
+        for s in range(self.num_stages):
+            for i, (layer, _) in enumerate(self._layers.get_stage_items(s)):
+                if not isinstance(layer, Layer):
+                    continue
+                for n, p in layer.named_parameters():
+                    k = f"s{s}.l{i}.{n}"
+                    if id(p) in id2key:
+                        alias[k] = id2key[id(p)]
+                    else:
+                        id2key[id(p)] = k
+        for dup, canon in alias.items():
+            flat_grads[canon] = jax.tree.map(
+                jnp.add, flat_grads[canon], flat_grads[dup])
+            del flat_params[dup], flat_grads[dup]
+        if not hasattr(optimizer, "_fn_state") or optimizer._fn_state is None:
+            optimizer._fn_state = optimizer.functional_init(flat_params)
+        new_params, optimizer._fn_state = optimizer.functional_apply(
+            flat_params, flat_grads, optimizer._fn_state)
+        for dup, canon in alias.items():
+            new_params[dup] = new_params[canon]
+        for s in range(self.num_stages):
+            _load_stage_state(self._layers, s,
+                              {k: new_params[k] for k in states[s]})
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return self.total_loss
+
+    def forward(self, x):
+        return self._layers(x)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)

@@ -1,0 +1,175 @@
+"""Pipeline layer partitioning.
+
+Reference parity: ``python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py`` — LayerDesc(:31), SharedLayerDesc(:49),
+PipelineLayer(:132): an nn.Layer declared as a flat list of layer
+descriptors, partitioned into pipeline stages.
+
+TPU-first: a single process holds every stage (single-controller SPMD),
+so PipelineLayer materialises all segments and records the stage
+boundaries; the schedule (pipeline_parallel.py) jits each stage function
+separately, and the fully-compiled path stacks homogeneous middle stages
+for the ppermute pipeline (spmd_pipeline.py).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Sequence
+
+from ....nn.layer_base import Layer
+from ....nn.layer.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    """reference pp_layers.py:31 — deferred layer constructor."""
+
+    def __init__(self, layer_func: Callable, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        is_layer_cls = isinstance(layer_func, type) \
+            and issubclass(layer_func, Layer)
+        if not is_layer_cls and not callable(layer_func):
+            raise TypeError("layer_func must be a Layer subclass or a "
+                            "factory callable")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', '?')})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """reference pp_layers.py:49 — layer whose parameters are shared
+    between stages (e.g. embedding <-> output head).  In the
+    single-controller build the *same* Layer object is reused, so the
+    gradient all-reduce the reference performs across stages
+    (pipeline_parallel.py:147) happens for free via shared parameters."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+def _uniform_partition(num_items: int, num_parts: int) -> List[int]:
+    """Stage boundaries, longest stages first (reference segment_parse)."""
+    base = num_items // num_parts
+    extra = num_items % num_parts
+    bounds = [0]
+    for i in range(num_parts):
+        bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+    return bounds
+
+
+class PipelineLayer(Layer):
+    """reference pp_layers.py:132.
+
+    layers: list of LayerDesc / Layer / callables executed sequentially.
+    num_stages: pipeline depth (defaults to hcg pp degree).
+    seg_method: "uniform" or "layer:<ClassName>" — cut before each
+    occurrence of the named class (reference's transformer-block cut).
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, **kwargs):
+        super().__init__()
+        if num_stages is None:
+            hcg = _get_hcg_or_none()
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = num_stages
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._descs = list(layers)
+
+        built: List = []
+        self._shared: dict = {}
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                built.append((self._shared[d.layer_name], d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer) or callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"bad pipeline item {d!r}")
+        self.run_function = LayerList(
+            [l for l, _ in built if isinstance(l, Layer)])
+        self._items = built
+
+        if seg_method.startswith("layer:"):
+            cls_name = seg_method.split(":", 1)[1]
+            cut_idx = [i for i, (l, _) in enumerate(built)
+                       if type(l).__name__ == cls_name]
+            if len(cut_idx) < num_stages:
+                raise ValueError(
+                    f"{len(cut_idx)} x {cls_name} layers < {num_stages} "
+                    "stages")
+            # distribute the named blocks uniformly; everything before the
+            # first block sticks to stage 0, after the last to stage -1
+            b = _uniform_partition(len(cut_idx), num_stages)
+            self._bounds = [0] + [cut_idx[b[i]] for i in range(1, num_stages)] \
+                + [len(built)]
+        else:
+            self._bounds = _uniform_partition(len(built), num_stages)
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def stage_bounds(self):
+        return list(self._bounds)
+
+    def get_stage_items(self, stage: int):
+        lo, hi = self._bounds[stage], self._bounds[stage + 1]
+        return self._items[lo:hi]
+
+    def stage_forward_fn(self, stage: int):
+        """A python callable running this stage's segment (Tensor in/out)."""
+        items = self.get_stage_items(stage)
+
+        def run(x):
+            for layer, ffn in items:
+                if ffn is not None:
+                    x = ffn(layer, x)
+                elif isinstance(layer, Layer) or callable(layer):
+                    x = layer(x)
+            return x
+        return run
+
+    def stage_parameters(self, stage: int):
+        out = []
+        seen = set()
+        for layer, _ in self.get_stage_items(stage):
+            if isinstance(layer, Layer):
+                for p in layer.parameters():
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        out.append(p)
+        return out
+
+    # -- whole-model forward (non-pipelined fallback / parity checks) -----
+    def forward(self, x):
+        for layer, ffn in self._items:
+            if ffn is not None:
+                x = ffn(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+
+def _get_hcg_or_none():
+    try:
+        from .. import get_hybrid_communicate_group
+        return get_hybrid_communicate_group()
+    except Exception:
+        return None

@@ -1,0 +1,40 @@
+"""Activation recompute (checkpointing).
+
+Reference parity: ``python/paddle/distributed/fleet/utils/recompute.py:63``
+RecomputeFunction (custom PyLayer that stashes RNG state and re-runs the
+forward inside backward) and ``:182`` recompute().
+
+TPU-first: inside a jitted trace this IS ``jax.checkpoint`` — XLA
+rematerialises the segment in the backward pass; the RNG-state juggling
+the reference does by hand is unnecessary because JAX PRNG keys are
+values threaded through the trace (same key ⇒ same dropout mask on
+replay, by construction).  In eager tape mode the segment simply runs
+normally — eager holds activations anyway; memory pressure is a compiled-
+path concern.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core.tensor import Tensor
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
+    """Run `function(*args)` marked for rematerialisation under jit."""
+    raws = [a._data if isinstance(a, Tensor) else a for a in args]
+    traced = any(isinstance(r, jax.core.Tracer) for r in raws)
+    if not traced:
+        return function(*args, **kwargs)
+
+    def raw_fn(*raw_args):
+        wrapped = [Tensor(r, stop_gradient=False)
+                   if i < len(args) and isinstance(args[i], Tensor) else r
+                   for i, r in enumerate(raw_args)]
+        out = function(*wrapped, **kwargs)
+        return out._data if isinstance(out, Tensor) else out
+
+    out = jax.checkpoint(raw_fn)(*raws)
+    return Tensor(out, stop_gradient=False) if any(
+        isinstance(a, Tensor) for a in args) else out

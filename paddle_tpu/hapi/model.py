@@ -201,6 +201,8 @@ class Model:
         inputs = _to_list(inputs)
         self.network.eval()
         arrays = [to_tensor(t)._data for t in inputs]
+        if hasattr(self.network, "shard_inputs"):
+            arrays = self.network.shard_inputs(arrays)
         sig = ("pred", tuple((a.shape, str(a.dtype)) for a in arrays))
         if sig not in self._jit_cache:
             self._jit_cache[sig] = self._build_jit_eval_step(
