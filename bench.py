@@ -1,0 +1,67 @@
+"""Benchmark: flagship GPT (BERT-base scale) training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference publishes no numbers (BASELINE.md); the operative
+target is BERT-base seq/sec/chip >= 0.8x a V100 CUDA chip.  NVIDIA's
+public BERT-base fp16 seq-512 training figure on one V100 is ~107 seq/s,
+so vs_baseline = value / (0.8 * 107).  On CPU fallback (no TPU tunnel)
+the config is shrunk and vs_baseline is reported against the same target
+for continuity (expect << 1 on CPU).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.topology import build_mesh
+    from paddle_tpu.models import GPTConfig
+    from paddle_tpu.models.gpt_spmd import build_spmd_train_step
+
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+    if on_tpu:
+        # BERT-base scale: L=12, D=768, H=12, T=512 (BASELINE config 3)
+        cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=512)
+        B, T, steps, dtype = 16, 512, 10, jnp.bfloat16
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
+                        num_heads=4, max_seq_len=128, ffn_mult=2)
+        B, T, steps, dtype = 8, 128, 3, jnp.float32
+
+    mesh = build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step, init_fn = build_spmd_train_step(cfg, mesh, compute_dtype=dtype)
+    params, opt_state = init_fn(seed=0)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+
+    # warmup/compile
+    loss, params, opt_state = step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt_state = step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    seq_per_sec = B * steps / dt
+    target = 0.8 * 107.0  # see module docstring
+    print(json.dumps({
+        "metric": f"gpt_bert_base_train_seq_per_sec_per_chip[{backend}]"
+        if on_tpu else f"gpt_small_train_seq_per_sec[{backend}]",
+        "value": round(seq_per_sec, 2),
+        "unit": "seq/s",
+        "vs_baseline": round(seq_per_sec / target, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""Flagship model family (transformer LM / BERT-style encoder).
+
+The reference ships its NLP flagships out-of-tree (ERNIE) atop
+``python/paddle/nn/layer/transformer.py``; this package provides the
+equivalent in-tree: an eager nn.Layer GPT (optionally tensor-parallel via
+fleet mp layers) and a fully-compiled SPMD trainer that pipelines the
+blocks over the ``pp`` mesh axis.
+"""
+from .gpt import GPTConfig, GPT, GPTBlock  # noqa: F401
+from .gpt_spmd import (init_gpt_params, build_spmd_train_step,  # noqa: F401
+                       gpt_param_shardings)

@@ -1,0 +1,125 @@
+"""GPT-style decoder LM — the flagship eager model.
+
+Built purely from the framework's own layers (nn.Layer module system,
+fleet mp layers when tensor_parallel=True), mirroring how the reference's
+transformer stacks are assembled from ``python/paddle/nn/layer/
+transformer.py`` building blocks.  The compiled SPMD twin (pipelined over
+``pp``) is gpt_spmd.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from .. import nn
+
+__all__ = ["GPTConfig", "GPT", "GPTBlock"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 8192
+    hidden_size: int = 256
+    num_layers: int = 4
+    num_heads: int = 4
+    max_seq_len: int = 512
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    tensor_parallel: bool = False
+
+
+class GPTAttention(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        D = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.head_dim = D // cfg.num_heads
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+            self.qkv = ColumnParallelLinear(D, 3 * D, has_bias=True,
+                                            gather_output=False)
+            self.out = RowParallelLinear(D, D, input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(D, 3 * D)
+            self.out = nn.Linear(D, D)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        from ..ops.manipulation import reshape, split, squeeze
+        from ..ops.nn_misc import scaled_dot_product_attention
+        B, T, D = x.shape
+        h, hd = self.num_heads, self.head_dim
+        qkv = self.qkv(x)
+        qkv = reshape(qkv, [B, T, 3, h, hd])
+        q, k, v = [squeeze(t, axis=2) for t in split(qkv, 3, axis=2)]
+        # paddle layout (B, S, H, D); pallas flash kernel on TPU
+        ctx = scaled_dot_product_attention(q, k, v, is_causal=True,
+                                           training=self.training)
+        out = self.out(reshape(ctx, [B, T, D]))
+        return self.dropout(out)
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        D = cfg.hidden_size
+        self.ln1 = nn.LayerNorm(D)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(D)
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+            self.up = ColumnParallelLinear(D, cfg.ffn_mult * D,
+                                           has_bias=True,
+                                           gather_output=False)
+            self.down = RowParallelLinear(cfg.ffn_mult * D, D,
+                                          input_is_parallel=True)
+        else:
+            self.up = nn.Linear(D, cfg.ffn_mult * D)
+            self.down = nn.Linear(cfg.ffn_mult * D, D)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.dropout(self.down(F.gelu(self.up(self.ln2(x)))))
+        return x
+
+
+class GPT(Layer):
+    """Decoder-only LM; forward(ids) -> logits (B, T, V)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import (VocabParallelEmbedding,
+                                             ColumnParallelLinear)
+            self.wte = VocabParallelEmbedding(cfg.vocab_size,
+                                              cfg.hidden_size)
+            self.head = ColumnParallelLinear(cfg.hidden_size,
+                                             cfg.vocab_size,
+                                             has_bias=False,
+                                             gather_output=True)
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+            self.head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, ids):
+        import jax.numpy as jnp
+        T = ids.shape[1]
+        pos = Tensor(jnp.arange(T, dtype=jnp.int32)[None, :])
+        x = self.wte(ids) + self.wpe(pos)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(self.ln_f(x))

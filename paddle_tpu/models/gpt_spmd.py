@@ -1,0 +1,207 @@
+"""Fully-compiled SPMD GPT trainer: one jitted step over the hybrid mesh.
+
+This is the compiled twin of models/gpt.py — the "static graph path" of
+the flagship (reference parity: the ERNIE/BERT-large static+fleet config,
+BASELINE config 5).  Everything is one XLA program:
+
+- dp: batch sharded over ``dp`` (gradient all-reduce by GSPMD),
+- mp: Megatron-style qkv/ffn shardings over ``mp`` via PartitionSpecs,
+- pp: blocks stacked on a leading layer dim, sharded over ``pp``, run
+  through the ppermute micro-batch pipeline (spmd_pipeline) inside a
+  partial-manual shard_map ({'pp'} manual, dp/mp left to GSPMD),
+- sp: sequence axis reserved (ring attention wires in via
+  distributed.fleet.meta_parallel.sequence_parallel).
+
+The optimizer is an inline functional AdamW whose state inherits the
+parameter shardings (slots live sharded over mp/pp like their params).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .gpt import GPTConfig
+
+__all__ = ["init_gpt_params", "gpt_param_shardings",
+           "build_spmd_train_step"]
+
+
+def _glorot(key, shape):
+    fan_in, fan_out = shape[-2], shape[-1]
+    std = np.sqrt(2.0 / (fan_in + fan_out))
+    return jax.random.normal(key, shape, jnp.float32) * std
+
+
+def init_gpt_params(cfg: GPTConfig, key) -> Dict:
+    """Param pytree with blocks stacked on a leading layer dim (the
+    layout spmd_pipeline shards over pp)."""
+    V, D, L = cfg.vocab_size, cfg.hidden_size, cfg.num_layers
+    H = cfg.ffn_mult * D
+    ks = jax.random.split(key, 8)
+    blocks = {
+        "ln1_g": jnp.ones((L, D)), "ln1_b": jnp.zeros((L, D)),
+        "qkv_w": _glorot(ks[0], (L, D, 3 * D)),
+        "qkv_b": jnp.zeros((L, 3 * D)),
+        "out_w": _glorot(ks[1], (L, D, D)), "out_b": jnp.zeros((L, D)),
+        "ln2_g": jnp.ones((L, D)), "ln2_b": jnp.zeros((L, D)),
+        "up_w": _glorot(ks[2], (L, D, H)), "up_b": jnp.zeros((L, H)),
+        "down_w": _glorot(ks[3], (L, H, D)), "down_b": jnp.zeros((L, D)),
+    }
+    return {
+        "wte": jax.random.normal(ks[4], (V, D)) * 0.02,
+        "wpe": jax.random.normal(ks[5], (cfg.max_seq_len, D)) * 0.02,
+        "blocks": blocks,
+        "ln_f_g": jnp.ones((D,)), "ln_f_b": jnp.zeros((D,)),
+        "head_w": _glorot(ks[6], (D, V)),
+    }
+
+
+def gpt_param_shardings(mesh: Mesh, cfg: GPTConfig) -> Dict:
+    """PartitionSpecs: vocab/ffn over mp, stacked layer dim over pp."""
+    def ns(*spec):
+        spec = tuple(s if s in mesh.axis_names else None
+                     if isinstance(s, str) else s for s in spec)
+        return NamedSharding(mesh, P(*spec))
+
+    blocks = {
+        "ln1_g": ns("pp", None), "ln1_b": ns("pp", None),
+        "qkv_w": ns("pp", None, "mp"), "qkv_b": ns("pp", "mp"),
+        "out_w": ns("pp", "mp", None), "out_b": ns("pp", None),
+        "ln2_g": ns("pp", None), "ln2_b": ns("pp", None),
+        "up_w": ns("pp", None, "mp"), "up_b": ns("pp", "mp"),
+        "down_w": ns("pp", "mp", None), "down_b": ns("pp", None),
+    }
+    return {
+        "wte": ns("mp", None), "wpe": ns(None, None),
+        "blocks": blocks,
+        "ln_f_g": ns(None), "ln_f_b": ns(None),
+        "head_w": ns(None, "mp"),
+    }
+
+
+def _layernorm(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * g + b
+
+
+def make_block_fn(cfg: GPTConfig):
+    h, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+
+    def block_fn(p, x):
+        from ..ops.pallas.flash_attention import flash_attention
+        # x: (mb, T, D)
+        B, T, D = x.shape
+        y = _layernorm(x, p["ln1_g"], p["ln1_b"])
+        qkv = y @ p["qkv_w"] + p["qkv_b"]
+        q, k, v = jnp.split(qkv.reshape(B, T, 3 * h, hd), 3, axis=2)
+        ctx = flash_attention(q, k, v, causal=True)  # (B, T, h, hd)
+        ctx = ctx.reshape(B, T, D)
+        x = x + ctx @ p["out_w"] + p["out_b"]
+        y = _layernorm(x, p["ln2_g"], p["ln2_b"])
+        x = x + jax.nn.gelu(y @ p["up_w"] + p["up_b"]) @ p["down_w"] \
+            + p["down_b"]
+        return x
+    return block_fn
+
+
+def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
+                          num_microbatches: int = 1,
+                          learning_rate: float = 1e-3,
+                          weight_decay: float = 0.01,
+                          compute_dtype=jnp.float32):
+    """Returns (jitted_step, init_fn).
+
+    step(params, opt_state, ids, labels) -> (loss, params, opt_state);
+    init_fn(seed) -> (params, opt_state) placed onto the mesh.
+    """
+    from ..distributed.fleet.meta_parallel.spmd_pipeline import spmd_pipeline
+
+    block_fn = make_block_fn(cfg)
+    pp = mesh.shape.get("pp", 1)
+    use_pp = pp > 1
+    M = num_microbatches
+    L = cfg.num_layers
+    if use_pp and L % pp != 0:
+        raise ValueError(f"num_layers {L} must divide pp {pp}")
+
+    def forward(params, ids):
+        if compute_dtype != jnp.float32:
+            # AMP O2: f32 master params, bf16 matmuls on the MXU
+            params = jax.tree.map(
+                lambda a: a.astype(compute_dtype)
+                if a.dtype == jnp.float32 else a, params)
+        B, T = ids.shape
+        x = params["wte"][ids] + params["wpe"][:T][None]
+        if use_pp:
+            # (M, mb, T, D): micro-batch dim unsharded, per-mb batch over dp
+            xm = x.reshape(M, B // M, T, cfg.hidden_size)
+            xm = lax.with_sharding_constraint(
+                xm, NamedSharding(mesh, P(None, "dp")))
+
+            def piped(bp, xi):
+                # remat per block here too — same HBM posture as the
+                # non-pipelined scan branch below
+                return spmd_pipeline(jax.checkpoint(block_fn), bp, xi,
+                                     axis="pp", num_stages=pp,
+                                     num_microbatches=M)
+
+            xm = jax.shard_map(
+                piped, mesh=mesh, in_specs=(P("pp"), P(None)),
+                out_specs=P(None), axis_names={"pp"},
+                check_vma=False)(params["blocks"], xm)
+            x = xm.reshape(B, T, cfg.hidden_size)
+        else:
+            # remat each block: O(1) layer activations live at once, the
+            # backward recomputes (reference recompute_optimizer default
+            # posture — HBM is the bottleneck, MXU flops are cheap)
+            def body(h, p):
+                return jax.checkpoint(block_fn)(p, h), None
+            x, _ = lax.scan(body, x, params["blocks"])
+        x = _layernorm(x, params["ln_f_g"], params["ln_f_b"])
+        return x @ params["head_w"]
+
+    def loss_fn(params, ids, labels):
+        logits = forward(params, ids).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    def adamw_update(params, grads, opt_state):
+        step = opt_state["step"] + 1
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                         opt_state["m"], grads)
+        v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                         opt_state["v"], grads)
+        c1 = 1 - b1 ** step.astype(jnp.float32)
+        c2 = 1 - b2 ** step.astype(jnp.float32)
+        params = jax.tree.map(
+            lambda p, mm, vv: (1 - learning_rate * weight_decay) * p
+            - learning_rate * (mm / c1) / (jnp.sqrt(vv / c2) + eps),
+            params, m, v)
+        return params, {"m": m, "v": v, "step": step}
+
+    def step(params, opt_state, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+        params, opt_state = adamw_update(params, grads, opt_state)
+        return loss, params, opt_state
+
+    shardings = gpt_param_shardings(mesh, cfg)
+
+    def init_fn(seed: int = 0):
+        params = init_gpt_params(cfg, jax.random.PRNGKey(seed))
+        params = jax.tree.map(jax.device_put, params, shardings)
+        opt_state = {"m": jax.tree.map(jnp.zeros_like, params),
+                     "v": jax.tree.map(jnp.zeros_like, params),
+                     "step": jnp.zeros((), jnp.int32)}
+        return params, opt_state
+
+    return jax.jit(step, donate_argnums=(0, 1)), init_fn

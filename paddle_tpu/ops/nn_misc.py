@@ -132,6 +132,21 @@ def _sdpa_xla(q, k, v, *rest, causal=False, scale=None, dropout_p=0.0,
 register_kernel("scaled_dot_product_attention", "xla")(_sdpa_xla)
 
 
+def _sdpa_pallas(q, k, v, *rest, causal=False, scale=None, dropout_p=0.0,
+                 dropout_key=None, has_mask=False):
+    """Flash-attention pallas kernel (ops/pallas/flash_attention.py);
+    mask/dropout variants fall back to the XLA math."""
+    if has_mask or dropout_p > 0.0:
+        return _sdpa_xla(q, k, v, *rest, causal=causal, scale=scale,
+                         dropout_p=dropout_p, dropout_key=dropout_key,
+                         has_mask=has_mask)
+    from .pallas.flash_attention import flash_attention
+    return flash_attention(q, k, v, causal=causal, scale=scale)
+
+
+register_kernel("scaled_dot_product_attention", "pallas")(_sdpa_pallas)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, scale=None, name=None):

@@ -1,0 +1,8 @@
+"""Hand-written TPU kernels (pallas).
+
+Reference parity: these play the role of the reference's hand-authored
+CUDA in ``operators/fused/`` (fused_attention_op.cu, fused_dropout chains)
+and ``operators/kernel_primitives/`` — the ops where HBM bandwidth or
+softmax-rescaling tricks beat what the compiler fuses on its own.
+"""
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,155 @@
+"""Flash attention — pallas TPU kernel.
+
+Reference parity: the capability of ``operators/fused/fused_attention_op.cu``
+(+ cuDNN attention) — attention without materialising the (T, T) score
+matrix in HBM.  Mechanism is the TPU one: a pallas kernel that streams K/V
+blocks through VMEM with the online-softmax rescaling (flash-attention
+algorithm), keeping the running max/denominator in f32 registers while the
+two matmuls ride the MXU.
+
+Forward is the pallas kernel; backward is a jax.custom_vjp that recomputes
+attention with XLA math from the saved (q, k, v) — the same
+recompute-in-backward posture the training stack uses everywhere
+(jax.checkpoint per block), so the (T, T) tensor only ever exists
+transiently inside one layer's backward.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+                block_k: int, seq_k: int, seq_q: int):
+    # q_ref: (1, block_q, d); k_ref/v_ref: (1, seq_k, d); o_ref like q_ref
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+
+    # bottom-right alignment for Tq != Tk (matches _xla_attention's
+    # tril(k=Tk-Tq)): query row i attends keys <= i + offset
+    offset = seq_k - seq_q
+    num_kb = seq_k // block_k
+    if causal:
+        # process only blocks at/below the (offset) diagonal of this block
+        last_q_row = (qi + 1) * block_q - 1 + offset
+        num_live = lax.min(jnp.int32(num_kb),
+                           (last_q_row // block_k) + 1)
+    else:
+        num_live = jnp.int32(num_kb)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) \
+                + qi * block_q + offset
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) \
+                + kb * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, num_live, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale: float, causal: bool,
+               block_q: int = 256, block_k: int = 256,
+               interpret: bool = False):
+    """q/k/v: (BH, T, d) -> (BH, T, d)."""
+    BH, T, d = q.shape
+    Tk = k.shape[1]
+    # callers guarantee T, Tk % 128 == 0 (the _flash gate); drop to the
+    # 128 block when the preferred block doesn't divide the sequence
+    block_q = block_q if T % block_q == 0 else 128
+    block_k = block_k if Tk % block_k == 0 else 128
+    assert T % block_q == 0 and Tk % block_k == 0, (T, Tk, block_q, block_k)
+    grid = (BH, T // block_q)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k, seq_k=Tk, seq_q=T)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _xla_attention(q, k, v, scale, causal):
+    # (BH, T, d) reference math for the backward recompute / CPU path
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale, causal):
+    if q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 \
+            and jax.default_backend() not in ("cpu",):
+        return _flash_fwd(q, k, v, scale, causal)
+    return _xla_attention(q, k, v, scale, causal).astype(q.dtype)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal):
+    return _flash(q, k, v, scale, causal), (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, scale, causal)
+                     .astype(q.dtype), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale=None):
+    """q/k/v: (B, S, H, D) paddle layout -> (B, S, H, D)."""
+    B, T, H, D = q.shape
+    Tk = k.shape[1]
+    s = float(scale) if scale is not None else float(1.0 / np.sqrt(D))
+
+    def fold(x):
+        return jnp.swapaxes(x, 1, 2).reshape(B * H, x.shape[1], D)
+
+    out = _flash(fold(q), fold(k), fold(v), s, causal)
+    return jnp.swapaxes(out.reshape(B, H, T, D), 1, 2)
