@@ -16,7 +16,7 @@ from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
     broadcast, reduce, scatter, alltoall, all_to_all, reduce_scatter,
     send, recv, barrier, wait, psum, pmean, ppermute, axis_index,
-    destroy_process_group)
+    destroy_process_group, global_scatter, global_gather)
 from . import topology  # noqa: F401
 from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
                        build_mesh, ParallelMode)

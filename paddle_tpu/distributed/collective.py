@@ -462,3 +462,32 @@ def ppermute(x, perm, group: Optional[Group] = None):
 def axis_index(group: Optional[Group] = None):
     g = _resolve(group)
     return lax.axis_index(g.axis_name)
+
+
+def global_scatter(x, local_count=None, global_count=None,
+                   group: Optional[Group] = None):
+    """reference collective/global_scatter_op.cu.cc — MoE token dispatch.
+
+    TPU-native: variable-count send lists don't fit XLA's static shapes;
+    tokens travel in fixed-capacity expert buffers (E, C, D) and the
+    exchange is one all_to_all over the expert-parallel axis.  See
+    fleet.meta_parallel.moe for gating/capacity. In-trace only."""
+    g = _resolve(group)
+    x = _raw(x)
+    if not _is_traced(x):
+        raise RuntimeError("global_scatter is an in-trace (shard_map) op; "
+                           "eager MoE uses fleet.meta_parallel.MoELayer")
+    from .fleet.meta_parallel.moe import moe_alltoall
+    return moe_alltoall(x, g.axis_name)
+
+
+def global_gather(x, local_count=None, global_count=None,
+                  group: Optional[Group] = None):
+    """reference collective/global_gather_op.cu.cc — inverse dispatch."""
+    g = _resolve(group)
+    x = _raw(x)
+    if not _is_traced(x):
+        raise RuntimeError("global_gather is an in-trace (shard_map) op; "
+                           "eager MoE uses fleet.meta_parallel.MoELayer")
+    from .fleet.meta_parallel.moe import moe_alltoall_inverse
+    return moe_alltoall_inverse(x, g.axis_name)

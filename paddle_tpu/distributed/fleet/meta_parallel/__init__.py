@@ -9,3 +9,7 @@ from ....core.random import RNGStatesTracker, get_rng_tracker  # noqa: F401
 def get_rng_state_tracker():
     """reference parallel_layers/random.py get_rng_state_tracker."""
     return get_rng_tracker()
+from .sequence_parallel import (ring_attention, ulysses_attention,  # noqa: F401
+                                split_sequence, gather_sequence)
+from .moe import (MoELayer, top1_gating, moe_dispatch, moe_combine,  # noqa: F401
+                  moe_alltoall, moe_alltoall_inverse)

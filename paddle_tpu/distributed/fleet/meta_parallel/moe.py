@@ -1,0 +1,153 @@
+"""Mixture-of-Experts: gating, capacity dispatch, expert-parallel
+all-to-all.
+
+Reference parity: ``operators/collective/global_scatter_op.*`` /
+``global_gather_op.*`` — the MoE token-dispatch plumbing (all-to-all by
+per-expert counts; capacity-style routing left to user code).
+
+TPU-first: XLA needs static shapes, so dispatch is capacity-based
+(Switch-Transformer style): each expert receives a fixed-capacity buffer,
+overflow tokens are dropped (their combine weight is 0), and the
+token→expert routing is expressed as one-hot matmuls that ride the MXU.
+Expert weights are stacked on a leading E dim — batched einsum applies
+all experts at once, and sharding that dim over the ``ep`` mesh axis
+(Parameter.placements) is expert parallelism; the two ``lax.all_to_all``
+calls are the reference's global_scatter/global_gather collapsed into
+compiler collectives.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ....core.dispatch import dispatch
+from ....core.tensor import Tensor
+from ....nn.layer_base import Layer
+from ....nn import initializer as I
+from .... import nn
+
+__all__ = ["top1_gating", "moe_dispatch", "moe_combine", "moe_alltoall",
+           "moe_alltoall_inverse", "MoELayer"]
+
+
+def top1_gating(logits, capacity: int):
+    """Switch top-1 gating with capacity.
+
+    logits: (tokens, E).  Returns (dispatch (T, E, C), combine (T, E, C),
+    aux_loss scalar)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                    # (T,)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T, E)
+    # 0-based arrival rank of each token within its expert's buffer
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot      # (T, E)
+    pos_in_expert = jnp.sum(pos, axis=-1)                  # (T,)
+    keep = pos_in_expert < capacity
+    gate = jnp.sum(probs * onehot, axis=-1) * keep         # (T,)
+    pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
+                            dtype=jnp.float32)             # (T, C)
+    dispatch_t = onehot[:, :, None] * pos_oh[:, None, :] \
+        * keep[:, None, None]
+    combine = dispatch_t * gate[:, None, None]
+    # load-balancing aux loss (Switch eq. 4): E * sum(f_e * p_e)
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return dispatch_t, combine, aux
+
+
+def moe_dispatch(x, dispatch_t):
+    """x: (T, D), dispatch: (T, E, C) -> (E, C, D) expert buffers."""
+    return jnp.einsum("td,tec->ecd", x, dispatch_t.astype(x.dtype))
+
+
+def moe_combine(expert_out, combine):
+    """expert_out: (E, C, D), combine: (T, E, C) -> (T, D)."""
+    return jnp.einsum("ecd,tec->td", expert_out,
+                      combine.astype(expert_out.dtype))
+
+
+def moe_alltoall(buffers, axis_name: str = "ep"):
+    """global_scatter: exchange expert buffers so each rank holds the
+    full token set for its local experts.
+
+    buffers: (E, C, D) with E = global expert count, E % ep == 0.
+    Returns (E/ep, ep*C, D).  In-trace (shard_map) only."""
+    return lax.all_to_all(buffers, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+
+
+def moe_alltoall_inverse(buffers, axis_name: str = "ep"):
+    """global_gather: route expert outputs back to token owners."""
+    return lax.all_to_all(buffers, axis_name, split_axis=1, concat_axis=0,
+                          tiled=True)
+
+
+def _moe_ffn(tokens, gate_w, up_w, up_b, down_w, down_b, *,
+             capacity: int):
+    """Pure MoE FFN: gating + capacity dispatch + batched experts +
+    combine.  tokens: (T, D); expert weights stacked on leading E dim."""
+    logits = tokens @ gate_w                                 # (T, E)
+    dispatch_t, combine, _ = top1_gating(logits, capacity)
+    buf = moe_dispatch(tokens, dispatch_t)                   # (E, C, D)
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", buf, up_w)
+                    + up_b[:, None, :])
+    out = jnp.einsum("ech,ehd->ecd", h, down_w) + down_b[:, None, :]
+    return moe_combine(out, combine)
+
+
+def _moe_aux(tokens, gate_w):
+    logits = tokens @ gate_w
+    _, _, aux = top1_gating(logits, logits.shape[0])
+    return aux
+
+
+class MoELayer(Layer):
+    """MoE FFN layer (top-1, capacity-based).
+
+    Expert weights are stacked (E, ...) Parameters with ``placements``
+    P('ep', ...) so expert parallelism is a placement decision, exactly
+    like mp in mp_layers.py.  Forward goes through the op dispatcher, so
+    both the eager tape and the compiled jax.grad paths differentiate
+    through gating, experts, and the aux loss.
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 capacity_factor: float = 1.25, gate_weight_attr=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.gate = nn.Linear(d_model, num_experts, bias_attr=False)
+        init = I.XavierNormal()
+        self.up_w = self.create_parameter(
+            [num_experts, d_model, d_hidden], default_initializer=init)
+        self.up_b = self.create_parameter(
+            [num_experts, d_hidden], is_bias=True)
+        self.down_w = self.create_parameter(
+            [num_experts, d_hidden, d_model], default_initializer=init)
+        self.down_b = self.create_parameter(
+            [num_experts, d_model], is_bias=True)
+        for p in (self.up_w, self.up_b, self.down_w, self.down_b):
+            p.placements = P("ep")
+        self.aux_loss = None
+
+    def forward(self, x):
+        B, T, D = x.shape
+        tokens = x.reshape([B * T, D])
+        capacity = int(np.ceil(B * T / self.num_experts
+                               * self.capacity_factor))
+        out = dispatch(
+            "moe_ffn",
+            lambda t, gw, uw, ub, dw, db: _moe_ffn(
+                t, gw, uw, ub, dw, db, capacity=capacity),
+            [tokens, self.gate.weight, self.up_w, self.up_b,
+             self.down_w, self.down_b], {})
+        self.aux_loss = dispatch("moe_aux", _moe_aux,
+                                 [tokens, self.gate.weight], {})
+        return out.reshape([B, T, D])

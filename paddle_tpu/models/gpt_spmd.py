@@ -92,17 +92,24 @@ def _layernorm(x, g, b, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * g + b
 
 
-def make_block_fn(cfg: GPTConfig):
+def make_block_fn(cfg: GPTConfig, sp_axis: Optional[str] = None):
+    """One transformer block; with sp_axis set, attention runs as ring
+    attention over that manual mesh axis (sequence/context parallel)."""
     h, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
 
     def block_fn(p, x):
         from ..ops.pallas.flash_attention import flash_attention
-        # x: (mb, T, D)
+        # x: (mb, T_local, D)
         B, T, D = x.shape
         y = _layernorm(x, p["ln1_g"], p["ln1_b"])
         qkv = y @ p["qkv_w"] + p["qkv_b"]
         q, k, v = jnp.split(qkv.reshape(B, T, 3 * h, hd), 3, axis=2)
-        ctx = flash_attention(q, k, v, causal=True)  # (B, T, h, hd)
+        if sp_axis is not None:
+            from ..distributed.fleet.meta_parallel.sequence_parallel \
+                import ring_attention
+            ctx = ring_attention(q, k, v, sp_axis, causal=True)
+        else:
+            ctx = flash_attention(q, k, v, causal=True)  # (B, T, h, hd)
         ctx = ctx.reshape(B, T, D)
         x = x + ctx @ p["out_w"] + p["out_b"]
         y = _layernorm(x, p["ln2_g"], p["ln2_b"])
@@ -124,9 +131,11 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
     """
     from ..distributed.fleet.meta_parallel.spmd_pipeline import spmd_pipeline
 
-    block_fn = make_block_fn(cfg)
     pp = mesh.shape.get("pp", 1)
-    use_pp = pp > 1
+    sp = mesh.shape.get("sp", 1)
+    use_pp, use_sp = pp > 1, sp > 1
+    sp_axis = "sp" if use_sp else None
+    block_fn = make_block_fn(cfg, sp_axis=sp_axis)
     M = num_microbatches
     L = cfg.num_layers
     if use_pp and L % pp != 0:
@@ -141,10 +150,12 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
         B, T = ids.shape
         x = params["wte"][ids] + params["wpe"][:T][None]
         if use_pp:
-            # (M, mb, T, D): micro-batch dim unsharded, per-mb batch over dp
+            # (M, mb, T, D): micro-batch dim unsharded, per-mb batch over
+            # dp, sequence over sp (ring attention inside the blocks)
             xm = x.reshape(M, B // M, T, cfg.hidden_size)
             xm = lax.with_sharding_constraint(
-                xm, NamedSharding(mesh, P(None, "dp")))
+                xm, NamedSharding(mesh, P(None, "dp", sp_axis)))
+            x_spec = P(None, None, "sp") if use_sp else P(None)
 
             def piped(bp, xi):
                 # remat per block here too — same HBM posture as the
@@ -154,10 +165,23 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
                                      num_microbatches=M)
 
             xm = jax.shard_map(
-                piped, mesh=mesh, in_specs=(P("pp"), P(None)),
-                out_specs=P(None), axis_names={"pp"},
+                piped, mesh=mesh, in_specs=(P("pp"), x_spec),
+                out_specs=x_spec, axis_names={"pp"} | ({"sp"} if use_sp
+                                                       else set()),
                 check_vma=False)(params["blocks"], xm)
             x = xm.reshape(B, T, cfg.hidden_size)
+        elif use_sp:
+            # sequence parallel without pp: shard T over sp, ring
+            # attention inside; blocks scanned locally
+            def seq_par(bp, xi):
+                def body(h, p):
+                    return jax.checkpoint(block_fn)(p, h), None
+                h, _ = lax.scan(body, xi, bp)
+                return h
+            x = jax.shard_map(
+                seq_par, mesh=mesh, in_specs=(P(None), P(None, "sp")),
+                out_specs=P(None, "sp"), axis_names={"sp"},
+                check_vma=False)(params["blocks"], x)
         else:
             # remat each block: O(1) layer activations live at once, the
             # backward recomputes (reference recompute_optimizer default

@@ -1,0 +1,177 @@
+"""Sequence/context parallelism + MoE tests on the 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ring_attention, ulysses_attention, MoELayer, top1_gating,
+    moe_dispatch, moe_combine, moe_alltoall, moe_alltoall_inverse)
+from paddle_tpu.ops.pallas.flash_attention import _xla_attention
+
+
+def _full_attention(q, k, v, causal):
+    B, T, H, D = q.shape
+
+    def fold(x):
+        return jnp.swapaxes(x, 1, 2).reshape(B * H, x.shape[1], D)
+    out = _xla_attention(fold(q), fold(k), fold(v), 1.0 / np.sqrt(D),
+                         causal)
+    return jnp.swapaxes(out.reshape(B, H, T, D), 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    sp = 4
+    B, T, H, D = 2, 64, 2, 16
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+               for _ in range(3))
+    mesh = Mesh(np.asarray(jax.devices()[:sp]), ("sp",))
+
+    def local(qs, ks, vs):
+        return ring_attention(qs, ks, vs, "sp", causal=causal)
+
+    out = jax.jit(jax.shard_map(local, mesh=mesh,
+                                in_specs=P(None, "sp"),
+                                out_specs=P(None, "sp"),
+                                check_vma=False))(q, k, v)
+    ref = _full_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grad():
+    sp = 4
+    B, T, H, D = 1, 32, 2, 8
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+               for _ in range(3))
+    mesh = Mesh(np.asarray(jax.devices()[:sp]), ("sp",))
+
+    def loss_ring(q, k, v):
+        f = jax.shard_map(
+            lambda a, b, c: ring_attention(a, b, c, "sp", causal=True),
+            mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+            check_vma=False)
+        return jnp.sum(f(q, k, v) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(_full_attention(q, k, v, True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_matches_full():
+    sp = 4
+    B, T, H, D = 2, 64, 4, 16  # H % sp == 0
+    rng = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+               for _ in range(3))
+    mesh = Mesh(np.asarray(jax.devices()[:sp]), ("sp",))
+
+    out = jax.jit(jax.shard_map(
+        lambda a, b, c: ulysses_attention(a, b, c, "sp", causal=True),
+        mesh=mesh, in_specs=P(None, "sp"), out_specs=P(None, "sp"),
+        check_vma=False))(q, k, v)
+    ref = _full_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_top1_gating_capacity():
+    logits = jnp.asarray(np.random.RandomState(0).randn(32, 4)
+                         .astype(np.float32))
+    # ample capacity: every token must be dispatched to exactly one slot
+    dispatch, combine, aux = top1_gating(logits, capacity=32)
+    assert dispatch.shape == (32, 4, 32)
+    per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    np.testing.assert_allclose(per_token, np.ones(32))
+    # every buffer slot holds at most one token
+    assert float(jnp.max(jnp.sum(dispatch, axis=0))) <= 1.0
+    # combine weights are the softmax probs of the chosen expert
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    chosen = probs.max(axis=-1)
+    np.testing.assert_allclose(np.asarray(jnp.sum(combine, axis=(1, 2))),
+                               chosen, rtol=1e-6)
+    assert float(aux) > 0
+    # tight capacity: exactly capacity tokens survive per expert
+    dispatch2, _, _ = top1_gating(logits, capacity=2)
+    per_expert = np.asarray(jnp.sum(dispatch2, axis=(0, 2)))
+    counts = np.bincount(np.asarray(jnp.argmax(logits, -1)), minlength=4)
+    np.testing.assert_allclose(per_expert, np.minimum(counts, 2))
+
+
+def test_moe_dispatch_combine_roundtrip():
+    T, D, E, C = 16, 8, 4, 16
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    dispatch, combine, _ = top1_gating(logits, C)
+    buf = moe_dispatch(x, dispatch)
+    assert buf.shape == (E, C, D)
+    # identity experts + combine == gate-scaled input, with real gates
+    out = moe_combine(buf, combine)
+    gates = jnp.sum(combine, axis=(1, 2))
+    assert float(jnp.min(gates)) > 0  # nothing dropped at ample capacity
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(x * gates[:, None]), rtol=1e-5)
+
+
+def test_moe_alltoall_roundtrip():
+    ep = 4
+    E, C, D = 8, 4, 16
+    rng = np.random.RandomState(4)
+    mesh = Mesh(np.asarray(jax.devices()[:ep]), ("ep",))
+    x = jnp.asarray(rng.randn(ep, E, C, D).astype(np.float32))
+
+    def f(b):
+        buf = b[0]                         # local (E, C, D)
+        fwd = moe_alltoall(buf, "ep")      # (E/ep, ep*C, D)
+        assert fwd.shape == (E // ep, ep * C, D)
+        return moe_alltoall_inverse(fwd, "ep")[None]
+
+    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("ep"),
+                                out_specs=P("ep"), check_vma=False))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+def test_moe_layer_trains():
+    paddle.seed(0)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inp = paddle.nn.Linear(8, 16)
+            self.moe = MoELayer(16, 32, num_experts=4)
+            self.out = paddle.nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.out(self.moe(self.inp(x)))
+
+    net = Net()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(5e-3,
+                                        parameters=net.parameters()),
+                  paddle.nn.MSELoss())
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8, 8).astype(np.float32)
+    y = rng.randn(4, 8, 4).astype(np.float32)
+    up_before = np.asarray(net.moe.up_w._data).copy()
+    gate_before = np.asarray(net.moe.gate.weight._data).copy()
+    l0 = model.train_batch([x], [y])["loss"]
+    for _ in range(30):
+        l1 = model.train_batch([x], [y])["loss"]
+    assert l1 < l0 * 0.5, (l0, l1)
+    # experts and gate must actually receive gradients
+    assert np.abs(np.asarray(net.moe.up_w._data) - up_before).max() > 1e-5
+    assert np.abs(np.asarray(net.moe.gate.weight._data)
+                  - gate_before).max() > 1e-6
