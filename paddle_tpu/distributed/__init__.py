@@ -22,3 +22,4 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F40
                        build_mesh, ParallelMode)
 from .parallel import (DataParallel, shard_batch, param_shardings,  # noqa: F401
                        apply_param_shardings, scale_loss)
+from . import checkpoint  # noqa: F401

@@ -13,6 +13,7 @@ import queue
 import threading
 from typing import Any, Callable, Iterable, List, Optional, Sequence
 
+import jax
 import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
@@ -281,6 +282,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = max(2, prefetch_factor)
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -323,7 +325,83 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
+        if self.use_shared_memory:
+            from .. import native
+            if native.available():
+                yield from self._prefetch_iter_native()
+                return
         yield from self._prefetch_iter()
+
+    def _prefetch_iter_native(self):
+        """Prefetch through the native C++ BlockingQueue: batches travel
+        as pickled bytes in arena-backed buffers, and queue waits happen
+        with the GIL released (reference blocking_queue.h + mmap shared
+        memory path, collapsed to one process)."""
+        import pickle
+        from .. import native
+
+        batches = list(self.batch_sampler)
+        cursor = {"i": 0}
+        lock = threading.Lock()
+        q = native.BlockingQueue(
+            capacity=self.prefetch_factor * self.num_workers)
+        done = {"workers": 0}
+
+        def to_np(obj):
+            if isinstance(obj, Tensor):
+                return np.asarray(obj._data)
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(to_np(o) for o in obj)
+            if isinstance(obj, dict):
+                return {k: to_np(v) for k, v in obj.items()}
+            return obj
+
+        def worker(wid):
+            _worker_info.info = _WorkerInfo(wid, self.num_workers,
+                                            self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            try:
+                while True:
+                    with lock:
+                        i = cursor["i"]
+                        if i >= len(batches):
+                            break
+                        cursor["i"] += 1
+                    try:
+                        payload = (i, to_np(self._fetch(batches[i])), None)
+                    except BaseException as e:
+                        payload = (i, None, e)
+                    q.push(pickle.dumps(payload))
+            finally:
+                with lock:
+                    done["workers"] += 1
+                    if done["workers"] == self.num_workers:
+                        q.close()
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        pending: dict = {}
+        for i in range(len(batches)):
+            while i not in pending:
+                raw = q.pop()
+                if raw is None:
+                    break
+                j, data, err = pickle.loads(raw)
+                pending[j] = (data, err)
+            if i not in pending:
+                raise RuntimeError("DataLoader workers exited early")
+            data, err = pending.pop(i)
+            if err is not None:
+                raise RuntimeError(
+                    f"DataLoader worker failed on batch {i}") from err
+            yield jax.tree.map(
+                lambda a: to_tensor(a) if isinstance(a, np.ndarray) else a,
+                data)
+        for t in threads:
+            t.join()
 
     def _prefetch_iter(self):
         batches = list(self.batch_sampler)

@@ -1,0 +1,323 @@
+// paddle_tpu native runtime support library.
+//
+// TPU-native equivalents of the reference's C++ host-side subsystems:
+//  - BlockingQueue  <- paddle/fluid/operators/reader/blocking_queue.h
+//      bounded MPMC byte-buffer queue; waits happen outside the Python
+//      GIL (callers use ctypes, which releases the GIL for the call).
+//  - Arena          <- paddle/fluid/memory/allocation/
+//                      auto_growth_best_fit_allocator.h:30
+//      chunked auto-growth best-fit allocator for host staging buffers
+//      (DataLoader batches, checkpoint I/O) — avoids malloc churn and
+//      keeps buffers alignment-friendly for zero-copy numpy views.
+//  - Profiler       <- paddle/fluid/platform/profiler.h:216 (RecordEvent
+//      + chrome-trace export); device-side tracing stays with XLA/jax
+//      profiler, this records host spans.
+//  - StatRegistry   <- paddle/fluid/platform/monitor.h:77
+//      named int64 counters.
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Arena: chunked auto-growth best-fit allocator
+// ---------------------------------------------------------------------------
+
+struct FreeBlock {
+  size_t size;
+  char* ptr;
+};
+
+struct Arena {
+  std::mutex mu;
+  size_t chunk_size;
+  size_t total_reserved = 0;
+  size_t total_in_use = 0;
+  std::vector<char*> chunks;
+  // best-fit free list ordered by size (multimap: size -> ptr)
+  std::multimap<size_t, char*> free_blocks;
+  std::unordered_map<char*, size_t> sizes;  // live allocation sizes
+};
+
+static const size_t kAlign = 256;  // page/DMA friendly
+
+static size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+void* arena_create(uint64_t chunk_size) {
+  Arena* a = new Arena();
+  a->chunk_size = chunk_size ? chunk_size : (8u << 20);
+  return a;
+}
+
+void* arena_alloc(void* handle, uint64_t size) {
+  Arena* a = static_cast<Arena*>(handle);
+  size_t need = align_up(size);
+  std::lock_guard<std::mutex> lock(a->mu);
+  // best fit: smallest free block that holds `need`
+  auto it = a->free_blocks.lower_bound(need);
+  if (it == a->free_blocks.end()) {
+    size_t chunk = std::max(a->chunk_size, need);
+    char* mem = static_cast<char*>(::operator new(chunk, std::nothrow));
+    if (!mem) return nullptr;
+    a->chunks.push_back(mem);
+    a->total_reserved += chunk;
+    it = a->free_blocks.emplace(chunk, mem);
+  }
+  size_t bsize = it->first;
+  char* bptr = it->second;
+  a->free_blocks.erase(it);
+  if (bsize > need + kAlign) {  // split the remainder back
+    a->free_blocks.emplace(bsize - need, bptr + need);
+    bsize = need;
+  }
+  a->sizes[bptr] = bsize;
+  a->total_in_use += bsize;
+  return bptr;
+}
+
+void arena_free(void* handle, void* ptr) {
+  Arena* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->sizes.find(static_cast<char*>(ptr));
+  if (it == a->sizes.end()) return;
+  a->total_in_use -= it->second;
+  a->free_blocks.emplace(it->second, it->first);
+  a->sizes.erase(it);
+}
+
+uint64_t arena_reserved(void* handle) {
+  return static_cast<Arena*>(handle)->total_reserved;
+}
+
+uint64_t arena_in_use(void* handle) {
+  return static_cast<Arena*>(handle)->total_in_use;
+}
+
+void arena_destroy(void* handle) {
+  Arena* a = static_cast<Arena*>(handle);
+  for (char* c : a->chunks) ::operator delete(c);
+  delete a;
+}
+
+// ---------------------------------------------------------------------------
+// BlockingQueue of byte buffers (arena-backed)
+// ---------------------------------------------------------------------------
+
+struct Buf {
+  char* ptr;
+  size_t size;
+};
+
+struct BlockingQueue {
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::deque<Buf> items;
+  size_t capacity;
+  bool closed = false;
+  Arena* arena;
+};
+
+void* bq_create(uint64_t capacity, uint64_t arena_chunk) {
+  BlockingQueue* q = new BlockingQueue();
+  q->capacity = capacity ? capacity : 8;
+  q->arena = static_cast<Arena*>(arena_create(arena_chunk));
+  return q;
+}
+
+// returns 0 ok, -1 closed, -2 timeout
+int bq_push(void* handle, const void* data, uint64_t size,
+            int64_t timeout_ms) {
+  BlockingQueue* q = static_cast<BlockingQueue*>(handle);
+  std::unique_lock<std::mutex> lock(q->mu);
+  auto pred = [q] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_ms < 0) {
+    q->not_full.wait(lock, pred);
+  } else if (!q->not_full.wait_for(
+                 lock, std::chrono::milliseconds(timeout_ms), pred)) {
+    return -2;
+  }
+  if (q->closed) return -1;
+  char* mem = static_cast<char*>(arena_alloc(q->arena, size));
+  if (!mem) return -3;
+  std::memcpy(mem, data, size);
+  q->items.push_back(Buf{mem, static_cast<size_t>(size)});
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// returns size >=0, -1 closed+drained, -2 timeout. Caller then calls
+// bq_fetch to copy out and release.
+int64_t bq_peek_size(void* handle, int64_t timeout_ms) {
+  BlockingQueue* q = static_cast<BlockingQueue*>(handle);
+  std::unique_lock<std::mutex> lock(q->mu);
+  auto pred = [q] { return q->closed || !q->items.empty(); };
+  if (timeout_ms < 0) {
+    q->not_empty.wait(lock, pred);
+  } else if (!q->not_empty.wait_for(
+                 lock, std::chrono::milliseconds(timeout_ms), pred)) {
+    return -2;
+  }
+  if (q->items.empty()) return -1;  // closed + drained
+  return static_cast<int64_t>(q->items.front().size);
+}
+
+int64_t bq_fetch(void* handle, void* out, uint64_t out_cap) {
+  BlockingQueue* q = static_cast<BlockingQueue*>(handle);
+  std::unique_lock<std::mutex> lock(q->mu);
+  if (q->items.empty()) return -1;
+  Buf b = q->items.front();
+  if (b.size > out_cap) return -3;
+  q->items.pop_front();
+  std::memcpy(out, b.ptr, b.size);
+  arena_free(q->arena, b.ptr);
+  q->not_full.notify_one();
+  return static_cast<int64_t>(b.size);
+}
+
+uint64_t bq_size(void* handle) {
+  BlockingQueue* q = static_cast<BlockingQueue*>(handle);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return q->items.size();
+}
+
+void bq_close(void* handle) {
+  BlockingQueue* q = static_cast<BlockingQueue*>(handle);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->closed = true;
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+void bq_destroy(void* handle) {
+  BlockingQueue* q = static_cast<BlockingQueue*>(handle);
+  bq_close(handle);
+  for (auto& b : q->items) arena_free(q->arena, b.ptr);
+  arena_destroy(q->arena);
+  delete q;
+}
+
+// ---------------------------------------------------------------------------
+// Profiler: host event ring + chrome-trace export
+// ---------------------------------------------------------------------------
+
+struct ProfEvent {
+  char name[64];
+  int64_t start_ns;
+  int64_t end_ns;
+  int64_t tid;
+};
+
+struct Profiler {
+  std::mutex mu;
+  std::vector<ProfEvent> events;
+  size_t capacity;
+  std::atomic<bool> enabled{false};
+};
+
+static Profiler g_prof;
+
+void prof_enable(uint64_t capacity) {
+  std::lock_guard<std::mutex> lock(g_prof.mu);
+  g_prof.capacity = capacity ? capacity : (1u << 20);
+  g_prof.events.clear();
+  g_prof.events.reserve(std::min<size_t>(g_prof.capacity, 4096));
+  g_prof.enabled = true;
+}
+
+void prof_disable() { g_prof.enabled = false; }
+
+int prof_is_enabled() { return g_prof.enabled ? 1 : 0; }
+
+int64_t prof_now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void prof_record(const char* name, int64_t start_ns, int64_t end_ns,
+                 int64_t tid) {
+  if (!g_prof.enabled) return;
+  std::lock_guard<std::mutex> lock(g_prof.mu);
+  if (g_prof.events.size() >= g_prof.capacity) return;  // ring full: drop
+  ProfEvent e;
+  std::strncpy(e.name, name, sizeof(e.name) - 1);
+  e.name[sizeof(e.name) - 1] = '\0';
+  e.start_ns = start_ns;
+  e.end_ns = end_ns;
+  e.tid = tid;
+  g_prof.events.push_back(e);
+}
+
+uint64_t prof_event_count() {
+  std::lock_guard<std::mutex> lock(g_prof.mu);
+  return g_prof.events.size();
+}
+
+// serialize into caller buffer as chrome-trace JSON; returns bytes
+// written or -needed if too small
+int64_t prof_dump_json(char* out, uint64_t cap) {
+  std::lock_guard<std::mutex> lock(g_prof.mu);
+  std::string s = "{\"traceEvents\":[";
+  char line[256];
+  bool first = true;
+  for (const auto& e : g_prof.events) {
+    std::snprintf(line, sizeof(line),
+                  "%s{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%lld,"
+                  "\"ts\":%.3f,\"dur\":%.3f}",
+                  first ? "" : ",", e.name,
+                  static_cast<long long>(e.tid), e.start_ns / 1000.0,
+                  (e.end_ns - e.start_ns) / 1000.0);
+    s += line;
+    first = false;
+  }
+  s += "]}";
+  if (s.size() + 1 > cap) return -static_cast<int64_t>(s.size() + 1);
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return static_cast<int64_t>(s.size());
+}
+
+// ---------------------------------------------------------------------------
+// StatRegistry: named int64 counters (platform/monitor.h:77)
+// ---------------------------------------------------------------------------
+
+struct Stats {
+  std::mutex mu;
+  std::map<std::string, int64_t> vals;
+};
+
+static Stats g_stats;
+
+void stat_add(const char* name, int64_t delta) {
+  std::lock_guard<std::mutex> lock(g_stats.mu);
+  g_stats.vals[name] += delta;
+}
+
+int64_t stat_get(const char* name) {
+  std::lock_guard<std::mutex> lock(g_stats.mu);
+  auto it = g_stats.vals.find(name);
+  return it == g_stats.vals.end() ? 0 : it->second;
+}
+
+void stat_reset(const char* name) {
+  std::lock_guard<std::mutex> lock(g_stats.mu);
+  if (name && *name) {
+    g_stats.vals.erase(name);
+  } else {
+    g_stats.vals.clear();
+  }
+}
+
+}  // extern "C"

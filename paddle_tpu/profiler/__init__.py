@@ -19,25 +19,57 @@ _active = {"dir": None}
 
 
 class RecordEvent:
-    """Named host-side span (reference platform/profiler RecordEvent RAII)."""
+    """Named host-side span (reference platform/profiler RecordEvent RAII).
+
+    Feeds both jax.profiler (TensorBoard/Perfetto timeline) and the
+    native C++ event collector (paddle_tpu.native, chrome-trace export
+    via export_chrome_tracing) when it is enabled."""
 
     def __init__(self, name: str):
         self.name = name
         self._ctx = None
+        self._t0 = None
 
     def __enter__(self):
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
+        from ..native import Profiler as _NP
+        if _NP.enabled():
+            self._t0 = _NP.now_ns()
         return self
 
     def __exit__(self, *exc):
         self._ctx.__exit__(*exc)
+        if self._t0 is not None:
+            from ..native import Profiler as _NP
+            import threading
+            _NP.record(self.name, self._t0, _NP.now_ns(),
+                       threading.get_ident() % (1 << 31))
+            self._t0 = None
         return False
 
     begin = __enter__
 
     def end(self):
         self.__exit__(None, None, None)
+
+
+def enable_host_tracer(capacity: int = 1 << 20):
+    """Turn on the native host-span collector (C++ ring buffer)."""
+    from ..native import Profiler as _NP
+    _NP.enable(capacity)
+
+
+def disable_host_tracer():
+    from ..native import Profiler as _NP
+    _NP.disable()
+
+
+def export_chrome_tracing(path: str):
+    """Write collected host spans as a chrome://tracing JSON file
+    (reference profiler chrome-trace report)."""
+    from ..native import Profiler as _NP
+    _NP.dump_chrome_trace(path)
 
 
 def start_profiler(state=None, tracer_option=None, log_dir="profile_log"):

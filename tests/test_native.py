@@ -1,0 +1,102 @@
+"""Native C++ runtime tests (blocking queue, arena, profiler, stats)."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain")
+
+
+def test_arena_best_fit_reuse():
+    a = native.Arena(1 << 20)
+    p1 = a.alloc(1000)
+    p2 = a.alloc(5000)
+    assert p1 and p2 and p1 != p2
+    used = a.in_use
+    a.free(p1)
+    assert a.in_use < used
+    p3 = a.alloc(500)
+    assert p3 == p1  # best-fit reuses the freed 1000-byte block
+    assert a.reserved == 1 << 20  # no extra chunk needed
+
+
+def test_arena_growth():
+    a = native.Arena(4096)
+    ptrs = [a.alloc(4096) for _ in range(4)]
+    assert all(ptrs)
+    assert a.reserved >= 4 * 4096
+
+
+def test_blocking_queue_mpmc_and_close():
+    q = native.BlockingQueue(capacity=4)
+    n_items = 50
+
+    def producer(base):
+        for i in range(n_items):
+            q.push(f"{base}:{i}".encode())
+
+    threads = [threading.Thread(target=producer, args=(b,))
+               for b in range(3)]
+    for t in threads:
+        t.start()
+    got = []
+    for _ in range(3 * n_items):
+        got.append(q.pop())
+    for t in threads:
+        t.join()
+    q.close()
+    assert q.pop() is None  # closed + drained
+    assert len(got) == 3 * n_items
+    assert all(g is not None for g in got)
+
+
+def test_blocking_queue_timeout():
+    q = native.BlockingQueue(capacity=2)
+    with pytest.raises(TimeoutError):
+        q.pop(timeout_ms=50)
+
+
+def test_profiler_chrome_trace(tmp_path):
+    native.Profiler.enable()
+    with paddle.profiler.RecordEvent("span_a"):
+        pass
+    with paddle.profiler.RecordEvent("span_b"):
+        pass
+    assert native.Profiler.event_count() >= 2
+    out = tmp_path / "trace.json"
+    paddle.profiler.export_chrome_tracing(str(out))
+    tr = json.loads(out.read_text())
+    names = {e["name"] for e in tr["traceEvents"]}
+    assert {"span_a", "span_b"} <= names
+    native.Profiler.disable()
+
+
+def test_stats():
+    native.stat_reset()
+    native.stat_add("STAT_batches", 3)
+    native.stat_add("STAT_batches", 4)
+    assert native.stat_get("STAT_batches") == 7
+    native.stat_reset("STAT_batches")
+    assert native.stat_get("STAT_batches") == 0
+
+
+def test_dataloader_native_path():
+    class DS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return np.full((3,), i, np.float32), np.int64(i % 2)
+
+        def __len__(self):
+            return 17
+
+    loader = paddle.io.DataLoader(DS(), batch_size=4, num_workers=2,
+                                  use_shared_memory=True, drop_last=False)
+    seen = []
+    for x, y in loader:
+        assert x.shape[0] in (4, 1)
+        seen.extend(np.asarray(x.numpy())[:, 0].tolist())
+    assert sorted(seen) == list(range(17))
