@@ -10,14 +10,42 @@ the config is shrunk and vs_baseline is reported against the same target
 for continuity (expect << 1 on CPU).
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 
+def _probe_backend(timeout_s: float = 240.0) -> bool:
+    """True if the default (TPU/axon) backend initializes in a fresh
+    subprocess within timeout_s.  The axon tunnel can hang indefinitely
+    on init when down; probing out-of-process lets us fall back to CPU
+    instead of hanging the whole bench run."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s, capture_output=True, text=True)
+        return r.returncode == 0 and "ok" in r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def main():
     import jax
+
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        # axon sitecustomize force-sets jax_platforms; re-honor the env.
+        jax.config.update("jax_platforms", env_platforms)
+    if str(jax.config.jax_platforms) != "cpu":
+        if not _probe_backend():
+            print("bench: accelerator backend unreachable; CPU fallback",
+                  file=sys.stderr)
+            jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
     from paddle_tpu.distributed.topology import build_mesh
     from paddle_tpu.models import GPTConfig
