@@ -36,6 +36,14 @@ from .ops import *  # noqa: F401,F403
 from . import ops  # noqa: F401
 
 # subsystem namespaces
+# NB: `from .ops import *` above binds the ops.linalg submodule onto this
+# package under the name `linalg`; rebind to the real paddle_tpu.linalg
+# namespace module (which re-exports the op set and adds cond etc.).
+import importlib as _importlib
+
+linalg = _importlib.import_module(".linalg", __name__)
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401

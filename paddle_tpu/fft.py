@@ -1,0 +1,161 @@
+"""``paddle_tpu.fft`` — discrete Fourier transforms.
+
+Reference parity: ``python/paddle/fft.py`` (public surface) backed by
+``operators/spectral_op.*`` (cuFFT/pocketfft).  Here every transform is
+``jnp.fft`` — XLA lowers to its native FFT HLO, which runs on the TPU
+vector unit; no vendor-library dynload layer is needed.
+
+Norm convention matches the reference: "backward" (default), "ortho",
+"forward".
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import dispatch
+from .core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_VALID_NORM = ("backward", "ortho", "forward")
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in _VALID_NORM:
+        raise ValueError(
+            f"norm should be one of {_VALID_NORM}, got {norm!r}")
+    return norm
+
+
+def _make_1d(op_name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        x = to_tensor(x)
+        nm = _norm(norm)
+        return dispatch(
+            op_name, lambda a: jfn(a, n=n, axis=axis, norm=nm), (x,), {})
+    op.__name__ = op_name
+    return op
+
+
+def _make_nd(op_name, jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        x = to_tensor(x)
+        nm = _norm(norm)
+        ss = tuple(s) if s is not None else None
+        if axes is not None:
+            ax = tuple(axes)
+        elif ss is not None:
+            ax = tuple(range(-len(ss), 0))
+        else:
+            ax = None
+        return dispatch(
+            op_name, lambda a: jfn(a, s=ss, axes=ax, norm=nm), (x,), {})
+    op.__name__ = op_name
+    return op
+
+
+fft = _make_1d("fft", jnp.fft.fft)
+ifft = _make_1d("ifft", jnp.fft.ifft)
+rfft = _make_1d("rfft", jnp.fft.rfft)
+irfft = _make_1d("irfft", jnp.fft.irfft)
+hfft = _make_1d("hfft", jnp.fft.hfft)
+ihfft = _make_1d("ihfft", jnp.fft.ihfft)
+
+fftn = _make_nd("fftn", jnp.fft.fftn)
+ifftn = _make_nd("ifftn", jnp.fft.ifftn)
+rfftn = _make_nd("rfftn", jnp.fft.rfftn)
+irfftn = _make_nd("irfftn", jnp.fft.irfftn)
+
+
+def _make_2d(op_name, ndfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return ndfn(x, s=s, axes=axes, norm=norm)
+    op.__name__ = op_name
+    return op
+
+
+fft2 = _make_2d("fft2", fftn)
+ifft2 = _make_2d("ifft2", ifftn)
+rfft2 = _make_2d("rfft2", rfftn)
+irfft2 = _make_2d("irfft2", irfftn)
+
+
+def _hfftn_impl(a, s, axes, nm, inverse):
+    # hfftn = irfftn of the conjugate with "flipped" norm scaling; jnp has
+    # no hfftn, so compose from the 1d hfft along the last axis + fftn on
+    # the rest, matching pocketfft's definition used by the reference.
+    if axes is None:
+        ndim = len(s) if s is not None else a.ndim
+        axes = tuple(range(-ndim, 0))
+    else:
+        axes = tuple(axes)
+    if s is not None:
+        s = tuple(s)
+    head, last = axes[:-1], axes[-1]
+    n_last = None if s is None else s[-1]
+    sub = None if s is None else s[:-1]
+    if inverse:
+        # ihfft must see the real input, so it runs on the last axis
+        # FIRST; the head-axes ifftn then operates on its complex output.
+        a = jnp.fft.ihfft(a, n=n_last, axis=last, norm=nm)
+        if head:
+            a = jnp.fft.ifftn(a, s=sub, axes=head, norm=nm)
+        return a
+    if head:
+        a = jnp.fft.fftn(a, s=sub, axes=head, norm=nm)
+    return jnp.fft.hfft(a, n=n_last, axis=last, norm=nm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = to_tensor(x)
+    nm = _norm(norm)
+    return dispatch(
+        "hfftn", lambda a: _hfftn_impl(a, s, axes, nm, False), (x,), {})
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = to_tensor(x)
+    nm = _norm(norm)
+    return dispatch(
+        "ihfftn", lambda a: _hfftn_impl(a, s, axes, nm, True), (x,), {})
+
+
+hfft2 = _make_2d("hfft2", hfftn)
+ihfft2 = _make_2d("ihfft2", ihfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        from .core.dtype import dtype_to_jnp
+        out = out.astype(dtype_to_jnp(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        from .core.dtype import dtype_to_jnp
+        out = out.astype(dtype_to_jnp(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    x = to_tensor(x)
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return dispatch("fftshift", lambda a: jnp.fft.fftshift(a, axes=ax),
+                    (x,), {})
+
+
+def ifftshift(x, axes=None, name=None):
+    x = to_tensor(x)
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return dispatch("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=ax),
+                    (x,), {})

@@ -1,0 +1,60 @@
+"""``paddle_tpu.linalg`` — the ``paddle.linalg`` namespace.
+
+Reference parity: ``python/paddle/linalg.py`` (re-export table) and the
+C++ linalg operator suite (``operators/svd_op.cc``, ``cholesky_op.cu``,
+``eig_op.cc``...).  Every op lowers through XLA's linalg expansions; on
+TPU the decompositions run in f32 on the MXU/VPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import to_tensor
+from .core.dispatch import dispatch
+from .ops.linalg import (  # noqa: F401
+    cholesky, norm, inverse as inv, eig, eigvals, multi_dot, matrix_rank,
+    svd, qr, lu, matrix_power, det, slogdet, eigh, eigvalsh, pinv, solve,
+    triangular_solve, cholesky_solve, lstsq, cov, corrcoef, matmul,
+)
+
+__all__ = [
+    "cholesky", "norm", "cond", "inv", "eig", "eigvals", "multi_dot",
+    "matrix_rank", "svd", "qr", "lu", "matrix_power", "det", "slogdet",
+    "eigh", "eigvalsh", "pinv", "solve", "triangular_solve",
+    "cholesky_solve", "lstsq", "cov", "corrcoef", "matmul",
+]
+
+
+def cond(x, p=None, name=None):
+    """Condition number of matrix ``x`` in norm ``p``.
+
+    Reference: ``python/paddle/linalg.py`` 'cond' entry
+    (``python/paddle/tensor/linalg.py`` cond).  p in {None/'fro'/'nuc'/
+    1/-1/2/-2/inf/-inf}; None means 2-norm.
+    """
+    x = to_tensor(x)
+    pp = 2 if p is None else p
+
+    def impl(a):
+        if pp in ("fro", "nuc"):
+            if pp == "fro":
+                na = jnp.sqrt(jnp.sum(jnp.square(a), axis=(-2, -1)))
+                nb = jnp.sqrt(jnp.sum(
+                    jnp.square(jnp.linalg.inv(a)), axis=(-2, -1)))
+            else:
+                s = jnp.linalg.svd(a, compute_uv=False)
+                na = jnp.sum(s, axis=-1)
+                nb = jnp.sum(1.0 / s, axis=-1)
+            return na * nb
+        if pp in (2, -2):
+            s = jnp.linalg.svd(a, compute_uv=False)
+            smax, smin = jnp.max(s, axis=-1), jnp.min(s, axis=-1)
+            return smax / smin if pp == 2 else smin / smax
+        # 1/-1/inf/-inf: induced norms via row/col abs sums
+        inv_a = jnp.linalg.inv(a)
+        axis = -2 if pp in (1, -1) else -1
+        red = jnp.max if pp in (1, float("inf")) else jnp.min
+        na = red(jnp.sum(jnp.abs(a), axis=axis), axis=-1)
+        nb = red(jnp.sum(jnp.abs(inv_a), axis=axis), axis=-1)
+        return na * nb
+    return dispatch("cond", impl, (x,), {})

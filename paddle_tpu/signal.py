@@ -1,0 +1,187 @@
+"""``paddle_tpu.signal`` — short-time Fourier analysis.
+
+Reference parity: ``python/paddle/signal.py`` (frame / overlap_add /
+stft / istft, built there on ``operators/frame_op.cc``,
+``overlap_add_op.cc`` and the spectral ops).  Here frame is a gather,
+overlap_add a scatter-add, and the FFTs are XLA HLO — all fully
+jit-traceable with static frame counts.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import dispatch
+from .core.tensor import Tensor, to_tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_idx(n, frame_length, hop_length):
+    if n < frame_length:
+        raise ValueError(
+            f"input size ({n}) < frame_length ({frame_length})")
+    num_frames = 1 + (n - frame_length) // hop_length
+    return (jnp.arange(frame_length)[:, None]
+            + hop_length * jnp.arange(num_frames)[None, :])
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice ``x`` into overlapping frames.
+
+    axis=-1 → output (..., frame_length, num_frames);
+    axis=0  → output (num_frames, frame_length, ...).
+    Reference: ``python/paddle/signal.py`` frame().
+    """
+    x = to_tensor(x)
+    if hop_length is None or hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError(f"axis should be 0 or -1, got {axis}")
+    n = x.shape[0] if axis == 0 else x.shape[-1]
+    idx = _frame_idx(n, frame_length, hop_length)
+
+    def impl(a):
+        if axis == 0:
+            # (num_frames, frame_length, ...)
+            return a[jnp.transpose(idx)]
+        return a[..., idx]
+    return dispatch("frame", impl, (x,), {})
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of :func:`frame` — scatter-add overlapping frames.
+
+    Reference: ``python/paddle/signal.py`` overlap_add()
+    (``operators/overlap_add_op.cc``).
+    """
+    x = to_tensor(x)
+    if hop_length is None or hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError(f"axis should be 0 or -1, got {axis}")
+    if axis == 0:
+        num_frames, frame_length = x.shape[0], x.shape[1]
+    else:
+        frame_length, num_frames = x.shape[-2], x.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    idx = _frame_idx(out_len, frame_length, hop_length)
+
+    def impl(a):
+        if axis == 0:
+            shape = (out_len,) + a.shape[2:]
+            out = jnp.zeros(shape, a.dtype)
+            return out.at[jnp.transpose(idx)].add(a)
+        shape = a.shape[:-2] + (out_len,)
+        out = jnp.zeros(shape, a.dtype)
+        return out.at[..., idx].add(a)
+    return dispatch("overlap_add", impl, (x,), {})
+
+
+def _prep_window(window, win_length, n_fft, dtype):
+    if window is None:
+        w = jnp.ones((win_length,), dtype)
+    else:
+        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+        if w.shape[0] != win_length:
+            raise ValueError(
+                f"window length ({w.shape[0]}) != win_length ({win_length})")
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    return w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """Short-time Fourier transform.
+
+    Output: complex (..., n_fft//2+1 if onesided else n_fft, num_frames).
+    Reference: ``python/paddle/signal.py`` stft().
+    """
+    x = to_tensor(x)
+    hop_length = n_fft // 4 if hop_length is None else hop_length
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    win_length = n_fft if win_length is None else win_length
+    w = _prep_window(window, win_length, n_fft, x._data.real.dtype)
+    is_complex = jnp.iscomplexobj(x._data)
+    if is_complex and onesided:
+        raise ValueError("onesided is not supported for complex input")
+
+    def impl(a):
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        idx = _frame_idx(a.shape[-1], n_fft, hop_length)
+        frames = a[..., idx] * w[:, None]
+        fftfn = jnp.fft.rfft if (onesided and not is_complex) else jnp.fft.fft
+        spec = fftfn(frames, n=n_fft, axis=-2)
+        if normalized:
+            spec = spec * (1.0 / jnp.sqrt(n_fft).astype(spec.real.dtype))
+        return spec
+    return dispatch("stft", impl, (x,), {})
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization.
+
+    Reference: ``python/paddle/signal.py`` istft().
+    """
+    x = to_tensor(x)
+    hop_length = n_fft // 4 if hop_length is None else hop_length
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    win_length = n_fft if win_length is None else win_length
+    if onesided and return_complex:
+        raise ValueError(
+            "onesided=True is incompatible with return_complex=True")
+    w = _prep_window(window, win_length, n_fft, jnp.float32)
+
+    # NOLA validation (eager: shapes and window are concrete here).  The
+    # reference raises when the squared-window overlap-add envelope has
+    # ~zero entries in the retained region.
+    import numpy as _nmp
+    _frames = x.shape[-1]
+    _out_len = (_frames - 1) * hop_length + n_fft
+    _idx = _nmp.asarray(_frame_idx(_out_len, n_fft, hop_length))
+    _env_full = _nmp.zeros(_out_len)
+    _nmp.add.at(_env_full, _idx.reshape(-1),
+                _nmp.broadcast_to(_nmp.asarray(w * w)[:, None],
+                                  _idx.shape).reshape(-1))
+    _env = _env_full
+    if center:
+        _env = _env[n_fft // 2: _out_len - n_fft // 2]
+    if length is not None:
+        _env = _env[:length]
+    if _env.size and _nmp.abs(_env).min() < 1e-11:
+        raise ValueError(
+            "window/hop_length pair violates NOLA: overlap-add envelope "
+            "has (near-)zero entries; istft is not invertible")
+
+    def impl(spec):
+        if normalized:
+            spec = spec * jnp.sqrt(n_fft).astype(spec.real.dtype)
+        if onesided and not return_complex:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(spec, n=n_fft, axis=-2)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w[:, None]
+        num_frames = frames.shape[-1]
+        out_len = (num_frames - 1) * hop_length + n_fft
+        idx = _frame_idx(out_len, n_fft, hop_length)
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        # COLA normalization by the precomputed (compile-time) envelope
+        env = jnp.asarray(_env_full, w.dtype)
+        out = out / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            out = out[..., n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return dispatch("istft", impl, (x,), {})

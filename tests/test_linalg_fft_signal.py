@@ -1,0 +1,156 @@
+"""paddle_tpu.linalg / fft / signal parity vs numpy oracles.
+
+Mirrors the reference's spectral/linalg op tests
+(python/paddle/fluid/tests/unittests/test_spectral_op.py,
+test_signal.py, test_linalg_cond.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestLinalgNamespace:
+    def test_cond_2norm(self):
+        rng = np.random.RandomState(0)
+        a = rng.rand(4, 4).astype("float32") + 4 * np.eye(4, dtype="float32")
+        got = _np(paddle.linalg.cond(paddle.to_tensor(a)))
+        want = np.linalg.cond(a)
+        np.testing.assert_allclose(got, want, rtol=1e-3)
+
+    @pytest.mark.parametrize("p", ["fro", 1, np.inf])
+    def test_cond_other_norms(self, p):
+        rng = np.random.RandomState(1)
+        a = rng.rand(5, 5).astype("float64") + 5 * np.eye(5)
+        got = _np(paddle.linalg.cond(paddle.to_tensor(a), p=p))
+        want = np.linalg.cond(a, p=p)
+        np.testing.assert_allclose(got, want, rtol=1e-3)
+
+    def test_inv_det_namespace(self):
+        rng = np.random.RandomState(2)
+        a = rng.rand(3, 3).astype("float64") + 3 * np.eye(3)
+        np.testing.assert_allclose(
+            _np(paddle.linalg.inv(paddle.to_tensor(a))), np.linalg.inv(a),
+            rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.linalg.det(paddle.to_tensor(a))), np.linalg.det(a),
+            rtol=1e-3)
+
+
+class TestFFT:
+    def setup_method(self, m):
+        rng = np.random.RandomState(0)
+        self.x = rng.rand(4, 16).astype("float64")
+        self.z = (rng.rand(4, 16) + 1j * rng.rand(4, 16)).astype("complex128")
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_fft_ifft(self, norm):
+        got = _np(paddle.fft.fft(paddle.to_tensor(self.z), norm=norm))
+        np.testing.assert_allclose(got, np.fft.fft(self.z, norm=norm),
+                                   rtol=2e-4, atol=2e-4)
+        back = _np(paddle.fft.ifft(paddle.to_tensor(got), norm=norm))
+        np.testing.assert_allclose(back, self.z, rtol=2e-4, atol=2e-4)
+
+    def test_rfft_irfft(self):
+        got = _np(paddle.fft.rfft(paddle.to_tensor(self.x)))
+        np.testing.assert_allclose(got, np.fft.rfft(self.x),
+                                   rtol=2e-4, atol=2e-4)
+        back = _np(paddle.fft.irfft(paddle.to_tensor(got), n=16))
+        np.testing.assert_allclose(back, self.x, rtol=2e-4, atol=2e-4)
+
+    def test_hfft_ihfft(self):
+        spec = np.fft.ihfft(self.x[0])
+        got = _np(paddle.fft.hfft(paddle.to_tensor(spec), n=16))
+        np.testing.assert_allclose(got, np.fft.hfft(spec, n=16),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_fft2_fftn(self):
+        got = _np(paddle.fft.fft2(paddle.to_tensor(self.z)))
+        np.testing.assert_allclose(got, np.fft.fft2(self.z),
+                                   rtol=2e-4, atol=2e-4)
+        got = _np(paddle.fft.fftn(paddle.to_tensor(self.z)))
+        np.testing.assert_allclose(got, np.fft.fftn(self.z),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_rfft2(self):
+        got = _np(paddle.fft.rfft2(paddle.to_tensor(self.x)))
+        np.testing.assert_allclose(got, np.fft.rfft2(self.x),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_freq_shift(self):
+        np.testing.assert_allclose(_np(paddle.fft.fftfreq(16, d=0.5)),
+                                   np.fft.fftfreq(16, d=0.5))
+        np.testing.assert_allclose(_np(paddle.fft.rfftfreq(16)),
+                                   np.fft.rfftfreq(16))
+        got = _np(paddle.fft.fftshift(paddle.to_tensor(self.x)))
+        np.testing.assert_allclose(got, np.fft.fftshift(self.x, axes=None))
+        got = _np(paddle.fft.ifftshift(paddle.to_tensor(self.x), axes=[-1]))
+        np.testing.assert_allclose(got, np.fft.ifftshift(self.x, axes=-1))
+
+    def test_fft_grad(self):
+        # autograd flows through the dispatch tape
+        x = paddle.to_tensor(self.x.astype("float32"), stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        loss = (y.abs() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert x.grad.shape == list(self.x.shape)
+
+
+class TestSignal:
+    def test_frame_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 40).astype("float32")
+        fr = paddle.signal.frame(paddle.to_tensor(x), frame_length=8,
+                                 hop_length=4)
+        assert list(fr.shape) == [2, 8, 9]
+        # hop == frame_length → overlap_add is exact inverse
+        fr2 = paddle.signal.frame(paddle.to_tensor(x), frame_length=8,
+                                  hop_length=8)
+        back = paddle.signal.overlap_add(fr2, hop_length=8)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-6)
+
+    def test_frame_axis0(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(40, 2).astype("float32")
+        fr = paddle.signal.frame(paddle.to_tensor(x), frame_length=8,
+                                 hop_length=4, axis=0)
+        assert list(fr.shape) == [9, 8, 2]
+        np.testing.assert_allclose(_np(fr)[0], x[:8], rtol=1e-6)
+        np.testing.assert_allclose(_np(fr)[1], x[4:12], rtol=1e-6)
+
+    def test_overlap_add_accumulates(self):
+        frames = np.ones((4, 3), "float32")  # frame_length=4, 3 frames
+        out = paddle.signal.overlap_add(paddle.to_tensor(frames),
+                                        hop_length=2)
+        want = np.zeros(8, "float32")
+        for i in range(3):
+            want[2 * i: 2 * i + 4] += 1
+        np.testing.assert_allclose(_np(out), want)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(2, 256).astype("float32")
+        w = np.hanning(64).astype("float32")
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64,
+                                  hop_length=16,
+                                  window=paddle.to_tensor(w))
+        assert list(spec.shape) == [2, 33, 17]
+        back = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                   window=paddle.to_tensor(w), length=256)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-3, atol=1e-4)
+
+    def test_stft_matches_manual_dft(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(128).astype("float64")
+        spec = _np(paddle.signal.stft(paddle.to_tensor(x), n_fft=32,
+                                      hop_length=8, center=False))
+        # manual frame + rfft
+        frames = np.stack([x[i * 8: i * 8 + 32]
+                           for i in range((128 - 32) // 8 + 1)], axis=1)
+        want = np.fft.rfft(frames, axis=0)
+        np.testing.assert_allclose(spec, want, rtol=2e-4, atol=2e-4)
