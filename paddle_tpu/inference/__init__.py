@@ -1,0 +1,269 @@
+"""``paddle_tpu.inference`` — the deployment API.
+
+Reference parity: ``paddle/fluid/inference/api/analysis_predictor.h:86``
+(AnalysisPredictor), ``paddle_analysis_config.h`` (AnalysisConfig) and the
+Python veneer ``python/paddle/inference``.  TPU-first translation: the
+reference's IR-pass pipeline + NaiveExecutor collapse into an ahead-of-
+time XLA executable — artifacts are StableHLO functions serialized by
+``jax.export`` (written by ``paddle_tpu.jit.save`` or
+``paddle_tpu.static.save_inference_model``), so "optimize inference
+program" is literally the XLA compiler.  The Config knobs the reference
+routes to pass managers (ir optim, memory optim, TensorRT...) are
+accepted for API compatibility and recorded in ``Config.summary()``.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__
+
+
+class PrecisionType(enum.Enum):
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType(enum.Enum):
+    UNK = -1
+    CPU = 0
+    TPU = 1
+
+
+class Config:
+    """Inference configuration (reference AnalysisConfig).
+
+    Accepts either ``Config(prog_file, params_file)`` like the reference
+    or ``Config(path_prefix)`` pointing at a ``jit.save`` /
+    ``save_inference_model`` artifact pair (``<prefix>.pdmodel`` +
+    ``<prefix>.pdiparams``).
+    """
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self._prog_file = None
+        self._params_file = None
+        self.set_model(prog_file, params_file)
+        self._device = "tpu" if jax.default_backend() not in ("cpu",) \
+            else "cpu"
+        self._precision = PrecisionType.Float32
+        self._ir_optim = True
+        self._memory_optim = True
+        self._cpu_math_threads = 1
+        self._enable_profile = False
+
+    # -- model location (only the paths; other knobs are untouched) ----
+    def set_model(self, prog_file, params_file=None):
+        if prog_file is not None and params_file is None:
+            prefix = prog_file
+            if prefix.endswith(".pdmodel"):
+                prefix = prefix[: -len(".pdmodel")]
+            prog_file = prefix + ".pdmodel"
+            params_file = prefix + ".pdiparams"
+        self._prog_file = prog_file
+        self._params_file = params_file
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    def model_dir(self):
+        return os.path.dirname(self._prog_file or "")
+
+    # -- device selection ---------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # GPU does not exist on this stack; route to the accelerator
+        self.enable_tpu()
+
+    def enable_tpu(self, device_id: int = 0):
+        self._device = "tpu"
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return False
+
+    def use_tpu(self):
+        return self._device == "tpu"
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._cpu_math_threads = int(n)
+
+    def cpu_math_library_num_threads(self):
+        return self._cpu_math_threads
+
+    # -- optimization knobs (XLA always optimizes; recorded only) ------
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = bool(flag)
+
+    def enable_mkldnn(self):
+        pass
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def switch_use_feed_fetch_ops(self, flag: bool = False):
+        pass
+
+    def switch_specify_input_names(self, flag: bool = True):
+        pass
+
+    def set_precision(self, p: PrecisionType):
+        self._precision = p
+
+    def summary(self) -> str:
+        rows = [("model file", self._prog_file),
+                ("params file", self._params_file),
+                ("device", self._device),
+                ("precision", self._precision.name),
+                ("ir_optim (XLA)", self._ir_optim),
+                ("memory_optim", self._memory_optim),
+                ("cpu math threads", self._cpu_math_threads)]
+        width = max(len(k) for k, _ in rows)
+        return "\n".join(f"{k.ljust(width)}  {v}" for k, v in rows)
+
+
+class Tensor:
+    """Zero-copy-style IO handle (reference ZeroCopyTensor /
+    paddle_infer::Tensor): copy_from_cpu feeds, copy_to_cpu fetches."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[jnp.ndarray] = None
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = jnp.reshape(self._value, shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"output '{self.name}' has not been computed;"
+                               " call predictor.run() first")
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+    def type(self):
+        return str(self._value.dtype) if self._value is not None else "unset"
+
+
+class Predictor:
+    """Runs a serialized StableHLO inference artifact.
+
+    Reference call path (`analysis_predictor.cc:342` PrepareExecutor →
+    ZeroCopyRun) becomes: deserialize exported XLA function once, then
+    each ``run()`` executes the compiled program on the bound inputs.
+    """
+
+    def __init__(self, config: Config):
+        self._config = config
+        with open(config.params_file(), "rb") as f:
+            meta = pickle.load(f)
+        with open(config.prog_file(), "rb") as f:
+            blob = f.read()
+        if not blob:
+            raise RuntimeError(
+                f"model file {config.prog_file()} holds no serialized "
+                f"program (save-time error: {meta.get('export_error')})")
+        from jax import export as jax_export
+        self._exported = jax_export.deserialize(bytearray(blob))
+        self._meta = meta
+        self._kind = meta.get("kind", "layer")
+        if self._kind == "layer":
+            self._params = {k: jnp.asarray(v)
+                            for k, v in meta["params"].items()}
+            self._buffers = {k: jnp.asarray(v)
+                             for k, v in meta["buffers"].items()}
+            n_in = len(meta["input_avals"])
+            self._input_names = meta.get(
+                "feed_names", [f"input_{i}" for i in range(n_in)])
+        else:
+            self._params, self._buffers = None, None
+            self._input_names = list(meta["feed_names"])
+        self._output_names: List[str] = list(meta.get("fetch_names", []))
+        self._inputs: Dict[str, Tensor] = {n: Tensor(n)
+                                           for n in self._input_names}
+        self._outputs: Dict[str, Tensor] = {n: Tensor(n)
+                                            for n in self._output_names}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        if not self._output_names:
+            # unnamed single/tuple output artifact: materialized on run
+            return list(self._outputs)
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs but the model has "
+                    f"{len(self._input_names)}: {self._input_names}")
+            for n, arr in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(arr))
+        arrays = []
+        for n in self._input_names:
+            h = self._inputs[n]
+            if h._value is None:
+                raise RuntimeError(f"input '{n}' not set; call "
+                                   "get_input_handle(name).copy_from_cpu")
+            arrays.append(h._value)
+        if self._kind == "layer":
+            out = self._exported.call(self._params, self._buffers, *arrays)
+        else:
+            out = self._exported.call(*arrays)
+        flat = jax.tree_util.tree_leaves(out)
+        if not self._output_names:
+            self._output_names = [f"output_{i}" for i in range(len(flat))]
+            self._outputs = {n: Tensor(n) for n in self._output_names}
+        for n, v in zip(self._output_names, flat):
+            self._outputs[n]._value = v
+        if inputs is not None:
+            return [np.asarray(v) for v in flat]
+        return True
+
+    def clone(self):
+        return Predictor(self._config)
+
+    def clear_intermediate_tensor(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -162,13 +162,73 @@ def not_to_static(fn):
 # save / load: inference artifact via jax.export (StableHLO) — the
 # save_inference_model equivalent (reference fluid/io.py:1246)
 # ---------------------------------------------------------------------------
+def avals_for_export(shapes_dtypes):
+    """ShapeDtypeStructs for export, preserving dynamic dims (None/-1) as
+    jax.export symbolic dimensions in one shared scope so the artifact
+    accepts any batch size (reference: dynamic-batch save_inference_model).
+
+    Single source of truth for dim concretization — also used by
+    static/io.py; returns (symbolic_avals_or_None, concrete_avals)."""
+    from jax import export as jax_export
+    concrete = [jax.ShapeDtypeStruct(
+        tuple(1 if s in (None, -1) else int(s) for s in shape), dt)
+        for shape, dt in shapes_dtypes]
+    if not any(s in (None, -1) for shape, _ in shapes_dtypes for s in shape):
+        return None, concrete
+    try:
+        scope = jax_export.SymbolicScope()
+        symbolic, k = [], 0
+        for shape, dt in shapes_dtypes:
+            if any(s in (None, -1) for s in shape):
+                parts = []
+                for s in shape:
+                    if s in (None, -1):
+                        parts.append(f"dyn{k}")
+                        k += 1
+                    else:
+                        parts.append(str(int(s)))
+                shp = jax_export.symbolic_shape(", ".join(parts),
+                                                scope=scope)
+            else:
+                shp = tuple(int(s) for s in shape)
+            symbolic.append(jax.ShapeDtypeStruct(tuple(shp), dt))
+        return symbolic, concrete
+    except Exception:  # pragma: no cover - old jax without symbolic dims
+        return None, concrete
+
+
+def export_with_dynamic_dims(jitted, shapes_dtypes, *leading_args):
+    """jax.export `jitted`, trying symbolic (dynamic-dim) avals first and
+    falling back to concretized dims with a loud warning."""
+    import warnings
+    from jax import export as jax_export
+    symbolic, concrete = avals_for_export(shapes_dtypes)
+    if symbolic is not None:
+        try:
+            return jax_export.export(jitted)(*leading_args, *symbolic)
+        except Exception as e:
+            warnings.warn(
+                "dynamic-dim (symbolic shape) export failed "
+                f"({type(e).__name__}: {e}); falling back to concrete "
+                "dims — the artifact will only accept the concretized "
+                "shapes", UserWarning)
+    return jax_export.export(jitted)(*leading_args, *concrete)
+
+
 def save(layer, path, input_spec=None, **configs):
     """Serialize layer forward as StableHLO + params + pickle fallback."""
     if input_spec is None:
         raise ValueError("jit.save requires input_spec on the TPU path")
-    avals = [s.to_aval() if isinstance(s, InputSpec) else
-             jax.ShapeDtypeStruct(tuple(s.shape), s._data.dtype)
-             for s in input_spec]
+    shapes_dtypes = []
+    from ..core.dtype import dtype_to_jnp
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            shapes_dtypes.append((list(s.shape), dtype_to_jnp(s.dtype)))
+        else:
+            shapes_dtypes.append((list(s.shape), s._data.dtype))
+    avals = [jax.ShapeDtypeStruct(
+        tuple(1 if d in (None, -1) else int(d) for d in shape), dt)
+        for shape, dt in shapes_dtypes]
     layer.eval()
     params, buffers = layer.functional_state()
 
@@ -182,18 +242,20 @@ def save(layer, path, input_spec=None, **configs):
         return _tree_to_arrays(out)
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    meta = {"params": {k: np.asarray(v) for k, v in params.items()},
+    meta = {"kind": "layer",
+            "params": {k: np.asarray(v) for k, v in params.items()},
             "buffers": {k: np.asarray(v) for k, v in buffers.items()},
+            "feed_names": [getattr(s, "name", None) or f"input_{i}"
+                           for i, s in enumerate(input_spec)],
             "input_avals": [(list(a.shape), str(a.dtype)) for a in avals]}
     exported_bytes = None
     try:
-        from jax import export as jax_export
-        exp = jax_export.export(jax.jit(infer))(
+        exp = export_with_dynamic_dims(
+            jax.jit(infer), shapes_dtypes,
             {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in
              params.items()},
             {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in
-             buffers.items()},
-            *avals)
+             buffers.items()})
         exported_bytes = exp.serialize()
     except Exception as e:  # pragma: no cover - export unsupported path
         meta["export_error"] = str(e)

@@ -78,6 +78,9 @@ def overlap_add(x, hop_length, axis=-1, name=None):
 
 
 def _prep_window(window, win_length, n_fft, dtype):
+    if win_length > n_fft:
+        raise ValueError(
+            f"win_length ({win_length}) must be <= n_fft ({n_fft})")
     if window is None:
         w = jnp.ones((win_length,), dtype)
     else:

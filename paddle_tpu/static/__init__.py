@@ -10,6 +10,7 @@ from .mode import enable_static, disable_static, in_dynamic_mode  # noqa: F401
 from .program import (Program, default_main_program,  # noqa: F401
                       default_startup_program, program_guard, data,
                       Executor, CompiledProgram)
+from .io import save_inference_model, load_inference_model  # noqa: F401
 from ..jit import InputSpec  # noqa: F401
 from .. import nn as _nn  # re-export layer helpers commonly used in static
 

@@ -1,0 +1,97 @@
+"""Static-graph inference-model serialization.
+
+Reference parity: ``python/paddle/fluid/io.py:1246`` save_inference_model
+and ``:1550`` load_inference_model — there a pruned ProgramDesc + params;
+here an ahead-of-time XLA export (StableHLO via ``jax.export``) keyed by
+feed/fetch names, with parameters baked into the traced program as
+constants (inference weights are frozen, matching the reference's merged
+``__params__`` file).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from .program import Program, default_main_program, _DataPlaceholder
+
+__all__ = ["save_inference_model", "load_inference_model"]
+
+
+def _var_name(v):
+    return v if isinstance(v, str) else getattr(v, "name", None)
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, program: Optional[Program] = None,
+                         **configs):
+    """Export ``program`` as a deployable artifact pair
+    ``<prefix>.pdmodel`` (StableHLO) + ``<prefix>.pdiparams`` (meta).
+
+    ``program._build_fn(feed_dict)`` is traced with the feed placeholders'
+    declared shapes; fetch_vars select the outputs by name.
+    """
+    program = program or default_main_program()
+    if program._build_fn is None:
+        raise RuntimeError("program has no build function; assign "
+                           "program._build_fn or use paddle_tpu.jit.save")
+    feed_names = [_var_name(v) for v in feed_vars]
+    fetch_names = [_var_name(v) for v in fetch_vars]
+    shapes_dtypes = []
+    for v in feed_vars:
+        if isinstance(v, _DataPlaceholder):
+            shapes_dtypes.append((list(v.declared_shape), v._data.dtype))
+        else:
+            t = v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+            shapes_dtypes.append((list(t.shape), t._data.dtype))
+
+    def infer(*arrays):
+        with autograd.no_grad():
+            outs = program._build_fn(dict(zip(feed_names, arrays)))
+        result = []
+        for n in fetch_names:
+            v = outs[n] if isinstance(outs, dict) else outs
+            result.append(v._data if isinstance(v, Tensor) else jnp.asarray(v))
+        return tuple(result)
+
+    from ..jit import export_with_dynamic_dims
+    exp = export_with_dynamic_dims(jax.jit(infer), shapes_dtypes)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    meta = {"kind": "program", "feed_names": feed_names,
+            "fetch_names": fetch_names,
+            "input_avals": [(list(shape), str(dt))
+                            for shape, dt in shapes_dtypes]}
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+    return path_prefix
+
+
+def load_inference_model(path_prefix: str, executor=None, **configs):
+    """Returns ``[program, feed_target_names, fetch_targets]`` like the
+    reference; the program's build function runs the deserialized XLA
+    executable."""
+    from ..inference import Config, Predictor
+    predictor = Predictor(Config(path_prefix))
+    feed_names = predictor.get_input_names()
+    fetch_names = list(predictor._meta.get("fetch_names", []))
+
+    program = Program()
+
+    def build_fn(feed):
+        arrays = [np.asarray(
+            feed[n]._data if isinstance(feed[n], Tensor) else feed[n])
+            for n in feed_names]
+        flat = predictor.run(arrays)
+        names = fetch_names or predictor.get_output_names()
+        return {n: Tensor(jnp.asarray(v)) for n, v in zip(names, flat)}
+
+    program._build_fn = build_fn
+    return [program, feed_names, fetch_names]

@@ -1,0 +1,164 @@
+"""Inference/export path: jit.save artifacts driven through
+paddle_tpu.inference, and static save/load_inference_model roundtrip.
+
+Mirrors the reference's inference API tests
+(paddle/fluid/inference/tests/api/, python/paddle/inference).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture
+def artifact(tmp_path):
+    paddle.seed(0)
+    net = SmallNet()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([2, 8], "float32",
+                                                       name="x")])
+    x = np.random.RandomState(0).rand(2, 8).astype("float32")
+    want = np.asarray(net(paddle.to_tensor(x))._data)
+    return prefix, x, want
+
+
+class TestPredictor:
+    def test_config_summary(self, artifact):
+        prefix, _, _ = artifact
+        cfg = paddle.inference.Config(prefix)
+        cfg.switch_ir_optim(True)
+        cfg.enable_memory_optim()
+        cfg.set_cpu_math_library_num_threads(2)
+        s = cfg.summary()
+        assert "model file" in s and prefix in s
+
+    def test_predictor_handles(self, artifact):
+        prefix, x, want = artifact
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(prefix))
+        names = pred.get_input_names()
+        assert names == ["x"]
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(x)
+        assert pred.run() is True
+        out_names = pred.get_output_names()
+        got = pred.get_output_handle(out_names[0]).copy_to_cpu()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_predictor_run_list(self, artifact):
+        prefix, x, want = artifact
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(prefix))
+        outs = pred.run([x])
+        np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-6)
+
+    def test_predictor_clone(self, artifact):
+        prefix, x, want = artifact
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(prefix))
+        pred2 = pred.clone()
+        np.testing.assert_allclose(pred2.run([x])[0], want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_config_two_file_form(self, artifact):
+        prefix, x, want = artifact
+        cfg = paddle.inference.Config(prefix + ".pdmodel",
+                                      prefix + ".pdiparams")
+        pred = paddle.inference.create_predictor(cfg)
+        np.testing.assert_allclose(pred.run([x])[0], want, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestStaticInferenceModel:
+    def test_save_load_roundtrip(self, tmp_path):
+        paddle.seed(1)
+        net = SmallNet()
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x_ph = paddle.static.data("x", [4, 8], "float32")
+
+        def build_fn(feed):
+            x = paddle.to_tensor(feed["x"])
+            return {"out": net(x)}
+
+        prog._build_fn = build_fn
+        prefix = str(tmp_path / "static_model")
+        paddle.static.save_inference_model(prefix, [x_ph], ["out"],
+                                           program=prog)
+
+        x = np.random.RandomState(1).rand(4, 8).astype("float32")
+        want = np.asarray(net(paddle.to_tensor(x))._data)
+
+        loaded, feed_names, fetch_names = \
+            paddle.static.load_inference_model(prefix)
+        assert feed_names == ["x"] and fetch_names == ["out"]
+        exe = paddle.static.Executor()
+        out, = exe.run(loaded, feed={"x": x}, fetch_list=["out"])
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_loaded_artifact_through_predictor(self, tmp_path):
+        paddle.seed(2)
+        net = SmallNet()
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x_ph = paddle.static.data("inp", [3, 8], "float32")
+        prog._build_fn = lambda feed: {"y": net(paddle.to_tensor(
+            feed["inp"]))}
+        prefix = str(tmp_path / "m2")
+        paddle.static.save_inference_model(prefix, [x_ph], ["y"],
+                                           program=prog)
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(prefix))
+        assert pred.get_input_names() == ["inp"]
+        x = np.random.RandomState(2).rand(3, 8).astype("float32")
+        want = np.asarray(net(paddle.to_tensor(x))._data)
+        np.testing.assert_allclose(pred.run([x])[0], want, rtol=1e-5,
+                                   atol=1e-6)
+        assert pred.get_output_names() == ["y"]
+
+
+class TestDynamicBatchExport:
+    def test_jit_save_symbolic_batch(self, tmp_path):
+        paddle.seed(3)
+        net = SmallNet()
+        prefix = str(tmp_path / "dyn")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([-1, 8], "float32", name="x")])
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(prefix))
+        for bs in (1, 5, 13):
+            x = np.random.RandomState(bs).rand(bs, 8).astype("float32")
+            want = np.asarray(net(paddle.to_tensor(x))._data)
+            np.testing.assert_allclose(pred.run([x])[0], want, rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_run_arity_mismatch_raises(self, tmp_path):
+        paddle.seed(4)
+        net = SmallNet()
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([2, 8], "float32", name="x")])
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(prefix))
+        with pytest.raises(ValueError):
+            pred.run([])
+
+    def test_set_model_preserves_knobs(self):
+        cfg = paddle.inference.Config()
+        cfg.set_cpu_math_library_num_threads(8)
+        cfg.switch_ir_optim(False)
+        cfg.set_model("whatever")
+        assert cfg.cpu_math_library_num_threads() == 8
+        assert not cfg.ir_optim()
