@@ -55,10 +55,14 @@ from . import inference  # noqa: F401
 from . import vision  # noqa: F401
 from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
+from . import distribution  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import device  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler as profiler_mod  # noqa: F401
 from . import utils  # noqa: F401
 
+from .nn.param_attr import ParamAttr  # noqa: F401
 from .framework_io import save, load  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi import summary, flops  # noqa: F401

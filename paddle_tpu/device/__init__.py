@@ -1,0 +1,73 @@
+"""Device management namespace (``paddle.device`` parity).
+
+Reference parity: ``python/paddle/device/__init__.py`` — set_device
+(:182), get_device (:209), is_compiled_with_* probes (:41,:56), plus the
+``paddle.device.cuda`` submodule (mirrored here as ``tpu``).
+
+TPU-first: devices are PJRT devices enumerated by JAX; ``set_device``
+pins the default placement used by eager tensor creation.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (  # noqa: F401
+    CPUPlace, TPUPlace, CUDAPinnedPlace, set_device, get_device,
+    device_count, is_compiled_with_tpu,
+)
+
+__all__ = [
+    "set_device", "get_device", "device_count", "get_all_device_type",
+    "get_all_custom_device_type", "get_available_device",
+    "get_available_custom_device", "is_compiled_with_tpu",
+    "is_compiled_with_cuda", "is_compiled_with_npu", "is_compiled_with_xpu",
+    "get_cudnn_version", "XPUPlace", "CPUPlace", "TPUPlace",
+    "CUDAPinnedPlace", "synchronize",
+]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def get_cudnn_version():
+    return None
+
+
+def XPUPlace(dev_id):
+    raise RuntimeError(
+        "paddle_tpu is not compiled with XPU; use set_device('tpu')")
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes
+    (reference ``device/cuda/__init__.py`` synchronize; PJRT analog)."""
+    for d in jax.devices():
+        try:
+            jax.block_until_ready(jax.device_put(0, d))
+        except Exception:
+            pass

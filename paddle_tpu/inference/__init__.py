@@ -183,8 +183,22 @@ class Predictor:
     each ``run()`` executes the compiled program on the bound inputs.
     """
 
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, _shared_from: "Predictor" = None):
         self._config = config
+        if _shared_from is not None:
+            # clone(): share the immutable exported program + device weights
+            # (reference AnalysisPredictor::Clone shares program/params too);
+            # only the per-predictor input/output handles are fresh.
+            src = _shared_from
+            self._exported = src._exported
+            self._meta = src._meta
+            self._kind = src._kind
+            self._params, self._buffers = src._params, src._buffers
+            self._input_names = list(src._input_names)
+            self._output_names = list(src._output_names)
+            self._inputs = {n: Tensor(n) for n in self._input_names}
+            self._outputs = {n: Tensor(n) for n in self._output_names}
+            return
         with open(config.params_file(), "rb") as f:
             meta = pickle.load(f)
         with open(config.prog_file(), "rb") as f:
@@ -195,19 +209,20 @@ class Predictor:
                 f"program (save-time error: {meta.get('export_error')})")
         from jax import export as jax_export
         self._exported = jax_export.deserialize(bytearray(blob))
-        self._meta = meta
         self._kind = meta.get("kind", "layer")
         if self._kind == "layer":
+            # pop the numpy weight copies so only the jnp versions stay live
             self._params = {k: jnp.asarray(v)
-                            for k, v in meta["params"].items()}
+                            for k, v in meta.pop("params").items()}
             self._buffers = {k: jnp.asarray(v)
-                             for k, v in meta["buffers"].items()}
+                             for k, v in meta.pop("buffers").items()}
             n_in = len(meta["input_avals"])
             self._input_names = meta.get(
                 "feed_names", [f"input_{i}" for i in range(n_in)])
         else:
             self._params, self._buffers = None, None
             self._input_names = list(meta["feed_names"])
+        self._meta = meta  # small after the weight pops above
         self._output_names: List[str] = list(meta.get("fetch_names", []))
         self._inputs: Dict[str, Tensor] = {n: Tensor(n)
                                            for n in self._input_names}
@@ -259,7 +274,7 @@ class Predictor:
         return True
 
     def clone(self):
-        return Predictor(self._config)
+        return Predictor(self._config, _shared_from=self)
 
     def clear_intermediate_tensor(self):
         pass

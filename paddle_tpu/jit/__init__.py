@@ -226,9 +226,6 @@ def save(layer, path, input_spec=None, **configs):
             shapes_dtypes.append((list(s.shape), dtype_to_jnp(s.dtype)))
         else:
             shapes_dtypes.append((list(s.shape), s._data.dtype))
-    avals = [jax.ShapeDtypeStruct(
-        tuple(1 if d in (None, -1) else int(d) for d in shape), dt)
-        for shape, dt in shapes_dtypes]
     layer.eval()
     params, buffers = layer.functional_state()
 
@@ -247,7 +244,12 @@ def save(layer, path, input_spec=None, **configs):
             "buffers": {k: np.asarray(v) for k, v in buffers.items()},
             "feed_names": [getattr(s, "name", None) or f"input_{i}"
                            for i, s in enumerate(input_spec)],
-            "input_avals": [(list(a.shape), str(a.dtype)) for a in avals]}
+            # record the *declared* dims (dynamic stays -1) so artifact
+            # consumers see the true accepted shapes, not the fallback
+            # concretization (which avals_for_export owns)
+            "input_avals": [([-1 if d in (None, -1) else int(d)
+                              for d in shape], str(np.dtype(dt)))
+                            for shape, dt in shapes_dtypes]}
     exported_bytes = None
     try:
         exp = export_with_dynamic_dims(

@@ -28,19 +28,24 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
            "Adagrad", "Adadelta", "RMSProp", "Lamb"]
 
 
-class L2Decay:
-    """weight_decay coefficient wrapper (reference regularizer.L2Decay)."""
-
-    def __init__(self, coeff=0.0):
-        self.coeff = float(coeff)
+from ..regularizer import L1Decay, L2Decay, WeightDecayRegularizer
 
 
 def _wd_coeff(weight_decay):
     if weight_decay is None:
         return 0.0
-    if isinstance(weight_decay, L2Decay):
+    if isinstance(weight_decay, WeightDecayRegularizer):
         return weight_decay.coeff
     return float(weight_decay)
+
+
+def _wd_reg(weight_decay):
+    """Normalize the weight_decay argument to a regularizer (or None)."""
+    if weight_decay is None:
+        return None
+    if isinstance(weight_decay, WeightDecayRegularizer):
+        return weight_decay
+    return L2Decay(float(weight_decay))
 
 
 class Optimizer:
@@ -54,6 +59,7 @@ class Optimizer:
         self._parameter_list = parameters
         self._learning_rate = learning_rate
         self._weight_decay = _wd_coeff(weight_decay)
+        self._weight_decay_reg = _wd_reg(weight_decay)
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
         self._state: Dict[int, Dict[str, jnp.ndarray]] = {}
@@ -115,9 +121,11 @@ class Optimizer:
             parr = self._master_weights.get(key, p._data)
             garr = garr.astype(parr.dtype)
             lr_eff = lr * p.optimize_attr.get("learning_rate", 1.0)
-            if self._weight_decay and self._coupled_weight_decay and \
-                    p.regularizer is None:
-                garr = garr + self._weight_decay * parr
+            reg = p.regularizer if p.regularizer is not None \
+                else (self._weight_decay_reg if self._coupled_weight_decay
+                      else None)
+            if reg is not None and reg.coeff:
+                garr = garr + reg.grad(parr)
             new_p, new_state = self._update(parr, garr, state, lr_eff)
             if key in self._master_weights:
                 self._master_weights[key] = new_p
@@ -145,6 +153,14 @@ class Optimizer:
     # -- functional bridge (jit path) --------------------------------------
     def functional_init(self, params: Dict[str, jnp.ndarray]):
         """Build an optimizer state pytree for the jitted train step."""
+        # Match functional param names to live Parameter objects (by array
+        # identity — functional_state hands out p._data unchanged) so the
+        # jitted path honors per-parameter ParamAttr regularizers exactly
+        # like the eager step() does.
+        by_id = {id(p._data): p for p in (self._parameter_list or [])}
+        self._fn_regularizers = {
+            n: by_id[id(a)].regularizer for n, a in params.items()
+            if id(a) in by_id and by_id[id(a)].regularizer is not None}
         state = {n: self._init_state_for(
             a.astype(jnp.float32) if self._multi_precision and
             a.dtype in (jnp.bfloat16, jnp.float16) else a)
@@ -172,8 +188,11 @@ class Optimizer:
                 continue
             parr = master.get(n, params[n])
             g = g.astype(parr.dtype)
-            if self._weight_decay and self._coupled_weight_decay:
-                g = g + self._weight_decay * parr
+            reg = getattr(self, "_fn_regularizers", {}).get(
+                n, self._weight_decay_reg if self._coupled_weight_decay
+                else None)
+            if reg is not None and reg.coeff:
+                g = g + reg.grad(parr)
             new_p, slots[n] = self._update(parr, g, slots[n], lr)
             if n in master:
                 master[n] = new_p

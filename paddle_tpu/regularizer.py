@@ -1,0 +1,48 @@
+"""Weight-decay regularizers (``paddle.regularizer`` parity).
+
+Reference parity: ``python/paddle/regularizer.py`` — ``L1Decay`` (:20),
+``L2Decay`` (:82).  A regularizer may be set globally through the
+optimizer's ``weight_decay`` argument or per-parameter via
+``ParamAttr(regularizer=...)``; the per-parameter setting wins
+(reference fluid/regularizer.py append_regularization_ops semantics).
+
+TPU-first: rather than appending regularization *ops* to a program, the
+decay is a pure function folded into the gradient inside the (jitted)
+optimizer update — XLA fuses it into the parameter-update kernel.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    coeff: float = 0.0
+
+    def grad(self, param: jnp.ndarray) -> jnp.ndarray:
+        """Gradient contribution d(penalty)/d(param)."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 penalty coeff * sum|w|  (reference ``regularizer.py:20``)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def grad(self, param):
+        return self.coeff * jnp.sign(param)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 penalty 0.5 * coeff * sum(w^2)  (reference ``regularizer.py:82``)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def grad(self, param):
+        return self.coeff * param

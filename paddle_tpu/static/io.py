@@ -54,9 +54,16 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
     def infer(*arrays):
         with autograd.no_grad():
             outs = program._build_fn(dict(zip(feed_names, arrays)))
+        if not isinstance(outs, dict):
+            seq = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+            if len(seq) != len(fetch_names):
+                raise ValueError(
+                    f"build_fn returned {len(seq)} outputs but "
+                    f"{len(fetch_names)} fetch_vars were requested")
+            outs = dict(zip(fetch_names, seq))
         result = []
         for n in fetch_names:
-            v = outs[n] if isinstance(outs, dict) else outs
+            v = outs[n]
             result.append(v._data if isinstance(v, Tensor) else jnp.asarray(v))
         return tuple(result)
 

@@ -1,0 +1,102 @@
+"""paddle.distribution parity tests (reference: python/paddle/distribution.py
+validated against scipy-free numpy oracles)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Categorical, Normal, Uniform
+
+
+def test_uniform_density():
+    u = Uniform(low=1.0, high=3.0)
+    np.testing.assert_allclose(u.probs(2.0).numpy(), 0.5, rtol=1e-6)
+    np.testing.assert_allclose(u.log_prob(2.0).numpy(), np.log(0.5), rtol=1e-6)
+    assert u.probs(5.0).numpy() == 0.0
+    np.testing.assert_allclose(u.entropy().numpy(), np.log(2.0), rtol=1e-6)
+    s = u.sample([1000])
+    arr = s.numpy()
+    assert arr.shape == (1000,)
+    assert (arr >= 1.0).all() and (arr < 3.0).all()
+
+
+def test_uniform_batched():
+    u = Uniform(low=[0.0, 1.0], high=[1.0, 3.0])
+    s = u.sample([5])
+    assert tuple(s.shape) == (5, 2)
+    p = u.probs([0.5, 2.0]).numpy()
+    np.testing.assert_allclose(p, [1.0, 0.5], rtol=1e-6)
+
+
+def test_normal_density_entropy_kl():
+    n = Normal(loc=0.0, scale=2.0)
+    x = np.array([0.0, 1.0, -2.0], np.float32)
+    expect = -0.5 * (x / 2.0) ** 2 - np.log(2.0) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(n.log_prob(x).numpy(), expect, rtol=1e-5)
+    np.testing.assert_allclose(
+        n.entropy().numpy(), 0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0),
+        rtol=1e-6)
+    m = Normal(loc=1.0, scale=1.0)
+    # analytic KL(N(0,2) || N(1,1)) = log(1/2) + (4 + 1)/2 - 0.5
+    expect_kl = np.log(0.5) + (4.0 + 1.0) / 2.0 - 0.5
+    np.testing.assert_allclose(n.kl_divergence(m).numpy(), expect_kl, rtol=1e-5)
+    s = n.sample([2000])
+    assert abs(float(np.mean(s.numpy()))) < 0.2
+
+
+def test_normal_sample_reparam_grad():
+    loc = paddle.to_tensor(0.5, stop_gradient=False)
+    n = Normal(loc=loc, scale=1.0)
+    s = n.sample([16])
+    loss = paddle.sum(s)
+    loss.backward()
+    np.testing.assert_allclose(loc.grad.numpy(), 16.0, rtol=1e-5)
+
+
+def test_categorical():
+    logits = np.log(np.array([0.1, 0.2, 0.7], np.float32))
+    c = Categorical(logits)
+    np.testing.assert_allclose(
+        c.entropy().numpy(),
+        -(0.1 * np.log(0.1) + 0.2 * np.log(0.2) + 0.7 * np.log(0.7)),
+        rtol=1e-5)
+    np.testing.assert_allclose(c.probs(np.array([2])).numpy(), [0.7], rtol=1e-5)
+    np.testing.assert_allclose(
+        c.log_prob(np.array([0])).numpy(), [np.log(0.1)], rtol=1e-5)
+    c2 = Categorical(np.zeros(3, np.float32))
+    kl = c.kl_divergence(c2).numpy()
+    expect = np.sum([p * (np.log(p) - np.log(1 / 3))
+                     for p in (0.1, 0.2, 0.7)])
+    np.testing.assert_allclose(kl, expect, rtol=1e-5)
+    paddle.seed(0)
+    draws = c.sample([4000]).numpy()
+    assert draws.shape == (4000,)
+    frac2 = (draws == 2).mean()
+    assert 0.6 < frac2 < 0.8
+
+
+def test_regularizer_in_optimizer():
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+    from paddle_tpu.core.tensor import Parameter
+    prm = Parameter(np.array([2.0, -4.0], np.float32))
+    prm.regularizer = L2Decay(0.5)
+    prm._accumulate_grad(np.zeros(2, np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[prm])
+    opt.step()
+    # grad 0 + 0.5*w -> new w = w - 0.5*w = 0.5*w
+    np.testing.assert_allclose(prm.numpy(), [1.0, -2.0], rtol=1e-6)
+
+    prm2 = Parameter(np.array([2.0, -4.0], np.float32))
+    prm2._accumulate_grad(np.zeros(2, np.float32))
+    opt2 = paddle.optimizer.SGD(learning_rate=1.0, parameters=[prm2],
+                                weight_decay=L1Decay(0.5))
+    opt2.step()
+    # grad 0 + 0.5*sign(w) -> w - 0.5*sign(w)
+    np.testing.assert_allclose(prm2.numpy(), [1.5, -3.5], rtol=1e-6)
+
+
+def test_device_namespace():
+    assert paddle.device.get_device() in ("cpu", "tpu:0") or \
+        ":" in paddle.device.get_device()
+    assert not paddle.device.is_compiled_with_cuda()
+    assert "cpu" in paddle.device.get_all_device_type()
+    paddle.device.synchronize()
