@@ -56,6 +56,8 @@ from . import vision  # noqa: F401
 from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distribution  # noqa: F401
+from . import text  # noqa: F401
+from . import hub  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import device  # noqa: F401
 from . import incubate  # noqa: F401

@@ -1,0 +1,67 @@
+"""Model hub (``paddle.hub`` parity).
+
+Reference parity: ``python/paddle/hub.py`` — list/help/load entry points
+resolved from a ``hubconf.py`` in a repo.  Zero-egress image: the
+``github``/``gitee`` sources raise with a clear message; ``local`` source
+(a directory containing ``hubconf.py``) is fully supported.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}; expected local/github/gitee")
+    if source != "local":
+        raise RuntimeError(
+            "remote hub sources need network access, unavailable in this "
+            "build; clone the repo and use source='local'")
+    return _load_hubconf(os.path.expanduser(repo_dir))
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf
+    (reference ``hub.py`` list)."""
+    mod = _resolve(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """Docstring of a hub entrypoint (reference ``hub.py`` help)."""
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"entrypoint {model!r} not found in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Instantiate a hub entrypoint (reference ``hub.py`` load)."""
+    mod = _resolve(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"entrypoint {model!r} not found in hubconf")
+    return fn(**kwargs)

@@ -1,0 +1,209 @@
+"""paddle.text.datasets (reference python/paddle/text/datasets/).
+
+Zero-egress image: the reference datasets stream from public mirrors; here
+each dataset yields a deterministic synthetic corpus with the exact field
+structure, dtypes and vocabulary sizes the reference documents, so NLP
+pipelines (embedding lookup, padding, bucketing, seq2seq feed) exercise
+end-to-end.  ``UCIHousing`` additionally parses a locally provided
+``data_file`` (whitespace float table); the archive-format corpora raise
+if one is passed rather than silently ignoring it.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
+
+
+class _SyntheticCorpus(Dataset):
+    """Deterministic pool re-indexed to the advertised length."""
+
+    _n_train = 2000
+    _n_test = 400
+    _pool = 512
+
+    _MODE_SEED = {"train": 0, "test": 1, "dev": 2}
+
+    def __init__(self, mode="train", data_file=None):
+        if mode not in self._MODE_SEED:
+            raise ValueError(f"mode must be train/test/dev, got {mode}")
+        if data_file is not None:
+            raise NotImplementedError(
+                f"{type(self).__name__} cannot parse the reference archive "
+                "format offline; omit data_file to use the synthetic corpus")
+        self.mode = mode
+        self.data_file = data_file
+        self._len = self._n_train if mode == "train" else self._n_test
+        self._rng = np.random.RandomState(self._MODE_SEED[mode])
+        self._samples = [self._make(self._rng) for _ in range(self._pool)]
+
+    def _make(self, rng):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        return self._samples[idx % self._pool]
+
+    def __len__(self):
+        return self._len
+
+
+class UCIHousing(_SyntheticCorpus):
+    """13 float features -> house price (reference
+    ``text/datasets/uci_housing.py``)."""
+
+    _n_train = 404
+    _n_test = 102
+
+    def __init__(self, mode="train", data_file=None):
+        if data_file is not None:
+            # the UCI format is a plain whitespace float table: parse it
+            table = np.loadtxt(os.path.expanduser(data_file),
+                               dtype=np.float32)
+            if table.ndim != 2 or table.shape[1] != 14:
+                raise ValueError(
+                    f"expected a [N, 14] float table in {data_file}, got "
+                    f"shape {table.shape}")
+            split = int(len(table) * 0.8)
+            rows = table[:split] if mode == "train" else table[split:]
+            self.mode = mode
+            self.data_file = data_file
+            self._samples = [(r[:13], r[13:14]) for r in rows]
+            self._pool = len(self._samples)
+            self._len = len(self._samples)
+            return
+        super().__init__(mode=mode)
+
+    def _make(self, rng):
+        x = rng.rand(13).astype("float32")
+        y = np.asarray([float(x.sum() / 13.0 * 50.0)], "float32")
+        return x, y
+
+
+class Imdb(_SyntheticCorpus):
+    """Movie-review word-id sequence -> binary sentiment (reference
+    ``text/datasets/imdb.py``; vocab ~5147)."""
+
+    word_idx_size = 5147
+
+    def _make(self, rng):
+        n = rng.randint(16, 128)
+        doc = rng.randint(0, self.word_idx_size, size=(n,)).astype("int64")
+        label = np.asarray(int(doc[0] % 2), "int64")
+        return doc, label
+
+    @property
+    def word_idx(self):
+        # spans the full vocab so nn.Embedding(len(ds.word_idx), D) covers
+        # every id a sample can contain
+        return {f"w{i}": i for i in range(self.word_idx_size)}
+
+
+class Imikolov(_SyntheticCorpus):
+    """PTB-style n-gram tuples (reference ``text/datasets/imikolov.py``)."""
+
+    def __init__(self, mode="train", data_type="NGRAM", window_size=5,
+                 min_word_freq=50, data_file=None):
+        self.data_type = data_type
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        self.word_idx_size = 2074
+        super().__init__(mode=mode, data_file=data_file)
+
+    def _make(self, rng):
+        if self.data_type.upper() == "NGRAM":
+            return tuple(rng.randint(0, self.word_idx_size)
+                         for _ in range(self.window_size))
+        n = rng.randint(4, 32)
+        seq = rng.randint(0, self.word_idx_size, size=(n,)).astype("int64")
+        return seq[:-1], seq[1:]
+
+
+class Movielens(_SyntheticCorpus):
+    """(user features, movie features, rating) tuples (reference
+    ``text/datasets/movielens.py``)."""
+
+    max_user_id = 6040
+    max_movie_id = 3952
+
+    def _make(self, rng):
+        user_id = np.asarray(rng.randint(1, self.max_user_id), "int64")
+        gender = np.asarray(rng.randint(0, 2), "int64")
+        age = np.asarray(rng.randint(0, 7), "int64")
+        job = np.asarray(rng.randint(0, 21), "int64")
+        movie_id = np.asarray(rng.randint(1, self.max_movie_id), "int64")
+        categories = rng.randint(0, 19, size=(rng.randint(1, 4),)).astype(
+            "int64")
+        title = rng.randint(0, 5175, size=(rng.randint(1, 10),)).astype(
+            "int64")
+        rating = np.asarray([float(rng.randint(1, 6))], "float32")
+        return (user_id, gender, age, job, movie_id, categories, title,
+                rating)
+
+
+class Conll05st(_SyntheticCorpus):
+    """SRL fields: word/predicate/ctx windows/mark -> label seq (reference
+    ``text/datasets/conll05.py``)."""
+
+    word_dict_size = 44068
+    label_dict_size = 106
+    predicate_dict_size = 3162
+
+    def _make(self, rng):
+        n = rng.randint(8, 64)
+        words = rng.randint(0, self.word_dict_size, size=(n,)).astype("int64")
+        predicate = np.full((n,), rng.randint(0, self.predicate_dict_size),
+                            "int64")
+        ctx = [rng.randint(0, self.word_dict_size, size=(n,)).astype("int64")
+               for _ in range(4)]
+        mark = (rng.rand(n) < 0.2).astype("int64")
+        label = rng.randint(0, self.label_dict_size, size=(n,)).astype(
+            "int64")
+        return (words, predicate, *ctx, mark, label)
+
+    def get_dict(self):
+        return ({f"w{i}": i for i in range(self.word_dict_size)},
+                {f"p{i}": i for i in range(self.predicate_dict_size)},
+                {f"l{i}": i for i in range(self.label_dict_size)})
+
+
+class _WMT(_SyntheticCorpus):
+    """src ids, trg ids (shifted), trg ids (next) triples."""
+
+    def __init__(self, mode="train", src_dict_size=30000, trg_dict_size=30000,
+                 lang="en", data_file=None):
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.lang = lang
+        super().__init__(mode=mode, data_file=data_file)
+
+    def _make(self, rng):
+        n_src = rng.randint(4, 50)
+        n_trg = rng.randint(4, 50)
+        src = rng.randint(0, self.src_dict_size, size=(n_src,)).astype(
+            "int64")
+        trg = rng.randint(0, self.trg_dict_size, size=(n_trg,)).astype(
+            "int64")
+        trg_in = np.concatenate([[0], trg[:-1]]).astype("int64")  # <s> shift
+        return src, trg_in, trg
+
+    def get_dict(self, reverse=False):
+        d = {f"tok{i}": i for i in range(self.src_dict_size)}
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+class WMT14(_WMT):
+    """WMT14 en-fr (reference ``text/datasets/wmt14.py`` — single
+    ``dict_size`` shared by both sides)."""
+
+    def __init__(self, mode="train", dict_size=30000, data_file=None):
+        super().__init__(mode=mode, src_dict_size=dict_size,
+                         trg_dict_size=dict_size, data_file=data_file)
+
+
+class WMT16(_WMT):
+    """WMT16 en-de (reference ``text/datasets/wmt16.py``)."""

@@ -1,0 +1,106 @@
+"""Viterbi decoding (reference python/paddle/text/viterbi_decode.py:23,87).
+
+TPU-first: the reference implements this as a C++/CUDA ``viterbi_decode``
+op; here the whole dynamic program is a ``lax.scan`` over the time axis —
+one fused XLA loop (forward max-product + backpointer record) and a second
+reverse scan for the backtrace, all batched over [B].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi_arrays(potentials, transitions, lengths, include_bos_eos_tag):
+    B, T, N = potentials.shape
+    lengths = lengths.astype(jnp.int32)
+
+    alpha0 = potentials[:, 0, :]
+    if include_bos_eos_tag:
+        # last row of transitions = start tag -> tag scores
+        alpha0 = alpha0 + transitions[N - 1][None, :]
+
+    def fwd(carry, xs):
+        alpha, t = carry
+        emit = xs  # [B, N]
+        # score[b, prev, cur] = alpha[b, prev] + transitions[prev, cur]
+        scores = alpha[:, :, None] + transitions[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+        alpha_new = jnp.max(scores, axis=1) + emit        # [B, N]
+        active = (t < lengths)[:, None]                   # step t is real
+        alpha = jnp.where(active, alpha_new, alpha)
+        best_prev = jnp.where(active, best_prev,
+                              jnp.arange(N)[None, :])     # identity carry
+        return (alpha, t + 1), best_prev
+
+    (alpha, _), backptrs = lax.scan(
+        fwd, (alpha0, jnp.int32(1)),
+        jnp.moveaxis(potentials[:, 1:, :], 1, 0))          # [T-1, B, N]
+
+    if include_bos_eos_tag:
+        # second-to-last column = tag -> stop transition
+        alpha = alpha + transitions[:, N - 2][None, :]
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [B]
+
+    def bwd(tag, xs):
+        bp, t = xs                                         # bp: [B, N]
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # only follow the pointer while inside the sequence
+        tag = jnp.where(t < lengths, prev.astype(jnp.int32), tag)
+        return tag, tag
+
+    ts = jnp.arange(1, T, dtype=jnp.int32)
+    _, rev_tags = lax.scan(bwd, last_tag, (backptrs[::-1], ts[::-1]))
+    # rev_tags[k] = tag at time T-2-k ; full path = tags..., last position
+    # of each row is the tag at its (length-1) step, carried to the right.
+    path = jnp.concatenate([rev_tags[::-1],
+                            last_tag[None, :]], axis=0)    # [T, B]
+    path = jnp.moveaxis(path, 0, 1)                        # [B, T]
+    # zero out the positions beyond each sequence's length
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    path = jnp.where(mask, path, 0)
+    # x64 is off framework-wide: int64 canonicalizes to int32
+    return scores, path.astype(jnp.int32)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag sequence (reference ``viterbi_decode.py:23``).
+
+    Args mirror the reference: ``potentials`` [B, T, N] unary emissions,
+    ``transition_params`` [N, N], ``lengths`` [B].  Returns
+    ``(scores [B], paths [B, T])``.
+    """
+    potentials = to_tensor(potentials)
+    transition_params = to_tensor(transition_params)
+    lengths = to_tensor(lengths)
+
+    def _fn(p, t, l):
+        return _viterbi_arrays(p, t, l, include_bos_eos_tag)
+
+    out = dispatch("viterbi_decode", _fn,
+                   (potentials, transition_params, lengths), {})
+    return out
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (reference ``viterbi_decode.py:87``)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = to_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+        self.name = name
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag, self.name)

@@ -8,3 +8,9 @@ from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .alexnet import AlexNet, alexnet  # noqa: F401
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,  # noqa: F401
+                       densenet201, densenet264)
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
+from .resnext import (ResNeXt, resnext50_32x4d, resnext50_64x4d,  # noqa: F401
+                      resnext101_32x4d, resnext101_64x4d, resnext152_32x4d,
+                      resnext152_64x4d)

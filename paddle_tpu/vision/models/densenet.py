@@ -1,0 +1,139 @@
+"""DenseNet family (reference python/paddle/vision/models/densenet.py).
+
+Dense blocks concatenate every prior feature map; on TPU the concats are
+pure layout ops XLA folds into the following 1x1 conv's MXU matmul.
+"""
+from __future__ import annotations
+
+from ... import ops as P
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_ARCH = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return P.concat([x, out], axis=1)
+
+
+class _DenseBlock(nn.Layer):
+    def __init__(self, num_layers, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            _DenseLayer(in_c + i * growth_rate, growth_rate, bn_size, dropout)
+            for i in range(num_layers)])
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c)
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    """DenseNet model (reference ``vision/models/densenet.py`` DenseNet)."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in _ARCH:
+            raise ValueError(f"layers must be one of {sorted(_ARCH)}")
+        num_init, growth_rate, block_cfg = _ARCH[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv0 = nn.Conv2D(3, num_init, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.bn0 = nn.BatchNorm2D(num_init)
+        self.relu = nn.ReLU()
+        self.pool0 = nn.MaxPool2D(3, stride=2, padding=1)
+
+        blocks, chans = [], num_init
+        for i, n in enumerate(block_cfg):
+            blocks.append(_DenseBlock(n, chans, growth_rate, bn_size,
+                                      dropout))
+            chans += n * growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(chans, chans // 2))
+                chans //= 2
+        self.blocks = nn.LayerList(blocks)
+        self.bn_last = nn.BatchNorm2D(chans)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(chans, num_classes)
+
+    def forward(self, x):
+        x = self.pool0(self.relu(self.bn0(self.conv0(x))))
+        for b in self.blocks:
+            x = b(x)
+        x = self.relu(self.bn_last(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = P.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, pretrained=False, **kwargs):
+    model = DenseNet(layers=layers, **kwargs)
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require paddle.hub connectivity")
+    return model
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

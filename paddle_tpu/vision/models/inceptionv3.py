@@ -1,0 +1,181 @@
+"""Inception v3 (reference python/paddle/vision/models/inceptionv3.py).
+
+The factorized 1xN/Nx1 convolutions map to skinny MXU matmuls; XLA fuses
+the branch concats into the consumers.
+"""
+from __future__ import annotations
+
+from ... import ops as P
+from ... import nn
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b5_1 = _ConvBN(in_c, 48, 1)
+        self.b5_2 = _ConvBN(48, 64, 5, padding=2)
+        self.b3_1 = _ConvBN(in_c, 64, 1)
+        self.b3_2 = _ConvBN(64, 96, 3, padding=1)
+        self.b3_3 = _ConvBN(96, 96, 3, padding=1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(in_c, pool_features, 1)
+
+    def forward(self, x):
+        return P.concat([
+            self.b1(x),
+            self.b5_2(self.b5_1(x)),
+            self.b3_3(self.b3_2(self.b3_1(x))),
+            self.bp(self.pool(x))], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    """Grid reduction 35x35 -> 17x17."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBN(in_c, 384, 3, stride=2)
+        self.bd_1 = _ConvBN(in_c, 64, 1)
+        self.bd_2 = _ConvBN(64, 96, 3, padding=1)
+        self.bd_3 = _ConvBN(96, 96, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return P.concat([
+            self.b3(x),
+            self.bd_3(self.bd_2(self.bd_1(x))),
+            self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c, channels_7x7):
+        super().__init__()
+        c7 = channels_7x7
+        self.b1 = _ConvBN(in_c, 192, 1)
+        self.b7_1 = _ConvBN(in_c, c7, 1)
+        self.b7_2 = _ConvBN(c7, c7, (1, 7), padding=(0, 3))
+        self.b7_3 = _ConvBN(c7, 192, (7, 1), padding=(3, 0))
+        self.b7d_1 = _ConvBN(in_c, c7, 1)
+        self.b7d_2 = _ConvBN(c7, c7, (7, 1), padding=(3, 0))
+        self.b7d_3 = _ConvBN(c7, c7, (1, 7), padding=(0, 3))
+        self.b7d_4 = _ConvBN(c7, c7, (7, 1), padding=(3, 0))
+        self.b7d_5 = _ConvBN(c7, 192, (1, 7), padding=(0, 3))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(in_c, 192, 1)
+
+    def forward(self, x):
+        return P.concat([
+            self.b1(x),
+            self.b7_3(self.b7_2(self.b7_1(x))),
+            self.b7d_5(self.b7d_4(self.b7d_3(self.b7d_2(self.b7d_1(x))))),
+            self.bp(self.pool(x))], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    """Grid reduction 17x17 -> 8x8."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3_1 = _ConvBN(in_c, 192, 1)
+        self.b3_2 = _ConvBN(192, 320, 3, stride=2)
+        self.b7_1 = _ConvBN(in_c, 192, 1)
+        self.b7_2 = _ConvBN(192, 192, (1, 7), padding=(0, 3))
+        self.b7_3 = _ConvBN(192, 192, (7, 1), padding=(3, 0))
+        self.b7_4 = _ConvBN(192, 192, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return P.concat([
+            self.b3_2(self.b3_1(x)),
+            self.b7_4(self.b7_3(self.b7_2(self.b7_1(x)))),
+            self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 320, 1)
+        self.b3_1 = _ConvBN(in_c, 384, 1)
+        self.b3_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bd_1 = _ConvBN(in_c, 448, 1)
+        self.bd_2 = _ConvBN(448, 384, 3, padding=1)
+        self.bd_3a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.bd_3b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(in_c, 192, 1)
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        bd = self.bd_2(self.bd_1(x))
+        return P.concat([
+            self.b1(x),
+            P.concat([self.b3_2a(b3), self.b3_2b(b3)], axis=1),
+            P.concat([self.bd_3a(bd), self.bd_3b(bd)], axis=1),
+            self.bp(self.pool(x))], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Inception v3 (reference ``vision/models/inceptionv3.py`` InceptionV3)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2),
+            _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1),
+            _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32),
+            _InceptionA(256, 64),
+            _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128),
+            _InceptionC(768, 160),
+            _InceptionC(768, 160),
+            _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280),
+            _InceptionE(2048),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.dropout(P.flatten(x, 1))
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require paddle.hub connectivity")
+    return InceptionV3(**kwargs)

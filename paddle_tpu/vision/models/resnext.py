@@ -1,0 +1,64 @@
+"""ResNeXt family (reference python/paddle/vision/models/resnext.py).
+
+Grouped 3x3 convolutions (cardinality) — XLA lowers grouped conv to a
+batched MXU contraction, so cardinality is free on TPU.
+"""
+from __future__ import annotations
+
+from ... import nn
+from .resnet import ResNet, BottleneckBlock
+
+__all__ = ["ResNeXt", "resnext50_32x4d", "resnext50_64x4d",
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+           "resnext152_64x4d"]
+
+_DEPTH_CFG = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+
+
+class ResNeXt(ResNet):
+    """ResNeXt = ResNet bottleneck with cardinality groups
+    (reference ``vision/models/resnext.py`` ResNeXt)."""
+
+    def __init__(self, depth=50, cardinality=32, width=4, num_classes=1000,
+                 with_pool=True):
+        if depth not in _DEPTH_CFG:
+            raise ValueError(f"depth must be one of {sorted(_DEPTH_CFG)}")
+        # ResNet's bottleneck width = planes * (base_width/64) * groups, so
+        # passing width=4, groups=32 gives the 32x4d stage widths
+        # (128/256/512/1024).
+        super().__init__(BottleneckBlock, depth=depth, width=width,
+                         num_classes=num_classes, with_pool=with_pool,
+                         groups=cardinality)
+        self.cardinality = cardinality
+
+
+def _resnext(depth, cardinality, width, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require paddle.hub connectivity")
+    return ResNeXt(depth=depth, cardinality=cardinality, width=width,
+                   **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext(50, 32, 4, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, 4, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext(101, 32, 4, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext(101, 64, 4, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, 4, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, 4, pretrained, **kwargs)

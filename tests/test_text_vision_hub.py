@@ -1,0 +1,172 @@
+"""Tests for paddle.text (viterbi + datasets), new vision models, paddle.hub."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# -- viterbi -----------------------------------------------------------------
+def _np_viterbi(pots, trans, length, bos_eos):
+    """Reference oracle: plain numpy Viterbi for one sequence."""
+    N = trans.shape[0]
+    alpha = pots[0].copy()
+    if bos_eos:
+        alpha = alpha + trans[N - 1]
+    bps = []
+    for t in range(1, length):
+        scores = alpha[:, None] + trans
+        bps.append(np.argmax(scores, axis=0))
+        alpha = np.max(scores, axis=0) + pots[t]
+    if bos_eos:
+        alpha = alpha + trans[:, N - 2]
+    score = alpha.max()
+    tag = int(alpha.argmax())
+    path = [tag]
+    for bp in reversed(bps):
+        tag = int(bp[tag])
+        path.append(tag)
+    return score, list(reversed(path))
+
+
+@pytest.mark.parametrize("bos_eos", [True, False])
+def test_viterbi_decode_matches_numpy(bos_eos):
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 7, 5
+    pots = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lengths = np.array([7, 4, 1], np.int64)
+    scores, paths = viterbi_decode(pots, trans, lengths,
+                                   include_bos_eos_tag=bos_eos)
+    scores, paths = scores.numpy(), paths.numpy()
+    assert paths.shape == (B, T)
+    for b in range(B):
+        L = int(lengths[b])
+        exp_score, exp_path = _np_viterbi(pots[b], trans, L, bos_eos)
+        np.testing.assert_allclose(scores[b], exp_score, rtol=1e-5)
+        assert paths[b, :L].tolist() == exp_path, (b, paths[b], exp_path)
+        assert (paths[b, L:] == 0).all()
+
+
+def test_viterbi_decoder_layer():
+    from paddle_tpu.text import ViterbiDecoder
+    rng = np.random.RandomState(1)
+    trans = rng.randn(4, 4).astype(np.float32)
+    dec = ViterbiDecoder(trans)
+    pots = rng.randn(2, 5, 4).astype(np.float32)
+    scores, paths = dec(paddle.to_tensor(pots),
+                        paddle.to_tensor(np.array([5, 3], np.int64)))
+    assert tuple(paths.shape) == (2, 5)
+
+
+# -- text datasets -----------------------------------------------------------
+def test_text_datasets_shapes():
+    from paddle_tpu.text import (Conll05st, Imdb, Imikolov, Movielens,
+                                 UCIHousing, WMT14, WMT16)
+    uci = UCIHousing(mode="train")
+    x, y = uci[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert len(uci) == 404
+
+    imdb = Imdb(mode="test")
+    doc, label = imdb[5]
+    assert doc.dtype == np.int64 and doc.max() < imdb.word_idx_size
+    assert label in (0, 1)
+
+    ng = Imikolov(mode="train", data_type="NGRAM", window_size=5)
+    assert len(ng[3]) == 5
+
+    ml = Movielens(mode="train")
+    rec = ml[2]
+    assert len(rec) == 8 and rec[-1].dtype == np.float32
+
+    srl = Conll05st(mode="train")
+    fields = srl[1]
+    assert len(fields) == 8
+    assert all(f.shape == fields[0].shape for f in fields)
+
+    w14 = WMT14(mode="test", dict_size=1000)
+    src, trg_in, trg = w14[7]
+    assert src.max() < 1000 and len(trg_in) == len(trg)
+    w16 = WMT16(mode="test", src_dict_size=500, trg_dict_size=800)
+    src, _, _ = w16[7]
+    assert src.max() < 500
+
+    # vocab dict spans every producible id
+    assert len(imdb.word_idx) == imdb.word_idx_size
+    assert doc.max() < len(imdb.word_idx)
+
+    # determinism
+    a0 = Imdb(mode="train")[11]
+    a1 = Imdb(mode="train")[11]
+    np.testing.assert_array_equal(a0[0], a1[0])
+
+    # archive corpora refuse a data_file instead of ignoring it
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        Imdb(mode="train", data_file="/tmp/nope.tar.gz")
+
+
+def test_uci_housing_local_file(tmp_path):
+    from paddle_tpu.text import UCIHousing
+    rng = np.random.RandomState(0)
+    table = rng.rand(50, 14).astype(np.float32)
+    f = tmp_path / "housing.data"
+    np.savetxt(f, table)
+    tr = UCIHousing(mode="train", data_file=str(f))
+    te = UCIHousing(mode="test", data_file=str(f))
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    np.testing.assert_allclose(x, table[0, :13], rtol=1e-5)
+    np.testing.assert_allclose(y, table[0, 13:14], rtol=1e-5)
+
+
+# -- new vision models -------------------------------------------------------
+@pytest.mark.parametrize("factory,size,params_expected", [
+    ("densenet121", 64, 6964106),
+    ("resnext50_32x4d", 64, 23000394),
+])
+def test_vision_model_forward(factory, size, params_expected):
+    from paddle_tpu.vision import models
+    net = getattr(models, factory)(num_classes=10)
+    net.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 3, size, size).astype(np.float32))
+    out = net(x)
+    assert tuple(out.shape) == (1, 10)
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert n_params == params_expected
+
+
+def test_inception_v3_forward():
+    from paddle_tpu.vision.models import inception_v3
+    net = inception_v3(num_classes=10)
+    net.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 3, 128, 128).astype(np.float32))
+    out = net(x)
+    assert tuple(out.shape) == (1, 10)
+
+
+# -- hub ---------------------------------------------------------------------
+def test_hub_local_roundtrip(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def lenet(num_classes=10):\n"
+        "    'synthetic lenet entrypoint'\n"
+        "    from paddle_tpu.vision.models import LeNet\n"
+        "    return LeNet(num_classes=num_classes)\n")
+    entries = paddle.hub.list(str(tmp_path), source="local")
+    assert "lenet" in entries
+    assert "synthetic" in paddle.hub.help(str(tmp_path), "lenet",
+                                          source="local")
+    net = paddle.hub.load(str(tmp_path), "lenet", source="local",
+                          num_classes=7)
+    out = net(paddle.to_tensor(np.zeros((1, 1, 28, 28), np.float32)))
+    assert tuple(out.shape) == (1, 7)
+
+
+def test_hub_remote_gated(tmp_path):
+    with pytest.raises(RuntimeError, match="network"):
+        paddle.hub.list("some/repo", source="github")
