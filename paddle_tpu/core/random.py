@@ -38,8 +38,11 @@ class _FunctionalScope:
 
 class Generator:
     def __init__(self, seed_val: int = 0):
+        # key creation is deferred so `import paddle_tpu` never touches the
+        # accelerator backend (a launcher/CLI parent process may run where
+        # no backend is reachable)
         self._seed = seed_val
-        self._key = jax.random.PRNGKey(seed_val)
+        self._key = None
 
     def seed(self, seed_val: int):
         self._seed = seed_val
@@ -55,10 +58,14 @@ class Generator:
         scope = getattr(_state, "scope", None)
         if scope is not None:
             return scope.next_key()
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 
     def get_state(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         return self._key
 
     def set_state(self, key):

@@ -23,3 +23,12 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F40
 from .parallel import (DataParallel, shard_batch, param_shardings,  # noqa: F401
                        apply_param_shardings, scale_loss)
 from . import checkpoint  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: `python -m paddle_tpu.distributed.launch` warns if the module
+    # is already imported by the package it lives in
+    if name == "launch":
+        import importlib
+        return importlib.import_module(".launch", __name__)
+    raise AttributeError(name)

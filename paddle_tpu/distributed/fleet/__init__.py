@@ -33,6 +33,7 @@ from .meta_parallel.pipeline_parallel import PipelineParallel
 from .meta_parallel import spmd_pipeline as spmd_pipeline_mod
 from .utils import recompute as recompute_mod
 from .utils.recompute import recompute
+from . import elastic  # noqa: F401
 
 __all__ = [
     "init", "fleet", "DistributedStrategy", "distributed_model",

@@ -1,0 +1,7 @@
+"""Elastic training (reference python/paddle/distributed/fleet/elastic/)."""
+from .manager import (ELASTIC_EXIT_CODE, ElasticManager,  # noqa: F401
+                      ElasticStatus, FileStore, MemoryStore, enable_elastic,
+                      launch_elastic)
+
+__all__ = ["ELASTIC_EXIT_CODE", "ElasticManager", "ElasticStatus",
+           "FileStore", "MemoryStore", "enable_elastic", "launch_elastic"]

@@ -1,0 +1,275 @@
+"""Elastic membership manager.
+
+Reference parity: ``fleet/elastic/manager.py:103`` ElasticManager — an
+etcd3 registry of alive hosts (`:147-170`), node-set watches (`:99`),
+relaunch-on-change via ELASTIC_EXIT_CODE (`:26`), scale-in/out between
+``--np`` min:max bounds.
+
+TPU-first redesign: etcd is replaced by a pluggable TTL key-value
+``Store``.  ``FileStore`` covers single-host multi-process tests and
+shared-filesystem pods (heartbeat files with expiry stamps — the HDFS
+rendezvous pattern of ``framework/fleet/gloo_wrapper.h:53``); a real
+deployment can plug any KV (etcd/consul/GCS) by implementing the four
+Store methods.  On a TPU pod slice the membership unit is the *host*
+(PJRT process), matching jax.distributed's process-level world.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+ELASTIC_EXIT_CODE = 101  # keep in sync with distributed/launch.py
+
+__all__ = ["ELASTIC_EXIT_CODE", "ElasticStatus", "ElasticManager",
+           "FileStore", "MemoryStore", "enable_elastic", "launch_elastic"]
+
+
+class ElasticStatus:
+    """reference fleet/elastic/manager.py:29."""
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+# ---------------------------------------------------------------------------
+# stores
+# ---------------------------------------------------------------------------
+class Store:
+    """Minimal TTL KV interface the manager needs (etcd3 subset)."""
+
+    def put(self, key: str, value: str, ttl: Optional[float] = None):
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def delete(self, key: str):
+        raise NotImplementedError
+
+    def list_prefix(self, prefix: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+
+class MemoryStore(Store):
+    """In-process store (unit tests / single-process simulation)."""
+
+    def __init__(self):
+        self._d: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key, value, ttl=None):
+        exp = time.time() + ttl if ttl else None
+        with self._lock:
+            self._d[key] = (value, exp)
+
+    def get(self, key):
+        with self._lock:
+            v = self._d.get(key)
+        if v is None:
+            return None
+        value, exp = v
+        if exp is not None and time.time() > exp:
+            self.delete(key)
+            return None
+        return value
+
+    def delete(self, key):
+        with self._lock:
+            self._d.pop(key, None)
+
+    def list_prefix(self, prefix):
+        now = time.time()
+        with self._lock:
+            items = list(self._d.items())
+        out = {}
+        for k, (value, exp) in items:
+            if not k.startswith(prefix):
+                continue
+            if exp is not None and now > exp:
+                self.delete(k)
+                continue
+            out[k] = value
+        return out
+
+
+class FileStore(Store):
+    """Shared-directory store: one JSON file per key with an expiry stamp.
+
+    Works across processes on one machine and across hosts on a shared
+    filesystem (NFS/GCS-fuse) — the rendezvous pattern the reference uses
+    for its HDFS gloo store."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.strip("/").replace("/", "__"))
+
+    def put(self, key, value, ttl=None):
+        payload = {"value": value,
+                   "expire": time.time() + ttl if ttl else None}
+        path = self._path(key)
+        fd, tmp = tempfile.mkstemp(dir=self.root)
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)  # atomic on POSIX
+
+    def _read(self, path):
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        exp = payload.get("expire")
+        if exp is not None and time.time() > exp:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        return payload["value"]
+
+    def get(self, key):
+        return self._read(self._path(key))
+
+    def delete(self, key):
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
+
+    def list_prefix(self, prefix):
+        pfx = prefix.strip("/").replace("/", "__")
+        out = {}
+        for name in os.listdir(self.root):
+            if not name.startswith(pfx):
+                continue
+            v = self._read(os.path.join(self.root, name))
+            if v is not None:
+                out[name.replace("__", "/")] = v
+        return out
+
+
+# ---------------------------------------------------------------------------
+# manager
+# ---------------------------------------------------------------------------
+def _parse_np(np_spec) -> tuple:
+    """'2' -> (2,2); '2:4' -> (2,4) (reference manager.py np parsing)."""
+    if isinstance(np_spec, int):
+        return np_spec, np_spec
+    s = str(np_spec)
+    if ":" in s:
+        lo, hi = s.split(":")
+        return int(lo), int(hi)
+    n = int(s)
+    return n, n
+
+
+class ElasticManager:
+    """Tracks alive hosts in the store and classifies the pod state
+    (reference fleet/elastic/manager.py:103)."""
+
+    PREFIX = "/paddle/edl/hosts/"
+
+    def __init__(self, np_spec, store: Store, host: Optional[str] = None,
+                 heartbeat_interval: float = 1.0, ttl: float = 5.0,
+                 job_id: str = "default"):
+        self.np_min, self.np_max = _parse_np(np_spec)
+        self.store = store
+        self.host = host or f"{socket.gethostname()}-{os.getpid()}"
+        self.ttl = ttl
+        self.interval = heartbeat_interval
+        self.job_id = job_id
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._last_hosts: Optional[List[str]] = None
+        self.enabled = True
+
+    # -- membership --------------------------------------------------------
+    def _key(self, host=None):
+        return f"{self.PREFIX}{self.job_id}/{host or self.host}"
+
+    def register(self):
+        """Join + start heartbeating (reference manager.py:147-170)."""
+        self.store.put(self._key(), "alive", ttl=self.ttl)
+
+        def beat():
+            while not self._stop.wait(self.interval):
+                self.store.put(self._key(), "alive", ttl=self.ttl)
+
+        self._hb_thread = threading.Thread(target=beat, daemon=True)
+        self._hb_thread.start()
+
+    def deregister(self):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2 * self.interval)
+            self._hb_thread = None
+        self.store.delete(self._key())
+
+    def hosts(self) -> List[str]:
+        pfx = f"{self.PREFIX}{self.job_id}/"
+        return sorted(k.split("/")[-1]
+                      for k in self.store.list_prefix(pfx))
+
+    # -- state classification ---------------------------------------------
+    def _match(self) -> bool:
+        """reference manager.py:258 — alive set within [np_min, np_max]."""
+        n = len(self.hosts())
+        return self.np_min <= n <= self.np_max
+
+    def wait(self, timeout: float = 60.0) -> bool:
+        """Block until the pod matches (reference manager.py:293)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._match():
+                self._last_hosts = self.hosts()
+                return True
+            time.sleep(self.interval)
+        return False
+
+    def watch(self) -> str:
+        """One observation step (reference manager.py:324 loop body):
+        returns an ElasticStatus for the supervisor to act on."""
+        hosts = self.hosts()
+        if self._last_hosts is None:
+            self._last_hosts = hosts
+        if len(hosts) < self.np_min:
+            return ElasticStatus.HOLD      # wait for scale-out/rejoin
+        if hosts != self._last_hosts:
+            self._last_hosts = hosts
+            return ElasticStatus.RESTART   # membership changed: relaunch
+        return ElasticStatus.COMPLETED if not self.enabled \
+            else ElasticStatus.HOLD
+
+    def exit(self, completed: bool = False):
+        """reference manager.py:226."""
+        self.deregister()
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.EXIT
+
+
+def enable_elastic(args=None) -> bool:
+    """reference elastic/__init__.py enable_elastic: elastic is on when a
+    store endpoint is configured."""
+    return bool(os.environ.get("PADDLE_ELASTIC_STORE_ROOT") or
+                (args is not None and getattr(args, "elastic", False)))
+
+
+def launch_elastic(np_spec, store_root: Optional[str] = None,
+                   job_id: str = "default") -> ElasticManager:
+    """Construct a manager from env/args (reference elastic collective
+    entry): FileStore rooted at PADDLE_ELASTIC_STORE_ROOT."""
+    root = store_root or os.environ.get("PADDLE_ELASTIC_STORE_ROOT")
+    if not root:
+        raise ValueError("set PADDLE_ELASTIC_STORE_ROOT or pass store_root")
+    mgr = ElasticManager(np_spec, FileStore(root), job_id=job_id)
+    mgr.register()
+    return mgr

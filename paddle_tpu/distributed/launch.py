@@ -1,0 +1,177 @@
+"""Multi-process training launcher (``python -m paddle_tpu.distributed.launch``).
+
+Reference parity: ``python/paddle/distributed/fleet/launch.py:451`` (entry),
+``:276`` launch_collective — spawn one trainer process per device with the
+PADDLE_* env contract, stream logs, kill the pod on any failure, and
+relaunch on the elastic exit code (``fleet/elastic/manager.py:26``).
+
+TPU-first: one process per *host* (a pod slice host drives all its local
+chips through one PJRT client), identified to ``jax.distributed`` via
+coordinator address + process id; ``--nproc`` > 1 on a single machine is
+the CPU-simulation path, where each process gets an
+``xla_force_host_platform_device_count`` virtual mesh for test parity
+(reference TestDistBase's localhost multi-process cluster).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+
+ELASTIC_EXIT_CODE = 101  # reference fleet/elastic/manager.py:26
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a multi-process training job")
+    p.add_argument("--nproc", "--nproc_per_node", type=int, default=1,
+                   dest="nproc", help="processes to spawn on this host")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host list (multi-host)")
+    p.add_argument("--host_rank", type=int, default=0,
+                   help="index of this host in --ips")
+    p.add_argument("--master_port", type=int, default=36007)
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="write per-rank logs under this dir")
+    p.add_argument("--devices_per_proc", type=int, default=0,
+                   help="if >0, give each proc an N-device virtual CPU mesh")
+    p.add_argument("--elastic", action="store_true",
+                   help=f"relaunch the pod when a proc exits with code "
+                        f"{ELASTIC_EXIT_CODE}")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster_env(rank, world_size, endpoints, coordinator):
+    """The PADDLE_* env contract (reference distributed/utils.py Cluster/Pod
+    + parallel.py:69 ParallelEnv consumption)."""
+    return {
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world_size),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_MASTER": coordinator,
+    }
+
+
+class PodLauncher:
+    """Spawn + babysit one host's trainer processes
+    (reference fleet/elastic/manager.py:37 LauncherInterface)."""
+
+    def __init__(self, args, argv_tail):
+        self.args = args
+        self.argv_tail = argv_tail
+        self.procs = []
+        self.log_files = []
+
+    def launch(self):
+        a = self.args
+        hosts = [h.strip() for h in a.ips.split(",") if h.strip()]
+        world = len(hosts) * a.nproc
+        endpoints = [f"{h}:{a.master_port + i}"
+                     for h in hosts for i in range(a.nproc)]
+        coordinator = f"{hosts[0]}:{a.master_port - 1}"
+        if a.log_dir:
+            os.makedirs(a.log_dir, exist_ok=True)
+        self.procs, self.log_files = [], []
+        for local in range(a.nproc):
+            rank = a.host_rank * a.nproc + local
+            env = dict(os.environ)
+            env.update(get_cluster_env(rank, world, endpoints, coordinator))
+            # children must import the same framework as this parent even
+            # when it is run from a source tree rather than installed
+            pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            env["PYTHONPATH"] = os.pathsep.join(
+                [pkg_root] + ([env["PYTHONPATH"]]
+                              if env.get("PYTHONPATH") else []))
+            if a.devices_per_proc > 0:
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "") +
+                    f" --xla_force_host_platform_device_count="
+                    f"{a.devices_per_proc}").strip()
+            cmd = [sys.executable, a.training_script] + self.argv_tail
+            if a.log_dir:
+                f = open(os.path.join(a.log_dir, f"workerlog.{rank}"), "w")
+                self.log_files.append(f)
+                proc = subprocess.Popen(cmd, env=env, stdout=f, stderr=f)
+            else:
+                proc = subprocess.Popen(cmd, env=env)
+            self.procs.append(proc)
+        return self.procs
+
+    def wait(self):
+        """Block until all procs exit; on any failure kill the pod.
+        Returns the pod's exit code (first nonzero, else 0)."""
+        pending = {p.pid: p for p in self.procs}
+        code = 0
+        while pending:
+            for pid, p in list(pending.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del pending[pid]
+                if rc != 0:
+                    code = code or rc
+                    self.stop()
+                    pending.clear()
+                    break
+            time.sleep(0.1)
+        for f in self.log_files:
+            f.close()
+        self.log_files = []
+        return code
+
+    def stop(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in self.procs:
+            timeout = max(0.1, deadline - time.time())
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    tail = list(args.training_script_args)
+    if tail and tail[0] == "--":
+        tail = tail[1:]
+    restarts = 0
+    while True:
+        pod = PodLauncher(args, tail)
+        pod.launch()
+
+        def _sig(_s, _f):
+            pod.stop()
+            sys.exit(1)
+
+        signal.signal(signal.SIGTERM, _sig)
+        code = pod.wait()
+        if code == 0:
+            return 0
+        if args.elastic and code == ELASTIC_EXIT_CODE and \
+                restarts < args.max_restarts:
+            restarts += 1
+            print(f"launch: elastic exit ({code}); relaunch "
+                  f"{restarts}/{args.max_restarts}", file=sys.stderr)
+            continue
+        print(f"launch: pod failed with exit code {code} "
+              f"(cmd: {shlex.join([args.training_script] + tail)})",
+              file=sys.stderr)
+        return code
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -22,6 +22,9 @@ def _load_hubconf(repo_dir: str):
         raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir}")
     spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
     mod = importlib.util.module_from_spec(spec)
+    # register before exec so classes defined in hubconf are picklable
+    # (their __module__ must be importable)
+    sys.modules[spec.name] = mod
     sys.path.insert(0, repo_dir)
     try:
         spec.loader.exec_module(mod)

@@ -46,7 +46,11 @@ class _SyntheticCorpus(Dataset):
         raise NotImplementedError
 
     def __getitem__(self, idx):
-        return self._samples[idx % self._pool]
+        # copy so in-place mutation by consumers can't corrupt the pool
+        sample = self._samples[idx % self._pool]
+        copy = lambda f: np.copy(f) if isinstance(f, np.ndarray) else f
+        return tuple(copy(f) for f in sample) \
+            if isinstance(sample, tuple) else copy(sample)
 
     def __len__(self):
         return self._len
@@ -61,6 +65,8 @@ class UCIHousing(_SyntheticCorpus):
 
     def __init__(self, mode="train", data_file=None):
         if data_file is not None:
+            if mode not in self._MODE_SEED:
+                raise ValueError(f"mode must be train/test/dev, got {mode}")
             # the UCI format is a plain whitespace float table: parse it
             table = np.loadtxt(os.path.expanduser(data_file),
                                dtype=np.float32)
@@ -99,8 +105,10 @@ class Imdb(_SyntheticCorpus):
     @property
     def word_idx(self):
         # spans the full vocab so nn.Embedding(len(ds.word_idx), D) covers
-        # every id a sample can contain
-        return {f"w{i}": i for i in range(self.word_idx_size)}
+        # every id a sample can contain; built once
+        if not hasattr(self, "_word_idx"):
+            self._word_idx = {f"w{i}": i for i in range(self.word_idx_size)}
+        return self._word_idx
 
 
 class Imikolov(_SyntheticCorpus):
@@ -191,8 +199,8 @@ class _WMT(_SyntheticCorpus):
         trg_in = np.concatenate([[0], trg[:-1]]).astype("int64")  # <s> shift
         return src, trg_in, trg
 
-    def get_dict(self, reverse=False):
-        d = {f"tok{i}": i for i in range(self.src_dict_size)}
+    def _vocab(self, size, reverse):
+        d = {f"tok{i}": i for i in range(size)}
         return {v: k for k, v in d.items()} if reverse else d
 
 
@@ -204,6 +212,15 @@ class WMT14(_WMT):
         super().__init__(mode=mode, src_dict_size=dict_size,
                          trg_dict_size=dict_size, data_file=data_file)
 
+    def get_dict(self, reverse=False):
+        return self._vocab(self.src_dict_size, reverse)
+
 
 class WMT16(_WMT):
-    """WMT16 en-de (reference ``text/datasets/wmt16.py``)."""
+    """WMT16 en-de (reference ``text/datasets/wmt16.py`` — per-language
+    ``get_dict(lang, reverse)``)."""
+
+    def get_dict(self, lang="en", reverse=False):
+        size = self.src_dict_size if lang == self.lang else \
+            self.trg_dict_size
+        return self._vocab(size, reverse)

@@ -12,7 +12,7 @@ __all__ = ["ResNeXt", "resnext50_32x4d", "resnext50_64x4d",
            "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
            "resnext152_64x4d"]
 
-_DEPTH_CFG = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+_DEPTHS = (50, 101, 152)  # stage counts live in ResNet's layer_cfg
 
 
 class ResNeXt(ResNet):
@@ -21,8 +21,8 @@ class ResNeXt(ResNet):
 
     def __init__(self, depth=50, cardinality=32, width=4, num_classes=1000,
                  with_pool=True):
-        if depth not in _DEPTH_CFG:
-            raise ValueError(f"depth must be one of {sorted(_DEPTH_CFG)}")
+        if depth not in _DEPTHS:
+            raise ValueError(f"depth must be one of {_DEPTHS}")
         # ResNet's bottleneck width = planes * (base_width/64) * groups, so
         # passing width=4, groups=32 gives the 32x4d stage widths
         # (128/256/512/1024).
