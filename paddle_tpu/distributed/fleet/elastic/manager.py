@@ -70,13 +70,15 @@ class MemoryStore(Store):
             self._d[key] = (value, exp)
 
     def get(self, key):
+        # expired entries are treated as absent but never deleted here:
+        # a delete racing a concurrent refresh (put) could drop the fresh
+        # heartbeat; the owner's deregister() is the only deleter
         with self._lock:
             v = self._d.get(key)
         if v is None:
             return None
         value, exp = v
         if exp is not None and time.time() > exp:
-            self.delete(key)
             return None
         return value
 
@@ -93,7 +95,6 @@ class MemoryStore(Store):
             if not k.startswith(prefix):
                 continue
             if exp is not None and now > exp:
-                self.delete(k)
                 continue
             out[k] = value
         return out
@@ -130,10 +131,9 @@ class FileStore(Store):
             return None
         exp = payload.get("expire")
         if exp is not None and time.time() > exp:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            # treat as absent but do NOT unlink: a reader-side delete can
+            # race the owner's atomic refresh (os.replace) and destroy a
+            # live heartbeat; only the owner deletes (deregister)
             return None
         return payload["value"]
 
@@ -242,8 +242,9 @@ class ElasticManager:
         hosts = self.hosts()
         if self._last_hosts is None:
             self._last_hosts = hosts
-        if len(hosts) < self.np_min:
-            return ElasticStatus.HOLD      # wait for scale-out/rejoin
+        if not (self.np_min <= len(hosts) <= self.np_max):
+            self._last_hosts = hosts
+            return ElasticStatus.HOLD      # wait for scale-out/in to match
         if hosts != self._last_hosts:
             self._last_hosts = hosts
             return ElasticStatus.RESTART   # membership changed: relaunch

@@ -22,7 +22,8 @@ import subprocess
 import sys
 import time
 
-ELASTIC_EXIT_CODE = 101  # reference fleet/elastic/manager.py:26
+# single source of truth for the relaunch protocol
+from .fleet.elastic.manager import ELASTIC_EXIT_CODE  # noqa: E402
 
 
 def _parse_args(argv=None):
@@ -149,15 +150,20 @@ def launch(argv=None):
     if tail and tail[0] == "--":
         tail = tail[1:]
     restarts = 0
+    pod_ref = {}
+
+    def _sig(_s, _f):
+        # reads the live pod through the holder so elastic relaunches are
+        # covered; installed before the first spawn so no orphan window
+        if pod_ref.get("pod") is not None:
+            pod_ref["pod"].stop()
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _sig)
     while True:
         pod = PodLauncher(args, tail)
+        pod_ref["pod"] = pod
         pod.launch()
-
-        def _sig(_s, _f):
-            pod.stop()
-            sys.exit(1)
-
-        signal.signal(signal.SIGTERM, _sig)
         code = pod.wait()
         if code == 0:
             return 0

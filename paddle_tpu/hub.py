@@ -7,6 +7,7 @@ resolved from a ``hubconf.py`` in a repo.  Zero-egress image: the
 """
 from __future__ import annotations
 
+import hashlib
 import importlib.util
 import os
 import sys
@@ -20,14 +21,22 @@ def _load_hubconf(repo_dir: str):
     path = os.path.join(repo_dir, _HUBCONF)
     if not os.path.isfile(path):
         raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir}")
-    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    # unique per repo so two hub repos never evict each other's classes
+    mod_name = "paddle_tpu_hubconf_" + hashlib.sha1(
+        os.path.abspath(repo_dir).encode()).hexdigest()[:12]
+    if mod_name in sys.modules:
+        return sys.modules[mod_name]
+    spec = importlib.util.spec_from_file_location(mod_name, path)
     mod = importlib.util.module_from_spec(spec)
     # register before exec so classes defined in hubconf are picklable
     # (their __module__ must be importable)
-    sys.modules[spec.name] = mod
+    sys.modules[mod_name] = mod
     sys.path.insert(0, repo_dir)
     try:
         spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(mod_name, None)
+        raise
     finally:
         sys.path.remove(repo_dir)
     return mod

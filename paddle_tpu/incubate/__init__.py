@@ -1,2 +1,3 @@
 """paddle.incubate (LookAhead/ModelAverage + experimental nn)."""
 from . import optimizer_mod as optimizer  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,8 @@
+"""paddle.incubate.nn (reference python/paddle/incubate/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from .layer.fused_transformer import (FusedMultiHeadAttention,  # noqa: F401
+                                      FusedFeedForward,
+                                      FusedTransformerEncoderLayer)
+
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]

@@ -1,0 +1,105 @@
+"""Fused transformer functional ops
+(reference python/paddle/incubate/nn/functional/fused_transformer.py).
+
+TPU-first: the reference fuses attention + dropout + residual + LN in
+hand-written CUDA (``operators/fused/fused_attention_op.cu``,
+``fused_feedforward_op.cu``).  Here the attention core is the pallas
+flash-attention kernel (on TPU) and everything around it is expressed as
+one traced function — XLA fuses the bias/dropout/residual/LN epilogue
+into neighboring kernels, which is exactly what the CUDA fusion
+hand-codes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .... import ops as P
+from ....core.tensor import Tensor, to_tensor
+
+__all__ = ["fused_multi_head_attention", "fused_feedforward"]
+
+
+def _maybe_ln(x, scale, bias, eps):
+    D = int(x.shape[-1])
+    return P.layer_norm(x, [D],
+                        None if scale is None else to_tensor(scale),
+                        None if bias is None else to_tensor(bias), eps)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True, name=None):
+    """Self-attention block (reference ``fused_transformer.py:176``):
+
+    ``out = LN(x + dropout(linear(MHA(maybe_LN(x)))))`` (post-LN) or the
+    pre-LN variant.  ``qkv_weight`` is the reference layout
+    ``[3, num_heads, head_dim, embed_dim]``; ``qkv_bias``
+    ``[3, num_heads, head_dim]``.
+    """
+    x = to_tensor(x)
+    qkv_weight = to_tensor(qkv_weight)
+    linear_weight = to_tensor(linear_weight)
+    _, H, Dh, D = (int(s) for s in qkv_weight.shape)
+
+    residual = x
+    h = _maybe_ln(x, pre_ln_scale, pre_ln_bias, pre_ln_epsilon) \
+        if pre_layer_norm else x
+
+    # qkv projection: [B,T,D] x [3,H,Dh,D] -> [B,T,3,H,Dh]
+    w = P.reshape(P.transpose(qkv_weight, [3, 0, 1, 2]), [D, 3 * H * Dh])
+    qkv = P.matmul(h, w)
+    if qkv_bias is not None:
+        qkv = qkv + P.reshape(to_tensor(qkv_bias), [3 * H * Dh])
+    B, T = int(x.shape[0]), int(x.shape[1])
+    qkv = P.reshape(qkv, [B, T, 3, H, Dh])
+    q = P.squeeze(P.slice(qkv, [2], [0], [1]), axis=2)   # [B,T,H,Dh]
+    k = P.squeeze(P.slice(qkv, [2], [1], [2]), axis=2)
+    v = P.squeeze(P.slice(qkv, [2], [2], [3]), axis=2)
+
+    ctx = P.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        training=training)
+    ctx = P.reshape(ctx, [B, T, H * Dh])
+
+    out = P.matmul(ctx, linear_weight)
+    if linear_bias is not None:
+        out = out + to_tensor(linear_bias)
+    out = P.dropout(out, p=dropout_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = _maybe_ln(out, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      name=None):
+    """FFN block (reference ``fused_transformer.py:31``):
+    ``out = LN(x + dropout2(linear2(dropout1(act(linear1(maybe_LN(x)))))))``.
+    """
+    x = to_tensor(x)
+    residual = x
+    h = _maybe_ln(x, ln1_scale, ln1_bias, ln1_epsilon) \
+        if pre_layer_norm else x
+    h = P.matmul(h, to_tensor(linear1_weight))
+    if linear1_bias is not None:
+        h = h + to_tensor(linear1_bias)
+    act = getattr(P, activation)
+    h = act(h)
+    h = P.dropout(h, p=dropout1_rate, training=training)
+    h = P.matmul(h, to_tensor(linear2_weight))
+    if linear2_bias is not None:
+        h = h + to_tensor(linear2_bias)
+    h = P.dropout(h, p=dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = _maybe_ln(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out

@@ -1,0 +1,144 @@
+"""Fused transformer layers
+(reference python/paddle/incubate/nn/layer/fused_transformer.py:25,234).
+
+The layer surface matches the reference (qkv packed weight layout
+``[3, H, Dh, D]``); the compute goes through
+``incubate.nn.functional.fused_*`` which rides the pallas flash-attention
+kernel on TPU.
+"""
+from __future__ import annotations
+
+from ....nn.layer_base import Layer
+from ....nn import initializer as I
+from .. import functional as F
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """Fused self-attention block (reference ``fused_transformer.py:25``)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        if embed_dim <= 0 or num_heads <= 0:
+            raise ValueError("embed_dim and num_heads must be positive")
+        if embed_dim % num_heads != 0:
+            raise ValueError("embed_dim must be divisible by num_heads")
+        if need_weights:
+            raise ValueError("need_weights=True is not supported by the "
+                             "fused kernel (reference parity)")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                                  else attn_dropout_rate)
+        self.normalize_before = normalize_before
+
+        H, Dh, D = num_heads, self.head_dim, embed_dim
+        self.qkv_weight = self.create_parameter(
+            [3, H, Dh, D], attr=weight_attr)
+        self.qkv_bias = self.create_parameter(
+            [3, H, Dh], attr=bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter([D, D], attr=weight_attr)
+        self.linear_bias = self.create_parameter([D], attr=bias_attr,
+                                                 is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            [D], default_initializer=I.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter([D], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [D], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([D], is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        if cache is not None:
+            raise NotImplementedError("incremental cache not supported")
+        if (key is not None and key is not query) or \
+                (value is not None and value is not query):
+            raise NotImplementedError(
+                "the fused kernel only supports self-attention (reference "
+                "fused_attention_op parity); pass query alone")
+        return F.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """Fused FFN block (reference ``fused_transformer.py:234``)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", act_dropout_rate=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.d_model = d_model
+        self.dim_feedforward = dim_feedforward
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.normalize_before = normalize_before
+
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=weight_attr)
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=weight_attr)
+        self.linear2_bias = self.create_parameter([d_model], attr=bias_attr,
+                                                  is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            [d_model], default_initializer=I.Constant(1.0))
+        self.ln1_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            [d_model], default_initializer=I.Constant(1.0))
+        self.ln2_bias = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, src, cache=None):
+        return F.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate,
+            activation=self.activation,
+            pre_layer_norm=self.normalize_before,
+            training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Encoder layer = fused attention + fused FFN (reference
+    ``fused_transformer.py`` FusedTransformerEncoderLayer)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before, weight_attr=weight_attr,
+            bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=(dropout_rate if act_dropout_rate is None
+                              else act_dropout_rate),
+            normalize_before=normalize_before, weight_attr=weight_attr,
+            bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

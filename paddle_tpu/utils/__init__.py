@@ -1,2 +1,3 @@
 from . import flags  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
+from . import cpp_extension  # noqa: F401

@@ -1,0 +1,107 @@
+// Custom-op extension ABI for paddle_tpu.
+//
+// Reference parity: paddle/fluid/extension/include/ext_op_meta_info.h:501
+// (PD_BUILD_OP) + ext_tensor.h (paddle::Tensor ABI).  TPU-first redesign:
+// a custom op is a host kernel over dense row-major buffers; the Python
+// side wraps it as a jax.pure_callback so it composes with jit/grad,
+// while the device-resident path stays XLA/pallas.  The ABI is plain C
+// so the Python binding is ctypes (no pybind11 in the image).
+//
+// Usage (user .cc file):
+//
+//   #include "paddle_tpu_ext.h"
+//
+//   static void relu_kernel(const PTE_Tensor* ins, int n_in,
+//                           PTE_Tensor* outs, int n_out) {
+//     const float* x = static_cast<const float*>(ins[0].data);
+//     float* y = static_cast<float*>(outs[0].data);
+//     int64_t n = PTE_NumElements(&ins[0]);
+//     for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0 ? x[i] : 0;
+//   }
+//   PD_BUILD_OP(custom_relu, relu_kernel);
+//
+// An op named <name>_grad is auto-wired as the VJP: it receives the
+// forward inputs followed by the output cotangents and must fill one
+// gradient per forward input.
+#pragma once
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+typedef struct {
+  void* data;             // dense row-major buffer
+  const int64_t* shape;   // rank entries
+  int32_t rank;
+  int32_t dtype;          // PTE_F32..PTE_BOOL below
+} PTE_Tensor;
+
+enum PTE_DType {
+  PTE_F32 = 0,
+  PTE_F64 = 1,
+  PTE_I32 = 2,
+  PTE_I64 = 3,
+  PTE_U8 = 4,
+  PTE_BOOL = 5,
+};
+
+typedef void (*PTE_KernelFn)(const PTE_Tensor* inputs, int32_t n_inputs,
+                             PTE_Tensor* outputs, int32_t n_outputs);
+
+}  // extern "C"
+
+static inline int64_t PTE_NumElements(const PTE_Tensor* t) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < t->rank; ++i) n *= t->shape[i];
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// registry (one per shared object)
+// ---------------------------------------------------------------------------
+struct PTE_Registry {
+  enum { kMaxOps = 128 };
+  const char* names[kMaxOps];
+  PTE_KernelFn fns[kMaxOps];
+  int n;
+  static PTE_Registry& Instance() {
+    static PTE_Registry r;
+    return r;
+  }
+  int Add(const char* name, PTE_KernelFn fn) {
+    if (n < kMaxOps) {
+      names[n] = name;
+      fns[n] = fn;
+      ++n;
+    }
+    return n - 1;
+  }
+};
+
+struct PTE_Registrar {
+  PTE_Registrar(const char* name, PTE_KernelFn fn) {
+    PTE_Registry::Instance().Add(name, fn);
+  }
+};
+
+#define PD_BUILD_OP(opname, kernel_fn) \
+  static ::PTE_Registrar pte_registrar_##opname(#opname, kernel_fn)
+
+// C entry points the Python loader binds to.  Weak + default visibility:
+// emitted in every TU that includes this header, deduplicated at link
+// time, and guaranteed present in the .so even when nothing in the TU
+// references them (plain `inline` would be discarded).
+#define PTE_EXPORT extern "C" __attribute__((weak, visibility("default")))
+
+PTE_EXPORT int32_t pte_num_ops() { return PTE_Registry::Instance().n; }
+
+PTE_EXPORT const char* pte_op_name(int32_t i) {
+  PTE_Registry& r = PTE_Registry::Instance();
+  return (i >= 0 && i < r.n) ? r.names[i] : "";
+}
+
+PTE_EXPORT void pte_run(int32_t i, const PTE_Tensor* ins, int32_t n_in,
+                        PTE_Tensor* outs, int32_t n_out) {
+  PTE_Registry& r = PTE_Registry::Instance();
+  if (i >= 0 && i < r.n) r.fns[i](ins, n_in, outs, n_out);
+}

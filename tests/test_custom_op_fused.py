@@ -1,0 +1,163 @@
+"""Custom C++ op extension + incubate.nn fused transformer tests."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+NEED_GXX = not os.path.exists("/usr/bin/g++") and os.system("which g++ >/dev/null 2>&1") != 0
+
+CUSTOM_SRC = """
+#include "paddle_tpu_ext.h"
+#include <cmath>
+
+static void relu_kernel(const PTE_Tensor* ins, int n_in,
+                        PTE_Tensor* outs, int n_out) {
+  const float* x = static_cast<const float*>(ins[0].data);
+  float* y = static_cast<float*>(outs[0].data);
+  int64_t n = PTE_NumElements(&ins[0]);
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0.f ? x[i] : 0.f;
+}
+PD_BUILD_OP(custom_relu, relu_kernel);
+
+// grad contract: (fwd inputs..., cotangents...) -> one grad per fwd input
+static void relu_grad_kernel(const PTE_Tensor* ins, int n_in,
+                             PTE_Tensor* outs, int n_out) {
+  const float* x = static_cast<const float*>(ins[0].data);
+  const float* gy = static_cast<const float*>(ins[1].data);
+  float* gx = static_cast<float*>(outs[0].data);
+  int64_t n = PTE_NumElements(&ins[0]);
+  for (int64_t i = 0; i < n; ++i) gx[i] = x[i] > 0.f ? gy[i] : 0.f;
+}
+PD_BUILD_OP(custom_relu_grad, relu_grad_kernel);
+
+// two-input op, no grad: out = a + 2*b
+static void axpb_kernel(const PTE_Tensor* ins, int n_in,
+                        PTE_Tensor* outs, int n_out) {
+  const float* a = static_cast<const float*>(ins[0].data);
+  const float* b = static_cast<const float*>(ins[1].data);
+  float* y = static_cast<float*>(outs[0].data);
+  int64_t n = PTE_NumElements(&ins[0]);
+  for (int64_t i = 0; i < n; ++i) y[i] = a[i] + 2.f * b[i];
+}
+PD_BUILD_OP(custom_axpb, axpb_kernel);
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    if NEED_GXX:
+        pytest.skip("no g++ toolchain")
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "custom_ops.cc"
+    src.write_text(CUSTOM_SRC)
+    from paddle_tpu.utils.cpp_extension import load
+    return load(name="custom_ops", sources=[str(src)],
+                build_directory=str(d), verbose=True)
+
+
+def test_custom_op_lists_ops(ext):
+    assert set(ext.op_names()) >= {"custom_relu", "custom_relu_grad",
+                                   "custom_axpb"}
+
+
+def test_custom_op_forward(ext):
+    x = np.array([-1.0, 2.0, -3.0, 4.0], np.float32)
+    out = ext.custom_relu(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), [0, 2, 0, 4])
+    # two-input op
+    y = ext.custom_axpb(x, np.ones(4, np.float32))
+    np.testing.assert_allclose(y.numpy(), x + 2.0)
+
+
+def test_custom_op_grad_through_tape(ext):
+    x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0, 4.0], np.float32),
+                         stop_gradient=False)
+    out = ext.custom_relu(x)
+    loss = paddle.sum(out * out)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 4.0, 0.0, 8.0])
+
+
+def test_custom_op_inside_jit(ext):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(a):
+        t = ext.custom_relu(paddle.Tensor(a))
+        return t._data * 3.0
+
+    got = f(jnp.asarray([-2.0, 5.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), [0.0, 15.0])
+
+
+def test_cuda_extension_gated():
+    from paddle_tpu.utils.cpp_extension import CUDAExtension
+    with pytest.raises(RuntimeError, match="pallas"):
+        CUDAExtension(sources=["x.cu"])
+
+
+# -- fused transformer -------------------------------------------------------
+def _ref_mha(x, layer):
+    """Unfused numpy oracle of the post-LN fused attention block (eval
+    mode, no dropout)."""
+    qkvw = layer.qkv_weight.numpy()      # [3,H,Dh,D]
+    qkvb = layer.qkv_bias.numpy()        # [3,H,Dh]
+    lw = layer.linear_weight.numpy()     # [D,D]
+    lb = layer.linear_bias.numpy()
+    g, b = layer.ln_scale.numpy(), layer.ln_bias.numpy()
+    _, H, Dh, D = qkvw.shape
+    B, T, _ = x.shape
+    proj = np.einsum("btd,chkd->btchk", x, qkvw) + qkvb  # c in {q,k,v}
+    q, k, v = proj[:, :, 0], proj[:, :, 1], proj[:, :, 2]  # [B,T,H,Dh]
+    logits = np.einsum("bthd,bshd->bhts", q, k) / np.sqrt(Dh)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ctx = np.einsum("bhts,bshd->bthd", p, v).reshape(B, T, D)
+    out = ctx @ lw + lb + x
+    mu, var = out.mean(-1, keepdims=True), out.var(-1, keepdims=True)
+    return (out - mu) / np.sqrt(var + 1e-5) * g + b
+
+
+def test_fused_multi_head_attention_matches_oracle():
+    from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+    paddle.seed(0)
+    layer = FusedMultiHeadAttention(embed_dim=16, num_heads=4,
+                                    dropout_rate=0.0, attn_dropout_rate=0.0)
+    layer.eval()
+    x = np.random.RandomState(0).randn(2, 6, 16).astype(np.float32)
+    got = layer(paddle.to_tensor(x)).numpy()
+    expect = _ref_mha(x, layer)
+    np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-5)
+
+
+def test_fused_feedforward_and_encoder_layer_train():
+    from paddle_tpu.incubate.nn import (FusedFeedForward,
+                                        FusedTransformerEncoderLayer)
+    paddle.seed(0)
+    ffn = FusedFeedForward(d_model=8, dim_feedforward=32, dropout_rate=0.0)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 5, 8)
+                         .astype(np.float32), stop_gradient=False)
+    y = ffn(x)
+    assert tuple(y.shape) == (2, 5, 8)
+    paddle.sum(y).backward()
+    assert ffn.linear1_weight.grad is not None
+
+    enc = FusedTransformerEncoderLayer(d_model=8, nhead=2,
+                                       dim_feedforward=16,
+                                       dropout_rate=0.1)
+    enc.train()
+    out = enc(paddle.to_tensor(np.random.RandomState(2).randn(2, 5, 8)
+                               .astype(np.float32)))
+    assert tuple(out.shape) == (2, 5, 8)
+
+    # pre-LN variant
+    enc2 = FusedTransformerEncoderLayer(d_model=8, nhead=2,
+                                        dim_feedforward=16,
+                                        normalize_before=True)
+    enc2.eval()
+    out2 = enc2(paddle.to_tensor(np.zeros((1, 3, 8), np.float32)))
+    assert tuple(out2.shape) == (1, 3, 8)
