@@ -56,6 +56,12 @@ class Store:
     def list_prefix(self, prefix: str) -> Dict[str, str]:
         raise NotImplementedError
 
+    def purge_expired(self, grace: float = 0.0):
+        """GC entries expired for longer than ``grace``.  Run by live
+        managers' heartbeat threads; the grace period (>> one TTL) makes
+        the purge safe against the delete-vs-refresh race — a live owner
+        refreshes long before its entry is grace-expired."""
+
 
 class MemoryStore(Store):
     """In-process store (unit tests / single-process simulation)."""
@@ -98,6 +104,14 @@ class MemoryStore(Store):
                 continue
             out[k] = value
         return out
+
+    def purge_expired(self, grace: float = 0.0):
+        now = time.time()
+        with self._lock:
+            dead = [k for k, (_, exp) in self._d.items()
+                    if exp is not None and now > exp + grace]
+            for k in dead:
+                self._d.pop(k, None)
 
 
 class FileStore(Store):
@@ -157,6 +171,21 @@ class FileStore(Store):
                 out[name.replace("__", "/")] = v
         return out
 
+    def purge_expired(self, grace: float = 0.0):
+        now = time.time()
+        for name in os.listdir(self.root):
+            path = os.path.join(self.root, name)
+            try:
+                with open(path) as f:
+                    exp = json.load(f).get("expire")
+            except (OSError, json.JSONDecodeError):
+                continue
+            if exp is not None and now > exp + grace:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
 
 # ---------------------------------------------------------------------------
 # manager
@@ -202,8 +231,12 @@ class ElasticManager:
         self.store.put(self._key(), "alive", ttl=self.ttl)
 
         def beat():
+            n = 0
             while not self._stop.wait(self.interval):
                 self.store.put(self._key(), "alive", ttl=self.ttl)
+                n += 1
+                if n % 10 == 0:  # GC crashed hosts' stale entries
+                    self.store.purge_expired(grace=3.0 * self.ttl)
 
         self._hb_thread = threading.Thread(target=beat, daemon=True)
         self._hb_thread.start()

@@ -17,13 +17,15 @@ __all__ = ["list", "help", "load"]
 _HUBCONF = "hubconf.py"
 
 
-def _load_hubconf(repo_dir: str):
+def _load_hubconf(repo_dir: str, force_reload: bool = False):
     path = os.path.join(repo_dir, _HUBCONF)
     if not os.path.isfile(path):
         raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir}")
     # unique per repo so two hub repos never evict each other's classes
     mod_name = "paddle_tpu_hubconf_" + hashlib.sha1(
         os.path.abspath(repo_dir).encode()).hexdigest()[:12]
+    if force_reload:
+        sys.modules.pop(mod_name, None)
     if mod_name in sys.modules:
         return sys.modules[mod_name]
     spec = importlib.util.spec_from_file_location(mod_name, path)
@@ -42,7 +44,7 @@ def _load_hubconf(repo_dir: str):
     return mod
 
 
-def _resolve(repo_dir: str, source: str):
+def _resolve(repo_dir: str, source: str, force_reload: bool = False):
     if source not in ("local", "github", "gitee"):
         raise ValueError(
             f"unknown source {source!r}; expected local/github/gitee")
@@ -50,20 +52,20 @@ def _resolve(repo_dir: str, source: str):
         raise RuntimeError(
             "remote hub sources need network access, unavailable in this "
             "build; clone the repo and use source='local'")
-    return _load_hubconf(os.path.expanduser(repo_dir))
+    return _load_hubconf(os.path.expanduser(repo_dir), force_reload)
 
 
 def list(repo_dir, source="github", force_reload=False):  # noqa: A001
     """Entrypoint names exposed by the repo's hubconf
     (reference ``hub.py`` list)."""
-    mod = _resolve(repo_dir, source)
+    mod = _resolve(repo_dir, source, force_reload)
     return [n for n in dir(mod)
             if callable(getattr(mod, n)) and not n.startswith("_")]
 
 
 def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
     """Docstring of a hub entrypoint (reference ``hub.py`` help)."""
-    mod = _resolve(repo_dir, source)
+    mod = _resolve(repo_dir, source, force_reload)
     fn = getattr(mod, model, None)
     if fn is None or not callable(fn):
         raise RuntimeError(f"entrypoint {model!r} not found in hubconf")
@@ -72,7 +74,7 @@ def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
 
 def load(repo_dir, model, source="github", force_reload=False, **kwargs):
     """Instantiate a hub entrypoint (reference ``hub.py`` load)."""
-    mod = _resolve(repo_dir, source)
+    mod = _resolve(repo_dir, source, force_reload)
     fn = getattr(mod, model, None)
     if fn is None or not callable(fn):
         raise RuntimeError(f"entrypoint {model!r} not found in hubconf")

@@ -1,10 +1,11 @@
 """paddle.jit: dygraph-to-static == trace-and-compile with XLA.
 
 Reference parity: ``python/paddle/fluid/dygraph/jit.py:161`` @to_static
-(declarative), ``:529`` save, ``:901`` load, TracedLayer; the AST-transform
-suite (``dygraph_to_static/``) is unnecessary here — Python control flow is
-resolved during jax tracing, matching dy2static's net effect with XLA as
-the "Program".
+(declarative), ``:529`` save, ``:901`` load, TracedLayer.  Python control
+flow over *concrete* values resolves during jax tracing; tensor-dependent
+``if``/``while``/``for range``/bool ops are AST-converted by
+``jit.dy2static`` into ``lax.cond``/``lax.while_loop`` (the reference's
+``dygraph_to_static/`` suite re-targeted at XLA structured control flow).
 
 Input-spec caching mirrors ``program_translator.py:144`` CacheKey: one
 compiled executable per (shapes, dtypes, training-mode) signature.
@@ -67,7 +68,8 @@ class StaticFunction:
     """
 
     def __init__(self, fn, layer: Optional[Layer] = None, input_spec=None):
-        self._fn = fn
+        from .dy2static import convert_to_static
+        self._fn = convert_to_static(fn)
         self._layer = layer
         self._input_spec = input_spec
         self._cache: Dict[Any, Any] = {}

@@ -1,0 +1,12 @@
+"""dy2static: AST conversion of tensor-dependent Python control flow
+(reference python/paddle/fluid/dygraph/dygraph_to_static/)."""
+from .convert_operators import (UNDEFINED, convert_ifelse,  # noqa: F401
+                                convert_logical_and, convert_logical_not,
+                                convert_logical_or, convert_while_loop,
+                                maybe, range_cond, to_bool)
+from .program_translator import (ProgramTranslator,  # noqa: F401
+                                 convert_to_static)
+
+__all__ = ["ProgramTranslator", "convert_to_static", "convert_ifelse",
+           "convert_while_loop", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not", "UNDEFINED"]

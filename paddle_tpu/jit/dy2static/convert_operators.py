@@ -1,0 +1,250 @@
+"""Runtime control-flow converters for dy2static.
+
+Reference parity: ``fluid/dygraph/dygraph_to_static/convert_operators.py``
+— convert_ifelse / convert_while_loop / convert_logical_{and,or,not}: each
+checks *at runtime* whether the condition is a framework tensor and only
+then lowers to graph control flow, otherwise plain Python runs.
+
+TPU-first: "graph control flow" is ``lax.cond`` / ``lax.while_loop``; a
+condition is graph-bound when its array is a jax tracer (i.e. we are under
+``jax.jit`` tracing).  Branch/loop state is a tuple of local variables; the
+Tensor leaves ride the lax operands, everything else (python scalars,
+strings, None, UNDEFINED) is trace-time static and must agree across
+branches/iterations.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor
+
+__all__ = ["UNDEFINED", "maybe", "first_defined", "convert_ifelse",
+           "convert_while_loop", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not", "range_cond",
+           "to_bool"]
+
+
+class _Undefined:
+    """Sentinel for a variable not yet bound before a branch assigns it
+    (reference dygraph_to_static UndefinedVar)."""
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "UNDEFINED"
+
+    def __bool__(self):
+        raise NameError(
+            "variable is undefined on this control-flow path (assigned in "
+            "only one branch of a converted if/else)")
+
+
+UNDEFINED = _Undefined()
+
+
+def maybe(f: Callable):
+    """Evaluate ``lambda: name`` tolerating unbound names."""
+    try:
+        return f()
+    except (NameError, UnboundLocalError):
+        return UNDEFINED
+
+
+def first_defined(f: Callable, default):
+    """``f()`` if the name is bound, else ``default`` — used to seed a
+    for-loop variable's carry slot with the range start so the traced
+    carry has a stable array type."""
+    try:
+        return f()
+    except (NameError, UnboundLocalError):
+        return default
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x) -> bool:
+    return isinstance(_arr(x), jax.core.Tracer)
+
+
+def to_bool(x) -> bool:
+    a = _arr(x)
+    if isinstance(a, (jnp.ndarray, np.ndarray)):
+        return bool(a)
+    return bool(a)
+
+
+# ---------------------------------------------------------------------------
+# state (un)packing: Tensor/array leaves ride lax operands, rest is static
+# ---------------------------------------------------------------------------
+def _promote_scalars(state: Sequence) -> tuple:
+    """Under trace, python numeric locals (e.g. loop counters) must ride
+    the lax carry as arrays — they may differ per branch/iteration."""
+    return tuple(jnp.asarray(v) if isinstance(v, (bool, int, float))
+                 else v for v in state)
+
+
+def _split_state(state: Sequence) -> Tuple[List, List, List]:
+    """-> (operand arrays, per-slot tag, static values).
+    tag: 'T' Tensor operand, 'A' raw array operand, 'S' static."""
+    ops, tags, statics = [], [], []
+    for v in state:
+        if isinstance(v, Tensor):
+            ops.append(v._data)
+            tags.append("T")
+            statics.append(None)
+        elif isinstance(v, (jnp.ndarray, jax.core.Tracer)):
+            ops.append(v)
+            tags.append("A")
+            statics.append(None)
+        else:
+            tags.append("S")
+            statics.append(v)
+    return ops, tags, statics
+
+
+def _merge_state(ops: Sequence, tags: Sequence[str], statics: Sequence):
+    out, i = [], 0
+    for tag, st in zip(tags, statics):
+        if tag == "T":
+            out.append(Tensor(ops[i]))
+            i += 1
+        elif tag == "A":
+            out.append(ops[i])
+            i += 1
+        else:
+            out.append(st)
+    return tuple(out)
+
+
+def _statics_match(a, b) -> bool:
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if x is y:
+            continue
+        try:
+            if x != y:
+                return False
+        except Exception:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# converters
+# ---------------------------------------------------------------------------
+def convert_ifelse(pred, true_fn, false_fn, init_vars: tuple):
+    """``if`` over a traced tensor -> lax.cond; python otherwise
+    (reference convert_operators.convert_ifelse)."""
+    if not _is_traced(pred):
+        return true_fn(init_vars) if to_bool(pred) else false_fn(init_vars)
+
+    ops0, tags0, statics0 = _split_state(_promote_scalars(init_vars))
+    rec = {}
+
+    def wrap(branch, key):
+        def b(ops):
+            out = branch(_merge_state(ops, tags0, statics0))
+            o, t, s = _split_state(_promote_scalars(tuple(out)))
+            rec[key] = (t, s)
+            return tuple(o)
+        return b
+
+    p = jnp.reshape(jnp.asarray(_arr(pred)).astype(bool), ())
+    out_ops = lax.cond(p, wrap(true_fn, "t"), wrap(false_fn, "f"),
+                       tuple(ops0))
+    t_tags, t_statics = rec["t"]
+    f_tags, f_statics = rec["f"]
+    if t_tags != f_tags or not _statics_match(t_statics, f_statics):
+        raise TypeError(
+            "converted if/else branches disagree on non-tensor state "
+            f"(true: {t_statics}, false: {f_statics}); only Tensor "
+            "variables may differ between traced branches")
+    return _merge_state(list(out_ops), t_tags, t_statics)
+
+
+def convert_while_loop(cond_fn, body_fn, init_vars: tuple):
+    """``while`` -> lax.while_loop when the condition (or any loop var)
+    is traced; python loop otherwise
+    (reference convert_operators.convert_while_loop)."""
+    traced = any(_is_traced(v) for v in init_vars) or \
+        _is_traced(cond_fn(init_vars))
+    if not traced:
+        vars_ = tuple(init_vars)
+        while to_bool(cond_fn(vars_)):
+            vars_ = tuple(body_fn(vars_))
+        return vars_
+
+    ops0, tags0, statics0 = _split_state(_promote_scalars(init_vars))
+    rec = {}
+
+    def cond(ops):
+        c = cond_fn(_merge_state(ops, tags0, statics0))
+        return jnp.reshape(jnp.asarray(_arr(c)).astype(bool), ())
+
+    def body(ops):
+        out = body_fn(_merge_state(ops, tags0, statics0))
+        o, t, s = _split_state(_promote_scalars(tuple(out)))
+        rec["body"] = (t, s)
+        return tuple(o)
+
+    out_ops = lax.while_loop(cond, body, tuple(ops0))
+    b_tags, b_statics = rec["body"]
+    if b_tags != tags0 or not _statics_match(b_statics, statics0):
+        raise TypeError(
+            "converted while body changed non-tensor loop state "
+            f"({statics0} -> {b_statics}); only Tensor variables may "
+            "change across traced iterations")
+    return _merge_state(list(out_ops), tags0, statics0)
+
+
+def convert_logical_and(lhs_fn: Callable, rhs_fn: Callable):
+    """``a and b`` with python short-circuit preserved when untraced
+    (reference convert_operators.convert_logical_and)."""
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        if not to_bool(lhs):
+            return lhs
+        return rhs_fn()
+    rhs = rhs_fn()
+    return Tensor(jnp.logical_and(jnp.asarray(_arr(lhs)).astype(bool),
+                                  jnp.asarray(_arr(rhs)).astype(bool)))
+
+
+def convert_logical_or(lhs_fn: Callable, rhs_fn: Callable):
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        if to_bool(lhs):
+            return lhs
+        return rhs_fn()
+    rhs = rhs_fn()
+    return Tensor(jnp.logical_or(jnp.asarray(_arr(lhs)).astype(bool),
+                                 jnp.asarray(_arr(rhs)).astype(bool)))
+
+
+def convert_logical_not(x):
+    if not _is_traced(x):
+        return not to_bool(x)
+    return Tensor(jnp.logical_not(jnp.asarray(_arr(x)).astype(bool)))
+
+
+def range_cond(i, stop, step):
+    """Loop-continue predicate of a converted ``for i in range(...)`` —
+    correct for either sign of step, traced or not."""
+    ia, sa, st = _arr(i), _arr(stop), _arr(step)
+    if any(isinstance(a, jax.core.Tracer) for a in (ia, sa, st)):
+        ia, sa, st = (jnp.asarray(a) for a in (ia, sa, st))
+        return Tensor(jnp.where(st > 0, ia < sa, ia > sa))
+    return (ia < sa) if st > 0 else (ia > sa)

@@ -1,0 +1,128 @@
+"""Function-level conversion driver.
+
+Reference parity: ``dygraph_to_static/program_translator.py:768``
+ProgramTranslator (global enable switch, conversion cache) and
+``convert_call_func.py`` (fallback when source is unavailable).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import linecache
+import textwrap
+import threading
+from typing import Callable, Dict
+
+from . import convert_operators
+from .transformers import transform_ast, _JST
+
+__all__ = ["ProgramTranslator", "convert_to_static"]
+
+_cache: Dict[Callable, Callable] = {}
+_lock = threading.Lock()
+
+
+class ProgramTranslator:
+    """Global switch (reference program_translator.py:768); singleton."""
+    _instance = None
+    _enabled = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static: bool):
+        type(self)._enabled = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return type(self)._enabled
+
+
+def _closure_vars(fn) -> dict:
+    if fn.__closure__ is None:
+        return {}
+    out = {}
+    for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+        try:
+            out[name] = cell.cell_contents
+        except ValueError:  # empty cell
+            pass
+    return out
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """AST-convert ``fn`` so tensor-dependent control flow traces into
+    lax.cond/while_loop.  Falls back to ``fn`` unchanged when source is
+    unavailable (builtins, lambdas, C extensions) or the translator is
+    disabled — mirroring convert_call's fallback."""
+    if not ProgramTranslator._enabled:
+        return fn
+    if getattr(fn, "_not_to_static", False) or \
+            getattr(fn, "_pt_converted", False):
+        return fn
+    raw = fn.__func__ if inspect.ismethod(fn) else fn
+    if not inspect.isfunction(raw):
+        return fn
+    with _lock:
+        if raw in _cache:
+            converted = _cache[raw]
+        else:
+            converted = _convert_function(raw)
+            _cache[raw] = converted
+    if converted is raw:
+        return fn
+    if inspect.ismethod(fn):
+        return converted.__get__(fn.__self__, type(fn.__self__))
+    return converted
+
+
+def _convert_function(fn) -> Callable:
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []  # avoid re-applying @to_static on exec
+    tree = transform_ast(tree)
+
+    filename = f"<dy2static {fn.__module__}.{fn.__qualname__}>"
+    code_src = ast.unparse(tree)
+    # make the generated source inspectable in tracebacks
+    linecache.cache[filename] = (len(code_src), None,
+                                 code_src.splitlines(True), filename)
+    # a dict subclass deferring misses to the LIVE module globals: helpers
+    # defined after the decorated function, self-recursion, and later
+    # monkeypatches all resolve correctly (a plain snapshot would not)
+    class _LiveGlobals(dict):
+        def __missing__(self, k):
+            return fn.__globals__[k]
+
+    namespace = _LiveGlobals()
+    namespace.update(_closure_vars(fn))
+    namespace[_JST] = convert_operators
+    namespace["__builtins__"] = fn.__globals__.get(
+        "__builtins__", __builtins__)
+    try:
+        code = compile(ast.parse(code_src), filename, "exec")
+        exec(code, namespace)
+    except Exception:
+        return fn
+    converted = namespace[fn.__name__]
+    converted.__defaults__ = fn.__defaults__
+    converted.__kwdefaults__ = fn.__kwdefaults__
+    converted._pt_converted = True
+    converted._pt_original = fn
+    functools.update_wrapper(converted, fn,
+                             assigned=("__module__", "__name__",
+                                       "__qualname__", "__doc__"))
+    return converted

@@ -1,0 +1,369 @@
+"""AST transformers rewriting Python control flow to converter calls.
+
+Reference parity: ``fluid/dygraph/dygraph_to_static/`` —
+``ifelse_transformer.py``, ``loop_transformer.py``,
+``logical_transformer.py``, orchestrated by ``program_translator.py:768``.
+
+The rewrite is semantics-preserving for plain Python (each converter
+falls back to native control flow when the condition is concrete) and
+lifts tensor-dependent ``if``/``while``/``for range``/``and/or/not`` into
+``lax.cond``/``lax.while_loop`` under tracing.
+
+Scoping model: a statement's *assigned names* become the branch/loop
+state tuple; names only read resolve through the closure of the generated
+nested functions.  Constructs the rewrite cannot represent (return/break/
+continue inside the block, attribute/subscript-only mutation) leave the
+statement untouched — concrete conditions still work, traced ones get
+jax's standard tracer error.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+_JST = "_paddle_tpu_jst"  # module alias injected into the function globals
+_COUNTER = [0]
+
+
+def _uid(base: str) -> str:
+    _COUNTER[0] += 1
+    return f"__pt_{base}_{_COUNTER[0]}"
+
+
+def _assigned_names(nodes: List[ast.stmt]) -> Set[str]:
+    """Top-level-and-nested simple Name targets assigned in the block."""
+    out: Set[str] = set()
+    for node in nodes:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and isinstance(
+                                n.ctx, (ast.Store,)):
+                            out.add(n.id)
+            elif isinstance(sub, (ast.For,)):
+                for n in ast.walk(sub.target):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+    return out
+
+
+def _stores_in_stmt(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+            out.add(sub.id)
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            out.add(sub.name)
+    return out
+
+
+def _loads_in_node(node: ast.AST) -> Set[str]:
+    return {sub.id for sub in ast.walk(node)
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)}
+
+
+def _read_before_write(pre_exprs: List[ast.expr],
+                       stmts: List[ast.stmt]) -> Set[str]:
+    """Names loaded before their first store, scanning statement order
+    (approximate: within one statement, loads count before its stores)."""
+    written: Set[str] = set()
+    rbw: Set[str] = set()
+    for e in pre_exprs:
+        rbw |= _loads_in_node(e)
+    for stmt in stmts:
+        rbw |= (_loads_in_node(stmt) - written)
+        written |= _stores_in_stmt(stmt)
+    return rbw
+
+
+def _loads_with_pos(tree: ast.AST):
+    return [(sub.id, getattr(sub, "lineno", None)) for sub in ast.walk(tree)
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)]
+
+
+def _has_escape(nodes) -> bool:
+    """Return/break/continue that would escape this block.  Never descends
+    into nested function scopes (their returns are theirs); break/continue
+    additionally stop at nested loops (they bind to the inner loop)."""
+    def scan(node, in_loop_scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return False
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            return True
+        if isinstance(node, (ast.Break, ast.Continue)):
+            return not in_loop_scope
+        nested_loop = in_loop_scope or isinstance(node,
+                                                  (ast.For, ast.While))
+        return any(scan(c, nested_loop) for c in ast.iter_child_nodes(node))
+    return any(scan(n, False) for n in nodes)
+
+
+def _names_expr(names: List[str]) -> ast.expr:
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+                     ctx=ast.Load())
+
+
+def _names_target(names: List[str]) -> ast.expr:
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                     ctx=ast.Store())
+
+
+def _unpack_stmt(names: List[str], src: str) -> ast.stmt:
+    return ast.Assign(targets=[_names_target(names)],
+                      value=ast.Name(id=src, ctx=ast.Load()))
+
+
+def _init_tuple(names: List[str]) -> ast.expr:
+    """(maybe(lambda: a), maybe(lambda: b), ...) — tolerates unbound."""
+    elts = []
+    for n in names:
+        lam = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=ast.Name(id=n, ctx=ast.Load()))
+        elts.append(_jst_call("maybe", [lam]))
+    return ast.Tuple(elts=elts, ctx=ast.Load())
+
+
+def _jst_call(fn: str, args: List[ast.expr]) -> ast.expr:
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                           attr=fn, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _make_fn(name: str, param: str, body: List[ast.stmt]) -> ast.stmt:
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(posonlyargs=[],
+                           args=[ast.arg(arg=param)], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=body, decorator_list=[])
+
+
+class LogicalTransformer(ast.NodeTransformer):
+    """a and b / a or b / not a -> short-circuit-preserving converters."""
+
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        fn = "convert_logical_and" if isinstance(node.op, ast.And) \
+            else "convert_logical_or"
+        expr = node.values[-1]
+        for value in reversed(node.values[:-1]):
+            lam_l = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=value)
+            lam_r = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=expr)
+            expr = _jst_call(fn, [lam_l, lam_r])
+        return expr
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """if/while/for-range -> convert_ifelse / convert_while_loop.
+
+    State selection is liveness-aware: an assigned name joins the carried
+    tuple only if it is read before its first write inside the construct
+    (its incoming value matters) or read anywhere after the construct
+    (its outgoing value matters).  Pure branch/iteration temporaries stay
+    local to the generated functions.
+    """
+
+    def __init__(self, all_loads):
+        super().__init__()
+        self._loads = all_loads
+        self._loop_stack: List[ast.AST] = []
+
+    def _live_after(self, node) -> Set[str]:
+        end = getattr(node, "end_lineno", None)
+        if end is None:
+            live = {n for n, _ in self._loads}
+        else:
+            live = {n for n, ln in self._loads if ln is None or ln > end}
+        # loop back-edge: anything read anywhere in an enclosing loop is
+        # re-read on the next iteration, so it is live after this node
+        for loop in self._loop_stack:
+            live |= _loads_in_node(loop)
+        return live
+
+    @staticmethod
+    def _clean(names: Set[str]) -> List[str]:
+        return sorted(n for n in names if not n.startswith("__pt_"))
+
+    def visit_If(self, node: ast.If):
+        live = self._live_after(node)
+        rbw = _read_before_write([], list(node.body)) | \
+            _read_before_write([], list(node.orelse))
+        assigned = _assigned_names(node.body) | _assigned_names(node.orelse)
+        state = self._clean(assigned & (live | rbw))
+        self.generic_visit(node)
+        # tail-return pattern: both branches end in `return expr` (and have
+        # no other escapes) -> return convert_ifelse(...) directly
+        if (node.body and node.orelse
+                and isinstance(node.body[-1], ast.Return)
+                and isinstance(node.orelse[-1], ast.Return)
+                and node.body[-1].value is not None
+                and node.orelse[-1].value is not None
+                and not _has_escape(node.body[:-1])
+                and not _has_escape(node.orelse[:-1])):
+            return self._tail_return_if(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node
+        names = state
+        if not names:
+            return node
+        tf, ff, param = _uid("true_fn"), _uid("false_fn"), _uid("vars")
+        true_body = [_unpack_stmt(names, param)] + list(node.body) + \
+            [ast.Return(value=_names_expr(names))]
+        false_body = [_unpack_stmt(names, param)] + \
+            (list(node.orelse) or [ast.Pass()]) + \
+            [ast.Return(value=_names_expr(names))]
+        call = _jst_call("convert_ifelse",
+                         [node.test,
+                          ast.Name(id=tf, ctx=ast.Load()),
+                          ast.Name(id=ff, ctx=ast.Load()),
+                          _init_tuple(names)])
+        return [_make_fn(tf, param, true_body),
+                _make_fn(ff, param, false_body),
+                ast.Assign(targets=[_names_target(names)], value=call)]
+
+    def _tail_return_if(self, node: ast.If):
+        tf, ff, param = _uid("true_fn"), _uid("false_fn"), _uid("vars")
+        ret = _uid("ret")
+        true_body = list(node.body[:-1]) + \
+            [ast.Return(value=ast.Tuple(elts=[node.body[-1].value],
+                                        ctx=ast.Load()))]
+        false_body = list(node.orelse[:-1]) + \
+            [ast.Return(value=ast.Tuple(elts=[node.orelse[-1].value],
+                                        ctx=ast.Load()))]
+        call = _jst_call("convert_ifelse",
+                         [node.test,
+                          ast.Name(id=tf, ctx=ast.Load()),
+                          ast.Name(id=ff, ctx=ast.Load()),
+                          ast.Tuple(elts=[], ctx=ast.Load())])
+        return [
+            _make_fn(tf, param, true_body),
+            _make_fn(ff, param, false_body),
+            ast.Assign(
+                targets=[ast.Tuple(elts=[ast.Name(id=ret, ctx=ast.Store())],
+                                   ctx=ast.Store())],
+                value=call),
+            ast.Return(value=ast.Name(id=ret, ctx=ast.Load()))]
+
+    def visit_While(self, node: ast.While):
+        live = self._live_after(node)
+        rbw = _read_before_write([node.test], list(node.body))
+        assigned = _assigned_names(node.body)
+        state = self._clean(assigned & (live | rbw))
+        self._loop_stack.append(node)
+        self.generic_visit(node)
+        self._loop_stack.pop()
+        if _has_escape(node.body) or node.orelse:
+            return node
+        names = state
+        if not names:
+            return node
+        cf, bf, param = _uid("cond_fn"), _uid("body_fn"), _uid("vars")
+        cond_body = [_unpack_stmt(names, param),
+                     ast.Return(value=node.test)]
+        body_body = [_unpack_stmt(names, param)] + list(node.body) + \
+            [ast.Return(value=_names_expr(names))]
+        call = _jst_call("convert_while_loop",
+                         [ast.Name(id=cf, ctx=ast.Load()),
+                          ast.Name(id=bf, ctx=ast.Load()),
+                          _init_tuple(names)])
+        return [_make_fn(cf, param, cond_body),
+                _make_fn(bf, param, body_body),
+                ast.Assign(targets=[_names_target(names)], value=call)]
+
+    def visit_For(self, node: ast.For):
+        live = self._live_after(node)
+        rbw = _read_before_write([], list(node.body))
+        assigned = _assigned_names(node.body)
+        state = self._clean(assigned & (live | rbw))
+        self._loop_stack.append(node)
+        self.generic_visit(node)
+        self._loop_stack.pop()
+        # only `for <name> in range(...)` without escapes
+        if _has_escape(node.body) or node.orelse:
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and isinstance(node.target, ast.Name)):
+            return node
+        i = node.target.id
+        rargs = it.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+        elif len(rargs) == 3:
+            start, stop, step = rargs
+        else:
+            return node
+        # an internal counter drives the loop; the user variable is bound at
+        # the top of each iteration, so after the loop it holds the last
+        # *iterated* value (python for semantics), and an empty range never
+        # binds it
+        it_v = _uid("it")
+        names = sorted(set(state) | {i}) + [it_v]
+        stop_v, step_v = _uid("stop"), _uid("step")
+        cf, bf, param = _uid("cond_fn"), _uid("body_fn"), _uid("vars")
+        cond_body = [
+            _unpack_stmt(names, param),
+            ast.Return(value=_jst_call(
+                "range_cond", [ast.Name(id=it_v, ctx=ast.Load()),
+                               ast.Name(id=stop_v, ctx=ast.Load()),
+                               ast.Name(id=step_v, ctx=ast.Load())]))]
+        bind_i = ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+                            value=ast.Name(id=it_v, ctx=ast.Load()))
+        incr = ast.AugAssign(target=ast.Name(id=it_v, ctx=ast.Store()),
+                             op=ast.Add(),
+                             value=ast.Name(id=step_v, ctx=ast.Load()))
+        body_body = [_unpack_stmt(names, param), bind_i] + \
+            list(node.body) + [incr, ast.Return(value=_names_expr(names))]
+        # the user loop var's slot seeds from the counter start when it was
+        # unbound, keeping the traced carry type stable
+        init = _init_tuple(names)
+        i_lam = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=ast.Name(id=i, ctx=ast.Load()))
+        init.elts[names.index(i)] = _jst_call(
+            "first_defined", [i_lam, ast.Name(id=it_v, ctx=ast.Load())])
+        call = _jst_call("convert_while_loop",
+                         [ast.Name(id=cf, ctx=ast.Load()),
+                          ast.Name(id=bf, ctx=ast.Load()),
+                          init])
+        return [
+            ast.Assign(targets=[ast.Name(id=stop_v, ctx=ast.Store())],
+                       value=stop),
+            ast.Assign(targets=[ast.Name(id=step_v, ctx=ast.Store())],
+                       value=step),
+            ast.Assign(targets=[ast.Name(id=it_v, ctx=ast.Store())],
+                       value=start),
+            _make_fn(cf, param, cond_body),
+            _make_fn(bf, param, body_body),
+            ast.Assign(targets=[_names_target(names)], value=call)]
+
+
+def transform_ast(tree: ast.AST) -> ast.AST:
+    tree = LogicalTransformer().visit(tree)
+    tree = ControlFlowTransformer(_loads_with_pos(tree)).visit(tree)
+    ast.fix_missing_locations(tree)
+    return tree

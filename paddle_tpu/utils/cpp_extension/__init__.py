@@ -61,7 +61,10 @@ def _compile(name: str, sources: Sequence[str], build_dir: str,
     # staleness inputs: user sources, the bundled ABI header, and the
     # flag set (hashed into the artifact name so flag changes rebuild)
     header = os.path.join(_HERE, "paddle_tpu_ext.h")
-    tag = hashlib.sha1(" ".join(extra_cflags or []).encode()).hexdigest()[:8]
+    # identity = flags + source paths, so same-named extensions from
+    # different projects sharing the cache dir never collide
+    ident = " ".join(list(extra_cflags or []) + srcs)
+    tag = hashlib.sha1(ident.encode()).hexdigest()[:8]
     so = os.path.join(build_dir, f"{name}.{tag}.so")
     newest = max(os.path.getmtime(p) for p in srcs + [header])
     if os.path.exists(so) and os.path.getmtime(so) >= newest:
@@ -75,6 +78,16 @@ def _compile(name: str, sources: Sequence[str], build_dir: str,
         raise RuntimeError(f"compilation of {name} failed:\n{r.stderr}")
     os.replace(so + ".tmp", so)
     return so
+
+
+def _check_dtypes(opname: str, arrays) -> None:
+    for i, a in enumerate(arrays):
+        if np.dtype(jnp.result_type(a)) not in _DTYPE_CODE:
+            supported = ", ".join(str(d) for d in _DTYPE_CODE)
+            raise TypeError(
+                f"custom op '{opname}': input {i} has unsupported dtype "
+                f"{jnp.result_type(a)}; the C ABI supports [{supported}] "
+                "— cast before the call (e.g. bfloat16 -> float32)")
 
 
 def _make_struct(arr: np.ndarray, shape_holder: list) -> _PTETensor:
@@ -142,6 +155,7 @@ class ExtensionModule:
         return [avals[0]]
 
     def _callback(self, opname, arrays):
+        _check_dtypes(opname, arrays)
         out_avals = self._out_avals(opname, arrays)
         fn = self._host_call(self._ops[opname], out_avals)
         return jax.pure_callback(fn, tuple(out_avals), *arrays,

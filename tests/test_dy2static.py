@@ -1,0 +1,254 @@
+"""dy2static AST control-flow conversion tests (reference:
+tests/unittests/dygraph_to_static/ — dygraph vs converted-static parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import (ProgramTranslator, convert_to_static)
+
+
+def _f32(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+# -- plain python semantics preserved ---------------------------------------
+def test_converted_fn_python_semantics():
+    def f(x, flag):
+        if flag > 0:
+            y = x + 1
+        else:
+            y = x - 1
+        s = 0
+        for i in range(3):
+            s = s + i
+        while s > 2:
+            s = s - 1
+        return y, s
+
+    g = convert_to_static(f)
+    assert g is not f and getattr(g, "_pt_converted", False)
+    y, s = g(10, 1)
+    assert (y, s) == (11, 2)
+    y, s = g(10, -1)
+    assert (y, s) == (9, 2)
+
+
+def test_logical_ops_python():
+    def f(a, b):
+        return (a and b), (a or b), (not a)
+
+    g = convert_to_static(f)
+    assert g(True, False) == (False, True, False)
+    assert g(0, 5) == (0, 5, True)
+
+
+# -- tensor-dependent control flow under trace ------------------------------
+def test_tensor_if_under_jit():
+    @to_static
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x * 2
+        else:
+            y = x - 10
+        return y
+
+    xp = np.array([1.0, 2.0], np.float32)
+    np.testing.assert_allclose(f(_f32(xp)).numpy(), xp * 2)
+    xn = np.array([-5.0, 1.0], np.float32)
+    np.testing.assert_allclose(f(_f32(xn)).numpy(), xn - 10)
+
+
+def test_tensor_while_under_jit():
+    @to_static
+    def f(x):
+        # halve until the sum drops below 1 (classic dynamic loop)
+        while paddle.sum(x) > 1.0:
+            x = x / 2.0
+        return x
+
+    out = f(_f32([8.0, 8.0]))
+    assert float(np.sum(out.numpy())) <= 1.0
+    # oracle: sums 16 -> 8 -> 4 -> 2 -> 1, stop (four halvings)
+    np.testing.assert_allclose(out.numpy(), [0.5, 0.5])
+
+
+def test_tensor_for_range_under_jit():
+    @to_static
+    def f(x, n):
+        acc = paddle.zeros_like(x)
+        for i in range(n):
+            acc = acc + x
+        return acc
+
+    # n arrives as a tensor -> trip count is traced
+    out = f(_f32([1.0, 2.0]), paddle.to_tensor(np.int32(4)))
+    np.testing.assert_allclose(out.numpy(), [4.0, 8.0])
+
+
+def test_tensor_logical_under_jit():
+    @to_static
+    def f(x):
+        if (paddle.sum(x) > 0) and (paddle.max(x) < 10):
+            return x + 100
+        else:
+            return x - 100
+
+    np.testing.assert_allclose(f(_f32([1.0])).numpy(), [101.0])
+    np.testing.assert_allclose(f(_f32([20.0])).numpy(), [-80.0])
+    np.testing.assert_allclose(f(_f32([-1.0])).numpy(), [-101.0])
+
+
+def test_if_defines_var_single_branch_ok_when_used_in_branch_only():
+    @to_static
+    def f(x):
+        y = x * 0
+        if paddle.sum(x) > 0:
+            t = x + 1
+            y = t * 2
+        return y
+
+    np.testing.assert_allclose(f(_f32([3.0])).numpy(), [8.0])
+    np.testing.assert_allclose(f(_f32([-3.0])).numpy(), [0.0])
+
+
+def test_layer_forward_with_tensor_if():
+    import paddle_tpu.nn as nn
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if paddle.mean(h) > 0:
+                out = paddle.relu(h)
+            else:
+                out = h * 0.1
+            return out
+
+    paddle.seed(0)
+    net = to_static(Net())
+    x = _f32(np.random.RandomState(0).randn(2, 4))
+    out = net(x)
+    assert tuple(out.shape) == (2, 4)
+    # eager oracle (same weights, python branch)
+    net2 = Net()
+    net2.set_state_dict(net.state_dict())
+    h = net2.fc(x)
+    expect = paddle.relu(h) if float(paddle.mean(h).numpy()) > 0 \
+        else h * 0.1
+    np.testing.assert_allclose(out.numpy(), expect.numpy(), rtol=1e-5)
+
+
+def test_nested_if_in_while():
+    @to_static
+    def f(x):
+        steps = paddle.zeros([], "float32")
+        while paddle.sum(x) > 1.0:
+            if paddle.max(x) > 4.0:
+                x = x / 4.0
+            else:
+                x = x / 2.0
+            steps = steps + 1
+        return x, steps
+
+    out, steps = f(_f32([16.0]))
+    # 16 -(÷4)-> 4 -(÷2, 4 not >4)-> 2 -(÷2)-> 1: three steps, sum==1 stops
+    assert float(steps.numpy()) == 3.0
+    np.testing.assert_allclose(out.numpy(), [1.0])
+
+
+def test_translator_disable():
+    tr = ProgramTranslator()
+    tr.enable(False)
+    try:
+        def f(x):
+            if x > 0:
+                return 1
+            return 0
+        g = convert_to_static(f)
+        assert g is f
+    finally:
+        tr.enable(True)
+
+
+def test_escape_constructs_left_untouched():
+    def f(x):
+        for i in range(3):
+            if i == 2:
+                break
+        if x > 0:
+            return x  # return inside if -> untransformed
+        return -x
+
+    g = convert_to_static(f)
+    assert g(5) == 5 and g(-5) == 5
+
+
+def test_loop_backedge_liveness():
+    # `s` is only read BEFORE the if inside the loop body; the back-edge
+    # makes it live, so the branch's write to s must be carried
+    def f(x):
+        s = 1.0
+        acc = 0.0
+        for i in range(3):
+            acc = acc + s
+            if x > 0:
+                acc = acc + 1.0
+                s = acc * 2.0
+        return acc
+
+    g = convert_to_static(f)
+    assert g(5) == f(5) == 22.0
+    assert g(-5) == f(-5) == 3.0
+
+
+def test_for_loop_var_final_value():
+    def f(x):
+        s = 0
+        for i in range(3):
+            s = s + x
+        return s * i  # python leaves i at the last iterate (2)
+
+    g = convert_to_static(f)
+    assert g(2.0) == f(2.0) == 12.0
+
+
+def test_late_bound_global_and_recursion():
+    g = convert_to_static(_uses_late_helper)
+    assert g(3.0) == 7.0
+    r = convert_to_static(_recursive_sum)
+    assert r(4) == 10
+
+
+def _uses_late_helper(x):
+    if x > 0:
+        y = _late_helper(x)
+    else:
+        y = 0.0
+    return y
+
+
+def _late_helper(x):  # defined after its (converted) caller
+    return x * 2 + 1
+
+
+def _recursive_sum(n):
+    if n <= 0:
+        return 0
+    return n + _recursive_sum(n - 1)
+
+
+def test_static_mismatch_raises():
+    @to_static
+    def f(x):
+        if paddle.sum(x) > 0:
+            mode = "a"
+        else:
+            mode = "b"
+        return x, mode
+
+    with pytest.raises(Exception, match="non-tensor|disagree"):
+        f(_f32([1.0]))
