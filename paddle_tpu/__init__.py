@@ -58,6 +58,7 @@ from . import autograd  # noqa: F401
 from . import distribution  # noqa: F401
 from . import text  # noqa: F401
 from . import hub  # noqa: F401
+from . import sparsity  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import device  # noqa: F401
 from . import incubate  # noqa: F401

@@ -15,6 +15,7 @@ is the compiler pass.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -136,6 +137,62 @@ def barrier_worker():
     collective.barrier()
 
 
+# -- parameter-server lifecycle (reference fleet_base.py init_server /
+# run_server / init_worker / stop_worker; backed by the ps.py shim) -------
+_ps_server = None
+_ps_client = None
+
+
+def init_server(*model_paths, **kwargs):
+    """Build this role's PS shard from the env contract (reference
+    fleet_base.py init_server).  Tables are added by the caller through
+    the returned server before run_server()."""
+    global _ps_server
+    from .ps import PSServer, role_from_env
+    role, eps, tid = role_from_env()
+    endpoint = kwargs.get("endpoint")
+    # shard index: explicit PADDLE_PSERVER_ID, else the per-process id the
+    # launcher assigns (PADDLE_TRAINER_ID serves both roles in launch.py)
+    idx = int(os.environ.get("PADDLE_PSERVER_ID", str(tid)) or 0)
+    if endpoint is None:
+        if not eps:
+            raise RuntimeError(
+                "init_server needs PADDLE_PSERVERS_IP_PORT_LIST or an "
+                "explicit endpoint=")
+        endpoint = eps[idx]
+    _ps_server = PSServer(endpoint, shard_id=idx)
+    if model_paths:
+        # tables are restored from <path>/shard<idx>.pkl when the server
+        # starts (after the caller registers its tables)
+        _ps_server._pending_load = model_paths[0]
+    return _ps_server
+
+
+def run_server():
+    """Serve until stopped (reference fleet_base.py run_server)."""
+    if _ps_server is None:
+        raise RuntimeError("call fleet.init_server() first")
+    _ps_server.run()
+
+
+def init_worker():
+    """Connect this trainer to the PS shards (reference init_worker)."""
+    global _ps_client
+    from .ps import PSClient, role_from_env
+    _, eps, _ = role_from_env()
+    if not eps:
+        raise RuntimeError("init_worker needs PADDLE_PSERVERS_IP_PORT_LIST")
+    _ps_client = PSClient(eps)
+    return _ps_client
+
+
+def stop_worker():
+    global _ps_client
+    if _ps_client is not None:
+        _ps_client.close()
+        _ps_client = None
+
+
 class _Fleet:
     """Object-style facade (`from paddle.distributed import fleet;
     fleet.init(...)` and `fleet.distributed_model(...)` both work)."""
@@ -148,6 +205,10 @@ class _Fleet:
     worker_num = staticmethod(worker_num)
     is_first_worker = staticmethod(is_first_worker)
     barrier_worker = staticmethod(barrier_worker)
+    init_server = staticmethod(init_server)
+    run_server = staticmethod(run_server)
+    init_worker = staticmethod(init_worker)
+    stop_worker = staticmethod(stop_worker)
     DistributedStrategy = DistributedStrategy
 
 

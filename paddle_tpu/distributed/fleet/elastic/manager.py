@@ -176,12 +176,18 @@ class FileStore(Store):
         for name in os.listdir(self.root):
             path = os.path.join(self.root, name)
             try:
+                st_before = os.stat(path)
                 with open(path) as f:
                     exp = json.load(f).get("expire")
             except (OSError, json.JSONDecodeError):
                 continue
             if exp is not None and now > exp + grace:
+                # shrink the read→unlink race window: a concurrent owner
+                # refresh (os.replace) bumps mtime, so re-stat and skip if
+                # the file changed since we judged it expired
                 try:
+                    if os.stat(path).st_mtime_ns != st_before.st_mtime_ns:
+                        continue
                     os.unlink(path)
                 except OSError:
                     pass

@@ -2,3 +2,7 @@
 not program rewrites; see hybrid_optimizers module doc)."""
 from .hybrid_optimizers import (HybridParallelOptimizer,  # noqa: F401
                                 DygraphShardingOptimizer)
+from .strategy_optimizers import (GradientMergeOptimizer,  # noqa: F401
+                                  LocalSGDOptimizer,
+                                  FP16AllReduceOptimizer,
+                                  DGCMomentumOptimizer)

@@ -1,0 +1,417 @@
+"""Parameter-server stack (interface-compatible shim).
+
+Reference parity: ``paddle/fluid/distributed/`` — ``PSClient``
+(``service/ps_client.h:62``, async push/pull futures :107,:209),
+``BrpcPsServer`` (``service/brpc_ps_server.h:40``), tables
+(``table/common_sparse_table.h:111`` pull/push_sparse,
+``common_dense_table``), sparse SGD rules (``table/sparse_sgd_rule.h``),
+and the fleet facade's init_server/init_worker/run_server lifecycle
+(``fleet/base/fleet_base.py``).
+
+TPU-first scoping (SURVEY §7e): the full brpc/CTR stack is out of scope;
+this is a functional small-scale PS with the same interface — a threaded
+TCP server with a length-prefixed pickle protocol instead of brpc, dense
+tables as jnp arrays, sparse tables as hash maps with lazy row init and
+pluggable SGD rules, sparse keys sharded across servers by hash.  Dense
+training on TPU should use the collective path; the PS exists for the
+sparse-embedding workloads the reference serves (recsys-style lookup
+tables too large for device memory).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["SparseSGDRule", "NaiveSGDRule", "AdagradSGDRule", "DenseTable",
+           "SparseTable", "PSServer", "PSClient", "role_from_env"]
+
+
+# ---------------------------------------------------------------------------
+# SGD rules (reference table/sparse_sgd_rule.h)
+# ---------------------------------------------------------------------------
+class SparseSGDRule:
+    def update(self, value: np.ndarray, grad: np.ndarray,
+               state: dict) -> np.ndarray:
+        raise NotImplementedError
+
+
+class NaiveSGDRule(SparseSGDRule):
+    def __init__(self, learning_rate: float = 0.05):
+        self.lr = float(learning_rate)
+
+    def update(self, value, grad, state):
+        return value - self.lr * grad
+
+
+class AdagradSGDRule(SparseSGDRule):
+    def __init__(self, learning_rate: float = 0.05, epsilon: float = 1e-8):
+        self.lr = float(learning_rate)
+        self.eps = float(epsilon)
+
+    def update(self, value, grad, state):
+        g2 = state.setdefault("g2sum", np.zeros_like(value))
+        g2 += grad * grad
+        return value - self.lr * grad / (np.sqrt(g2) + self.eps)
+
+
+# ---------------------------------------------------------------------------
+# tables
+# ---------------------------------------------------------------------------
+class DenseTable:
+    """reference table/common_dense_table.h."""
+
+    def __init__(self, shape, initializer="zeros", rule=None):
+        self._value = np.zeros(shape, np.float32) if initializer == "zeros" \
+            else np.random.RandomState(0).normal(
+                0, 0.01, size=shape).astype(np.float32)
+        self._rule = rule or NaiveSGDRule()
+        self._state: dict = {}
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._value.copy()
+
+    def push(self, grad: np.ndarray):
+        with self._lock:
+            self._value = self._rule.update(self._value,
+                                            np.asarray(grad, np.float32),
+                                            self._state)
+
+    def set(self, value: np.ndarray):
+        with self._lock:
+            self._value = np.asarray(value, np.float32)
+
+    def state(self):
+        with self._lock:
+            return {"value": self._value, "opt": self._state}
+
+    def load_state(self, st):
+        with self._lock:
+            self._value = st["value"]
+            self._state = st["opt"]
+
+
+class SparseTable:
+    """Hash-map embedding table with lazy row init
+    (reference table/common_sparse_table.h:111,:151-176)."""
+
+    def __init__(self, dim: int, rule=None, init_std: float = 0.01,
+                 seed: int = 0):
+        self.dim = int(dim)
+        self._rows: Dict[int, np.ndarray] = {}
+        self._states: Dict[int, dict] = {}
+        self._rule = rule or NaiveSGDRule()
+        self._init_std = init_std
+        self._seed = seed
+        self._lock = threading.Lock()
+
+    def _row(self, key: int) -> np.ndarray:
+        row = self._rows.get(key)
+        if row is None:
+            rng = np.random.RandomState((self._seed * 1_000_003 + key)
+                                        % (2 ** 31))
+            row = rng.normal(0, self._init_std, self.dim).astype(np.float32)
+            self._rows[key] = row
+        return row
+
+    def pull(self, keys: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._row(int(k)) for k in keys])
+
+    def push(self, keys: Sequence[int], grads: np.ndarray):
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            # duplicate keys in one batch accumulate (reference push_sparse)
+            acc: Dict[int, np.ndarray] = {}
+            for k, g in zip(keys, grads):
+                k = int(k)
+                acc[k] = acc[k] + g if k in acc else g.copy()
+            for k, g in acc.items():
+                st = self._states.setdefault(k, {})
+                self._rows[k] = self._rule.update(self._row(k), g, st)
+
+    def __len__(self):
+        return len(self._rows)
+
+    def state(self):
+        with self._lock:
+            return {"rows": dict(self._rows), "states": dict(self._states)}
+
+    def load_state(self, st):
+        with self._lock:
+            self._rows = dict(st["rows"])
+            self._states = dict(st["states"])
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: 8-byte length prefix + pickle
+# ---------------------------------------------------------------------------
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    (n,) = struct.unpack("<Q", header)
+    body = _recv_exact(sock, n)
+    return pickle.loads(body) if body is not None else None
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+class PSServer:
+    """One PS shard (reference brpc_ps_server.h:40).  Hosts the tables
+    whose shard index maps to this server."""
+
+    def __init__(self, endpoint: str, shard_id: int = 0):
+        host, port = endpoint.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self.shard_id = int(shard_id)
+        self._tables: Dict[str, object] = {}
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition()
+        self._pending_load: Optional[str] = None
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def add_dense_table(self, name: str, shape, rule=None):
+        self._tables[name] = DenseTable(shape, rule=rule)
+
+    def add_sparse_table(self, name: str, dim: int, rule=None, seed=0):
+        self._tables[name] = SparseTable(dim, rule=rule, seed=seed)
+
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "pull_dense":
+            return self._tables[msg[1]].pull()
+        if op == "push_dense":
+            self._tables[msg[1]].push(msg[2])
+            return True
+        if op == "set_dense":
+            self._tables[msg[1]].set(msg[2])
+            return True
+        if op == "pull_sparse":
+            return self._tables[msg[1]].pull(msg[2])
+        if op == "push_sparse":
+            self._tables[msg[1]].push(msg[2], msg[3])
+            return True
+        if op == "barrier":
+            target = msg[1]
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count >= target:
+                    # release this generation and start a fresh one
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                    return True
+                # wait until this generation is released; error on timeout
+                # instead of silently proceeding unsynchronized
+                deadline = 60.0
+                released = self._barrier_cv.wait_for(
+                    lambda: self._barrier_gen != gen, timeout=deadline)
+                if not released:
+                    self._barrier_count = max(0, self._barrier_count - 1)
+                    raise TimeoutError(
+                        f"barrier timed out after {deadline}s waiting for "
+                        f"{target} workers")
+            return True
+        if op == "save":
+            with open(msg[1], "wb") as f:
+                pickle.dump({n: t.state()
+                             for n, t in self._tables.items()}, f,
+                            protocol=4)
+            return True
+        if op == "load":
+            with open(msg[1], "rb") as f:
+                states = pickle.load(f)
+            for n, st in states.items():
+                if n in self._tables:
+                    self._tables[n].load_state(st)
+            return True
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown ps op {op!r}")
+
+    def start(self):
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    msg = _recv_msg(self.request)
+                    if msg is None:
+                        return
+                    try:
+                        out = ("ok", outer._handle(msg))
+                    except Exception as e:  # surface errors to the client
+                        out = ("err", f"{type(e).__name__}: {e}")
+                    _send_msg(self.request, out)
+
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        self._server = socketserver.ThreadingTCPServer(
+            (self._host, self._port), Handler)
+        if self._pending_load:
+            # restore this shard's tables from a fleet.init_server(path)
+            shard_file = os.path.join(self._pending_load,
+                                      f"shard{self.shard_id}.pkl")
+            if os.path.exists(shard_file):
+                self._handle(("load", shard_file))
+            self._pending_load = None
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def run(self):
+        """Blocking variant (reference run_server)."""
+        self.start()
+        self._thread.join()
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+class PSClient:
+    """Sync + future-returning async pull/push against a server list
+    (reference ps_client.h:62, async futures :107,:209).  Sparse keys
+    shard across servers by ``key % n_servers``; dense tables live on
+    ``hash(name) % n_servers``."""
+
+    def __init__(self, endpoints: List[str]):
+        self._endpoints = list(endpoints)
+        self._socks: Dict[str, socket.socket] = {}
+        self._locks: Dict[str, threading.Lock] = {}
+        self._pool = ThreadPoolExecutor(max_workers=4)
+
+    def _sock(self, ep: str) -> socket.socket:
+        if ep not in self._socks:
+            host, port = ep.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=60)
+            self._socks[ep] = s
+            self._locks[ep] = threading.Lock()
+        return self._socks[ep]
+
+    def _call(self, ep: str, msg):
+        sock = self._sock(ep)
+        with self._locks[ep]:
+            _send_msg(sock, msg)
+            resp = _recv_msg(sock)
+        if resp is None:
+            raise ConnectionError(f"ps server {ep} closed the connection")
+        status, payload = resp
+        if status != "ok":
+            raise RuntimeError(f"ps server {ep}: {payload}")
+        return payload
+
+    def _dense_ep(self, table: str) -> str:
+        idx = int.from_bytes(table.encode(), "little") % len(self._endpoints)
+        return self._endpoints[idx]
+
+    # -- dense -------------------------------------------------------------
+    def pull_dense(self, table: str) -> np.ndarray:
+        return self._call(self._dense_ep(table), ("pull_dense", table))
+
+    def push_dense(self, table: str, grad: np.ndarray) -> None:
+        self._call(self._dense_ep(table), ("push_dense", table,
+                                           np.asarray(grad, np.float32)))
+
+    def set_dense(self, table: str, value: np.ndarray) -> None:
+        self._call(self._dense_ep(table), ("set_dense", table,
+                                           np.asarray(value, np.float32)))
+
+    def push_dense_async(self, table: str, grad) -> Future:
+        return self._pool.submit(self.push_dense, table, grad)
+
+    # -- sparse ------------------------------------------------------------
+    def pull_sparse(self, table: str, keys: Sequence[int]) -> np.ndarray:
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        n = len(self._endpoints)
+        out = None
+        for shard in range(n):
+            idx = np.nonzero(keys % n == shard)[0]
+            if idx.size == 0:
+                continue
+            rows = self._call(self._endpoints[shard],
+                              ("pull_sparse", table, keys[idx]))
+            if out is None:
+                out = np.zeros((keys.size, rows.shape[1]), np.float32)
+            out[idx] = rows
+        return out if out is not None else np.zeros((0, 0), np.float32)
+
+    def push_sparse(self, table: str, keys, grads) -> None:
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        n = len(self._endpoints)
+        for shard in range(n):
+            idx = np.nonzero(keys % n == shard)[0]
+            if idx.size:
+                self._call(self._endpoints[shard],
+                           ("push_sparse", table, keys[idx], grads[idx]))
+
+    def push_sparse_async(self, table: str, keys, grads) -> Future:
+        return self._pool.submit(self.push_sparse, table, keys, grads)
+
+    # -- control -----------------------------------------------------------
+    def barrier(self, n_workers: int):
+        self._call(self._endpoints[0], ("barrier", n_workers))
+
+    def save(self, dirname: str):
+        os.makedirs(dirname, exist_ok=True)
+        for i, ep in enumerate(self._endpoints):
+            self._call(ep, ("save", os.path.join(dirname, f"shard{i}.pkl")))
+
+    def load(self, dirname: str):
+        for i, ep in enumerate(self._endpoints):
+            self._call(ep, ("load", os.path.join(dirname, f"shard{i}.pkl")))
+
+    def close(self):
+        self._pool.shutdown(wait=True)
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()
+
+
+def role_from_env():
+    """(role, endpoints, trainer_id) from the reference launcher env
+    (PADDLE_TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST /
+    PADDLE_TRAINER_ID — fleet/launch.py:349 launch_ps contract)."""
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER").upper()
+    eps = [e for e in os.environ.get(
+        "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+    tid = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    return role, eps, tid

@@ -179,12 +179,15 @@ def convert_while_loop(cond_fn, body_fn, init_vars: tuple):
     """``while`` -> lax.while_loop when the condition (or any loop var)
     is traced; python loop otherwise
     (reference convert_operators.convert_while_loop)."""
-    traced = any(_is_traced(v) for v in init_vars) or \
-        _is_traced(cond_fn(init_vars))
-    if not traced:
+    # the probe evaluation doubles as the first real test so conditions
+    # with python side effects run exactly as often as in eager mode
+    first = cond_fn(init_vars)
+    if not (any(_is_traced(v) for v in init_vars) or _is_traced(first)):
         vars_ = tuple(init_vars)
-        while to_bool(cond_fn(vars_)):
+        c = first
+        while to_bool(c):
             vars_ = tuple(body_fn(vars_))
+            c = cond_fn(vars_)
         return vars_
 
     ops0, tags0, statics0 = _split_state(_promote_scalars(init_vars))

@@ -45,16 +45,10 @@ class ProgramTranslator:
         return type(self)._enabled
 
 
-def _closure_vars(fn) -> dict:
+def _closure_cells(fn) -> dict:
     if fn.__closure__ is None:
         return {}
-    out = {}
-    for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
-        try:
-            out[name] = cell.cell_contents
-        except ValueError:  # empty cell
-            pass
-    return out
+    return dict(zip(fn.__code__.co_freevars, fn.__closure__))
 
 
 def convert_to_static(fn: Callable) -> Callable:
@@ -100,15 +94,23 @@ def _convert_function(fn) -> Callable:
     # make the generated source inspectable in tracebacks
     linecache.cache[filename] = (len(code_src), None,
                                  code_src.splitlines(True), filename)
-    # a dict subclass deferring misses to the LIVE module globals: helpers
-    # defined after the decorated function, self-recursion, and later
-    # monkeypatches all resolve correctly (a plain snapshot would not)
+    # a dict subclass deferring misses to live closure cells, then the
+    # LIVE module globals: helpers defined after the decorated function,
+    # self-recursion, nonlocal mutations, and later monkeypatches all
+    # resolve correctly (a plain snapshot would not)
+    cells = _closure_cells(fn)
+
     class _LiveGlobals(dict):
         def __missing__(self, k):
+            cell = cells.get(k)
+            if cell is not None:
+                try:
+                    return cell.cell_contents
+                except ValueError:
+                    raise KeyError(k)
             return fn.__globals__[k]
 
     namespace = _LiveGlobals()
-    namespace.update(_closure_vars(fn))
     namespace[_JST] = convert_operators
     namespace["__builtins__"] = fn.__globals__.get(
         "__builtins__", __builtins__)

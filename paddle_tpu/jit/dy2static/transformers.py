@@ -35,7 +35,8 @@ def _assigned_names(nodes: List[ast.stmt]) -> Set[str]:
     out: Set[str] = set()
     for node in nodes:
         for sub in ast.walk(node):
-            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                ast.NamedExpr)):
                 targets = sub.targets if isinstance(sub, ast.Assign) \
                     else [sub.target]
                 for t in targets:
@@ -48,6 +49,26 @@ def _assigned_names(nodes: List[ast.stmt]) -> Set[str]:
                     if isinstance(n, ast.Name):
                         out.add(n.id)
     return out
+
+
+
+def _has_walrus(node: ast.AST) -> bool:
+    return any(isinstance(s, ast.NamedExpr) for s in ast.walk(node))
+
+
+def _has_unconvertible_bindings(nodes) -> bool:
+    """def/class/import/with-as bindings inside the block can't ride the
+    carried state tuple — leave such constructs untransformed."""
+    for node in nodes:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef, ast.Import, ast.ImportFrom,
+                                ast.Global, ast.Nonlocal)):
+                return True
+            if isinstance(sub, ast.withitem) and sub.optional_vars \
+                    is not None:
+                return True
+    return False
 
 
 def _stores_in_stmt(stmt: ast.stmt) -> Set[str]:
@@ -210,6 +231,10 @@ class ControlFlowTransformer(ast.NodeTransformer):
             _read_before_write([], list(node.orelse))
         assigned = _assigned_names(node.body) | _assigned_names(node.orelse)
         state = self._clean(assigned & (live | rbw))
+        # computed pre-visit: child transforms inject FunctionDefs of ours
+        # (walrus in the test mutates state the branch fns can't carry)
+        convertible = not _has_unconvertible_bindings(
+            node.body + node.orelse) and not _has_walrus(node.test)
         self.generic_visit(node)
         # tail-return pattern: both branches end in `return expr` (and have
         # no other escapes) -> return convert_ifelse(...) directly
@@ -221,7 +246,8 @@ class ControlFlowTransformer(ast.NodeTransformer):
                 and not _has_escape(node.body[:-1])
                 and not _has_escape(node.orelse[:-1])):
             return self._tail_return_if(node)
-        if _has_escape(node.body) or _has_escape(node.orelse):
+        if _has_escape(node.body) or _has_escape(node.orelse) or \
+                not convertible:
             return node
         names = state
         if not names:
@@ -269,10 +295,14 @@ class ControlFlowTransformer(ast.NodeTransformer):
         rbw = _read_before_write([node.test], list(node.body))
         assigned = _assigned_names(node.body)
         state = self._clean(assigned & (live | rbw))
+        # a walrus in the condition mutates state outside the carried
+        # tuple every evaluation — unconvertible
+        convertible = not _has_unconvertible_bindings(node.body) and \
+            not _has_walrus(node.test)
         self._loop_stack.append(node)
         self.generic_visit(node)
         self._loop_stack.pop()
-        if _has_escape(node.body) or node.orelse:
+        if _has_escape(node.body) or node.orelse or not convertible:
             return node
         names = state
         if not names:
@@ -295,11 +325,12 @@ class ControlFlowTransformer(ast.NodeTransformer):
         rbw = _read_before_write([], list(node.body))
         assigned = _assigned_names(node.body)
         state = self._clean(assigned & (live | rbw))
+        convertible = not _has_unconvertible_bindings(node.body)
         self._loop_stack.append(node)
         self.generic_visit(node)
         self._loop_stack.pop()
         # only `for <name> in range(...)` without escapes
-        if _has_escape(node.body) or node.orelse:
+        if _has_escape(node.body) or node.orelse or not convertible:
             return node
         it = node.iter
         if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)

@@ -1,0 +1,276 @@
+"""ASP — automatic structured (n:m) sparsity (``paddle.static.sparsity``
+/ fleet ASP parity).
+
+Reference parity: ``python/paddle/fluid/contrib/sparsity/`` —
+``utils.py:87`` calculate_density, ``:137`` check_mask_1d, ``:181``
+get_mask_1d, ``:264/:314/:422`` 2d variants, ``:475`` create_mask,
+``:537`` check_sparsity; ``asp.py:110`` decorate, ``:149`` prune_model,
+``:31/:72`` excluded-layer registry.
+
+TPU-first: masks are jnp arrays applied as a pure elementwise multiply
+that XLA fuses into the weight's consumer matmul; the ASP-decorated
+optimizer re-applies masks after each step (the reference appends masking
+ops to the program — here it's a post-step hook in eager mode and a
+mask-multiply folded into the jitted update in functional mode).
+2:4 weights feed the MXU densely today; the mask discipline keeps models
+convertible to sparse acceleration when available.
+"""
+from __future__ import annotations
+
+import itertools
+from enum import Enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["MaskAlgo", "CheckMethod", "calculate_density", "check_mask_1d",
+           "get_mask_1d", "check_mask_2d", "get_mask_2d_greedy",
+           "get_mask_2d_best", "create_mask", "check_sparsity",
+           "set_excluded_layers", "reset_excluded_layers", "decorate",
+           "prune_model", "ASPHelper"]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo: MaskAlgo):
+        return CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D \
+            else CheckMethod.CHECK_2D
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (reference ``utils.py:87``)."""
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(a)) / a.size
+
+
+def _reshape_1d(mat: np.ndarray, m: int):
+    pad = (m - mat.shape[1] % m) % m
+    padded = np.concatenate(
+        [mat, np.zeros((mat.shape[0], pad), mat.dtype)], axis=1)
+    return padded.reshape(-1, m), padded.shape
+
+
+def check_mask_1d(mat, n: int, m: int) -> bool:
+    """Every m-wide row chunk has >= n zeros (reference ``utils.py:137``)."""
+    mat = np.asarray(mat)
+    groups, _ = _reshape_1d(mat, m)
+    return bool(((groups == 0).sum(axis=1) >= n).all())
+
+
+def get_mask_1d(mat, n: int, m: int) -> np.ndarray:
+    """Keep the (m-n) largest |values| of each m-chunk
+    (reference ``utils.py:181``)."""
+    mat = np.asarray(mat)
+    groups, padded_shape = _reshape_1d(mat, m)
+    keep = m - n
+    order = np.argsort(np.abs(groups), axis=1)
+    mask = np.zeros_like(groups)
+    rows = np.arange(groups.shape[0])[:, None]
+    mask[rows, order[:, -keep:]] = 1.0
+    mask = mask.reshape(padded_shape)[:, : mat.shape[1]]
+    return mask.astype(mat.dtype)
+
+
+def _reshape_2d(mat: np.ndarray, m: int):
+    pad_r = (m - mat.shape[0] % m) % m
+    pad_c = (m - mat.shape[1] % m) % m
+    padded = np.pad(mat, ((0, pad_r), (0, pad_c)))
+    H, W = padded.shape
+    blocks = padded.reshape(H // m, m, W // m, m).transpose(0, 2, 1, 3)
+    return blocks.reshape(-1, m, m), padded.shape
+
+
+def check_mask_2d(mat, n: int, m: int) -> bool:
+    """Every m x m block is n:m sparse along BOTH axes
+    (reference ``utils.py:264``)."""
+    mat = np.asarray(mat)
+    blocks, _ = _reshape_2d(mat, m)
+    nz = blocks != 0
+    return bool((nz.sum(axis=1) <= m - n).all() and
+                (nz.sum(axis=2) <= m - n).all())
+
+
+def get_mask_2d_greedy(mat, n: int, m: int) -> np.ndarray:
+    """Greedy 2d mask: pick largest entries under per-row/col budgets
+    (reference ``utils.py:314``)."""
+    mat = np.asarray(mat)
+    blocks, padded_shape = _reshape_2d(mat, m)
+    keep = m - n
+    masks = np.zeros_like(blocks)
+    for b in range(blocks.shape[0]):
+        absb = np.abs(blocks[b])
+        order = np.dstack(np.unravel_index(
+            np.argsort(-absb, axis=None), (m, m)))[0]
+        row_cnt = np.zeros(m, np.int64)
+        col_cnt = np.zeros(m, np.int64)
+        for r, c in order:
+            if row_cnt[r] < keep and col_cnt[c] < keep:
+                masks[b, r, c] = 1.0
+                row_cnt[r] += 1
+                col_cnt[c] += 1
+    return _blocks_to_mat(masks, padded_shape, mat, m)
+
+
+_PATTERNS_CACHE: Dict[tuple, np.ndarray] = {}
+
+
+def _valid_2d_patterns(n: int, m: int) -> np.ndarray:
+    """All m x m 0/1 patterns with exactly (m-n) ones per row and per
+    column (reference ``utils.py:384``)."""
+    key = (n, m)
+    if key in _PATTERNS_CACHE:
+        return _PATTERNS_CACHE[key]
+    keep = m - n
+    rows = [np.array(p) for p in itertools.product([0, 1], repeat=m)
+            if sum(p) == keep]
+    pats = []
+    for combo in itertools.product(range(len(rows)), repeat=m):
+        pat = np.stack([rows[i] for i in combo])
+        if (pat.sum(axis=0) == keep).all():
+            pats.append(pat)
+    out = np.stack(pats).astype(np.float64)
+    _PATTERNS_CACHE[key] = out
+    return out
+
+
+def get_mask_2d_best(mat, n: int, m: int) -> np.ndarray:
+    """Exhaustive best 2d pattern per block (reference ``utils.py:422``)."""
+    mat = np.asarray(mat)
+    blocks, padded_shape = _reshape_2d(mat, m)
+    pats = _valid_2d_patterns(n, m)
+    scores = np.einsum("bij,pij->bp", np.abs(blocks.astype(np.float64)),
+                       pats)
+    best = pats[np.argmax(scores, axis=1)]
+    return _blocks_to_mat(best.astype(mat.dtype), padded_shape, mat, m)
+
+
+def _blocks_to_mat(blocks, padded_shape, mat, m):
+    H, W = padded_shape
+    out = blocks.reshape(H // m, W // m, m, m).transpose(0, 2, 1, 3)
+    out = out.reshape(H, W)[: mat.shape[0], : mat.shape[1]]
+    return out.astype(mat.dtype)
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4) -> np.ndarray:
+    """n:m mask for a 2D-or-higher weight (reference ``utils.py:475``);
+    >2D tensors are masked over their trailing-2D reshape."""
+    t = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    shape = t.shape
+    if t.ndim < 2:
+        raise ValueError("ASP masks need >= 2D weights")
+    mat = t.reshape(shape[0], -1)
+    fn = globals()[MaskAlgo(func_name).value] if not isinstance(
+        func_name, MaskAlgo) else globals()[func_name.value]
+    mask = fn(mat, n, m)
+    return mask.reshape(shape)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4) -> bool:
+    t = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    mat = t.reshape(t.shape[0], -1)
+    fn = globals()[CheckMethod(func_name).value] if not isinstance(
+        func_name, CheckMethod) else globals()[func_name.value]
+    return fn(mat, n, m)
+
+
+# ---------------------------------------------------------------------------
+# model-level ASP (reference asp.py)
+# ---------------------------------------------------------------------------
+class ASPHelper:
+    """Tracks per-model masks (reference ``asp.py:275``)."""
+
+    _excluded: set = set()
+    MIN_DIM = 32  # reference skips small layers
+
+    @classmethod
+    def supported(cls, name: str, param) -> bool:
+        if name in cls._excluded:
+            return False
+        shape = tuple(param.shape)
+        if len(shape) < 2:
+            return False
+        if shape[0] < cls.MIN_DIM or int(np.prod(shape[1:])) < cls.MIN_DIM:
+            return False
+        # convention: weights only (biases/norm scales are 1D anyway)
+        return True
+
+
+def set_excluded_layers(param_names: List[str], main_program=None):
+    """reference ``asp.py:31`` (program arg kept for signature parity)."""
+    ASPHelper._excluded |= set(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    ASPHelper._excluded = set()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every supported weight of an nn.Layer
+    (reference ``asp.py:149`` prune_model on a Program).  Returns the
+    {param_name: mask} dict used later by the decorated optimizer."""
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
+    masks: Dict[int, jnp.ndarray] = {}
+    for name, p in model.named_parameters():
+        if not ASPHelper.supported(name, p):
+            continue
+        mask = jnp.asarray(create_mask(p, algo, n, m))
+        p._data = p._data * mask.astype(p._data.dtype)
+        if with_mask:
+            masks[id(p)] = mask
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies masks after every step (reference ``asp.py:535``)."""
+
+    def __init__(self, optimizer, masks: Dict[int, jnp.ndarray]):
+        self._opt = optimizer
+        self._masks = masks
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def step(self):
+        self._opt.step()
+        for p in self._opt._parameter_list or []:
+            mask = self._masks.get(id(p))
+            if mask is not None:
+                p._data = p._data * mask.astype(p._data.dtype)
+
+    def minimize(self, loss, *args, **kwargs):
+        out = self._opt.minimize(loss, *args, **kwargs)
+        self.step_masks_only()
+        return out
+
+    def step_masks_only(self):
+        for p in self._opt._parameter_list or []:
+            mask = self._masks.get(id(p))
+            if mask is not None:
+                p._data = p._data * mask.astype(p._data.dtype)
+
+
+def decorate(optimizer, masks: Optional[Dict[int, jnp.ndarray]] = None):
+    """Wrap an optimizer so updates preserve the pruned pattern
+    (reference ``asp.py:110``)."""
+    if masks is None:
+        masks = {}
+        for p in optimizer._parameter_list or []:
+            if len(p.shape) >= 2 and ASPHelper.supported(p.name or "", p):
+                masks[id(p)] = jnp.asarray(
+                    (np.asarray(p._data) != 0).astype(np.float32))
+    return OptimizerWithSparsityGuarantee(optimizer, masks)

@@ -12,6 +12,7 @@ from .program import (Program, default_main_program,  # noqa: F401
                       Executor, CompiledProgram)
 from .io import save_inference_model, load_inference_model  # noqa: F401
 from ..jit import InputSpec  # noqa: F401
+from .. import sparsity  # noqa: F401  (paddle.static.sparsity parity)
 from .. import nn as _nn  # re-export layer helpers commonly used in static
 
 

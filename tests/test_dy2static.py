@@ -241,6 +241,61 @@ def _recursive_sum(n):
     return n + _recursive_sum(n - 1)
 
 
+def test_def_inside_if_left_untouched():
+    def f(x, cond):
+        if cond:
+            mode = 1
+
+            def act(v):
+                return v * 2
+        else:
+            mode = 2
+
+            def act(v):
+                return v + 1
+        return act(x) + mode
+
+    g = convert_to_static(f)
+    assert g(10, True) == f(10, True) == 21
+    assert g(10, False) == f(10, False) == 13
+
+
+def test_walrus_while_cond_side_effects():
+    def f(n):
+        total = 0
+        while (n := n - 1) >= 0:
+            total = total + n
+        return total, n
+
+    g = convert_to_static(f)
+    assert g(4) == f(4) == (6, -1)
+
+
+def test_nonlocal_mutation_visible():
+    n_cell = {"v": 0}
+
+    def outer():
+        n = 0
+
+        def f(x):
+            if x > 0:
+                y = x + n
+            else:
+                y = 0
+            return y
+
+        def bump():
+            nonlocal n
+            n += 1
+        return f, bump
+
+    f, bump = outer()
+    g = convert_to_static(f)
+    assert g(1) == 1
+    bump()
+    assert g(1) == 2  # sees the mutated closure cell
+
+
 def test_static_mismatch_raises():
     @to_static
     def f(x):
