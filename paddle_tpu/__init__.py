@@ -59,6 +59,12 @@ from . import distribution  # noqa: F401
 from . import text  # noqa: F401
 from . import hub  # noqa: F401
 from . import sparsity  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import onnx  # noqa: F401
+from .batch import batch  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import device  # noqa: F401
 from . import incubate  # noqa: F401

@@ -1,0 +1,23 @@
+"""paddle.batch (reference python/paddle/batch.py:18)."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a mini-batch reader
+    (reference ``batch.py:18``)."""
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,7 @@
+"""paddle.callbacks namespace (reference python/paddle/callbacks.py)."""
+from .hapi.callbacks import (Callback, ProgBarLogger,  # noqa: F401
+                             ModelCheckpoint, EarlyStopping, VisualDL,
+                             LRSchedulerCallback as LRScheduler)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "VisualDL"]

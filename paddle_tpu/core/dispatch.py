@@ -17,8 +17,29 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 import jax
 
 from . import autograd
+from ..utils import flags as _flags_mod
 
 __all__ = ["register_kernel", "get_kernel", "dispatch", "KernelKey"]
+
+
+def _debug_check_outputs(op_name, outs):
+    import numpy as _np
+    if _flags_mod.get_flag("FLAGS_check_nan_inf"):
+        for i, o in enumerate(outs):
+            if hasattr(o, "dtype") and jax.numpy.issubdtype(
+                    o.dtype, jax.numpy.floating) and not isinstance(
+                    o, jax.core.Tracer):
+                a = _np.asarray(o)
+                if not _np.isfinite(a).all():
+                    raise FloatingPointError(
+                        f"op '{op_name}' output {i} contains "
+                        f"{'NaN' if _np.isnan(a).any() else 'Inf'} "
+                        f"(FLAGS_check_nan_inf enabled)")
+    elif _flags_mod.get_flag("FLAGS_benchmark"):
+        for o in outs:
+            if hasattr(o, "block_until_ready") and not isinstance(
+                    o, jax.core.Tracer):
+                o.block_until_ready()
 
 
 class KernelKey(Tuple):
@@ -95,6 +116,14 @@ def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
 
     tuple_output = isinstance(out, tuple)
     outs = out if tuple_output else (out,)
+
+    # FLAGS_check_nan_inf: per-op numeric guard (reference
+    # framework/details/nan_inf_utils_detail.cc:559 CheckOpHasNanOrInf);
+    # FLAGS_benchmark: per-op device sync (reference operator.cc:1210).
+    # `debug_ops_active` is a cached module attribute so the common
+    # all-off case costs one attribute read on the hot path.
+    if _flags_mod.debug_ops_active:
+        _debug_check_outputs(op_name, outs)
     wrapped = []
     for i, o in enumerate(outs):
         t = Tensor(o, stop_gradient=(node is None))

@@ -1,0 +1,92 @@
+"""Legacy reader-style datasets (reference python/paddle/dataset/).
+
+Each submodule exposes ``train()`` / ``test()`` generator factories
+("readers") compatible with ``paddle.batch`` and the ``paddle.reader``
+decorators.  Data comes from the same deterministic synthetic corpora as
+``paddle.vision.datasets`` / ``paddle.text`` (zero-egress image — see
+those modules).
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens", "conll05", "wmt14", "wmt16", "common"]
+
+
+def _reader_from_dataset(ds_factory, flatten_image=False):
+    def reader():
+        ds = ds_factory()
+        for i in range(len(ds)):
+            sample = ds[i]
+            if flatten_image:
+                img, label = sample
+                yield (np.asarray(img, np.float32).reshape(-1),
+                       int(np.asarray(label).reshape(-1)[0]))
+            else:
+                yield sample
+    return reader
+
+
+def _module(name: str, members: dict) -> types.ModuleType:
+    mod = types.ModuleType(f"{__name__}.{name}")
+    for k, v in members.items():
+        setattr(mod, k, v)
+    sys.modules[mod.__name__] = mod
+    return mod
+
+
+def _vision(name, cls_name, flatten):
+    def make(mode):
+        def factory():
+            from ..vision import datasets as vd
+            return getattr(vd, cls_name)(mode=mode)
+        return _reader_from_dataset(factory, flatten_image=flatten)
+    return _module(name, {"train": lambda: make("train"),
+                          "test": lambda: make("test")})
+
+
+def _text(name, cls_name, **kwargs):
+    def make(mode):
+        def factory():
+            from .. import text as t
+            return getattr(t, cls_name)(mode=mode, **kwargs)
+        return _reader_from_dataset(factory)
+    members = {"train": lambda **kw: make("train"),
+               "test": lambda **kw: make("test")}
+    return _module(name, members)
+
+
+mnist = _vision("mnist", "MNIST", flatten=True)
+cifar = _module("cifar", {
+    "train10": lambda: _reader_from_dataset(
+        lambda: __import__("paddle_tpu.vision.datasets",
+                           fromlist=["Cifar10"]).Cifar10(mode="train")),
+    "test10": lambda: _reader_from_dataset(
+        lambda: __import__("paddle_tpu.vision.datasets",
+                           fromlist=["Cifar10"]).Cifar10(mode="test")),
+    "train100": lambda: _reader_from_dataset(
+        lambda: __import__("paddle_tpu.vision.datasets",
+                           fromlist=["Cifar100"]).Cifar100(mode="train")),
+    "test100": lambda: _reader_from_dataset(
+        lambda: __import__("paddle_tpu.vision.datasets",
+                           fromlist=["Cifar100"]).Cifar100(mode="test")),
+})
+uci_housing = _text("uci_housing", "UCIHousing")
+imdb = _text("imdb", "Imdb")
+imikolov = _text("imikolov", "Imikolov")
+movielens = _text("movielens", "Movielens")
+conll05 = _text("conll05", "Conll05st")
+wmt14 = _text("wmt14", "WMT14")
+wmt16 = _text("wmt16", "WMT16")
+
+def _common_split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    raise NotImplementedError(
+        "paddle.dataset.common.split is not supported; iterate the reader "
+        "and write chunks directly")
+
+
+common = _module("common", {"split": _common_split})

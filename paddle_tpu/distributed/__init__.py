@@ -23,6 +23,8 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F40
 from .parallel import (DataParallel, shard_batch, param_shardings,  # noqa: F401
                        apply_param_shardings, scale_loss)
 from . import checkpoint  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import shard_tensor, shard_op, reshard  # noqa: F401
 
 
 def __getattr__(name):

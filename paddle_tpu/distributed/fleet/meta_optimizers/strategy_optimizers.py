@@ -46,6 +46,17 @@ class _OptimizerWrapper:
     def __getattr__(self, item):
         return getattr(self._inner, item)
 
+    def minimize(self, loss, *a, **k):
+        # route through THIS wrapper's step (resolving via __getattr__
+        # would silently run the inner optimizer's step and skip the
+        # distributed logic)
+        if loss._grad_node is not None and all(
+                p.grad is None for p in (self._inner._parameter_list or [])):
+            loss.backward()
+        self.step()
+        return None, None
+
+
 
 class GradientMergeOptimizer(_OptimizerWrapper):
     """Accumulate grads for k_steps micro-batches, then apply once
@@ -90,15 +101,6 @@ class GradientMergeOptimizer(_OptimizerWrapper):
         self._inner.clear_grad(set_to_zero)
 
     clear_gradients = clear_grad
-
-    def minimize(self, loss, *a, **k):
-        # same guard as the base Optimizer.minimize: only run backward if
-        # the caller has not already populated gradients
-        if loss._grad_node is not None and all(
-                p.grad is None for p in (self._inner._parameter_list or [])):
-            loss.backward()
-        self.step()
-        return None, None
 
 
 class LocalSGDOptimizer(_OptimizerWrapper):
@@ -192,7 +194,11 @@ class DGCMomentumOptimizer(_OptimizerWrapper):
             g = p.grad._data if isinstance(p.grad, Tensor) else p.grad
             key = id(p)
             if self._step_count <= self.rampup_begin_step:
-                continue  # warmup: plain dense grads
+                # warmup: DENSE averaged allreduce (reference rampup) so
+                # replicas stay synchronized before compression kicks in
+                summed, nranks = _dist_sum(g, self._group)
+                p.grad = Tensor(summed / max(nranks, 1))
+                continue
             u = self._u.get(key, jnp.zeros_like(g))
             v = self._v.get(key, jnp.zeros_like(g))
             # momentum correction (DGC paper eq. 4): accumulate velocity
@@ -202,6 +208,6 @@ class DGCMomentumOptimizer(_OptimizerWrapper):
             send, resid = self._compress(v)
             self._v[key] = resid
             self._u[key] = u * (resid != 0).astype(u.dtype)  # mask clears
-            summed, _ = _dist_sum(send, self._group)
-            p.grad = Tensor(summed)
+            summed, nranks = _dist_sum(send, self._group)
+            p.grad = Tensor(summed / max(nranks, 1))
         self._inner.step()

@@ -312,20 +312,20 @@ class PSClient:
     def __init__(self, endpoints: List[str]):
         self._endpoints = list(endpoints)
         self._socks: Dict[str, socket.socket] = {}
-        self._locks: Dict[str, threading.Lock] = {}
+        # per-endpoint locks exist up-front so concurrent async pushes
+        # can never race the lazy socket creation or interleave frames
+        self._locks: Dict[str, threading.Lock] = {
+            ep: threading.Lock() for ep in self._endpoints}
         self._pool = ThreadPoolExecutor(max_workers=4)
 
-    def _sock(self, ep: str) -> socket.socket:
-        if ep not in self._socks:
-            host, port = ep.rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=60)
-            self._socks[ep] = s
-            self._locks[ep] = threading.Lock()
-        return self._socks[ep]
-
     def _call(self, ep: str, msg):
-        sock = self._sock(ep)
         with self._locks[ep]:
+            sock = self._socks.get(ep)
+            if sock is None:
+                host, port = ep.rsplit(":", 1)
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=60)
+                self._socks[ep] = sock
             _send_msg(sock, msg)
             resp = _recv_msg(sock)
         if resp is None:

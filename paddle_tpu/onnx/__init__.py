@@ -1,0 +1,24 @@
+"""paddle.onnx (reference python/paddle/onnx/export.py).
+
+The reference delegates to the external ``paddle2onnx`` converter.  The
+TPU-native interchange format is StableHLO (what ``jit.save`` /
+``save_inference_model`` emit — portable, versioned, consumed by any
+PJRT runtime), so ``export`` always produces that artifact and returns
+its path; a ``.onnx`` suffix on ``path`` is replaced to make the actual
+format explicit.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` for interchange (reference ``onnx/export.py``
+    export).  Writes the StableHLO artifact at ``path``; the ``.onnx``
+    suffix is replaced to make the format explicit."""
+    base = path[:-5] if path.endswith(".onnx") else path
+    from ..jit import save as jit_save
+    jit_save(layer, base, input_spec=input_spec)
+    return base + ".pdmodel"

@@ -1,4 +1,4 @@
 """paddle.optimizer namespace."""
-from .optimizers import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
+from .optimizers import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Lars, LarsMomentum,
                          Adagrad, Adadelta, RMSProp, Lamb, L2Decay)  # noqa: F401
 from . import lr  # noqa: F401

@@ -25,7 +25,7 @@ from ..core.tensor import Parameter, Tensor
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "LarsMomentum"]
 
 
 from ..regularizer import L1Decay, L2Decay, WeightDecayRegularizer
@@ -126,6 +126,7 @@ class Optimizer:
                       else None)
             if reg is not None and reg.coeff:
                 garr = garr + reg.grad(parr)
+            self._current_param_name = p.name or ""
             new_p, new_state = self._update(parr, garr, state, lr_eff)
             if key in self._master_weights:
                 self._master_weights[key] = new_p
@@ -193,6 +194,7 @@ class Optimizer:
                 else None)
             if reg is not None and reg.coeff:
                 g = g + reg.grad(parr)
+            self._current_param_name = n
             new_p, slots[n] = self._update(parr, g, slots[n], lr)
             if n in master:
                 master[n] = new_p
@@ -257,6 +259,50 @@ class Momentum(Optimizer):
         else:
             new_p = param - lr * v
         return new_p, {"velocity": v}
+
+
+class LarsMomentum(Optimizer):
+    """LARS: momentum with a layer-wise trust ratio scaling the learning
+    rate by ||w|| / (||g|| + wd*||w||) (reference
+    ``operators/optimizers/lars_momentum_op.cu`` +
+    ``fleet/meta_optimizers/lars_optimizer.py``)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, epsilon=1e-9,
+                 exclude_from_weight_decay=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _init_state_for(self, param_arr):
+        return {"velocity": jnp.zeros_like(param_arr)}
+
+    def _update(self, param, grad, state, lr):
+        # excluded layers (bias/norm by name) get plain momentum SGD —
+        # no trust ratio and no weight decay (reference lars_optimizer.py)
+        name = getattr(self, "_current_param_name", "")
+        if any(token in name for token in self._exclude):
+            v = self._momentum * state["velocity"] + lr * grad
+            return param - v, {"velocity": v}
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(param)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(grad)))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm /
+            (g_norm + self._lars_wd * w_norm + self._epsilon),
+            1.0)
+        scaled = lr * local_lr * (grad + self._lars_wd * param)
+        v = self._momentum * state["velocity"] + scaled
+        return param - v, {"velocity": v}
+
+
+Lars = LarsMomentum
 
 
 class Adam(Optimizer):

@@ -1,0 +1,156 @@
+"""Legacy reader decorators (reference python/paddle/reader/decorator.py).
+
+These compose generator-factories ("readers") — the pre-DataLoader data
+pipeline the reference keeps for fleet/dataset workflows.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Materialize once, replay from memory (reference decorator.cache)."""
+    all_data = None
+
+    def cached():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        return iter(all_data)
+
+    return cached
+
+
+def map_readers(func, *readers):
+    """Zip readers and map ``func`` over their tuples."""
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (reference decorator.shuffle)."""
+    def shuffled():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples (reference decorator.compose)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        iterator = zip(*rs) if check_alignment else \
+            itertools.zip_longest(*rs)
+        for outputs in iterator:
+            yield sum((make_tuple(o) for o in outputs), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Producer-thread prefetch buffer (reference decorator.buffered).
+    Reader exceptions propagate to the consumer instead of truncating the
+    stream silently."""
+    end = object()
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for s in reader():
+                    q.put((None, s))
+                q.put((None, end))
+            except BaseException as e:  # re-raised on the consumer side
+                q.put((e, None))
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            err, s = q.get()
+            if err is not None:
+                raise err
+            if s is end:
+                return
+            yield s
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def reader_n():
+        return itertools.islice(reader(), n)
+    return reader_n
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Thread-pool mapped reader (reference decorator.xmap_readers);
+    ``order=True`` preserves input order.  At most ``buffer_size``
+    samples are in flight, so unbounded/streaming readers stay bounded
+    in memory."""
+    import collections
+    from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+
+    def xreader():
+        window = max(1, int(buffer_size))
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            it = reader()
+            if order:
+                pending = collections.deque()
+                for s in it:
+                    pending.append(pool.submit(mapper, s))
+                    if len(pending) >= window:
+                        yield pending.popleft().result()
+                while pending:
+                    yield pending.popleft().result()
+            else:
+                pending = set()
+                for s in it:
+                    pending.add(pool.submit(mapper, s))
+                    if len(pending) >= window:
+                        done, pending = wait(pending,
+                                             return_when=FIRST_COMPLETED)
+                        for f in done:
+                            yield f.result()
+                for f in pending:
+                    yield f.result()
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Round-robin over multiple readers on threads (the reference uses
+    processes; device feeding is host-bound here so threads suffice —
+    heavy decode work should use DataLoader num_workers instead)."""
+    def reader():
+        for group in itertools.zip_longest(*[r() for r in readers]):
+            for s in group:
+                if s is not None:
+                    yield s
+    return reader

@@ -266,11 +266,19 @@ class OptimizerWithSparsityGuarantee:
 
 def decorate(optimizer, masks: Optional[Dict[int, jnp.ndarray]] = None):
     """Wrap an optimizer so updates preserve the pruned pattern
-    (reference ``asp.py:110``)."""
+    (reference ``asp.py:110``).  Without explicit masks the weights must
+    already be pruned — snapshotting a dense weight's nonzero pattern
+    would make the guarantee an all-ones no-op, so that case errors."""
     if masks is None:
         masks = {}
         for p in optimizer._parameter_list or []:
             if len(p.shape) >= 2 and ASPHelper.supported(p.name or "", p):
-                masks[id(p)] = jnp.asarray(
-                    (np.asarray(p._data) != 0).astype(np.float32))
+                pattern = (np.asarray(p._data) != 0).astype(np.float32)
+                if pattern.all():
+                    raise ValueError(
+                        f"decorate() called before pruning: parameter "
+                        f"{p.name or tuple(p.shape)} is fully dense. Call "
+                        "sparsity.prune_model(model) first, or pass its "
+                        "returned masks: decorate(opt, masks)")
+                masks[id(p)] = jnp.asarray(pattern)
     return OptimizerWithSparsityGuarantee(optimizer, masks)

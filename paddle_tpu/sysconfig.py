@@ -1,0 +1,18 @@
+"""paddle.sysconfig (reference python/paddle/sysconfig.py:20,:37)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of the C++ extension headers (the custom-op ABI)."""
+    return os.path.join(_ROOT, "utils", "cpp_extension")
+
+
+def get_lib() -> str:
+    """Directory of compiled native libraries."""
+    return os.path.join(_ROOT, "native")

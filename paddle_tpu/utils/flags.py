@@ -47,6 +47,18 @@ def set_flags(flags: Dict[str, Any]):
             if k not in _FLAGS:
                 raise KeyError(f"unknown flag '{k}'")
             _FLAGS[k] = v
+    _refresh_debug_cache()
+
+
+# cached fast-path predicate for the per-op dispatch hot loop: one module
+# attribute read when the debug flags are all off
+debug_ops_active = False
+
+
+def _refresh_debug_cache():
+    global debug_ops_active
+    debug_ops_active = bool(_FLAGS.get("FLAGS_check_nan_inf") or
+                            _FLAGS.get("FLAGS_benchmark"))
 
 
 def get_flags(names):
@@ -75,3 +87,6 @@ define_flag("FLAGS_init_allocated_mem", False, "parity no-op")
 define_flag("FLAGS_default_dtype", "float32", "default floating dtype")
 define_flag("FLAGS_matmul_precision", "default",
             "jax matmul precision: default|high|highest")
+
+# flags may arrive via env at import time — seed the dispatch fast path
+_refresh_debug_cache()

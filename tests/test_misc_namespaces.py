@@ -1,0 +1,72 @@
+"""batch/reader/dataset/callbacks/sysconfig/onnx namespace tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_batch():
+    r = paddle.batch(lambda: iter(range(10)), batch_size=3)
+    batches = list(r())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    r2 = paddle.batch(lambda: iter(range(10)), batch_size=3, drop_last=True)
+    assert list(r2())[-1] == [6, 7, 8]
+
+
+def test_reader_decorators():
+    from paddle_tpu import reader as R
+    base = lambda: iter(range(20))
+    assert list(R.firstn(base, 5)()) == [0, 1, 2, 3, 4]
+    assert sorted(R.shuffle(base, 8)()) == list(range(20))
+    assert list(R.buffered(base, 4)()) == list(range(20))
+    assert list(R.chain(base, base)()) == list(range(20)) * 2
+    mapped = R.map_readers(lambda a, b: a + b, base, base)
+    assert list(mapped()) == [2 * i for i in range(20)]
+    comp = R.compose(base, base)
+    assert list(comp())[0] == (0, 0)
+    xm = R.xmap_readers(lambda v: v * 10, base, 2, 4, order=True)
+    assert list(xm()) == [i * 10 for i in range(20)]
+    cached = R.cache(base)
+    assert list(cached()) == list(cached())
+
+
+def test_dataset_readers():
+    from paddle_tpu import dataset
+    r = dataset.uci_housing.train()
+    x, y = next(iter(r()))
+    assert x.shape == (13,)
+    img, label = next(iter(dataset.mnist.train()()))
+    assert img.shape == (784,) and isinstance(label, int)
+    doc, lab = next(iter(dataset.imdb.train()()))
+    assert doc.dtype == np.int64
+    # composes with paddle.batch
+    b = paddle.batch(dataset.uci_housing.train(), batch_size=4)
+    first = next(iter(b()))
+    assert len(first) == 4
+
+
+def test_callbacks_namespace():
+    assert hasattr(paddle.callbacks, "EarlyStopping")
+    assert hasattr(paddle.callbacks, "ModelCheckpoint")
+
+
+def test_sysconfig():
+    inc = paddle.sysconfig.get_include()
+    assert os.path.exists(os.path.join(inc, "paddle_tpu_ext.h"))
+    assert os.path.isdir(paddle.sysconfig.get_lib())
+
+
+def test_onnx_export_writes_stablehlo(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import InputSpec
+    net = nn.Linear(4, 2)
+    net.eval()
+    out = paddle.onnx.export(net, str(tmp_path / "m.onnx"),
+                             input_spec=[InputSpec([1, 4], "float32")])
+    assert out.endswith(".pdmodel") and os.path.exists(out)
+    from paddle_tpu.jit import load as jit_load
+    reloaded = jit_load(str(tmp_path / "m"))
+    y = reloaded(paddle.to_tensor(np.ones((1, 4), np.float32)))
+    assert tuple(y.shape) == (1, 2)
