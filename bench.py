@@ -75,7 +75,8 @@ def main():
 
     # warmup/compile
     loss, params, opt_state = step(params, opt_state, ids, labels)
-    jax.block_until_ready(loss)
+    float(loss)
+    jax.block_until_ready(params)
 
     # best-of-3 repetitions: the tunneled chip is shared, so single-window
     # timings vary ~2x with interference; the max is the machine's rate
@@ -85,7 +86,10 @@ def main():
         t0 = time.perf_counter()
         for _ in range(steps):
             loss, params, opt_state = step(params, opt_state, ids, labels)
-        jax.block_until_ready(loss)
+        # force full materialization: through the remote tunnel,
+        # block_until_ready alone can return before the device finishes
+        float(loss)
+        jax.block_until_ready(params)
         dt = time.perf_counter() - t0
         best_dt = dt if best_dt is None else min(best_dt, dt)
 

@@ -55,9 +55,16 @@ def _text(name, cls_name, **kwargs):
             from .. import text as t
             return getattr(t, cls_name)(mode=mode, **kwargs)
         return _reader_from_dataset(factory)
-    members = {"train": lambda **kw: make("train"),
-               "test": lambda **kw: make("test")}
-    return _module(name, members)
+
+    def entry(mode):
+        # reference signatures pass vocab dicts / ngram sizes positionally
+        # (e.g. imdb.train(word_idx), imikolov.train(word_idx, n)); the
+        # synthetic corpora have fixed vocabularies, so those arguments
+        # are accepted for call compatibility but do not alter the data
+        def train_or_test(*_args, **_kwargs):
+            return make(mode)
+        return train_or_test
+    return _module(name, {"train": entry("train"), "test": entry("test")})
 
 
 mnist = _vision("mnist", "MNIST", flatten=True)

@@ -97,7 +97,14 @@ def shard_tensor(x, dist_attr=None, process_mesh: Optional[ProcessMesh] =
     """
     if dist_attr is not None:
         mesh = dist_attr.get("process_mesh") or _default_mesh
+        if mesh is None:
+            raise ValueError(
+                "dist_attr has no process_mesh and no default is set "
+                "(set_default_process_mesh)")
         dims_mapping = dist_attr.get("dims_mapping")
+        if dims_mapping is None:
+            raise ValueError("dist_attr requires a 'dims_mapping' list "
+                             "(-1 = replicated, i = mesh axis index)")
         spec = _spec_from_dims_mapping(mesh, dims_mapping)
     else:
         mesh = process_mesh or _default_mesh
@@ -123,12 +130,16 @@ def shard_op(op_fn, dist_attr=None, process_mesh=None, in_shard_specs=None,
     """Annotate an op's outputs with shardings (reference
     ``interface.py:73``): returns a wrapped callable whose inputs/outputs
     carry the given constraints; GSPMD propagates the rest."""
+    def _pad(specs, n):
+        specs = list(specs)
+        return specs + [None] * (n - len(specs))
+
     def wrapped(*args, **kwargs):
         if in_shard_specs is not None:
             args = tuple(
                 shard_tensor(a, process_mesh=process_mesh, shard_spec=s)
                 if s is not None else a
-                for a, s in zip(args, in_shard_specs))
+                for a, s in zip(args, _pad(in_shard_specs, len(args))))
         out = op_fn(*args, **kwargs)
         if out_shard_specs is None:
             return out
@@ -136,7 +147,7 @@ def shard_op(op_fn, dist_attr=None, process_mesh=None, in_shard_specs=None,
             return type(out)(
                 shard_tensor(o, process_mesh=process_mesh, shard_spec=s)
                 if s is not None else o
-                for o, s in zip(out, out_shard_specs))
+                for o, s in zip(out, _pad(out_shard_specs, len(out))))
         return shard_tensor(out, process_mesh=process_mesh,
                             shard_spec=out_shard_specs[0])
     return wrapped

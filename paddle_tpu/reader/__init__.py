@@ -11,7 +11,8 @@ import random
 import threading
 
 __all__ = ["cache", "map_readers", "buffered", "compose", "chain",
-           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader",
+           "ComposeNotAligned"]
 
 
 def cache(reader):
@@ -57,8 +58,14 @@ def chain(*readers):
     return reader
 
 
+class ComposeNotAligned(ValueError):
+    """reference decorator.ComposeNotAligned."""
+
+
 def compose(*readers, **kwargs):
-    """Zip readers into flat tuples (reference decorator.compose)."""
+    """Zip readers into flat tuples (reference decorator.compose):
+    ``check_alignment=True`` raises ComposeNotAligned when readers have
+    different lengths; ``False`` pads exhausted readers with None."""
     check_alignment = kwargs.pop("check_alignment", True)
 
     def make_tuple(x):
@@ -66,10 +73,17 @@ def compose(*readers, **kwargs):
 
     def reader():
         rs = [r() for r in readers]
-        iterator = zip(*rs) if check_alignment else \
-            itertools.zip_longest(*rs)
-        for outputs in iterator:
-            yield sum((make_tuple(o) for o in outputs), ())
+        if check_alignment:
+            sentinel = object()
+            for outputs in itertools.zip_longest(*rs, fillvalue=sentinel):
+                if any(o is sentinel for o in outputs):
+                    raise ComposeNotAligned(
+                        "readers have different lengths; pass "
+                        "check_alignment=False to pad with None")
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
     return reader
 
 
@@ -149,8 +163,10 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     processes; device feeding is host-bound here so threads suffice —
     heavy decode work should use DataLoader num_workers instead)."""
     def reader():
-        for group in itertools.zip_longest(*[r() for r in readers]):
+        exhausted = object()
+        for group in itertools.zip_longest(*[r() for r in readers],
+                                           fillvalue=exhausted):
             for s in group:
-                if s is not None:
+                if s is not exhausted:
                     yield s
     return reader

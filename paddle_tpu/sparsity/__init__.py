@@ -236,7 +236,11 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
 
 
 class OptimizerWithSparsityGuarantee:
-    """Re-applies masks after every step (reference ``asp.py:535``)."""
+    """Re-applies masks after every step (reference ``asp.py:535``).
+
+    A parameter registered with mask ``None`` is still dense (reference
+    call order decorate -> prune_model): its mask is captured lazily at
+    the first step after pruning zeroes it."""
 
     def __init__(self, optimizer, masks: Dict[int, jnp.ndarray]):
         self._opt = optimizer
@@ -245,7 +249,16 @@ class OptimizerWithSparsityGuarantee:
     def __getattr__(self, item):
         return getattr(self._opt, item)
 
+    def _lazy_capture(self):
+        for p in self._opt._parameter_list or []:
+            key = id(p)
+            if key in self._masks and self._masks[key] is None:
+                pattern = (np.asarray(p._data) != 0).astype(np.float32)
+                if not pattern.all():  # pruned since decorate()
+                    self._masks[key] = jnp.asarray(pattern)
+
     def step(self):
+        self._lazy_capture()
         self._opt.step()
         for p in self._opt._parameter_list or []:
             mask = self._masks.get(id(p))
@@ -274,11 +287,9 @@ def decorate(optimizer, masks: Optional[Dict[int, jnp.ndarray]] = None):
         for p in optimizer._parameter_list or []:
             if len(p.shape) >= 2 and ASPHelper.supported(p.name or "", p):
                 pattern = (np.asarray(p._data) != 0).astype(np.float32)
-                if pattern.all():
-                    raise ValueError(
-                        f"decorate() called before pruning: parameter "
-                        f"{p.name or tuple(p.shape)} is fully dense. Call "
-                        "sparsity.prune_model(model) first, or pass its "
-                        "returned masks: decorate(opt, masks)")
-                masks[id(p)] = jnp.asarray(pattern)
+                # still-dense weight: reference order is decorate ->
+                # prune_model, so register for lazy capture at the first
+                # post-pruning step rather than snapshotting all-ones
+                masks[id(p)] = None if pattern.all() \
+                    else jnp.asarray(pattern)
     return OptimizerWithSparsityGuarantee(optimizer, masks)
