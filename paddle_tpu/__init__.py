@@ -42,6 +42,7 @@ from . import ops  # noqa: F401
 import importlib as _importlib
 
 linalg = _importlib.import_module(".linalg", __name__)
+cond = linalg.cond  # paddle.cond == paddle.linalg.cond (reference export)
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import nn  # noqa: F401

@@ -1,0 +1,266 @@
+"""Remaining paddle.* tensor-namespace ops: in-place variants, tensor
+arrays, misc utilities.
+
+Reference parity: the last exports of ``python/paddle/tensor/__init__.py``
+not covered by the category modules — in-place op variants (``exp_`` ...,
+generated alongside each op by ``pybind/op_function_generator.cc``),
+LoDTensorArray ops (``create_array``/``array_read``/``array_write``/
+``array_length`` over ``fluid/layers/control_flow``), and utilities
+(``add_n``, ``broadcast_*``, ``multiplex``, ``scatter_nd`` ...).
+
+TPU-first: "in-place" rebinds the Tensor's array (XLA arrays are
+immutable; donation recovers the buffer under jit), and a tensor array
+is a plain python list of Tensors (the dynamic-shape LoD machinery has
+no XLA analog — under jit use ``lax.scan`` carries instead).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "add_n", "broadcast_shape", "broadcast_tensors", "diagflat", "diagonal",
+    "floor_mod", "increment", "is_tensor", "multiplex", "rank", "shape",
+    "scatter_nd", "standard_normal", "set_printoptions",
+    "create_array", "array_read", "array_write", "array_length",
+    "exp_", "ceil_", "floor_", "round_", "reciprocal_", "rsqrt_", "sqrt_",
+    "tanh_", "squeeze_", "unsqueeze_", "flatten_", "uniform_", "scatter_", "scale_", "check_shape",
+]
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference sum_op / add_n)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    tensors = [to_tensor(t) for t in inputs]
+    if len(tensors) == 1:
+        # still a fresh tensor (reference add_n never aliases its input)
+        return dispatch("add_n", lambda x: x + 0, tensors, {})
+    return dispatch("add_n", lambda *xs: sum(xs[1:], xs[0]), tensors, {})
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [to_tensor(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in tensors])
+
+    def impl(*xs):
+        return tuple(jnp.broadcast_to(x, shape) for x in xs)
+    return list(dispatch("broadcast_tensors", impl, tensors, {}))
+
+
+def diagflat(x, offset=0, name=None):
+    return dispatch("diagflat",
+                    lambda a: jnp.diagflat(a, k=offset), (to_tensor(x),), {})
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch(
+        "diagonal",
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        (to_tensor(x),), {})
+
+
+def floor_mod(x, y, name=None):
+    from .math import mod
+    return mod(x, y)
+
+
+def increment(x, value=1.0, name=None):
+    """In-place add of a python scalar (reference increment op)."""
+    _inplace_guard(x, "increment")
+    x._data = x._data + jnp.asarray(value, x._data.dtype)
+    return x
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (reference multiplex_op):
+    out[i] = inputs[index[i]][i]."""
+    tensors = [to_tensor(t) for t in inputs]
+    idx = to_tensor(index)
+
+    def impl(ix, *xs):
+        stacked = jnp.stack(xs)            # (n_candidates, B, ...)
+        ix = ix.reshape(-1).astype(jnp.int32)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[ix, rows]
+    return dispatch("multiplex", impl, [idx] + tensors, {})
+
+
+def rank(x, name=None):
+    return Tensor(jnp.asarray(to_tensor(x).ndim, jnp.int32))
+
+
+def shape(x, name=None):
+    return Tensor(jnp.asarray(tuple(to_tensor(x).shape), jnp.int32))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Scatter updates into zeros of ``shape`` (reference scatter_nd_op)."""
+    index, updates = to_tensor(index), to_tensor(updates)
+    out_shape = tuple(int(s) for s in shape)
+
+    def impl(ix, up):
+        zeros = jnp.zeros(out_shape, up.dtype)
+        return zeros.at[tuple(jnp.moveaxis(ix, -1, 0))].add(up)
+    return dispatch("scatter_nd", impl, (index, updates), {})
+
+
+def standard_normal(shape, dtype=None, name=None):
+    from .creation import randn
+    return randn(shape, dtype=dtype)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr options (reference set_printoptions — numpy-backed)."""
+    kwargs = {}
+    if precision is not None:
+        kwargs["precision"] = precision
+    if threshold is not None:
+        kwargs["threshold"] = threshold
+    if edgeitems is not None:
+        kwargs["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kwargs["linewidth"] = linewidth
+    if sci_mode is not None:
+        kwargs["suppress"] = not sci_mode
+    np.set_printoptions(**kwargs)
+
+
+# -- tensor arrays (LoDTensorArray ≡ python list) ---------------------------
+def create_array(dtype="float32", initialized_list=None):
+    """reference fluid/layers create_array; a plain list here."""
+    return list(initialized_list) if initialized_list else []
+
+
+def array_write(x, i, array=None):
+    x = to_tensor(x)
+    i = int(i.item()) if isinstance(i, Tensor) else int(i)
+    if i < 0:
+        raise ValueError(f"array_write index must be >= 0, got {i}")
+    if array is None:
+        array = []
+    while len(array) <= i:
+        array.append(None)
+    array[i] = x
+    return array
+
+
+def array_read(array, i):
+    i = int(i.item()) if isinstance(i, Tensor) else int(i)
+    return array[i]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int32))
+
+
+# -- in-place variants ------------------------------------------------------
+
+def _inplace_guard(x, opname):
+    """In-place mutation cannot be represented on the identity-linked
+    tape (the reference raises the same way: a Var that requires grad
+    can't use the inplace strategy)."""
+    from ..core import autograd as _ag
+    if _ag.is_grad_enabled() and not x.stop_gradient:
+        raise RuntimeError(
+            f"{opname}: in-place update of a tensor that requires grad is "
+            "unsupported; use the out-of-place op or wrap in "
+            "paddle.no_grad()")
+
+
+def _inplace(op_name, fn):
+    def op(x, *args, name=None, **kwargs):
+        _inplace_guard(x, op_name)
+        x._data = fn(x._data, *args, **kwargs)
+        return x
+    op.__name__ = op_name
+    op.__qualname__ = op_name
+    op.__doc__ = (f"In-place {op_name[:-1]} (rebinds the tensor's array; "
+                  "XLA buffers are immutable)")
+    return op
+
+
+exp_ = _inplace("exp_", jnp.exp)
+ceil_ = _inplace("ceil_", jnp.ceil)
+floor_ = _inplace("floor_", jnp.floor)
+round_ = _inplace("round_", jnp.round)
+reciprocal_ = _inplace("reciprocal_", jnp.reciprocal)
+rsqrt_ = _inplace("rsqrt_", jax.lax.rsqrt)
+sqrt_ = _inplace("sqrt_", jnp.sqrt)
+tanh_ = _inplace("tanh_", jnp.tanh)
+
+
+def squeeze_(x, axis=None, name=None):
+    _inplace_guard(x, "squeeze_")
+    from .manipulation import squeeze
+    x._data = squeeze(Tensor(x._data), axis=axis)._data
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    _inplace_guard(x, "unsqueeze_")
+    from .manipulation import unsqueeze
+    x._data = unsqueeze(Tensor(x._data), axis=axis)._data
+    return x
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    _inplace_guard(x, "flatten_")
+    from .manipulation import flatten
+    x._data = flatten(Tensor(x._data), start_axis, stop_axis)._data
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    _inplace_guard(x, "uniform_")
+    from ..core.random import default_generator
+    key = jax.random.PRNGKey(seed) if seed else default_generator.next_key()
+    x._data = jax.random.uniform(key, x._data.shape, x._data.dtype,
+                                 minval=min, maxval=max)
+    return x
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    _inplace_guard(x, "scatter_")
+    from .manipulation import scatter
+    x._data = scatter(Tensor(x._data), index, updates,
+                      overwrite=overwrite)._data
+    return x
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+           name=None):
+    """In-place scale (reference ``tensor/math.py:89``)."""
+    _inplace_guard(x, "scale_")
+    from .math import scale as scale_op
+    x._data = scale_op(Tensor(x._data), scale, bias, bias_after_scale,
+                       act)._data
+    return x
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference ``fluid/layers/utils.py:373``):
+    entries must be positive or the -1 dynamic marker."""
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    for s in shape:
+        if isinstance(s, (int, np.integer)) and s < -1:
+            raise ValueError(f"invalid dim {s} in shape {shape}; dims must "
+                             "be >= -1 (-1 = inferred)")
+    return True

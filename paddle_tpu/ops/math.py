@@ -128,6 +128,8 @@ def clip(x, min=None, max=None, name=None):
 
 
 def clip_(x, min=None, max=None, name=None):
+    from .extras import _inplace_guard
+    _inplace_guard(x, "clip_")
     out = clip(x, min, max)
     x._data = out._data
     return x
@@ -209,18 +211,24 @@ def diff(x, n=1, axis=-1, name=None):
 
 # -- in-place variants (eager convenience; rebind storage) -----------------
 def add_(x, y, name=None):
+    from .extras import _inplace_guard
+    _inplace_guard(x, "add_")
     out = add(x, y)
     x._data = out._data
     return x
 
 
 def subtract_(x, y, name=None):
+    from .extras import _inplace_guard
+    _inplace_guard(x, "subtract_")
     out = subtract(x, y)
     x._data = out._data
     return x
 
 
 def multiply_(x, y, name=None):
+    from .extras import _inplace_guard
+    _inplace_guard(x, "multiply_")
     out = multiply(x, y)
     x._data = out._data
     return x

@@ -1,0 +1,133 @@
+"""Tests for the extras op module (in-place variants, tensor arrays,
+misc utilities) — closes the paddle.tensor namespace export gap."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_namespace_gap_closed():
+    """Every reference paddle.tensor export (minus internals) resolves."""
+    expected = ["add_n", "broadcast_shape", "broadcast_tensors", "diagflat",
+                "diagonal", "floor_mod", "increment", "is_tensor",
+                "multiplex", "rank", "shape", "scatter_nd",
+                "standard_normal", "set_printoptions", "create_array",
+                "array_read", "array_write", "array_length", "exp_",
+                "ceil_", "floor_", "round_", "reciprocal_", "rsqrt_",
+                "sqrt_", "tanh_", "squeeze_", "unsqueeze_", "flatten_",
+                "uniform_", "scatter_", "cond"]
+    missing = [n for n in expected if not hasattr(paddle, n)]
+    assert not missing, missing
+
+
+def test_add_n_and_grad():
+    xs = [paddle.to_tensor(np.full((3,), float(i), np.float32),
+                           stop_gradient=False) for i in range(1, 4)]
+    out = paddle.add_n(xs)
+    np.testing.assert_allclose(out.numpy(), [6.0, 6.0, 6.0])
+    paddle.sum(out).backward()
+    for x in xs:
+        np.testing.assert_allclose(x.grad.numpy(), 1.0)
+
+
+def test_broadcast_helpers():
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+    a, b = paddle.broadcast_tensors([
+        paddle.to_tensor(np.ones((2, 1), np.float32)),
+        paddle.to_tensor(np.ones((1, 3), np.float32))])
+    assert tuple(a.shape) == tuple(b.shape) == (2, 3)
+
+
+def test_diag_helpers():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    d = paddle.diagflat(x)
+    assert tuple(d.shape) == (3, 3)
+    m = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+    np.testing.assert_allclose(paddle.diagonal(m).numpy(), [0, 4, 8])
+
+
+def test_multiplex():
+    a = np.array([[1, 2], [3, 4]], np.float32)
+    b = np.array([[5, 6], [7, 8]], np.float32)
+    idx = np.array([[1], [0]], np.int32)
+    out = paddle.multiplex([paddle.to_tensor(a), paddle.to_tensor(b)],
+                           paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), [[5, 6], [3, 4]])
+
+
+def test_scatter_nd():
+    index = paddle.to_tensor(np.array([[1], [2], [1]], np.int32))
+    updates = paddle.to_tensor(np.array([9.0, 10.0, 11.0], np.float32))
+    out = paddle.scatter_nd(index, updates, [4])
+    np.testing.assert_allclose(out.numpy(), [0.0, 20.0, 10.0, 0.0])
+
+
+def test_rank_shape_is_tensor():
+    x = paddle.to_tensor(np.zeros((2, 5), np.float32))
+    assert int(paddle.rank(x).numpy()) == 2
+    np.testing.assert_array_equal(paddle.shape(x).numpy(), [2, 5])
+    assert paddle.is_tensor(x) and not paddle.is_tensor(np.zeros(3))
+
+
+def test_tensor_array_ops():
+    arr = paddle.create_array()
+    paddle.array_write(paddle.to_tensor(np.float32(1.0)), 0, arr)
+    paddle.array_write(paddle.to_tensor(np.float32(2.0)), 2, arr)
+    assert int(paddle.array_length(arr).numpy()) == 3
+    assert float(paddle.array_read(arr, 2).numpy()) == 2.0
+
+
+def test_inplace_variants():
+    x = paddle.to_tensor(np.array([4.0, 9.0], np.float32))
+    y = paddle.sqrt_(x)
+    assert y is x
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    paddle.exp_(paddle.to_tensor(np.zeros(2, np.float32)))
+    x2 = paddle.to_tensor(np.zeros((2, 1), np.float32))
+    paddle.squeeze_(x2, axis=1)
+    assert tuple(x2.shape) == (2,)
+    paddle.unsqueeze_(x2, axis=0)
+    assert tuple(x2.shape) == (1, 2)
+    x3 = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    paddle.uniform_(x3, min=0.5, max=1.0, seed=7)
+    assert (x3.numpy() >= 0.5).all() and (x3.numpy() < 1.0).all()
+    x4 = paddle.to_tensor(np.zeros((4,), np.float32))
+    paddle.increment(x4, 2.5)
+    np.testing.assert_allclose(x4.numpy(), 2.5)
+
+
+def test_inplace_on_grad_tensor_raises():
+    x = paddle.to_tensor(np.array([1.0, 4.0], np.float32),
+                         stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError, match="in-place"):
+        paddle.sqrt_(y)
+    # allowed under no_grad (init-style usage)
+    with paddle.no_grad():
+        paddle.sqrt_(y)
+    np.testing.assert_allclose(y.numpy(), [np.sqrt(2.0), np.sqrt(8.0)],
+                               rtol=1e-6)
+
+
+def test_add_n_never_aliases():
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    out = paddle.add_n(x)
+    assert out is not x
+    paddle.exp_(out)
+    np.testing.assert_allclose(x.numpy(), 1.0)
+
+
+def test_array_write_negative_index_rejected():
+    arr = paddle.create_array()
+    paddle.array_write(paddle.to_tensor(np.float32(1.0)), 0, arr)
+    with pytest.raises(ValueError, match=">= 0"):
+        paddle.array_write(paddle.to_tensor(np.float32(2.0)), -1, arr)
+
+
+def test_standard_normal_and_floor_mod():
+    paddle.seed(0)
+    s = paddle.standard_normal([1000])
+    assert abs(float(np.mean(s.numpy()))) < 0.15
+    out = paddle.floor_mod(paddle.to_tensor(np.array([7, -7], np.float32)),
+                           3.0)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
