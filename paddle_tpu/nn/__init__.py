@@ -20,3 +20,7 @@ from . import clip  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 
 from ..core.tensor import Parameter  # noqa: F401
+
+from . import utils_mod as utils  # noqa: F401  (paddle.nn.utils)
+from .utils_mod import spectral_norm, weight_norm, remove_weight_norm  # noqa: F401
+from .layer import loss  # noqa: F401  (paddle.nn.loss submodule parity)

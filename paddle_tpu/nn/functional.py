@@ -5,6 +5,8 @@ conv, loss, norm, pooling, vision, sparse_attention modules).
 """
 from __future__ import annotations
 
+import jax
+
 # activations
 from ..ops.activation import *  # noqa: F401,F403
 # conv / pool / vision
@@ -22,3 +24,52 @@ from ..ops.activation import gumbel_softmax  # noqa: F401
 
 # flash attention namespace parity with paddle.nn.functional.flash_attention
 from ..ops.nn_misc import scaled_dot_product_attention as flash_attention  # noqa: F401
+
+# in-place activation variants (reference paddle.nn.functional elu_/...)
+from ..ops.extras import _inplace_guard as __ipg  # noqa: E402
+
+
+def elu_(x, alpha=1.0, name=None):
+    __ipg(x, "elu_")
+    from ..ops.activation import elu
+    from ..core.tensor import Tensor as _T
+    x._data = elu(_T(x._data), alpha)._data
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    __ipg(x, "softmax_")
+    from ..ops.activation import softmax
+    from ..core.tensor import Tensor as _T
+    x._data = softmax(_T(x._data), axis=axis, dtype=dtype)._data
+    return x
+
+
+def tanh_(x, name=None):
+    from ..ops.extras import tanh_ as _tanh_
+    return _tanh_(x)
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search ids along parent pointers (reference
+    gather_tree_op): ids/parents [T, B, beam] -> full sequences."""
+    import jax.numpy as _jnp
+    from ..core.dispatch import dispatch as _dispatch
+    from ..core.tensor import to_tensor as _tt
+    ids, parents = _tt(ids), _tt(parents)
+
+    def impl(i, p):
+        T = i.shape[0]
+
+        def body(carry, xs):
+            beam_idx = carry                       # (B, beam)
+            step_ids, step_parents = xs
+            out = _jnp.take_along_axis(step_ids, beam_idx, axis=-1)
+            prev = _jnp.take_along_axis(step_parents, beam_idx, axis=-1)
+            return prev, out
+
+        init = _jnp.broadcast_to(
+            _jnp.arange(i.shape[-1], dtype=p.dtype), i.shape[1:])
+        _, outs = jax.lax.scan(body, init, (i[::-1], p[::-1]))
+        return outs[::-1]
+    return _dispatch("gather_tree", impl, (ids, parents), {})

@@ -7,7 +7,8 @@ from ..layer_base import Layer
 __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "HuberLoss",
            "MarginRankingLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss",
-           "TripletMarginLoss", "CTCLoss", "SoftmaxWithCrossEntropy"]
+           "TripletMarginLoss", "CTCLoss", "SoftmaxWithCrossEntropy",
+           "HSigmoidLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -177,3 +178,27 @@ class CTCLoss(Layer):
         return ops.loss.ctc_loss(log_probs, labels, input_lengths,
                                  label_lengths, self.blank, self.reduction,
                                  norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classifier head (reference nn.HSigmoidLoss):
+    holds the (num_classes-1, feature_size) internal-node weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        # internal tree nodes only: (num_classes - 1) rows, matching the
+        # reference checkpoint layout
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return ops.loss.hsigmoid_loss(
+            input, label, self.num_classes, self.weight, self.bias,
+            path_table=path_table, path_code=path_code)

@@ -6,7 +6,8 @@ from ..layer_base import Layer
 
 __all__ = ["MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
            "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
-           "AdaptiveAvgPool3D", "AdaptiveMaxPool2D"]
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+           "AdaptiveMaxPool3D", "MaxUnPool2D"]
 
 
 class MaxPool1D(Layer):
@@ -115,3 +116,41 @@ class AdaptiveMaxPool2D(Layer):
     def forward(self, x):
         return ops.conv.adaptive_max_pool2d(x, self.output_size,
                                             self.return_mask)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return ops.conv.adaptive_max_pool1d(x, self.output_size,
+                                            self.return_mask)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return ops.conv.adaptive_max_pool3d(x, self.output_size,
+                                            self.return_mask)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return ops.conv.max_unpool2d(x, indices, self.kernel_size,
+                                     self.stride, self.padding,
+                                     self.output_size, self.data_format)

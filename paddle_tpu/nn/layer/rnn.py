@@ -19,7 +19,8 @@ from ..param_attr import ParamAttr
 from .. import initializer as I
 
 __all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
-           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+           "BiRNN", "SimpleRNN", "LSTM", "GRU", "BeamSearchDecoder",
+           "dynamic_decode"]
 
 
 class RNNCellBase(Layer):
@@ -27,7 +28,10 @@ class RNNCellBase(Layer):
                            init_value=0.0, batch_dim_idx=0):
         batch = to_tensor(batch_ref).shape[batch_dim_idx]
         shape = shape or self.state_shape
-        if isinstance(shape, tuple):
+        # nested = tuple of shapes (LSTM's ((h,), (h,))); a flat tuple of
+        # ints like GRU's (hidden_size,) is ONE state shape
+        if isinstance(shape, tuple) and shape and \
+                isinstance(shape[0], (tuple, list)):
             return tuple(Tensor(jnp.full((batch,) + tuple(s), init_value,
                                          jnp.float32)) for s in shape)
         return Tensor(jnp.full((batch,) + tuple(shape), init_value,
@@ -302,3 +306,92 @@ class LSTM(_RNNBase):
 
 class GRU(_RNNBase):
     _cell_cls = GRUCell
+
+
+class BeamSearchDecoder(Layer):
+    """Beam-search decoding over an RNN cell (reference
+    ``nn/layer/rnn.py`` BeamSearchDecoder + ``fluid/layers/rnn.py``).
+
+    TPU-first: the per-step top-k expand/prune is plain jnp (argmax/topk
+    lower to XLA); ``dynamic_decode`` drives it with a python loop eagerly
+    and finishes with ``gather_tree`` backtracking — the same op contract
+    as the reference (beam_search / beam_search_decode ops).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        """-> (first inputs [B*beam], states, finished [B, beam])."""
+        states = jax.tree_util.tree_map(
+            lambda t: Tensor(jnp.repeat(t._data, self.beam_size, axis=0)),
+            initial_cell_states)
+        some = jax.tree_util.tree_leaves(initial_cell_states)[0]
+        B = int(some.shape[0])
+        ids = jnp.full((B * self.beam_size,), self.start_token, jnp.int32)
+        # only beam 0 live initially so duplicate beams don't tie
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32), (B,))
+        finished = jnp.zeros((B * self.beam_size,), bool)
+        return Tensor(ids), states, log_probs, finished
+
+    def step(self, inputs, states, log_probs, finished):
+        """One expand/prune step -> (next ids, parent idx, states, ...)."""
+        x = self.embedding_fn(inputs) if self.embedding_fn is not None \
+            else inputs
+        out, new_states = self.cell(x, states)
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        logit_arr = logits._data if isinstance(logits, Tensor) \
+            else jnp.asarray(logits)
+        V = logit_arr.shape[-1]
+        step_lp = jax.nn.log_softmax(logit_arr, axis=-1)
+        # finished beams only extend with end_token at zero cost
+        fin_row = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[:, None], fin_row[None, :], step_lp)
+        Bb = step_lp.shape[0]
+        B = Bb // self.beam_size
+        total = log_probs[:, None] + step_lp              # (B*beam, V)
+        total = total.reshape(B, self.beam_size * V)
+        top_lp, top_idx = jax.lax.top_k(total, self.beam_size)
+        parent = (top_idx // V).astype(jnp.int32)          # (B, beam)
+        token = (top_idx % V).astype(jnp.int32)
+        flat_parent = parent + (jnp.arange(B) * self.beam_size)[:, None]
+        new_states = jax.tree_util.tree_map(
+            lambda t: Tensor(t._data[flat_parent.reshape(-1)]), new_states)
+        finished = finished[flat_parent.reshape(-1)] | \
+            (token.reshape(-1) == self.end_token)
+        return (Tensor(token.reshape(-1)), parent, new_states,
+                top_lp.reshape(-1), finished)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, **kwargs):
+    """Run a decoder to completion (reference ``fluid/layers/rnn.py``
+    dynamic_decode).  Returns (ids [B, T, beam], final log-probs)."""
+    from ..functional import gather_tree
+    if inits is None:
+        raise ValueError(
+            "dynamic_decode requires the decoder's initial cell states "
+            "(e.g. cell.get_initial_states(batch_ref))")
+    inputs, states, log_probs, finished = decoder.initialize(inits)
+    step_tokens, step_parents = [], []
+    for _ in range(max_step_num):
+        inputs, parent, states, log_probs, finished = decoder.step(
+            inputs, states, log_probs, finished)
+        B = parent.shape[0]
+        step_tokens.append(inputs._data.reshape(B, decoder.beam_size))
+        step_parents.append(parent)
+        if bool(jnp.all(finished)):
+            break
+    ids = jnp.stack(step_tokens)        # (T, B, beam)
+    parents = jnp.stack(step_parents)
+    seqs = gather_tree(Tensor(ids), Tensor(parents))
+    out = jnp.transpose(seqs._data, (1, 0, 2))  # (B, T, beam)
+    return Tensor(out), Tensor(log_probs.reshape(-1, decoder.beam_size))

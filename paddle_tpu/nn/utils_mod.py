@@ -1,0 +1,104 @@
+"""paddle.nn.utils (reference python/paddle/nn/utils/):
+weight_norm / spectral_norm parametrizations + parameters_to_vector.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import ops as P
+
+__all__ = ["spectral_norm", "remove_weight_norm", "weight_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _power_iteration(w2d, u, n_iters, eps=1e-12):
+    v = None
+    for _ in range(max(1, n_iters)):
+        v = w2d.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = w2d @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ (w2d @ v)
+    return u, sigma
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide ``layer.<name>`` by its largest singular value before each
+    forward (reference ``nn/utils/spectral_norm_hook.py``); the power-
+    iteration vector persists as a buffer."""
+    weight = getattr(layer, name)
+    w = np.asarray(weight._data)
+    d = dim if dim is not None else 0
+    w2d = np.moveaxis(w, d, 0).reshape(w.shape[d], -1)
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(w2d.shape[0]).astype(np.float32)
+    u0 /= np.linalg.norm(u0) + eps
+    layer.register_buffer(f"{name}_u", Tensor(jnp.asarray(u0)))
+    layer._spectral_cfg = (name, d, n_power_iterations, eps)
+
+    def hook(lyr, inputs):
+        nm, dd, iters, e = lyr._spectral_cfg
+        wt = getattr(lyr, nm)
+        arr = wt._data
+        mat = jnp.moveaxis(arr, dd, 0).reshape(arr.shape[dd], -1)
+        u = getattr(lyr, f"{nm}_u")._data
+        u, sigma = _power_iteration(mat, u, iters, e)
+        getattr(lyr, f"{nm}_u")._data = u
+        wt._data = arr / sigma
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (reference weight_norm_hook)."""
+    weight = getattr(layer, name)
+    arr = weight._data
+    axes = tuple(i for i in range(arr.ndim) if i != dim)
+    g = jnp.sqrt(jnp.sum(arr * arr, axis=axes, keepdims=True))
+    layer.register_buffer(f"{name}_g", Tensor(g))
+    layer._weight_norm_cfg = (name, dim)
+
+    def hook(lyr, inputs):
+        nm, dd = lyr._weight_norm_cfg
+        wt = getattr(lyr, nm)
+        a = wt._data
+        ax = tuple(i for i in range(a.ndim) if i != dd)
+        norm = jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=True)) + 1e-12
+        wt._data = a / norm * getattr(lyr, f"{nm}_g")._data
+        return None
+
+    layer._weight_norm_hook = layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Unhook and restore plain-weight behavior (reference
+    remove_weight_norm)."""
+    handle = getattr(layer, "_weight_norm_hook", None)
+    if handle is not None:
+        handle.remove()
+        del layer._weight_norm_hook
+    if hasattr(layer, "_weight_norm_cfg"):
+        del layer._weight_norm_cfg
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    arrays = [jnp.ravel(p._data) for p in parameters]
+    return Tensor(jnp.concatenate(arrays))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    arr = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._data = arr[offset:offset + n].reshape(tuple(p.shape)).astype(
+            p._data.dtype)
+        offset += n

@@ -18,7 +18,8 @@ __all__ = [
     "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
     "conv3d_transpose", "max_pool1d", "max_pool2d", "max_pool3d",
     "avg_pool1d", "avg_pool2d", "avg_pool3d", "adaptive_avg_pool1d",
-    "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool2d",
+    "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+    "adaptive_max_pool2d", "adaptive_max_pool3d", "max_unpool2d",
     "interpolate", "upsample", "pixel_shuffle", "unfold", "grid_sample",
 ]
 
@@ -219,7 +220,38 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode)
     out = dispatch("max_pool2d", impl, (x,), {})
     if return_mask:
-        raise NotImplementedError("max_pool2d return_mask on TPU path")
+        # argmax positions as flat input indices (reference max_pool mask
+        # for max_unpool2d): windows via conv patches, patch-local argmax
+        # mapped back to global H*W offsets
+        ks = _tuplen(kernel_size, 2)
+        st = _tuplen(stride if stride is not None else kernel_size, 2)
+        pd = _tuplen(padding, 2)
+        N, C, H, W = (int(s) for s in x.shape)
+
+        def mask_impl(a):
+            pad_cfg = [(pd[0], pd[0]), (pd[1], pd[1])]
+            patches = jax.lax.conv_general_dilated_patches(
+                a, ks, st, pad_cfg)
+            Hp, Wp = patches.shape[-2:]
+            # patch layout: (N, C*kh*kw, Hp, Wp) with C outermost
+            p = patches.reshape(N, C, ks[0] * ks[1], Hp, Wp)
+            if pd[0] or pd[1]:
+                # patches are zero-padded; mark padded slots -inf so the
+                # argmax can never select an out-of-image position (the
+                # pooled values use -inf padding semantics)
+                ones = jax.lax.conv_general_dilated_patches(
+                    jnp.ones_like(a[:1, :1]), ks, st, pad_cfg)
+                live = ones.reshape(1, 1, ks[0] * ks[1], Hp, Wp) > 0
+                p = jnp.where(live, p, -jnp.inf)
+            local = jnp.argmax(p, axis=2).astype(jnp.int32)
+            dy, dx = local // ks[1], local % ks[1]
+            i0 = jnp.arange(Hp, dtype=jnp.int32)[:, None] * st[0] - pd[0]
+            j0 = jnp.arange(Wp, dtype=jnp.int32)[None, :] * st[1] - pd[1]
+            rows = i0[None, None] + dy
+            cols = j0[None, None] + dx
+            return rows * W + cols
+        mask = dispatch("max_pool2d_mask", mask_impl, (x,), {})
+        return out, mask
     return out
 
 
@@ -306,19 +338,74 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
                     _adaptive_avg(x, output_size, 3, data_format), (x,), {})
 
 
-def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    x = to_tensor(x)
-    out_sizes = _tuplen(output_size, 2)
-
+def _adaptive_max(out_sizes, axes):
     def impl(a):
         out = a
-        for ax, osz in zip((2, 3), out_sizes):
+        for ax, osz in zip(axes, out_sizes):
             isz = out.shape[ax]
-            k = isz // osz
-            new_shape = out.shape[:ax] + (osz, k) + out.shape[ax + 1:]
-            out = out.reshape(new_shape).max(axis=ax + 1)
+            if isz % osz == 0:
+                k = isz // osz
+                new_shape = out.shape[:ax] + (osz, k) + out.shape[ax + 1:]
+                out = out.reshape(new_shape).max(axis=ax + 1)
+            else:
+                # general adaptive bins (variable-width windows)
+                starts = (np.arange(osz) * isz) // osz
+                ends = ((np.arange(osz) + 1) * isz + osz - 1) // osz
+                pieces = [jnp.take(out, jnp.arange(s, e), axis=ax).max(
+                    axis=ax, keepdims=True) for s, e in zip(starts, ends)]
+                out = jnp.concatenate(pieces, axis=ax)
         return out
-    return dispatch("adaptive_max_pool2d", impl, (x,), {})
+    return impl
+
+
+def _adaptive_max_pool(x, output_size, nd, return_mask, opname):
+    x = to_tensor(x)
+    if return_mask:
+        raise NotImplementedError(
+            f"{opname} return_mask is not supported on the TPU path; "
+            "use max_pool with return_mask for unpooling")
+    axes = tuple(range(2, 2 + nd))
+    return dispatch(opname, _adaptive_max(_tuplen(output_size, nd), axes),
+                    (x,), {})
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(x, output_size, 1, return_mask,
+                              "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(x, output_size, 2, return_mask,
+                              "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(x, output_size, 3, return_mask,
+                              "adaptive_max_pool3d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d with return_mask=True (reference
+    unpool_op): scatters pooled values back to their argmax positions."""
+    x, indices = to_tensor(x), to_tensor(indices)
+    ks = _tuplen(kernel_size, 2)
+    st = _tuplen(stride if stride is not None else kernel_size, 2)
+    N, C, Hp, Wp = (int(s) for s in x.shape)
+    if output_size is None:
+        H = (Hp - 1) * st[0] + ks[0] - 2 * _tuplen(padding, 2)[0]
+        W = (Wp - 1) * st[1] + ks[1] - 2 * _tuplen(padding, 2)[1]
+    else:
+        H, W = (int(s) for s in _tuplen(output_size, 2)[-2:])
+
+    def impl(a, idx):
+        flat = a.reshape(N, C, -1)
+        fidx = idx.reshape(N, C, -1).astype(jnp.int32)
+        out = jnp.zeros((N, C, H * W), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, v: o.at[i].set(v)))(out, fidx, flat)
+        return out.reshape(N, C, H, W)
+    return dispatch("max_unpool2d", impl, (x, indices), {})
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",

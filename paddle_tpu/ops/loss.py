@@ -17,7 +17,8 @@ __all__ = [
     "kl_div", "smooth_l1_loss", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_embedding_loss", "label_smooth", "square_error_cost",
     "sigmoid_focal_loss", "log_loss", "huber_loss", "triplet_margin_loss",
-    "ctc_loss", "one_hot",
+    "ctc_loss", "one_hot", "dice_loss", "hsigmoid_loss",
+    "margin_cross_entropy",
 ]
 
 
@@ -326,3 +327,115 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce_loss(loss, reduction)
     return dispatch("ctc_loss", impl,
                     (log_probs, labels, input_lengths, label_lengths), {})
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice coefficient loss (reference dice_loss in nn/functional/loss.py):
+    input [N, ..., C] probabilities, label [N, ..., 1] class ids."""
+    input, label = to_tensor(input), to_tensor(label)
+
+    def impl(p, y):
+        num_classes = p.shape[-1]
+        oh = jax.nn.one_hot(y.squeeze(-1), num_classes, dtype=p.dtype)
+        p2 = p.reshape(p.shape[0], -1)
+        y2 = oh.reshape(oh.shape[0], -1)
+        inter = jnp.sum(p2 * y2, axis=1)
+        union = jnp.sum(p2, axis=1) + jnp.sum(y2, axis=1)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+    return dispatch("dice_loss", impl, (input, label), {})
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss over a complete binary tree (reference
+    hsigmoid_loss / hierarchical_sigmoid_op): O(log C) classifier for
+    large vocabularies.  Default complete-tree codes; custom trees via
+    path_table/path_code."""
+    input, label = to_tensor(input), to_tensor(label)
+    weight = to_tensor(weight)
+    tensors = [input, label, weight]
+    if bias is not None:
+        tensors.append(to_tensor(bias))
+
+    if path_table is None:
+        tbl, code, valid = _complete_tree_paths(int(num_classes))
+        path_table_arr = jnp.asarray(tbl)
+        path_code_arr = jnp.asarray(code)
+        path_valid_arr = jnp.asarray(valid)
+    else:
+        path_table_arr = jnp.asarray(to_tensor(path_table)._data)
+        path_code_arr = jnp.asarray(to_tensor(path_code)._data,
+                                    jnp.float32)
+        path_valid_arr = jnp.ones(path_code_arr.shape, jnp.float32)
+
+    def impl(x, y, w, *rest):
+        b = rest[0] if rest else None
+        nodes = path_table_arr[y.reshape(-1)]          # (N, depth)
+        codes = path_code_arr[y.reshape(-1)]           # (N, depth)
+        valid = path_valid_arr[y.reshape(-1)]          # (N, depth)
+        wn = w[nodes]                                  # (N, depth, D)
+        logits = jnp.einsum("nd,nkd->nk", x, wn)
+        if b is not None:
+            logits = logits + b.reshape(-1)[nodes]
+        # sigmoid CE against the left/right code at every LIVE tree level
+        # (shallow leaves of a non-power-of-2 tree have shorter paths)
+        ce = jnp.maximum(logits, 0) - logits * codes + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.mean(jnp.sum(ce * valid, axis=1))
+    return dispatch("hsigmoid_loss", impl, tensors, {})
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _complete_tree_paths(num_classes: int):
+    """(table, code, valid) for the complete binary tree over
+    ``num_classes`` leaves: internal nodes 1..C-1 map to weight rows
+    0..C-2; shallow leaves get shorter (masked) paths.  Vectorized +
+    cached — the vocabulary is static."""
+    import numpy as _np
+    C = max(int(num_classes), 2)
+    depth = max(1, int(_np.ceil(_np.log2(C))))
+    node = _np.arange(C, dtype=_np.int64) + C   # leaves occupy [C, 2C)
+    tbl = _np.zeros((C, depth), _np.int32)
+    code = _np.zeros((C, depth), _np.float32)
+    valid = _np.zeros((C, depth), _np.float32)
+    for d in range(depth):
+        active = node > 1
+        parent = node // 2
+        tbl[:, d] = _np.where(active, parent - 1, 0)
+        code[:, d] = _np.where(active, node % 2, 0).astype(_np.float32)
+        valid[:, d] = active.astype(_np.float32)
+        node = _np.where(active, parent, node)
+    return tbl, code, valid
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace-style margin softmax (reference
+    margin_cross_entropy): cos(m1*theta + m2) - m3 on the target logit,
+    then scaled CE."""
+    logits, label = to_tensor(logits), to_tensor(label)
+
+    def impl(lg, y):
+        theta = jnp.arccos(jnp.clip(lg, -1.0, 1.0))
+        target_theta = jnp.take_along_axis(theta, y[:, None], axis=1)
+        adjusted = jnp.cos(margin1 * target_theta + margin2) - margin3
+        lg2 = jnp.asarray(lg)
+        lg2 = lg2.at[jnp.arange(lg.shape[0]), y].set(adjusted[:, 0])
+        lg2 = lg2 * scale
+        logp = jax.nn.log_softmax(lg2, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1)
+        if reduction == "mean":
+            loss = jnp.mean(nll)
+        elif reduction == "sum":
+            loss = jnp.sum(nll)
+        else:
+            loss = nll
+        if return_softmax:
+            return loss, jax.nn.softmax(lg2, axis=-1)
+        return loss
+    return dispatch("margin_cross_entropy", impl, (logits, label), {})

@@ -1,0 +1,125 @@
+"""Tests for the last nn/functional additions: adaptive max pools,
+unpool, hsigmoid/dice/margin losses, spectral/weight norm, beam search."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_max_pool_mask_unpool_roundtrip():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(2, 3, 8, 8).astype(np.float32))
+    out, mask = F.max_pool2d(x, 2, return_mask=True)
+    assert tuple(out.shape) == (2, 3, 4, 4)
+    rec = F.max_unpool2d(out, mask, 2)
+    assert tuple(rec.shape) == (2, 3, 8, 8)
+    # every pooled max lands back at its argmax position
+    xr = x.numpy()
+    rr = rec.numpy()
+    np.testing.assert_allclose(rr.max(axis=(2, 3)), xr.max(axis=(2, 3)))
+    assert (np.count_nonzero(rr, axis=(2, 3)) == 16).all()
+    # layer forms
+    layer_out = nn.MaxUnPool2D(2)(out, mask)
+    np.testing.assert_allclose(layer_out.numpy(), rr)
+
+
+def test_adaptive_max_pool_layers():
+    x1 = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 2, 8))
+    p = nn.AdaptiveMaxPool1D(2)(x1)
+    np.testing.assert_allclose(p.numpy(), [[[3, 7], [11, 15]]])
+    x3 = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 2, 4, 4, 4).astype(np.float32))
+    assert tuple(nn.AdaptiveMaxPool3D(2)(x3).shape) == (1, 2, 2, 2, 2)
+
+
+def test_dice_loss():
+    probs = paddle.to_tensor(np.array([[[0.9, 0.1], [0.2, 0.8]]],
+                                      np.float32))
+    labels = paddle.to_tensor(np.array([[[0], [1]]], np.int64))
+    loss = F.dice_loss(probs, labels)
+    # perfect-ish prediction -> small loss; flipped labels -> large
+    flipped = paddle.to_tensor(np.array([[[1], [0]]], np.int64))
+    loss_bad = F.dice_loss(probs, flipped)
+    assert float(loss.numpy()) < float(loss_bad.numpy())
+
+
+def test_hsigmoid_trains():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    D, C, N = 8, 6, 64
+    X = rng.randn(N, D).astype(np.float32)
+    W_true = rng.randn(D, C).astype(np.float32)
+    Y = np.argmax(X @ W_true, axis=1, keepdims=True).astype(np.int64)
+    layer = nn.HSigmoidLoss(D, C)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=layer.parameters())
+    first = last = None
+    for _ in range(60):
+        loss = layer(paddle.to_tensor(X), paddle.to_tensor(Y))
+        loss.backward(); opt.step(); opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+        last = float(loss.numpy())
+    assert last < first * 0.5, (first, last)
+
+
+def test_margin_cross_entropy():
+    rng = np.random.RandomState(0)
+    logits = rng.rand(4, 10).astype(np.float32) * 2 - 1  # cosines
+    labels = np.array([1, 3, 5, 7], np.int64)
+    loss = F.margin_cross_entropy(paddle.to_tensor(logits),
+                                  paddle.to_tensor(labels))
+    assert float(loss.numpy()) > 0
+    loss2, probs = F.margin_cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        return_softmax=True)
+    np.testing.assert_allclose(np.sum(probs.numpy(), -1), 1.0, rtol=1e-5)
+
+
+def test_spectral_and_weight_norm():
+    paddle.seed(0)
+    lin = nn.Linear(6, 6)
+    nn.spectral_norm(lin, name="weight", n_power_iterations=3)
+    x = paddle.to_tensor(np.eye(6, dtype=np.float32))
+    lin(x)
+    s = np.linalg.svd(lin.weight.numpy(), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=0.05)
+
+    lin2 = nn.Linear(4, 4)
+    w0 = lin2.weight.numpy().copy()
+    nn.weight_norm(lin2, dim=0)
+    lin2(paddle.to_tensor(np.zeros((1, 4), np.float32)))
+    np.testing.assert_allclose(lin2.weight.numpy(), w0, rtol=1e-5)
+
+
+def test_gather_tree():
+    # T=3, B=1, beam=2
+    ids = paddle.to_tensor(np.array(
+        [[[2, 5]], [[6, 1]], [[3, 9]]], np.int32))
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0]], [[1, 0]], [[0, 1]]], np.int32))
+    out = F.gather_tree(ids, parents).numpy()
+    # beam0 at T=2 token 3, parent 0 -> T=1 beam0 token 6, parent 1
+    #   -> T=0 beam1 token 5
+    assert out[:, 0, 0].tolist() == [5, 6, 3]
+
+
+def test_beam_search_decode_end_to_end():
+    paddle.seed(0)
+    V, D, B, beam = 12, 8, 2, 3
+    emb = nn.Embedding(V, D)
+    cell = nn.GRUCell(D, D)
+    proj = nn.Linear(D, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                               beam_size=beam,
+                               embedding_fn=emb, output_fn=proj)
+    init = cell.get_initial_states(
+        paddle.to_tensor(np.zeros((B, D), np.float32)))
+    ids, log_probs = nn.dynamic_decode(dec, init, max_step_num=6)
+    assert ids.shape[0] == B and ids.shape[2] == beam
+    assert tuple(log_probs.shape) == (B, beam)
+    # beams sorted best-first
+    lp = log_probs.numpy()
+    assert (np.diff(lp, axis=1) <= 1e-5).all()
