@@ -1,3 +1,7 @@
 """paddle.incubate (LookAhead/ModelAverage + experimental nn)."""
 from . import optimizer_mod as optimizer  # noqa: F401
 from . import nn  # noqa: F401
+from .ops_mod import (softmax_mask_fuse,  # noqa: F401
+                      softmax_mask_fuse_upper_triangle, segment_sum,
+                      segment_mean, segment_min, segment_max)
+from .optimizer_mod import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,84 @@
+"""incubate operators + segment tensor math.
+
+Reference parity: ``incubate/operators/softmax_mask_fuse*.py`` (fused
+CUDA kernels at ``operators/fused/fused_softmax_mask*.cu``) and
+``incubate/tensor/math.py:22-179`` segment_{sum,mean,min,max}
+(``operators/segment_pool_op``).
+
+TPU-first: the mask+softmax "fusion" is a single traced expression XLA
+fuses on its own; segment reductions lower to ``jax.ops.segment_*``
+(one-hot matmul or scatter on TPU, picked by XLA).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "segment_sum", "segment_mean", "segment_min", "segment_max"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) over the last axis (reference
+    ``incubate/operators/softmax_mask_fuse.py``)."""
+    x, mask = to_tensor(x), to_tensor(mask)
+    return dispatch("softmax_mask_fuse",
+                    lambda a, m: jax.nn.softmax(a + m, axis=-1),
+                    (x, mask), {})
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (reference
+    ``softmax_mask_fuse_upper_triangle.py``): positions above the
+    diagonal are masked out."""
+    x = to_tensor(x)
+
+    def impl(a):
+        T1, T2 = a.shape[-2], a.shape[-1]
+        mask = jnp.tril(jnp.ones((T1, T2), bool), k=T2 - T1)
+        return jax.nn.softmax(jnp.where(mask, a, -1e9), axis=-1)
+    return dispatch("softmax_mask_fuse_upper_triangle", impl, (x,), {})
+
+
+def _num_segments(data, segment_ids):
+    """Tight size eagerly; under jit the output size must be static, so
+    pad to the upper bound (rows past max(ids) hold the identity)."""
+    if isinstance(segment_ids._data, jax.core.Tracer):
+        return int(data._data.shape[0])
+    return int(jax.device_get(
+        jnp.max(segment_ids._data.astype(jnp.int32)))) + 1
+
+
+def _segment(op_name, reducer):
+    def op(data, segment_ids, name=None):
+        data, segment_ids = to_tensor(data), to_tensor(segment_ids)
+        n = _num_segments(data, segment_ids)
+
+        def impl(d, ids):
+            return reducer(d, ids.astype(jnp.int32), num_segments=n)
+        return dispatch(op_name, impl, (data, segment_ids), {})
+    op.__name__ = op_name
+    return op
+
+
+segment_sum = _segment("segment_sum", jax.ops.segment_sum)
+segment_max = _segment("segment_max", jax.ops.segment_max)
+segment_min = _segment("segment_min", jax.ops.segment_min)
+
+
+def segment_mean(data, segment_ids, name=None):
+    """Per-segment mean (reference ``incubate/tensor/math.py:74``)."""
+    data, segment_ids = to_tensor(data), to_tensor(segment_ids)
+    n = _num_segments(data, segment_ids)
+
+    def impl(d, i):
+        i = i.astype(jnp.int32)
+        total = jax.ops.segment_sum(d, i, num_segments=n)
+        counts = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), i,
+                                     num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return total / jnp.maximum(counts, 1).reshape(shape)
+    return dispatch("segment_mean", impl, (data, segment_ids), {})

@@ -16,3 +16,29 @@ if repo_root not in sys.path:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def free_port():
+    """Unused TCP port (shared test helper)."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def free_launch_port():
+    """A master_port whose coordinator neighbor (port-1) is also free —
+    the launcher binds hosts[0]:(master_port - 1) for jax.distributed."""
+    import socket
+    for _ in range(64):
+        p = free_port()
+        try:
+            s = socket.socket()
+            s.bind(("127.0.0.1", p - 1))
+            s.close()
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair found")

@@ -135,12 +135,8 @@ def test_dgc_momentum_error_feedback():
 
 # -- parameter server --------------------------------------------------------
 def _free_port():
-    import socket
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from conftest import free_port
+    return free_port()
 
 
 def test_ps_dense_sparse_roundtrip(tmp_path):

@@ -80,13 +80,10 @@ def _run(tmp_path, nproc, devices_per_proc, tag):
         r = subprocess.run([sys.executable, str(script)], env=env,
                            capture_output=True, text=True, timeout=600)
     else:
-        # free port at runtime: a fixed one collides across parallel or
-        # back-to-back runs (coordinator sockets linger in TIME_WAIT)
-        import socket
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
+        # free port PAIR at runtime: the launcher's coordinator binds
+        # master_port - 1 (a fixed port collides across runs)
+        from conftest import free_launch_port
+        port = free_launch_port()
         r = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
              "--nproc", str(nproc), "--devices_per_proc",

@@ -1,0 +1,61 @@
+"""incubate operators: fused-softmax-mask + segment reductions."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate as I
+
+
+def test_softmax_mask_fuse():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3, 4, 4).astype(np.float32))
+    mask = paddle.to_tensor(
+        np.where(rng.rand(2, 1, 4, 4) > 0.5, 0.0, -1e9).astype(np.float32))
+    out = I.softmax_mask_fuse(x, mask)
+    np.testing.assert_allclose(np.sum(out.numpy(), -1), 1.0, rtol=1e-5)
+    masked = mask.numpy() < -1e8
+    assert (out.numpy()[np.broadcast_to(masked, out.shape)] < 1e-6).all()
+
+
+def test_softmax_mask_fuse_upper_triangle():
+    x = paddle.to_tensor(np.zeros((1, 1, 4, 4), np.float32))
+    out = I.softmax_mask_fuse_upper_triangle(x).numpy()[0, 0]
+    # causal rows: uniform over the prefix
+    np.testing.assert_allclose(out[0], [1, 0, 0, 0], atol=1e-6)
+    np.testing.assert_allclose(out[3], [0.25] * 4, atol=1e-6)
+
+
+def test_segment_reductions_and_grad():
+    d = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [10., 20.]],
+                                  np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    np.testing.assert_allclose(I.segment_sum(d, ids).numpy(),
+                               [[4, 6], [10, 20]])
+    np.testing.assert_allclose(I.segment_mean(d, ids).numpy(),
+                               [[2, 3], [10, 20]])
+    np.testing.assert_allclose(I.segment_max(d, ids).numpy(),
+                               [[3, 4], [10, 20]])
+    np.testing.assert_allclose(I.segment_min(d, ids).numpy(),
+                               [[1, 2], [10, 20]])
+
+    d2 = paddle.to_tensor(np.ones((4, 2), np.float32), stop_gradient=False)
+    s = I.segment_sum(d2, paddle.to_tensor(np.array([0, 1, 1, 1],
+                                                    np.int32)))
+    paddle.sum(s * s).backward()
+    np.testing.assert_allclose(d2.grad.numpy()[0], 2.0)
+    np.testing.assert_allclose(d2.grad.numpy()[1], 6.0)
+
+
+def test_segment_under_jit_padded():
+    import jax
+    d = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [10., 20.]],
+                                  np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+
+    @jax.jit
+    def f(darr, iarr):
+        return I.segment_sum(paddle.Tensor(darr), paddle.Tensor(iarr))._data
+
+    out = np.asarray(f(d._data, ids._data))
+    assert out.shape[0] == 3  # padded to static bound under jit
+    np.testing.assert_allclose(out[:2], [[4, 6], [10, 20]])
