@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import dispatch
-from ..core.tensor import Tensor, to_tensor
+from ..core.tensor import to_tensor
 
 __all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
            "segment_sum", "segment_mean", "segment_min", "segment_max"]
@@ -44,10 +44,13 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
 
 
 def _num_segments(data, segment_ids):
-    """Tight size eagerly; under jit the output size must be static, so
-    pad to the upper bound (rows past max(ids) hold the identity)."""
+    """Tight size eagerly (one host sync per eager call — the id maximum
+    decides the output shape); under jit the output size must be static,
+    so pad to the upper bound (rows past max(ids) hold the identity)."""
     if isinstance(segment_ids._data, jax.core.Tracer):
         return int(data._data.shape[0])
+    if segment_ids._data.size == 0:
+        return 0
     return int(jax.device_get(
         jnp.max(segment_ids._data.astype(jnp.int32)))) + 1
 

@@ -1,0 +1,512 @@
+"""Detection / CV operators (``paddle.vision.ops`` parity).
+
+Reference parity: ``python/paddle/vision/ops.py`` — yolo_box (:252),
+yolo_loss (:42), deform_conv2d (:423) + DeformConv2D (:626),
+psroi_pool (:911), roi_pool (:1022), roi_align (:1145) with their Layer
+wrappers; backed by the ~40 detection kernels under
+``paddle/fluid/operators/detection/``.
+
+TPU-first: every op is dense, statically-shaped jnp — gathers/bilinear
+sampling vectorize over boxes and lower to XLA gather/dot; there is no
+per-box dynamic control flow (boxes_num selects by masking).  read_file/
+decode_jpeg are host I/O and live on the DataLoader side, not here.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import to_tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["yolo_box", "yolo_loss", "deform_conv2d", "DeformConv2D",
+           "roi_pool", "RoIPool", "roi_align", "RoIAlign", "psroi_pool",
+           "PSRoIPool"]
+
+
+# ---------------------------------------------------------------------------
+# YOLO
+# ---------------------------------------------------------------------------
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode YOLOv3 head activations into boxes + scores
+    (reference ``vision/ops.py:252`` / ``operators/detection/yolo_box_op``).
+
+    x: (N, len(anchors)//2 * (5 + class_num), H, W); img_size: (N, 2) hw.
+    Returns (boxes (N, H*W*na, 4) xyxy, scores (N, H*W*na, class_num)).
+    """
+    x, img_size = to_tensor(x), to_tensor(img_size)
+    anchors = [int(a) for a in anchors]
+    na = len(anchors) // 2
+
+    def impl(a, imgs):
+        N, C, H, W = a.shape
+        a = a.reshape(N, na, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+        bx = (jax.nn.sigmoid(a[:, :, 0]) * alpha + beta + gx) / W
+        by = (jax.nn.sigmoid(a[:, :, 1]) * alpha + beta + gy) / H
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+        input_w = float(W * downsample_ratio)
+        input_h = float(H * downsample_ratio)
+        bw = jnp.exp(a[:, :, 2]) * aw / input_w
+        bh = jnp.exp(a[:, :, 3]) * ah / input_h
+        conf = jax.nn.sigmoid(a[:, :, 4])
+        probs = jax.nn.sigmoid(a[:, :, 5:]) * conf[:, :, None]
+
+        img_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * img_w
+        y1 = (by - bh / 2) * img_h
+        x2 = (bx + bw / 2) * img_w
+        y2 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, img_w - 1)
+            y1 = jnp.clip(y1, 0, img_h - 1)
+            x2 = jnp.clip(x2, 0, img_w - 1)
+            y2 = jnp.clip(y2, 0, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)        # (N,na,H,W,4)
+        keep = (conf > conf_thresh).astype(boxes.dtype)
+        boxes = boxes * keep[..., None]
+        probs = probs * keep[:, :, None]
+        boxes = boxes.reshape(N, na * H * W, 4)
+        scores = jnp.moveaxis(probs, 2, -1).reshape(N, na * H * W,
+                                                    class_num)
+        return boxes, scores
+    return dispatch("yolo_box", impl, (x, img_size), {})
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference ``vision/ops.py:42`` /
+    ``yolov3_loss_op``): coordinate + objectness + class terms; boxes
+    matched to the best anchor of this detection head."""
+    x, gt_box, gt_label = to_tensor(x), to_tensor(gt_box), to_tensor(gt_label)
+    anchors = [float(a) for a in anchors]
+    mask = [int(m) for m in anchor_mask]
+    na = len(mask)
+    tensors = [x, gt_box, gt_label]
+    has_score = gt_score is not None
+    if has_score:
+        tensors.append(to_tensor(gt_score))
+
+    def impl(a, gb, gl, *rest):
+        gs = rest[0] if has_score else None
+        N, C, H, W = a.shape
+        B = gb.shape[1]
+        a = a.reshape(N, na, 5 + class_num, H, W)
+        input_w = float(W * downsample_ratio)
+        input_h = float(H * downsample_ratio)
+
+        # gt boxes are (cx, cy, w, h) normalized to [0, 1]
+        gx, gy, gw, gh = gb[..., 0], gb[..., 1], gb[..., 2], gb[..., 3]
+        valid = (gw > 0).astype(a.dtype)                  # (N, B)
+        gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+
+        # best anchor over ALL anchors by IoU of (w, h) at the origin
+        all_aw = jnp.asarray(anchors[0::2], a.dtype) / input_w
+        all_ah = jnp.asarray(anchors[1::2], a.dtype) / input_h
+        inter = jnp.minimum(gw[..., None], all_aw) * \
+            jnp.minimum(gh[..., None], all_ah)
+        union = gw[..., None] * gh[..., None] + all_aw * all_ah - inter
+        best = jnp.argmax(inter / (union + 1e-9), axis=-1)  # (N, B)
+        mask_arr = jnp.asarray(mask)
+        in_head = (best[..., None] == mask_arr).any(-1).astype(a.dtype)
+        an_idx = jnp.argmax((best[..., None] == mask_arr).astype(jnp.int32),
+                            axis=-1)                        # (N, B)
+        w_obj = valid * in_head
+        if gs is not None:
+            # per-box score weighting (mixup; reference gt_score path)
+            w_obj = w_obj * gs.reshape(w_obj.shape)
+
+        # target tx/ty/tw/th at matched cells
+        aw = jnp.asarray(anchors[0::2], a.dtype)[an_idx] / input_w
+        ah = jnp.asarray(anchors[1::2], a.dtype)[an_idx] / input_h
+        tx = gx * W - gi.astype(a.dtype)
+        ty = gy * H - gj.astype(a.dtype)
+        tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-9), 1e-9))
+        th = jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-9), 1e-9))
+        box_scale = 2.0 - gw * gh
+
+        batch = jnp.arange(N)[:, None].repeat(B, 1)
+        pred = a[batch, an_idx, :, gj, gi]                 # (N, B, 5+cls)
+        px, py = jax.nn.sigmoid(pred[..., 0]), jax.nn.sigmoid(pred[..., 1])
+        loss_xy = (jnp.square(px - tx) + jnp.square(py - ty)) * box_scale
+        loss_wh = (jnp.square(pred[..., 2] - tw) +
+                   jnp.square(pred[..., 3] - th)) * box_scale
+
+        # objectness: positives at matched cells; negatives everywhere
+        # else unless best IoU with any gt exceeds ignore_thresh
+        obj_logit = a[:, :, 4]                             # (N,na,H,W)
+        pos = jnp.zeros((N, na, H, W), a.dtype)
+        pos = pos.at[batch, an_idx, gj, gi].max(w_obj)
+        boxes_pred = _decode_all(a, anchors, mask, W, H, input_w, input_h,
+                                 scale_x_y)
+        iou = _iou_grid_vs_gt(boxes_pred, gb, valid)       # (N,na,H,W)
+        ignore = (iou > ignore_thresh).astype(a.dtype) * (1 - pos)
+        bce = jnp.maximum(obj_logit, 0) - obj_logit * pos + \
+            jnp.log1p(jnp.exp(-jnp.abs(obj_logit)))
+        loss_obj = jnp.sum(bce * (1 - ignore), axis=(1, 2, 3))
+
+        smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+        tgt = jax.nn.one_hot(gl.reshape(N, B), class_num, dtype=a.dtype)
+        tgt = tgt * (1 - smooth) + smooth / max(class_num, 1)
+        cls_logit = pred[..., 5:]
+        bce_c = jnp.maximum(cls_logit, 0) - cls_logit * tgt + \
+            jnp.log1p(jnp.exp(-jnp.abs(cls_logit)))
+        loss_cls = jnp.sum(bce_c, axis=-1)
+
+        per_box = (loss_xy + loss_wh + loss_cls) * w_obj
+        return jnp.sum(per_box, axis=1) + loss_obj         # (N,)
+    return dispatch("yolo_loss", impl, tensors, {})
+
+
+def _decode_all(a, anchors, mask, W, H, input_w, input_h, scale_x_y):
+    na = len(mask)
+    gx = jnp.arange(W, dtype=a.dtype)[None, None, None, :]
+    gy = jnp.arange(H, dtype=a.dtype)[None, None, :, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(a[:, :, 0]) * alpha + beta + gx) / W
+    by = (jax.nn.sigmoid(a[:, :, 1]) * alpha + beta + gy) / H
+    aw = jnp.asarray([anchors[2 * m] for m in mask],
+                     a.dtype)[None, :, None, None] / input_w
+    ah = jnp.asarray([anchors[2 * m + 1] for m in mask],
+                     a.dtype)[None, :, None, None] / input_h
+    bw = jnp.exp(jnp.clip(a[:, :, 2], -10, 10)) * aw
+    bh = jnp.exp(jnp.clip(a[:, :, 3], -10, 10)) * ah
+    return jnp.stack([bx, by, bw, bh], axis=-1)            # (N,na,H,W,4)
+
+
+def _iou_grid_vs_gt(pred, gt, valid):
+    """max IoU of each predicted cell box against any valid gt box."""
+    px1 = pred[..., 0] - pred[..., 2] / 2
+    py1 = pred[..., 1] - pred[..., 3] / 2
+    px2 = pred[..., 0] + pred[..., 2] / 2
+    py2 = pred[..., 1] + pred[..., 3] / 2
+    gx1 = (gt[..., 0] - gt[..., 2] / 2)[:, None, None, None, :]
+    gy1 = (gt[..., 1] - gt[..., 3] / 2)[:, None, None, None, :]
+    gx2 = (gt[..., 0] + gt[..., 2] / 2)[:, None, None, None, :]
+    gy2 = (gt[..., 1] + gt[..., 3] / 2)[:, None, None, None, :]
+    ix = jnp.maximum(jnp.minimum(px2[..., None], gx2) -
+                     jnp.maximum(px1[..., None], gx1), 0)
+    iy = jnp.maximum(jnp.minimum(py2[..., None], gy2) -
+                     jnp.maximum(py1[..., None], gy1), 0)
+    inter = ix * iy
+    area_p = (px2 - px1)[..., None] * (py2 - py1)[..., None]
+    area_g = (gx2 - gx1) * (gy2 - gy1)
+    iou = inter / (area_p + area_g - inter + 1e-9)
+    iou = iou * valid[:, None, None, None, :]
+    return jnp.max(iou, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# RoI pooling family
+# ---------------------------------------------------------------------------
+def _roi_batch_index(boxes_num, n_boxes, N):
+    idx = np.zeros((n_boxes,), np.int32)
+    start = 0
+    for b, cnt in enumerate(np.asarray(boxes_num).reshape(-1)):
+        idx[start:start + int(cnt)] = b
+        start += int(cnt)
+    return idx
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign with bilinear sampling (reference ``vision/ops.py:1145`` /
+    ``roi_align_op``)."""
+    x, boxes = to_tensor(x), to_tensor(boxes)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    n_boxes = int(boxes.shape[0])
+    N = int(x.shape[0])
+    bidx = jnp.asarray(_roi_batch_index(
+        np.asarray(to_tensor(boxes_num)._data), n_boxes, N))
+    if sampling_ratio <= 0:
+        # reference uses ceil(roi_size/output) per RoI (dynamic shapes);
+        # the static equivalent samples at the feature-map upper bound,
+        # capped to keep cost bounded
+        sr = min(8, max(2, -(-int(x.shape[2]) // int(oh))))
+    else:
+        sr = int(sampling_ratio)
+
+    def impl(feat, bx):
+        C, H, W = feat.shape[1:]
+        off = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - off
+        y1 = bx[:, 1] * spatial_scale - off
+        x2 = bx[:, 2] * spatial_scale - off
+        y2 = bx[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_w, bin_h = rw / ow, rh / oh
+        # sample sr x sr points per bin, bilinear, then average
+        iy = (jnp.arange(oh)[:, None] + (jnp.arange(sr) + 0.5)[None] / sr)
+        ix = (jnp.arange(ow)[:, None] + (jnp.arange(sr) + 0.5)[None] / sr)
+        ys = y1[:, None, None] + bin_h[:, None, None] * iy[None]  # (R,oh,sr)
+        xs = x1[:, None, None] + bin_w[:, None, None] * ix[None]
+
+        def sample(f, yy, xx):
+            # f: (C, H, W); yy/xx: scalars -> bilinear value (C,)
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1c = jnp.clip(y0 + 1, 0, H - 1)
+            x1c = jnp.clip(x0 + 1, 0, W - 1)
+            ly = jnp.clip(yy - y0, 0, 1)
+            lx = jnp.clip(xx - x0, 0, 1)
+            v = (f[:, y0, x0] * (1 - ly) * (1 - lx) +
+                 f[:, y1c, x0] * ly * (1 - lx) +
+                 f[:, y0, x1c] * (1 - ly) * lx +
+                 f[:, y1c, x1c] * ly * lx)
+            inside = (yy > -1) & (yy < H) & (xx > -1) & (xx < W)
+            return v * inside
+
+        def per_roi(b, ys_r, xs_r):
+            f = feat[b]
+            vals = jax.vmap(lambda yy: jax.vmap(
+                lambda xx: jax.vmap(lambda y1s: jax.vmap(
+                    lambda x1s: sample(f, y1s, x1s))(xx))(yy))(xs_r))(ys_r)
+            # vals: (oh, ow, sr, sr, C) -> average samples
+            return jnp.mean(vals, axis=(2, 3)).transpose(2, 0, 1)
+
+        return jax.vmap(per_roi)(bidx, ys, xs)             # (R, C, oh, ow)
+    return dispatch("roi_align", impl, (x, boxes), {})
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """RoIPool (max in each bin; reference ``vision/ops.py:1022``)."""
+    x, boxes = to_tensor(x), to_tensor(boxes)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    n_boxes = int(boxes.shape[0])
+    bidx = jnp.asarray(_roi_batch_index(
+        np.asarray(to_tensor(boxes_num)._data), n_boxes, int(x.shape[0])))
+
+    def impl(feat, bx):
+        C, H, W = feat.shape[1:]
+        x1 = jnp.round(bx[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(bx[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.maximum(jnp.round(bx[:, 2] * spatial_scale), x1 + 1)
+        y2 = jnp.maximum(jnp.round(bx[:, 3] * spatial_scale), y1 + 1)
+
+        ii = jnp.arange(H)[None, :]
+        jj = jnp.arange(W)[None, :]
+
+        def per_roi(b, xx1, yy1, xx2, yy2):
+            f = feat[b]                                    # (C, H, W)
+            rh = (yy2 - yy1).astype(jnp.float32) / oh
+            rw = (xx2 - xx1).astype(jnp.float32) / ow
+            outs = []
+            for ph in range(oh):
+                y_lo = yy1 + jnp.floor(ph * rh).astype(jnp.int32)
+                y_hi = yy1 + jnp.ceil((ph + 1) * rh).astype(jnp.int32)
+                row_mask = (ii[0][None, :] >= y_lo) & (ii[0][None, :] < y_hi)
+                for pw in range(ow):
+                    x_lo = xx1 + jnp.floor(pw * rw).astype(jnp.int32)
+                    x_hi = xx1 + jnp.ceil((pw + 1) * rw).astype(jnp.int32)
+                    col_mask = (jj[0][None, :] >= x_lo) & \
+                        (jj[0][None, :] < x_hi)
+                    m = row_mask.reshape(-1, 1) & col_mask.reshape(1, -1)
+                    vals = jnp.where(m[None], f, -jnp.inf)
+                    v = jnp.max(vals, axis=(1, 2))
+                    # bins entirely outside the map output 0 (reference
+                    # clips hstart/hend and zero-fills empty bins)
+                    outs.append(jnp.where(jnp.isfinite(v), v, 0.0))
+            return jnp.stack(outs, -1).reshape(C, oh, ow)
+        return jax.vmap(per_roi)(bidx, x1, y1, x2, y2)
+    return dispatch("roi_pool", impl, (x, boxes), {})
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (reference
+    ``vision/ops.py:911``): channel c of output bin (i, j) reads input
+    channel c*oh*ow + i*ow + j."""
+    x, boxes = to_tensor(x), to_tensor(boxes)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    C_in = int(x.shape[1])
+    if C_in % (oh * ow) != 0:
+        raise ValueError(
+            f"psroi_pool needs channels ({C_in}) divisible by "
+            f"output_size^2 ({oh * ow})")
+    C_out = C_in // (oh * ow)
+    bidx = jnp.asarray(_roi_batch_index(
+        np.asarray(to_tensor(boxes_num)._data), int(boxes.shape[0]),
+        int(x.shape[0])))
+
+    def impl(feat, bx):
+        H, W = feat.shape[2:]
+        x1 = bx[:, 0] * spatial_scale
+        y1 = bx[:, 1] * spatial_scale
+        x2 = bx[:, 2] * spatial_scale
+        y2 = bx[:, 3] * spatial_scale
+        rh, rw = (y2 - y1) / oh, (x2 - x1) / ow
+        ii = jnp.arange(H)
+        jj = jnp.arange(W)
+
+        def per_roi(b, xx1, yy1, hh, ww):
+            f = feat[b].reshape(C_out, oh * ow, H, W)
+            outs = []
+            for ph in range(oh):
+                y_lo, y_hi = yy1 + ph * hh, yy1 + (ph + 1) * hh
+                rmask = (ii >= jnp.floor(y_lo)) & (ii < jnp.ceil(y_hi))
+                for pw in range(ow):
+                    x_lo, x_hi = xx1 + pw * ww, xx1 + (pw + 1) * ww
+                    cmask = (jj >= jnp.floor(x_lo)) & (jj < jnp.ceil(x_hi))
+                    m = (rmask[:, None] & cmask[None, :]).astype(f.dtype)
+                    cnt = jnp.maximum(jnp.sum(m), 1.0)
+                    chan = f[:, ph * ow + pw]              # (C_out, H, W)
+                    outs.append(jnp.sum(chan * m[None], axis=(1, 2)) / cnt)
+            return jnp.stack(outs, -1).reshape(C_out, oh, ow)
+        return jax.vmap(per_roi)(bidx, x1, y1, rh, rw)
+    return dispatch("psroi_pool", impl, (x, boxes), {})
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference ``vision/ops.py:423`` /
+    ``deformable_conv_op``): sampling positions are offset per output
+    location, bilinear-gathered, then contracted with the weight (v2 when
+    ``mask`` is given)."""
+    x, offset, weight = to_tensor(x), to_tensor(offset), to_tensor(weight)
+    tensors = [x, offset, weight]
+    if mask is not None:
+        tensors.append(to_tensor(mask))
+    if bias is not None:
+        tensors.append(to_tensor(bias))
+    has_mask = mask is not None
+    has_bias = bias is not None
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else \
+        tuple(dilation)
+
+    def impl(a, off, w, *rest):
+        msk = rest[0] if has_mask else None
+        b = rest[-1] if has_bias else None
+        N, C, H, W = a.shape
+        Co, Cg, kh, kw = w.shape
+        Ho = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        Wo = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        K = kh * kw
+        off = off.reshape(N, deformable_groups, K, 2, Ho, Wo)
+
+        base_y = (jnp.arange(Ho) * s[0] - p[0])[:, None]
+        base_x = (jnp.arange(Wo) * s[1] - p[1])[None, :]
+        kyx = jnp.stack(jnp.meshgrid(jnp.arange(kh) * d[0],
+                                     jnp.arange(kw) * d[1],
+                                     indexing="ij"), -1).reshape(K, 2)
+
+        cpg = C // deformable_groups   # channels per deformable group
+
+        def sample_chan(f2d, yy, xx):
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            ly, lx = yy - y0, xx - x0
+            def g(yi, xi):
+                inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+                v = f2d[jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+                return v * inside
+            return (g(y0, x0) * (1 - ly) * (1 - lx) +
+                    g(y0 + 1, x0) * ly * (1 - lx) +
+                    g(y0, x0 + 1) * (1 - ly) * lx +
+                    g(y0 + 1, x0 + 1) * ly * lx)
+
+        def per_n(an, offn, mskn):
+            # sampling grid per (dg, K, Ho, Wo)
+            yy = base_y[None, None] + kyx[None, :, 0][..., None, None] + \
+                offn[:, :, 0]
+            xx = base_x[None, None] + kyx[None, :, 1][..., None, None] + \
+                offn[:, :, 1]
+            # gather per channel: (C, K, Ho, Wo)
+            def per_c(c):
+                dg = c // cpg
+                return jax.vmap(lambda k: sample_chan(
+                    an[c], yy[dg, k], xx[dg, k]))(jnp.arange(K))
+            cols = jax.vmap(per_c)(jnp.arange(C))
+            if mskn is not None:
+                m = mskn.reshape(deformable_groups, K, Ho, Wo)
+                m = jnp.repeat(m, cpg, axis=0)             # (C, K, Ho, Wo)
+                cols = cols * m
+            return cols
+
+        if msk is None:
+            cols = jax.vmap(per_n, in_axes=(0, 0, None))(a, off, None)
+        else:
+            cols = jax.vmap(per_n)(a, off, msk)
+        # contraction: out[n, co, ho, wo] = sum_{cg, k} w * cols
+        wg = w.reshape(groups, Co // groups, Cg, K)
+        colsg = cols.reshape(N, groups, Cg, K, Ho, Wo)
+        out = jnp.einsum("gock,ngckhw->ngohw", wg, colsg)
+        out = out.reshape(N, Co, Ho, Wo)
+        if b is not None:
+            out = out + b.reshape(1, Co, 1, 1)
+        return out
+    return dispatch("deform_conv2d", impl, tensors, {})
+
+
+class DeformConv2D(Layer):
+    """Layer wrapper (reference ``vision/ops.py:626``)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)

@@ -1,0 +1,132 @@
+"""paddle.vision.ops detection operators (yolo, roi family, deform conv)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+
+@pytest.fixture
+def single_box():
+    boxes = paddle.to_tensor(np.array([[1.0, 1.0, 5.0, 5.0]], np.float32))
+    boxes_num = paddle.to_tensor(np.array([1], np.int32))
+    return boxes, boxes_num
+
+
+def test_roi_align_constant_map(single_box):
+    boxes, bn = single_box
+    feat = paddle.to_tensor(np.full((1, 2, 8, 8), 3.0, np.float32))
+    out = V.roi_align(feat, boxes, bn, output_size=2)
+    assert tuple(out.shape) == (1, 2, 2, 2)
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+    # layer wrapper
+    out2 = V.RoIAlign(2)(feat, boxes, bn)
+    np.testing.assert_allclose(out2.numpy(), out.numpy())
+
+
+def test_roi_align_gradient(single_box):
+    boxes, bn = single_box
+    feat = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 2, 8, 8).astype(np.float32),
+        stop_gradient=False)
+    out = V.roi_align(feat, boxes, bn, output_size=2)
+    paddle.sum(out).backward()
+    g = feat.grad.numpy()
+    assert g is not None and g.sum() > 0
+    # gradient concentrated inside the box
+    assert g[:, :, 6:, 6:].sum() < 1e-6
+
+
+def test_roi_pool_max(single_box):
+    boxes, bn = single_box
+    fm = np.zeros((1, 1, 8, 8), np.float32)
+    fm[0, 0, 2, 2] = 7.0
+    out = V.roi_pool(paddle.to_tensor(fm), boxes, bn, output_size=2)
+    assert float(out.numpy().max()) == 7.0
+
+
+def test_psroi_pool_shapes(single_box):
+    boxes, bn = single_box
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 8, 8, 8).astype(np.float32))
+    out = V.psroi_pool(x, boxes, bn, output_size=2)
+    assert tuple(out.shape) == (1, 2, 2, 2)
+    with pytest.raises(ValueError, match="divisible"):
+        V.psroi_pool(paddle.to_tensor(np.zeros((1, 7, 8, 8), np.float32)),
+                     boxes, bn, output_size=2)
+
+
+def test_yolo_box_decode():
+    rng = np.random.RandomState(0)
+    na, cls, H, W = 2, 3, 4, 4
+    x = paddle.to_tensor(rng.randn(2, na * (5 + cls), H, W)
+                         .astype(np.float32))
+    img = paddle.to_tensor(np.array([[64, 64], [32, 32]], np.int32))
+    boxes, scores = V.yolo_box(x, img, [10, 14, 23, 27], cls, 0.01, 8)
+    assert tuple(boxes.shape) == (2, na * H * W, 4)
+    assert tuple(scores.shape) == (2, na * H * W, cls)
+    b = boxes.numpy()
+    assert b[0].max() <= 63.0 + 1e-3 and b[1].max() <= 31.0 + 1e-3
+    assert (scores.numpy() >= 0).all() and (scores.numpy() <= 1).all()
+
+
+def test_yolo_loss_trains():
+    """The loss must be differentiable and decrease as the head learns
+    one synthetic box."""
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    na, cls, H, W = 2, 3, 4, 4
+    anchors, mask = [10, 14, 23, 27], [0, 1]
+    from paddle_tpu.core.tensor import Parameter
+    head = Parameter(rng.randn(1, na * (5 + cls), H, W)
+                     .astype(np.float32) * 0.1)
+    gt_box = paddle.to_tensor(np.array([[[0.5, 0.5, 0.3, 0.4]]],
+                                       np.float32))
+    gt_label = paddle.to_tensor(np.array([[2]], np.int64))
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=[head])
+    first = last = None
+    for _ in range(40):
+        loss = paddle.sum(V.yolo_loss(head, gt_box, gt_label, anchors,
+                                      mask, cls, 0.7, 8))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+        last = float(loss.numpy())
+    assert np.isfinite(last) and last < first * 0.5, (first, last)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(1, 2, 6, 6).astype(np.float32))
+    w = paddle.to_tensor(rng.rand(3, 2, 3, 3).astype(np.float32))
+    off = paddle.to_tensor(np.zeros((1, 2 * 3 * 3, 4, 4), np.float32))
+    dc = V.deform_conv2d(x, off, w)
+    ref = F.conv2d(x, w)
+    np.testing.assert_allclose(dc.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    # v2: all-ones mask is also identity
+    m = paddle.to_tensor(np.ones((1, 3 * 3, 4, 4), np.float32))
+    dc2 = V.deform_conv2d(x, off, w, mask=m)
+    np.testing.assert_allclose(dc2.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_deform_conv2d_layer_shift():
+    """A whole-pixel offset equals sampling the shifted image."""
+    rng = np.random.RandomState(1)
+    x_np = rng.rand(1, 1, 6, 6).astype(np.float32)
+    w = paddle.to_tensor(np.ones((1, 1, 1, 1), np.float32))
+    # offset (dy, dx) = (0, 1): sample one pixel to the right
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[0, 1] = 1.0
+    out = V.deform_conv2d(paddle.to_tensor(x_np), paddle.to_tensor(off), w)
+    np.testing.assert_allclose(out.numpy()[0, 0, :, :-1],
+                               x_np[0, 0, :, 1:], rtol=1e-5)
+
+    layer = V.DeformConv2D(1, 2, 3, padding=1)
+    o = layer(paddle.to_tensor(x_np),
+              paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32)))
+    assert tuple(o.shape) == (1, 2, 6, 6)
