@@ -3,3 +3,6 @@ from .core.autograd import (PyLayer, PyLayerContext, backward, grad,  # noqa: F4
                             no_grad, enable_grad, set_grad_enabled,
                             is_grad_enabled)
 from .autograd_functional import vjp, jvp, jacobian, hessian  # noqa: F401
+
+no_grad_ = no_grad  # reference alias
+from .core import autograd as backward_mode  # noqa: E402,F401

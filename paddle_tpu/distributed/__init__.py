@@ -25,6 +25,11 @@ from .parallel import (DataParallel, shard_batch, param_shardings,  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import shard_tensor, shard_op, reshard  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import utils  # noqa: F401
+from .compat import (split, gloo_init_parallel_env, gloo_barrier,  # noqa: F401
+                     gloo_release, InMemoryDataset, QueueDataset,
+                     CountFilterEntry, ProbabilityEntry)
 
 
 def __getattr__(name):

@@ -1,0 +1,193 @@
+"""Remaining paddle.distributed surface: split, gloo shims, dataset-path
+classes, utils.
+
+Reference parity: ``distributed/collective.py:1233`` split (model-parallel
+layer factory), gloo_init_parallel_env/gloo_barrier/gloo_release
+(CPU-rendezvous trio), ``distributed/fleet/dataset/`` InMemoryDataset /
+QueueDataset / BoxPSDataset (C++ data_feed channels), and
+``distributed/utils.py`` cluster helpers.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["split", "gloo_init_parallel_env", "gloo_barrier",
+           "gloo_release", "InMemoryDataset", "QueueDataset",
+           "CountFilterEntry", "ProbabilityEntry"]
+
+_split_layers: Dict[str, object] = {}
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel layer factory (reference ``collective.py:1233``):
+    'embedding' -> vocab-parallel embedding, 'linear' -> column/row
+    parallel linear by ``axis``.  The constructed layer is cached by
+    ``name`` so repeated calls share parameters (the reference creates
+    persistable params through its LayerHelper)."""
+    from .fleet.meta_parallel.mp_layers import (
+        VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear)
+    if name is None:
+        # key unnamed layers by their call site so two different unnamed
+        # projections never share parameters, while the same line reuses
+        # its layer across training iterations
+        import inspect
+        frame = inspect.currentframe().f_back
+        name = f"split@{frame.f_code.co_filename}:{frame.f_lineno}"
+    key = f"{name}_{operation}_{size}_{axis}"
+    layer = _split_layers.get(key)
+    if layer is None:
+        if operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr)
+        elif operation == "linear" and axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        elif operation == "linear" and axis == 1:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        else:
+            raise ValueError(
+                f"unsupported split operation {operation!r}/axis {axis}")
+        _split_layers[key] = layer
+    return layer(x)
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU rendezvous (reference parallel.py gloo trio): jax.distributed
+    fills this role — initialize via the standard env contract."""
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("PADDLE_MASTER", server_endpoint)
+    from .env import init_parallel_env
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from . import collective
+    collective.barrier()
+
+
+def gloo_release():
+    pass  # jax.distributed owns the store lifetime
+
+
+class CountFilterEntry:
+    """Sparse-feature admission by count (reference entry_attr)."""
+
+    def __init__(self, count_filter: int):
+        self.count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ProbabilityEntry:
+    def __init__(self, probability: float):
+        self.probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class _DatasetBase:
+    """Dataset-path shim (reference ``framework/data_set.h:47`` via
+    fleet/dataset): file-list driven sample pipelines for the PS/CTR
+    workflow.  Files are line-oriented; ``set_pipe_command`` transforms
+    are python callables here (no fork/exec pipe)."""
+
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_vars = []
+        self._pipe = None
+        self._records = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_vars = use_var or []
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd):
+        if callable(cmd):
+            self._pipe = cmd
+        else:
+            raise ValueError(
+                "the TPU build takes a python callable per line instead of "
+                "a shell pipe command")
+
+    def _iter_lines(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    yield self._pipe(line) if self._pipe else line
+
+    def __iter__(self):
+        batch = []
+        for sample in self._iter_lines():
+            batch.append(sample)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class InMemoryDataset(_DatasetBase):
+    """reference InMemoryDataset: load_into_memory + shuffle."""
+
+    def load_into_memory(self):
+        self._records = list(self._iter_lines())
+
+    def local_shuffle(self):
+        import random
+        if self._records is None:
+            raise RuntimeError("call load_into_memory() first")
+        random.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()  # single-host world: local == global
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records or [])
+
+    def release_memory(self):
+        self._records = None
+
+    def __iter__(self):
+        if self._records is None:
+            yield from super().__iter__()
+            return
+        batch = []
+        for sample in self._records:
+            batch.append(sample)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class QueueDataset(_DatasetBase):
+    """reference QueueDataset: streaming (never fully materialized)."""

@@ -1,0 +1,72 @@
+"""paddle.distributed.spawn (reference python/paddle/distributed/spawn.py:394).
+
+Spawns ``nprocs`` python processes running ``func(*args)`` with the
+PADDLE_* env contract set per rank (same contract as
+``paddle_tpu.distributed.launch``); each child gets a virtual CPU device
+mesh when requested, multi-host TPU processes use jax.distributed via
+init_parallel_env inside ``func``.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import sys
+from typing import Optional
+
+from .launch import get_cluster_env
+
+__all__ = ["spawn"]
+
+
+def _worker(func, args, rank, nprocs, ports, devices_per_proc):
+    env = get_cluster_env(
+        rank, nprocs,
+        [f"127.0.0.1:{p}" for p in ports[1:]],
+        f"127.0.0.1:{ports[0]}")
+    os.environ.update(env)
+    if devices_per_proc:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={devices_per_proc}"
+        ).strip()
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Run ``func`` in ``nprocs`` freshly spawned processes
+    (reference ``spawn.py:394``).  Returns the context (list of
+    Process objects) when ``join=False``."""
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    port = int(options.get("started_port", 0) or 0)
+    if port:
+        ports = [port - 1] + [port + i for i in range(nprocs)]
+    else:
+        from .utils import find_free_ports
+        # coordinator + one endpoint per rank, all actually free
+        ports = sorted(find_free_ports(nprocs + 1))
+    devices_per_proc = int(options.get("devices_per_proc", 0) or 0)
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, tuple(args), rank, nprocs, ports,
+                              devices_per_proc),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    failed = []
+    for p in procs:
+        p.join()
+        if p.exitcode != 0:
+            failed.append(p.exitcode)
+    if failed:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        raise RuntimeError(f"spawned processes failed with exit codes "
+                           f"{failed}")
+    return procs

@@ -1,0 +1,93 @@
+"""paddle.distributed.utils (reference python/paddle/distributed/utils.py:
+Cluster/Pod/Trainer bookkeeping + helpers used by launchers)."""
+from __future__ import annotations
+
+import os
+from typing import List
+
+__all__ = ["Cluster", "Pod", "Trainer", "get_cluster",
+           "get_host_name_ip", "find_free_ports"]
+
+
+class Trainer:
+    def __init__(self, endpoint: str = "", rank: int = -1):
+        self.endpoint = endpoint
+        self.rank = rank
+        self.accelerators: List[int] = []
+
+    def __repr__(self):
+        return f"Trainer(rank={self.rank}, endpoint={self.endpoint})"
+
+
+class Pod:
+    def __init__(self):
+        self.rank = -1
+        self.addr = ""
+        self.port = -1
+        self.trainers: List[Trainer] = []
+
+    def trainers_endpoints(self):
+        return [t.endpoint for t in self.trainers]
+
+
+class Cluster:
+    def __init__(self, hdfs=None):
+        self.pods: List[Pod] = []
+        self.hdfs = hdfs
+
+    def trainers_endpoints(self):
+        return [ep for p in self.pods for ep in p.trainers_endpoints()]
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def pods_endpoints(self):
+        return [f"{p.addr}:{p.port}" for p in self.pods]
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, device_mode=None,
+                devices_per_proc=None):
+    """Build the Cluster/Pod graph from host + endpoint lists (reference
+    ``distributed/utils.py`` get_cluster)."""
+    cluster = Cluster()
+    rank = 0
+    for pod_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = pod_rank
+        pod.addr = ip
+        eps = trainer_endpoints[pod_rank] if trainer_endpoints and \
+            isinstance(trainer_endpoints[0], (list, tuple)) else [
+            ep for ep in trainer_endpoints if ep.startswith(ip)]
+        for ep in eps:
+            t = Trainer(endpoint=ep, rank=rank)
+            rank += 1
+            pod.trainers.append(t)
+        cluster.pods.append(pod)
+    pod = cluster.pods[node_ips.index(node_ip)] if node_ip in node_ips \
+        else None
+    return cluster, pod
+
+
+def get_host_name_ip():
+    import socket
+    name = socket.gethostname()
+    try:
+        ip = socket.gethostbyname(name)
+    except OSError:
+        ip = "127.0.0.1"
+    return name, ip
+
+
+def find_free_ports(num: int):
+    import socket
+    socks, ports = [], []
+    try:
+        for _ in range(num):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return set(ports)

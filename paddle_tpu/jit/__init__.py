@@ -324,3 +324,20 @@ class TracedLayer:
     def save_inference_model(self, path, feed=None, fetch=None):
         specs = [InputSpec(t.shape, str(t.dtype)) for t in self._last_inputs]
         save(self._layer, path, input_spec=specs)
+
+
+# dy2static surface re-exports (reference paddle.jit namespace)
+from . import dy2static  # noqa: E402,F401
+from .dy2static import ProgramTranslator  # noqa: E402,F401
+
+
+def set_code_level(level=100):
+    """reference jit.set_code_level: print the converted source of
+    subsequently-converted functions when level > 0."""
+    from .dy2static import program_translator as _pt
+    _pt.CODE_LEVEL = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    from .dy2static import program_translator as _pt
+    _pt.CODE_LEVEL = level

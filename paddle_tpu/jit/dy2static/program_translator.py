@@ -21,6 +21,7 @@ __all__ = ["ProgramTranslator", "convert_to_static"]
 
 _cache: Dict[Callable, Callable] = {}
 _lock = threading.Lock()
+CODE_LEVEL = 0  # jit.set_code_level: >0 prints converted source
 
 
 class ProgramTranslator:
@@ -91,6 +92,8 @@ def _convert_function(fn) -> Callable:
 
     filename = f"<dy2static {fn.__module__}.{fn.__qualname__}>"
     code_src = ast.unparse(tree)
+    if CODE_LEVEL > 0:
+        print(f"--- dy2static converted {fn.__qualname__} ---\n{code_src}")
     # make the generated source inspectable in tracebacks
     linecache.cache[filename] = (len(code_src), None,
                                  code_src.splitlines(True), filename)

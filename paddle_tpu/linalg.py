@@ -58,3 +58,4 @@ def cond(x, p=None, name=None):
         nb = red(jnp.sum(jnp.abs(inv_a), axis=axis), axis=-1)
         return na * nb
     return dispatch("cond", impl, (x,), {})
+inverse = inv  # reference alias (paddle.linalg.inverse)

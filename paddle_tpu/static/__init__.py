@@ -20,3 +20,10 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     from ..core.autograd import grad as _grad
     return _grad(targets, inputs, grad_outputs=target_gradients,
                  allow_unused=True)
+
+from .compat import *  # noqa: F401,F403
+from .program import Program as _P  # noqa: F401
+from ..amp import *  # noqa: F401,F403  (paddle.static.amp parity)
+from .. import amp  # noqa: F401
+from .. import nn  # noqa: F401  (paddle.static.nn veneer)
+from .program import CompiledProgram as ParallelExecutor  # noqa: F401

@@ -1,0 +1,378 @@
+"""Static-graph compatibility surface.
+
+Reference parity: the rest of ``python/paddle/static/__init__.py`` —
+Scope/global_scope/scope_guard, name_scope/device_guard, *_places,
+create_parameter/create_global_var, program/state (de)serialization,
+save/load(+vars), py_func, accuracy/auc, ExponentialMovingAverage,
+Build/ExecutionStrategy, WeightNormParamAttr.
+
+TPU-first: a "Scope" is a name->Tensor dict (the reference's C++ Scope
+tree is variable storage for program execution — here eager tensors are
+their own storage); programs serialize as the captured build function's
+artifacts (StableHLO via static.io), state as pickled array dicts.
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.param_attr import ParamAttr
+
+__all__ = [
+    "Scope", "global_scope", "scope_guard", "name_scope", "device_guard",
+    "cpu_places", "cuda_places", "xpu_places", "npu_places",
+    "create_parameter", "create_global_var", "py_func", "accuracy", "auc",
+    "ExponentialMovingAverage", "BuildStrategy", "ExecutionStrategy",
+    "WeightNormParamAttr", "Print", "save", "load", "save_vars",
+    "load_vars", "load_program_state", "set_program_state",
+    "serialize_program", "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "save_to_file", "load_from_file",
+    "normalize_program", "Variable", "append_backward",
+]
+
+Variable = Tensor  # the 2.x static Variable is a Tensor here
+
+
+class Scope:
+    """name -> Tensor storage (reference ``framework/scope.h:62``)."""
+
+    def __init__(self):
+        self._vars: Dict[str, Tensor] = {}
+
+    def var(self, name: str) -> Tensor:
+        if name not in self._vars:
+            self._vars[name] = Tensor(jnp.zeros(()))
+        return self._vars[name]
+
+    def find_var(self, name: str) -> Optional[Tensor]:
+        return self._vars.get(name)
+
+    def set_var(self, name: str, value) -> None:
+        self._vars[name] = value if isinstance(value, Tensor) \
+            else Tensor(jnp.asarray(value))
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str = None):
+    """Naming-only context (the reference prefixes op names for debug
+    visualization; jaxpr keeps its own naming)."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device: str = None):
+    """Reference pins ops to a device (op_device attr for pipeline
+    partitioning); placement here is mesh/sharding-driven, so this is a
+    no-op context kept for source compatibility."""
+    yield
+
+
+def cpu_places(device_count=None):
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    from ..core.place import CPUPlace
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    raise RuntimeError("no CUDA in the TPU build; devices are PJRT "
+                       "(see paddle.device)")
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError("no XPU in the TPU build")
+
+
+def npu_places(device_ids=None):
+    raise RuntimeError("no NPU in the TPU build")
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference static.create_parameter — standalone Parameter tensor."""
+    from ..core.tensor import Parameter
+    from ..nn import initializer as I
+    init = default_initializer or (
+        attr.initializer if isinstance(attr, ParamAttr) and attr.initializer
+        else (I.Constant(0.0) if is_bias else I.XavierNormal()))
+    from ..core.dtype import dtype_to_jnp
+    arr = init(tuple(int(s) for s in shape), dtype_to_jnp(dtype))
+    p = Parameter(arr, name=name)
+    global_scope().set_var(p.name, p)
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..core.dtype import dtype_to_jnp
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        dtype_to_jnp(dtype)))
+    t.name = name or t.name
+    global_scope().set_var(t.name, t)
+    return t
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op (reference py_func_op): runs ``func`` on the inputs
+    eagerly / via pure_callback under trace."""
+    from ..core.dispatch import dispatch
+    from ..core.tensor import to_tensor
+    xs = [to_tensor(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
+
+    def impl(*arrays):
+        host = [np.asarray(a) for a in arrays]
+        res = func(*host)
+        return jnp.asarray(res)
+    if any(isinstance(t._data, jax.core.Tracer) for t in xs):
+        out_aval = jax.ShapeDtypeStruct(tuple(out.shape), out._data.dtype)
+        arr = jax.pure_callback(lambda *a: np.asarray(func(*a)), out_aval,
+                                *[t._data for t in xs])
+        return Tensor(arr)
+    return dispatch("py_func", impl, xs, {})
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy (reference static accuracy layer)."""
+    from .. import ops as P
+    from ..core.tensor import to_tensor
+    input, label = to_tensor(input), to_tensor(label)
+    topk = jnp.argsort(-input._data, axis=-1)[..., :k]
+    lab = label._data.reshape(-1, 1)
+    hit = (topk == lab).any(-1)
+    return Tensor(jnp.mean(hit.astype(jnp.float32)))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Area under ROC (reference static auc layer; batch-local here)."""
+    from ..metric import Auc
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(input), np.asarray(label))
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static.ExponentialMovingAverage):
+    update() after each step; apply()/restore() swap averaged weights in
+    and out (e.g. for evaluation)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._ema: Dict[int, jnp.ndarray] = {}
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._params = []
+        self._step = 0
+
+    def _track(self, parameters):
+        if parameters is not None:
+            self._params = list(parameters)
+        return self._params
+
+    def update(self, parameters=None):
+        params = self._track(parameters)
+        if not params:
+            raise ValueError("pass parameters= on the first update()")
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in params:
+            key = id(p)
+            prev = self._ema.get(key, p._data)
+            self._ema[key] = d * prev + (1 - d) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        params = self._params
+        for p in params:
+            self._backup[id(p)] = p._data
+            p._data = self._ema.get(id(p), p._data)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+class BuildStrategy:
+    """Config bag (reference BuildStrategy proto); XLA owns fusion and
+    scheduling, so these are recorded but advisory."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = False
+        self.memory_optimize = None
+        self.reduce_strategy = None
+        self.gradient_scale_strategy = None
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+
+
+class WeightNormParamAttr(ParamAttr):
+    """reference WeightNormParamAttr: ParamAttr marking weight-norm
+    reparameterization (apply nn.utils.weight_norm on the layer)."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference Print): host-prints and passes through."""
+    arr = np.asarray(input._data if isinstance(input, Tensor) else input)
+    prefix = (message or "") + (f" {getattr(input, 'name', '')}"
+                                if print_tensor_name else "")
+    print(f"{prefix} shape={arr.shape} dtype={arr.dtype} "
+          f"values={arr.reshape(-1)[:summarize]}")
+    return input
+
+
+# -- program / state (de)serialization --------------------------------------
+def _state_of(program):
+    params, buffers = {}, {}
+    net = getattr(program, "_network", None)
+    if net is not None:
+        params = {n: np.asarray(p._data) for n, p in net.named_parameters()}
+        buffers = {n: np.asarray(b._data) for n, b in net.named_buffers()}
+    else:
+        params = {n: np.asarray(v._data)
+                  for n, v in global_scope()._vars.items()}
+    return {"params": params, "buffers": buffers}
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdstate" if not model_path.endswith(".pdstate")
+              else model_path, "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    net = getattr(program, "_network", None)
+    if net is None:
+        for n, arr in state_dict.get("params", state_dict).items():
+            global_scope().set_var(n, Tensor(jnp.asarray(arr)))
+        return
+    lookup = dict(net.named_parameters())
+    lookup.update(dict(net.named_buffers()))
+    flat = dict(state_dict.get("params", {}))
+    flat.update(state_dict.get("buffers", {}))
+    for n, arr in flat.items():
+        if n in lookup:
+            lookup[n]._data = jnp.asarray(arr)
+
+
+def save(program, model_path, protocol=4):
+    """reference static.save: persistables + program artifact."""
+    with open(model_path + ".pdstate", "wb") as f:
+        pickle.dump(_state_of(program), f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    set_program_state(program, load_program_state(model_path))
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import os
+    os.makedirs(dirname, exist_ok=True)
+    data = {getattr(v, "name", f"var{i}"): np.asarray(v._data)
+            for i, v in enumerate(vars or [])}
+    with open(f"{dirname}/{filename or 'vars.pkl'}", "wb") as f:
+        pickle.dump(data, f, protocol=4)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    with open(f"{dirname}/{filename or 'vars.pkl'}", "rb") as f:
+        data = pickle.load(f)
+    for v in vars or []:
+        if v.name in data:
+            v._data = jnp.asarray(data[v.name])
+
+
+def serialize_program(feed_vars, fetch_vars, program=None):
+    from .io import save_inference_model
+    import tempfile, os
+    d = tempfile.mkdtemp()
+    prefix = save_inference_model(os.path.join(d, "m"), feed_vars,
+                                  fetch_vars, program=program)
+    with open(prefix + ".pdmodel", "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data: bytes):
+    from jax import export as jax_export
+    return jax_export.deserialize(bytearray(data))
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None):
+    return pickle.dumps(_state_of(program), protocol=4)
+
+
+def deserialize_persistables(program, data, executor=None):
+    set_program_state(program, pickle.loads(data))
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """reference normalize_program prunes to the feed->fetch subgraph;
+    XLA dead-code-eliminates at compile, so the program passes through."""
+    return program
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference fluid/backward.py:1406 — returns (param, grad) pairs via
+    the autograd engine."""
+    from ..core import autograd
+    params = parameter_list
+    if params is None:
+        raise ValueError("append_backward needs parameter_list on the "
+                         "TPU path (no global program to scan)")
+    grads = autograd.grad(loss, params, allow_unused=True, retain_graph=True)
+    return list(zip(params, grads))

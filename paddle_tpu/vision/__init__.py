@@ -1,6 +1,42 @@
-"""paddle.vision namespace."""
+"""paddle.vision namespace (reference python/paddle/vision/__init__.py
+re-exports models / transforms / datasets at the package level)."""
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
-from .models import LeNet  # noqa: F401
 from . import ops  # noqa: F401
+from .models import *  # noqa: F401,F403
+from .transforms import *  # noqa: F401,F403
+from .datasets import *  # noqa: F401,F403
+
+_image_backend = "numpy"
+
+
+def set_image_backend(backend):
+    """reference vision/image.py set_image_backend (pil/cv2/numpy; only
+    the numpy/tensor path exists in this zero-dependency build)."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "numpy", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file as an HWC uint8 array (reference image_load);
+    npy/npz natively, PIL only if available."""
+    import numpy as np
+    if str(path).endswith(".npy"):
+        return np.load(path)
+    if str(path).endswith(".npz"):
+        z = np.load(path)
+        return z[z.files[0]]
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path))
+    except ImportError as e:
+        raise RuntimeError(
+            "image decoding needs PIL, which is not available; save "
+            "arrays as .npy or decode in your own loader") from e

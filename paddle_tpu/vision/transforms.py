@@ -12,7 +12,11 @@ import numpy as np
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
            "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
            "Transpose", "BrightnessTransform", "Pad", "RandomResizedCrop",
-           "to_tensor", "normalize", "resize", "hflip", "vflip"]
+           "BaseTransform", "Grayscale", "RandomRotation",
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "ColorJitter", "to_tensor", "normalize", "resize", "hflip",
+           "vflip", "crop", "center_crop", "pad", "rotate", "to_grayscale",
+           "adjust_brightness", "adjust_contrast", "adjust_hue"]
 
 
 class Compose:
@@ -205,3 +209,232 @@ class Pad:
             p = (p, p, p, p)
         return np.pad(a, [(p[1], p[3]), (p[0], p[2])] +
                       [(0, 0)] * (a.ndim - 2))
+
+
+# ---------------------------------------------------------------------------
+# remaining functional ops + transforms (reference vision/transforms/)
+# ---------------------------------------------------------------------------
+def crop(img, top, left, height, width):
+    """Crop an HWC ndarray (reference transforms.functional.crop)."""
+    img = np.asarray(img)
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = np.asarray(img)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    h, w = img.shape[:2]
+    top = max(0, (h - oh) // 2)
+    left = max(0, (w - ow) // 2)
+    return crop(img, top, left, oh, ow)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = np.asarray(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    width = [(pt, pb), (pl, pr)] + [(0, 0)] * (img.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(img, width, mode=mode, **kw)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate an HWC image by ``angle`` degrees counter-clockwise
+    (inverse-warp with nearest/bilinear sampling)."""
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    if expand:
+        nh = int(abs(h * cos) + abs(w * sin) + 0.5)
+        nw = int(abs(w * cos) + abs(h * sin) + 0.5)
+    else:
+        nh, nw = h, w
+    ocy, ocx = (nh - 1) / 2.0, (nw - 1) / 2.0
+    yy, xx = np.meshgrid(np.arange(nh), np.arange(nw), indexing="ij")
+    # inverse map: output (y, x) -> source coords
+    sy = (yy - ocy) * cos - (xx - ocx) * sin + cy
+    sx = (yy - ocy) * sin + (xx - ocx) * cos + cx
+    if interpolation == "bilinear":
+        y0 = np.clip(np.floor(sy).astype(int), 0, h - 1)
+        x0 = np.clip(np.floor(sx).astype(int), 0, w - 1)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        ly, lx = sy - y0, sx - x0
+        ly = np.clip(ly, 0, 1)[..., None] if img.ndim == 3 else np.clip(ly, 0, 1)
+        lx = np.clip(lx, 0, 1)[..., None] if img.ndim == 3 else np.clip(lx, 0, 1)
+        out = (img[y0, x0] * (1 - ly) * (1 - lx) + img[y1, x0] * ly * (1 - lx)
+               + img[y0, x1] * (1 - ly) * lx + img[y1, x1] * ly * lx)
+    else:
+        ys = np.clip(np.round(sy).astype(int), 0, h - 1)
+        xs = np.clip(np.round(sx).astype(int), 0, w - 1)
+        out = img[ys, xs]
+    inside = (sy >= -0.5) & (sy <= h - 0.5) & (sx >= -0.5) & (sx <= w - 0.5)
+    if img.ndim == 3:
+        inside = inside[..., None]
+    return np.where(inside, out, fill).astype(img.dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = np.asarray(img).astype(np.float32)
+    gray = img[..., 0] * 0.299 + img[..., 1] * 0.587 + img[..., 2] * 0.114
+    gray = gray[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return gray
+
+
+def _value_range(img):
+    """Value ceiling from dtype: integer images are [0, 255], floats are
+    [0, 1] (value-based guessing misclassifies dark uint8 frames)."""
+    return 255.0 if np.issubdtype(np.asarray(img).dtype, np.integer) \
+        else 1.0
+
+
+def adjust_brightness(img, brightness_factor):
+    hi = _value_range(img)
+    img = np.asarray(img).astype(np.float32)
+    return np.clip(img * brightness_factor, 0, hi)
+
+
+def adjust_contrast(img, contrast_factor):
+    hi = _value_range(img)
+    img = np.asarray(img).astype(np.float32)
+    mean = to_grayscale(img).mean()
+    return np.clip(mean + contrast_factor * (img - mean), 0, hi)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) via HSV roundtrip."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    orig_dtype = np.asarray(img).dtype
+    hi = _value_range(img)
+    img = np.asarray(img).astype(np.float32)
+    x = img / hi
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx, mn = x.max(-1), x.min(-1)
+    diff = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    h = np.where(mx == r, ((g - b) / diff) % 6, h)
+    h = np.where(mx == g, (b - r) / diff + 2, h)
+    h = np.where(mx == b, (r - g) / diff + 4, h)
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p, q, t = v * (1 - s), v * (1 - s * f), v * (1 - s * (1 - f))
+    i = (i.astype(int) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return (out * hi).astype(orig_dtype)
+
+
+class BaseTransform:
+    """reference transforms.BaseTransform: keys-aware callable base."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            return tuple(self._apply_image(i) if k == "image" else i
+                         for i, k in zip(inputs, self.keys))
+        return self._apply_image(inputs)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, (int, float)) else tuple(degrees)
+        self.kw = dict(interpolation=interpolation, expand=expand,
+                       center=center, fill=fill)
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, **self.kw)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = to_grayscale(img, 3)
+        return np.clip(gray + factor * (np.asarray(img, np.float32) - gray),
+                       0, _value_range(img))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue (reference ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [t for t in (
+            BrightnessTransform(brightness) if brightness else None,
+            ContrastTransform(contrast) if contrast else None,
+            SaturationTransform(saturation) if saturation else None,
+            HueTransform(hue) if hue else None) if t is not None]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i](img)
+        return img
