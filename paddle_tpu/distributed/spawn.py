@@ -13,12 +13,14 @@ import os
 import sys
 from typing import Optional
 
-from .launch import get_cluster_env
-
 __all__ = ["spawn"]
 
 
 def _worker(func, args, rank, nprocs, ports, devices_per_proc):
+    # imported lazily: an eager module-level import of .launch would
+    # defeat the package's lazy `launch` attribute and re-trigger the
+    # `python -m` double-import warning
+    from .launch import get_cluster_env
     env = get_cluster_env(
         rank, nprocs,
         [f"127.0.0.1:{p}" for p in ports[1:]],
@@ -58,15 +60,25 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         procs.append(p)
     if not join:
         return procs
+    # poll all children: the first failure terminates the peers (they may
+    # be blocked in a collective waiting for the dead rank forever)
+    import time
     failed = []
-    for p in procs:
-        p.join()
-        if p.exitcode != 0:
-            failed.append(p.exitcode)
+    while True:
+        alive = [p for p in procs if p.is_alive()]
+        failed = [p.exitcode for p in procs
+                  if not p.is_alive() and p.exitcode not in (0, None)]
+        if failed or not alive:
+            break
+        time.sleep(0.2)
     if failed:
         for p in procs:
             if p.is_alive():
                 p.terminate()
+        for p in procs:
+            p.join(timeout=10)
         raise RuntimeError(f"spawned processes failed with exit codes "
                            f"{failed}")
+    for p in procs:
+        p.join()
     return procs

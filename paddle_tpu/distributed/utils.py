@@ -57,7 +57,7 @@ def get_cluster(node_ips, node_ip, trainer_endpoints, device_mode=None,
         pod.addr = ip
         eps = trainer_endpoints[pod_rank] if trainer_endpoints and \
             isinstance(trainer_endpoints[0], (list, tuple)) else [
-            ep for ep in trainer_endpoints if ep.startswith(ip)]
+            ep for ep in trainer_endpoints if ep.rsplit(":", 1)[0] == ip]
         for ep in eps:
             t = Trainer(endpoint=ep, rank=rank)
             rank += 1

@@ -338,6 +338,11 @@ def set_code_level(level=100):
     _pt.CODE_LEVEL = level
 
 
+_verbosity = 0
+
+
 def set_verbosity(level=0, also_to_stdout=False):
-    from .dy2static import program_translator as _pt
-    _pt.CODE_LEVEL = level
+    """reference jit.set_verbosity: transform-log verbosity only (does
+    not toggle converted-source printing — that is set_code_level)."""
+    global _verbosity
+    _verbosity = level

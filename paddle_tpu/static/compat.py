@@ -329,11 +329,11 @@ def load_vars(executor, dirname, main_program=None, vars=None,
 def serialize_program(feed_vars, fetch_vars, program=None):
     from .io import save_inference_model
     import tempfile, os
-    d = tempfile.mkdtemp()
-    prefix = save_inference_model(os.path.join(d, "m"), feed_vars,
-                                  fetch_vars, program=program)
-    with open(prefix + ".pdmodel", "rb") as f:
-        return f.read()
+    with tempfile.TemporaryDirectory() as d:
+        prefix = save_inference_model(os.path.join(d, "m"), feed_vars,
+                                      fetch_vars, program=program)
+        with open(prefix + ".pdmodel", "rb") as f:
+            return f.read()
 
 
 def deserialize_program(data: bytes):

@@ -14,7 +14,7 @@ import numpy as np
 from ..io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
-           "ImageFolder"]
+           "ImageFolder", "Flowers", "VOC2012"]
 
 
 class _SyntheticImageDataset(Dataset):
@@ -122,3 +122,27 @@ class ImageFolder(DatasetFolder):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(_SyntheticImageDataset):
+    """reference vision/datasets/flowers.py (102 classes, 3x224x224)."""
+    _shape = (3, 64, 64)   # reduced synthetic resolution; same fields
+    _nclass = 102
+    _n = 6149
+
+
+class VOC2012(_SyntheticImageDataset):
+    """reference vision/datasets/voc2012.py: (image, segmentation mask)."""
+    _shape = (3, 64, 64)
+    _nclass = 21
+    _n = 2913
+
+    def __getitem__(self, idx):
+        # image path shared with the base class; NOTE: transforms are
+        # image-only here, so use geometry-preserving transforms (paired
+        # image+mask augmentation is the caller's job)
+        img, _ = super().__getitem__(idx)
+        rng = np.random.RandomState(idx % self._pool)
+        mask = rng.randint(0, self._nclass,
+                           size=self._shape[1:]).astype("int64")
+        return img, mask

@@ -193,9 +193,8 @@ class BrightnessTransform:
         self.value = value
 
     def __call__(self, img):
-        a = np.asarray(img, dtype="float32")
         alpha = 1 + random.uniform(-self.value, self.value)
-        return np.clip(a * alpha, 0, 255 if a.max() > 1 else 1.0)
+        return adjust_brightness(img, alpha)
 
 
 class Pad:
@@ -303,16 +302,18 @@ def _value_range(img):
 
 
 def adjust_brightness(img, brightness_factor):
+    orig = np.asarray(img).dtype
     hi = _value_range(img)
     img = np.asarray(img).astype(np.float32)
-    return np.clip(img * brightness_factor, 0, hi)
+    return np.clip(img * brightness_factor, 0, hi).astype(orig)
 
 
 def adjust_contrast(img, contrast_factor):
+    orig = np.asarray(img).dtype
     hi = _value_range(img)
     img = np.asarray(img).astype(np.float32)
     mean = to_grayscale(img).mean()
-    return np.clip(mean + contrast_factor * (img - mean), 0, hi)
+    return np.clip(mean + contrast_factor * (img - mean), 0, hi).astype(orig)
 
 
 def adjust_hue(img, hue_factor):
@@ -356,8 +357,10 @@ class BaseTransform:
 
     def __call__(self, inputs):
         if isinstance(inputs, tuple):
+            # elements beyond the declared keys pass through untouched
+            keys = list(self.keys) + ["_"] * (len(inputs) - len(self.keys))
             return tuple(self._apply_image(i) if k == "image" else i
-                         for i, k in zip(inputs, self.keys))
+                         for i, k in zip(inputs, keys))
         return self._apply_image(inputs)
 
 
@@ -406,8 +409,9 @@ class SaturationTransform(BaseTransform):
             return img
         factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
         gray = to_grayscale(img, 3)
-        return np.clip(gray + factor * (np.asarray(img, np.float32) - gray),
-                       0, _value_range(img))
+        out = np.clip(gray + factor * (np.asarray(img, np.float32) - gray),
+                      0, _value_range(img))
+        return out.astype(np.asarray(img).dtype)
 
 
 class HueTransform(BaseTransform):
