@@ -93,6 +93,17 @@ def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
     fn's array params); kwargs are static non-tensor attrs."""
     from .tensor import Tensor
 
+    # static-graph capture: under paddle.enable_static() ops append to the
+    # active Program instead of executing (reference: OpProtoHolder append
+    # path, framework.py:2147; see static/program.py capture_op)
+    from ..static import mode as _static_mode
+    if not _static_mode.in_dynamic_mode():
+        from ..static import program as _static_program
+        prog = _static_program.capturing_program()
+        if prog is not None:
+            return _static_program.capture_op(prog, op_name, fn,
+                                              tensor_args, kwargs)
+
     arrays = [t._data for t in tensor_args]
     # AMP autocast rewrite (reference imperative/tracer.cc:179-185)
     from ..amp import amp_cast_inputs, _amp_state

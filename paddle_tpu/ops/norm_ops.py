@@ -80,12 +80,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     bshape = [1] * x.ndim
     bshape[channel_axis] = x.shape[channel_axis]
 
-    def impl(a, mean_r, var_r, *wb):
-        if use_global_stats:
-            mu, var = mean_r, var_r
-        else:
-            mu = jnp.mean(a, axis=axes)
-            var = jnp.var(a, axis=axes)
+    def _norm(a, mu, var, wb):
         out = (a - mu.reshape(bshape)) * jax.lax.rsqrt(
             var.reshape(bshape) + epsilon)
         i = 0
@@ -95,8 +90,44 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         if has_b:
             out = out + wb[i].reshape(bshape)
         return out
-    out = dispatch("batch_norm", impl, tensors, {})
 
+    def impl(a, mean_r, var_r, *wb):
+        if use_global_stats:
+            mu, var = mean_r, var_r
+        else:
+            mu = jnp.mean(a, axis=axes)
+            var = jnp.var(a, axis=axes)
+        return _norm(a, mu, var, wb)
+
+    def impl_eval(a, mean_r, var_r, *wb):
+        return _norm(a, mean_r, var_r, wb)
+
+    from ..static.program import capturing_program, capture_op
+    prog = capturing_program()
+    if prog is not None:
+        # program mode: the forward op carries its is_test lowering
+        # (clone(for_test=True) swaps it in — reference batch_norm flips
+        # the is_test attr), and the running-stat update is a separate
+        # captured op whose outputs ARE the buffer vars (reference
+        # MeanOut/VarianceOut in-place outputs, batch_norm_op.cc).
+        # The buffers register as mutable vars FIRST so every op reads
+        # their live (not capture-time) values.
+        prog.parameters[rm.name] = rm
+        prog.parameters[rv.name] = rv
+        out = capture_op(prog, "batch_norm", impl, tensors, {},
+                         eval_impl=impl_eval)
+        if training and not use_global_stats:
+
+            def stats_impl(a, mean_r, var_r):
+                bm = jnp.mean(a, axis=axes)
+                bv = jnp.var(a, axis=axes)
+                return (momentum * mean_r + (1.0 - momentum) * bm,
+                        momentum * var_r + (1.0 - momentum) * bv)
+            capture_op(prog, "batch_norm_stats", stats_impl, (x, rm, rv),
+                       {}, output_names=[rm.name, rv.name])
+        return out
+
+    out = dispatch("batch_norm", impl, tensors, {})
     if training and not use_global_stats:
         batch_mean = jnp.mean(x._data, axis=axes)
         batch_var = jnp.var(x._data, axis=axes)

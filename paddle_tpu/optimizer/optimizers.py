@@ -138,11 +138,64 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import Variable as _StaticVar
+        if isinstance(loss, _StaticVar):
+            return self._minimize_static(loss, parameters, no_grad_set)
         if loss._grad_node is not None and all(
                 p.grad is None for p in (self._parameter_list or [])):
             loss.backward()
         self.step()
         return None, None
+
+    def _minimize_static(self, loss, parameters=None, no_grad_set=None):
+        """Program-mode minimize (reference ``optimizer.py:49`` static
+        path): append_backward scans the program for parameters, then one
+        update op per (param, grad) pair is appended.  The op type is the
+        optimizer's name (``sgd``/``adam``/...), matching the reference's
+        optimizer op names for golden checks."""
+        from ..static.program import (OpDesc, append_backward as _ab,
+                                      default_main_program, _LR_NAME)
+        prog = loss.program or default_main_program()
+        params_grads = _ab(loss, parameter_list=parameters,
+                           no_grad_set=no_grad_set)
+        prog._lr_provider = self.get_lr
+        op_type = type(self).__name__.lower()
+
+        if self._grad_clip is not None and hasattr(self._grad_clip,
+                                                   "_clip_arrays"):
+            grad_names = [g.name for _, g in params_grads]
+
+            def clip_impl(*garrs, _clip=self._grad_clip):
+                return tuple(_clip._clip_arrays(list(garrs)))
+            prog._append(OpDesc("clip_by_global_norm", "compute", clip_impl,
+                                grad_names, grad_names))
+
+        for p, gvar in params_grads:
+            state = self._init_state_for(p._data)
+            keys = sorted(state)
+            state_names = [f"{p.name}_{k}" for k in keys]
+            for sn, k in zip(state_names, keys):
+                prog.state_vars[sn] = state[k]
+            reg = p.regularizer if p.regularizer is not None else (
+                self._weight_decay_reg if self._coupled_weight_decay
+                else None)
+
+            def impl(param, grad, lr, *slots, _keys=tuple(keys),
+                     _self=self, _p=p, _reg=reg):
+                _self._current_param_name = _p.name or ""
+                g = grad.astype(param.dtype)
+                if _reg is not None and _reg.coeff:
+                    g = g + _reg.grad(param)
+                lr_eff = lr * _p.optimize_attr.get("learning_rate", 1.0)
+                new_p, new_sd = _self._update(param, g,
+                                              dict(zip(_keys, slots)),
+                                              lr_eff)
+                return (new_p,) + tuple(new_sd[k] for k in _keys)
+
+            prog._append(OpDesc(op_type, "optimize", impl,
+                                [p.name, gvar.name, _LR_NAME] + state_names,
+                                [p.name] + state_names))
+        return None, params_grads
 
     @autograd.no_grad()
     def clear_grad(self, set_to_zero: bool = False):

@@ -9,21 +9,17 @@ reproduced — jaxpr/HLO is the IR (see SURVEY.md §7 translation table).
 from .mode import enable_static, disable_static, in_dynamic_mode  # noqa: F401
 from .program import (Program, default_main_program,  # noqa: F401
                       default_startup_program, program_guard, data,
-                      Executor, CompiledProgram)
+                      Executor, CompiledProgram, Variable, OpDesc, Block,
+                      append_backward, gradients)
 from .io import save_inference_model, load_inference_model  # noqa: F401
 from ..jit import InputSpec  # noqa: F401
 from .. import sparsity  # noqa: F401  (paddle.static.sparsity parity)
 from .. import nn as _nn  # re-export layer helpers commonly used in static
 
 
-def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    from ..core.autograd import grad as _grad
-    return _grad(targets, inputs, grad_outputs=target_gradients,
-                 allow_unused=True)
-
 from .compat import *  # noqa: F401,F403
 from .program import Program as _P  # noqa: F401
 from ..amp import *  # noqa: F401,F403  (paddle.static.amp parity)
 from .. import amp  # noqa: F401
-from .. import nn  # noqa: F401  (paddle.static.nn veneer)
+from . import nn  # noqa: F401  (static layer fns + layer classes)
 from .program import CompiledProgram as ParallelExecutor  # noqa: F401

@@ -37,7 +37,7 @@ __all__ = [
     "normalize_program", "Variable", "append_backward",
 ]
 
-Variable = Tensor  # the 2.x static Variable is a Tensor here
+from .program import Variable  # symbolic static-graph Variable
 
 
 class Scope:
@@ -365,14 +365,4 @@ def normalize_program(program, feed_vars, fetch_vars):
     return program
 
 
-def append_backward(loss, parameter_list=None, no_grad_set=None,
-                    callbacks=None, checkpoints=None):
-    """reference fluid/backward.py:1406 — returns (param, grad) pairs via
-    the autograd engine."""
-    from ..core import autograd
-    params = parameter_list
-    if params is None:
-        raise ValueError("append_backward needs parameter_list on the "
-                         "TPU path (no global program to scan)")
-    grads = autograd.grad(loss, params, allow_unused=True, retain_graph=True)
-    return list(zip(params, grads))
+from .program import append_backward  # noqa: F401  (program-scanning)

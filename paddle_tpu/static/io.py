@@ -38,34 +38,51 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
     declared shapes; fetch_vars select the outputs by name.
     """
     program = program or default_main_program()
-    if program._build_fn is None:
-        raise RuntimeError("program has no build function; assign "
-                           "program._build_fn or use paddle_tpu.jit.save")
+    if program._build_fn is None and not program.ops:
+        raise RuntimeError("program has no ops and no build function; "
+                           "build it under paddle.enable_static(), assign "
+                           "program._build_fn, or use paddle_tpu.jit.save")
     feed_names = [_var_name(v) for v in feed_vars]
     fetch_names = [_var_name(v) for v in fetch_vars]
     shapes_dtypes = []
     for v in feed_vars:
         if isinstance(v, _DataPlaceholder):
-            shapes_dtypes.append((list(v.declared_shape), v._data.dtype))
+            shapes_dtypes.append((list(v.declared_shape),
+                                  jnp.dtype(v.dtype)))
         else:
             t = v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
-            shapes_dtypes.append((list(t.shape), t._data.dtype))
+            shapes_dtypes.append((list(t.shape), jnp.dtype(t.dtype)))
 
-    def infer(*arrays):
-        with autograd.no_grad():
-            outs = program._build_fn(dict(zip(feed_names, arrays)))
-        if not isinstance(outs, dict):
-            seq = list(outs) if isinstance(outs, (list, tuple)) else [outs]
-            if len(seq) != len(fetch_names):
-                raise ValueError(
-                    f"build_fn returned {len(seq)} outputs but "
-                    f"{len(fetch_names)} fetch_vars were requested")
-            outs = dict(zip(fetch_names, seq))
-        result = []
-        for n in fetch_names:
-            v = outs[n]
-            result.append(v._data if isinstance(v, Tensor) else jnp.asarray(v))
-        return tuple(result)
+    if program._build_fn is not None:
+        def infer(*arrays):
+            with autograd.no_grad():
+                outs = program._build_fn(dict(zip(feed_names, arrays)))
+            if not isinstance(outs, dict):
+                seq = list(outs) if isinstance(outs, (list, tuple)) \
+                    else [outs]
+                if len(seq) != len(fetch_names):
+                    raise ValueError(
+                        f"build_fn returned {len(seq)} outputs but "
+                        f"{len(fetch_names)} fetch_vars were requested")
+                outs = dict(zip(fetch_names, seq))
+            result = []
+            for n in fetch_names:
+                v = outs[n]
+                result.append(v._data if isinstance(v, Tensor)
+                              else jnp.asarray(v))
+            return tuple(result)
+    else:
+        # captured-program path: replay the forward (compute) ops with
+        # parameters baked in as constants (reference merged __params__)
+        infer_prog = program.clone(for_test=True)
+        from .program import _build_runner
+        runner = _build_runner(infer_prog, tuple(fetch_names), ())
+        params = {n: p._data for n, p in infer_prog.parameters.items()}
+
+        def infer(*arrays):
+            fetches, _ = runner(dict(zip(feed_names, arrays)), params,
+                                jnp.float32(0))
+            return tuple(fetches)
 
     from ..jit import export_with_dynamic_dims
     exp = export_with_dynamic_dims(jax.jit(infer), shapes_dtypes)

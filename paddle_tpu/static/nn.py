@@ -1,0 +1,131 @@
+"""paddle.static.nn: reference-style static layer functions.
+
+Reference parity: ``python/paddle/static/nn/__init__.py`` (fc, conv2d,
+batch_norm, embedding, ...) which wrap ``fluid.layers``.  TPU-first: each
+function creates eager Parameters (initializers run immediately, like the
+reference's startup program would) and then calls the op surface — under
+``paddle.enable_static()`` those op calls are captured into the active
+Program (see static/program.py capture_op).
+
+The full ``paddle.nn`` layer surface is also re-exported so
+``paddle.static.nn.Conv2D`` etc. keep working as in round 1.
+"""
+from __future__ import annotations
+
+from ..nn import *  # noqa: F401,F403  (layer classes remain available)
+from .. import ops as _ops
+from ..core.dtype import dtype_to_jnp
+
+
+def _prod(xs):
+    n = 1
+    for x in xs:
+        n *= int(x)
+    return n
+
+
+def _make_param(shape, dtype, attr, is_bias=False, default_initializer=None):
+    from .compat import create_parameter
+    return create_parameter(shape, dtype, attr=attr, is_bias=is_bias,
+                            default_initializer=default_initializer)
+
+
+def _activate(out, activation):
+    if activation is None:
+        return out
+    fn = getattr(_ops, activation, None)
+    if fn is None:
+        from .. import nn
+        fn = getattr(nn.functional, activation)
+    return fn(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference ``static.nn.fc`` (fluid/layers/nn.py fc): flatten trailing
+    dims, y = act(x @ W + b)."""
+    shape = x.shape
+    if num_flatten_dims < 0:
+        num_flatten_dims = len(shape) + num_flatten_dims
+    in_dim = _prod(shape[num_flatten_dims:])
+    dtype = x.dtype
+    w = _make_param([in_dim, size], dtype, weight_attr)
+    if len(shape) > num_flatten_dims + 1:
+        lead = [s if s and s > 0 else -1 for s in shape[:num_flatten_dims]]
+        x = _ops.reshape(x, shape=lead + [in_dim])
+    out = _ops.matmul(x, w)
+    if bias_attr is not False:
+        b = _make_param([size], dtype, bias_attr, is_bias=True)
+        out = _ops.add(out, b)
+    return _activate(out, activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, weight_attr=None,
+              dtype="float32"):
+    """reference ``static.nn.embedding``: lookup-table op over a created
+    weight.  ``is_sparse`` selects the row-sparse gradient path (see
+    ops/sparse_grad.py)."""
+    from ..nn import initializer as I
+    w = _make_param(list(size), dtype, weight_attr or param_attr,
+                    default_initializer=I.XavierNormal())
+    return _ops.embedding(input, w, padding_idx=padding_idx,
+                          sparse=is_sparse)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCHW", name=None):
+    """reference ``fluid.layers.conv2d``."""
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    c_axis = 1 if data_format == "NCHW" else -1
+    in_ch = input.shape[c_axis]
+    dtype = input.dtype
+    w = _make_param([num_filters, in_ch // groups, *filter_size], dtype,
+                    param_attr)
+    out = _ops.conv2d(input, w, stride=stride, padding=padding,
+                      dilation=dilation, groups=groups,
+                      data_format=data_format)
+    if bias_attr is not False:
+        b = _make_param([num_filters], dtype, bias_attr, is_bias=True)
+        bshape = [1, num_filters, 1, 1] if data_format == "NCHW" \
+            else [1, 1, 1, num_filters]
+        out = _ops.add(out, _ops.reshape(b, shape=bshape))
+    return _activate(out, act)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, use_global_stats=False):
+    """reference ``fluid.layers.batch_norm``.  In program mode the
+    train-time statistics update is part of the captured graph (the
+    running buffers become program state vars via the layer's buffers)."""
+    from ..nn import BatchNorm2D, BatchNorm1D
+    cls = BatchNorm2D if len(input.shape) == 4 else BatchNorm1D
+    layer = cls(input.shape[1 if data_layout == "NCHW" else -1],
+                momentum=momentum, epsilon=epsilon,
+                weight_attr=param_attr, bias_attr=bias_attr)
+    if is_test or use_global_stats:
+        layer.eval()
+    out = layer(input)
+    return _activate(out, act)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    if is_test:
+        return x
+    return _ops.dropout(x, p=dropout_prob)
+
+
+def softmax(x, axis=-1, name=None):
+    return _ops.softmax(x, axis=axis)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100,
+                  axis=-1):
+    return _ops.cross_entropy(input, label, soft_label=soft_label,
+                              ignore_index=ignore_index, axis=axis,
+                              reduction="none")

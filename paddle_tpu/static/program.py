@@ -1,80 +1,329 @@
-"""Static-graph facade: Program / Executor / program_guard.
+"""Static-graph core: Program / Variable / OpDesc / Executor / program_guard.
 
-Reference parity: ``python/paddle/fluid/framework.py:4392`` Program,
-``executor.py:607`` Executor.  TPU-first translation (SURVEY.md §7):
-a Program captures python-level layer calls between ``program_guard``
-enter/exit as a deferred callable graph; ``Executor.run`` jits it with
-feeds as inputs and fetches as outputs.  The per-op ProgramDesc protobuf
-and the C++ interpreter stack collapse into jaxpr/XLA.
+Reference parity: ``python/paddle/fluid/framework.py:4392`` (Program),
+``framework.py:915`` (Variable), ``framework.py:2844`` (Block),
+``executor.py:1065`` (Executor.run), ``fluid/backward.py:1406``
+(append_backward).
+
+TPU-first design: under ``paddle.enable_static()`` every op that flows
+through ``core.dispatch`` is *captured* instead of executed — appended to
+the active Program as an ``OpDesc`` holding the op's jax-traceable
+implementation.  ``Executor.run`` replays the op list inside one
+``jax.jit``-compiled function of (feeds, parameters, optimizer state):
+the whole program — forward, per-op VJP backward, optimizer updates —
+compiles to a single XLA executable, which is the TPU-native analog of
+the reference's instruction-list interpreters
+(``framework/new_executor/interpretercore.h:54``).  Grad ops replay the
+``jax.vjp`` closure captured at the matching forward op, so the op-level
+Program description (``prog.global_block().ops``) is a truthful,
+golden-checkable record of what executes — not decoration.
 """
 from __future__ import annotations
 
+import functools
 import threading
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import autograd
-from ..core.tensor import Tensor, to_tensor
+from ..core.tensor import Tensor, Parameter
 from ..core.dtype import dtype_to_jnp
 
-__all__ = ["Program", "default_main_program", "default_startup_program",
-           "program_guard", "data", "Executor", "CompiledProgram"]
+__all__ = ["Program", "Variable", "OpDesc", "Block", "default_main_program",
+           "default_startup_program", "program_guard", "data", "Executor",
+           "CompiledProgram", "append_backward", "gradients"]
 
 _state = threading.local()
 
+_LR_NAME = "@LR@"
 
-class _DataPlaceholder(Tensor):
-    """Feed slot: a named symbolic input (reference static.data)."""
 
-    def __init__(self, name, shape, dtype):
-        concrete_shape = tuple(1 if s in (None, -1) else int(s)
-                               for s in shape)
-        super().__init__(jnp.zeros(concrete_shape, dtype_to_jnp(dtype)),
-                         stop_gradient=True, name=name)
-        self.is_placeholder = True
+class Variable(Tensor):
+    """Symbolic static-graph variable (reference ``framework.py:915``).
+
+    Has shape/dtype metadata but no eager value: touching ``_data``
+    raises, pointing the user at ``Executor.run``.  Inherits the whole
+    Tensor operator surface, so any op called on a Variable routes
+    through ``core.dispatch`` and is captured into the owning Program.
+    """
+
+    __slots__ = ("_shape", "_dtype", "program", "is_parameter",
+                 "declared_shape", "is_placeholder", "op_idx")
+
+    def __init__(self, name, shape, dtype, program=None,
+                 stop_gradient=True, is_parameter=False):
+        # NOTE: deliberately does not call Tensor.__init__ (no storage).
+        self._shape = tuple(-1 if s is None else int(s) for s in shape)
+        self._dtype = dtype_to_jnp(dtype) if isinstance(dtype, str) else \
+            jnp.dtype(dtype)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._output_index = 0
+        self._hooks = []
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self.program = program
+        self.is_parameter = is_parameter
         self.declared_shape = list(shape)
+        self.is_placeholder = False
+        self.op_idx = None  # producing op index, None for feeds
+
+    # `_data` shadows the Tensor slot: symbolic vars have no storage.
+    @property
+    def _data(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' is symbolic (static-graph mode) and "
+            "has no eager value; execute the program with "
+            "Executor.run(program, feed={...}, fetch_list=[...]) instead.")
+
+    @_data.setter
+    def _data(self, v):
+        raise RuntimeError(
+            f"cannot assign an eager value to symbolic Variable "
+            f"'{self.name}' (static-graph mode)")
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._shape:
+            n *= max(s, 1)
+        return n
+
+    def aval(self):
+        """ShapeDtypeStruct with unknown (-1) dims concretized to 1 for
+        capture-time shape inference; Executor retraces with real shapes."""
+        return jax.ShapeDtypeStruct(
+            tuple(1 if s < 0 else s for s in self._shape), self._dtype)
+
+    def numel(self):
+        return self.size
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={self.declared_shape}, "
+                f"dtype={self._dtype}, stop_gradient={self.stop_gradient})")
+
+
+# Back-compat alias: round-1 code/tests referred to the feed slot type.
+_DataPlaceholder = Variable
+
+
+class OpDesc:
+    """One appended op (reference ``framework/framework.proto:50`` OpDesc).
+
+    kind: 'compute' (forward impl), 'grad' (replays the vjp of op
+    ``fwd_idx``), or 'optimize' (parameter/state update).
+    """
+
+    __slots__ = ("type", "kind", "impl", "input_names", "output_names",
+                 "attrs", "idx", "fwd_idx", "grad_input_mask", "eval_impl")
+
+    def __init__(self, type, kind, impl, input_names, output_names,
+                 attrs=None, fwd_idx=None, grad_input_mask=None,
+                 eval_impl=None):
+        self.type = type
+        self.kind = kind
+        self.impl = impl
+        self.input_names = list(input_names)
+        self.output_names = list(output_names)
+        self.attrs = dict(attrs or {})
+        self.idx = None  # assigned by Program._append
+        self.fwd_idx = fwd_idx
+        self.grad_input_mask = grad_input_mask
+        # alternate impl used by clone(for_test=True) — the reference
+        # flips the op's is_test attr (batch_norm, dropout); here the op
+        # carries its eval-mode lowering
+        self.eval_impl = eval_impl
+
+    @property
+    def input_arg_names(self):
+        return list(self.input_names)
+
+    @property
+    def output_arg_names(self):
+        return list(self.output_names)
+
+    def input(self, slot=None):
+        return list(self.input_names)
+
+    def output(self, slot=None):
+        return list(self.output_names)
+
+    def attr(self, name):
+        return self.attrs.get(name)
+
+    def __repr__(self):
+        return (f"{{{self.type}: ({', '.join(self.input_names)}) -> "
+                f"({', '.join(self.output_names)})}}")
+
+
+class Block:
+    """Single-block facade (reference ``framework.py:2844``): the TPU
+    build has no control-flow sub-blocks at the program level — structured
+    control flow lives inside op impls as lax primitives."""
+
+    def __init__(self, program):
+        self.program = program
+        self.idx = 0
+
+    @property
+    def ops(self):
+        return self.program.ops
+
+    @property
+    def vars(self):
+        return self.program._vars
+
+    def var(self, name):
+        v = self.program._vars.get(name)
+        if v is None:
+            p = self.program.parameters.get(name)
+            if p is not None:
+                return p
+            raise KeyError(f"variable '{name}' not found in program")
+        return v
+
+    def has_var(self, name):
+        return name in self.program._vars or name in self.program.parameters
+
+    def all_parameters(self):
+        return list(self.program.parameters.values())
+
+    def __repr__(self):
+        lines = [f"block {{  // {len(self.ops)} ops"]
+        for op in self.ops:
+            lines.append(f"  {op!r}")
+        lines.append("}")
+        return "\n".join(lines)
 
 
 class Program:
-    """Captured computation: a list of (callable, inputs) built by running
-    user code under program_guard; re-executed functionally by Executor."""
+    """Captured op-level graph (reference ``framework.py:4392``)."""
 
     _counter = 0
 
     def __init__(self):
         Program._counter += 1
         self._id = Program._counter
-        self._build_fn = None          # callable(feeds) -> {name: Tensor}
-        self._placeholders: Dict[str, _DataPlaceholder] = {}
-        self._captured: List = []      # (fn, args, kwargs) trace
+        self.ops: List[OpDesc] = []
+        self._vars: Dict[str, Variable] = {}
+        self.parameters: Dict[str, Parameter] = {}
+        self.constants: Dict[str, jnp.ndarray] = {}
+        self.state_vars: Dict[str, jnp.ndarray] = {}
+        self._placeholders: Dict[str, Variable] = {}
+        self._version = 0
+        self._lr_provider: Optional[Callable[[], float]] = None
+        self._build_fn = None  # legacy round-1 escape hatch (still honored)
+        self._block = Block(self)
         self.random_seed = 0
+        self._appending_grads = False
 
-    def global_block(self):
-        return self
+    # -- construction ------------------------------------------------------
+    def _append(self, op: OpDesc) -> OpDesc:
+        op.idx = len(self.ops)
+        self.ops.append(op)
+        self._version += 1
+        return op
+
+    def _register_var(self, var: Variable):
+        self._vars[var.name] = var
+        self._version += 1
+
+    def _unique_name(self, stem: str) -> str:
+        base = f"{stem}.tmp_{self._version}"
+        n = base
+        i = 0
+        while n in self._vars or n in self.parameters or n in self.constants:
+            i += 1
+            n = f"{base}_{i}"
+        return n
+
+    # -- introspection -----------------------------------------------------
+    def global_block(self) -> Block:
+        return self._block
+
+    def block(self, idx=0) -> Block:
+        return self._block
+
+    @property
+    def blocks(self):
+        return [self._block]
+
+    def num_blocks(self):
+        return 1
+
+    def all_parameters(self):
+        return list(self.parameters.values())
+
+    def list_vars(self):
+        return list(self._vars.values())
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        return repr(self._block)
+
+    __str__ = to_string
 
     def clone(self, for_test=False):
-        import copy
+        """for_test=True prunes grad/optimize ops (reference
+        ``Program.clone`` pruning the backward graph)."""
         p = Program()
-        p._build_fn = self._build_fn
         p._placeholders = dict(self._placeholders)
-        p._for_test = for_test
+        p.parameters = dict(self.parameters)
+        p.constants = dict(self.constants)
+        p._vars = dict(self._vars)
+        p._build_fn = self._build_fn
+        p._lr_provider = self._lr_provider
+        if for_test:
+            # drop backward + optimizer ops AND state-update ops (every
+            # output is a mutable var, e.g. batch_norm_stats) so eval
+            # runs never touch training state (reference is_test=True)
+            kept = [op for op in self.ops if op.kind == "compute"
+                    and not op.type.endswith("_grad")
+                    and "@GRAD" not in "".join(op.output_names)
+                    and not (op.output_names and
+                             all(n in self.parameters
+                                 for n in op.output_names))]
+        else:
+            kept = list(self.ops)
+            p.state_vars = dict(self.state_vars)
+        for op in kept:
+            impl = op.eval_impl if (for_test and op.eval_impl is not None) \
+                else op.impl
+            clone_op = OpDesc(op.type, op.kind, impl, op.input_names,
+                              op.output_names, op.attrs, op.fwd_idx,
+                              op.grad_input_mask, op.eval_impl)
+            p._append(clone_op)
         return p
 
     def __repr__(self):
-        return f"Program(id={self._id}, feeds={list(self._placeholders)})"
+        return (f"Program(id={self._id}, ops={len(self.ops)}, "
+                f"feeds={list(self._placeholders)}, "
+                f"params={list(self.parameters)})")
 
 
 def default_main_program() -> Program:
-    if not hasattr(_state, "main"):
+    if getattr(_state, "main", None) is None:
         _state.main = Program()
     return _state.main
 
 
 def default_startup_program() -> Program:
-    if not hasattr(_state, "startup"):
+    if getattr(_state, "startup", None) is None:
         _state.startup = Program()
     return _state.startup
 
@@ -100,53 +349,391 @@ class program_guard:
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    ph = _DataPlaceholder(name, shape, dtype)
-    default_main_program()._placeholders[name] = ph
-    return ph
+    """Feed slot (reference ``static.data``): a named symbolic input."""
+    prog = default_main_program()
+    var = Variable(name, shape, dtype, program=prog, stop_gradient=True)
+    var.is_placeholder = True
+    prog._placeholders[name] = var
+    prog._register_var(var)
+    return var
 
 
+# ---------------------------------------------------------------------------
+# Op capture (called from core.dispatch when static mode is enabled)
+# ---------------------------------------------------------------------------
+def capturing_program() -> Optional[Program]:
+    """The Program ops should append to, or None when in dygraph mode."""
+    from .mode import in_dynamic_mode
+    if in_dynamic_mode():
+        return None
+    return default_main_program()
+
+
+def capture_op(prog: Program, op_name: str, fn: Callable,
+               tensor_args: Sequence, kwargs: dict,
+               output_names: Optional[Sequence[str]] = None,
+               eval_impl: Optional[Callable] = None):
+    """Append (fn, inputs, attrs) to ``prog`` and return symbolic outputs.
+
+    Mirrors ``OpProtoHolder``-driven op append (reference
+    ``framework.py:2147`` + ``block.append_op``): concrete Tensors become
+    program constants, Parameters are registered as program inputs, and
+    output shapes come from ``jax.eval_shape`` of the closed impl.
+    """
+    closed = functools.partial(fn, **kwargs) if kwargs else fn
+    in_names, in_avals = [], []
+    requires_grad = False
+    for t in tensor_args:
+        if isinstance(t, Variable):
+            if t.program is None:
+                t.program = prog
+            in_names.append(t.name)
+            in_avals.append(t.aval())
+            if t.name not in prog._vars:
+                prog._register_var(t)
+            requires_grad |= (not t.stop_gradient) or t.is_parameter
+        elif isinstance(t, Parameter):
+            prog.parameters[t.name] = t
+            in_names.append(t.name)
+            in_avals.append(jax.ShapeDtypeStruct(t._data.shape,
+                                                 t._data.dtype))
+            requires_grad |= t.trainable
+        elif t.name in prog.parameters:
+            # pre-registered mutable var (e.g. batch-norm running stats):
+            # reads see the live value, writes come back via Executor
+            in_names.append(t.name)
+            in_avals.append(jax.ShapeDtypeStruct(t._data.shape,
+                                                 t._data.dtype))
+        else:  # concrete Tensor -> constant baked into the program
+            prog.constants[t.name] = t._data
+            in_names.append(t.name)
+            in_avals.append(jax.ShapeDtypeStruct(t._data.shape,
+                                                 t._data.dtype))
+
+    try:
+        out_avals = jax.eval_shape(closed, *in_avals)
+    except Exception:
+        # impls that resist abstract evaluation (host callbacks etc.):
+        # infer shapes by running on zeros
+        zeros = [jnp.zeros(a.shape, a.dtype) for a in in_avals]
+        probe = closed(*zeros)
+        out_avals = jax.tree_util.tree_map(
+            lambda o: jax.ShapeDtypeStruct(o.shape, o.dtype), probe)
+
+    tuple_output = isinstance(out_avals, tuple)
+    avals = out_avals if tuple_output else (out_avals,)
+
+    out_vars = []
+    for i, a in enumerate(avals):
+        if output_names is not None:
+            # caller-directed outputs (state-update ops writing into
+            # pre-registered mutable vars, e.g. batch_norm running stats)
+            name = output_names[i]
+            v = prog._vars.get(name) or Variable(
+                name, a.shape, a.dtype, program=prog)
+        else:
+            v = Variable(prog._unique_name(op_name), a.shape, a.dtype,
+                         program=prog, stop_gradient=not requires_grad)
+            prog._register_var(v)
+        out_vars.append(v)
+
+    static_attrs = {k: v for k, v in kwargs.items()
+                    if isinstance(v, (bool, int, float, str, list, tuple,
+                                      type(None)))}
+    op = prog._append(OpDesc(op_name, "compute", closed, in_names,
+                             [v.name for v in out_vars], static_attrs,
+                             eval_impl=eval_impl))
+    for v in out_vars:
+        v.op_idx = op.idx
+    return tuple(out_vars) if tuple_output else out_vars[0]
+
+
+# ---------------------------------------------------------------------------
+# append_backward: program-scanning autodiff
+# ---------------------------------------------------------------------------
+def _grad_name(name: str) -> str:
+    return name + "@GRAD"
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None, _diff_vars=()):
+    """Reference ``fluid/backward.py:1406``: appends grad ops for every
+    forward op on a (param → loss) path, in reverse topological order.
+
+    Unlike round 1, no ``parameter_list`` is required — trainable
+    parameters are found by scanning the program, exactly like the
+    reference's grad-op-maker walk.  Returns [(param, grad_var)].
+    """
+    if not isinstance(loss, Variable):
+        # eager tensor: fall back to the dygraph engine
+        from ..core import autograd
+        if parameter_list is None:
+            raise ValueError(
+                "append_backward on an eager tensor needs parameter_list; "
+                "build under paddle.enable_static() for program scanning")
+        grads = autograd.grad(loss, parameter_list, allow_unused=True,
+                              retain_graph=True)
+        return list(zip(parameter_list, grads))
+
+    prog = loss.program or default_main_program()
+    no_grad = {getattr(v, "name", v) for v in (no_grad_set or ())}
+
+    trainable = {n for n, p in prog.parameters.items()
+                 if p.trainable and n not in no_grad}
+    if parameter_list is not None:
+        wanted = {getattr(p, "name", p) for p in parameter_list}
+        trainable &= wanted
+
+    # feeds explicitly marked differentiable participate too, as do any
+    # extra vars requested by gradients() (intermediates included)
+    diff_feeds = {n for n, v in prog._placeholders.items()
+                  if not v.stop_gradient and n not in no_grad}
+    diff_feeds |= {getattr(v, "name", v) for v in _diff_vars}
+
+    # pass 1 (forward): vars transitively depending on a trainable input
+    dep = set(trainable) | diff_feeds
+    compute_ops = [op for op in prog.ops if op.kind == "compute"]
+    for op in compute_ops:
+        if any(n in dep for n in op.input_names):
+            dep.update(op.output_names)
+
+    if loss.name not in dep:
+        raise RuntimeError(
+            f"loss '{loss.name}' does not depend on any trainable "
+            "parameter; nothing to differentiate")
+
+    # pass 2 (backward): ops whose outputs reach the loss
+    need = {loss.name}
+    relevant: List[OpDesc] = []
+    for op in reversed(compute_ops):
+        if any(o in need for o in op.output_names) and \
+                any(i in dep for i in op.input_names):
+            relevant.append(op)
+            need.update(i for i in op.input_names if i in dep)
+
+    # seed: d(loss)/d(loss) = 1 (reference emits fill_constant for this)
+    seed_name = _grad_name(loss.name)
+    prog._append(OpDesc("fill_constant", "compute",
+                        lambda l: jnp.ones_like(l),
+                        [loss.name], [seed_name],
+                        {"value": 1.0, "shape": loss.shape}))
+    seed_var = Variable(seed_name, loss.shape, loss.dtype, program=prog)
+    prog._register_var(seed_var)
+
+    grad_vars: Dict[str, Variable] = {}
+    for op in relevant:  # already reverse order
+        mask = [n in dep for n in op.input_names]
+        out_names = []
+        for n, m in zip(op.input_names, mask):
+            if not m:
+                continue
+            gname = _grad_name(n)
+            out_names.append(gname)
+            if gname not in grad_vars:
+                if n in prog.parameters:
+                    shp = list(prog.parameters[n]._data.shape)
+                    dt = prog.parameters[n]._data.dtype
+                elif n in prog._vars:
+                    shp, dt = prog._vars[n].shape, prog._vars[n].dtype
+                else:
+                    shp, dt = None, None
+                gv = Variable(gname, shp or [], dt or jnp.float32,
+                              program=prog)
+                prog._register_var(gv)
+                grad_vars[gname] = gv
+        prog._append(OpDesc(op.type + "_grad", "grad", None,
+                            [_grad_name(o) for o in op.output_names],
+                            out_names, {}, fwd_idx=op.idx,
+                            grad_input_mask=mask))
+
+    params_grads = []
+    for n, p in prog.parameters.items():
+        gname = _grad_name(n)
+        if n in trainable and gname in grad_vars:
+            params_grads.append((p, grad_vars[gname]))
+    return params_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference ``fluid/backward.py:2003``: grads of targets w.r.t.
+    arbitrary program vars (not just parameters)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if not isinstance(targets[0], Variable):
+        from ..core.autograd import grad as _grad
+        return _grad(targets, inputs, grad_outputs=target_gradients,
+                     allow_unused=True)
+    loss = targets[0]
+    prog = loss.program or default_main_program()
+    diff_vars = [v for v in inputs if isinstance(v, Variable)]
+    append_backward(loss, parameter_list=[
+        v for v in inputs if isinstance(v, Parameter)] or None,
+        no_grad_set=no_grad_set, _diff_vars=diff_vars)
+    out = []
+    for v in inputs:
+        gname = _grad_name(getattr(v, "name", v))
+        out.append(prog._vars.get(gname))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Executor: compile + run the captured program
+# ---------------------------------------------------------------------------
 class CompiledProgram:
-    """reference compiler.py:88 — here: marks a program for jit."""
+    """reference compiler.py:88 — marks a program for jit compilation;
+    multi-device data parallelism is expressed via pjit sharding in
+    distributed.fleet (SURVEY §7 stage 6), so with_data_parallel is a
+    documented pass-through."""
 
     def __init__(self, program, build_strategy=None):
         self.program = program
         self.build_strategy = build_strategy
 
     def with_data_parallel(self, loss_name=None, **kw):
-        # data-parallel static execution is expressed via pjit sharding in
-        # distributed.fleet; single-process multi-device replication is a
-        # non-port (SURVEY §7 stage 6 note)
         return self
+
+    def __getattr__(self, item):
+        return getattr(self.program, item)
+
+
+def _build_runner(program: Program, fetch_names: Tuple[str, ...],
+                  written: Tuple[str, ...]):
+    """Build the jittable replay fn: (feeds, mutables, lr) ->
+    (fetches, new_mutables).  One XLA program for fwd+bwd+update."""
+    ops = tuple(program.ops)
+    needs_vjp = frozenset(op.fwd_idx for op in ops if op.kind == "grad")
+    consts = dict(program.constants)
+    float0 = jax.dtypes.float0
+
+    def run_fn(feeds, mutables, lr):
+        env = dict(consts)
+        env.update(feeds)
+        env.update(mutables)
+        env[_LR_NAME] = lr
+        vjps = {}
+        out_meta = {}  # fwd idx -> (avals, tuple_output)
+        for op in ops:
+            if op.kind == "compute":
+                ins = [env[n] for n in op.input_names]
+                if op.idx in needs_vjp:
+                    out, vjp_fn = jax.vjp(op.impl, *ins)
+                    vjps[op.idx] = vjp_fn
+                else:
+                    out = op.impl(*ins)
+                tup = isinstance(out, tuple)
+                outs = out if tup else (out,)
+                out_meta[op.idx] = ([(o.shape, o.dtype) for o in outs], tup)
+                for n, o in zip(op.output_names, outs):
+                    env[n] = o
+            elif op.kind == "grad":
+                metas, tup = out_meta[op.fwd_idx]
+                cots = [env[n] if n in env else jnp.zeros(s, d)
+                        for n, (s, d) in zip(op.input_names, metas)]
+                cot = tuple(cots) if tup else cots[0]
+                in_grads = vjps[op.fwd_idx](cot)
+                it = iter(op.output_names)
+                for g, m in zip(in_grads, op.grad_input_mask):
+                    if not m:
+                        continue
+                    gname = next(it)
+                    if g is None or (hasattr(g, "dtype") and
+                                     g.dtype == float0):
+                        continue
+                    env[gname] = env[gname] + g if gname in env else g
+            else:  # optimize
+                ins = [env[n] for n in op.input_names]
+                outs = op.impl(*ins)
+                if not isinstance(outs, tuple):
+                    outs = (outs,)
+                for n, o in zip(op.output_names, outs):
+                    env[n] = o
+        fetches = [env[n] for n in fetch_names]
+        new_mut = {n: env[n] for n in written if n in env}
+        return fetches, new_mut
+
+    return jax.jit(run_fn)
 
 
 class Executor:
-    """Feed/fetch runner.  In the TPU build a 'program' executes as a
-    jitted function of its feeds; mutation of Parameters during the run
-    (optimizer updates) happens functionally and is written back."""
+    """Feed/fetch runner (reference ``executor.py:607``).
+
+    The captured op list compiles (once per feed-signature) into a single
+    jitted function; parameter and optimizer-state mutation happens
+    functionally inside it and is written back to the live Parameter
+    objects afterwards — the TPU analog of scope variable mutation."""
 
     def __init__(self, place=None):
         self.place = place
-        self._cache = {}
+        self._cache: Dict = {}
+
+    def close(self):
+        self._cache.clear()
 
     def run(self, program=None, feed=None, fetch_list=None,
-            scope=None, return_numpy=True, use_program_cache=True):
+            scope=None, return_numpy=True, use_program_cache=True,
+            use_prune=False):
         feed = feed or {}
-        fetch_list = fetch_list or []
+        fetch_list = fetch_list if fetch_list is not None else []
         program = program or default_main_program()
         if isinstance(program, CompiledProgram):
             program = program.program
-        if program._build_fn is None:
-            raise RuntimeError(
-                "Program has no build function. In the TPU build, construct "
-                "static programs by assigning `program._build_fn = "
-                "fn(feed_dict) -> fetches` or use the dygraph/jit path "
-                "(paddle_tpu.jit.to_static).")
-        outs = program._build_fn(feed)
-        result = []
-        for f in fetch_list:
-            name = f if isinstance(f, str) else getattr(f, "name", None)
-            v = outs[name] if isinstance(outs, dict) else outs
-            if return_numpy:
-                v = np.asarray(v._data if isinstance(v, Tensor) else v)
-            result.append(v)
-        return result
+
+        # round-1 escape hatch: hand-assigned build function
+        if program._build_fn is not None:
+            outs = program._build_fn(feed)
+            result = []
+            for f in fetch_list:
+                name = f if isinstance(f, str) else getattr(f, "name", None)
+                v = outs[name] if isinstance(outs, dict) else outs
+                if return_numpy:
+                    v = np.asarray(v._data if isinstance(v, Tensor) else v)
+                result.append(v)
+            return result
+
+        if not program.ops:
+            if fetch_list:
+                raise RuntimeError(
+                    "Program is empty; build it under paddle.enable_static() "
+                    "+ program_guard so ops are captured")
+            return []  # e.g. exe.run(startup_program)
+
+        fetch_names = tuple(
+            f if isinstance(f, str) else f.name for f in fetch_list)
+        feed_arrays = {}
+        for n, v in feed.items():
+            if isinstance(v, Tensor):
+                v = v._data
+            ph = program._placeholders.get(n)
+            want = ph._dtype if ph is not None else None
+            feed_arrays[n] = jnp.asarray(v, dtype=want)
+
+        written = tuple(sorted({
+            n for op in program.ops if op.kind in ("optimize", "compute")
+            for n in op.output_names
+            if n in program.parameters or n in program.state_vars}))
+
+        key = (program._id, program._version, fetch_names,
+               tuple(sorted((n, a.shape, str(a.dtype))
+                            for n, a in feed_arrays.items())))
+        fn = self._cache.get(key) if use_program_cache else None
+        if fn is None:
+            fn = _build_runner(program, fetch_names, written)
+            if use_program_cache:
+                self._cache[key] = fn
+
+        mutables = {n: p._data for n, p in program.parameters.items()}
+        mutables.update(program.state_vars)
+        lr = jnp.asarray(
+            program._lr_provider() if program._lr_provider else 0.0,
+            jnp.float32)
+        fetches, new_mut = fn(feed_arrays, mutables, lr)
+
+        for n, arr in new_mut.items():
+            if n in program.parameters:
+                program.parameters[n]._data = arr
+            else:
+                program.state_vars[n] = arr
+
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return [Tensor(v) for v in fetches]

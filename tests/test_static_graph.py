@@ -1,0 +1,359 @@
+"""Static-graph path tests: program capture, Executor, append_backward.
+
+Mirrors the reference's meta-optimizer golden tests
+(test_fleet_sharding_meta_optimizer.py style: assert on generated op
+sequences — cheap, deviceless) plus executor feed/fetch tests
+(test_executor_and_use_program_cache etc.).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _build_fc_program(lr=0.1, optimizer=None):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        label = static.data("label", [None, 1], "float32")
+        hidden = static.nn.fc(x, 16, activation="relu")
+        pred = static.nn.fc(hidden, 1)
+        loss = paddle.mean(paddle.square(pred - label))
+        opt = optimizer or paddle.optimizer.SGD(learning_rate=lr)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+class TestProgramCapture:
+    def test_forward_op_sequence_golden(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+            static.nn.fc(h, 1)
+        assert [op.type for op in main.global_block().ops] == \
+            ["matmul", "add", "relu", "matmul", "add"]
+
+    def test_append_backward_golden_sequence(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            y = static.data("y", [4, 1], "float32")
+            pred = static.nn.fc(x, 1, bias_attr=False)
+            loss = paddle.mean(paddle.square(pred - y))
+            params_grads = static.append_backward(loss)
+        types = [op.type for op in main.global_block().ops]
+        assert types == ["matmul", "subtract", "square", "reduce_mean",
+                         "fill_constant", "reduce_mean_grad", "square_grad",
+                         "subtract_grad", "matmul_grad"]
+        assert len(params_grads) == 1
+        p, g = params_grads[0]
+        assert g.name == p.name + "@GRAD"
+
+    def test_minimize_appends_optimizer_ops(self):
+        main, _, _ = _build_fc_program()
+        types = [op.type for op in main.global_block().ops]
+        assert types.count("sgd") == 4  # w,b for each of the two fc layers
+        assert types.index("fill_constant") < types.index("sgd")
+
+    def test_captured_var_metadata(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8], "float32")
+            out = paddle.matmul(x, paddle.to_tensor(
+                np.ones((8, 3), np.float32)))
+        assert isinstance(out, static.Variable)
+        assert out.shape[-1] == 3
+        with pytest.raises(RuntimeError):
+            _ = out._data  # symbolic vars have no eager value
+
+    def test_op_desc_introspection(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            paddle.add(x, x)
+        op = main.global_block().ops[0]
+        assert op.type == "add"
+        assert op.input_arg_names == ["x", "x"]
+        assert len(op.output_arg_names) == 1
+        assert main.global_block().has_var("x")
+
+    def test_parameters_registered(self):
+        main, _, _ = _build_fc_program()
+        assert len(main.all_parameters()) == 4
+        assert all(p.persistable for p in main.all_parameters())
+
+
+class TestExecutor:
+    def test_train_loop_converges(self):
+        main, startup, loss = _build_fc_program(lr=0.1)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        w_true = rng.rand(8, 1).astype("float32")
+        first = last = None
+        for i in range(60):
+            xb = rng.rand(32, 8).astype("float32")
+            yb = xb @ w_true
+            lv, = exe.run(main, feed={"x": xb, "label": yb},
+                          fetch_list=[loss])
+            if first is None:
+                first = float(lv)
+            last = float(lv)
+        assert last < first * 0.1
+
+    def test_adam_static(self):
+        main, startup, loss = _build_fc_program(
+            optimizer=paddle.optimizer.Adam(learning_rate=0.01))
+        types = [op.type for op in main.global_block().ops]
+        assert types.count("adam") == 4
+        assert main.state_vars  # moments registered
+        exe = static.Executor()
+        rng = np.random.RandomState(1)
+        w_true = rng.rand(8, 1).astype("float32")
+        losses = []
+        for _ in range(40):
+            xb = rng.rand(16, 8).astype("float32")
+            losses.append(float(exe.run(
+                main, feed={"x": xb, "label": xb @ w_true},
+                fetch_list=[loss])[0]))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_fetch_intermediate_and_param(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+        exe = static.Executor()
+        xb = np.random.RandomState(0).rand(4, 8).astype("float32")
+        hv, = exe.run(main, feed={"x": xb}, fetch_list=[h])
+        assert hv.shape == (4, 16)
+        assert (hv >= 0).all()  # relu output
+
+    def test_variable_batch_sizes(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            out = paddle.sum(x, axis=-1)
+        exe = static.Executor()
+        for bs in (2, 5):
+            xb = np.ones((bs, 4), np.float32)
+            ov, = exe.run(main, feed={"x": xb}, fetch_list=[out])
+            assert ov.shape == (bs,)
+            np.testing.assert_allclose(ov, 4.0)
+
+    def test_numeric_parity_with_dygraph(self):
+        rng = np.random.RandomState(3)
+        xb = rng.rand(5, 6).astype("float32")
+        w = rng.rand(6, 3).astype("float32")
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [5, 6], "float32")
+            out = paddle.nn.functional.softmax(
+                paddle.matmul(x, paddle.to_tensor(w)))
+        exe = static.Executor()
+        got, = exe.run(main, feed={"x": xb}, fetch_list=[out])
+        paddle.disable_static()
+        want = paddle.nn.functional.softmax(
+            paddle.matmul(paddle.to_tensor(xb), paddle.to_tensor(w))).numpy()
+        paddle.enable_static()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_empty_program_startup_run(self):
+        exe = static.Executor()
+        assert exe.run(static.Program()) == []
+
+    def test_fetch_from_empty_program_raises(self):
+        exe = static.Executor()
+        with pytest.raises(RuntimeError):
+            exe.run(static.Program(), feed={}, fetch_list=["nope"])
+
+    def test_compiled_program_passthrough(self):
+        main, startup, loss = _build_fc_program()
+        cp = static.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        exe = static.Executor()
+        xb = np.random.RandomState(0).rand(8, 8).astype("float32")
+        lv, = exe.run(cp, feed={"x": xb, "label": xb[:, :1]},
+                      fetch_list=[loss])
+        assert np.isfinite(lv)
+
+
+class TestCloneAndPrune:
+    def test_clone_for_test_prunes_backward(self):
+        main, _, loss = _build_fc_program()
+        test_prog = main.clone(for_test=True)
+        types = [op.type for op in test_prog.global_block().ops]
+        assert not any(t.endswith("_grad") for t in types)
+        assert "sgd" not in types and "fill_constant" not in types
+        # pruned program still runs inference
+        exe = static.Executor()
+        xb = np.random.RandomState(0).rand(4, 8).astype("float32")
+        lv, = exe.run(test_prog, feed={"x": xb, "label": xb[:, :1]},
+                      fetch_list=[loss])
+        assert np.isfinite(lv)
+
+    def test_clone_shares_parameters(self):
+        main, _, _ = _build_fc_program()
+        test_prog = main.clone(for_test=True)
+        for n, p in main.parameters.items():
+            assert test_prog.parameters[n] is p
+
+
+class TestGradientsAPI:
+    def test_gradients_wrt_feed(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3, 3], "float32")
+            x.stop_gradient = False
+            y = paddle.sum(paddle.square(x))
+            gx, = static.gradients(y, x)
+        exe = static.Executor()
+        xb = np.arange(9, dtype=np.float32).reshape(3, 3)
+        gv, = exe.run(main, feed={"x": xb}, fetch_list=[gx])
+        np.testing.assert_allclose(gv, 2 * xb, rtol=1e-6)
+
+    def test_grad_accumulation_fanout(self):
+        # x used twice -> grads from both paths must sum
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            x.stop_gradient = False
+            y = paddle.sum(paddle.add(paddle.multiply(x, x),
+                                      paddle.scale(x, scale=3.0)))
+            gx, = static.gradients(y, x)
+        exe = static.Executor()
+        xb = np.ones((2, 2), np.float32)
+        gv, = exe.run(main, feed={"x": xb}, fetch_list=[gx])
+        np.testing.assert_allclose(gv, 2 * xb + 3.0, rtol=1e-6)
+
+
+class TestStaticNNLayers:
+    def test_conv_bn_pipeline(self):
+        main = static.Program()
+        with static.program_guard(main):
+            img = static.data("img", [2, 3, 8, 8], "float32")
+            c = static.nn.conv2d(img, num_filters=4, filter_size=3,
+                                 padding=1, act="relu")
+            b = static.nn.batch_norm(c)
+            pool = paddle.nn.functional.max_pool2d(b, kernel_size=2)
+        exe = static.Executor()
+        xb = np.random.RandomState(0).rand(2, 3, 8, 8).astype("float32")
+        ov, = exe.run(main, feed={"img": xb}, fetch_list=[pool])
+        assert ov.shape == (2, 4, 4, 4)
+
+    def test_batch_norm_train_eval_semantics(self):
+        # train runs update running stats; clone(for_test=True) must use
+        # the learned running stats and must NOT mutate them (reference
+        # is_test attr flip on clone)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [8, 4], "float32")
+            out = static.nn.batch_norm(x)
+        bufs = [p for n, p in main.parameters.items()
+                if not getattr(p, "trainable", False)]
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        xb = (rng.rand(8, 4) * 3 + 5).astype("float32")
+        before = [p.numpy().copy() for p in bufs]
+        exe.run(main, feed={"x": xb}, fetch_list=[out])
+        after_train = [p.numpy().copy() for p in bufs]
+        assert any(not np.allclose(b, a)
+                   for b, a in zip(before, after_train))
+
+        test_prog = main.clone(for_test=True)
+        types = [op.type for op in test_prog.global_block().ops]
+        assert "batch_norm_stats" not in types
+        ov, = exe.run(test_prog, feed={"x": xb}, fetch_list=[out])
+        after_eval = [p.numpy().copy() for p in bufs]
+        for a, b in zip(after_train, after_eval):
+            np.testing.assert_array_equal(a, b)  # eval must not mutate
+        # eval normalizes with running stats, not the batch's own stats:
+        # output mean won't be ~0 because running mean != batch mean
+        assert abs(float(ov.mean())) > 0.1
+
+    def test_gradients_wrt_intermediate(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3, 3], "float32")
+            h = paddle.scale(x, scale=2.0)
+            y = paddle.sum(paddle.square(h))
+            gh, = static.gradients(y, h)
+        assert gh is not None
+        exe = static.Executor()
+        xb = np.ones((3, 3), np.float32)
+        gv, = exe.run(main, feed={"x": xb}, fetch_list=[gh])
+        np.testing.assert_allclose(gv, 2 * (2 * xb), rtol=1e-6)
+
+    def test_embedding_capture(self):
+        main = static.Program()
+        with static.program_guard(main):
+            ids = static.data("ids", [4, 6], "int64")
+            emb = static.nn.embedding(ids, size=(32, 8))
+        exe = static.Executor()
+        idv = np.random.RandomState(0).randint(0, 32, (4, 6)).astype("int64")
+        ev, = exe.run(main, feed={"ids": idv}, fetch_list=[emb])
+        assert ev.shape == (4, 6, 8)
+
+    def test_fc_multi_dim_flatten(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 3, 4], "float32")
+            out = static.nn.fc(x, 5, num_flatten_dims=1)
+        exe = static.Executor()
+        xb = np.random.RandomState(0).rand(2, 3, 4).astype("float32")
+        ov, = exe.run(main, feed={"x": xb}, fetch_list=[out])
+        assert ov.shape == (2, 5)
+
+
+class TestStaticSaveInference:
+    def test_captured_program_save_load(self, tmp_path):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            out = static.nn.fc(x, 3)
+        prefix = str(tmp_path / "capt")
+        static.save_inference_model(prefix, [x], [out], program=main)
+        exe = static.Executor()
+        xb = np.random.RandomState(0).rand(4, 8).astype("float32")
+        want, = exe.run(main, feed={"x": xb}, fetch_list=[out])
+        loaded, feed_names, fetch_names = static.load_inference_model(prefix)
+        got, = exe.run(loaded, feed={"x": xb}, fetch_list=fetch_names)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestModeIsolation:
+    def test_dygraph_unaffected_after_static(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            paddle.add(x, x)
+        paddle.disable_static()
+        t = paddle.to_tensor(np.ones((2, 2), np.float32))
+        out = paddle.add(t, t)
+        assert not isinstance(out, static.Variable)
+        np.testing.assert_allclose(out.numpy(), 2.0)
+        paddle.enable_static()
+
+    def test_lr_scheduler_static(self):
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=1, gamma=0.5)
+        main, startup, loss = _build_fc_program(
+            optimizer=paddle.optimizer.SGD(learning_rate=sched))
+        exe = static.Executor()
+        xb = np.random.RandomState(0).rand(4, 8).astype("float32")
+        feed = {"x": xb, "label": xb[:, :1]}
+        exe.run(main, feed=feed, fetch_list=[loss])
+        sched.step()
+        # lr is an input (not baked), so stepping must not recompile
+        n_cache = len(exe._cache)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        assert len(exe._cache) == n_cache
