@@ -177,7 +177,13 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False):
         for i, (shape, dtype) in enumerate(node.out_avals):
             g = grads_by_idx.get(i)
             if g is None:
-                g = jnp.zeros(shape, dtype)
+                if jnp.issubdtype(dtype, jnp.inexact):
+                    g = jnp.zeros(shape, dtype)
+                else:
+                    # integer/bool outputs (e.g. the lengths a sequence op
+                    # passes through) take float0 cotangents under jax.vjp
+                    import numpy as _np
+                    g = _np.zeros(shape, jax.dtypes.float0)
             cotangents.append(g)
         if node.vjp_fn is None:
             raise RuntimeError(

@@ -21,9 +21,11 @@ from .loss import *  # noqa: F401,F403
 from .nn_misc import *  # noqa: F401,F403
 from .amp_ops import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 
 from . import creation, math, reduction, manipulation, logic, linalg, \
-    activation, conv, norm_ops, loss, nn_misc, amp_ops, extras  # noqa: F401
+    activation, conv, norm_ops, loss, nn_misc, amp_ops, extras, \
+    sequence  # noqa: F401
 
 from ..core.tensor import Tensor
 from ..core import dispatch as _dispatch_mod

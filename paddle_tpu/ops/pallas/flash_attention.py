@@ -1,21 +1,25 @@
-"""Flash attention — pallas TPU kernel.
+"""Flash attention — pallas TPU kernels (forward AND backward).
 
 Reference parity: the capability of ``operators/fused/fused_attention_op.cu``
 (+ cuDNN attention) — attention without materialising the (T, T) score
-matrix in HBM.  Mechanism is the TPU one: a pallas kernel that streams K/V
+matrix in HBM.  Mechanism is the TPU one: pallas kernels that stream K/V
 blocks through VMEM with the online-softmax rescaling (flash-attention
-algorithm), keeping the running max/denominator in f32 registers while the
-two matmuls ride the MXU.
+algorithm), keeping the running max/denominator in f32 while the matmuls
+ride the MXU.
 
-Forward is the pallas kernel; backward is a jax.custom_vjp that recomputes
-attention with XLA math from the saved (q, k, v) — the same
-recompute-in-backward posture the training stack uses everywhere
-(jax.checkpoint per block), so the (T, T) tensor only ever exists
-transiently inside one layer's backward.
+Forward saves the per-row log-sum-exp; backward is two pallas kernels
+(dQ over k-blocks; dK/dV over q-blocks) that rebuild the normalised
+probabilities as ``exp(s - lse)`` — no (T, T) tensor, no extra softmax
+pass.  Off-TPU (and for short sequences where one fused XLA attention is
+faster) both directions fall back to plain XLA math.
+
+Set ``PADDLE_PALLAS_FORCE=1`` to force the pallas path (interpret mode on
+CPU) — used by the kernel unit tests.
 """
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -30,7 +34,32 @@ __all__ = ["flash_attention"]
 NEG_INF = -1e30
 
 
-def _fwd_kernel_pipelined(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+def _pallas_mode(seq_q: int, seq_k: int, causal: bool):
+    """(use_pallas, interpret) — static decision from shapes + env so the
+    forward and backward of one call always agree.
+
+    causal with seq_q > seq_k has fully-masked query rows whose lse
+    degenerates to NEG_INF (float cancellation makes exp(s - lse) == 1 in
+    the backward instead of 1/seq_k) — that configuration stays on the XLA
+    path.
+    """
+    if causal and seq_q > seq_k:
+        return False, False
+    if os.environ.get("PADDLE_PALLAS_FORCE") == "1":
+        ok = seq_q % 128 == 0 and seq_k % 128 == 0
+        return ok, jax.default_backend() == "cpu"
+    # the pallas kernel pays off once the O(T^2) score materialisation
+    # dominates (measured crossover ~1k on v5e: at T=512 XLA's fused
+    # attention is ~5% faster, at T=2048 the kernel wins)
+    ok = (seq_q % 128 == 0 and seq_k % 128 == 0 and seq_k >= 1024
+          and jax.default_backend() not in ("cpu",))
+    return ok, False
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel_pipelined(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                           acc_scr, *, scale: float, causal: bool,
                           block_q: int, block_k: int, nk: int,
                           seq_q: int, seq_k: int):
@@ -80,21 +109,25 @@ def _fwd_kernel_pipelined(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[...] / l_scr[...]).astype(o_ref.dtype)
+        l = l_scr[...]
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(l)
+
+
+def _block_sizes(T, Tk, block_q, block_k):
+    block_q = block_q if T % block_q == 0 else 128
+    block_k = block_k if Tk % block_k == 0 else 128
+    assert T % block_q == 0 and Tk % block_k == 0, (T, Tk, block_q, block_k)
+    return block_q, block_k
 
 
 def _flash_fwd(q, k, v, scale: float, causal: bool,
                block_q: int = 256, block_k: int = 512,
                interpret: bool = False):
-    """q/k/v: (BH, T, d) -> (BH, T, d)."""
-    from jax.experimental.pallas import tpu as pltpu
+    """q/k/v: (BH, T, d) -> (out (BH, T, d), lse (BH, T, 1) f32)."""
     BH, T, d = q.shape
     Tk = k.shape[1]
-    # callers guarantee T, Tk % 128 == 0 (the _flash gate); drop to the
-    # 128 block when the preferred block doesn't divide the sequence
-    block_q = block_q if T % block_q == 0 else 128
-    block_k = block_k if Tk % block_k == 0 else 128
-    assert T % block_q == 0 and Tk % block_k == 0, (T, Tk, block_q, block_k)
+    block_q, block_k = _block_sizes(T, Tk, block_q, block_k)
     nk = Tk // block_k
     grid = (BH, T // block_q, nk)
     kernel = functools.partial(_fwd_kernel_pipelined, scale=scale,
@@ -108,8 +141,14 @@ def _flash_fwd(q, k, v, scale: float, causal: bool,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -121,8 +160,165 @@ def _flash_fwd(q, k, v, scale: float, causal: bool,
     )(q, k, v)
 
 
+# ---------------------------------------------------------------------------
+# backward — dQ kernel (grid over q blocks, scan k blocks)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale: float, causal: bool, block_q: int,
+                   block_k: int, nk: int, seq_q: int, seq_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    offset = seq_k - seq_q
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        live = (qi + 1) * block_q - 1 + offset >= ki * block_k
+    else:
+        live = True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) \
+                + qi * block_q + offset
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) \
+                + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                       # (bq, bk)
+        if causal:
+            p = jnp.where(rows >= cols, p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# backward — dK/dV kernel (grid over k blocks, scan q blocks)
+# ---------------------------------------------------------------------------
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    causal: bool, block_q: int, block_k: int, nq: int,
+                    seq_q: int, seq_k: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    offset = seq_k - seq_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    if causal:
+        live = (qi + 1) * block_q - 1 + offset >= ki * block_k
+    else:
+        live = True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) \
+                + qi * block_q + offset
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) \
+                + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                       # (bq, bk)
+        if causal:
+            p = jnp.where(rows >= cols, p, 0.0)
+        # dV += P^T dO
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        # dK += dS^T (q*scale)  [s = (q*scale) k^T => ds/dk = ds^T q*scale]
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool,
+               block_q: int = 256, block_k: int = 256,
+               interpret: bool = False):
+    BH, T, d = q.shape
+    Tk = k.shape[1]
+    block_q, block_k = _block_sizes(T, Tk, block_q, block_k)
+    nq, nk = T // block_q, Tk // block_k
+    # D_i = rowsum(dO * O) — one fused elementwise reduce in XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)              # (BH, T, 1)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    r_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          seq_q=T, seq_k=Tk),
+        grid=(BH, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dkv grid: (BH, k blocks, q blocks) — same specs re-indexed
+    qs = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    ks = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    rs = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          seq_q=T, seq_k=Tk),
+        grid=(BH, nk, nq),
+        in_specs=[qs, ks, ks, qs, rs, rs],
+        out_specs=[ks, ks],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback + custom_vjp stitching
+# ---------------------------------------------------------------------------
 def _xla_attention(q, k, v, scale, causal):
-    # (BH, T, d) reference math for the backward recompute / CPU path
+    # (BH, T, d) reference math for the short-sequence / CPU path
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
@@ -135,23 +331,28 @@ def _xla_attention(q, k, v, scale, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, scale, causal):
-    # the pallas kernel pays off once the O(T^2) score materialization
-    # dominates (measured crossover ~1k on v5e: at T=512 XLA's fused
-    # attention is ~5% faster, at T=2048 the kernel wins); short
-    # sequences take XLA's path
-    if q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 \
-            and k.shape[1] >= 1024 \
-            and jax.default_backend() not in ("cpu",):
-        return _flash_fwd(q, k, v, scale, causal)
+    use_pallas, interpret = _pallas_mode(q.shape[1], k.shape[1], causal)
+    if use_pallas:
+        out, _ = _flash_fwd(q, k, v, scale, causal, interpret=interpret)
+        return out
     return _xla_attention(q, k, v, scale, causal).astype(q.dtype)
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal):
-    return _flash(q, k, v, scale, causal), (q, k, v)
+    use_pallas, interpret = _pallas_mode(q.shape[1], k.shape[1], causal)
+    if use_pallas:
+        out, lse = _flash_fwd(q, k, v, scale, causal, interpret=interpret)
+        return out, (q, k, v, out, lse)
+    return _xla_attention(q, k, v, scale, causal).astype(q.dtype), \
+        (q, k, v, None, None)
 
 
 def _flash_vjp_bwd(scale, causal, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
+    use_pallas, interpret = _pallas_mode(q.shape[1], k.shape[1], causal)
+    if use_pallas and lse is not None:
+        return _flash_bwd(q, k, v, o, lse, g, scale, causal,
+                          interpret=interpret)
     _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, scale, causal)
                      .astype(q.dtype), q, k, v)
     return vjp(g)

@@ -1,0 +1,463 @@
+"""Sequence (ragged / LoD) op family on the segment-ids representation.
+
+Reference parity: ``paddle/fluid/operators/sequence_ops/`` (~40 ops over
+LoDTensor, ``framework/lod_tensor.h:109``) and ``operators/edit_distance_op.*``.
+
+TPU-first design: the reference attaches LoD (level-of-detail offset
+metadata) to tensors and writes per-sequence CPU/CUDA loops.  Here a ragged
+batch is an explicit pair ``(x, seq_lens)``:
+
+- ``x``: dense ``(total_tokens, ...)`` array — all sequences concatenated,
+  a *static* leading dimension (XLA needs static shapes);
+- ``seq_lens``: int array ``(num_seqs,)`` with ``sum(seq_lens) <= total``.
+
+Segment ids are derived with ``jnp.repeat(..., total_repeat_length=total)``,
+which is jit-traceable because the *total* is static even when the split is
+data-dependent.  Reductions use XLA's ``segment_sum/max/min`` (which lower
+to one-pass scatter-adds the TPU handles well), softmax/normalisation are
+computed with a broadcast-back of per-segment statistics, and the padded
+<-> flattened converters (``sequence_pad``/``sequence_unpad``) bridge to
+the (B, T, D) layout the attention/rnn stack uses.  Tokens past the valid
+total (padding tail) map to a scrap segment and are masked out of every
+result.
+
+Ops with data-dependent *output* shapes (``sequence_expand``,
+``sequence_erase``, ...) are eager-only by nature (the reference computes
+their output LoD on host too); they document this and work on concrete
+arrays.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "sequence_pool", "sequence_softmax", "sequence_first_step",
+    "sequence_last_step", "sequence_pad", "sequence_unpad",
+    "sequence_reverse", "sequence_conv", "sequence_expand",
+    "sequence_expand_as", "sequence_concat", "sequence_slice",
+    "sequence_enumerate", "sequence_reshape", "sequence_erase",
+    "sequence_scatter", "edit_distance",
+]
+
+
+def _segment_ids(seq_lens, total):
+    """Token -> sequence index; padding tail -> num_seqs (scrap segment)."""
+    n = seq_lens.shape[0]
+    ids = jnp.repeat(jnp.arange(n, dtype=jnp.int32),
+                     seq_lens.astype(jnp.int32),
+                     total_repeat_length=total)
+    # jnp.repeat pads the tail by repeating the last id when
+    # sum(lens) < total; rebuild the tail as the scrap segment instead.
+    valid = jnp.arange(total) < jnp.sum(seq_lens)
+    return jnp.where(valid, ids, n), valid
+
+
+def sequence_pool(x, seq_lens, pool_type="average", pad_value=0.0, name=None):
+    """Per-sequence reduction over flattened tokens.
+
+    Reference: ``sequence_ops/sequence_pool_op.h`` — SUM/AVERAGE/SQRT/MAX/
+    MIN/LAST/FIRST over each LoD segment; empty sequences produce
+    ``pad_value``.
+    """
+    x, seq_lens = to_tensor(x), to_tensor(seq_lens)
+    ptype = pool_type.lower()
+
+    def impl(a, lens):
+        total = a.shape[0]
+        n = lens.shape[0]
+        ids, valid = _segment_ids(lens, total)
+        vmask = valid.reshape((-1,) + (1,) * (a.ndim - 1))
+        az = jnp.where(vmask, a, 0)
+        if ptype in ("sum", "average", "sqrt"):
+            s = jax.ops.segment_sum(az, ids, num_segments=n + 1)[:n]
+            if ptype == "average":
+                s = s / jnp.maximum(lens, 1).astype(a.dtype).reshape(
+                    (-1,) + (1,) * (a.ndim - 1))
+            elif ptype == "sqrt":
+                s = s / jnp.sqrt(jnp.maximum(lens, 1).astype(a.dtype)).reshape(
+                    (-1,) + (1,) * (a.ndim - 1))
+            out = s
+        elif ptype == "max":
+            neg = jnp.full_like(a, -jnp.inf) if jnp.issubdtype(
+                a.dtype, jnp.floating) else jnp.full_like(
+                    a, jnp.iinfo(a.dtype).min)
+            out = jax.ops.segment_max(jnp.where(vmask, a, neg), ids,
+                                      num_segments=n + 1)[:n]
+        elif ptype == "min":
+            pos = jnp.full_like(a, jnp.inf) if jnp.issubdtype(
+                a.dtype, jnp.floating) else jnp.full_like(
+                    a, jnp.iinfo(a.dtype).max)
+            out = jax.ops.segment_min(jnp.where(vmask, a, pos), ids,
+                                      num_segments=n + 1)[:n]
+        elif ptype in ("first", "last"):
+            ends = jnp.cumsum(lens)
+            starts = ends - lens
+            idx = starts if ptype == "first" else jnp.maximum(ends - 1, 0)
+            out = a[jnp.clip(idx, 0, total - 1)]
+        else:
+            raise ValueError(f"unknown pool_type '{pool_type}'")
+        empty = (lens == 0).reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(empty, jnp.asarray(pad_value, a.dtype), out)
+    return dispatch("sequence_pool", impl, (x, seq_lens), {})
+
+
+def sequence_first_step(x, seq_lens, name=None):
+    return sequence_pool(x, seq_lens, "first")
+
+
+def sequence_last_step(x, seq_lens, name=None):
+    return sequence_pool(x, seq_lens, "last")
+
+
+def sequence_softmax(x, seq_lens, name=None):
+    """Softmax within each sequence (x: (total,) or (total, 1)).
+
+    Reference: ``sequence_ops/sequence_softmax_op.h`` — per-LoD-segment
+    softmax.  Padding-tail tokens get probability 0.
+    """
+    x, seq_lens = to_tensor(x), to_tensor(seq_lens)
+
+    def impl(a, lens):
+        squeeze = a.ndim == 2 and a.shape[1] == 1
+        v = a.reshape(a.shape[0]) if squeeze else a
+        total, n = v.shape[0], lens.shape[0]
+        ids, valid = _segment_ids(lens, total)
+        neg = jnp.where(valid, v, -jnp.inf)
+        mx = jax.ops.segment_max(neg, ids, num_segments=n + 1)
+        mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+        e = jnp.where(valid, jnp.exp(v - mx[ids]), 0.0)
+        denom = jax.ops.segment_sum(e, ids, num_segments=n + 1)
+        out = e / jnp.maximum(denom[ids], 1e-30)
+        return out.reshape(a.shape) if squeeze else out
+    return dispatch("sequence_softmax", impl, (x, seq_lens), {})
+
+
+def sequence_pad(x, seq_lens, pad_value=0.0, maxlen=None, name=None):
+    """Flattened (total, ...) -> padded (num_seqs, maxlen, ...).
+
+    Reference: ``sequence_ops/sequence_pad_op.h``.  Returns
+    ``(padded, seq_lens)`` like the reference (which returns Length).
+    ``maxlen`` defaults to the static total (jit-safe upper bound) when
+    tracing, else to max(seq_lens).
+    """
+    x, seq_lens = to_tensor(x), to_tensor(seq_lens)
+    if maxlen is None:
+        if isinstance(seq_lens._data, jax.core.Tracer):
+            maxlen = int(x.shape[0])  # static total: jit-safe upper bound
+        else:
+            lens_np = seq_lens.numpy()
+            maxlen = int(np.max(lens_np)) if lens_np.size else 1
+
+    def impl(a, lens):
+        total = a.shape[0]
+        n = lens.shape[0]
+        ends = jnp.cumsum(lens)
+        starts = ends - lens
+        # (n, maxlen) gather indices into the flat token axis
+        pos = jnp.arange(maxlen)[None, :]
+        tok = starts[:, None] + pos
+        ok = pos < lens[:, None]
+        gathered = a[jnp.clip(tok, 0, total - 1)]
+        okb = ok.reshape(ok.shape + (1,) * (a.ndim - 1))
+        return (jnp.where(okb, gathered, jnp.asarray(pad_value, a.dtype)),
+                lens)
+    return dispatch("sequence_pad", impl, (x, seq_lens), {})
+
+
+def sequence_unpad(x, seq_lens, name=None):
+    """Padded (num_seqs, maxlen, ...) -> flattened (sum(lens), ...).
+
+    Reference: ``sequence_ops/sequence_unpad_op.h``.  Output leading dim is
+    data-dependent -> eager-only (concrete lens), like the reference's
+    host-side LoD computation.
+    """
+    x, seq_lens = to_tensor(x), to_tensor(seq_lens)
+    lens_np = np.asarray(seq_lens.numpy(), np.int64)
+    total = int(lens_np.sum())
+
+    def impl(a, lens):
+        n, maxlen = a.shape[0], a.shape[1]
+        ends = jnp.cumsum(lens)
+        starts = ends - lens
+        ids, _ = _segment_ids(lens, total)
+        within = jnp.arange(total) - starts[ids]
+        return a[ids, within]
+    return dispatch("sequence_unpad", impl, (x, seq_lens), {})
+
+
+def sequence_reverse(x, seq_lens, name=None):
+    """Reverse tokens within each sequence; padding tail kept in place.
+
+    Reference: ``sequence_ops/sequence_reverse_op.h``.
+    """
+    x, seq_lens = to_tensor(x), to_tensor(seq_lens)
+
+    def impl(a, lens):
+        total = a.shape[0]
+        ids, valid = _segment_ids(lens, total)
+        ends = jnp.cumsum(lens)
+        starts = ends - lens
+        n = lens.shape[0]
+        starts_e = jnp.concatenate([starts, jnp.array([0])])
+        ends_e = jnp.concatenate([ends, jnp.array([0])])
+        pos = jnp.arange(total)
+        mirrored = starts_e[ids] + (ends_e[ids] - 1 - pos)
+        src = jnp.where(valid, mirrored, pos)
+        return a[jnp.clip(src, 0, total - 1)]
+    return dispatch("sequence_reverse", impl, (x, seq_lens), {})
+
+
+def sequence_conv(x, seq_lens, filter, context_length=3, context_start=None,
+                  bias=None, name=None):
+    """Context-window convolution respecting sequence boundaries.
+
+    Reference: ``sequence_ops/sequence_conv_op.h`` — im2col over each LoD
+    segment (ContextProjectFunctor) then GEMM with ``filter`` of shape
+    ``(context_length * D, M)``.  TPU design: build the context tensor with
+    one gather (total, ctx, D), zero out-of-segment taps, then a single
+    matmul that XLA maps onto the MXU.
+    """
+    x, seq_lens, filter = to_tensor(x), to_tensor(seq_lens), to_tensor(filter)
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    tensors = (x, seq_lens, filter) + ((to_tensor(bias),)
+                                      if bias is not None else ())
+
+    def impl(a, lens, w, *maybe_b):
+        total, d = a.shape
+        ids, valid = _segment_ids(lens, total)
+        ends = jnp.cumsum(lens)
+        starts = ends - lens
+        n = lens.shape[0]
+        starts_e = jnp.concatenate([starts, jnp.array([total])])
+        ends_e = jnp.concatenate([ends, jnp.array([total])])
+        pos = jnp.arange(total)
+        taps = pos[:, None] + context_start + jnp.arange(context_length)[None]
+        ok = ((taps >= starts_e[ids][:, None]) & (taps < ends_e[ids][:, None])
+              & valid[:, None])
+        ctx = a[jnp.clip(taps, 0, total - 1)]          # (total, ctx, D)
+        ctx = jnp.where(ok[..., None], ctx, 0)
+        out = ctx.reshape(total, context_length * d) @ w
+        if maybe_b:
+            out = out + maybe_b[0]
+        return jnp.where(valid[:, None], out, 0)
+    return dispatch("sequence_conv", impl, tensors, {})
+
+
+def sequence_expand(x, x_lens, y_lens, name=None):
+    """Repeat each sequence of x by the matching sequence count in y.
+
+    Reference: ``sequence_ops/sequence_expand_op.h`` (ref_level collapsed:
+    y's lod level gives per-sequence repeat counts).  Output length is
+    data-dependent -> eager-only.
+    """
+    x, x_lens, y_lens = to_tensor(x), to_tensor(x_lens), to_tensor(y_lens)
+    xl = np.asarray(x_lens.numpy(), np.int64)
+    yl = np.asarray(y_lens.numpy(), np.int64)
+    starts = np.concatenate([[0], np.cumsum(xl)])[:-1]
+    idx = []
+    for i, (s, l, r) in enumerate(zip(starts, xl, yl)):
+        for _ in range(int(r)):
+            idx.extend(range(int(s), int(s + l)))
+    idx = np.asarray(idx, np.int32)
+
+    def impl(a, _xl, _yl):
+        return a[jnp.asarray(idx)]
+    return dispatch("sequence_expand", impl, (x, x_lens, y_lens), {})
+
+
+def sequence_expand_as(x, y_lens, name=None):
+    """Row i of x repeated y_lens[i] times (x: (num_seqs, D)).
+
+    Reference: ``sequence_ops/sequence_expand_as_op.h``.  Eager-only
+    (data-dependent output length).
+    """
+    x, y_lens = to_tensor(x), to_tensor(y_lens)
+    yl = np.asarray(y_lens.numpy(), np.int64)
+    total = int(yl.sum())
+
+    def impl(a, lens):
+        ids, _ = _segment_ids(lens, total)
+        return a[ids]
+    return dispatch("sequence_expand_as", impl, (x, y_lens), {})
+
+
+def sequence_concat(xs, lens_list, name=None):
+    """Concatenate ragged batches sequence-wise.
+
+    Reference: ``sequence_ops/sequence_concat_op.h`` — output sequence i is
+    ``concat(x0[i], x1[i], ...)``.  Returns ``(out, out_lens)``.
+    Eager-only (interleave permutation computed on host).
+    """
+    xs = [to_tensor(x) for x in xs]
+    lens_np = [np.asarray(to_tensor(l).numpy(), np.int64) for l in lens_list]
+    n = len(lens_np[0])
+    starts = [np.concatenate([[0], np.cumsum(l)])[:-1] for l in lens_np]
+    order = []  # (input_idx, token_idx) in output order
+    for i in range(n):
+        for j in range(len(xs)):
+            s, l = int(starts[j][i]), int(lens_np[j][i])
+            order.extend((j, t) for t in range(s, s + l))
+    offsets = np.concatenate([[0], np.cumsum([x.shape[0] for x in xs])])
+    flat_idx = np.asarray([offsets[j] + t for j, t in order], np.int32)
+    out_lens = to_tensor(np.sum(np.stack(lens_np), axis=0).astype(np.int64))
+
+    def impl(*arrs):
+        return jnp.concatenate(arrs, axis=0)[jnp.asarray(flat_idx)]
+    return dispatch("sequence_concat", impl, tuple(xs), {}), out_lens
+
+
+def sequence_slice(x, seq_lens, offset, length, name=None):
+    """Per-sequence slice: take ``length[i]`` tokens starting at
+    ``offset[i]`` from sequence i.
+
+    Reference: ``sequence_ops/sequence_slice_op.h``.  Eager-only.
+    Returns ``(out, new_lens)``.
+    """
+    x, seq_lens = to_tensor(x), to_tensor(seq_lens)
+    offset = np.asarray(to_tensor(offset).numpy(), np.int64).reshape(-1)
+    length = np.asarray(to_tensor(length).numpy(), np.int64).reshape(-1)
+    lens_np = np.asarray(seq_lens.numpy(), np.int64)
+    starts = np.concatenate([[0], np.cumsum(lens_np)])[:-1]
+    idx = []
+    for s, o, l in zip(starts, offset, length):
+        idx.extend(range(int(s + o), int(s + o + l)))
+    idx = np.asarray(idx, np.int32)
+    new_lens = to_tensor(length.astype(np.int64))
+
+    def impl(a, _l):
+        return a[jnp.asarray(idx)]
+    return dispatch("sequence_slice", impl, (x, seq_lens), {}), new_lens
+
+
+def sequence_enumerate(x, seq_lens, win_size, pad_value=0, name=None):
+    """All win_size-grams per sequence, padded past each sequence end.
+
+    Reference: ``sequence_ops/sequence_enumerate_op.h``.
+    x: (total,) int ids -> out: (total, win_size).
+    """
+    x, seq_lens = to_tensor(x), to_tensor(seq_lens)
+
+    def impl(a, lens):
+        total = a.shape[0]
+        ids, valid = _segment_ids(lens, total)
+        ends = jnp.cumsum(lens)
+        ends_e = jnp.concatenate([ends, jnp.array([total])])
+        pos = jnp.arange(total)
+        taps = pos[:, None] + jnp.arange(win_size)[None]
+        ok = (taps < ends_e[ids][:, None]) & valid[:, None]
+        vals = a[jnp.clip(taps, 0, total - 1)]
+        return jnp.where(ok, vals, jnp.asarray(pad_value, a.dtype))
+    return dispatch("sequence_enumerate", impl, (x, seq_lens), {})
+
+
+def sequence_reshape(x, seq_lens, new_dim, name=None):
+    """Re-chunk each sequence's payload to width ``new_dim``.
+
+    Reference: ``sequence_ops/sequence_reshape_op.h`` — total elements per
+    sequence must divide new_dim.  Returns ``(out, new_lens)``.
+    """
+    x, seq_lens = to_tensor(x), to_tensor(seq_lens)
+    lens_np = np.asarray(seq_lens.numpy(), np.int64)
+    d = x.shape[1]
+    new_lens = lens_np * d // new_dim
+    out_lens = to_tensor(new_lens.astype(np.int64))
+
+    def impl(a, _l):
+        return a.reshape(-1, new_dim)
+    return dispatch("sequence_reshape", impl, (x, seq_lens), {}), out_lens
+
+
+def sequence_erase(x, seq_lens, tokens, name=None):
+    """Remove the given token ids from each sequence.
+
+    Reference: ``sequence_ops/sequence_erase_op.h``.  Eager-only.
+    Returns ``(out, new_lens)``.
+    """
+    x, seq_lens = to_tensor(x), to_tensor(seq_lens)
+    a = np.asarray(x.numpy())
+    lens_np = np.asarray(seq_lens.numpy(), np.int64)
+    keep = ~np.isin(a, np.asarray(list(tokens)))
+    starts = np.concatenate([[0], np.cumsum(lens_np)])[:-1]
+    new_lens = np.asarray([int(keep[int(s):int(s + l)].sum())
+                           for s, l in zip(starts, lens_np)], np.int64)
+    idx = np.nonzero(keep)[0].astype(np.int32)
+
+    def impl(arr, _l):
+        return arr[jnp.asarray(idx)]
+    return dispatch("sequence_erase", impl, (x, seq_lens), {}), \
+        to_tensor(new_lens)
+
+
+def sequence_scatter(x, index, updates, seq_lens, name=None):
+    """Scatter-add ragged per-sequence updates into rows of x.
+
+    Reference: ``sequence_ops/sequence_scatter_op.h`` — updates' sequence i
+    (positions ``index`` within row i of x) adds into ``x[i]``.
+    """
+    x, index = to_tensor(x), to_tensor(index)
+    updates, seq_lens = to_tensor(updates), to_tensor(seq_lens)
+
+    def impl(a, idx, upd, lens):
+        total = idx.shape[0]
+        ids, valid = _segment_ids(lens, total)
+        rows = jnp.where(valid, ids, 0)
+        cols = jnp.clip(idx, 0, a.shape[1] - 1)
+        vals = jnp.where(valid, upd, 0)
+        return a.at[rows, cols].add(vals)
+    return dispatch("sequence_scatter", impl, (x, index, updates, seq_lens),
+                    {})
+
+
+def edit_distance(hyps, refs, hyp_lens, ref_lens, normalized=True, name=None):
+    """Batched Levenshtein distance over padded id matrices.
+
+    Reference: ``operators/edit_distance_op.h`` (CPU DP) / ``.cu`` (GPU
+    wavefront).  TPU design: one ``lax.scan`` over hypothesis positions
+    carrying the DP row, vmapped over the batch — static shapes, no host
+    loop.  Returns ``(dist, seq_num)`` like the reference.
+
+    hyps/refs: (B, Th)/(B, Tr) int arrays; lens: (B,).
+    """
+    hyps, refs = to_tensor(hyps), to_tensor(refs)
+    hyp_lens, ref_lens = to_tensor(hyp_lens), to_tensor(ref_lens)
+
+    def impl(h, r, hl, rl):
+        B, Th = h.shape
+        Tr = r.shape[1]
+
+        def one(hrow, rrow, m, n):
+            # DP over rows i=1..Th; row[j] = edit distance (i tokens, j toks).
+            # All rows are kept (scan ys) so DP[m, n] can be gathered for
+            # any per-example (m, n) without data-dependent trip counts.
+            row0 = jnp.arange(Tr + 1, dtype=jnp.float32)
+
+            def step(prev, i):
+                sub = prev[:-1] + (hrow[i] != rrow).astype(jnp.float32)
+                # new[0] = i+1; new[j] = min(prev[j]+1, new[j-1]+1, sub[j-1])
+                del_cost = prev[1:] + 1.0
+                base = jnp.minimum(del_cost, sub)
+
+                def inner(carry, b):
+                    v = jnp.minimum(b, carry + 1.0)
+                    return v, v
+                ip1 = (i + 1).astype(jnp.float32)
+                _, rest = jax.lax.scan(inner, ip1, base)
+                new = jnp.concatenate([ip1[None], rest])
+                return new, new
+
+            _, rows = jax.lax.scan(step, row0, jnp.arange(Th))
+            table = jnp.concatenate([row0[None], rows])  # (Th+1, Tr+1)
+            return table[m, n]
+
+        dist = jax.vmap(one)(h, r, hl, rl)
+        if normalized:
+            dist = dist / jnp.maximum(rl, 1).astype(jnp.float32)
+        return dist, jnp.asarray(B)
+    return dispatch("edit_distance", impl, (hyps, refs, hyp_lens, ref_lens),
+                    {})

@@ -93,7 +93,12 @@ class OpTest:
                 f"numeric {numeric.ravel()[:4]})")
 
     def _numeric_grad(self, name, output_idx, delta):
-        base = {k: np.asarray(v, np.float64) for k, v in self.inputs.items()}
+        # only the perturbed input is promoted to float64; integer side
+        # inputs (sequence lengths, indices) must keep their dtype
+        base = {k: (np.asarray(v, np.float64)
+                    if np.issubdtype(np.asarray(v).dtype, np.floating)
+                    else np.asarray(v))
+                for k, v in self.inputs.items()}
         x = base[name]
         grad = np.zeros_like(x, dtype=np.float64)
         flat = x.reshape(-1)

@@ -1,0 +1,375 @@
+"""Sequence/ragged op family tests (ops/sequence.py).
+
+Mirrors the reference's per-op tests under
+``python/paddle/fluid/tests/unittests/test_sequence_*.py`` — numpy oracle
+per op, forward + finite-difference gradient checks via the OpTest harness
+for the jit-safe ops, direct eager parity for the ops whose output shape is
+data-dependent (eager-only by design, like the reference's host-side LoD
+computation).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import sequence as seq
+
+from op_test import OpTest
+
+RS = np.random.RandomState(7)
+LENS = np.array([3, 0, 4, 2], dtype=np.int64)   # one empty sequence
+TOTAL = int(LENS.sum())
+
+
+def _segments(lens):
+    starts = np.concatenate([[0], np.cumsum(lens)])[:-1]
+    return [(int(s), int(s + l)) for s, l in zip(starts, lens)]
+
+
+# ---------------------------------------------------------------------------
+# sequence_pool
+# ---------------------------------------------------------------------------
+def _pool_ref(x, seq_lens, pool_type="average", pad_value=0.0):
+    out = []
+    for s, e in _segments(seq_lens):
+        if e == s:
+            out.append(np.full(x.shape[1:], pad_value, x.dtype))
+            continue
+        seg = x[s:e]
+        if pool_type == "sum":
+            out.append(seg.sum(0))
+        elif pool_type == "average":
+            out.append(seg.mean(0))
+        elif pool_type == "sqrt":
+            out.append(seg.sum(0) / np.sqrt(e - s))
+        elif pool_type == "max":
+            out.append(seg.max(0))
+        elif pool_type == "min":
+            out.append(seg.min(0))
+        elif pool_type == "first":
+            out.append(seg[0])
+        elif pool_type == "last":
+            out.append(seg[-1])
+    return np.stack(out).astype(x.dtype)
+
+
+class TestSequencePoolOp(OpTest):
+    op_fn = staticmethod(seq.sequence_pool)
+    pool_type = "average"
+
+    def setUp(self):
+        self.inputs = {"x": RS.rand(TOTAL, 5).astype("float32"),
+                       "seq_lens": LENS.copy()}
+        self.attrs = {"pool_type": self.pool_type}
+        self.grad_inputs = ["x"]
+        self.ref_fn = _pool_ref
+
+    def test_all(self):
+        self.setUp()
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestSequencePoolSum(TestSequencePoolOp):
+    pool_type = "sum"
+
+
+class TestSequencePoolSqrt(TestSequencePoolOp):
+    pool_type = "sqrt"
+
+
+class TestSequencePoolMax(TestSequencePoolOp):
+    pool_type = "max"
+
+    def setUp(self):
+        # well-separated values: the numeric grad perturbation (1e-3) must
+        # not flip the argmax (reference whitelists max ops similarly)
+        super().setUp()
+        vals = np.linspace(0.0, 1.0, TOTAL * 5, dtype="float32")
+        self.inputs["x"] = RS.permutation(vals).reshape(TOTAL, 5)
+
+
+class TestSequencePoolMin(TestSequencePoolMax):
+    pool_type = "min"
+
+
+class TestSequencePoolFirst(TestSequencePoolOp):
+    pool_type = "first"
+
+
+class TestSequencePoolLast(TestSequencePoolOp):
+    pool_type = "last"
+
+
+# ---------------------------------------------------------------------------
+# sequence_softmax
+# ---------------------------------------------------------------------------
+def _softmax_ref(x, seq_lens):
+    out = np.zeros_like(x)
+    for s, e in _segments(seq_lens):
+        if e > s:
+            v = x[s:e]
+            ex = np.exp(v - v.max())
+            out[s:e] = ex / ex.sum()
+    return out
+
+
+class TestSequenceSoftmaxOp(OpTest):
+    op_fn = staticmethod(seq.sequence_softmax)
+
+    def setUp(self):
+        self.inputs = {"x": RS.rand(TOTAL).astype("float32"),
+                       "seq_lens": LENS.copy()}
+        self.attrs = {}
+        self.grad_inputs = ["x"]
+        self.ref_fn = _softmax_ref
+
+    def test_all(self):
+        self.setUp()
+        self.check_output()
+        self.check_grad(["x"], max_relative_error=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# sequence_pad / sequence_unpad
+# ---------------------------------------------------------------------------
+def _pad_ref(x, seq_lens, pad_value=0.0, maxlen=None):
+    ml = maxlen or int(seq_lens.max())
+    out = np.full((len(seq_lens), ml) + x.shape[1:], pad_value, x.dtype)
+    for i, (s, e) in enumerate(_segments(seq_lens)):
+        out[i, :e - s] = x[s:e]
+    return out, seq_lens
+
+
+class TestSequencePadOp(OpTest):
+    op_fn = staticmethod(seq.sequence_pad)
+
+    def setUp(self):
+        self.inputs = {"x": RS.rand(TOTAL, 3).astype("float32"),
+                       "seq_lens": LENS.copy()}
+        self.attrs = {"pad_value": -1.0, "maxlen": 5}
+        self.grad_inputs = ["x"]
+        self.ref_fn = _pad_ref
+
+    def test_all(self):
+        self.setUp()
+        self.check_output()
+        self.check_grad(["x"])
+
+
+def test_sequence_unpad():
+    x = RS.rand(4, 5, 3).astype("float32")
+    lens = np.array([2, 5, 1, 3], dtype=np.int64)
+    out = seq.sequence_unpad(x, lens)
+    ref = np.concatenate([x[i, :l] for i, l in enumerate(lens)])
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    # grad: only valid positions receive gradient
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    y = seq.sequence_unpad(xt, lens)
+    paddle.sum(y).backward()
+    g = xt.grad.numpy()
+    for i, l in enumerate(lens):
+        assert np.all(g[i, :l] == 1.0) and np.all(g[i, l:] == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# sequence_reverse
+# ---------------------------------------------------------------------------
+def _reverse_ref(x, seq_lens):
+    out = x.copy()
+    for s, e in _segments(seq_lens):
+        out[s:e] = x[s:e][::-1]
+    return out
+
+
+class TestSequenceReverseOp(OpTest):
+    op_fn = staticmethod(seq.sequence_reverse)
+
+    def setUp(self):
+        self.inputs = {"x": RS.rand(TOTAL, 4).astype("float32"),
+                       "seq_lens": LENS.copy()}
+        self.attrs = {}
+        self.grad_inputs = ["x"]
+        self.ref_fn = _reverse_ref
+
+    def test_all(self):
+        self.setUp()
+        self.check_output()
+        self.check_grad(["x"])
+
+
+# ---------------------------------------------------------------------------
+# sequence_conv
+# ---------------------------------------------------------------------------
+def _conv_ref(x, seq_lens, filter, context_length=3, context_start=None):
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    total, d = x.shape
+    ctx = np.zeros((total, context_length, d), x.dtype)
+    for s, e in _segments(seq_lens):
+        for p in range(s, e):
+            for c in range(context_length):
+                t = p + context_start + c
+                if s <= t < e:
+                    ctx[p, c] = x[t]
+    return (ctx.reshape(total, -1) @ filter).astype(x.dtype)
+
+
+class TestSequenceConvOp(OpTest):
+    op_fn = staticmethod(seq.sequence_conv)
+
+    def setUp(self):
+        self.inputs = {"x": RS.rand(TOTAL, 4).astype("float32"),
+                       "seq_lens": LENS.copy(),
+                       "filter": RS.rand(12, 6).astype("float32")}
+        self.attrs = {"context_length": 3}
+        self.grad_inputs = ["x", "filter"]
+        self.ref_fn = _conv_ref
+
+    def test_all(self):
+        self.setUp()
+        self.check_output(atol=1e-4, rtol=1e-4)
+        self.check_grad(["x", "filter"], max_relative_error=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# sequence_enumerate
+# ---------------------------------------------------------------------------
+def _enum_ref(x, seq_lens, win_size=2, pad_value=0):
+    total = x.shape[0]
+    out = np.full((total, win_size), pad_value, x.dtype)
+    for s, e in _segments(seq_lens):
+        for p in range(s, e):
+            for c in range(win_size):
+                if p + c < e:
+                    out[p, c] = x[p + c]
+    return out
+
+
+class TestSequenceEnumerateOp(OpTest):
+    op_fn = staticmethod(seq.sequence_enumerate)
+
+    def setUp(self):
+        self.inputs = {"x": RS.randint(1, 100, TOTAL).astype("int32"),
+                       "seq_lens": LENS.copy()}
+        self.attrs = {"win_size": 2, "pad_value": 0}
+        self.ref_fn = _enum_ref
+
+    def test_all(self):
+        self.setUp()
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# sequence_scatter
+# ---------------------------------------------------------------------------
+def test_sequence_scatter():
+    x = RS.rand(3, 8).astype("float32")
+    upd_lens = np.array([2, 3, 1], dtype=np.int64)
+    index = np.array([1, 3, 0, 2, 5, 7], dtype=np.int32)
+    updates = RS.rand(6).astype("float32")
+    out = seq.sequence_scatter(x, index, updates, upd_lens)
+    ref = x.copy()
+    for i, (s, e) in enumerate(_segments(upd_lens)):
+        for j in range(s, e):
+            ref[i, index[j]] += updates[j]
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# eager-only ops: expand / expand_as / concat / slice / erase / reshape
+# ---------------------------------------------------------------------------
+def test_sequence_expand():
+    x = RS.rand(5, 2).astype("float32")
+    x_lens = np.array([2, 3], dtype=np.int64)
+    y_lens = np.array([2, 3], dtype=np.int64)   # repeat counts
+    out = seq.sequence_expand(x, x_lens, y_lens)
+    ref = np.concatenate([x[0:2], x[0:2], x[2:5], x[2:5], x[2:5]])
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+def test_sequence_expand_as():
+    x = RS.rand(3, 4).astype("float32")
+    y_lens = np.array([2, 1, 3], dtype=np.int64)
+    out = seq.sequence_expand_as(x, y_lens)
+    ref = np.concatenate([np.tile(x[i], (l, 1))
+                          for i, l in enumerate(y_lens)])
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    # gradient: each row's grad = number of repeats
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    paddle.sum(seq.sequence_expand_as(xt, y_lens)).backward()
+    np.testing.assert_allclose(
+        xt.grad.numpy(), np.tile(y_lens[:, None], (1, 4)).astype("float32"))
+
+
+def test_sequence_concat():
+    a = RS.rand(5, 2).astype("float32")
+    b = RS.rand(4, 2).astype("float32")
+    la = np.array([2, 3], dtype=np.int64)
+    lb = np.array([1, 3], dtype=np.int64)
+    out, lens = seq.sequence_concat([a, b], [la, lb])
+    ref = np.concatenate([a[0:2], b[0:1], a[2:5], b[1:4]])
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    np.testing.assert_array_equal(lens.numpy(), [3, 6])
+
+
+def test_sequence_slice():
+    x = RS.rand(9, 2).astype("float32")
+    lens = np.array([4, 5], dtype=np.int64)
+    out, new_lens = seq.sequence_slice(x, lens,
+                                       np.array([1, 0], dtype=np.int64),
+                                       np.array([2, 3], dtype=np.int64))
+    ref = np.concatenate([x[1:3], x[4:7]])
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    np.testing.assert_array_equal(new_lens.numpy(), [2, 3])
+
+
+def test_sequence_erase():
+    x = np.array([1, 2, 3, 2, 5, 2, 7], dtype=np.int64)
+    lens = np.array([4, 3], dtype=np.int64)
+    out, new_lens = seq.sequence_erase(x, lens, [2])
+    np.testing.assert_array_equal(out.numpy(), [1, 3, 5, 7])
+    np.testing.assert_array_equal(new_lens.numpy(), [2, 2])
+
+
+def test_sequence_reshape():
+    x = RS.rand(6, 4).astype("float32")
+    lens = np.array([4, 2], dtype=np.int64)
+    out, new_lens = seq.sequence_reshape(x, lens, 8)
+    np.testing.assert_allclose(out.numpy(), x.reshape(3, 8), rtol=1e-6)
+    np.testing.assert_array_equal(new_lens.numpy(), [2, 1])
+
+
+# ---------------------------------------------------------------------------
+# edit_distance
+# ---------------------------------------------------------------------------
+def _levenshtein(a, b):
+    m, n = len(a), len(b)
+    dp = np.zeros((m + 1, n + 1))
+    dp[:, 0] = np.arange(m + 1)
+    dp[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[m, n]
+
+
+@pytest.mark.parametrize("normalized", [False, True])
+def test_edit_distance(normalized):
+    B, Th, Tr = 5, 8, 7
+    hyps = RS.randint(0, 4, (B, Th)).astype("int32")
+    refs = RS.randint(0, 4, (B, Tr)).astype("int32")
+    hl = np.array([8, 3, 0, 5, 6], dtype=np.int64)
+    rl = np.array([7, 4, 2, 5, 1], dtype=np.int64)
+    dist, num = seq.edit_distance(hyps, refs, hl, rl, normalized=normalized)
+    ref = np.array([_levenshtein(h[:m], r[:n])
+                    for h, r, m, n in zip(hyps, refs, hl, rl)])
+    if normalized:
+        ref = ref / np.maximum(rl, 1)
+    np.testing.assert_allclose(dist.numpy(), ref, rtol=1e-6)
+    assert int(num.numpy()) == B
+    # jit consistency
+    import jax
+    jd = jax.jit(lambda h, r, a, b: seq.edit_distance(
+        h, r, a, b, normalized=normalized)[0]._data)(hyps, refs, hl, rl)
+    np.testing.assert_allclose(np.asarray(jd), ref, rtol=1e-6)
