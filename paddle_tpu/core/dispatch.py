@@ -69,17 +69,27 @@ def get_kernel(op_name: str, backend: Optional[str] = None) -> Callable:
 
 
 def preferred_backend() -> str:
-    """'pallas' on real TPU unless disabled via FLAGS_use_pallas=0."""
+    """'pallas' on real TPU unless disabled via FLAGS_use_pallas=0.
+
+    The platform probe is cached; the flag is re-read every call so
+    ``set_flags({'FLAGS_use_pallas': 0/1})`` flips the dispatch path at
+    runtime (the reference flips kernels per-op the same way via
+    FLAGS_run_pten_kernel).  PADDLE_PALLAS_FORCE=1 forces 'pallas' on any
+    platform (kernels run in interpret mode off-TPU) — the test hook.
+    """
     val = getattr(_preferred_backend, "value", None)
     if val is not None:
         return val
     from ..utils import flags
-    use_pallas = flags.get_flag("FLAGS_use_pallas")
-    if use_pallas and jax.default_backend() in ("tpu", "axon"):
-        _preferred_backend.value = "pallas"
-    else:
-        _preferred_backend.value = "xla"
-    return _preferred_backend.value
+    if not flags.get_flag("FLAGS_use_pallas"):
+        return "xla"
+    on_tpu = getattr(_preferred_backend, "on_tpu", None)
+    if on_tpu is None:
+        on_tpu = _preferred_backend.on_tpu = \
+            jax.default_backend() in ("tpu", "axon")
+    if on_tpu or os.environ.get("PADDLE_PALLAS_FORCE") == "1":
+        return "pallas"
+    return "xla"
 
 
 def _tensors_of(args):
@@ -103,6 +113,14 @@ def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
         if prog is not None:
             return _static_program.capture_op(prog, op_name, fn,
                                               tensor_args, kwargs)
+
+    # kernel-registry consultation (reference operator.cc:1296 ChooseKernel
+    # / pten kernel_factory.h:255): when the caller passed the registered
+    # 'xla' kernel and a better backend (pallas) has a registration for
+    # this op, dispatch swaps it in.  FLAGS_use_pallas=0 forces 'xla'.
+    backend = preferred_backend()
+    if backend != "xla" and _REGISTRY.get((op_name, "xla")) is fn:
+        fn = _REGISTRY.get((op_name, backend), fn)
 
     arrays = [t._data for t in tensor_args]
     # AMP autocast rewrite (reference imperative/tracer.cc:179-185)

@@ -118,9 +118,17 @@ class PipelineParallel(Layer):
 
     # -- schedule ----------------------------------------------------------
     def forward_backward_pipeline(self, data, labels, loss_fn):
-        """1F1B dependency order: per micro-batch fwd(all stages) then
-        bwd(all stages), grads accumulated across micro-batches
-        (reference :80 forward_backward_pipeline)."""
+        """Micro-batch schedule honoring ``schedule_mode`` (reference
+        :80 forward_backward_pipeline; section_worker.cc:62):
+
+        - "1F1B": interleaved — stage s starts the backward of
+          micro-batch b while micro-batch b + 2(S-1-s) is still going
+          forward; saved inputs per stage stay O(S), and everything is
+          issued without host syncs so JAX's async dispatch keeps the
+          device queue full (loss is materialized once at the end).
+        - "F-then-B": all forwards, then all backwards (saved inputs
+          O(M) — the fill-drain memory profile).
+        """
         S = self.num_stages
         m = self.accumulate_steps
         batch = np.asarray(data)
@@ -133,28 +141,72 @@ class PipelineParallel(Layer):
         ys = np.array_split(np.asarray(labels), m)
         states = [_stage_state(self._layers, s) for s in range(S)]
         grads = [jax.tree.map(jnp.zeros_like, st) for st in states]
-        total_loss = 0.0
+        keys = [[default_generator.next_key() for _ in range(S)]
+                for _ in range(m)]
+        saved = {}     # (stage, mb) -> saved stage input
+        fwd_out = {}   # (stage, mb) -> activation for stage+1
+        cot = {}       # (stage, mb) -> cotangent pending stage's backward
+        loss_acc = jnp.zeros((), jnp.float32)
+        self.peak_saved_per_stage = 0
+
+        def _track():
+            per_stage = {}
+            for (s, _) in saved:
+                per_stage[s] = per_stage.get(s, 0) + 1
+            self.peak_saved_per_stage = max(
+                self.peak_saved_per_stage, max(per_stage.values(), default=0))
+
+        def do_fwd(s, f):
+            nonlocal loss_acc
+            inp = jnp.asarray(xs[f]) if s == 0 else fwd_out.pop((s - 1, f))
+            if s == S - 1:
+                # last stage: loss + its own backward fused (value_and_grad)
+                loss, gS, gx = self._get_jit("last", s, loss_fn)(
+                    states[s], inp, jnp.asarray(ys[f]), keys[f][s])
+                grads[s] = jax.tree.map(jnp.add, grads[s], gS)
+                loss_acc = loss_acc + loss
+                if S > 1:
+                    cot[(s - 1, f)] = gx
+            else:
+                saved[(s, f)] = inp
+                _track()
+                fwd_out[(s, f)] = self._get_jit("fwd", s)(
+                    states[s], inp, keys[f][s])
+
+        def do_bwd(s, b):
+            gy = cot.pop((s, b))
+            gs, gx = self._get_jit("bwd", s)(
+                states[s], saved.pop((s, b)), gy, keys[b][s])
+            grads[s] = jax.tree.map(jnp.add, grads[s], gs)
+            if s > 0:
+                cot[(s - 1, b)] = gx
+
         try:
-            for mb in range(m):
-                keys = [default_generator.next_key() for _ in range(S)]
-                acts = [jnp.asarray(xs[mb])]
-                for s in range(S - 1):
-                    acts.append(self._get_jit("fwd", s)(states[s], acts[-1],
-                                                        keys[s]))
-                loss, gS, gx = self._get_jit("last", S - 1, loss_fn)(
-                    states[S - 1], acts[-1], jnp.asarray(ys[mb]),
-                    keys[S - 1])
-                grads[S - 1] = jax.tree.map(jnp.add, grads[S - 1], gS)
-                for s in range(S - 2, -1, -1):
-                    gs, gx = self._get_jit("bwd", s)(states[s], acts[s], gx,
-                                                     keys[s])
-                    grads[s] = jax.tree.map(jnp.add, grads[s], gs)
-                total_loss += float(loss)
+            if self.schedule_mode == "F-then-B":
+                for f in range(m):
+                    for s in range(S):
+                        do_fwd(s, f)
+                for b in range(m):
+                    for s in range(S - 2, -1, -1):
+                        do_bwd(s, b)
+            else:  # 1F1B interleave on the dual-slot clock
+                for t in range(m + 2 * (S - 1)):
+                    for s in range(S):
+                        f = t - s
+                        if 0 <= f < m:
+                            do_fwd(s, f)
+                    for s in range(S - 2, -1, -1):
+                        b = t - 2 * (S - 1) + s
+                        if 0 <= b < m and (s, b) in cot:
+                            do_bwd(s, b)
+            # single host sync for the whole batch
+            total_loss = float(loss_acc)
         finally:
             # tracing rebinds live Parameters to tracers; restore the
             # concrete snapshot even if a stage fn raises
             for s in range(S):
                 _load_stage_state(self._layers, s, states[s])
+        assert not saved and not cot, "pipeline schedule left work pending"
         # mean over micro-batches (reference broadcasts final loss)
         scale = 1.0 / m
         grads = [jax.tree.map(lambda g: g * scale, gr) for gr in grads]

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["stack_stage_params", "spmd_pipeline"]
+__all__ = ["stack_stage_params", "spmd_pipeline", "spmd_pipeline_1f1b"]
 
 
 def stack_stage_params(param_trees):
@@ -86,3 +86,123 @@ def spmd_pipeline(block_fn: Callable, stacked_params, x,
     mask = (stage == S - 1).astype(outputs.dtype)
     outputs = lax.psum(outputs * mask, axis)
     return outputs
+
+
+def spmd_pipeline_1f1b(block_fn: Callable, stacked_params, x, labels,
+                       last_fn: Callable, *, axis: str = "pp",
+                       num_stages: int, num_microbatches: int):
+    """1F1B pipeline: forward AND backward interleaved in one scan.
+
+    Reference parity: ``framework/section_worker.cc:92-150`` — the 1F1B
+    schedule (schedule_mode at ``:62``) where a stage starts the backward
+    of micro-batch b while later micro-batches still stream forward, so
+    in-flight activations stay O(num_stages), not O(num_microbatches).
+
+    TPU mechanism: one interleaved ``lax.scan`` of M + 2(S-1) ticks.  Each
+    tick does one forward slot (micro-batch f = t - stage) and one
+    backward slot (micro-batch b = t - 2(S-1) + stage); activations hop
+    stages via ``lax.ppermute`` forward, cotangents via the reverse
+    permute.  Each stage keeps a ring buffer of 2(S-1)+1 micro-batch
+    inputs — the backward recomputes its local blocks from the saved
+    input (remat posture), so that buffer IS the pipeline's entire
+    activation footprint.
+
+    Must be called INSIDE shard_map with `axis` manual.  Args:
+      block_fn: (stage_params, h) -> h for this rank's stacked blocks
+        slice (applied blockwise via an internal scan).
+      x: (M, mb, ...) micro-batched input (replicated over `axis`).
+      labels: (M, ...) per-micro-batch labels fed to last_fn.
+      last_fn: (out_mb, labels_mb) -> (loss, dout, extra_grads) — the
+        loss head run by the LAST stage at emit time; extra_grads is a
+        pytree of grads for the head's own params (closure).
+    Returns (loss_sum, stage_param_grads, dx, extra_grads_sum), all valid
+    on every rank (loss/dx/extra psum'd off their owning stage).
+    """
+    stage = lax.axis_index(axis)
+    S, M = num_stages, num_microbatches
+    mb_shape = x.shape[1:]
+    # ring buffer must cover the full fwd-to-bwd window 2(S-1) even when
+    # M is smaller — otherwise drain-phase writes clobber pending reads
+    B_buf = 2 * (S - 1) + 1 if S > 1 else 1
+
+    def local_stack(params, h):
+        def body(h, p):
+            return block_fn(p, h), None
+        h, _ = lax.scan(body, h, params)
+        return h
+
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+    zero_like_params = jax.tree.map(jnp.zeros_like, stacked_params)
+    # probe last_fn's extra-grad structure with zeros (traced shapes only)
+    _, _, extra_probe = last_fn(jnp.zeros(mb_shape, x.dtype),
+                                lax.dynamic_index_in_dim(
+                                    labels, 0, axis=0, keepdims=False))
+    zero_extra = jax.tree.map(jnp.zeros_like, extra_probe)
+
+    def tick(carry, t):
+        (fwd_state, cot_state, buf, dparams_acc, dextra_acc, dx_acc,
+         loss_acc) = carry
+        f = t - stage                       # fwd micro-batch at this stage
+        # ---- forward slot -------------------------------------------------
+        feed = lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+        inp = jnp.where(stage == 0, feed, fwd_state)
+        buf = lax.dynamic_update_index_in_dim(
+            buf, inp, jnp.maximum(f, 0) % B_buf, axis=0)
+        out = local_stack(stacked_params, inp)
+        # ---- emit + loss head on the last stage ---------------------------
+        emit_t = t - (S - 1)
+        live_emit = (stage == S - 1) & (emit_t >= 0) & (emit_t < M)
+        lab = lax.dynamic_index_in_dim(
+            labels, jnp.clip(emit_t, 0, M - 1), axis=0, keepdims=False)
+        loss_mb, dout, dextra = last_fn(out, lab)
+        emit_f = live_emit.astype(jnp.float32)
+        loss_acc = loss_acc + loss_mb * emit_f
+        dextra_acc = jax.tree.map(
+            lambda a, g: a + g * emit_f.astype(g.dtype), dextra_acc, dextra)
+        # ---- fwd hop ------------------------------------------------------
+        fwd_state = lax.ppermute(out, axis, perm_fwd)
+        # ---- backward slot ------------------------------------------------
+        b = t - 2 * (S - 1) + stage
+        live_b = (b >= 0) & (b < M)
+        cot_in = jnp.where(stage == S - 1,
+                           jnp.where(live_emit, dout, 0).astype(x.dtype),
+                           cot_state)
+        h_saved = lax.dynamic_index_in_dim(
+            buf, jnp.maximum(b, 0) % B_buf, axis=0, keepdims=False)
+        _, vjp = jax.vjp(local_stack, stacked_params, h_saved)
+        dparams, dh = vjp(cot_in)
+        live_bf = live_b.astype(jnp.float32)
+        dparams_acc = jax.tree.map(
+            lambda a, g: a + g * live_bf.astype(g.dtype), dparams_acc,
+            dparams)
+        # stage 0's dh is the grad wrt x[b]
+        bidx = jnp.clip(b, 0, M - 1)
+        old = lax.dynamic_index_in_dim(dx_acc, bidx, axis=0, keepdims=False)
+        upd = jnp.where(live_b & (stage == 0), dh, old)
+        dx_acc = lax.dynamic_update_index_in_dim(dx_acc, upd, bidx, axis=0)
+        # ---- bwd hop ------------------------------------------------------
+        cot_state = lax.ppermute(jnp.where(live_b, dh, 0), axis, perm_bwd)
+        return (fwd_state, cot_state, buf, dparams_acc, dextra_acc,
+                dx_acc, loss_acc), None
+
+    carry0 = (
+        jnp.zeros(mb_shape, x.dtype),             # fwd_state
+        jnp.zeros(mb_shape, x.dtype),             # cot_state
+        jnp.zeros((B_buf,) + mb_shape, x.dtype),  # residual ring buffer
+        zero_like_params,                         # dparams
+        zero_extra,                               # head grads
+        jnp.zeros_like(x),                        # dx
+        jnp.zeros((), jnp.float32),               # loss sum
+    )
+    (fs, cs, buf, dparams, dextra, dx, loss), _ = lax.scan(
+        tick, carry0, jnp.arange(M + 2 * (S - 1)))
+    # loss/extra live on the last stage, dx on stage 0 — share them
+    loss = lax.psum(loss * (stage == S - 1).astype(loss.dtype), axis)
+    dextra = jax.tree.map(
+        lambda g: lax.psum(
+            g * (stage == S - 1).astype(g.dtype), axis), dextra)
+    dx = lax.psum(dx * (stage == 0).astype(dx.dtype), axis)
+    return loss, dparams, dx, dextra

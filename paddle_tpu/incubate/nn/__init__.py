@@ -2,7 +2,9 @@
 from . import functional  # noqa: F401
 from .layer.fused_transformer import (FusedMultiHeadAttention,  # noqa: F401
                                       FusedFeedForward,
-                                      FusedTransformerEncoderLayer)
+                                      FusedTransformerEncoderLayer,
+                                      FusedBiasDropoutResidualLayerNorm)
 
 __all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer"]
+           "FusedTransformerEncoderLayer",
+           "FusedBiasDropoutResidualLayerNorm"]

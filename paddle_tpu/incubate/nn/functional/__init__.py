@@ -15,8 +15,11 @@ from typing import Optional
 
 from .... import ops as P
 from ....core.tensor import Tensor, to_tensor
+from ....ops.fused_ops import \
+    fused_bias_dropout_residual_layer_norm  # noqa: F401
 
-__all__ = ["fused_multi_head_attention", "fused_feedforward"]
+__all__ = ["fused_multi_head_attention", "fused_feedforward",
+           "fused_bias_dropout_residual_layer_norm"]
 
 
 def _maybe_ln(x, scale, bias, eps):
@@ -67,13 +70,17 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     ctx = P.reshape(ctx, [B, T, H * Dh])
 
     out = P.matmul(ctx, linear_weight)
+    if not pre_layer_norm:
+        # post-LN epilogue rides the fused pallas kernel (one HBM pass,
+        # reference fused_dropout_helper.h)
+        return fused_bias_dropout_residual_layer_norm(
+            out, residual, bias=linear_bias, ln_scale=ln_scale,
+            ln_bias=ln_bias, dropout_rate=dropout_rate,
+            ln_epsilon=ln_epsilon, training=training)
     if linear_bias is not None:
         out = out + to_tensor(linear_bias)
     out = P.dropout(out, p=dropout_rate, training=training)
-    out = residual + out
-    if not pre_layer_norm:
-        out = _maybe_ln(out, ln_scale, ln_bias, ln_epsilon)
-    return out
+    return residual + out
 
 
 def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
@@ -96,10 +103,12 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     h = act(h)
     h = P.dropout(h, p=dropout1_rate, training=training)
     h = P.matmul(h, to_tensor(linear2_weight))
+    if not pre_layer_norm:
+        return fused_bias_dropout_residual_layer_norm(
+            h, residual, bias=linear2_bias, ln_scale=ln2_scale,
+            ln_bias=ln2_bias, dropout_rate=dropout2_rate,
+            ln_epsilon=ln2_epsilon, training=training)
     if linear2_bias is not None:
         h = h + to_tensor(linear2_bias)
     h = P.dropout(h, p=dropout2_rate, training=training)
-    out = residual + h
-    if not pre_layer_norm:
-        out = _maybe_ln(out, ln2_scale, ln2_bias, ln2_epsilon)
-    return out
+    return residual + h

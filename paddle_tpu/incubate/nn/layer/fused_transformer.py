@@ -142,3 +142,37 @@ class FusedTransformerEncoderLayer(Layer):
 
     def forward(self, src, src_mask=None, cache=None):
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """``LayerNorm(residual + dropout(x + bias))`` as a layer.
+
+    Reference: ``incubate.nn.FusedBiasDropoutResidualLayerNorm`` backed by
+    ``operators/fused/fused_dropout_helper.h``; here one pallas kernel
+    (ops/pallas/fused_ln.py).
+    """
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+    def extra_repr(self):
+        return f"embed_dim={self.embed_dim}, p={self.dropout_rate}"
+
+
+__all__.append("FusedBiasDropoutResidualLayerNorm")

@@ -123,13 +123,20 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
                           num_microbatches: int = 1,
                           learning_rate: float = 1e-3,
                           weight_decay: float = 0.01,
-                          compute_dtype=jnp.float32):
+                          compute_dtype=jnp.float32,
+                          schedule_mode: str = "F-then-B"):
     """Returns (jitted_step, init_fn).
 
     step(params, opt_state, ids, labels) -> (loss, params, opt_state);
     init_fn(seed) -> (params, opt_state) placed onto the mesh.
+
+    ``schedule_mode`` (reference section_worker.cc:62): "F-then-B" runs
+    the fill-drain forward pipeline and lets jax.grad build the backward
+    pipeline (activations O(M)); "1F1B" uses the interleaved
+    spmd_pipeline_1f1b schedule (activations O(num_stages)).
     """
-    from ..distributed.fleet.meta_parallel.spmd_pipeline import spmd_pipeline
+    from ..distributed.fleet.meta_parallel.spmd_pipeline import (
+        spmd_pipeline, spmd_pipeline_1f1b)
 
     pp = mesh.shape.get("pp", 1)
     sp = mesh.shape.get("sp", 1)
@@ -213,8 +220,73 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
             params, m, v)
         return params, {"m": m, "v": v, "step": step}
 
+    def _cast(params):
+        if compute_dtype == jnp.float32:
+            return params
+        return jax.tree.map(
+            lambda a: a.astype(compute_dtype)
+            if a.dtype == jnp.float32 else a, params)
+
+    def loss_and_grads_1f1b(params, ids, labels):
+        """Fused loss+grad via the interleaved 1F1B pipeline (no outer
+        jax.grad: the pipeline carries its own backward)."""
+        cp = _cast(params)
+        B, T = ids.shape
+        D = cfg.hidden_size
+
+        def emb_fn(wte, wpe):
+            x = wte[ids] + wpe[:T][None]
+            return x.reshape(M, B // M, T, D)
+
+        x, emb_vjp = jax.vjp(emb_fn, cp["wte"], cp["wpe"])
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, "dp", sp_axis)))
+        labels_m = labels.reshape(M, B // M, T)
+        x_spec = P(None, None, "sp") if use_sp else P(None)
+        head = {"g": cp["ln_f_g"], "b": cp["ln_f_b"], "w": cp["head_w"]}
+        inv_tokens = 1.0 / float(B * T)
+
+        def run(bp, xi, lab, hp):
+            def last_fn(out_mb, lab_mb):
+                def head_loss(h, o):
+                    z = _layernorm(o, h["g"], h["b"])
+                    logits = (z @ h["w"]).astype(jnp.float32)
+                    logp = jax.nn.log_softmax(logits, axis=-1)
+                    # one-hot contraction, not take_along_axis: a gather
+                    # on mp-sharded logits inside the manual-pp region
+                    # trips XLA's SPMD partitioner (CHECK failure in
+                    # PartitionGather); the contraction partitions clean
+                    onehot = jax.nn.one_hot(lab_mb, logits.shape[-1],
+                                            dtype=logp.dtype)
+                    nll = -jnp.sum(logp * onehot, axis=-1)
+                    return jnp.sum(nll) * inv_tokens
+                loss, (dh, dout) = jax.value_and_grad(
+                    head_loss, argnums=(0, 1))(hp, out_mb)
+                return loss, dout, dh
+            return spmd_pipeline_1f1b(
+                jax.checkpoint(block_fn), bp, xi, lab, last_fn,
+                axis="pp", num_stages=pp, num_microbatches=M)
+
+        loss, dblocks, dx, dhead = jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(P("pp"), x_spec, P(None), P()),
+            out_specs=(P(), P("pp"), x_spec, P()),
+            axis_names={"pp"} | ({"sp"} if use_sp else set()),
+            check_vma=False)(cp["blocks"], x, labels_m, head)
+        dwte, dwpe = emb_vjp(dx)
+        grads = {"wte": dwte, "wpe": dwpe, "blocks": dblocks,
+                 "ln_f_g": dhead["g"], "ln_f_b": dhead["b"],
+                 "head_w": dhead["w"]}
+        # master-weight update path expects f32 grads
+        grads = jax.tree.map(
+            lambda g, p: g.astype(p.dtype), grads, params)
+        return loss, grads
+
     def step(params, opt_state, ids, labels):
-        loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+        if use_pp and schedule_mode == "1F1B":
+            loss, grads = loss_and_grads_1f1b(params, ids, labels)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
         params, opt_state = adamw_update(params, grads, opt_state)
         return loss, params, opt_state
 

@@ -22,10 +22,11 @@ from .nn_misc import *  # noqa: F401,F403
 from .amp_ops import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
+from .fused_ops import *  # noqa: F401,F403
 
 from . import creation, math, reduction, manipulation, logic, linalg, \
     activation, conv, norm_ops, loss, nn_misc, amp_ops, extras, \
-    sequence  # noqa: F401
+    sequence, fused_ops  # noqa: F401
 
 from ..core.tensor import Tensor
 from ..core import dispatch as _dispatch_mod

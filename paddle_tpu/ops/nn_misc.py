@@ -157,13 +157,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if has_mask:
         tensors.append(to_tensor(attn_mask))
     dkey = default_generator.next_key() if (dropout_p > 0.0 and training) else None
-    impl = get_kernel("scaled_dot_product_attention")
-
-    import functools
-    fn = functools.partial(impl, causal=is_causal, scale=scale,
-                           dropout_p=dropout_p if training else 0.0,
-                           dropout_key=dkey, has_mask=has_mask)
-    return dispatch("scaled_dot_product_attention", fn, tensors, {})
+    # pass the registered xla kernel + static attrs through dispatch's
+    # kwargs — dispatch itself swaps in the pallas registration when
+    # preferred_backend() says so (core/dispatch.py)
+    impl = get_kernel("scaled_dot_product_attention", "xla")
+    return dispatch("scaled_dot_product_attention", impl, tensors,
+                    dict(causal=is_causal, scale=scale,
+                         dropout_p=dropout_p if training else 0.0,
+                         dropout_key=dkey, has_mask=has_mask))
 
 
 def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
