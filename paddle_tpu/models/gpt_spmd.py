@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .gpt import GPTConfig
@@ -110,6 +111,7 @@ def make_block_fn(cfg: GPTConfig, sp_axis: Optional[str] = None):
             ctx = ring_attention(q, k, v, sp_axis, causal=True)
         else:
             ctx = flash_attention(q, k, v, causal=True)  # (B, T, h, hd)
+        ctx = checkpoint_name(ctx, "attn_ctx")
         ctx = ctx.reshape(B, T, D)
         x = x + ctx @ p["out_w"] + p["out_b"]
         y = _layernorm(x, p["ln2_g"], p["ln2_b"])
@@ -124,7 +126,10 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
                           learning_rate: float = 1e-3,
                           weight_decay: float = 0.01,
                           compute_dtype=jnp.float32,
-                          schedule_mode: str = "F-then-B"):
+                          schedule_mode: str = "F-then-B",
+                          sharding_stage: int = 1,
+                          offload: bool = False,
+                          remat_policy: str = "full"):
     """Returns (jitted_step, init_fn).
 
     step(params, opt_state, ids, labels) -> (loss, params, opt_state);
@@ -134,15 +139,46 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
     the fill-drain forward pipeline and lets jax.grad build the backward
     pipeline (activations O(M)); "1F1B" uses the interleaved
     spmd_pipeline_1f1b schedule (activations O(num_stages)).
+
+    ``sharding_stage``/``offload`` (reference sharding_optimizer.py:45 +
+    offload_helper.py): ZeRO over the mesh's ``sharding`` axis — see
+    fleet/meta_optimizers/zero.py.  The sharding axis co-shards the
+    global batch (reference hybrid topology [dp, pp, sharding, mp]).
     """
     from ..distributed.fleet.meta_parallel.spmd_pipeline import (
         spmd_pipeline, spmd_pipeline_1f1b)
+    from ..distributed.fleet.meta_optimizers.zero import (
+        shard_tree, zero_state_shardings)
 
     pp = mesh.shape.get("pp", 1)
     sp = mesh.shape.get("sp", 1)
+    sharding_n = mesh.shape.get("sharding", 1)
     use_pp, use_sp = pp > 1, sp > 1
+    use_zero = sharding_n > 1
+    batch_axes = ("dp", "sharding") if use_zero else "dp"
     sp_axis = "sp" if use_sp else None
     block_fn = make_block_fn(cfg, sp_axis=sp_axis)
+
+    # remat policy (reference recompute_optimizer checkpoints attr):
+    #   full — recompute everything in backward (min HBM, +1/3 flops)
+    #   ctx  — save each block's attention output: the backward skips the
+    #          second flash-attention forward (the costliest recompute)
+    #   dots — save all matmul outputs (XLA's dots_saveable)
+    #   none — no remat: XLA keeps what backward needs (max HBM)
+    if remat_policy == "none":
+        def maybe_remat(f):
+            return f
+    elif remat_policy == "ctx":
+        def maybe_remat(f):
+            return jax.checkpoint(
+                f, policy=jax.checkpoint_policies.save_only_these_names(
+                    "attn_ctx"))
+    elif remat_policy == "dots":
+        def maybe_remat(f):
+            return jax.checkpoint(
+                f, policy=jax.checkpoint_policies.dots_saveable)
+    else:
+        maybe_remat = jax.checkpoint
     M = num_microbatches
     L = cfg.num_layers
     if use_pp and L % pp != 0:
@@ -161,13 +197,13 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
             # dp, sequence over sp (ring attention inside the blocks)
             xm = x.reshape(M, B // M, T, cfg.hidden_size)
             xm = lax.with_sharding_constraint(
-                xm, NamedSharding(mesh, P(None, "dp", sp_axis)))
+                xm, NamedSharding(mesh, P(None, batch_axes, sp_axis)))
             x_spec = P(None, None, "sp") if use_sp else P(None)
 
             def piped(bp, xi):
                 # remat per block here too — same HBM posture as the
                 # non-pipelined scan branch below
-                return spmd_pipeline(jax.checkpoint(block_fn), bp, xi,
+                return spmd_pipeline(maybe_remat(block_fn), bp, xi,
                                      axis="pp", num_stages=pp,
                                      num_microbatches=M)
 
@@ -182,7 +218,7 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
             # attention inside; blocks scanned locally
             def seq_par(bp, xi):
                 def body(h, p):
-                    return jax.checkpoint(block_fn)(p, h), None
+                    return maybe_remat(block_fn)(p, h), None
                 h, _ = lax.scan(body, xi, bp)
                 return h
             x = jax.shard_map(
@@ -194,7 +230,7 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
             # backward recomputes (reference recompute_optimizer default
             # posture — HBM is the bottleneck, MXU flops are cheap)
             def body(h, p):
-                return jax.checkpoint(block_fn)(p, h), None
+                return maybe_remat(block_fn)(p, h), None
             x, _ = lax.scan(body, x, params["blocks"])
         x = _layernorm(x, params["ln_f_g"], params["ln_f_b"])
         return x @ params["head_w"]
@@ -240,7 +276,7 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
 
         x, emb_vjp = jax.vjp(emb_fn, cp["wte"], cp["wpe"])
         x = lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(None, "dp", sp_axis)))
+            x, NamedSharding(mesh, P(None, batch_axes, sp_axis)))
         labels_m = labels.reshape(M, B // M, T)
         x_spec = P(None, None, "sp") if use_sp else P(None)
         head = {"g": cp["ln_f_g"], "b": cp["ln_f_b"], "w": cp["head_w"]}
@@ -264,7 +300,7 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
                     head_loss, argnums=(0, 1))(hp, out_mb)
                 return loss, dout, dh
             return spmd_pipeline_1f1b(
-                jax.checkpoint(block_fn), bp, xi, lab, last_fn,
+                maybe_remat(block_fn), bp, xi, lab, last_fn,
                 axis="pp", num_stages=pp, num_microbatches=M)
 
         loss, dblocks, dx, dhead = jax.shard_map(
@@ -282,22 +318,59 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
             lambda g, p: g.astype(p.dtype), grads, params)
         return loss, grads
 
+    base_shardings = gpt_param_shardings(mesh, cfg)
+    shapes = jax.tree.map(
+        lambda a: a.shape, init_gpt_params(cfg, jax.random.PRNGKey(0)))
+    if use_zero:
+        shardings, state_shardings = zero_state_shardings(
+            base_shardings, shapes, stage=sharding_stage, offload=offload)
+        grad_shardings = shard_tree(base_shardings, shapes) \
+            if sharding_stage >= 2 else None
+        state_dev = shard_tree(base_shardings, shapes) if offload else None
+    else:
+        shardings, state_shardings = base_shardings, base_shardings
+        grad_shardings, state_dev = None, None
+
     def step(params, opt_state, ids, labels):
         if use_pp and schedule_mode == "1F1B":
             loss, grads = loss_and_grads_1f1b(params, ids, labels)
         else:
             loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+        if grad_shardings is not None:
+            # ZeRO-2: constrain grads to the sharded layout — GSPMD turns
+            # the data-parallel gradient all-reduce into a reduce-scatter
+            grads = jax.tree.map(lax.with_sharding_constraint, grads,
+                                 grad_shardings)
+        if offload:
+            # ZeRO offload: state lives in pinned host RAM between steps
+            mv = jax.device_put({"m": opt_state["m"], "v": opt_state["v"]},
+                                {"m": state_dev, "v": state_dev})
+            opt_state = {**opt_state, **mv}
         params, opt_state = adamw_update(params, grads, opt_state)
+        if use_zero and sharding_stage < 3:
+            params = jax.tree.map(lax.with_sharding_constraint, params,
+                                  shardings)
+        if offload:
+            mv = jax.device_put({"m": opt_state["m"], "v": opt_state["v"]},
+                                {"m": state_shardings,
+                                 "v": state_shardings})
+            opt_state = {**opt_state, **mv}
         return loss, params, opt_state
-
-    shardings = gpt_param_shardings(mesh, cfg)
 
     def init_fn(seed: int = 0):
         params = init_gpt_params(cfg, jax.random.PRNGKey(seed))
         params = jax.tree.map(jax.device_put, params, shardings)
-        opt_state = {"m": jax.tree.map(jnp.zeros_like, params),
-                     "v": jax.tree.map(jnp.zeros_like, params),
-                     "step": jnp.zeros((), jnp.int32)}
+        opt_state = {
+            "m": jax.tree.map(
+                lambda a, ns: jax.device_put(jnp.zeros_like(a), ns),
+                params, state_shardings),
+            "v": jax.tree.map(
+                lambda a, ns: jax.device_put(jnp.zeros_like(a), ns),
+                params, state_shardings),
+            "step": jnp.zeros((), jnp.int32)}
         return params, opt_state
 
-    return jax.jit(step, donate_argnums=(0, 1)), init_fn
+    # offload: opt_state lives in pinned host memory — XLA cannot alias
+    # host-memory inputs onto device-memory outputs, so skip its donation
+    donate = (0,) if offload else (0, 1)
+    return jax.jit(step, donate_argnums=donate), init_fn

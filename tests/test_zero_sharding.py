@@ -1,0 +1,102 @@
+"""ZeRO-2/3 sharding + offload tests (fleet/meta_optimizers/zero.py).
+
+Reference parity: ``fleet/meta_optimizers/sharding_optimizer.py:45,568``
+and ``sharding/offload_helper.py``; correctness net mirrors the
+reference's meta-optimizer golden tests
+(``test_fleet_sharding_meta_optimizer.py`` asserts on generated op
+sequences — here we assert on the compiled HLO and on the placement
+specs, same idea one level down).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.topology import build_mesh
+from paddle_tpu.models import GPTConfig
+from paddle_tpu.models.gpt_spmd import build_spmd_train_step
+
+CFG = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4, num_heads=2,
+                max_seq_len=16, ffn_mult=2)
+RS = np.random.RandomState(0)
+IDS = jnp.asarray(RS.randint(0, 128, (8, 16)), jnp.int32)
+LABELS = jnp.asarray(RS.randint(0, 128, (8, 16)), jnp.int32)
+
+
+@pytest.fixture(scope="module")
+def dp8_result():
+    mesh = build_mesh({"dp": 8})
+    step, init = build_spmd_train_step(CFG, mesh)
+    p, s = init(seed=0)
+    loss, pn, _ = step(p, s, IDS, LABELS)
+    return float(loss), jax.tree.leaves(jax.device_get(pn))
+
+
+@pytest.mark.parametrize("stage,offload", [(1, False), (2, False),
+                                           (2, True), (3, False)])
+def test_zero_stage_parity(dp8_result, stage, offload):
+    """Every stage gives the same loss/updates as plain dp8 (the sharding
+    axis co-shards the batch, so the math is identical)."""
+    l0, leaves0 = dp8_result
+    mesh = build_mesh({"dp": 2, "sharding": 4})
+    step, init = build_spmd_train_step(CFG, mesh, sharding_stage=stage,
+                                       offload=offload)
+    p, s = init(seed=0)
+    loss, pn, sn = step(p, s, IDS, LABELS)
+    assert abs(float(loss) - l0) < 1e-5
+    err = max(float(jnp.abs(a - b).max()) for a, b in
+              zip(leaves0, jax.tree.leaves(jax.device_get(pn))))
+    # adam's g/(sqrt(v)+eps) amplifies summation-order noise near g=0
+    assert err < 5e-3
+    # state is sharded over the sharding axis
+    mspec = sn["m"]["blocks"]["qkv_w"].sharding.spec
+    assert "sharding" in tuple(mspec)
+    # params sharded only at stage 3
+    pspec = tuple(pn["blocks"]["qkv_w"].sharding.spec)
+    assert ("sharding" in pspec) == (stage >= 3)
+    # second step consumes the produced state (round-trips host memory
+    # when offloaded)
+    l2, _, _ = step(pn, sn, IDS, LABELS)
+    assert float(l2) < float(loss)
+
+
+def test_zero2_program_shards_gradients():
+    """Golden program check (reference meta-optimizer tests assert on
+    generated op sequences): stage 2 adds one sharding constraint per
+    gradient leaf to the lowered program — the annotation GSPMD turns
+    into a reduce-scatter on TPU (XLA:CPU lowers it as
+    all-reduce+dynamic-slice, so we assert on the program, not the
+    backend's collective choice)."""
+    mesh = build_mesh({"dp": 2, "sharding": 4})
+    counts = {}
+    for stage in (1, 2):
+        step, init = build_spmd_train_step(CFG, mesh, sharding_stage=stage)
+        p, s = init(seed=0)
+        txt = jax.jit(lambda p, s: step(p, s, IDS, LABELS)) \
+            .lower(p, s).as_text()
+        counts[stage] = txt.count("sdy.sharding_constraint")
+    n_params = len(jax.tree.leaves(
+        build_spmd_train_step(CFG, mesh)[1](seed=0)[0]))
+    assert counts[2] >= counts[1] + n_params
+
+
+def test_offload_state_in_host_memory():
+    mesh = build_mesh({"dp": 2, "sharding": 4})
+    _, init = build_spmd_train_step(CFG, mesh, sharding_stage=2,
+                                    offload=True)
+    _, s = init(seed=0)
+    kinds = {a.sharding.memory_kind
+             for a in jax.tree.leaves(s["m"])}
+    assert kinds == {"pinned_host"}
+
+
+def test_stage3_per_device_param_bytes_shrink():
+    """Stage 3 shards params: per-device bytes for a sharded param are
+    1/sharding_degree of the full array."""
+    mesh = build_mesh({"dp": 2, "sharding": 4})
+    _, init3 = build_spmd_train_step(CFG, mesh, sharding_stage=3)
+    p3, _ = init3(seed=0)
+    qkv = p3["blocks"]["qkv_w"]
+    # sharded 4-way over 'sharding' (dp replicates): each device holds 1/4
+    assert qkv.addressable_shards[0].data.size * 4 == qkv.size
