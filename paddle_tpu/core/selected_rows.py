@@ -1,0 +1,79 @@
+"""SelectedRows — row-sparse gradient representation.
+
+Reference parity: ``paddle/fluid/framework/selected_rows.h`` — the
+(rows, value) pair an embedding backward produces so a large-vocab
+lookup table never materialises a dense (V, D) gradient, consumed by the
+sparse branches of the optimizer ops
+(``operators/optimizers/adam_op.h``) and by the parameter-server
+push_sparse path.
+
+TPU translation: an IndexedSlices-style pair of device arrays.  Rows may
+repeat (one entry per lookup); ``merge()`` concatenates lazily and
+``merged()`` segment-sums duplicates — the reference's
+``scatter::MergeAdd`` — before an optimizer consumes the slices.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    __slots__ = ("rows", "values", "dense_shape")
+
+    def __init__(self, rows, values, dense_shape: Tuple[int, ...]):
+        self.rows = jnp.asarray(rows).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.dense_shape = tuple(int(s) for s in dense_shape)
+        assert self.values.shape[0] == self.rows.shape[0], (
+            self.values.shape, self.rows.shape)
+        assert self.values.shape[1:] == self.dense_shape[1:], (
+            self.values.shape, self.dense_shape)
+
+    @property
+    def shape(self):
+        return list(self.dense_shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def merge(self, other: "SelectedRows") -> "SelectedRows":
+        """Lazy accumulation: concatenate slices (grad accumulation
+        across backward calls / multiple lookups of one table)."""
+        assert self.dense_shape == other.dense_shape
+        return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.dense_shape)
+
+    def merged(self) -> "SelectedRows":
+        """Reference scatter::MergeAdd — unique rows, duplicate slices
+        summed.  Host-computes the unique set (eager path; data-dependent
+        output size is inherently host-side, like the reference)."""
+        rows_np = np.asarray(self.rows)
+        uniq, inverse = np.unique(rows_np, return_inverse=True)
+        if uniq.size == rows_np.size:
+            order = np.argsort(rows_np, kind="stable")
+            return SelectedRows(rows_np[order],
+                                self.values[jnp.asarray(order)],
+                                self.dense_shape)
+        summed = jax.ops.segment_sum(self.values,
+                                     jnp.asarray(inverse),
+                                     num_segments=int(uniq.size))
+        return SelectedRows(jnp.asarray(uniq), summed, self.dense_shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def scale(self, s) -> "SelectedRows":
+        return SelectedRows(self.rows, self.values * s, self.dense_shape)
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={self.rows.shape[0]}, "
+                f"dense_shape={self.dense_shape})")

@@ -145,7 +145,10 @@ class Tensor:
 
     def clear_gradient(self, set_to_zero: bool = False):
         if set_to_zero and self.grad is not None:
-            self.grad = Tensor(jnp.zeros_like(self.grad._data), stop_gradient=True)
+            # works for Tensor and SelectedRows grads alike
+            self.grad = Tensor(jnp.zeros(tuple(self.grad.shape),
+                                         self.grad.dtype),
+                               stop_gradient=True)
         else:
             self.grad = None
 
@@ -163,9 +166,22 @@ class Tensor:
         return _Handle()
 
     def _accumulate_grad(self, g):
+        from .selected_rows import SelectedRows
+        if isinstance(g, SelectedRows):
+            # row-sparse grad (reference SelectedRows accumulation)
+            if self.grad is None:
+                self.grad = g
+            elif isinstance(self.grad, SelectedRows):
+                self.grad = self.grad.merge(g)
+            else:
+                self.grad = Tensor(self.grad._data + g.to_dense(),
+                                   stop_gradient=True)
+            return
         g = jnp.asarray(g)
         if self.grad is None:
             self.grad = Tensor(g, stop_gradient=True)
+        elif isinstance(self.grad, SelectedRows):
+            self.grad = Tensor(self.grad.to_dense() + g, stop_gradient=True)
         else:
             self.grad = Tensor(self.grad._data + g, stop_gradient=True)
 

@@ -283,6 +283,7 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
         self.prefetch_factor = max(2, prefetch_factor)
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -325,12 +326,175 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
+        if self._process_workers_available():
+            yield from self._prefetch_iter_process()
+            return
         if self.use_shared_memory:
             from .. import native
             if native.available():
                 yield from self._prefetch_iter_native()
                 return
         yield from self._prefetch_iter()
+
+    def _process_workers_available(self):
+        """Process workers need fork (dataset/collate inherit without
+        pickling).  PADDLE_TPU_THREAD_WORKERS=1 forces the thread path
+        (the reference's use_shared_memory=False analog at process
+        level)."""
+        import multiprocessing as mp
+        import os as _os
+        if _os.environ.get("PADDLE_TPU_THREAD_WORKERS") == "1":
+            return False
+        return "fork" in mp.get_all_start_methods()
+
+    # -- multiprocess workers (reference dataloader_iter.py:320,381) ------
+    @staticmethod
+    def _pack(obj, arrays):
+        """Replace ndarrays in a nested structure with placeholders;
+        collect the arrays (the worker-side half of the shared-memory
+        transport, reference mmap_allocator.h)."""
+        if isinstance(obj, Tensor):
+            obj = np.asarray(obj._data)
+        if isinstance(obj, np.ndarray):
+            arrays.append(np.ascontiguousarray(obj))
+            return ("__arr__", len(arrays) - 1)
+        if isinstance(obj, tuple):
+            return ("__tuple__",
+                    [DataLoader._pack(o, arrays) for o in obj])
+        if isinstance(obj, list):
+            return ("__list__",
+                    [DataLoader._pack(o, arrays) for o in obj])
+        if isinstance(obj, dict):
+            return ("__dict__",
+                    {k: DataLoader._pack(v, arrays) for k, v in obj.items()})
+        return ("__leaf__", obj)
+
+    @staticmethod
+    def _unpack(node, arrays):
+        tag, payload = node
+        if tag == "__arr__":
+            # copy out: jnp.asarray is zero-copy on the CPU backend and
+            # would alias the (about to be unlinked) shm segment
+            return to_tensor(np.array(arrays[payload]))
+        if tag == "__tuple__":
+            return tuple(DataLoader._unpack(o, arrays) for o in payload)
+        if tag == "__list__":
+            return [DataLoader._unpack(o, arrays) for o in payload]
+        if tag == "__dict__":
+            return {k: DataLoader._unpack(v, arrays)
+                    for k, v in payload.items()}
+        return payload
+
+    def _prefetch_iter_process(self):
+        """Fork worker processes; batches return through POSIX shared
+        memory (one segment per batch — the TPU-host translation of the
+        reference's mmap allocator + _worker_loop,
+        ``fluid/dataloader/dataloader_iter.py:320,381``,
+        ``memory/allocation/mmap_allocator.h``).  Heavy pure-Python
+        transforms scale past the GIL this way; the thread paths remain
+        as fallback."""
+        import multiprocessing as mp
+        import pickle
+        import traceback
+        from multiprocessing import shared_memory
+
+        ctx = mp.get_context("fork")
+        batches = list(self.batch_sampler)
+        task_q = ctx.Queue()
+        result_q = ctx.Queue()
+        for i in range(len(batches)):
+            task_q.put(i)
+        for _ in range(self.num_workers):
+            task_q.put(None)
+        use_shm = self.use_shared_memory
+
+        def worker_loop(wid):
+            _worker_info.info = _WorkerInfo(wid, self.num_workers,
+                                            self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            while True:
+                i = task_q.get()
+                if i is None:
+                    break
+                try:
+                    arrays: list = []
+                    structure = DataLoader._pack(self._fetch(batches[i]),
+                                                 arrays)
+                    if use_shm:
+                        total = max(1, sum(a.nbytes for a in arrays))
+                        seg = shared_memory.SharedMemory(create=True,
+                                                         size=total)
+                        metas, off = [], 0
+                        for a in arrays:
+                            seg.buf[off:off + a.nbytes] = a.tobytes()
+                            metas.append((a.dtype.str, a.shape, off,
+                                          a.nbytes))
+                            off += a.nbytes
+                        result_q.put((i, ("shm", seg.name, metas,
+                                          pickle.dumps(structure)), None))
+                        # the parent unlinks; stop this process's
+                        # resource tracker from double-freeing it
+                        try:
+                            from multiprocessing import resource_tracker
+                            resource_tracker.unregister(
+                                seg._name, "shared_memory")
+                        except Exception:
+                            pass
+                        seg.close()
+                    else:
+                        result_q.put((i, ("pickle", pickle.dumps(
+                            (structure, arrays))), None))
+                except BaseException:
+                    result_q.put((i, None, traceback.format_exc()))
+
+        procs = [ctx.Process(target=worker_loop, args=(w,), daemon=True)
+                 for w in range(self.num_workers)]
+        for pr in procs:
+            pr.start()
+
+        def decode(payload):
+            if payload[0] == "shm":
+                _, name, metas, sbytes = payload
+                seg = shared_memory.SharedMemory(name=name)
+                try:
+                    arrays = [np.frombuffer(
+                        seg.buf, dtype=np.dtype(d),
+                        count=int(np.prod(shp)) if shp else 1,
+                        offset=off).reshape(shp)
+                        for d, shp, off, _ in metas]
+                    # to_tensor copies onto device; drop the mmap views
+                    # before close() or the segment can't be released
+                    out = DataLoader._unpack(pickle.loads(sbytes), arrays)
+                finally:
+                    del arrays
+                    seg.close()
+                    try:
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+                return out
+            _, blob = payload
+            structure, arrays = pickle.loads(blob)
+            return DataLoader._unpack(structure, arrays)
+
+        try:
+            pending: dict = {}
+            for i in range(len(batches)):
+                while i not in pending:
+                    j, payload, err = result_q.get(
+                        timeout=self.timeout or 300)
+                    pending[j] = (payload, err)
+                payload, err = pending.pop(i)
+                if err is not None:
+                    raise RuntimeError(
+                        f"DataLoader worker failed on batch {i}:\n{err}")
+                yield decode(payload)
+        finally:
+            for pr in procs:
+                pr.terminate()
+            for pr in procs:
+                pr.join(timeout=5)
 
     def _prefetch_iter_native(self):
         """Prefetch through the native C++ BlockingQueue: batches travel

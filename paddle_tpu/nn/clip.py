@@ -78,7 +78,13 @@ class ClipGradByGlobalNorm(ClipGradBase):
         return jnp.minimum(self.clip_norm / jnp.maximum(total, 1e-12), 1.0)
 
     def __call__(self, params_grads):
-        clippable = [g._data for p, g in params_grads
+        from ..core.selected_rows import SelectedRows
+
+        def arr(g):
+            # SelectedRows contribute their slice values to the global
+            # norm (reference merges SelectedRows before clipping)
+            return g.values if isinstance(g, SelectedRows) else g._data
+        clippable = [arr(g) for p, g in params_grads
                      if g is not None and getattr(p, "need_clip", True)]
         scale = self._scale(clippable)
         if scale is None:
@@ -87,6 +93,8 @@ class ClipGradByGlobalNorm(ClipGradBase):
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+            elif isinstance(g, SelectedRows):
+                out.append((p, g.scale(scale.astype(g.values.dtype))))
             else:
                 out.append((p, Tensor((g._data.astype(jnp.float32) * scale)
                                       .astype(g._data.dtype))))

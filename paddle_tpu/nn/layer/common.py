@@ -51,6 +51,7 @@ class Embedding(Layer):
         super().__init__()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
+        self._sparse = sparse
         self._padding_idx = padding_idx if padding_idx is None or \
             padding_idx >= 0 else num_embeddings + padding_idx
         wa = ParamAttr._to_attr(weight_attr)
@@ -65,7 +66,9 @@ class Embedding(Layer):
     def forward(self, x):
         return ops.nn_misc.embedding(x, self.weight,
                                      padding_idx=self._padding_idx
-                                     if self._padding_idx is not None else None)
+                                     if self._padding_idx is not None
+                                     else None,
+                                     sparse=self._sparse)
 
 
 class Dropout(Layer):

@@ -33,6 +33,38 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             mask = (idx != padding_idx)[..., None]
             out = out * mask.astype(out.dtype)
         return out
+
+    from ..core import autograd as _ag
+    if sparse and _ag.is_grad_enabled() and not weight.stop_gradient \
+            and not isinstance(weight._data, jax.core.Tracer):
+        # SelectedRows backward (reference selected_rows.h +
+        # lookup_table_v2_op grad is_sparse branch): the weight gradient
+        # is (rows=ids, values=cotangent slices) — the dense (V, D) grad
+        # never materialises.  Eager-only: under jit the dense path's
+        # scatter-add fuses anyway.
+        from ..core.selected_rows import SelectedRows
+        ids = x._data
+        out_arr = impl(ids, weight._data)
+        D = weight.shape[1]
+        V = weight.shape[0]
+
+        def vjp_fn(cot):
+            rows = ids.reshape(-1)
+            vals = jnp.asarray(cot).reshape(-1, D)
+            if padding_idx is not None and padding_idx >= 0:
+                keep = (rows != padding_idx)[:, None]
+                vals = vals * keep.astype(vals.dtype)
+            import numpy as _np
+            gx = _np.zeros(ids.shape, jax.dtypes.float0)
+            return gx, SelectedRows(rows, vals, (V, D))
+
+        node = _ag.GradNode("embedding_sparse_grad", vjp_fn, [x, weight],
+                            [False, True],
+                            [(out_arr.shape, out_arr.dtype)], False)
+        t = Tensor(out_arr, stop_gradient=False)
+        t._grad_node = node
+        t._output_index = 0
+        return t
     return dispatch("embedding", impl, (x, weight), {})
 
 

@@ -101,6 +101,14 @@ class Optimizer:
     def _update(self, param, grad, state, lr):
         raise NotImplementedError
 
+    def _update_sparse(self, param, rows, vals, state, lr):
+        """Row-sparse update: rows unique, vals merged.  Default
+        densifies (optimizers without a sparse kernel — reference ops
+        without a SelectedRows specialization do the same)."""
+        dense = jnp.zeros_like(param).at[rows].add(
+            vals.astype(param.dtype))
+        return self._update(param, dense, state, lr)
+
     # -- eager step --------------------------------------------------------
     @autograd.no_grad()
     def step(self):
@@ -112,10 +120,34 @@ class Optimizer:
                if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
             pgs = self._grad_clip(pgs)
+        from ..core.selected_rows import SelectedRows
         for p, g in pgs:
             if g is None:
                 continue
             state = self._slot(p)
+            if isinstance(g, SelectedRows):
+                # row-sparse update (reference adam_op.h sparse branch /
+                # sgd_op SelectedRows kernel): only touched rows move
+                key = id(p)
+                parr = self._master_weights.get(key, p._data)
+                sr = g.merged()
+                vals = sr.values.astype(parr.dtype)
+                lr_eff = lr * p.optimize_attr.get("learning_rate", 1.0)
+                reg = p.regularizer if p.regularizer is not None \
+                    else (self._weight_decay_reg
+                          if self._coupled_weight_decay else None)
+                if reg is not None and getattr(reg, "coeff", 0.0):
+                    vals = vals + reg.grad(parr[sr.rows])
+                self._current_param_name = p.name or ""
+                new_p, new_state = self._update_sparse(
+                    parr, sr.rows, vals, state, lr_eff)
+                if key in self._master_weights:
+                    self._master_weights[key] = new_p
+                    p._data = new_p.astype(p._data.dtype)
+                else:
+                    p._data = new_p
+                self._state[key] = new_state
+                continue
             garr = g._data if isinstance(g, Tensor) else g
             key = id(p)
             parr = self._master_weights.get(key, p._data)
@@ -290,6 +322,10 @@ class SGD(Optimizer):
     def _update(self, param, grad, state, lr):
         return param - lr * grad, state
 
+    def _update_sparse(self, param, rows, vals, state, lr):
+        # reference sgd_op.h SelectedRows kernel: scatter-sub touched rows
+        return param.at[rows].add(-lr * vals), state
+
 
 class Momentum(Optimizer):
     """reference operators/optimizers/momentum_op.h (+nesterov)."""
@@ -391,6 +427,22 @@ class Adam(Optimizer):
                                            "beta1_pow": b1p,
                                            "beta2_pow": b2p}
 
+    def _update_sparse(self, param, rows, vals, state, lr):
+        """Lazy-mode sparse Adam (reference adam_op.h SparseAdamFunctor,
+        lazy_mode=True rows-only semantics): moments and param move only
+        on touched rows; bias-correction powers advance globally."""
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m1r = b1 * state["moment1"][rows] + (1 - b1) * vals
+        m2r = b2 * state["moment2"][rows] + (1 - b2) * jnp.square(vals)
+        lr_t = (lr * jnp.sqrt(1 - b2p) / (1 - b1p)).astype(param.dtype)
+        upd = lr_t * m1r / (jnp.sqrt(m2r) + eps)
+        new_p = param.at[rows].add(-upd.astype(param.dtype))
+        return new_p, {"moment1": state["moment1"].at[rows].set(m1r),
+                       "moment2": state["moment2"].at[rows].set(m2r),
+                       "beta1_pow": b1p, "beta2_pow": b2p}
+
 
 class AdamW(Adam):
     """Decoupled weight decay (reference adamw semantics:
@@ -419,6 +471,12 @@ class AdamW(Adam):
             if self._wd_for_current else param
         return super()._update(decayed, grad, state, lr)
 
+    def _update_sparse(self, param, rows, vals, state, lr):
+        # lazy semantics: decoupled decay only on touched rows
+        if self._wd_for_current:
+            param = param.at[rows].mul(1.0 - lr * self._wd_for_current)
+        return super()._update_sparse(param, rows, vals, state, lr)
+
     # plumbing: _wd_for_current set per-param so apply_decay_param_fun works
     _wd_for_current = 0.0
 
@@ -432,14 +490,27 @@ class AdamW(Adam):
                if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
             pgs = self._grad_clip(pgs)
+        from ..core.selected_rows import SelectedRows
         for p, g in pgs:
             state = self._slot(p)
             key = id(p)
             parr = self._master_weights.get(key, p._data)
-            garr = (g._data if isinstance(g, Tensor) else g).astype(parr.dtype)
             self._wd_for_current = self._weight_decay if \
                 self._should_decay(p.name) else 0.0
             lr_eff = lr * p.optimize_attr.get("learning_rate", 1.0)
+            if isinstance(g, SelectedRows):
+                sr = g.merged()
+                new_p, new_state = self._update_sparse(
+                    parr, sr.rows, sr.values.astype(parr.dtype), state,
+                    lr_eff)
+                if key in self._master_weights:
+                    self._master_weights[key] = new_p
+                    p._data = new_p.astype(p._data.dtype)
+                else:
+                    p._data = new_p
+                self._state[key] = new_state
+                continue
+            garr = (g._data if isinstance(g, Tensor) else g).astype(parr.dtype)
             new_p, new_state = self._update(parr, garr, state, lr_eff)
             if key in self._master_weights:
                 self._master_weights[key] = new_p
