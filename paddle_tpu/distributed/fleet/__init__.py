@@ -44,7 +44,10 @@ __all__ = [
     "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
     "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc",
     "PipelineLayer", "PipelineParallel", "recompute",
+    "DistributedEmbedding",
 ]
+
+from .ps_layers import DistributedEmbedding  # noqa: E402
 
 _hcg: Optional[HybridCommunicateGroup] = None
 _strategy: Optional[DistributedStrategy] = None
@@ -141,6 +144,7 @@ def barrier_worker():
 # run_server / init_worker / stop_worker; backed by the ps.py shim) -------
 _ps_server = None
 _ps_client = None
+_communicator = None
 
 
 def init_server(*model_paths, **kwargs):
@@ -176,18 +180,41 @@ def run_server():
 
 
 def init_worker():
-    """Connect this trainer to the PS shards (reference init_worker)."""
-    global _ps_client
-    from .ps import PSClient, role_from_env
+    """Connect this trainer to the PS shards (reference init_worker).
+
+    The sync mode is chosen from the strategy passed to ``fleet.init``
+    (reference parameter_server_optimizer mode selection):
+    ``a_sync=False`` -> sync pushes; ``a_sync=True`` -> a background
+    AsyncCommunicator; ``a_sync_configs['k_steps'] > 0`` -> geo-SGD
+    delta sync every k steps.  Returns the ps.Communicator (which
+    forwards pull/push, so existing PSClient call sites keep working).
+    """
+    global _ps_client, _communicator
+    from .ps import Communicator, PSClient, role_from_env
     _, eps, _ = role_from_env()
     if not eps:
         raise RuntimeError("init_worker needs PADDLE_PSERVERS_IP_PORT_LIST")
     _ps_client = PSClient(eps)
-    return _ps_client
+    strategy = _strategy if _strategy is not None else None
+    mode, k_steps = "sync", 0
+    if strategy is not None and getattr(strategy, "a_sync", False):
+        cfg = getattr(strategy, "a_sync_configs", {}) or {}
+        k_steps = int(cfg.get("k_steps", 0))
+        mode = "geo" if k_steps > 0 else "async"
+    _communicator = Communicator(_ps_client, mode=mode,
+                                 k_steps=max(1, k_steps))
+    return _communicator
+
+
+def get_communicator():
+    return _communicator
 
 
 def stop_worker():
-    global _ps_client
+    global _ps_client, _communicator
+    if _communicator is not None:
+        _communicator.stop()
+        _communicator = None
     if _ps_client is not None:
         _ps_client.close()
         _ps_client = None
@@ -206,6 +233,7 @@ class _Fleet:
     is_first_worker = staticmethod(is_first_worker)
     barrier_worker = staticmethod(barrier_worker)
     init_server = staticmethod(init_server)
+    get_communicator = staticmethod(get_communicator)
     run_server = staticmethod(run_server)
     init_worker = staticmethod(init_worker)
     stop_worker = staticmethod(stop_worker)

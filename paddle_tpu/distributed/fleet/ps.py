@@ -23,6 +23,7 @@ import os
 import pickle
 import socket
 import socketserver
+import time
 import struct
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -31,7 +32,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["SparseSGDRule", "NaiveSGDRule", "AdagradSGDRule", "DenseTable",
-           "SparseTable", "PSServer", "PSClient", "role_from_env"]
+           "SparseTable", "PSServer", "PSClient", "Communicator", "role_from_env"]
 
 
 # ---------------------------------------------------------------------------
@@ -415,3 +416,145 @@ def role_from_env():
         "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
     tid = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
     return role, eps, tid
+
+
+# ---------------------------------------------------------------------------
+# communicator (async / geo-SGD trainer-side sync engines)
+# ---------------------------------------------------------------------------
+class Communicator:
+    """Background trainer->PS gradient shipping.
+
+    Reference parity: ``distributed/service/communicator.h`` —
+    AsyncCommunicator (queued grads merged and sent by a background
+    thread, decoupling trainer steps from PS round-trips) and
+    GeoCommunicator / ``table/sparse_geo_table.h`` (trainers train local
+    copies and periodically exchange *deltas* with the global table).
+
+    Modes:
+      - ``"sync"``: push_* forwards straight to the client (the existing
+        path; one RPC per step).
+      - ``"async"``: push_* enqueues; a daemon thread merges everything
+        queued (dense grads summed, sparse slices concatenated) and
+        ships batches at ``send_wait_ms`` cadence.
+      - ``"geo"``: ``geo_step(name, local)`` accumulates; every
+        ``k_steps`` the local-vs-synced delta goes to the PS and the
+        fresh global value comes back (applied to the local copy).
+    """
+
+    def __init__(self, client: "PSClient", mode: str = "async",
+                 send_wait_ms: int = 5, k_steps: int = 4,
+                 merge_size: int = 32):
+        assert mode in ("sync", "async", "geo"), mode
+        self._client = client
+        self.mode = mode
+        self._send_wait = send_wait_ms / 1000.0
+        self._k_steps = max(1, int(k_steps))
+        self._merge_size = merge_size
+        self._lock = threading.Lock()
+        self._dense_pending: Dict[str, np.ndarray] = {}
+        self._sparse_pending: Dict[str, list] = {}
+        self._geo_synced: Dict[str, np.ndarray] = {}
+        self._geo_steps: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._flushed = threading.Event()
+        self._flushed.set()
+        self._thread = None
+        if mode == "async":
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    # -- async engine ------------------------------------------------------
+    def push_dense(self, table: str, grad):
+        grad = np.asarray(grad, np.float32)
+        if self.mode != "async":
+            self._client.push_dense(table, grad)
+            return
+        with self._lock:
+            cur = self._dense_pending.get(table)
+            self._dense_pending[table] = grad if cur is None else cur + grad
+            self._flushed.clear()
+
+    def push_sparse(self, table: str, keys, grads):
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        if self.mode != "async":
+            self._client.push_sparse(table, keys, grads)
+            return
+        with self._lock:
+            self._sparse_pending.setdefault(table, []).append((keys, grads))
+            self._flushed.clear()
+
+    def pull_dense(self, table: str):
+        return self._client.pull_dense(table)
+
+    def pull_sparse(self, table: str, keys):
+        return self._client.pull_sparse(table, keys)
+
+    def _drain(self):
+        with self._lock:
+            dense = self._dense_pending
+            sparse = self._sparse_pending
+            self._dense_pending = {}
+            self._sparse_pending = {}
+        for table, grad in dense.items():
+            self._client.push_dense(table, grad)
+        for table, items in sparse.items():
+            keys = np.concatenate([k for k, _ in items])
+            grads = np.concatenate([g for _, g in items])
+            self._client.push_sparse(table, keys, grads)
+        with self._lock:
+            if not self._dense_pending and not self._sparse_pending:
+                self._flushed.set()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._stop.wait(self._send_wait)
+            try:
+                self._drain()
+            except Exception:
+                if self._stop.is_set():
+                    break
+                raise
+
+    def flush(self, timeout: float = 30.0):
+        """Block until every queued push reached the PS (the reference's
+        Communicator barrier before save/evaluate)."""
+        if self.mode == "async":
+            deadline = time.time() + timeout
+            while not self._flushed.is_set():
+                self._drain()
+                if time.time() > deadline:
+                    raise TimeoutError("communicator flush timed out")
+
+    # -- geo engine --------------------------------------------------------
+    def geo_register_dense(self, table: str, value: np.ndarray):
+        """Start geo tracking from this synced snapshot."""
+        self._geo_synced[table] = np.array(value, np.float32)
+        self._geo_steps[table] = 0
+
+    def geo_step(self, table: str, local: np.ndarray) -> np.ndarray:
+        """One trainer step done on the local copy; every k_steps the
+        delta ships and the fresh global value is returned (else the
+        local copy is returned unchanged)."""
+        assert self.mode == "geo", "geo_step requires mode='geo'"
+        self._geo_steps[table] = self._geo_steps.get(table, 0) + 1
+        if self._geo_steps[table] % self._k_steps:
+            return local
+        local = np.asarray(local, np.float32)
+        delta = local - self._geo_synced[table]
+        # the PS applies value - lr*grad; geo tables must be registered
+        # with NaiveSGDRule(learning_rate=1.0) so pushing -delta applies
+        # the delta exactly (fleet.init_worker sets this up)
+        self._client.push_dense(table, -delta)
+        fresh = np.asarray(self._client.pull_dense(table), np.float32)
+        self._geo_synced[table] = fresh
+        return fresh
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            try:
+                self._drain()
+            except Exception:
+                pass
+            self._thread.join(timeout=5)

@@ -1,0 +1,68 @@
+"""PS-backed layers: the distributed lookup table.
+
+Reference parity: ``operators/pscore/distributed_lookup_table_op`` +
+``python/paddle/fluid/layers/nn.py embedding(is_sparse=True,
+is_distributed=True)`` — an embedding whose rows live in a PS sparse
+table: forward pulls the touched rows, backward pushes their gradients
+(through the Communicator, so async mode batches them off the training
+path).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd
+from ...core.tensor import Tensor, to_tensor
+from ...nn.layer_base import Layer
+
+__all__ = ["DistributedEmbedding"]
+
+
+class DistributedEmbedding(Layer):
+    """Embedding over a PS sparse table (reference
+    distributed_lookup_table).  ``comm`` is a ps.Communicator (or a raw
+    PSClient for sync pushes)."""
+
+    def __init__(self, table_name: str, num_embeddings: int,
+                 embedding_dim: int, comm, name=None):
+        super().__init__()
+        self.table_name = table_name
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._comm = comm
+
+    def forward(self, x):
+        x = to_tensor(x)
+        ids = np.asarray(x._data)
+        uniq, inverse = np.unique(ids.reshape(-1), return_inverse=True)
+        rows = np.asarray(self._comm.pull_sparse(self.table_name, uniq),
+                          np.float32)
+        out_arr = jnp.asarray(rows)[jnp.asarray(inverse)].reshape(
+            ids.shape + (self._embedding_dim,))
+
+        if autograd.is_grad_enabled() and self.training:
+            table, comm, D = self.table_name, self._comm, \
+                self._embedding_dim
+            flat_ids = ids.reshape(-1)
+
+            def vjp_fn(cot):
+                vals = np.asarray(cot).reshape(-1, D)
+                comm.push_sparse(table, flat_ids, vals)
+                gx = np.zeros(ids.shape, jax.dtypes.float0)
+                return (gx,)
+
+            node = autograd.GradNode(
+                "distributed_lookup_table_grad", vjp_fn, [x], [False],
+                [(out_arr.shape, out_arr.dtype)], False)
+            t = Tensor(out_arr, stop_gradient=False)
+            t._grad_node = node
+            t._output_index = 0
+            return t
+        return Tensor(out_arr, stop_gradient=True)
+
+    def extra_repr(self):
+        return (f"table={self.table_name}, "
+                f"{self._num_embeddings}x{self._embedding_dim}")
