@@ -67,7 +67,11 @@ def main():
         B, T, steps, dtype = 8, 128, 3, jnp.float32
 
     mesh = build_mesh({"dp": 1}, devices=jax.devices()[:1])
-    step, init_fn = build_spmd_train_step(cfg, mesh, compute_dtype=dtype)
+    # 'ctx' remat saves per-block attention outputs: measured +1-3% over
+    # full remat on v5e at this config (see round-2 ablation)
+    step, init_fn = build_spmd_train_step(cfg, mesh, compute_dtype=dtype,
+                                          remat_policy="ctx" if on_tpu
+                                          else "full")
     params, opt_state = init_fn(seed=0)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
@@ -95,13 +99,59 @@ def main():
 
     seq_per_sec = B * steps / best_dt
     target = 0.8 * 107.0  # see module docstring
-    print(json.dumps({
+    result = {
         "metric": f"gpt_bert_base_train_seq_per_sec_per_chip[{backend}]"
         if on_tpu else f"gpt_small_train_seq_per_sec[{backend}]",
         "value": round(seq_per_sec, 2),
         "unit": "seq/s",
         "vs_baseline": round(seq_per_sec / target, 3),
-    }))
+    }
+    try:
+        result["extra"] = {"resnet50": bench_resnet(on_tpu)}
+    except Exception as e:  # the headline metric must still print
+        print(f"bench: resnet leg failed: {e!r}", file=sys.stderr)
+    print(json.dumps(result))
+
+
+def bench_resnet(on_tpu: bool):
+    """ResNet50 imgs/s through the real user API (paddle.Model compiled
+    train step) — BASELINE north-star 2.  V100 fp16 ResNet50 ImageNet
+    training is ~390 imgs/s (NVIDIA public), target 0.8x = 312."""
+    import time
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    if on_tpu:
+        B, hw, steps, nclass = 256, 224, 10, 1000
+        net = paddle.vision.models.resnet50(num_classes=nclass)
+        amp = "O2"
+    else:
+        B, hw, steps, nclass = 8, 32, 2, 10
+        net = paddle.vision.models.resnet18(num_classes=nclass)
+        amp = None
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=net.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                  amp_configs=amp)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(B, 3, hw, hw), jnp.float32)
+    y = jnp.asarray(rng.randint(0, nclass, (B, 1)), jnp.int64)
+    model.train_batch([x], [y])          # compile
+    reps = 3 if on_tpu else 1
+    best = None
+    p0 = next(iter(net.parameters()))
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            model.train_batch([x], [y])   # float(loss) syncs per step
+        jax.block_until_ready(p0._data)
+        best = min(best or 9e9, time.perf_counter() - t0)
+    imgs = B * steps / best
+    return {"value": round(imgs, 1), "unit": "imgs/s",
+            "vs_baseline": round(imgs / (0.8 * 390.0), 3)}
 
 
 if __name__ == "__main__":

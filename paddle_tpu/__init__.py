@@ -26,6 +26,7 @@ from .core.autograd import no_grad, enable_grad, set_grad_enabled, \
     is_grad_enabled, grad  # noqa: F401
 from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
 from .core import dtype as _dtype_mod
+from .core import errors  # noqa: F401  (enforce taxonomy, enforce.h:422)
 from .core.dtype import (  # noqa: F401
     float32, float64, float16, bfloat16, int8, int16, int32, int64, uint8,
     bool_, complex64, complex128,

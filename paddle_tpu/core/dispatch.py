@@ -135,13 +135,17 @@ def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
     else:
         closed = fn
 
-    if needs_grad:
-        out, vjp_fn = jax.vjp(closed, *arrays)
-        node = autograd.record(op_name, closed, tensor_args, arrays,
-                               (out, vjp_fn))
-    else:
-        out = closed(*arrays)
-        node = None
+    try:
+        if needs_grad:
+            out, vjp_fn = jax.vjp(closed, *arrays)
+            node = autograd.record(op_name, closed, tensor_args, arrays,
+                                   (out, vjp_fn))
+        else:
+            out = closed(*arrays)
+            node = None
+    except Exception as e:  # enforce-style op context (enforce.h:422)
+        from .errors import tag_op_error
+        tag_op_error(op_name, e)
 
     tuple_output = isinstance(out, tuple)
     outs = out if tuple_output else (out,)

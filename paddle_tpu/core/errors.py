@@ -1,0 +1,123 @@
+"""Error taxonomy + enforce helpers.
+
+Reference parity: ``paddle/fluid/platform/enforce.h:422`` (PADDLE_THROW)
+``:434`` (PADDLE_ENFORCE_*) and ``platform/error_codes.proto`` — typed
+error codes with operator context so a failure deep in a kernel surfaces
+as "Error in op 'conv2d': InvalidArgumentError: ..." instead of a raw
+backend traceback.
+
+TPU translation: Python exception classes (one per proto code) raised by
+``enforce``/``raise_error``; the dispatcher wraps kernel exceptions with
+op context via ``op_error_context``.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
+    "PreconditionNotMetError", "PermissionDeniedError",
+    "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
+    "FatalError", "ExternalError", "enforce", "enforce_eq", "enforce_gt",
+    "op_error_context",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base — reference ``enforce.h:422`` EnforceNotMet."""
+
+    code = "LEGACY"
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet, LookupError):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceNotMet):
+    code = "FATAL"
+
+
+class ExternalError(EnforceNotMet):
+    code = "EXTERNAL"
+
+
+def enforce(cond, msg="", error_cls=InvalidArgumentError):
+    """PADDLE_ENFORCE: raise ``error_cls`` with message when cond is
+    falsy (reference enforce.h:434)."""
+    if not cond:
+        raise error_cls(f"{error_cls.code}: {msg}" if msg
+                        else error_cls.code)
+
+
+def enforce_eq(a, b, msg="", error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(f"{error_cls.code}: expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_gt(a, b, msg="", error_cls=InvalidArgumentError):
+    if not a > b:
+        raise error_cls(f"{error_cls.code}: expected {a!r} > {b!r}. {msg}")
+
+
+def tag_op_error(op_name: str, e: BaseException):
+    """Convert/annotate an exception with operator context and raise it
+    (reference ``framework/operator.cc`` appends the op type + callstack
+    to EnforceNotMet).  Shared by dispatch() and op_error_context so the
+    tagging rules live in exactly one place."""
+    if isinstance(e, EnforceNotMet):
+        if not getattr(e, "_op_tagged", False):
+            e._op_tagged = True
+            e.args = (f"[operator < {op_name} > error] {e}",) + e.args[1:]
+        raise e
+    if isinstance(e, (TypeError, ValueError, IndexError, KeyError)):
+        exc = InvalidArgumentError(
+            f"[operator < {op_name} > error] {type(e).__name__}: {e}")
+        exc._op_tagged = True
+        raise exc from e
+    raise e
+
+
+@contextmanager
+def op_error_context(op_name: str):
+    """Context-manager form of ``tag_op_error``."""
+    try:
+        yield
+    except BaseException as e:
+        tag_op_error(op_name, e)

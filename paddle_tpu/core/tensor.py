@@ -263,8 +263,19 @@ class Tensor:
     # ------------------------------------------------------------------
     # indexing (method bodies attached by ops package for the rest)
     # ------------------------------------------------------------------
+    @staticmethod
+    def _unwrap_index(idx):
+        # Tensor indices (incl. bool masks and int arrays) unwrap to
+        # their arrays; tuples recurse
+        if isinstance(idx, Tensor):
+            return idx._data
+        if isinstance(idx, tuple):
+            return tuple(Tensor._unwrap_index(i) for i in idx)
+        return idx
+
     def __getitem__(self, idx):
         from .dispatch import dispatch
+        idx = Tensor._unwrap_index(idx)
 
         def _index(x, *, idx=idx):
             return x[idx]
@@ -273,7 +284,7 @@ class Tensor:
     def __setitem__(self, idx, value):
         if isinstance(value, Tensor):
             value = value._data
-        self._data = self._data.at[idx].set(value)
+        self._data = self._data.at[Tensor._unwrap_index(idx)].set(value)
 
     def __iter__(self):
         for i in range(len(self)):

@@ -456,12 +456,19 @@ class Communicator:
         self._geo_synced: Dict[str, np.ndarray] = {}
         self._geo_steps: Dict[str, int] = {}
         self._stop = threading.Event()
-        self._flushed = threading.Event()
-        self._flushed.set()
+        self._inflight = 0          # pushes popped but not yet on the PS
         self._thread = None
         if mode == "async":
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
+
+    def __getattr__(self, name):
+        # full PSClient surface passes through (barrier/save/load/
+        # _endpoints/...) so init_worker's return value is call-
+        # compatible with a raw client
+        if name.startswith("_client") or name.startswith("__"):
+            raise AttributeError(name)
+        return getattr(self._client, name)
 
     # -- async engine ------------------------------------------------------
     def push_dense(self, table: str, grad):
@@ -472,7 +479,6 @@ class Communicator:
         with self._lock:
             cur = self._dense_pending.get(table)
             self._dense_pending[table] = grad if cur is None else cur + grad
-            self._flushed.clear()
 
     def push_sparse(self, table: str, keys, grads):
         keys = np.asarray(keys, np.int64).reshape(-1)
@@ -482,7 +488,6 @@ class Communicator:
             return
         with self._lock:
             self._sparse_pending.setdefault(table, []).append((keys, grads))
-            self._flushed.clear()
 
     def pull_dense(self, table: str):
         return self._client.pull_dense(table)
@@ -496,35 +501,74 @@ class Communicator:
             sparse = self._sparse_pending
             self._dense_pending = {}
             self._sparse_pending = {}
-        for table, grad in dense.items():
-            self._client.push_dense(table, grad)
-        for table, items in sparse.items():
-            keys = np.concatenate([k for k, _ in items])
-            grads = np.concatenate([g for _, g in items])
-            self._client.push_sparse(table, keys, grads)
-        with self._lock:
-            if not self._dense_pending and not self._sparse_pending:
-                self._flushed.set()
+            self._inflight += 1
+        try:
+            # per-table: a transient RPC failure re-queues that table's
+            # grads instead of dropping them or killing the thread
+            # (reference communicator retries the same way)
+            for table in list(dense):
+                g = dense.pop(table)
+                try:
+                    self._client.push_dense(table, g)
+                except Exception:
+                    with self._lock:
+                        cur = self._dense_pending.get(table)
+                        self._dense_pending[table] = \
+                            g if cur is None else cur + g
+                    raise
+            for table in list(sparse):
+                items = sparse.pop(table)
+                try:
+                    keys = np.concatenate([k for k, _ in items])
+                    grads = np.concatenate([g for _, g in items])
+                    self._client.push_sparse(table, keys, grads)
+                except Exception:
+                    with self._lock:
+                        self._sparse_pending.setdefault(
+                            table, []).extend(items)
+                    raise
+        finally:
+            with self._lock:
+                self._inflight -= 1
 
     def _loop(self):
+        import warnings
         while not self._stop.is_set():
             self._stop.wait(self._send_wait)
             try:
                 self._drain()
-            except Exception:
+            except Exception as e:
                 if self._stop.is_set():
                     break
-                raise
+                # transient failure: grads were re-queued by _drain;
+                # keep the shipping thread alive (reference communicator
+                # logs and retries)
+                warnings.warn(f"ps communicator push failed, retrying: "
+                              f"{e!r}")
+
+    def _idle(self) -> bool:
+        with self._lock:
+            return (not self._dense_pending and not self._sparse_pending
+                    and self._inflight == 0)
 
     def flush(self, timeout: float = 30.0):
         """Block until every queued push reached the PS (the reference's
-        Communicator barrier before save/evaluate)."""
-        if self.mode == "async":
-            deadline = time.time() + timeout
-            while not self._flushed.is_set():
+        Communicator barrier before save/evaluate).  Tracks in-flight
+        drains, so a push the background thread already popped still
+        holds the barrier until it lands."""
+        if self.mode != "async":
+            return
+        deadline = time.time() + timeout
+        while not self._idle():
+            try:
                 self._drain()
-                if time.time() > deadline:
-                    raise TimeoutError("communicator flush timed out")
+            except Exception:
+                pass  # re-queued; retry until the deadline
+            if self._idle():
+                break
+            if time.time() > deadline:
+                raise TimeoutError("communicator flush timed out")
+            time.sleep(0.001)
 
     # -- geo engine --------------------------------------------------------
     def geo_register_dense(self, table: str, value: np.ndarray):
@@ -537,14 +581,22 @@ class Communicator:
         delta ships and the fresh global value is returned (else the
         local copy is returned unchanged)."""
         assert self.mode == "geo", "geo_step requires mode='geo'"
+        if table not in self._geo_synced:
+            raise KeyError(
+                f"geo table '{table}' not registered: call "
+                "geo_register_dense(table, client.pull_dense(table)) "
+                "once before training (and register the server-side "
+                "table with NaiveSGDRule(learning_rate=1.0) so deltas "
+                "apply exactly)")
         self._geo_steps[table] = self._geo_steps.get(table, 0) + 1
         if self._geo_steps[table] % self._k_steps:
             return local
         local = np.asarray(local, np.float32)
         delta = local - self._geo_synced[table]
         # the PS applies value - lr*grad; geo tables must be registered
-        # with NaiveSGDRule(learning_rate=1.0) so pushing -delta applies
-        # the delta exactly (fleet.init_worker sets this up)
+        # server-side with NaiveSGDRule(learning_rate=1.0) so pushing
+        # -delta applies the delta exactly (caller contract, see
+        # geo_register_dense error message)
         self._client.push_dense(table, -delta)
         fresh = np.asarray(self._client.pull_dense(table), np.float32)
         self._geo_synced[table] = fresh

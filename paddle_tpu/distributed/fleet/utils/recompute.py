@@ -8,33 +8,102 @@ TPU-first: inside a jitted trace this IS ``jax.checkpoint`` — XLA
 rematerialises the segment in the backward pass; the RNG-state juggling
 the reference does by hand is unnecessary because JAX PRNG keys are
 values threaded through the trace (same key ⇒ same dropout mask on
-replay, by construction).  In eager tape mode the segment simply runs
-normally — eager holds activations anyway; memory pressure is a compiled-
-path concern.
+replay, by construction).
+
+In eager tape mode this genuinely saves memory now: the segment runs
+under ``no_grad`` (no per-op jax.vjp closures retaining activations),
+only the *inputs* and the RNG state are stashed, and the backward replays
+the forward with grad enabled — the reference RecomputeFunction's exact
+mechanism, PyLayer included.
 """
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
+from ....core import autograd
+from ....core.random import default_generator, rng_scope
 from ....core.tensor import Tensor
 
 __all__ = ["recompute"]
 
 
 def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
-    """Run `function(*args)` marked for rematerialisation under jit."""
+    """Checkpoint `function(*args)`: jax.checkpoint under jit, replay-in-
+    backward in eager mode (reference recompute.py:63 RecomputeFunction).
+    """
     raws = [a._data if isinstance(a, Tensor) else a for a in args]
     traced = any(isinstance(r, jax.core.Tracer) for r in raws)
-    if not traced:
+    if traced:
+        def raw_fn(*raw_args):
+            wrapped = [Tensor(r, stop_gradient=False)
+                       if i < len(args) and isinstance(args[i], Tensor)
+                       else r for i, r in enumerate(raw_args)]
+            out = function(*wrapped, **kwargs)
+            return out._data if isinstance(out, Tensor) else out
+
+        out = jax.checkpoint(raw_fn)(*raws)
+        return Tensor(out, stop_gradient=False) if any(
+            isinstance(a, Tensor) for a in args) else out
+
+    if not autograd.is_grad_enabled():
         return function(*args, **kwargs)
 
-    def raw_fn(*raw_args):
-        wrapped = [Tensor(r, stop_gradient=False)
-                   if i < len(args) and isinstance(args[i], Tensor) else r
-                   for i, r in enumerate(raw_args)]
-        out = function(*wrapped, **kwargs)
-        return out._data if isinstance(out, Tensor) else out
+    # ---- eager checkpointing ------------------------------------------
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensors = [args[i] for i in tensor_idx]
+    # RNG snapshot so dropout replays identically (reference stashes the
+    # cuda RNG state the same way)
+    rng_key = default_generator.next_key() if preserve_rng_state else None
 
-    out = jax.checkpoint(raw_fn)(*raws)
-    return Tensor(out, stop_gradient=False) if any(
-        isinstance(a, Tensor) for a in args) else out
+    def run(arg_list):
+        if rng_key is not None:
+            with rng_scope(rng_key):
+                return function(*arg_list, **kwargs)
+        return function(*arg_list, **kwargs)
+
+    with autograd.no_grad():
+        out = run(list(args))
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    out_arrays = [o._data if isinstance(o, Tensor) else o for o in outs]
+
+    def vjp_fn(cot):
+        cots = cot if isinstance(cot, tuple) else (cot,)
+        # detached input copies: their grads become this node's input
+        # cotangents; parameter grads accumulate into the live Parameters
+        # as a side effect of the replayed backward (reference semantics)
+        leaves = [Tensor(t._data, stop_gradient=t.stop_gradient)
+                  for t in tensors]
+        replay_args = list(args)
+        for i, leaf in zip(tensor_idx, leaves):
+            replay_args[i] = leaf
+        out2 = run(replay_args)
+        outs2 = out2 if isinstance(out2, (tuple, list)) else (out2,)
+        for o2, g in zip(outs2, cots):
+            if isinstance(o2, Tensor) and not o2.stop_gradient:
+                autograd.backward(o2, grad_tensor=Tensor(jnp.asarray(g)),
+                                  retain_graph=True)
+        grads = []
+        for leaf in leaves:
+            if leaf.grad is not None:
+                grads.append(leaf.grad._data)
+            else:
+                import numpy as _np
+                grads.append(_np.zeros(leaf._data.shape, jax.dtypes.float0)
+                             if not jnp.issubdtype(leaf._data.dtype,
+                                                   jnp.inexact)
+                             else jnp.zeros_like(leaf._data))
+        return tuple(grads)
+
+    tuple_output = isinstance(out, (tuple, list))
+    node = autograd.GradNode(
+        "recompute", vjp_fn, tensors,
+        [not t.stop_gradient for t in tensors],
+        [(a.shape, a.dtype) for a in out_arrays], tuple_output)
+    wrapped = []
+    for i, a in enumerate(out_arrays):
+        t = Tensor(a, stop_gradient=False)
+        t._grad_node = node
+        t._output_index = i
+        wrapped.append(t)
+    return tuple(wrapped) if tuple_output else wrapped[0]

@@ -166,9 +166,11 @@ def pinv(x, rcond=1e-15, hermitian=False, name=None):
 
 
 def svd(x, full_matrices=False, name=None):
+    """Returns (U, S, VH) — VH is the conjugate transpose of V, matching
+    the reference convention (``tensor/linalg.py:1534``)."""
     x = to_tensor(x)
     u, s, vh = jnp.linalg.svd(x._data, full_matrices=full_matrices)
-    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2).conj())
+    return Tensor(u), Tensor(s), Tensor(vh)
 
 
 def qr(x, mode="reduced", name=None):

@@ -183,8 +183,18 @@ def mode(x, axis=-1, keepdim=False, name=None):
         counts = jax.vmap(lambda r: jnp.bincount(r, length=n + 1),
                           in_axes=0)(runs.reshape(-1, n)).reshape(*runs.shape[:-1], n + 1)
         best_run = jnp.argmax(counts, axis=-1)
-        pos = jnp.argmax(runs == best_run[..., None], axis=-1)
+        is_best = runs == best_run[..., None]
+        # LAST sorted position of the winning run: with a stable argsort
+        # it maps to the LAST original occurrence — the reference's mode
+        # op returns that index (docs example: mode([1,2,2]) -> index 2)
+        pos = n - 1 - jnp.argmax(jnp.flip(is_best, axis=-1), axis=-1)
         vals = jnp.take_along_axis(moved, pos[..., None], axis=-1)[..., 0]
-        return jnp.moveaxis(vals[..., None], -1, axis if keepdim else -1) if keepdim else vals
-    out = impl(x._data)
-    return Tensor(out)
+        order = jnp.moveaxis(jnp.argsort(a, axis=axis, stable=True),
+                             axis, -1)
+        idxs = jnp.take_along_axis(order, pos[..., None], axis=-1)[..., 0]
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idxs = jnp.expand_dims(idxs, axis)
+        return vals, idxs
+    vals, idxs = impl(x._data)
+    return Tensor(vals), Tensor(idxs.astype(jnp.int64))

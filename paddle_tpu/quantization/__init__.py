@@ -1,0 +1,226 @@
+"""Int8 quantization — post-training + quant-aware training.
+
+Reference parity: ``inference/api/mkldnn_quantizer.cc`` (post-training
+calibration: per-tensor abs-max activation ranges, per-channel weight
+scales, int8 kernels) and the slim QAT passes
+(``fluid/contrib/slim/quantization``: fake_quantize ops with
+moving-average abs-max + straight-through gradients).
+
+TPU-first: the int8 compute path is ``lax.dot_general`` on int8 operands
+with int32 accumulation — the MXU runs int8 matmuls at 2x bf16
+throughput, which is what TensorRT/mkldnn int8 buys the reference.
+Weight scales are per-output-channel symmetric; activation scales are
+per-tensor from calibration (abs_max over the calibration set).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..nn.layer_base import Layer
+from .. import nn
+
+__all__ = ["quantize_weights", "PostTrainingQuantization",
+           "QuantizedLinear", "fake_quantize_abs_max", "QAT"]
+
+
+def _per_channel_scales(w: np.ndarray, axis: int) -> np.ndarray:
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    amax = np.abs(w).max(axis=red)
+    return np.maximum(amax, 1e-8) / 127.0
+
+
+def _quantize(w: np.ndarray, scales: np.ndarray, axis: int) -> np.ndarray:
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    return np.clip(np.round(w / scales.reshape(shape)),
+                   -127, 127).astype(np.int8)
+
+
+class QuantizedLinear(Layer):
+    """Int8 linear: x -> q8(x) @ q8(W) (int32 accum) * s_x * s_w + b.
+
+    With a calibrated input scale the matmul runs fully in int8 on the
+    MXU; without one it falls back to weight-only (dequantize W, fp
+    matmul) — the reference's two mkldnn quantization flavors.
+    """
+
+    def __init__(self, weight_int8, w_scales, bias=None,
+                 in_scale: Optional[float] = None, name=None):
+        super().__init__()
+        self.weight_q = jnp.asarray(weight_int8)        # (in, out) int8
+        self.w_scales = jnp.asarray(w_scales, jnp.float32)   # (out,)
+        self.bias = None if bias is None else jnp.asarray(bias)
+        self.in_scale = None if in_scale is None else float(in_scale)
+
+    def forward(self, x):
+        x = to_tensor(x)
+        a = x._data
+        if self.in_scale is not None:
+            q = jnp.clip(jnp.round(a / self.in_scale), -127, 127) \
+                .astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                q, self.weight_q, (((q.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (self.in_scale * self.w_scales)
+        else:  # weight-only: dequant folds into the fp matmul
+            w = self.weight_q.astype(jnp.float32) * self.w_scales[None, :]
+            out = a @ w
+        if self.bias is not None:
+            out = out + self.bias
+        return Tensor(out.astype(jnp.float32), stop_gradient=True)
+
+    def extra_repr(self):
+        mode = "static-int8" if self.in_scale is not None else \
+            "weight-only"
+        return f"{self.weight_q.shape}, {mode}"
+
+
+def quantize_weights(model: Layer) -> Layer:
+    """Weight-only int8: swap every nn.Linear for a QuantizedLinear with
+    per-output-channel scales (reference mkldnn int8 weight path).
+    Returns the model (mutated in place, eval-mode inference)."""
+    for name, sub in list(model.named_sublayers()):
+        _replace_linears(sub)
+    _replace_linears(model)
+    return model
+
+
+def _replace_linears(layer: Layer, in_scales: Optional[Dict] = None):
+    for attr, sub in list(layer._sub_layers.items()):
+        if isinstance(sub, nn.Linear):
+            w = np.asarray(sub.weight._data)             # (in, out)
+            scales = _per_channel_scales(w, axis=1)
+            q = _quantize(w, scales, axis=1)
+            b = None if getattr(sub, "bias", None) is None \
+                else np.asarray(sub.bias._data)
+            in_scale = None if in_scales is None else \
+                in_scales.get(id(sub))
+            layer._sub_layers[attr] = QuantizedLinear(
+                q, scales, b, in_scale=in_scale)
+        else:
+            _replace_linears(sub, in_scales)
+
+
+class PostTrainingQuantization:
+    """Static int8 PTQ (reference mkldnn_quantizer.cc /
+    PostTrainingQuantization): run calibration batches, record per-layer
+    input abs-max, then convert Linears to fully-int8 QuantizedLinears.
+    """
+
+    def __init__(self, model: Layer, algo: str = "abs_max"):
+        assert algo == "abs_max", "only abs_max calibration implemented"
+        self.model = model
+        self._ranges: Dict[int, float] = {}
+        self._hooks = []
+
+    def _observe(self, lin):
+        def hook(layer, inputs):
+            x = inputs[0]
+            arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+            cur = self._ranges.get(id(layer), 0.0)
+            self._ranges[id(layer)] = max(cur, float(np.abs(arr).max()))
+            return None
+        return lin.register_forward_pre_hook(hook)
+
+    def calibrate(self, data_iter: Iterable):
+        self.model.eval()
+        for lin in self._linears(self.model):
+            self._hooks.append(self._observe(lin))
+        try:
+            for batch in data_iter:
+                self.model(*batch if isinstance(batch, (tuple, list))
+                           else (batch,))
+        finally:
+            for h in self._hooks:
+                h.remove()
+            self._hooks = []
+        return self
+
+    @staticmethod
+    def _linears(layer) -> List:
+        out = []
+        for _, sub in layer.named_sublayers():
+            if isinstance(sub, nn.Linear):
+                out.append(sub)
+        return out
+
+    def convert(self) -> Layer:
+        in_scales = {lid: r / 127.0 for lid, r in self._ranges.items()}
+        _replace_linears(self.model, in_scales)
+        return self.model
+
+
+# ---------------------------------------------------------------------------
+# QAT: fake quantization with straight-through gradients
+# ---------------------------------------------------------------------------
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_q(x, scale, bits):
+    qmax = 2 ** (bits - 1) - 1
+    return jnp.clip(jnp.round(x / scale), -qmax, qmax) * scale
+
+
+def _fake_q_fwd(x, scale, bits):
+    return _fake_q(x, scale, bits), (x, scale)
+
+
+def _fake_q_bwd(bits, res, g):
+    x, scale = res
+    qmax = 2 ** (bits - 1) - 1
+    # straight-through inside the clip window (reference
+    # fake_quantize_abs_max grad)
+    inside = (jnp.abs(x) <= scale * qmax).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale)
+
+
+_fake_q.defvjp(_fake_q_fwd, _fake_q_bwd)
+
+
+def fake_quantize_abs_max(x, bits: int = 8, name=None):
+    """Fake-quant op: quantize-dequantize with abs-max scale and
+    straight-through gradient (reference fake_quantize_abs_max op)."""
+    x = to_tensor(x)
+    from ..core.dispatch import dispatch
+
+    def impl(a):
+        qmax = 2 ** (bits - 1) - 1
+        scale = jnp.maximum(jnp.max(jnp.abs(jax.lax.stop_gradient(a))),
+                            1e-8) / qmax
+        return _fake_q(a, scale, bits)
+    return dispatch("fake_quantize_abs_max", impl, (x,), {})
+
+
+class QAT:
+    """Quant-aware training wrapper: monkey-patches each Linear to
+    fake-quantize weights + activations in forward (reference slim
+    QuantizationTransformPass 'moving_average_abs_max' posture, abs-max
+    variant)."""
+
+    def __init__(self, bits: int = 8):
+        self.bits = bits
+
+    def quantize(self, model: Layer) -> Layer:
+        bits = self.bits
+        for _, sub in list(model.named_sublayers()) + [("", model)]:
+            for attr, lin in list(sub._sub_layers.items()):
+                if isinstance(lin, nn.Linear) and \
+                        not getattr(lin, "_qat_wrapped", False):
+                    orig_forward = lin.forward
+
+                    def fwd(x, _lin=lin, _orig=orig_forward):
+                        xq = fake_quantize_abs_max(to_tensor(x), bits)
+                        wq = fake_quantize_abs_max(_lin.weight, bits)
+                        from ..nn import functional as NF
+                        return NF.linear(xq, wq,
+                                         getattr(_lin, "bias", None))
+                    lin.forward = fwd
+                    lin._qat_wrapped = True
+        return model

@@ -737,3 +737,50 @@ class Executor:
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return [Tensor(v) for v in fetches]
+
+    # -- dataset-path trainer loop (reference executor.py
+    # train_from_dataset -> framework/trainer.h:57 MultiTrainer /
+    # data_feed channels; here the channel is the Dataset iterator and
+    # the worker loop is the compiled program run per batch) ------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        program = program or default_main_program()
+        use_vars = list(getattr(dataset, "_use_vars", []))
+        if not use_vars:
+            raise ValueError(
+                "dataset.set_use_var([...]) must name the feed variables")
+        names = [v if isinstance(v, str) else v.name for v in use_vars]
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [getattr(f, "name", str(f))
+                                    for f in fetch_list]
+        last_fetch = None
+        for step, batch in enumerate(dataset):
+            cols = list(zip(*batch)) if batch and isinstance(
+                batch[0], (tuple, list)) else [batch]
+            if len(cols) != len(names):
+                raise ValueError(
+                    f"dataset samples have {len(cols)} slot(s) but "
+                    f"set_use_var declared {len(names)} variable(s) "
+                    f"({names}); the pipe command must emit one value "
+                    "per use_var")
+            feed = {n: np.stack([np.asarray(s) for s in col])
+                    for n, col in zip(names, cols)}
+            out = self.run(program, feed=feed, fetch_list=fetch_list)
+            last_fetch = out
+            if debug and fetch_list and step % max(1, print_period) == 0:
+                msg = ", ".join(f"{i}={np.asarray(v).mean():.6f}"
+                                for i, v in zip(fetch_info, out))
+                print(f"[train_from_dataset] step {step}: {msg}")
+        return last_fetch
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Reference executor.py infer_from_dataset — same loop, caller
+        passes an inference program (clone(for_test=True))."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)

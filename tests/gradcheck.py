@@ -1,0 +1,75 @@
+"""Shared finite-difference gradient checker (OpTest.check_grad's engine
+as a standalone helper for table-driven suites).
+
+Reference parity: ``tests/unittests/op_test.py:1450`` check_grad — the
+numeric central-difference vs analytic (tape) comparison that polices
+every reference op.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def gradcheck(fn, inputs, diff_idx=None, delta=1e-3, max_rel=5e-3,
+              atol=1e-4, **kwargs):
+    """fn(*tensors, **kwargs) -> Tensor (or tuple; first output checked).
+
+    inputs: list of np arrays; diff_idx: which positions to grad-check
+    (default: all floating inputs).
+    """
+    if diff_idx is None:
+        diff_idx = [i for i, a in enumerate(inputs)
+                    if np.issubdtype(np.asarray(a).dtype, np.floating)]
+
+    def run(arrs, stop_grad=True):
+        ts = [paddle.to_tensor(a, stop_gradient=(
+            stop_grad or i not in diff_idx)) for i, a in enumerate(arrs)]
+        out = fn(*ts, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return ts, out
+
+    ts, out = run(inputs, stop_grad=False)
+    cot = np.asarray(np.random.RandomState(1234).rand(*out.shape),
+                     "float32")
+    loss = paddle.sum(out * paddle.to_tensor(cot))
+    loss.backward()
+
+    def eval_sum(arrs):
+        with paddle.no_grad():
+            _, o = run(arrs)
+        return float((np.asarray(o.numpy(), np.float64) * cot).sum())
+
+    for i in diff_idx:
+        analytic = np.asarray(ts[i].grad.numpy(), np.float64)
+        base = [np.asarray(a, np.float64)
+                if np.issubdtype(np.asarray(a).dtype, np.floating)
+                else np.asarray(a) for a in inputs]
+        x = base[i]
+        numeric = np.zeros_like(x)
+        flat, nflat = x.reshape(-1), numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + delta
+            plus = eval_sum(base)
+            flat[j] = orig - delta
+            minus = eval_sum(base)
+            flat[j] = orig
+            nflat[j] = (plus - minus) / (2 * delta)
+        denom = np.maximum(np.abs(analytic),
+                           np.maximum(np.abs(numeric), 1e-2))
+        rel = np.abs(analytic - numeric) / denom
+        bad = rel > max_rel
+        close = np.abs(analytic - numeric) < atol
+        assert not np.any(bad & ~close), (
+            f"gradcheck failed for input {i}: max rel "
+            f"{rel[bad & ~close].max():.2e}\nanalytic "
+            f"{analytic.ravel()[:5]}\nnumeric {numeric.ravel()[:5]}")
+
+
+def well_separated(shape, lo=0.0, hi=1.0, seed=0):
+    """Values whose pairwise gaps exceed the fd delta — safe for
+    max/min-style ops."""
+    n = int(np.prod(shape))
+    vals = np.linspace(lo, hi, n, dtype="float32")
+    return np.random.RandomState(seed).permutation(vals).reshape(shape)

@@ -26,21 +26,21 @@ def test_flash_kernel_matches_reference():
                for _ in range(3))
     s = 1.0 / np.sqrt(D)
     for causal in (False, True):
-        out = _flash_fwd(q, k, v, s, causal, block_q=128, block_k=128,
-                         interpret=True)
+        out, _ = _flash_fwd(q, k, v, s, causal, block_q=128,
+                            block_k=128, interpret=True)
         ref = _xla_attention(q, k, v, s, causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
     # Tq != Tk causal: bottom-right alignment must match the XLA math
     q2 = q[:, :128]
-    out = _flash_fwd(q2, k, v, s, True, block_q=128, block_k=128,
-                     interpret=True)
+    out, _ = _flash_fwd(q2, k, v, s, True, block_q=128, block_k=128,
+                        interpret=True)
     ref = _xla_attention(q2, k, v, s, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
     # T=384: divisible by 128 but not by the default 256 block
-    out = _flash_fwd(q[:, :384], k[:, :384], v[:, :384], s, True,
-                     interpret=True)
+    out, _ = _flash_fwd(q[:, :384], k[:, :384], v[:, :384], s, True,
+                        interpret=True)
     ref = _xla_attention(q[:, :384], k[:, :384], v[:, :384], s, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)

@@ -1,0 +1,224 @@
+"""Table-driven OpTest coverage: loss functions, creation ops, logic ops.
+
+Reference parity: ``test_mse_loss.py``, ``test_cross_entropy_op.py``,
+``test_zeros_op.py``, ``test_compare_op.py`` families.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from gradcheck import gradcheck
+
+RS = np.random.RandomState(3)
+PRED = RS.rand(4, 5).astype("float32")
+TGT = RS.rand(4, 5).astype("float32")
+LOGITS = (RS.rand(4, 5) * 2 - 1).astype("float32")
+LABELS = RS.randint(0, 5, (4,)).astype("int64")
+
+
+def _np_softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+LOSSES = [
+    ("mse", lambda p, t: F.mse_loss(p, t),
+     lambda p, t: np.mean((p - t) ** 2)),
+    ("l1", lambda p, t: F.l1_loss(p, t),
+     lambda p, t: np.mean(np.abs(p - t))),
+    ("smooth_l1", lambda p, t: F.smooth_l1_loss(p, t), None),
+    ("bce", lambda p, t: F.binary_cross_entropy(
+        paddle.nn.functional.sigmoid(p), paddle.nn.functional.sigmoid(t)),
+     None),
+    ("bce_logits", lambda p, t: F.binary_cross_entropy_with_logits(
+        p, paddle.nn.functional.sigmoid(t)), None),
+    ("kl_div", lambda p, t: F.kl_div(
+        paddle.nn.functional.log_softmax(p),
+        paddle.nn.functional.softmax(t)), None),
+    ("huber", lambda p, t: paddle.nn.SmoothL1Loss()(p, t), None),
+]
+
+
+@pytest.mark.parametrize("name,fn,ref", LOSSES, ids=[c[0] for c in LOSSES])
+def test_loss_forward(name, fn, ref):
+    out = fn(paddle.to_tensor(PRED), paddle.to_tensor(TGT))
+    v = float(out)
+    assert np.isfinite(v) and v >= 0
+    if ref is not None:
+        np.testing.assert_allclose(v, ref(PRED, TGT), rtol=1e-5)
+
+
+@pytest.mark.parametrize("name,fn,ref", LOSSES, ids=[c[0] for c in LOSSES])
+def test_loss_grad(name, fn, ref):
+    gradcheck(fn, [PRED[:2, :3], TGT[:2, :3]], diff_idx=[0],
+              max_rel=2e-2)
+
+
+def test_cross_entropy_and_nll():
+    ce = F.cross_entropy(paddle.to_tensor(LOGITS),
+                         paddle.to_tensor(LABELS))
+    logp = np.log(_np_softmax(LOGITS))
+    ref = -logp[np.arange(4), LABELS].mean()
+    np.testing.assert_allclose(float(ce), ref, rtol=1e-5)
+    nll = F.nll_loss(paddle.to_tensor(np.asarray(logp, "float32")),
+                     paddle.to_tensor(LABELS))
+    np.testing.assert_allclose(float(nll), ref, rtol=1e-4)
+    gradcheck(lambda p: F.cross_entropy(p, paddle.to_tensor(LABELS)),
+              [LOGITS], max_rel=2e-2)
+    # soft labels
+    soft = _np_softmax(TGT).astype("float32")
+    ce_soft = F.cross_entropy(paddle.to_tensor(LOGITS),
+                              paddle.to_tensor(soft), soft_label=True)
+    np.testing.assert_allclose(float(ce_soft),
+                               -(soft * logp).sum(-1).mean(), rtol=1e-4)
+
+
+def test_margin_and_embedding_losses():
+    a = paddle.to_tensor(PRED[:2])
+    b = paddle.to_tensor(TGT[:2])
+    y = paddle.to_tensor(np.array([1., -1.], "float32"))
+    out = F.margin_ranking_loss(a, b, paddle.to_tensor(
+        np.ones((2, 5), "float32")))
+    assert float(out) >= 0
+    out = F.cosine_embedding_loss(a, b, y)
+    assert float(out) >= 0
+    trip = F.triplet_margin_loss(a, b, paddle.to_tensor(PRED[2:4]))
+    assert float(trip) >= 0
+
+
+CREATION = [
+    ("zeros", lambda: paddle.zeros([2, 3]), np.zeros((2, 3))),
+    ("ones", lambda: paddle.ones([2, 3]), np.ones((2, 3))),
+    ("full", lambda: paddle.full([2, 2], 7.0), np.full((2, 2), 7.0)),
+    ("arange", lambda: paddle.arange(2, 10, 2), np.arange(2, 10, 2)),
+    ("linspace", lambda: paddle.linspace(0, 1, 5), np.linspace(0, 1, 5)),
+    ("eye", lambda: paddle.eye(3), np.eye(3)),
+    ("diagflat", lambda: paddle.diagflat(paddle.to_tensor(
+        np.array([1., 2.], "float32"))), np.diagflat([1., 2.])),
+    ("zeros_like", lambda: paddle.zeros_like(paddle.to_tensor(PRED)),
+     np.zeros_like(PRED)),
+    ("ones_like", lambda: paddle.ones_like(paddle.to_tensor(PRED)),
+     np.ones_like(PRED)),
+    ("full_like", lambda: paddle.full_like(paddle.to_tensor(PRED), 3.0),
+     np.full_like(PRED, 3.0)),
+]
+
+
+@pytest.mark.parametrize("name,fn,ref", CREATION,
+                         ids=[c[0] for c in CREATION])
+def test_creation(name, fn, ref):
+    np.testing.assert_allclose(np.asarray(fn().numpy(), np.float64),
+                               ref, rtol=1e-6)
+
+
+def test_meshgrid_and_indices():
+    a = np.arange(3).astype("float32")
+    b = np.arange(2).astype("float32")
+    X, Y = paddle.meshgrid(paddle.to_tensor(a), paddle.to_tensor(b))
+    rx, ry = np.meshgrid(a, b, indexing="ij")
+    np.testing.assert_allclose(X.numpy(), rx)
+    np.testing.assert_allclose(Y.numpy(), ry)
+
+
+LOGIC = [
+    ("equal", lambda a, b: paddle.equal(a, b), np.equal),
+    ("not_equal", lambda a, b: paddle.not_equal(a, b), np.not_equal),
+    ("greater_than", lambda a, b: paddle.greater_than(a, b), np.greater),
+    ("greater_equal", lambda a, b: paddle.greater_equal(a, b),
+     np.greater_equal),
+    ("less_than", lambda a, b: paddle.less_than(a, b), np.less),
+    ("less_equal", lambda a, b: paddle.less_equal(a, b), np.less_equal),
+    ("logical_and", lambda a, b: paddle.logical_and(a > 0.5, b > 0.5),
+     lambda a, b: (a > 0.5) & (b > 0.5)),
+    ("logical_or", lambda a, b: paddle.logical_or(a > 0.5, b > 0.5),
+     lambda a, b: (a > 0.5) | (b > 0.5)),
+    ("logical_xor", lambda a, b: paddle.logical_xor(a > 0.5, b > 0.5),
+     lambda a, b: (a > 0.5) ^ (b > 0.5)),
+]
+
+
+@pytest.mark.parametrize("name,fn,ref", LOGIC, ids=[c[0] for c in LOGIC])
+def test_logic(name, fn, ref):
+    out = fn(paddle.to_tensor(PRED), paddle.to_tensor(TGT))
+    np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                  ref(PRED, TGT))
+
+
+def test_is_family():
+    x = np.array([1.0, np.nan, np.inf, -np.inf], "float32")
+    t = paddle.to_tensor(x)
+    np.testing.assert_array_equal(paddle.isnan(t).numpy(), np.isnan(x))
+    np.testing.assert_array_equal(paddle.isinf(t).numpy(), np.isinf(x))
+    np.testing.assert_array_equal(paddle.isfinite(t).numpy(),
+                                  np.isfinite(x))
+    assert bool(paddle.allclose(paddle.to_tensor(PRED),
+                                paddle.to_tensor(PRED + 1e-9)))
+    assert not bool(paddle.allclose(paddle.to_tensor(PRED),
+                                    paddle.to_tensor(TGT)))
+
+
+def test_where_and_select():
+    cond = PRED > 0.5
+    out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(PRED),
+                       paddle.to_tensor(TGT))
+    np.testing.assert_allclose(out.numpy(), np.where(cond, PRED, TGT))
+    gradcheck(lambda a, b: paddle.where(paddle.to_tensor(cond[:2, :3]),
+                                        a, b),
+              [PRED[:2, :3], TGT[:2, :3]])
+
+
+# ---------------------------------------------------------------------------
+# tensor indexing / method surface (reference test_variable / test_slice)
+# ---------------------------------------------------------------------------
+IDX_CASES = [
+    ("basic_row", lambda a: a[1], lambda a: a[1]),
+    ("slice", lambda a: a[0:3:2], lambda a: a[0:3:2]),
+    ("neg", lambda a: a[-1], lambda a: a[-1]),
+    ("col", lambda a: a[:, 2], lambda a: a[:, 2]),
+    ("ellipsis", lambda a: a[..., 1], lambda a: a[..., 1]),
+    ("newaxis", lambda a: a[:, None, :], lambda a: a[:, None, :]),
+    ("bool_mask", lambda a: a[a > 0.5], lambda a: a[a > 0.5]),
+    ("int_array", lambda a: a[np.array([2, 0])],
+     lambda a: a[np.array([2, 0])]),
+    ("rev", lambda a: a[::-1], lambda a: a[::-1]),
+]
+
+
+@pytest.mark.parametrize("name,pfn,nfn", IDX_CASES,
+                         ids=[c[0] for c in IDX_CASES])
+def test_indexing(name, pfn, nfn):
+    t = paddle.to_tensor(PRED)
+    np.testing.assert_allclose(np.asarray(pfn(t).numpy()), nfn(PRED),
+                               rtol=1e-6)
+
+
+def test_setitem_and_inplace():
+    t = paddle.to_tensor(PRED.copy())
+    t[1] = 0.0
+    ref = PRED.copy()
+    ref[1] = 0.0
+    np.testing.assert_allclose(t.numpy(), ref)
+    t[:, 2] = 5.0
+    ref[:, 2] = 5.0
+    np.testing.assert_allclose(t.numpy(), ref)
+
+
+def test_tensor_methods():
+    t = paddle.to_tensor(PRED)
+    assert t.numel() == 20 and t.ndim == 2 and t.size == 20
+    assert t.astype("float64").dtype  # canonicalized per x64 setting
+    c = t.clone()
+    assert np.allclose(c.numpy(), PRED) and c is not t
+    d = t.detach()
+    assert d.stop_gradient
+    assert "Tensor" in repr(t)
+    assert float(t.sum()) == pytest.approx(PRED.sum(), rel=1e-5)
+    assert t.item(0) == pytest.approx(float(PRED.flat[0]))
+    np.testing.assert_allclose(t.tolist(), PRED.tolist(), rtol=1e-6)
+
+
+def test_slicing_grad_flows():
+    gradcheck(lambda a: a[1:, :2] * 2.0, [PRED[:3, :3]])
+    gradcheck(lambda a: paddle.concat([a[0], a[2]], axis=0),
+              [PRED[:3, :3]])

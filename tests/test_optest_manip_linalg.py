@@ -1,0 +1,250 @@
+"""Table-driven OpTest coverage: manipulation + linalg families.
+
+Reference parity: ``test_concat_op.py``, ``test_gather_op.py``,
+``test_matmul_v2_op.py``, ``test_cholesky_op.py`` etc.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from gradcheck import gradcheck
+
+RS = np.random.RandomState(1)
+A = RS.rand(2, 3, 4).astype("float32")
+B2 = RS.rand(3, 4).astype("float32")
+
+
+MANIP = [
+    ("concat", lambda: paddle.concat([paddle.to_tensor(B2),
+                                      paddle.to_tensor(B2 * 2)], axis=0),
+     lambda: np.concatenate([B2, B2 * 2], 0)),
+    ("stack", lambda: paddle.stack([paddle.to_tensor(B2),
+                                    paddle.to_tensor(B2 * 2)], axis=1),
+     lambda: np.stack([B2, B2 * 2], 1)),
+    ("tile", lambda: paddle.tile(paddle.to_tensor(B2), [2, 3]),
+     lambda: np.tile(B2, (2, 3))),
+    ("flip", lambda: paddle.flip(paddle.to_tensor(A), axis=[1]),
+     lambda: np.flip(A, 1)),
+    ("roll", lambda: paddle.roll(paddle.to_tensor(B2), 2, axis=1),
+     lambda: np.roll(B2, 2, 1)),
+    ("transpose", lambda: paddle.transpose(paddle.to_tensor(A), [2, 0, 1]),
+     lambda: A.transpose(2, 0, 1)),
+    ("reshape", lambda: paddle.reshape(paddle.to_tensor(A), [4, 6]),
+     lambda: A.reshape(4, 6)),
+    ("squeeze", lambda: paddle.squeeze(paddle.to_tensor(A[:1]), axis=0),
+     lambda: A[0]),
+    ("unsqueeze", lambda: paddle.unsqueeze(paddle.to_tensor(B2), axis=1),
+     lambda: B2[:, None]),
+    ("split0", lambda: paddle.split(paddle.to_tensor(A), 2, axis=2)[0],
+     lambda: A[:, :, :2]),
+    ("chunk1", lambda: paddle.chunk(paddle.to_tensor(A), 3, axis=1)[1],
+     lambda: A[:, 1:2]),
+    ("expand", lambda: paddle.expand(paddle.to_tensor(B2[None]),
+                                     [4, 3, 4]),
+     lambda: np.broadcast_to(B2, (4, 3, 4))),
+    ("flatten", lambda: paddle.flatten(paddle.to_tensor(A), 1, 2),
+     lambda: A.reshape(2, 12)),
+    ("rot90", lambda: paddle.rot90(paddle.to_tensor(B2)),
+     lambda: np.rot90(B2)),
+    ("moveaxis", lambda: paddle.moveaxis(paddle.to_tensor(A), 0, 2),
+     lambda: np.moveaxis(A, 0, 2)),
+    ("repeat_interleave",
+     lambda: paddle.repeat_interleave(paddle.to_tensor(B2), 2, axis=0),
+     lambda: np.repeat(B2, 2, 0)),
+    ("broadcast_to", lambda: paddle.broadcast_to(paddle.to_tensor(B2),
+                                                 [2, 3, 4]),
+     lambda: np.broadcast_to(B2, (2, 3, 4))),
+    ("as_strided_diag", lambda: paddle.diag(paddle.to_tensor(B2[:3, :3])),
+     lambda: np.diag(B2[:3, :3])),
+    ("tril", lambda: paddle.tril(paddle.to_tensor(B2)),
+     lambda: np.tril(B2)),
+    ("triu", lambda: paddle.triu(paddle.to_tensor(B2)),
+     lambda: np.triu(B2)),
+]
+
+
+@pytest.mark.parametrize("name,fn,ref", MANIP, ids=[c[0] for c in MANIP])
+def test_manip_forward(name, fn, ref):
+    np.testing.assert_allclose(fn().numpy(), ref(), rtol=1e-6)
+
+
+def test_pad_modes():
+    x = paddle.to_tensor(B2)
+    np.testing.assert_allclose(
+        paddle.nn.functional.pad(x, [1, 2], value=7.0).numpy(),
+        np.pad(B2, ((0, 0), (1, 2)), constant_values=7.0), rtol=1e-6)
+    x4 = paddle.to_tensor(A[None])
+    out = paddle.nn.functional.pad(x4, [1, 1, 2, 2], mode="reflect")
+    ref = np.pad(A[None], ((0, 0), (0, 0), (2, 2), (1, 1)),
+                 mode="reflect")
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+def test_gather_scatter_index_ops():
+    idx = np.array([2, 0], np.int64)
+    np.testing.assert_allclose(
+        paddle.gather(paddle.to_tensor(B2), paddle.to_tensor(idx)).numpy(),
+        B2[idx], rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.index_select(paddle.to_tensor(B2), paddle.to_tensor(idx),
+                            axis=0).numpy(), B2[idx], rtol=1e-6)
+    upd = np.ones((2, 4), np.float32)
+    out = paddle.scatter(paddle.to_tensor(B2), paddle.to_tensor(idx),
+                         paddle.to_tensor(upd), overwrite=True)
+    ref = B2.copy()
+    ref[idx] = upd
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+    # take_along_axis / put_along_axis
+    ta = paddle.take_along_axis(paddle.to_tensor(B2),
+                                paddle.to_tensor(np.array([[1], [2], [0]])),
+                                axis=1)
+    np.testing.assert_allclose(
+        ta.numpy(), np.take_along_axis(B2, np.array([[1], [2], [0]]), 1))
+    mask = B2 > 0.5
+    np.testing.assert_allclose(
+        paddle.masked_select(paddle.to_tensor(B2),
+                             paddle.to_tensor(mask)).numpy(), B2[mask])
+
+
+@pytest.mark.parametrize("name,fn", [
+    ("concat", lambda a, b: paddle.concat([a, b], axis=0)),
+    ("stack", lambda a, b: paddle.stack([a, b])),
+    ("tile", lambda a, b: paddle.tile(a, [2, 2]) + paddle.sum(b) * 0),
+    ("transpose", lambda a, b: paddle.transpose(a, [1, 0]) +
+     paddle.transpose(b, [1, 0])),
+    ("gather", lambda a, b: paddle.gather(
+        a, paddle.to_tensor(np.array([1, 0], np.int64))) + b[:2]),
+], ids=["concat", "stack", "tile", "transpose", "gather"])
+def test_manip_grads(name, fn):
+    gradcheck(fn, [B2[:2, :3].copy(), B2[:2, :3].copy() + 0.5])
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+def _spd(n=3):
+    m = RS.rand(n, n).astype("float32")
+    return (m @ m.T + n * np.eye(n, dtype="float32"))
+
+
+LINALG_FWD = [
+    ("matmul", lambda: paddle.matmul(paddle.to_tensor(B2),
+                                     paddle.to_tensor(B2.T)),
+     lambda: B2 @ B2.T),
+    ("dot", lambda: paddle.dot(paddle.to_tensor(B2[0]),
+                               paddle.to_tensor(B2[1])),
+     lambda: B2[0] @ B2[1]),
+    ("t", lambda: paddle.t(paddle.to_tensor(B2)), lambda: B2.T),
+    ("inv", lambda: paddle.linalg.inv(paddle.to_tensor(_spd())),
+     None),
+    ("det", lambda: paddle.linalg.det(paddle.to_tensor(_spd())), None),
+    ("slogdet", lambda: paddle.linalg.slogdet(
+        paddle.to_tensor(_spd()))[1], None),
+    ("norm_fro", lambda: paddle.linalg.norm(paddle.to_tensor(B2)),
+     lambda: np.linalg.norm(B2)),
+    ("cond", lambda: paddle.linalg.cond(paddle.to_tensor(_spd())), None),
+    ("matrix_rank", lambda: paddle.linalg.matrix_rank(
+        paddle.to_tensor(_spd())), None),
+    ("pinv", lambda: paddle.linalg.pinv(paddle.to_tensor(B2)), None),
+]
+
+
+@pytest.mark.parametrize("name,fn,ref", LINALG_FWD,
+                         ids=[c[0] for c in LINALG_FWD])
+def test_linalg_forward(name, fn, ref):
+    out = fn()
+    if ref is not None:
+        np.testing.assert_allclose(out.numpy(), ref(), rtol=1e-4,
+                                   atol=1e-5)
+    else:
+        assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_linalg_identities():
+    m = _spd()
+    t = paddle.to_tensor(m)
+    inv = paddle.linalg.inv(t)
+    np.testing.assert_allclose((paddle.matmul(t, inv)).numpy(), np.eye(3),
+                               atol=1e-4)
+    L = paddle.linalg.cholesky(t)
+    np.testing.assert_allclose(
+        paddle.matmul(L, paddle.t(L)).numpy(), m, rtol=1e-4, atol=1e-4)
+    q, r = paddle.linalg.qr(paddle.to_tensor(B2))
+    np.testing.assert_allclose(paddle.matmul(q, r).numpy(), B2, atol=1e-5)
+    u, s, vh = paddle.linalg.svd(paddle.to_tensor(B2))
+    rec = (u.numpy() * s.numpy()[None, :]) @ vh.numpy()
+    np.testing.assert_allclose(rec, B2, atol=1e-4)
+    # eigh on SPD: reconstruct
+    w, v = paddle.linalg.eigh(t)
+    rec = v.numpy() @ np.diag(w.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(rec, m, rtol=1e-3, atol=1e-3)
+    # solve
+    b = RS.rand(3).astype("float32")
+    x = paddle.linalg.solve(t, paddle.to_tensor(b))
+    np.testing.assert_allclose(m @ x.numpy(), b, atol=1e-4)
+    # lstsq
+    sol = paddle.linalg.lstsq(paddle.to_tensor(B2.T),
+                              paddle.to_tensor(RS.rand(4, 1)
+                                               .astype("float32")))[0]
+    assert sol.shape[0] == 3
+    # triangular_solve
+    Lt = np.tril(_spd())
+    bb = RS.rand(3, 1).astype("float32")
+    xt = paddle.linalg.triangular_solve(paddle.to_tensor(Lt),
+                                        paddle.to_tensor(bb), upper=False)
+    np.testing.assert_allclose(Lt @ xt.numpy(), bb, atol=1e-4)
+
+
+@pytest.mark.parametrize("name,fn", [
+    ("matmul", lambda a, b: paddle.matmul(a, b)),
+    ("matmul_tA", lambda a, b: paddle.matmul(a, b, transpose_x=True)),
+    ("inv", lambda a, b: paddle.linalg.inv(a + paddle.t(a) +
+                                           3 * paddle.to_tensor(
+                                               np.eye(3, dtype="float32")))
+     + 0 * paddle.sum(b)),
+    ("det", lambda a, b: paddle.linalg.det(a + paddle.t(a) +
+                                           3 * paddle.to_tensor(
+                                               np.eye(3, dtype="float32")))
+     + 0 * paddle.sum(b)),
+    ("solve", lambda a, b: paddle.linalg.solve(
+        a + paddle.t(a) + 3 * paddle.to_tensor(np.eye(3, dtype="float32")),
+        b)),
+], ids=["matmul", "matmul_tA", "inv", "det", "solve"])
+def test_linalg_grads(name, fn):
+    a = RS.rand(3, 3).astype("float32")
+    b = RS.rand(3, 3).astype("float32")
+    gradcheck(fn, [a, b], max_rel=2e-2)
+
+
+def test_einsum_forward_and_grad():
+    a = RS.rand(2, 3).astype("float32")
+    b = RS.rand(3, 4).astype("float32")
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                        paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+    gradcheck(lambda x, y: paddle.einsum("ij,jk->ik", x, y), [a, b])
+
+
+def test_bmm_mv_outer_cross_kron():
+    a3 = RS.rand(2, 2, 3).astype("float32")
+    b3 = RS.rand(2, 3, 2).astype("float32")
+    np.testing.assert_allclose(
+        paddle.bmm(paddle.to_tensor(a3), paddle.to_tensor(b3)).numpy(),
+        a3 @ b3, rtol=1e-5)
+    m = B2
+    v = RS.rand(4).astype("float32")
+    np.testing.assert_allclose(
+        paddle.mv(paddle.to_tensor(m), paddle.to_tensor(v)).numpy(),
+        m @ v, rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.outer(paddle.to_tensor(v), paddle.to_tensor(v)).numpy(),
+        np.outer(v, v), rtol=1e-5)
+    c1 = RS.rand(3).astype("float32")
+    c2 = RS.rand(3).astype("float32")
+    np.testing.assert_allclose(
+        paddle.cross(paddle.to_tensor(c1), paddle.to_tensor(c2)).numpy(),
+        np.cross(c1, c2), rtol=1e-5)
+    k1 = RS.rand(2, 2).astype("float32")
+    np.testing.assert_allclose(
+        paddle.kron(paddle.to_tensor(k1), paddle.to_tensor(k1)).numpy(),
+        np.kron(k1, k1), rtol=1e-5)

@@ -1,0 +1,262 @@
+"""Table-driven OpTest coverage: conv / pooling / normalization
+families — numpy oracles + finite-difference grad checks.
+
+Reference parity: ``test_conv2d_op.py``, ``test_pool2d_op.py``,
+``test_batch_norm_op.py`` etc. under the reference unittest tree.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from gradcheck import gradcheck, well_separated
+
+RS = np.random.RandomState(0)
+
+
+# ---------------------------------------------------------------------------
+# naive conv oracles
+# ---------------------------------------------------------------------------
+def conv2d_ref(x, w, stride=1, padding=0, dilation=1, groups=1):
+    N, C, H, W = x.shape
+    O, Cg, kh, kw = w.shape
+    s, p, d = stride, padding, dilation
+    xp = np.pad(x, ((0, 0), (0, 0), (p, p), (p, p)))
+    oh = (H + 2 * p - d * (kh - 1) - 1) // s + 1
+    ow = (W + 2 * p - d * (kw - 1) - 1) // s + 1
+    out = np.zeros((N, O, oh, ow), np.float64)
+    og = O // groups
+    for n in range(N):
+        for o in range(O):
+            g = o // og
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[n, g * Cg:(g + 1) * Cg,
+                               i * s:i * s + d * kh:d,
+                               j * s:j * s + d * kw:d]
+                    out[n, o, i, j] = (patch * w[o]).sum()
+    return out.astype(x.dtype)
+
+
+def conv1d_ref(x, w, stride=1, padding=0):
+    x4 = x[:, :, None, :]
+    w4 = w[:, :, None, :]
+    return conv2d_ref(x4, w4, stride=stride, padding=0 if padding == 0
+                      else padding)[:, :, 0, :] if padding == 0 else \
+        conv2d_ref(np.pad(x, ((0, 0), (0, 0), (padding, padding)))[
+            :, :, None, :], w4, stride=stride)[:, :, 0, :]
+
+
+CONV_CASES = [
+    ("conv2d_basic", dict(stride=1, padding=0, dilation=1, groups=1),
+     (1, 2, 5, 5), (3, 2, 3, 3)),
+    ("conv2d_stride2_pad1", dict(stride=2, padding=1, dilation=1,
+                                 groups=1), (1, 2, 6, 6), (2, 2, 3, 3)),
+    ("conv2d_dilation2", dict(stride=1, padding=2, dilation=2, groups=1),
+     (1, 1, 7, 7), (2, 1, 3, 3)),
+    ("conv2d_groups2", dict(stride=1, padding=0, dilation=1, groups=2),
+     (1, 4, 5, 5), (4, 2, 3, 3)),
+]
+
+
+@pytest.mark.parametrize("name,kw,xs,ws", CONV_CASES,
+                         ids=[c[0] for c in CONV_CASES])
+def test_conv2d_forward(name, kw, xs, ws):
+    x = RS.rand(*xs).astype("float32")
+    w = RS.rand(*ws).astype("float32")
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), **kw)
+    np.testing.assert_allclose(out.numpy(), conv2d_ref(x, w, **kw),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("name,kw,xs,ws", CONV_CASES[:2],
+                         ids=[c[0] for c in CONV_CASES[:2]])
+def test_conv2d_grad(name, kw, xs, ws):
+    x = RS.rand(*xs).astype("float32")
+    w = RS.rand(*ws).astype("float32")
+    gradcheck(F.conv2d, [x, w], max_rel=1e-2, **kw)
+
+
+def test_conv1d_forward_and_grad():
+    x = RS.rand(1, 2, 8).astype("float32")
+    w = RS.rand(3, 2, 3).astype("float32")
+    out = F.conv1d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+    np.testing.assert_allclose(out.numpy(), conv1d_ref(x, w, padding=1),
+                               rtol=1e-4, atol=1e-4)
+    gradcheck(F.conv1d, [x[:, :, :5], w], max_rel=1e-2)
+
+
+def test_conv3d_shape_and_grad():
+    x = RS.rand(1, 1, 4, 4, 4).astype("float32")
+    w = RS.rand(2, 1, 3, 3, 3).astype("float32")
+    out = F.conv3d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+    assert out.shape == [1, 2, 4, 4, 4]
+    gradcheck(F.conv3d, [x, w], max_rel=1e-2, padding=1)
+
+
+def test_conv2d_transpose_matches_gradient_of_conv():
+    """conv_transpose(x, w) is the vjp of conv wrt its input — check
+    against autodiff of the forward conv (the reference tests transpose
+    conv the same way)."""
+    x = RS.rand(1, 3, 4, 4).astype("float32")
+    w = RS.rand(3, 2, 3, 3).astype("float32")   # (Cin, Cout, kh, kw)
+    out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w))
+    assert out.shape == [1, 2, 6, 6]
+    gradcheck(F.conv2d_transpose, [x, w], max_rel=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+def avg_pool2d_ref(x, k, s):
+    N, C, H, W = x.shape
+    oh, ow = (H - k) // s + 1, (W - k) // s + 1
+    out = np.zeros((N, C, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = x[:, :, i * s:i * s + k,
+                                j * s:j * s + k].mean((-1, -2))
+    return out
+
+
+def max_pool2d_ref(x, k, s):
+    N, C, H, W = x.shape
+    oh, ow = (H - k) // s + 1, (W - k) // s + 1
+    out = np.zeros((N, C, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = x[:, :, i * s:i * s + k,
+                                j * s:j * s + k].max((-1, -2))
+    return out
+
+
+def test_avg_pool2d():
+    x = RS.rand(1, 2, 6, 6).astype("float32")
+    out = F.avg_pool2d(paddle.to_tensor(x), 2, stride=2)
+    np.testing.assert_allclose(out.numpy(), avg_pool2d_ref(x, 2, 2),
+                               rtol=1e-5)
+    gradcheck(F.avg_pool2d, [x[:, :1, :4, :4]], kernel_size=2, stride=2)
+
+
+def test_max_pool2d():
+    x = well_separated((1, 2, 6, 6), 0, 2)
+    out = F.max_pool2d(paddle.to_tensor(x), 2, stride=2)
+    np.testing.assert_allclose(out.numpy(), max_pool2d_ref(x, 2, 2),
+                               rtol=1e-5)
+    gradcheck(F.max_pool2d, [x[:, :1, :4, :4]], kernel_size=2, stride=2)
+
+
+def test_max_pool2d_return_mask():
+    x = well_separated((1, 1, 4, 4), 0, 1)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                             return_mask=True)
+    np.testing.assert_allclose(out.numpy(), max_pool2d_ref(x, 2, 2))
+    assert mask.shape == [1, 1, 2, 2]
+
+
+@pytest.mark.parametrize("fn,nd", [(F.avg_pool1d, 1), (F.max_pool1d, 1),
+                                   (F.avg_pool3d, 3), (F.max_pool3d, 3)],
+                         ids=["avg1d", "max1d", "avg3d", "max3d"])
+def test_pool_1d_3d_shapes(fn, nd):
+    shape = (1, 2) + (6,) * nd
+    x = well_separated(shape, 0, 2)
+    out = fn(paddle.to_tensor(x), 2, stride=2)
+    assert out.shape == [1, 2] + [3] * nd
+
+
+def test_adaptive_pools():
+    x = RS.rand(1, 2, 6, 6).astype("float32")
+    out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 3)
+    np.testing.assert_allclose(out.numpy(), avg_pool2d_ref(x, 2, 2),
+                               rtol=1e-5)
+    xs = well_separated((1, 2, 6, 6), 0, 2)
+    out = F.adaptive_max_pool2d(paddle.to_tensor(xs), 3)
+    np.testing.assert_allclose(out.numpy(), max_pool2d_ref(xs, 2, 2),
+                               rtol=1e-5)
+    out = F.adaptive_avg_pool1d(paddle.to_tensor(x[:, :, 0]), 3)
+    assert out.shape == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def test_layer_norm_forward_and_grad():
+    x = RS.rand(2, 3, 8).astype("float32")
+    g = RS.rand(8).astype("float32") + 0.5
+    b = RS.rand(8).astype("float32")
+    out = F.layer_norm(paddle.to_tensor(x), [8], paddle.to_tensor(g),
+                       paddle.to_tensor(b), 1e-5)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5) * g + b
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+    gradcheck(lambda t, gg, bb: F.layer_norm(t, [8], gg, bb, 1e-5),
+              [x[:1, :2], g, b], max_rel=2e-2)
+
+
+def test_batch_norm_train_and_eval():
+    x = RS.rand(4, 3, 5).astype("float32")
+    rm = np.zeros(3, np.float32)
+    rv = np.ones(3, np.float32)
+    g = np.ones(3, np.float32)
+    b = np.zeros(3, np.float32)
+    out = F.batch_norm(paddle.to_tensor(x), paddle.to_tensor(rm),
+                       paddle.to_tensor(rv), paddle.to_tensor(g),
+                       paddle.to_tensor(b), training=True)
+    mu = x.mean((0, 2), keepdims=True)
+    var = x.var((0, 2), keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+    # eval mode normalizes by running stats
+    out = F.batch_norm(paddle.to_tensor(x), paddle.to_tensor(rm),
+                       paddle.to_tensor(rv), paddle.to_tensor(g),
+                       paddle.to_tensor(b), training=False)
+    np.testing.assert_allclose(out.numpy(), x / np.sqrt(1 + 1e-5),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_instance_and_group_norm():
+    x = RS.rand(2, 4, 6).astype("float32")
+    out = F.instance_norm(paddle.to_tensor(x))
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), (x - mu) / np.sqrt(var + 1e-5),
+                               rtol=1e-3, atol=1e-4)
+    xg = RS.rand(2, 4, 3, 3).astype("float32")
+    out = F.group_norm(paddle.to_tensor(xg), num_groups=2)
+    r = xg.reshape(2, 2, 2 * 9)
+    mu = r.mean(-1, keepdims=True)
+    var = r.var(-1, keepdims=True)
+    ref = ((r - mu) / np.sqrt(var + 1e-5)).reshape(xg.shape)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_norm_grads():
+    x = RS.rand(2, 3, 4).astype("float32")
+    gradcheck(lambda t: F.instance_norm(t), [x], max_rel=2e-2)
+    gradcheck(lambda t: F.group_norm(t, num_groups=3), [x], max_rel=2e-2)
+    gradcheck(lambda t: F.local_response_norm(t, size=3), [x],
+              max_rel=2e-2)
+
+
+def test_rnn_cells_grad():
+    """SimpleRNN/GRU/LSTM cell grads through the tape (reference
+    test_rnn_cells)."""
+    B, I, H = 2, 3, 4
+    x = RS.rand(B, I).astype("float32")
+    h = RS.rand(B, H).astype("float32")
+    cell = paddle.nn.SimpleRNNCell(I, H)
+    out, _ = cell(paddle.to_tensor(x), paddle.to_tensor(h))
+    assert out.shape == [B, H]
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    out, _ = cell(xt)
+    paddle.sum(out).backward()
+    assert xt.grad is not None
+    for Cell in (paddle.nn.GRUCell, paddle.nn.LSTMCell):
+        cell = Cell(I, H)
+        xt = paddle.to_tensor(x, stop_gradient=False)
+        res = cell(xt)
+        out = res[0]
+        paddle.sum(out).backward()
+        assert xt.grad is not None and \
+            float(paddle.sum(paddle.abs(xt.grad))) > 0

@@ -111,19 +111,27 @@ def test_fleet_init_worker_selects_mode(ps_pair, monkeypatch):
     server, client, ep = ps_pair
     from paddle_tpu.distributed import fleet
     monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", ep)
-    strat = fleet.DistributedStrategy()
-    strat.a_sync = True
-    strat.a_sync_configs = {"k_steps": 8}
-    fleet.init(is_collective=False, strategy=strat)
-    comm = fleet.init_worker()
-    assert comm.mode == "geo" and comm._k_steps == 8
-    fleet.stop_worker()
-    strat2 = fleet.DistributedStrategy()
-    strat2.a_sync = True
-    fleet.init(is_collective=False, strategy=strat2)
-    comm = fleet.init_worker()
-    assert comm.mode == "async"
-    fleet.stop_worker()
+    try:
+        strat = fleet.DistributedStrategy()
+        strat.a_sync = True
+        strat.a_sync_configs = {"k_steps": 8}
+        fleet.init(is_collective=False, strategy=strat)
+        comm = fleet.init_worker()
+        assert comm.mode == "geo" and comm._k_steps == 8
+        # the communicator keeps the full PSClient surface
+        assert comm._endpoints == [ep]
+        fleet.stop_worker()
+        strat2 = fleet.DistributedStrategy()
+        strat2.a_sync = True
+        fleet.init(is_collective=False, strategy=strat2)
+        comm = fleet.init_worker()
+        assert comm.mode == "async"
+        fleet.stop_worker()
+    finally:
+        # don't leak the a_sync strategy into later fleet users (the
+        # module-global strategy governs init_worker's mode)
+        fleet.init(is_collective=False,
+                   strategy=fleet.DistributedStrategy())
 
 
 def test_distributed_embedding_trains(ps_pair):

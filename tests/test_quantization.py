@@ -1,0 +1,89 @@
+"""Int8 quantization tests (paddle_tpu.quantization).
+
+Reference parity: ``inference/api/mkldnn_quantizer.cc`` (PTQ calibration
++ int8 kernels) and the slim QAT fake_quantize passes.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.quantization import (QAT, PostTrainingQuantization,
+                                     QuantizedLinear,
+                                     fake_quantize_abs_max,
+                                     quantize_weights)
+
+
+def _net():
+    paddle.seed(0)
+    return paddle.nn.Sequential(paddle.nn.Linear(16, 32),
+                                paddle.nn.ReLU(),
+                                paddle.nn.Linear(32, 8))
+
+
+X = np.random.RandomState(0).rand(4, 16).astype("float32")
+
+
+def _clone(net):
+    n = _net()
+    n.set_state_dict(net.state_dict())
+    return n
+
+
+def test_weight_only_int8():
+    net = _net()
+    ref = net(paddle.to_tensor(X)).numpy()
+    q = quantize_weights(_clone(net))
+    out = q(paddle.to_tensor(X)).numpy()
+    rel = np.abs(out - ref).max() / np.abs(ref).max()
+    assert rel < 0.02
+    lin = q._sub_layers["0"]
+    assert isinstance(lin, QuantizedLinear)
+    assert lin.weight_q.dtype == np.int8
+    assert lin.in_scale is None                 # weight-only mode
+
+
+def test_static_ptq_int8_matmul():
+    net = _net()
+    ref = net(paddle.to_tensor(X)).numpy()
+    q = _clone(net)
+    PostTrainingQuantization(q).calibrate(
+        [(paddle.to_tensor(X),)]).convert()
+    out = q(paddle.to_tensor(X)).numpy()
+    rel = np.abs(out - ref).max() / np.abs(ref).max()
+    assert rel < 0.05
+    lin = q._sub_layers["0"]
+    assert lin.in_scale is not None             # calibrated activation
+    # int8 weights, per-channel scales
+    assert lin.weight_q.dtype == np.int8
+    assert lin.w_scales.shape == (32,)
+
+
+def test_fake_quantize_levels_and_ste():
+    x = np.linspace(-1, 1, 64).astype("float32").reshape(8, 8)
+    fq = fake_quantize_abs_max(paddle.to_tensor(x)).numpy()
+    scale = np.abs(x).max() / 127
+    assert len(np.unique(np.round(fq / scale))) <= 255
+    # straight-through gradient: ones inside the clip window
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    paddle.sum(fake_quantize_abs_max(xt)).backward()
+    np.testing.assert_allclose(xt.grad.numpy(), np.ones_like(x))
+
+
+def test_qat_trains():
+    paddle.seed(1)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 1))
+    QAT(bits=8).quantize(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    rs = np.random.RandomState(2)
+    xb = rs.rand(32, 8).astype("float32")
+    yb = (xb @ rs.rand(8, 1).astype("float32"))
+    losses = []
+    for _ in range(30):
+        out = net(paddle.to_tensor(xb))
+        loss = paddle.mean((out - paddle.to_tensor(yb)) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5

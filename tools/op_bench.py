@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Config-driven op micro-benchmark harness.
+
+Reference parity: ``paddle/fluid/operators/benchmark/op_tester.cc`` (+
+``op_tester_config``) — per-op timing driven by small config entries —
+and the CI gates ``tools/test_ci_op_benchmark.sh`` /
+``tools/check_op_benchmark_result.py``.
+
+Usage:
+    python tools/op_bench.py                      # built-in suite
+    python tools/op_bench.py --config my.json     # custom entries
+    python tools/op_bench.py --baseline old.json  # regression compare
+
+Config entry: {"op": "matmul", "shapes": [[1024,1024],[1024,1024]],
+"dtype": "float32", "kwargs": {}, "repeat": 30}
+Emits one JSON line per op: {"op", "eager_us", "jit_us", "shapes"}.
+A baseline compare fails (exit 1) when jit time regresses >20%
+(check_op_benchmark_result.py's relative gate).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEFAULT_SUITE = [
+    {"op": "matmul", "shapes": [[512, 512], [512, 512]]},
+    {"op": "add", "shapes": [[1024, 1024], [1024, 1024]]},
+    {"op": "multiply", "shapes": [[1024, 1024], [1024, 1024]]},
+    {"op": "sum", "shapes": [[2048, 512]]},
+    {"op": "softmax", "shapes": [[256, 1024]], "module": "nn.functional"},
+    {"op": "relu", "shapes": [[2048, 512]], "module": "nn.functional"},
+    {"op": "exp", "shapes": [[1024, 1024]]},
+    {"op": "transpose", "shapes": [[1024, 1024]],
+     "kwargs": {"perm": [1, 0]}},
+    {"op": "concat", "shapes": [[512, 512], [512, 512]], "is_list": True},
+    {"op": "layer_norm", "shapes": [[256, 1024]],
+     "module": "nn.functional", "kwargs": {"normalized_shape": [1024]}},
+]
+
+
+def _resolve(paddle, entry):
+    mod = paddle
+    for part in entry.get("module", "").split("."):
+        if part:
+            mod = getattr(mod, part)
+    return getattr(mod, entry["op"])
+
+
+def bench_entry(paddle, jax, np, entry):
+    import jax.numpy as jnp
+    fn = _resolve(paddle, entry)
+    rs = np.random.RandomState(0)
+    dtype = entry.get("dtype", "float32")
+    args = [paddle.to_tensor(rs.rand(*s).astype(dtype))
+            for s in entry["shapes"]]
+    kwargs = entry.get("kwargs", {})
+    call = (lambda: fn(args, **kwargs)) if entry.get("is_list") \
+        else (lambda: fn(*args, **kwargs))
+    repeat = entry.get("repeat", 30)
+
+    out = call()                      # warm the eager path
+    jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = call()
+    jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+    eager_us = (time.perf_counter() - t0) / repeat * 1e6
+
+    raw = [a._data for a in args]
+
+    def jfn(*arrs):
+        ts = [paddle.Tensor(a) for a in arrs]
+        with paddle.no_grad():
+            o = fn(ts, **kwargs) if entry.get("is_list") \
+                else fn(*ts, **kwargs)
+        return o._data if hasattr(o, "_data") else o
+
+    jitted = jax.jit(jfn)
+    jax.block_until_ready(jitted(*raw))
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        r = jitted(*raw)
+    jax.block_until_ready(r)
+    jit_us = (time.perf_counter() - t0) / repeat * 1e6
+    return {"op": entry["op"], "shapes": entry["shapes"],
+            "eager_us": round(eager_us, 1), "jit_us": round(jit_us, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", help="json list of entries")
+    ap.add_argument("--baseline", help="previous output for regression "
+                    "compare (>20%% jit regression fails)")
+    ap.add_argument("--out", help="write results json here")
+    args = ap.parse_args()
+
+    import jax
+    env = os.environ.get("JAX_PLATFORMS")
+    if env and jax.config.jax_platforms != env:
+        jax.config.update("jax_platforms", env)
+    import numpy as np
+    import paddle_tpu as paddle
+
+    suite = DEFAULT_SUITE
+    if args.config:
+        suite = json.load(open(args.config))
+    results = []
+    for entry in suite:
+        r = bench_entry(paddle, jax, np, entry)
+        print(json.dumps(r))
+        results.append(r)
+    if args.out:
+        json.dump(results, open(args.out, "w"), indent=1)
+    if args.baseline:
+        base = {b["op"]: b for b in json.load(open(args.baseline))}
+        bad = [r for r in results
+               if r["op"] in base
+               and r["jit_us"] > 1.2 * base[r["op"]]["jit_us"]]
+        if bad:
+            for r in bad:
+                print(f"REGRESSION {r['op']}: {r['jit_us']}us vs "
+                      f"{base[r['op']]['jit_us']}us", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
