@@ -1,2 +1,3 @@
 """fleet.utils (reference fleet/utils/)."""
 from .recompute import recompute  # noqa: F401
+from .fs import FS, LocalFS, HDFSClient  # noqa: F401

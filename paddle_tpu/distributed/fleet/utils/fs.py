@@ -1,0 +1,197 @@
+"""Filesystem clients for fleet checkpoint/data staging.
+
+Reference parity: ``python/paddle/distributed/fleet/utils/fs.py`` —
+``LocalFS`` (:119) and ``HDFSClient`` (:423): the FS abstraction the PS
+runtime uses to snapshot tables and the trainers use to stage data.
+
+TPU translation: LocalFS is the real implementation (and what orbax
+checkpointing rides); HDFSClient keeps the interface but shells out to
+a ``hadoop`` binary when one exists — in the zero-egress build it
+raises UnavailableError with a clear message instead of half-working.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Tuple
+
+from ....core.errors import UnavailableError
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class ExecuteError(RuntimeError):
+    pass
+
+
+class FS:
+    """Interface (reference fs.py:40 abstract base)."""
+
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_file(self, path):
+        raise NotImplementedError
+
+    def is_dir(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def rename(self, src, dst):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """reference fs.py:119 — local filesystem client."""
+
+    def ls_dir(self, path) -> Tuple[List[str], List[str]]:
+        """Returns (dirs, files) like the reference."""
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def delete(self, path):
+        if self.is_dir(path):
+            shutil.rmtree(path)
+        elif self.is_file(path):
+            os.remove(path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            if exist_ok:
+                return
+            raise FileExistsError(path)
+        with open(path, "a"):
+            pass
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src):
+            raise FileNotFoundError(src)
+        if self.is_exist(dst):
+            if not overwrite:
+                raise FileExistsError(dst)
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def list_dirs(self, path) -> List[str]:
+        return self.ls_dir(path)[0]
+
+    def upload(self, local_path, fs_path):
+        if os.path.abspath(local_path) != os.path.abspath(fs_path):
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        if os.path.abspath(local_path) != os.path.abspath(fs_path):
+            shutil.copy(fs_path, local_path)
+
+
+class HDFSClient(FS):
+    """reference fs.py:423 — `hadoop fs` subprocess client.
+
+    Functional when a ``hadoop`` binary is on PATH; in the zero-egress
+    TPU build every call raises UnavailableError so callers can fall
+    back to LocalFS (the reference raises ExecuteError on a missing
+    binary the same way)."""
+
+    def __init__(self, hadoop_home=None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        self._configs = configs or {}
+        self._available = shutil.which(self._hadoop) is not None
+
+    def _run(self, *args) -> str:
+        if not self._available:
+            raise UnavailableError(
+                "UNAVAILABLE: no `hadoop` binary on PATH — the zero-"
+                "egress TPU build has no HDFS; use LocalFS (orbax "
+                "checkpoints and PS snapshots work against it)")
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise ExecuteError(f"{' '.join(cmd)}: {r.stderr[-500:]}")
+        return r.stdout
+
+    def ls_dir(self, path):
+        out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, path):
+        try:
+            self._run("-test", "-f", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, path):
+        try:
+            self._run("-test", "-d", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", path)
+
+    def rename(self, src, dst):
+        self._run("-mv", src, dst)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def need_upload_download(self):
+        return True

@@ -478,13 +478,38 @@ class DataLoader:
             structure, arrays = pickle.loads(blob)
             return DataLoader._unpack(structure, arrays)
 
+        import queue as _queue
+        import time as _time
+        import warnings as _warnings
+        watchdog = self.timeout or 60.0
+        fallback = False
         try:
             pending: dict = {}
             for i in range(len(batches)):
-                while i not in pending:
-                    j, payload, err = result_q.get(
-                        timeout=self.timeout or 300)
-                    pending[j] = (payload, err)
+                if not fallback:
+                    last = _time.monotonic()
+                    while i not in pending and not fallback:
+                        try:
+                            j, payload, err = result_q.get(timeout=2)
+                            pending[j] = (payload, err)
+                            last = _time.monotonic()
+                        except _queue.Empty:
+                            # fork in a thread-heavy parent can deadlock
+                            # a child on inherited locks; after the
+                            # watchdog, finish the epoch in-process (the
+                            # reference kills hung workers similarly)
+                            dead = all(not pr.is_alive() for pr in procs)
+                            if dead or _time.monotonic() - last > watchdog:
+                                _warnings.warn(
+                                    "DataLoader process workers "
+                                    f"{'died' if dead else 'stalled'}; "
+                                    "falling back to in-process loading")
+                                for pr in procs:
+                                    pr.terminate()
+                                fallback = True
+                if fallback and i not in pending:
+                    yield self._fetch(batches[i])
+                    continue
                 payload, err = pending.pop(i)
                 if err is not None:
                     raise RuntimeError(

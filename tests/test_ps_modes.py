@@ -160,3 +160,31 @@ def test_distributed_embedding_trains(ps_pair):
     comm2.flush()
     comm2.stop()
     comm.stop()
+
+
+def test_fleet_fs_clients(tmp_path):
+    """LocalFS/HDFSClient (reference fleet/utils/fs.py:119,423)."""
+    from paddle_tpu.distributed.fleet.utils import LocalFS, HDFSClient
+    import paddle_tpu as paddle
+
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d) and not fs.is_file(d)
+    f = str(tmp_path / "a" / "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert dirs == ["b"] and files == ["x.txt"]
+    fs.mv(f, str(tmp_path / "a" / "y.txt"))
+    assert fs.is_file(str(tmp_path / "a" / "y.txt"))
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    assert not fs.need_upload_download()
+
+    # zero-egress build: HDFS raises a typed, actionable error
+    h = HDFSClient()
+    if not h._available:
+        import pytest as _pytest
+        with _pytest.raises(paddle.errors.UnavailableError):
+            h.ls_dir("/tmp")
