@@ -1,24 +1,31 @@
-"""paddle.onnx (reference python/paddle/onnx/export.py).
+"""paddle.onnx (reference python/paddle/onnx/export.py → paddle2onnx).
 
-The reference delegates to the external ``paddle2onnx`` converter.  The
-TPU-native interchange format is StableHLO (what ``jit.save`` /
-``save_inference_model`` emit — portable, versioned, consumed by any
-PJRT runtime), so ``export`` always produces that artifact and returns
-its path; a ``.onnx`` suffix on ``path`` is replaced to make the actual
-format explicit.
+``export`` emits a REAL ONNX ModelProto (opset 13) — the jaxpr of the
+traced layer maps primitive-by-primitive to ONNX nodes and a
+zero-dependency protobuf writer serialises it (see onnx/proto.py,
+onnx/export.py).  For graphs using primitives outside the supported
+MLP/CNN inference surface, ``export(..., fallback_stablehlo=True)``
+writes the StableHLO artifact instead (the TPU-native interchange
+format from ``jit.save``).
 """
 from __future__ import annotations
 
-import os
+from ..core.errors import UnimplementedError
+from .export import export as _onnx_export
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Export ``layer`` for interchange (reference ``onnx/export.py``
-    export).  Writes the StableHLO artifact at ``path``; the ``.onnx``
-    suffix is replaced to make the format explicit."""
-    base = path[:-5] if path.endswith(".onnx") else path
-    from ..jit import save as jit_save
-    jit_save(layer, base, input_spec=input_spec)
-    return base + ".pdmodel"
+def export(layer, path, input_spec=None, opset_version=13,
+           fallback_stablehlo: bool = False, **configs):
+    """Reference ``onnx/export.py`` export: write ``<path>.onnx``."""
+    try:
+        return _onnx_export(layer, path, input_spec=input_spec,
+                            opset_version=opset_version, **configs)
+    except UnimplementedError:
+        if not fallback_stablehlo:
+            raise
+        base = path[:-5] if path.endswith(".onnx") else path
+        from ..jit import save as jit_save
+        jit_save(layer, base, input_spec=input_spec)
+        return base + ".pdmodel"

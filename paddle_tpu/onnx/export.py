@@ -1,0 +1,305 @@
+"""ONNX export: trace a Layer/function to jaxpr, convert to ONNX nodes.
+
+Reference parity: ``python/paddle/onnx/export.py`` (paddle2onnx) — the
+reference walks its ProgramDesc and maps fluid ops to ONNX ops; here the
+captured program IS the jaxpr, and each lax primitive maps to an ONNX
+op (opset 13).  Supported primitives cover the MLP/CNN inference
+surface: matmul/add/mul/sub/div/neg, relu-style max, conv, reshape,
+transpose, broadcast, reductions, softmax composites, pooling
+(reduce_window), cast, slicing.  Unsupported primitives raise
+UnimplementedError naming the culprit.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import UnimplementedError
+from ..core.tensor import Tensor
+from . import proto as P
+
+__all__ = ["export"]
+
+
+class _Ctx:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.names: Dict[int, str] = {}   # id(jaxpr var) -> onnx name
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def name_of(self, var):
+        from jax._src.core import Literal
+        if isinstance(var, Literal):
+            val = np.asarray(var.val)
+            nm = self.fresh("const")
+            self.initializers.append(P.tensor_proto(nm, val))
+            return nm
+        key = id(var)
+        if key not in self.names:
+            self.names[key] = self.fresh("v")
+        return self.names[key]
+
+    def const(self, arr, hint="const"):
+        nm = self.fresh(hint)
+        self.initializers.append(P.tensor_proto(nm, np.asarray(arr)))
+        return nm
+
+    def add(self, op_type, inputs, outputs, attrs=()):
+        self.nodes.append(P.node_proto(
+            op_type, inputs, outputs, name=self.fresh(op_type.lower()),
+            attrs=list(attrs)))
+
+
+def _conv_attrs(ctx, eqn):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    # require NCHW/OIHW (the framework's conv lowering emits this)
+    lhs_spec = dn.lhs_spec if hasattr(dn, "lhs_spec") else dn[0]
+    strides = list(p["window_strides"])
+    padding = p["padding"]
+    pads = [pr[0] for pr in padding] + [pr[1] for pr in padding]
+    dil = list(p.get("rhs_dilation") or [1] * len(strides))
+    groups = int(p.get("feature_group_count", 1))
+    return [P.attr_ints("strides", strides), P.attr_ints("pads", pads),
+            P.attr_ints("dilations", dil), P.attr_int("group", groups)]
+
+
+def _convert_eqn(ctx: _Ctx, eqn):
+    prim = eqn.primitive.name
+    ins = [ctx.name_of(v) for v in eqn.invars]
+    outs = [ctx.name_of(v) for v in eqn.outvars]
+
+    simple = {"add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+              "max": "Max", "min": "Min", "pow": "Pow", "exp": "Exp",
+              "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+              "sqrt": "Sqrt", "rsqrt": None, "neg": "Neg", "abs": "Abs",
+              "erf": "Erf", "floor": "Floor", "ceil": "Ceil",
+              "sign": "Sign", "sin": "Sin", "cos": "Cos",
+              "select_n": None, "stop_gradient": "Identity",
+              "copy": "Identity"}
+    if prim in ("add", "sub", "mul", "div", "max", "min", "pow", "exp",
+                "log", "tanh", "logistic", "sqrt", "neg", "abs", "erf",
+                "floor", "ceil", "sign", "sin", "cos", "stop_gradient",
+                "copy"):
+        ctx.add(simple[prim], ins, outs)
+    elif prim == "add_any":
+        ctx.add("Add", ins, outs)
+    elif prim == "erfc":                # erfc(x) = 1 - erf(x)
+        mid = ctx.fresh("erf")
+        ctx.add("Erf", ins, [mid])
+        one = ctx.const(np.ones((), eqn.invars[0].aval.dtype), "one")
+        ctx.add("Sub", [one, mid], outs)
+    elif prim == "rsqrt":
+        mid = ctx.fresh("sqrt")
+        ctx.add("Sqrt", ins, [mid])
+        ctx.add("Reciprocal", [mid], outs)
+    elif prim == "integer_pow":
+        y = eqn.params["y"]
+        if y == 2:
+            ctx.add("Mul", [ins[0], ins[0]], outs)
+        else:
+            ctx.add("Pow", [ins[0],
+                            ctx.const(np.float32(y), "exp")], outs)
+    elif prim == "select_n":
+        # select_n(pred, on_false, on_true) -> Where(pred, true, false)
+        ctx.add("Where", [ins[0], ins[2], ins[1]], outs)
+    elif prim == "dot_general":
+        dims = eqn.params["dimension_numbers"]
+        (lc, rc), (lb, rb) = dims
+        if lb or rb:
+            ctx.add("MatMul", ins, outs)      # batched matmul
+        elif lc == (len(eqn.invars[0].aval.shape) - 1,) and rc == (0,):
+            ctx.add("MatMul", ins, outs)
+        else:
+            raise UnimplementedError(
+                f"UNIMPLEMENTED: dot_general layout {dims} in ONNX "
+                "export (transpose operands to standard matmul first)")
+    elif prim == "conv_general_dilated":
+        ctx.add("Conv", ins, outs, attrs=_conv_attrs(ctx, eqn))
+    elif prim == "reshape":
+        shape = ctx.const(np.asarray(eqn.params["new_sizes"], np.int64),
+                          "shape")
+        ctx.add("Reshape", [ins[0], shape], outs)
+    elif prim == "squeeze":
+        dims = ctx.const(np.asarray(eqn.params["dimensions"], np.int64),
+                         "axes")
+        ctx.add("Squeeze", [ins[0], dims], outs)
+    elif prim == "transpose":
+        ctx.add("Transpose", ins, outs,
+                attrs=[P.attr_ints("perm", eqn.params["permutation"])])
+    elif prim == "broadcast_in_dim":
+        # Expand to target shape; insert axes via Reshape when needed
+        tgt = list(eqn.params["shape"])
+        bdims = list(eqn.params["broadcast_dimensions"])
+        src_shape = list(eqn.invars[0].aval.shape)
+        mid_shape = [1] * len(tgt)
+        for i, d in enumerate(bdims):
+            mid_shape[d] = src_shape[i]
+        cur = ins[0]
+        if mid_shape != src_shape:
+            shp = ctx.const(np.asarray(mid_shape, np.int64), "shape")
+            mid = ctx.fresh("rs")
+            ctx.add("Reshape", [cur, shp], [mid])
+            cur = mid
+        shp = ctx.const(np.asarray(tgt, np.int64), "shape")
+        ctx.add("Expand", [cur, shp], outs)
+    elif prim == "convert_element_type":
+        dt = P._NP2ONNX[str(np.dtype(eqn.params["new_dtype"]))]
+        ctx.add("Cast", ins, outs, attrs=[P.attr_int("to", dt)])
+    elif prim == "reduce_sum":
+        axes = ctx.const(np.asarray(eqn.params["axes"], np.int64), "axes")
+        ctx.add("ReduceSum", [ins[0], axes], outs,
+                attrs=[P.attr_int("keepdims", 0)])
+    elif prim in ("reduce_max", "reduce_min"):
+        op = "ReduceMax" if prim == "reduce_max" else "ReduceMin"
+        ctx.add(op, [ins[0]], outs,
+                attrs=[P.attr_ints("axes", eqn.params["axes"]),
+                       P.attr_int("keepdims", 0)])
+    elif prim == "reduce_window_max":
+        _pool(ctx, eqn, ins, outs, "MaxPool")
+    elif prim == "reduce_window_sum":
+        # emitted by avg_pool: sum window then divide — divide appears
+        # as a separate eqn, so export the raw sum as LpPool is wrong;
+        # use AveragePool only when the caller divides; here keep sum
+        # via MaxPool-style attrs on AveragePool * window_size
+        _pool(ctx, eqn, ins, [ctx.fresh("avg")], "AveragePool",
+              extra_out=outs[0])
+    elif prim == "slice":
+        p = eqn.params
+        starts = ctx.const(np.asarray(p["start_indices"], np.int64), "st")
+        ends = ctx.const(np.asarray(p["limit_indices"], np.int64), "en")
+        axes = ctx.const(np.arange(len(p["start_indices"]),
+                                   dtype=np.int64), "ax")
+        steps = ctx.const(np.asarray(p["strides"] or
+                                     [1] * len(p["start_indices"]),
+                                     np.int64), "sp")
+        ctx.add("Slice", [ins[0], starts, ends, axes, steps], outs)
+    elif prim == "concatenate":
+        ctx.add("Concat", ins, outs,
+                attrs=[P.attr_int("axis", eqn.params["dimension"])])
+    elif prim in ("pjit", "jit", "closed_call", "core_call",
+                  "closed_call_p"):
+        inner = eqn.params["jaxpr"]
+        _convert_jaxpr(ctx, inner.jaxpr, ins, outs,
+                       [np.asarray(c) for c in inner.consts])
+    elif prim == "custom_jvp_call" or prim == "custom_vjp_call":
+        inner = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+        _convert_jaxpr(ctx, inner.jaxpr, ins, outs,
+                       [np.asarray(c) for c in inner.consts])
+    elif prim == "argmax":
+        ctx.add("ArgMax", ins, outs,
+                attrs=[P.attr_int("axis", eqn.params["axes"][0]),
+                       P.attr_int("keepdims", 0)])
+    elif prim == "iota":
+        aval = eqn.outvars[0].aval
+        arr = np.reshape(
+            np.broadcast_to(
+                np.arange(aval.shape[eqn.params["dimension"]]),
+                aval.shape), aval.shape).astype(np.dtype(aval.dtype))
+        nm = ctx.const(arr, "iota")
+        ctx.add("Identity", [nm], outs)
+    else:
+        raise UnimplementedError(
+            f"UNIMPLEMENTED: primitive '{prim}' has no ONNX mapping yet "
+            "(paddle_tpu.onnx supports the MLP/CNN inference surface)")
+
+
+def _pool(ctx, eqn, ins, outs, op, extra_out=None):
+    p = eqn.params
+    wd = list(p["window_dimensions"])
+    ws = list(p["window_strides"])
+    pad = p["padding"]
+    # spatial dims only (N, C leading)
+    kernel = wd[2:]
+    strides = ws[2:]
+    pads = [pr[0] for pr in pad[2:]] + [pr[1] for pr in pad[2:]]
+    attrs = [P.attr_ints("kernel_shape", kernel),
+             P.attr_ints("strides", strides),
+             P.attr_ints("pads", pads)]
+    if extra_out is not None:
+        # reduce_window_sum == AveragePool * prod(kernel)
+        mid = outs[0]
+        ctx.add(op, [ins[0]], [mid], attrs=attrs)
+        scale = ctx.const(np.float32(np.prod(kernel)), "winsz")
+        ctx.add("Mul", [mid, scale], [extra_out])
+    else:
+        ctx.add(op, [ins[0]], outs, attrs=attrs)
+
+
+def _convert_jaxpr(ctx: _Ctx, jaxpr, in_names, out_names, consts):
+    for var, nm in zip(jaxpr.invars, in_names):
+        ctx.names[id(var)] = nm
+    for var, c in zip(jaxpr.constvars, consts):
+        ctx.names[id(var)] = ctx.const(np.asarray(c), "w")
+    for eqn in jaxpr.eqns:
+        _convert_eqn(ctx, eqn)
+    # alias outputs onto requested names
+    for var, nm in zip(jaxpr.outvars, out_names):
+        got = ctx.name_of(var)
+        if got != nm:
+            ctx.add("Identity", [got], [nm])
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 13,
+           **configs):
+    """paddle.onnx.export parity: trace `layer` (a Layer or callable)
+    with `input_spec` (list of example Tensors/arrays or InputSpec-like
+    objects with .shape/.dtype) and write ``<path>.onnx``."""
+    if input_spec is None:
+        raise ValueError("onnx.export needs input_spec (example inputs)")
+
+    examples = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            examples.append(spec._data)
+        elif hasattr(spec, "shape") and hasattr(spec, "dtype") \
+                and not isinstance(spec, (np.ndarray, jnp.ndarray)):
+            shape = [1 if (d is None or int(d) < 0) else int(d)
+                     for d in spec.shape]
+            examples.append(jnp.zeros(shape, np.dtype(str(spec.dtype)
+                                                      .replace("paddle.",
+                                                               ""))))
+        else:
+            examples.append(jnp.asarray(spec))
+
+    from ..core import autograd
+
+    def fn(*arrs):
+        with autograd.no_grad():
+            out = layer(*[Tensor(a) for a in arrs])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    closed = jax.make_jaxpr(fn)(*examples)
+    ctx = _Ctx()
+    in_names = [f"input_{i}" for i in range(len(examples))]
+    n_out = len(closed.jaxpr.outvars)
+    out_names = [f"output_{i}" for i in range(n_out)]
+    _convert_jaxpr(ctx, closed.jaxpr, in_names, out_names,
+                   [np.asarray(c) for c in closed.consts])
+
+    inputs = [P.value_info(nm, str(np.asarray(e).dtype), np.shape(e))
+              for nm, e in zip(in_names, examples)]
+    outputs = []
+    for nm, var in zip(out_names, closed.jaxpr.outvars):
+        aval = var.aval
+        outputs.append(P.value_info(nm, str(np.dtype(aval.dtype)),
+                                    aval.shape))
+    graph = P.graph_proto("paddle_tpu_graph", ctx.nodes,
+                          ctx.initializers, inputs, outputs)
+    model = P.model_proto(graph, opset=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model)
+    return out_path

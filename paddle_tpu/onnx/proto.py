@@ -1,0 +1,203 @@
+"""Minimal ONNX protobuf writer (no onnx package needed).
+
+Reference parity: ``paddle.onnx.export`` (python/paddle/onnx/export.py →
+paddle2onnx).  The zero-dependency TPU build emits ONNX ModelProto wire
+format directly: protobuf encoding is varints + length-delimited fields,
+so a ~150-line encoder covers the subset ONNX needs (field numbers
+transcribed from onnx/onnx.proto3, opset 13 semantics).
+
+Field numbers used (onnx.proto3):
+  ModelProto:    ir_version=1, producer_name=2, producer_version=3,
+                 model_version=5, graph=7, opset_import=8
+  OperatorSetId: domain=1, version=2
+  GraphProto:    node=1, name=2, initializer=5, input=11, output=12
+  NodeProto:     input=1, output=2, name=3, op_type=4, attribute=5
+  AttributeProto:name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, type=20
+  TensorProto:   dims=1, data_type=2, name=8, raw_data=9
+  ValueInfoProto:name=1, type=2
+  TypeProto:     tensor_type=1;  Tensor: elem_type=1, shape=2
+  TensorShapeProto: dim=1;  Dimension: dim_value=1, dim_param=2
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+# ONNX TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64, BOOL, FLOAT16, DOUBLE = \
+    1, 2, 3, 6, 7, 9, 10, 11
+_NP2ONNX = {"float32": FLOAT, "float64": DOUBLE, "int32": INT32,
+            "int64": INT64, "bool": BOOL, "float16": FLOAT16,
+            "uint8": UINT8, "int8": INT8, "bfloat16": FLOAT}
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR, AT_FLOATS, AT_INTS = \
+    1, 2, 3, 4, 6, 7
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def f_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def f_string(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode())
+
+
+def f_packed_i64(field: int, vals: Sequence[int]) -> bytes:
+    payload = b"".join(_varint(int(v)) for v in vals)
+    return f_bytes(field, payload)
+
+
+def f_packed_f32(field: int, vals: Sequence[float]) -> bytes:
+    return f_bytes(field, struct.pack(f"<{len(vals)}f", *vals))
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    if str(arr.dtype) == "bfloat16":  # ONNX has no bf16 raw_data here
+        arr = arr.astype(np.float32)
+    dt = _NP2ONNX[str(arr.dtype)]
+    out = f_packed_i64(1, arr.shape)            # dims
+    out += f_varint(2, dt)                      # data_type
+    out += f_string(8, name)                    # name
+    out += f_bytes(9, arr.tobytes())            # raw_data
+    return out
+
+
+def attr_int(name: str, v: int) -> bytes:
+    return f_string(1, name) + f_varint(3, v) + f_varint(20, AT_INT)
+
+
+def attr_float(name: str, v: float) -> bytes:
+    return f_string(1, name) + _tag(2, 5) + struct.pack("<f", v) \
+        + f_varint(20, AT_FLOAT)
+
+
+def attr_ints(name: str, vals: Sequence[int]) -> bytes:
+    out = f_string(1, name)
+    for v in vals:
+        out += f_varint(8, v)
+    return out + f_varint(20, AT_INTS)
+
+
+def attr_string(name: str, s: str) -> bytes:
+    return f_string(1, name) + f_bytes(4, s.encode()) \
+        + f_varint(20, AT_STRING)
+
+
+def attr_tensor(name: str, arr: np.ndarray) -> bytes:
+    return f_string(1, name) + f_bytes(5, tensor_proto("", arr)) \
+        + f_varint(20, AT_TENSOR)
+
+
+def node_proto(op_type: str, inputs: Sequence[str],
+               outputs: Sequence[str], name: str = "",
+               attrs: Sequence[bytes] = ()) -> bytes:
+    out = b""
+    for i in inputs:
+        out += f_string(1, i)
+    for o in outputs:
+        out += f_string(2, o)
+    if name:
+        out += f_string(3, name)
+    out += f_string(4, op_type)
+    for a in attrs:
+        out += f_bytes(5, a)
+    return out
+
+
+def value_info(name: str, dtype: str,
+               shape: Sequence[Optional[int]]) -> bytes:
+    dims = b""
+    for i, d in enumerate(shape):
+        if d is None or int(d) < 0:
+            dims += f_bytes(1, f_string(2, f"dyn_{i}"))      # dim_param
+        else:
+            dims += f_bytes(1, f_varint(1, int(d)))          # dim_value
+    tensor_type = f_varint(1, _NP2ONNX[dtype]) + f_bytes(2, dims)
+    return f_string(1, name) + f_bytes(2, f_bytes(1, tensor_type))
+
+
+def graph_proto(name: str, nodes: List[bytes], initializers: List[bytes],
+                inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    out = b""
+    for n in nodes:
+        out += f_bytes(1, n)
+    out += f_string(2, name)
+    for t in initializers:
+        out += f_bytes(5, t)
+    for i in inputs:
+        out += f_bytes(11, i)
+    for o in outputs:
+        out += f_bytes(12, o)
+    return out
+
+
+def model_proto(graph: bytes, opset: int = 13,
+                producer: str = "paddle_tpu") -> bytes:
+    out = f_varint(1, 8)                        # ir_version 8
+    out += f_string(2, producer)
+    out += f_string(3, "0.1")
+    out += f_bytes(7, graph)
+    out += f_bytes(8, f_string(1, "") + f_varint(2, opset))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# minimal decoder (round-trip tests; NOT a general protobuf parser)
+# ---------------------------------------------------------------------------
+def _read_varint(buf, pos):
+    shift = n = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+
+
+def decode_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) — value is int for varint,
+    bytes for length-delimited, raw 4/8 bytes for fixed."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, v

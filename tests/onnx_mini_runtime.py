@@ -1,0 +1,234 @@
+"""Tiny ONNX decoder + numpy interpreter for round-trip tests.
+
+Independent re-implementation of the wire format reader + a numpy
+executor for the exporter's op subset — the test oracle proving the
+emitted bytes ARE executable ONNX (no onnx package in the image).
+"""
+import numpy as np
+
+from paddle_tpu.onnx import proto as P
+
+ONNX2NP = {1: "float32", 2: "uint8", 3: "int8", 6: "int32", 7: "int64",
+           9: "bool", 10: "float16", 11: "float64"}
+
+
+def _parse_tensor(buf):
+    dims, dtype, name, raw = [], None, "", b""
+    for f, w, v in P.decode_fields(buf):
+        if f == 1:
+            if w == 2:   # packed repeated int64
+                pos = 0
+                while pos < len(v):
+                    d, pos = P._read_varint(v, pos)
+                    dims.append(d)
+            else:
+                dims.append(v)
+        elif f == 2:
+            dtype = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+    arr = np.frombuffer(raw, ONNX2NP[dtype]).reshape(dims)
+    return name, arr
+
+
+def _parse_attr(buf):
+    name, val = "", None
+    ints, floats = [], []
+    for f, w, v in P.decode_fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            val = np.frombuffer(v, "<f4")[0]
+        elif f == 3:
+            val = v if v < (1 << 63) else v - (1 << 64)
+        elif f == 4:
+            val = v.decode()
+        elif f == 5:
+            val = _parse_tensor(v)[1]
+        elif f == 8:
+            ints.append(v if v < (1 << 63) else v - (1 << 64))
+    if ints:
+        val = ints
+    return name, val
+
+
+def _parse_node(buf):
+    node = {"inputs": [], "outputs": [], "op": "", "attrs": {}}
+    for f, w, v in P.decode_fields(buf):
+        if f == 1:
+            node["inputs"].append(v.decode())
+        elif f == 2:
+            node["outputs"].append(v.decode())
+        elif f == 4:
+            node["op"] = v.decode()
+        elif f == 5:
+            k, a = _parse_attr(v)
+            node["attrs"][k] = a
+    return node
+
+
+def _parse_value_info(buf):
+    for f, w, v in P.decode_fields(buf):
+        if f == 1:
+            return v.decode()
+    return ""
+
+
+def parse_model(data: bytes):
+    graph = None
+    opset = None
+    for f, w, v in P.decode_fields(data):
+        if f == 7:
+            graph = v
+        elif f == 8:
+            for f2, _, v2 in P.decode_fields(v):
+                if f2 == 2:
+                    opset = v2
+    nodes, inits, inputs, outputs = [], {}, [], []
+    for f, w, v in P.decode_fields(graph):
+        if f == 1:
+            nodes.append(_parse_node(v))
+        elif f == 5:
+            n, a = _parse_tensor(v)
+            inits[n] = a
+        elif f == 11:
+            inputs.append(_parse_value_info(v))
+        elif f == 12:
+            outputs.append(_parse_value_info(v))
+    return {"nodes": nodes, "initializers": inits, "inputs": inputs,
+            "outputs": outputs, "opset": opset}
+
+
+def _pool2d(x, kernel, strides, pads, mode):
+    N, C, H, W = x.shape
+    ph0, pw0, ph1, pw1 = (pads + [0] * 4)[:4] if len(pads) == 4 else \
+        (pads[0], pads[1], pads[2], pads[3])
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                constant_values=-np.inf if mode == "max" else 0.0)
+    kh, kw = kernel
+    sh, sw = strides
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    out = np.zeros((N, C, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            out[:, :, i, j] = win.max((-1, -2)) if mode == "max" \
+                else win.mean((-1, -2))
+    return out
+
+
+def _conv2d(x, w, b, strides, pads, dil, groups):
+    N, C, H, W = x.shape
+    O, Cg, kh, kw = w.shape
+    ph0, pw0, ph1, pw1 = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    sh, sw = strides
+    dh, dw = dil
+    oh = (xp.shape[2] - dh * (kh - 1) - 1) // sh + 1
+    ow = (xp.shape[3] - dw * (kw - 1) - 1) // sw + 1
+    out = np.zeros((N, O, oh, ow), np.float64)
+    og = O // groups
+    for n in range(N):
+        for o in range(O):
+            g = o // og
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[n, g * Cg:(g + 1) * Cg,
+                               i * sh:i * sh + dh * kh:dh,
+                               j * sw:j * sw + dw * kw:dw]
+                    out[n, o, i, j] = (patch * w[o]).sum()
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out.astype(x.dtype)
+
+
+def run_model(model, feeds):
+    env = dict(model["initializers"])
+    env.update(feeds)
+    for node in model["nodes"]:
+        op = node["op"]
+        a = node["attrs"]
+        x = [env[i] for i in node["inputs"] if i]
+        o = node["outputs"]
+        if op == "MatMul":
+            env[o[0]] = x[0] @ x[1]
+        elif op in ("Add", "Sub", "Mul", "Div", "Pow"):
+            fn = {"Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+                  "Div": np.divide, "Pow": np.power}[op]
+            env[o[0]] = fn(x[0], x[1])
+        elif op == "Max":
+            env[o[0]] = np.maximum(x[0], x[1])
+        elif op == "Min":
+            env[o[0]] = np.minimum(x[0], x[1])
+        elif op in ("Relu",):
+            env[o[0]] = np.maximum(x[0], 0)
+        elif op == "Tanh":
+            env[o[0]] = np.tanh(x[0])
+        elif op == "Sigmoid":
+            env[o[0]] = 1 / (1 + np.exp(-x[0]))
+        elif op == "Erf":
+            import math
+            env[o[0]] = np.vectorize(math.erf)(x[0]).astype(x[0].dtype)
+        elif op == "Exp":
+            env[o[0]] = np.exp(x[0])
+        elif op == "Log":
+            env[o[0]] = np.log(x[0])
+        elif op == "Sqrt":
+            env[o[0]] = np.sqrt(x[0])
+        elif op == "Reciprocal":
+            env[o[0]] = 1.0 / x[0]
+        elif op == "Neg":
+            env[o[0]] = -x[0]
+        elif op == "Abs":
+            env[o[0]] = np.abs(x[0])
+        elif op == "Identity":
+            env[o[0]] = x[0]
+        elif op == "Where":
+            env[o[0]] = np.where(x[0], x[1], x[2])
+        elif op == "Reshape":
+            env[o[0]] = x[0].reshape([int(d) for d in x[1]])
+        elif op == "Squeeze":
+            env[o[0]] = np.squeeze(x[0], tuple(int(d) for d in x[1]))
+        elif op == "Transpose":
+            env[o[0]] = np.transpose(x[0], a["perm"])
+        elif op == "Expand":
+            env[o[0]] = np.broadcast_to(
+                x[0], [int(d) for d in x[1]]).copy()
+        elif op == "Cast":
+            env[o[0]] = x[0].astype(ONNX2NP[a["to"]])
+        elif op == "ReduceSum":
+            axes = tuple(int(d) for d in x[1])
+            env[o[0]] = x[0].sum(axes, keepdims=bool(a.get("keepdims", 1)))
+        elif op in ("ReduceMax", "ReduceMin"):
+            fn = np.max if op == "ReduceMax" else np.min
+            env[o[0]] = fn(x[0], tuple(a["axes"]),
+                           keepdims=bool(a.get("keepdims", 1)))
+        elif op == "MaxPool":
+            env[o[0]] = _pool2d(x[0], a["kernel_shape"], a["strides"],
+                                a["pads"], "max")
+        elif op == "AveragePool":
+            env[o[0]] = _pool2d(x[0], a["kernel_shape"], a["strides"],
+                                a["pads"], "avg")
+        elif op == "Conv":
+            b = x[2] if len(x) > 2 else None
+            pads = a["pads"]
+            env[o[0]] = _conv2d(x[0], x[1], b, a["strides"],
+                                (pads[0], pads[1], pads[2], pads[3]),
+                                a.get("dilations", [1, 1]),
+                                a.get("group", 1))
+        elif op == "Concat":
+            env[o[0]] = np.concatenate(x, axis=a["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (x[1], x[2], x[3], x[4])
+            sl = [slice(None)] * x[0].ndim
+            for st, en, ax, sp in zip(starts, ends, axes, steps):
+                sl[int(ax)] = slice(int(st), int(en), int(sp))
+            env[o[0]] = x[0][tuple(sl)]
+        elif op == "ArgMax":
+            env[o[0]] = np.argmax(x[0], axis=a["axis"])
+        else:
+            raise NotImplementedError(f"mini-runtime: {op}")
+    return [env[n] for n in model["outputs"]]

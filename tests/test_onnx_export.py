@@ -1,0 +1,99 @@
+"""ONNX export round-trip tests.
+
+Reference parity: ``python/paddle/onnx/export.py`` (paddle2onnx).  The
+oracle is an independent mini decoder/interpreter of the ONNX wire
+format (tests/onnx_mini_runtime.py): exported bytes must parse as a
+valid ModelProto and execute to the same numbers as the paddle model.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from onnx_mini_runtime import parse_model, run_model
+
+
+def _roundtrip(net, examples, tmp_path, atol=1e-5):
+    path = paddle.onnx.export(net, str(tmp_path / "model"),
+                              input_spec=[paddle.to_tensor(e)
+                                          for e in examples])
+    assert path.endswith(".onnx")
+    model = parse_model(open(path, "rb").read())
+    assert model["opset"] == 13
+    ref = net(*[paddle.to_tensor(e) for e in examples])
+    refs = [r.numpy() for r in (ref if isinstance(ref, (tuple, list))
+                                else [ref])]
+    feeds = {f"input_{i}": np.asarray(e) for i, e in enumerate(examples)}
+    outs = run_model(model, feeds)
+    for got, want in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(got, np.float64),
+                                   np.asarray(want, np.float64),
+                                   atol=atol, rtol=1e-4)
+    return model
+
+
+def test_mlp_roundtrip(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+        paddle.nn.Linear(16, 4), paddle.nn.Sigmoid())
+    x = np.random.RandomState(0).rand(3, 8).astype("float32")
+    model = _roundtrip(net, [x], tmp_path)
+    ops = {n["op"] for n in model["nodes"]}
+    assert "MatMul" in ops
+    # weights travel as initializers
+    shapes = sorted(v.shape for v in model["initializers"].values()
+                    if v.ndim == 2)
+    assert (8, 16) in shapes and (16, 4) in shapes
+
+
+def test_gelu_tanh_mlp(tmp_path):
+    paddle.seed(1)
+    net = paddle.nn.Sequential(paddle.nn.Linear(6, 6),
+                               paddle.nn.GELU(),
+                               paddle.nn.Tanh())
+    x = np.random.RandomState(1).rand(2, 6).astype("float32")
+    _roundtrip(net, [x], tmp_path, atol=1e-4)
+
+
+def test_conv_pool_net(tmp_path):
+    paddle.seed(2)
+    net = paddle.nn.Sequential(
+        paddle.nn.Conv2D(1, 4, 3, padding=1),
+        paddle.nn.ReLU(),
+        paddle.nn.MaxPool2D(2, 2),
+        paddle.nn.Flatten(),
+        paddle.nn.Linear(4 * 4 * 4, 3))
+    x = np.random.RandomState(2).rand(2, 1, 8, 8).astype("float32")
+    model = _roundtrip(net, [x], tmp_path, atol=1e-4)
+    ops = [n["op"] for n in model["nodes"]]
+    assert "Conv" in ops and "MaxPool" in ops
+
+
+def test_softmax_composite(tmp_path):
+    paddle.seed(3)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(5, 5)
+
+        def forward(self, x):
+            return paddle.nn.functional.softmax(self.fc(x))
+
+    x = np.random.RandomState(3).rand(2, 5).astype("float32")
+    _roundtrip(Net(), [x], tmp_path, atol=1e-5)
+
+
+def test_unsupported_raises_and_fallback(tmp_path):
+    class Weird(paddle.nn.Layer):
+        def forward(self, x):
+            return paddle.cumsum(x, axis=0)   # no ONNX mapping yet
+
+    x = np.random.RandomState(0).rand(3, 3).astype("float32")
+    with pytest.raises(paddle.errors.UnimplementedError):
+        paddle.onnx.export(Weird(), str(tmp_path / "w"),
+                           input_spec=[paddle.to_tensor(x)])
+    out = paddle.onnx.export(Weird(), str(tmp_path / "w"),
+                             input_spec=[paddle.to_tensor(x)],
+                             fallback_stablehlo=True)
+    assert out.endswith(".pdmodel")
