@@ -580,16 +580,27 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
 # Executor: compile + run the captured program
 # ---------------------------------------------------------------------------
 class CompiledProgram:
-    """reference compiler.py:88 — marks a program for jit compilation;
-    multi-device data parallelism is expressed via pjit sharding in
-    distributed.fleet (SURVEY §7 stage 6), so with_data_parallel is a
-    documented pass-through."""
+    """reference compiler.py:88 — marks a program for jit compilation.
+
+    ``with_data_parallel`` (reference compiler.py:164 → ParallelExecutor)
+    arms the Executor's multi-device path: feeds get sharded over a
+    ``dp`` mesh of the available devices and parameters stay replicated,
+    so GSPMD inserts the cross-device gradient all-reduce exactly where
+    the reference's ParallelExecutor places its allreduce op-handles."""
 
     def __init__(self, program, build_strategy=None):
         self.program = program
         self.build_strategy = build_strategy
+        self._dp_mesh = None
+        self._loss_name = None
 
-    def with_data_parallel(self, loss_name=None, **kw):
+    def with_data_parallel(self, loss_name=None, places=None, **kw):
+        from jax.sharding import Mesh
+        devices = list(places) if places and not isinstance(
+            places[0], (str,)) and hasattr(places[0], "platform") \
+            else jax.devices()
+        self._dp_mesh = Mesh(np.array(devices), ("dp",))
+        self._loss_name = loss_name
         return self
 
     def __getattr__(self, item):
@@ -675,7 +686,9 @@ class Executor:
         feed = feed or {}
         fetch_list = fetch_list if fetch_list is not None else []
         program = program or default_main_program()
+        dp_mesh = None
         if isinstance(program, CompiledProgram):
+            dp_mesh = program._dp_mesh
             program = program.program
 
         # round-1 escape hatch: hand-assigned build function
@@ -721,15 +734,48 @@ class Executor:
             if use_program_cache:
                 self._cache[key] = fn
 
-        mutables = {n: p._data for n, p in program.parameters.items()}
-        mutables.update(program.state_vars)
+        # scope isolation (reference framework/scope.h:62 + executor.py
+        # scope arg): with an explicit scope, parameter/state values are
+        # read from and written back to the scope, not the live program
+        use_scope = scope is not None
+        if use_scope:
+            mutables = {}
+            for n, p in program.parameters.items():
+                v = scope.find_var(n)
+                if v is None or tuple(v._data.shape) != \
+                        tuple(p._data.shape):
+                    scope.set_var(n, p._data)
+                    v = scope.find_var(n)
+                mutables[n] = v._data
+            for n, arr in program.state_vars.items():
+                v = scope.find_var(n)
+                mutables[n] = v._data if v is not None and \
+                    tuple(v._data.shape) == tuple(arr.shape) else arr
+        else:
+            mutables = {n: p._data for n, p in
+                        program.parameters.items()}
+            mutables.update(program.state_vars)
+
+        if dp_mesh is not None:
+            # reference ParallelExecutor: batch over devices, params
+            # replicated; GSPMD emits the gradient all-reduce
+            from jax.sharding import NamedSharding, PartitionSpec as Pspec
+            batch = NamedSharding(dp_mesh, Pspec("dp"))
+            rep = NamedSharding(dp_mesh, Pspec())
+            feed_arrays = {n: jax.device_put(a, batch)
+                           for n, a in feed_arrays.items()}
+            mutables = {n: jax.device_put(a, rep)
+                        for n, a in mutables.items()}
+
         lr = jnp.asarray(
             program._lr_provider() if program._lr_provider else 0.0,
             jnp.float32)
         fetches, new_mut = fn(feed_arrays, mutables, lr)
 
         for n, arr in new_mut.items():
-            if n in program.parameters:
+            if use_scope:
+                scope.set_var(n, arr)
+            elif n in program.parameters:
                 program.parameters[n]._data = arr
             else:
                 program.state_vars[n] = arr
