@@ -15,6 +15,7 @@ import threading
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from . import autograd
 from ..utils import flags as _flags_mod
@@ -97,6 +98,128 @@ def _tensors_of(args):
     return [a for a in args if isinstance(a, Tensor)]
 
 
+
+
+# ---------------------------------------------------------------------------
+# eager jit/vjp cache (SURVEY §7 hard part (a): dygraph speed without
+# per-op C++ dispatch).  jax.vjp re-traces its function on every call —
+# ~1.8ms per tracked op eagerly.  For impls whose closure captures only
+# hashable primitives, the traced forward and backward are cached as
+# jitted functions keyed by (code, captured values, avals, attrs):
+# the backward re-derives grads from primals inside jit (XLA dead-code
+# eliminates the unused primal recompute for linear ops — remat posture
+# for the rest), so a cache hit costs two jitted dispatches (~40x less).
+# Ops capturing arrays/PRNG keys (dropout) are uncacheable and keep the
+# exact per-call path.  FLAGS_eager_jit_cache=0 disables.
+# ---------------------------------------------------------------------------
+_EAGER_CACHE: Dict[tuple, tuple] = {}
+_HASHABLE = (int, float, bool, str, bytes, type(None), slice,
+             type(Ellipsis))
+
+
+def _closure_key(fn):
+    """Hashable identity for fn incl. captured values, or None."""
+    if isinstance(fn, functools.partial):
+        inner = _closure_key(fn.func)
+        if inner is None:
+            return None
+        parts = [inner]
+        for a in fn.args:
+            if not isinstance(a, _HASHABLE):
+                return None
+            parts.append(a)
+        for k, v in sorted(fn.keywords.items()):
+            if not _attr_hashable(v):
+                return None
+            parts.append((k, _freeze(v)))
+        return ("partial",) + tuple(parts)
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # jnp/numpy ufuncs and library callables are stateless: behavior
+        # IS their identity (the cache entry pins a strong ref so the id
+        # stays valid).  Arbitrary callable objects may carry mutable
+        # state -> never identity-keyed.
+        mod = getattr(fn, "__module__", "") or ""
+        if callable(fn) and mod.split(".")[0] in ("jax", "numpy", "jnp"):
+            return ("obj", id(fn))
+        return None
+    parts = [id(code)]
+    # default args carry per-call payloads too (e.g. getitem's idx=idx)
+    for v in (fn.__defaults__ or ()):
+        if not _attr_hashable(v):
+            return None
+        parts.append(("d", _freeze(v)))
+    for k, v in sorted((fn.__kwdefaults__ or {}).items()):
+        if not _attr_hashable(v):
+            return None
+        parts.append((k, _freeze(v)))
+    for cell in fn.__closure__ or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            return None
+        if isinstance(v, _HASHABLE):
+            parts.append(v)
+        elif isinstance(v, type) or isinstance(v, jnp.dtype):
+            parts.append(repr(v))          # jnp.float32 / np.dtype refs
+        elif isinstance(v, (tuple, list)) and all(
+                isinstance(x, _HASHABLE) for x in v):
+            parts.append(tuple(v))
+        else:
+            inner = _closure_key(v) if callable(v) else None
+            if inner is None:
+                return None
+            parts.append(inner)
+    return tuple(parts)
+
+
+def _attr_hashable(v):
+    if isinstance(v, _HASHABLE):
+        return True
+    if isinstance(v, (tuple, list)):
+        return all(_attr_hashable(x) for x in v)
+    return False
+
+
+def _freeze(v):
+    if isinstance(v, list):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, tuple):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
+def _cached_pair(op_name, fn, kwargs, arrays):
+    """(fwd_jit, bwd_jit) for a cacheable dispatch, else None."""
+    if not _flags_mod.get_flag("FLAGS_eager_jit_cache"):
+        return None
+    fkey = _closure_key(fn)
+    if fkey is None:
+        return None
+    if kwargs and not all(_attr_hashable(v) for v in kwargs.values()):
+        return None
+    avals = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+    akey = tuple(sorted((k, _freeze(v)) for k, v in kwargs.items()))
+    key = (op_name, fkey, akey, avals)
+    entry = _EAGER_CACHE.get(key)
+    if entry is None:
+        closed = functools.partial(fn, **kwargs) if kwargs else fn
+        fwd = jax.jit(closed)
+
+        def bwd(primals, cot):
+            _, vjp_fn = jax.vjp(closed, *primals)
+            gs = vjp_fn(cot)
+            # float0 (int-input) grads aren't valid jit outputs -> None
+            return tuple(
+                None if (hasattr(g, "dtype")
+                         and g.dtype == jax.dtypes.float0) else g
+                for g in gs)
+        # fn pinned in the entry: keeps id()-based keys valid
+        entry = (fwd, jax.jit(bwd), fn)
+        _EAGER_CACHE[key] = entry
+    return entry
+
+
 def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
     """Run ``fn(*arrays, **kwargs)`` eagerly, recording a GradNode when any
     input requires grad.  ``tensor_args`` are Tensors (positionally matching
@@ -135,13 +258,30 @@ def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
     else:
         closed = fn
 
+    pair = None
+    if not any(isinstance(a, jax.core.Tracer) for a in arrays):
+        pair = _cached_pair(op_name, fn, kwargs, arrays)
+
     try:
         if needs_grad:
-            out, vjp_fn = jax.vjp(closed, *arrays)
+            if pair is not None:
+                fwd_jit, bwd_jit = pair[0], pair[1]
+                out = fwd_jit(*arrays)
+                outs_t = out if isinstance(out, tuple) else (out,)
+                if all(jax.numpy.issubdtype(o.dtype, jax.numpy.inexact)
+                       for o in outs_t):
+                    vjp_fn = functools.partial(bwd_jit, tuple(arrays))
+                else:
+                    # int outputs take float0 cotangents, which cannot
+                    # cross a jit boundary — rare; pay the retrace
+                    out, vjp_fn = jax.vjp(closed, *arrays)
+            else:
+                out, vjp_fn = jax.vjp(closed, *arrays)
             node = autograd.record(op_name, closed, tensor_args, arrays,
                                    (out, vjp_fn))
         else:
-            out = closed(*arrays)
+            out = pair[0](*arrays) if pair is not None \
+                else closed(*arrays)
             node = None
     except Exception as e:  # enforce-style op context (enforce.h:422)
         from .errors import tag_op_error

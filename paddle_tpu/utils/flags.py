@@ -72,6 +72,9 @@ def all_flags() -> Dict[str, Any]:
 
 
 # -- core flag set (subset of platform/flags.cc most relevant on TPU) ------
+define_flag("FLAGS_eager_jit_cache", True,
+            "cache jitted fwd/vjp per (op, closure, shapes) on the eager "
+            "tape path (dygraph speed; SURVEY hard part a)")
 define_flag("FLAGS_use_pallas", True,
             "prefer hand-written pallas kernels on TPU where registered")
 define_flag("FLAGS_check_nan_inf", False,

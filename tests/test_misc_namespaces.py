@@ -58,15 +58,16 @@ def test_sysconfig():
     assert os.path.isdir(paddle.sysconfig.get_lib())
 
 
-def test_onnx_export_writes_stablehlo(tmp_path):
+def test_onnx_export_writes_real_onnx(tmp_path):
     import paddle_tpu.nn as nn
     from paddle_tpu.jit import InputSpec
     net = nn.Linear(4, 2)
     net.eval()
     out = paddle.onnx.export(net, str(tmp_path / "m.onnx"),
                              input_spec=[InputSpec([1, 4], "float32")])
-    assert out.endswith(".pdmodel") and os.path.exists(out)
-    from paddle_tpu.jit import load as jit_load
-    reloaded = jit_load(str(tmp_path / "m"))
-    y = reloaded(paddle.to_tensor(np.ones((1, 4), np.float32)))
-    assert tuple(y.shape) == (1, 2)
+    # round 2: a REAL ONNX ModelProto (see test_onnx_export.py for the
+    # full round-trip suite)
+    assert out.endswith(".onnx") and os.path.exists(out)
+    data = open(out, "rb").read()
+    assert b"paddle_tpu" in data          # producer_name travels
+    assert len(data) > 50
