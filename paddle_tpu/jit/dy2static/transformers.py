@@ -167,6 +167,127 @@ def _make_fn(name: str, param: str, body: List[ast.stmt]) -> ast.stmt:
         body=body, decorator_list=[])
 
 
+def _assign_bool(name: str, value: bool) -> ast.stmt:
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _contains_escape_here(stmts, kinds) -> bool:
+    """break/continue belonging to THIS loop level: descend into ifs
+    only (nested loops own their escapes)."""
+    for st in stmts:
+        if isinstance(st, kinds):
+            return True
+        if isinstance(st, ast.If):
+            if _contains_escape_here(st.body, kinds) or \
+                    _contains_escape_here(st.orelse, kinds):
+                return True
+    return False
+
+
+class BreakContinueTransformer(ast.NodeTransformer):
+    """break/continue in tensor-dependent loops -> carried bool flags.
+
+    Reference parity: ``dygraph_to_static/break_continue_transformer.py``
+    — `break` becomes ``flag = True`` + guard-chaining of the remaining
+    statements + an extra loop-condition conjunct; `continue` becomes a
+    per-iteration flag with the same guard chaining.  Runs BEFORE the
+    Logical/ControlFlow transformers so the generated `not`/`and` lower
+    to tensor-safe converters and the loop no longer carries escapes.
+    """
+
+    def _rewrite_body(self, stmts, brk, cont):
+        """Guard-chained statement list; returns (stmts, escaped)."""
+        out = []
+        for idx, st in enumerate(stmts):
+            if isinstance(st, ast.Break):
+                out.append(_assign_bool(brk, True))
+                return out, True          # rest is unreachable
+            if isinstance(st, ast.Continue):
+                out.append(_assign_bool(cont, True))
+                return out, True
+            if isinstance(st, ast.If) and (
+                    _contains_escape_here([st], (ast.Break,))
+                    or _contains_escape_here([st], (ast.Continue,))):
+                st.body, b1 = self._rewrite_body(st.body, brk, cont)
+                st.orelse, b2 = self._rewrite_body(st.orelse, brk, cont) \
+                    if st.orelse else ([], False)
+                out.append(st)
+                if b1 or b2:
+                    rest, _ = self._rewrite_body(stmts[idx + 1:], brk,
+                                                 cont)
+                    if rest:
+                        flags = [ast.Name(id=brk, ctx=ast.Load()),
+                                 ast.Name(id=cont, ctx=ast.Load())]
+                        guard = ast.UnaryOp(
+                            op=ast.Not(),
+                            operand=ast.BoolOp(op=ast.Or(),
+                                               values=flags))
+                        out.append(ast.If(test=guard, body=rest,
+                                          orelse=[]))
+                    return out, True
+                continue
+            out.append(st)
+        return out, False
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)          # inner loops first
+        has_brk = _contains_escape_here(node.body, (ast.Break,))
+        has_cont = _contains_escape_here(node.body, (ast.Continue,))
+        if not (has_brk or has_cont) or node.orelse:
+            return node
+        brk = _uid("brk").replace("__pt_", "_jst_")   # must stay in state
+        cont = _uid("cont").replace("__pt_", "_jst_")
+        body, _ = self._rewrite_body(list(node.body), brk, cont)
+        new_body = [_assign_bool(cont, False)] + body
+        test = node.test
+        if has_brk:
+            test = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(),
+                            operand=ast.Name(id=brk, ctx=ast.Load())),
+                test])
+        new_loop = ast.While(test=test, body=new_body, orelse=[])
+        return [_assign_bool(brk, False), new_loop]
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        has_brk = _contains_escape_here(node.body, (ast.Break,))
+        has_cont = _contains_escape_here(node.body, (ast.Continue,))
+        if not (has_brk or has_cont) or node.orelse:
+            return node
+        it = node.iter
+        range_form = (isinstance(it, ast.Call)
+                      and isinstance(it.func, ast.Name)
+                      and it.func.id == "range" and not it.keywords
+                      and 1 <= len(it.args) <= 2
+                      and isinstance(node.target, ast.Name))
+        if has_brk and not range_form:
+            return node   # python semantics (fails only if tensor-dep)
+        brk = _uid("brk").replace("__pt_", "_jst_")
+        cont = _uid("cont").replace("__pt_", "_jst_")
+        body, _ = self._rewrite_body(list(node.body), brk, cont)
+        if not has_brk:
+            return ast.For(target=node.target, iter=node.iter,
+                           body=[_assign_bool(cont, False)] + body,
+                           orelse=[])
+        # for i in range(...) with break -> while with the break conjunct
+        i = node.target.id
+        start = ast.Constant(value=0) if len(it.args) == 1 else it.args[0]
+        stop = it.args[-1]
+        test = ast.BoolOp(op=ast.And(), values=[
+            ast.UnaryOp(op=ast.Not(),
+                        operand=ast.Name(id=brk, ctx=ast.Load())),
+            ast.Compare(left=ast.Name(id=i, ctx=ast.Load()),
+                        ops=[ast.Lt()], comparators=[stop])])
+        incr = ast.AugAssign(target=ast.Name(id=i, ctx=ast.Store()),
+                             op=ast.Add(), value=ast.Constant(value=1))
+        new_body = [_assign_bool(cont, False)] + body + [incr]
+        return [ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+                           value=start),
+                _assign_bool(brk, False),
+                ast.While(test=test, body=new_body, orelse=[])]
+
+
 class LogicalTransformer(ast.NodeTransformer):
     """a and b / a or b / not a -> short-circuit-preserving converters."""
 
