@@ -113,8 +113,25 @@ def _tensors_of(args):
 # exact per-call path.  FLAGS_eager_jit_cache=0 disables.
 # ---------------------------------------------------------------------------
 _EAGER_CACHE: Dict[tuple, tuple] = {}
-_HASHABLE = (int, float, bool, str, bytes, type(None), slice,
-             type(Ellipsis))
+_SCALARS = (int, float, bool, str, bytes, type(None), type(Ellipsis))
+
+
+class _HashableMeta(type):
+    """isinstance(v, _HASHABLE) — scalars, plus slices whose components
+    are themselves scalars.  A slice built from device arrays
+    (t[i0:i0+k]) must NOT be cache-keyed: jax arrays are unhashable and
+    would make the whole cache key blow up with TypeError at lookup."""
+    def __instancecheck__(cls, v):
+        if isinstance(v, _SCALARS):
+            return True
+        if isinstance(v, slice):
+            return all(isinstance(c, _SCALARS)
+                       for c in (v.start, v.stop, v.step))
+        return False
+
+
+class _HASHABLE(metaclass=_HashableMeta):
+    pass
 
 
 def _closure_key(fn):
@@ -127,7 +144,7 @@ def _closure_key(fn):
         for a in fn.args:
             if not isinstance(a, _HASHABLE):
                 return None
-            parts.append(a)
+            parts.append(_freeze(a))
         for k, v in sorted(fn.keywords.items()):
             if not _attr_hashable(v):
                 return None
@@ -159,7 +176,7 @@ def _closure_key(fn):
         except ValueError:
             return None
         if isinstance(v, _HASHABLE):
-            parts.append(v)
+            parts.append(_freeze(v))
         elif isinstance(v, type) or isinstance(v, jnp.dtype):
             parts.append(repr(v))          # jnp.float32 / np.dtype refs
         elif isinstance(v, (tuple, list)) and all(
@@ -182,10 +199,10 @@ def _attr_hashable(v):
 
 
 def _freeze(v):
-    if isinstance(v, list):
+    if isinstance(v, (list, tuple)):
         return tuple(_freeze(x) for x in v)
-    if isinstance(v, tuple):
-        return tuple(_freeze(x) for x in v)
+    if isinstance(v, slice):  # version-portable (slices hash only >=3.12)
+        return ("slice", v.start, v.stop, v.step)
     return v
 
 
@@ -201,7 +218,11 @@ def _cached_pair(op_name, fn, kwargs, arrays):
     avals = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
     akey = tuple(sorted((k, _freeze(v)) for k, v in kwargs.items()))
     key = (op_name, fkey, akey, avals)
-    entry = _EAGER_CACHE.get(key)
+    try:
+        entry = _EAGER_CACHE.get(key)
+    except TypeError:        # unhashable payload slipped past the checks
+        return None          # -> uncached per-call path, not a crash
+
     if entry is None:
         closed = functools.partial(fn, **kwargs) if kwargs else fn
         fwd = jax.jit(closed)

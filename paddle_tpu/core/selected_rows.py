@@ -24,12 +24,17 @@ __all__ = ["SelectedRows"]
 
 
 class SelectedRows:
-    __slots__ = ("rows", "values", "dense_shape")
+    __slots__ = ("rows", "values", "dense_shape", "_is_merged")
 
-    def __init__(self, rows, values, dense_shape: Tuple[int, ...]):
+    def __init__(self, rows, values, dense_shape: Tuple[int, ...],
+                 _is_merged: bool = False):
         self.rows = jnp.asarray(rows).reshape(-1)
         self.values = jnp.asarray(values)
         self.dense_shape = tuple(int(s) for s in dense_shape)
+        # rows known sorted-unique (output of merged()) — lets a later
+        # merged() call (e.g. optimizer after grad-clip already merged)
+        # skip the host sync + unique/sort
+        self._is_merged = bool(_is_merged)
         assert self.values.shape[0] == self.rows.shape[0], (
             self.values.shape, self.rows.shape)
         assert self.values.shape[1:] == self.dense_shape[1:], (
@@ -55,24 +60,28 @@ class SelectedRows:
         """Reference scatter::MergeAdd — unique rows, duplicate slices
         summed.  Host-computes the unique set (eager path; data-dependent
         output size is inherently host-side, like the reference)."""
+        if self._is_merged:
+            return self
         rows_np = np.asarray(self.rows)
         uniq, inverse = np.unique(rows_np, return_inverse=True)
         if uniq.size == rows_np.size:
             order = np.argsort(rows_np, kind="stable")
             return SelectedRows(rows_np[order],
                                 self.values[jnp.asarray(order)],
-                                self.dense_shape)
+                                self.dense_shape, _is_merged=True)
         summed = jax.ops.segment_sum(self.values,
                                      jnp.asarray(inverse),
                                      num_segments=int(uniq.size))
-        return SelectedRows(jnp.asarray(uniq), summed, self.dense_shape)
+        return SelectedRows(jnp.asarray(uniq), summed, self.dense_shape,
+                            _is_merged=True)
 
     def to_dense(self):
         out = jnp.zeros(self.dense_shape, self.values.dtype)
         return out.at[self.rows].add(self.values)
 
     def scale(self, s) -> "SelectedRows":
-        return SelectedRows(self.rows, self.values * s, self.dense_shape)
+        return SelectedRows(self.rows, self.values * s, self.dense_shape,
+                            _is_merged=self._is_merged)
 
     def __repr__(self):
         return (f"SelectedRows(rows={self.rows.shape[0]}, "

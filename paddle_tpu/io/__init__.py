@@ -483,8 +483,22 @@ class DataLoader:
         import warnings as _warnings
         watchdog = self.timeout or 60.0
         fallback = False
+
+        def discard(payload):
+            # workers unregister their segments from the resource
+            # tracker (the parent normally unlinks after decode), so an
+            # undelivered batch's segment leaks until reboot unless it
+            # is unlinked here
+            if payload and payload[0] == "shm":
+                try:
+                    seg = shared_memory.SharedMemory(name=payload[1])
+                    seg.close()
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+
+        pending: dict = {}
         try:
-            pending: dict = {}
             for i in range(len(batches)):
                 if not fallback:
                     last = _time.monotonic()
@@ -520,6 +534,18 @@ class DataLoader:
                 pr.terminate()
             for pr in procs:
                 pr.join(timeout=5)
+            # drain undelivered results and free their shm segments; a
+            # short timeout lets the queue's feeder pipe flush entries a
+            # just-terminated worker had already put
+            for payload, _err in pending.values():
+                discard(payload)
+            deadline = _time.monotonic() + 2.0
+            while _time.monotonic() < deadline:
+                try:
+                    _j, payload, _err = result_q.get(timeout=0.2)
+                except (_queue.Empty, OSError, EOFError):
+                    break
+                discard(payload)
 
     def _prefetch_iter_native(self):
         """Prefetch through the native C++ BlockingQueue: batches travel

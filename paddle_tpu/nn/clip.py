@@ -80,17 +80,23 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def __call__(self, params_grads):
         from ..core.selected_rows import SelectedRows
 
+        # MergeAdd SelectedRows first (reference merges before
+        # clip_by_global_norm): repeated rows must contribute the squared
+        # merged row, not sum-of-squares of individual slices, or the
+        # norm is underestimated and the grads under-clipped.
+        merged_pairs = [
+            (p, g.merged() if isinstance(g, SelectedRows) else g)
+            for p, g in params_grads]
+
         def arr(g):
-            # SelectedRows contribute their slice values to the global
-            # norm (reference merges SelectedRows before clipping)
             return g.values if isinstance(g, SelectedRows) else g._data
-        clippable = [arr(g) for p, g in params_grads
+        clippable = [arr(g) for p, g in merged_pairs
                      if g is not None and getattr(p, "need_clip", True)]
         scale = self._scale(clippable)
         if scale is None:
-            return params_grads
+            return merged_pairs
         out = []
-        for p, g in params_grads:
+        for p, g in merged_pairs:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
             elif isinstance(g, SelectedRows):

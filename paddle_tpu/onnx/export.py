@@ -115,9 +115,24 @@ def _convert_eqn(ctx: _Ctx, eqn):
     elif prim == "dot_general":
         dims = eqn.params["dimension_numbers"]
         (lc, rc), (lb, rb) = dims
+        lnd = len(eqn.invars[0].aval.shape)
+        rnd = len(eqn.invars[1].aval.shape)
         if lb or rb:
-            ctx.add("MatMul", ins, outs)      # batched matmul
-        elif lc == (len(eqn.invars[0].aval.shape) - 1,) and rc == (0,):
+            # ONNX MatMul batches over leading dims and contracts
+            # (last-of-lhs, second-to-last-of-rhs); anything else (e.g.
+            # einsum 'bqd,bkd->bqk') would export silently-wrong numerics.
+            nb = len(lb)
+            if (tuple(lb) == tuple(range(nb)) == tuple(rb)
+                    and lnd == nb + 2 and rnd == nb + 2
+                    and tuple(lc) == (lnd - 1,)
+                    and tuple(rc) == (rnd - 2,)):
+                ctx.add("MatMul", ins, outs)
+            else:
+                raise UnimplementedError(
+                    f"UNIMPLEMENTED: batched dot_general layout {dims} in "
+                    "ONNX export (transpose operands to standard batched "
+                    "matmul [..., M, K] @ [..., K, N] first)")
+        elif lc == (lnd - 1,) and rc == (0,):
             ctx.add("MatMul", ins, outs)
         else:
             raise UnimplementedError(
@@ -201,10 +216,10 @@ def _convert_eqn(ctx: _Ctx, eqn):
                        P.attr_int("keepdims", 0)])
     elif prim == "iota":
         aval = eqn.outvars[0].aval
-        arr = np.reshape(
-            np.broadcast_to(
-                np.arange(aval.shape[eqn.params["dimension"]]),
-                aval.shape), aval.shape).astype(np.dtype(aval.dtype))
+        dim = eqn.params["dimension"]
+        idx = np.arange(aval.shape[dim]).reshape(
+            [-1 if i == dim else 1 for i in range(len(aval.shape))])
+        arr = np.broadcast_to(idx, aval.shape).astype(np.dtype(aval.dtype))
         nm = ctx.const(arr, "iota")
         ctx.add("Identity", [nm], outs)
     else:

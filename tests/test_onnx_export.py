@@ -97,3 +97,58 @@ def test_unsupported_raises_and_fallback(tmp_path):
                              input_spec=[paddle.to_tensor(x)],
                              fallback_stablehlo=True)
     assert out.endswith(".pdmodel")
+
+
+def test_iota_nonlast_axis(tmp_path):
+    """ADVICE r2: iota along a non-last axis must vary along THAT axis
+    (square shapes previously baked a wrong-axis constant silently)."""
+    class Net(paddle.nn.Layer):
+        def forward(self, x):
+            # a true multi-dim iota[dimension=0] over a square shape:
+            # the old export varied it along the LAST axis silently
+            import jax.lax as lax
+            import jax.numpy as jnp
+            from paddle_tpu.core.tensor import Tensor
+            r = lax.broadcasted_iota(jnp.float32, (3, 3), 0)
+            return paddle.to_tensor(1.0) * Tensor(r) + x
+
+    x = np.zeros((3, 3), np.float32)
+    _roundtrip(Net(), [x], tmp_path)
+
+
+def test_batched_dot_general_nonstandard_raises(tmp_path):
+    """ADVICE r2: einsum('bqd,bkd->bqk') must refuse ONNX MatMul export
+    (same-size square dims would otherwise export silently wrong)."""
+    class Net(paddle.nn.Layer):
+        def forward(self, x):
+            return paddle.einsum("bqd,bkd->bqk", x, x)
+
+    x = np.random.RandomState(0).rand(2, 3, 3).astype("float32")
+    with pytest.raises(paddle.errors.UnimplementedError):
+        paddle.onnx.export(Net(), str(tmp_path / "m"),
+                           input_spec=[paddle.to_tensor(x)])
+
+
+def test_batched_matmul_standard_layout(tmp_path):
+    class Net(paddle.nn.Layer):
+        def forward(self, x):
+            return paddle.matmul(x, x)   # [B, 3, 3] @ [B, 3, 3]
+
+    x = np.random.RandomState(1).rand(2, 3, 3).astype("float32")
+    model = _roundtrip(Net(), [x], tmp_path)
+    assert "MatMul" in {n["op"] for n in model["nodes"]}
+
+
+def test_batched_dot_general_extra_free_dims_raises(tmp_path):
+    """einsum('bijk,bkn->bijn') satisfies the batch/contract layout but
+    has two free dims on the lhs — np.matmul semantics differ."""
+    class Net(paddle.nn.Layer):
+        def forward(self, x, y):
+            return paddle.einsum("bijk,bkn->bijn", x, y)
+
+    x = np.random.RandomState(0).rand(2, 2, 4, 5).astype("float32")
+    y = np.random.RandomState(1).rand(2, 5, 6).astype("float32")
+    with pytest.raises(paddle.errors.UnimplementedError):
+        paddle.onnx.export(Net(), str(tmp_path / "m"),
+                           input_spec=[paddle.to_tensor(x),
+                                       paddle.to_tensor(y)])

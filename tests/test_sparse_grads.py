@@ -174,3 +174,29 @@ def test_large_vocab_never_materializes_dense(monkeypatch):
     opt = paddle.optimizer.SGD(learning_rate=0.1,
                                parameters=emb.parameters())
     opt.step()
+
+
+def test_global_norm_clip_merges_repeated_rows():
+    """ADVICE r2: repeated rows must be MergeAdd'ed before the global
+    norm, or the norm is computed over per-occurrence slices and the
+    grads are under-clipped vs the dense-equivalent gradient."""
+    paddle.seed(0)
+    V, D = 10, 4
+    clipval = 0.5
+
+    def run(sparse):
+        paddle.seed(0)
+        emb = paddle.nn.Embedding(V, D, sparse=sparse)
+        clip = paddle.nn.ClipGradByGlobalNorm(clipval)
+        opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=emb.parameters(),
+                                   grad_clip=clip)
+        # row 3 looked up 4 times -> 4 duplicate slices in SelectedRows
+        ids = paddle.to_tensor(np.array([[3, 3, 3, 3, 1]]))
+        w0 = np.asarray(emb.weight._data).copy()
+        out = emb(ids)
+        paddle.sum(out * out).backward()
+        opt.step()
+        return np.asarray(emb.weight._data) - w0
+
+    np.testing.assert_allclose(run(True), run(False), atol=1e-6)
