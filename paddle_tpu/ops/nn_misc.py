@@ -21,6 +21,7 @@ __all__ = [
     "linear", "bilinear", "scaled_dot_product_attention", "sparse_attention",
     "sequence_mask", "diag_embed", "cosine_similarity", "pairwise_distance",
     "affine_grid", "npair_loss", "temporal_shift", "class_center_sample",
+    "affine_channel", "nce",
 ]
 
 
@@ -331,3 +332,97 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
 def class_center_sample(label, num_classes, num_samples, group=None):
     raise NotImplementedError(
         "class_center_sample: PS-style sampled softmax not yet on TPU path")
+
+
+def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
+    """Per-channel scale+shift (reference operators/affine_channel_op.cc:1
+    — frozen-BN replacement in detection backbones)."""
+    x, scale, bias = to_tensor(x), to_tensor(scale), to_tensor(bias)
+
+    def impl(a, s, b):
+        if data_layout in ("NCHW", "NCDHW"):
+            shape = (1, -1) + (1,) * (a.ndim - 2)
+        else:
+            shape = (1,) * (a.ndim - 1) + (-1,)
+        return a * s.reshape(shape) + b.reshape(shape)
+
+    return dispatch("affine_channel", impl, (x, scale, bias), {})
+
+
+def nce(input, label, weight, bias=None, num_total_classes=None,
+        num_neg_samples=10, sampler="uniform", sample_weight=None,
+        custom_dist=None, seed=None, name=None):
+    """Noise-contrastive estimation loss (reference operators/nce_op.h:80):
+    per row i with true class t and negatives {s_k}:
+    o = sigmoid(x_i . w_c + b_c); q = P_sampler(c) * num_neg;
+    cost = -log(o/(o+q)) for true, -log(q/(o+q)) for sampled.
+
+    TPU translation: negatives are sampled host-side per call (like the
+    reference's CPU Sampler), then the cost is one fused device gather +
+    matmul — differentiable through w/b/input via jax.vjp.
+    Returns per-row cost [N, 1]."""
+    input, weight = to_tensor(input), to_tensor(weight)
+    lab_np = np.asarray(to_tensor(label)._data)
+    N = int(input.shape[0])
+    # reference supports [N, num_true] labels (nce_op.h PrepareSamples)
+    lab_np = lab_np.reshape(N, -1)
+    num_true = lab_np.shape[1]
+    V = int(num_total_classes if num_total_classes is not None
+            else weight.shape[0])
+    if seed is None:
+        import jax.random as _jr
+        seed = int(_jr.randint(default_generator.next_key(), (),
+                               0, 2**31 - 1, jnp.int32))
+    rng = np.random.RandomState(seed)
+    if sampler == "uniform":
+        negs = rng.randint(0, V, size=(N, num_neg_samples))
+        def q(c):
+            return np.full(c.shape, 1.0 / V)
+    elif sampler == "log_uniform":
+        # P(k) = log((k+2)/(k+1)) / log(V+1)  (TF/paddle LogUniformSampler)
+        u = rng.rand(N, num_neg_samples)
+        negs = (np.exp(u * np.log(V + 1.0)) - 1.0).astype(np.int64)
+        negs = np.clip(negs, 0, V - 1)
+        def q(c):
+            c = c.astype(np.float64)
+            return (np.log((c + 2.0) / (c + 1.0)) / np.log(V + 1.0))
+    elif sampler == "custom_dist":
+        probs = np.asarray(custom_dist, np.float64)
+        probs = probs / probs.sum()
+        negs = np.stack([rng.choice(V, size=num_neg_samples, p=probs)
+                         for _ in range(N)])
+        def q(c):
+            return probs[c]
+    else:
+        raise ValueError(f"unknown sampler {sampler!r}")
+    samples = np.concatenate([lab_np, negs], axis=1)
+    qv = (q(samples) * num_neg_samples).astype(np.float32)
+    samples_j = jnp.asarray(samples)
+    q_j = jnp.asarray(qv)
+
+    args = [input, weight]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(to_tensor(bias))
+    if sample_weight is not None:
+        args.append(to_tensor(sample_weight))
+
+    def impl(x, w, *rest):
+        i = 0
+        b = rest[i] if has_bias else None
+        i += int(has_bias)
+        sw = rest[i] if sample_weight is not None else None
+        ws = w[samples_j]                       # [N, 1+S, D]
+        logits = jnp.einsum("nd,nsd->ns", x, ws)
+        if b is not None:
+            logits = logits + b[samples_j]
+        o = jax.nn.sigmoid(logits)
+        t = num_true
+        cost_true = -jnp.log(o[:, :t] / (o[:, :t] + q_j[:, :t]))
+        cost_neg = -jnp.log(q_j[:, t:] / (o[:, t:] + q_j[:, t:]))
+        cost = jnp.sum(cost_true, axis=1) + jnp.sum(cost_neg, axis=1)
+        if sw is not None:
+            cost = cost * sw.reshape(-1)
+        return cost.reshape(-1, 1)
+
+    return dispatch("nce", impl, tuple(args), {})

@@ -1,4 +1,5 @@
 """paddle.optimizer namespace."""
 from .optimizers import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Lars, LarsMomentum,
-                         Adagrad, Adadelta, RMSProp, Lamb, L2Decay)  # noqa: F401
+                         Adagrad, Adadelta, RMSProp, Lamb, L2Decay,
+                         Ftrl, DecayedAdagrad)  # noqa: F401
 from . import lr  # noqa: F401

@@ -25,7 +25,8 @@ from ..core.tensor import Parameter, Tensor
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "LarsMomentum"]
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "LarsMomentum",
+           "Ftrl", "DecayedAdagrad"]
 
 
 from ..regularizer import L1Decay, L2Decay, WeightDecayRegularizer
@@ -132,8 +133,10 @@ class Optimizer:
                 parr = self._master_weights.get(key, p._data)
                 sr = g.merged()
                 vals = sr.values.astype(parr.dtype)
-                lr_eff = lr * p.optimize_attr.get("learning_rate", 1.0)
-                reg = p.regularizer if p.regularizer is not None \
+                lr_eff = lr * (getattr(p, "optimize_attr", None)
+                           or {}).get("learning_rate", 1.0)
+                reg = getattr(p, "regularizer", None) \
+                if getattr(p, "regularizer", None) is not None \
                     else (self._weight_decay_reg
                           if self._coupled_weight_decay else None)
                 if reg is not None and getattr(reg, "coeff", 0.0):
@@ -152,8 +155,10 @@ class Optimizer:
             key = id(p)
             parr = self._master_weights.get(key, p._data)
             garr = garr.astype(parr.dtype)
-            lr_eff = lr * p.optimize_attr.get("learning_rate", 1.0)
-            reg = p.regularizer if p.regularizer is not None \
+            lr_eff = lr * (getattr(p, "optimize_attr", None)
+                           or {}).get("learning_rate", 1.0)
+            reg = getattr(p, "regularizer", None) \
+                if getattr(p, "regularizer", None) is not None \
                 else (self._weight_decay_reg if self._coupled_weight_decay
                       else None)
             if reg is not None and reg.coeff:
@@ -208,9 +213,10 @@ class Optimizer:
             state_names = [f"{p.name}_{k}" for k in keys]
             for sn, k in zip(state_names, keys):
                 prog.state_vars[sn] = state[k]
-            reg = p.regularizer if p.regularizer is not None else (
-                self._weight_decay_reg if self._coupled_weight_decay
-                else None)
+            reg = getattr(p, "regularizer", None)
+            if reg is None:
+                reg = (self._weight_decay_reg
+                       if self._coupled_weight_decay else None)
 
             def impl(param, grad, lr, *slots, _keys=tuple(keys),
                      _self=self, _p=p, _reg=reg):
@@ -218,7 +224,8 @@ class Optimizer:
                 g = grad.astype(param.dtype)
                 if _reg is not None and _reg.coeff:
                     g = g + _reg.grad(param)
-                lr_eff = lr * _p.optimize_attr.get("learning_rate", 1.0)
+                lr_eff = lr * (getattr(_p, "optimize_attr", None)
+                               or {}).get("learning_rate", 1.0)
                 new_p, new_sd = _self._update(param, g,
                                               dict(zip(_keys, slots)),
                                               lr_eff)
@@ -245,8 +252,10 @@ class Optimizer:
         # like the eager step() does.
         by_id = {id(p._data): p for p in (self._parameter_list or [])}
         self._fn_regularizers = {
-            n: by_id[id(a)].regularizer for n, a in params.items()
-            if id(a) in by_id and by_id[id(a)].regularizer is not None}
+            n: getattr(by_id[id(a)], "regularizer", None)
+            for n, a in params.items()
+            if id(a) in by_id
+            and getattr(by_id[id(a)], "regularizer", None) is not None}
         state = {n: self._init_state_for(
             a.astype(jnp.float32) if self._multi_precision and
             a.dtype in (jnp.bfloat16, jnp.float16) else a)
@@ -497,7 +506,8 @@ class AdamW(Adam):
             parr = self._master_weights.get(key, p._data)
             self._wd_for_current = self._weight_decay if \
                 self._should_decay(p.name) else 0.0
-            lr_eff = lr * p.optimize_attr.get("learning_rate", 1.0)
+            lr_eff = lr * (getattr(p, "optimize_attr", None)
+                           or {}).get("learning_rate", 1.0)
             if isinstance(g, SelectedRows):
                 sr = g.merged()
                 new_p, new_state = self._update_sparse(
@@ -679,3 +689,63 @@ class Lamb(Optimizer):
         return new_p.astype(param.dtype), {"moment1": m1, "moment2": m2,
                                            "beta1_pow": b1p,
                                            "beta2_pow": b2p}
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference operators/optimizers/ftrl_op.h:150):
+    n += g^2; sigma = (n_new^0.5 - n_old^0.5)/lr (lr_power=-0.5);
+    z += g - sigma*p; p = (l1*sign(z) - z) / (n_new^0.5/lr + 2*l2)
+    when |z| > l1 else 0."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        # the reference adds 1e-10 so l1/l2=0 still shrink-selects
+        self._l1 = float(l1) + 1e-10
+        self._l2 = float(l2) + 1e-10
+        self._lr_power = float(lr_power)
+
+    def _init_state_for(self, param_arr):
+        return {"squared": jnp.zeros_like(param_arr),
+                "linear": jnp.zeros_like(param_arr)}
+
+    def _update(self, param, grad, state, lr):
+        l1, l2, p_ = self._l1, self._l2, self._lr_power
+        sq, lin = state["squared"], state["linear"]
+        new_sq = sq + jnp.square(grad)
+        if p_ == -0.5:
+            sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+            y = jnp.sqrt(new_sq) / lr + 2 * l2
+        else:
+            sigma = (new_sq ** (-p_) - sq ** (-p_)) / lr
+            y = new_sq ** (-p_) / lr + 2 * l2
+        new_lin = lin + grad - sigma * param
+        x = l1 * jnp.sign(new_lin) - new_lin
+        new_p = jnp.where(jnp.abs(new_lin) > l1, x / y,
+                          jnp.zeros_like(param))
+        return new_p.astype(param.dtype), {"squared": new_sq,
+                                           "linear": new_lin}
+
+
+class DecayedAdagrad(Optimizer):
+    """reference operators/optimizers/decayed_adagrad_op.h:63:
+    moment = decay*moment + (1-decay)*g^2;
+    p -= lr * g / (sqrt(moment) + eps)."""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-06,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._decay, self._epsilon = float(decay), float(epsilon)
+
+    def _init_state_for(self, param_arr):
+        return {"moment": jnp.zeros_like(param_arr)}
+
+    def _update(self, param, grad, state, lr):
+        m = self._decay * state["moment"] + \
+            (1 - self._decay) * jnp.square(grad)
+        new_p = param - lr * grad / (jnp.sqrt(m) + self._epsilon)
+        return new_p, {"moment": m}

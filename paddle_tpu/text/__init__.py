@@ -1,7 +1,9 @@
 """paddle.text namespace (reference python/paddle/text/__init__.py)."""
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+from .tokenizer import FasterTokenizer, to_string_tensor  # noqa: F401
 from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
                        UCIHousing, WMT14, WMT16)
 
-__all__ = ["ViterbiDecoder", "viterbi_decode", "Conll05st", "Imdb",
+__all__ = ["FasterTokenizer", "to_string_tensor",
+           "ViterbiDecoder", "viterbi_decode", "Conll05st", "Imdb",
            "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16"]

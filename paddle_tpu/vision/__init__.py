@@ -40,3 +40,6 @@ def image_load(path, backend=None):
         raise RuntimeError(
             "image decoding needs PIL, which is not available; save "
             "arrays as .npy or decode in your own loader") from e
+
+
+from . import detection  # noqa: E402,F401

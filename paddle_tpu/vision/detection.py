@@ -1,0 +1,1160 @@
+"""Detection op family.
+
+Reference parity: ``paddle/fluid/operators/detection/`` — prior_box
+(prior_box_op.h:53), density_prior_box (density_prior_box_op.h:30),
+anchor_generator (anchor_generator_op.h:31), box_coder
+(box_coder_op.h:38), iou_similarity (iou_similarity_op.h:55), box_clip
+(box_clip_op.h:30), bipartite_match (bipartite_match_op.cc:72),
+target_assign (target_assign_op.h:48), multiclass_nms
+(multiclass_nms_op.cc:82), matrix_nms (matrix_nms_op.cc:144),
+locality_aware_nms (locality_aware_nms_op.cc:186), generate_proposals
+(generate_proposals_op.cc:57), distribute_fpn_proposals
+(distribute_fpn_proposals_op.h:47), collect_fpn_proposals
+(collect_fpn_proposals_op.h:55), rpn_target_assign
+(rpn_target_assign_op.cc:214), retinanet_target_assign
+(rpn_target_assign_op.cc:578), retinanet_detection_output
+(retinanet_detection_output_op.cc:154), generate_proposal_labels
+(generate_proposal_labels_op.cc:64), generate_mask_labels
+(generate_mask_labels_op.cc:82), mine_hard_examples
+(mine_hard_examples_op.cc:54), detection_map (detection_map_op.h:52),
+mean_iou (mean_iou_op.h:28), box_decoder_and_assign
+(box_decoder_and_assign_op.h:27).
+
+TPU-first split: pure box geometry (priors, coding, IoU, clipping,
+mean-IoU) is jnp and jit/grad-friendly; the data-dependent stages (NMS,
+proposal generation, target sampling) run on host in numpy — the
+reference runs these same stages on CPU kernels with dynamic output
+LoD, which has no fixed-shape XLA analog, so host execution IS the
+reference architecture here, feeding fixed-shape device stages around
+it.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "iou_similarity", "box_coder", "box_clip", "prior_box",
+    "density_prior_box", "anchor_generator", "bipartite_match",
+    "target_assign", "multiclass_nms", "matrix_nms",
+    "locality_aware_nms", "generate_proposals",
+    "distribute_fpn_proposals", "collect_fpn_proposals",
+    "rpn_target_assign", "retinanet_target_assign",
+    "retinanet_detection_output", "generate_proposal_labels",
+    "generate_mask_labels", "mine_hard_examples", "detection_map",
+    "mean_iou", "box_decoder_and_assign", "nms",
+]
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._data)
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# jittable geometry
+# ---------------------------------------------------------------------------
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU of [N,4] and [M,4] boxes -> [N,M]
+    (iou_similarity_op.h:55; +1 extent when boxes are pixel coords)."""
+    off = 0.0 if box_normalized else 1.0
+
+    def impl(bx, by):
+        ax = (bx[:, 2] - bx[:, 0] + off) * (bx[:, 3] - bx[:, 1] + off)
+        ay = (by[:, 2] - by[:, 0] + off) * (by[:, 3] - by[:, 1] + off)
+        x1 = jnp.maximum(bx[:, None, 0], by[None, :, 0])
+        y1 = jnp.maximum(bx[:, None, 1], by[None, :, 1])
+        x2 = jnp.minimum(bx[:, None, 2], by[None, :, 2])
+        y2 = jnp.minimum(bx[:, None, 3], by[None, :, 3])
+        iw = jnp.clip(x2 - x1 + off, 0)
+        ih = jnp.clip(y2 - y1 + off, 0)
+        inter = iw * ih
+        union = ax[:, None] + ay[None, :] - inter
+        return jnp.where(union > 0, inter / union, 0.0)
+
+    return dispatch("iou_similarity", impl, (to_tensor(x), to_tensor(y)), {})
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (box_coder_op.h:38).
+
+    encode: target [N,4] x prior [M,4] -> [N,M,4]
+    decode: target [N,M,4] (or [N,1,4]/[1,N,4] per axis) -> [N,M,4]
+    prior_box_var: None | [M,4] Tensor | list of 4 floats.
+    """
+    norm = bool(box_normalized)
+    var_is_tensor = isinstance(prior_box_var, (Tensor, np.ndarray)) or (
+        hasattr(prior_box_var, "shape") and not isinstance(prior_box_var,
+                                                           (list, tuple)))
+    var_list = (tuple(float(v) for v in prior_box_var)
+                if isinstance(prior_box_var, (list, tuple)) else None)
+
+    off = 0.0 if norm else 1.0
+
+    def _prior_cwh(pb):
+        pw = pb[..., 2] - pb[..., 0] + off
+        ph = pb[..., 3] - pb[..., 1] + off
+        pcx = pb[..., 0] + pw / 2
+        pcy = pb[..., 1] + ph / 2
+        return pcx, pcy, pw, ph
+
+    if code_type == "encode_center_size":
+        def impl(pb, tb, *rest):
+            pcx, pcy, pw, ph = _prior_cwh(pb)          # [M]
+            tcx = (tb[:, 2] + tb[:, 0]) / 2            # [N]
+            tcy = (tb[:, 3] + tb[:, 1]) / 2
+            tw = tb[:, 2] - tb[:, 0] + off
+            th = tb[:, 3] - tb[:, 1] + off
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                jnp.log(jnp.abs(tw[:, None] / pw[None, :])),
+                jnp.log(jnp.abs(th[:, None] / ph[None, :])),
+            ], axis=-1)                                # [N,M,4]
+            if rest:
+                out = out / rest[0][None, :, :]
+            elif var_list is not None:
+                out = out / jnp.asarray(var_list)
+            return out
+
+        args = [to_tensor(prior_box), to_tensor(target_box)]
+        if var_is_tensor:
+            args.append(to_tensor(prior_box_var))
+        return dispatch("box_coder", impl, tuple(args), {})
+
+    if code_type != "decode_center_size":
+        raise ValueError(f"unknown code_type {code_type!r}")
+
+    def impl(pb, tb, *rest):
+        # pb: [M,4]; tb: [N,M,4] (axis=0 -> prior per column,
+        # axis=1 -> prior per row)
+        pcx, pcy, pw, ph = _prior_cwh(pb)
+        if axis == 0:
+            pcx, pcy, pw, ph = (v[None, :] for v in (pcx, pcy, pw, ph))
+            vshape = (1, -1, 4)
+        else:
+            pcx, pcy, pw, ph = (v[:, None] for v in (pcx, pcy, pw, ph))
+            vshape = (-1, 1, 4)
+        if rest:
+            var = rest[0].reshape(vshape)
+            vx, vy, vw, vh = (var[..., k] for k in range(4))
+        elif var_list is not None:
+            vx, vy, vw, vh = var_list
+        else:
+            vx = vy = vw = vh = 1.0
+        tcx = vx * tb[..., 0] * pw + pcx
+        tcy = vy * tb[..., 1] * ph + pcy
+        tw = jnp.exp(vw * tb[..., 2]) * pw
+        th = jnp.exp(vh * tb[..., 3]) * ph
+        return jnp.stack([tcx - tw / 2, tcy - th / 2,
+                          tcx + tw / 2 - off, tcy + th / 2 - off], axis=-1)
+
+    args = [to_tensor(prior_box), to_tensor(target_box)]
+    if var_is_tensor:
+        args.append(to_tensor(prior_box_var))
+    return dispatch("box_coder", impl, tuple(args), {})
+
+
+def box_clip(input, im_info, name=None):
+    """Clip [..., 4] boxes to image extent (box_clip_op.h:30); im_info
+    rows are (height, width, scale)."""
+    def impl(boxes, info):
+        h = info[..., 0] / info[..., 2] - 1
+        w = info[..., 1] / info[..., 2] - 1
+        shape = boxes.shape
+        b = boxes.reshape(info.shape[0], -1, 4) if info.ndim == 2 else boxes
+        hh = h.reshape(-1, 1) if info.ndim == 2 else h
+        ww = w.reshape(-1, 1) if info.ndim == 2 else w
+        out = jnp.stack([
+            jnp.clip(b[..., 0], 0, ww), jnp.clip(b[..., 1], 0, hh),
+            jnp.clip(b[..., 2], 0, ww), jnp.clip(b[..., 3], 0, hh),
+        ], axis=-1)
+        return out.reshape(shape)
+
+    return dispatch("box_clip", impl,
+                    (to_tensor(input), to_tensor(im_info)), {})
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes over a feature map (prior_box_op.h:53).
+    Returns (boxes [H,W,P,4], variances [H,W,P,4]), normalized coords."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = _expand_aspect_ratios([float(a) for a in aspect_ratios], flip)
+    min_sizes = [float(m) for m in min_sizes]
+    max_sizes = [float(m) for m in (max_sizes or [])]
+    if max_sizes:
+        assert len(max_sizes) == len(min_sizes)
+
+    cx = (np.arange(fw) + offset) * step_w            # [W]
+    cy = (np.arange(fh) + offset) * step_h            # [H]
+    cx, cy = np.meshgrid(cx, cy)                      # [H,W]
+    whs: List[Tuple[float, float]] = []
+    for s, mn in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((mn / 2.0, mn / 2.0))
+            if max_sizes:
+                m = math.sqrt(mn * max_sizes[s]) / 2.0
+                whs.append((m, m))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((mn * math.sqrt(ar) / 2, mn / math.sqrt(ar) / 2))
+        else:
+            for ar in ars:
+                whs.append((mn * math.sqrt(ar) / 2, mn / math.sqrt(ar) / 2))
+            if max_sizes:
+                m = math.sqrt(mn * max_sizes[s]) / 2.0
+                whs.append((m, m))
+    wh = np.asarray(whs, np.float32)                  # [P,2]
+    boxes = np.stack([
+        (cx[..., None] - wh[None, None, :, 0]) / iw,
+        (cy[..., None] - wh[None, None, :, 1]) / ih,
+        (cx[..., None] + wh[None, None, :, 0]) / iw,
+        (cy[..., None] + wh[None, None, :, 1]) / ih,
+    ], axis=-1).astype(np.float32)                    # [H,W,P,4]
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Densified priors (density_prior_box_op.h:30): each fixed_size is
+    laid out on a density x density sub-grid inside the step cell."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    densities = [int(d) for d in densities]
+    fixed_sizes = [float(s) for s in fixed_sizes]
+    fixed_ratios = [float(r) for r in fixed_ratios]
+
+    rows = []
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            for size, dens in zip(fixed_sizes, densities):
+                for ar in fixed_ratios:
+                    bw = size * math.sqrt(ar)
+                    bh = size / math.sqrt(ar)
+                    shift = size / dens
+                    for di in range(dens):
+                        for dj in range(dens):
+                            c_x = cx - size / 2 + shift / 2 + dj * shift
+                            c_y = cy - size / 2 + shift / 2 + di * shift
+                            rows.append([(c_x - bw / 2) / iw,
+                                         (c_y - bh / 2) / ih,
+                                         (c_x + bw / 2) / iw,
+                                         (c_y + bh / 2) / ih])
+    num = sum(d * d * len(fixed_ratios) for d in densities)
+    boxes = np.asarray(rows, np.float32).reshape(fh, fw, num, 4)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """RPN anchors in pixel coords (anchor_generator_op.h:31).
+    Returns (anchors [H,W,A,4], variances [H,W,A,4])."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
+    whs = []
+    for ar in aspect_ratios:
+        for sz in anchor_sizes:
+            area = sw * sh
+            area_ratio = area / float(ar)
+            base_w = round(math.sqrt(area_ratio))
+            base_h = round(base_w * float(ar))
+            scale_w = float(sz) / sw
+            scale_h = float(sz) / sh
+            whs.append((scale_w * base_w / 2, scale_h * base_h / 2))
+    wh = np.asarray(whs, np.float32)                  # [A,2]
+    cx = (np.arange(fw) + offset) * sw
+    cy = (np.arange(fh) + offset) * sh
+    cx, cy = np.meshgrid(cx, cy)
+    anchors = np.stack([
+        cx[..., None] - wh[None, None, :, 0],
+        cy[..., None] - wh[None, None, :, 1],
+        cx[..., None] + wh[None, None, :, 0],
+        cy[..., None] + wh[None, None, :, 1],
+    ], axis=-1).astype(np.float32)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          anchors.shape).copy()
+    return Tensor(jnp.asarray(anchors)), Tensor(jnp.asarray(var))
+
+
+def mean_iou(input, label, num_classes, name=None):
+    """Segmentation mean-IoU (mean_iou_op.h:28).  Returns
+    (mean_iou scalar, out_wrong [C], out_correct [C])."""
+    def impl(pred, lab):
+        pred = pred.reshape(-1).astype(jnp.int32)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        correct = jnp.zeros((num_classes,), jnp.int32).at[
+            jnp.where(pred == lab, pred, num_classes)].add(
+                1, mode="drop")
+        pred_cnt = jnp.zeros((num_classes,), jnp.int32).at[pred].add(1)
+        lab_cnt = jnp.zeros((num_classes,), jnp.int32).at[lab].add(1)
+        union = pred_cnt + lab_cnt - correct
+        valid = union > 0
+        iou = jnp.where(valid, correct / jnp.maximum(union, 1), 0.0)
+        miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+        wrong = pred_cnt - correct
+        return miou.astype(jnp.float32), wrong, correct
+
+    out = dispatch("mean_iou", impl, (to_tensor(input), to_tensor(label)),
+                   {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host-side (data-dependent) kernels
+# ---------------------------------------------------------------------------
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    """Greedy maximum bipartite matching (bipartite_match_op.cc:72).
+    dist_matrix: [N,M] or [B,N,M].  Returns (match_indices [B,M] int32,
+    match_dist [B,M] float32); -1 where a column is unmatched."""
+    d = _np(dist_matrix).astype(np.float64)
+    batched = d.ndim == 3
+    mats = d if batched else d[None]
+    B, N, M = mats.shape
+    all_idx = np.full((B, M), -1, np.int32)
+    all_dist = np.zeros((B, M), np.float32)
+    for b in range(B):
+        mat = mats[b].copy()
+        row_used = np.zeros(N, bool)
+        col_used = np.zeros(M, bool)
+        work = mat.copy()
+        while True:
+            flat = np.argmax(work)
+            i, j = divmod(int(flat), M)
+            if work[i, j] <= 0:
+                break
+            all_idx[b, j] = i
+            all_dist[b, j] = mat[i, j]
+            row_used[i] = True
+            col_used[j] = True
+            work[i, :] = -1
+            work[:, j] = -1
+            if row_used.all() or col_used.all():
+                break
+        if match_type == "per_prediction":
+            for j in range(M):
+                if all_idx[b, j] >= 0:
+                    continue
+                i = int(np.argmax(mat[:, j]))
+                if mat[i, j] >= dist_threshold:
+                    all_idx[b, j] = i
+                    all_dist[b, j] = mat[i, j]
+    if not batched:
+        pass  # keep leading batch dim of 1, matching the LoD output shape
+    return Tensor(jnp.asarray(all_idx)), Tensor(jnp.asarray(all_dist))
+
+
+def target_assign(input, match_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """Gather per-prior targets by match indices (target_assign_op.h:48).
+    input: [N_gt, K] per batch stacked as [B, N_gt, K] (or [N_gt, K] for
+    B=1); match_indices: [B, M].  Returns (out [B, M, K], out_weight
+    [B, M, 1])."""
+    x = _np(input)
+    mi = _np(match_indices).astype(np.int64)
+    if x.ndim == 2:
+        x = np.broadcast_to(x[None], (mi.shape[0],) + x.shape)
+    B, M = mi.shape
+    K = x.shape[-1]
+    out = np.full((B, M, K), mismatch_value, x.dtype)
+    wt = np.zeros((B, M, 1), np.float32)
+    for b in range(B):
+        pos = mi[b] >= 0
+        out[b, pos] = x[b, mi[b, pos]]
+        wt[b, pos] = 1.0
+    if negative_indices is not None:
+        neg = _np(negative_indices).astype(np.int64)
+        if neg.ndim == 1:
+            neg = neg[None]
+        for b in range(B):
+            idx = neg[b][neg[b] >= 0]
+            out[b, idx] = mismatch_value
+            wt[b, idx] = 1.0
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(wt))
+
+
+def _iou_np(a, b, off=1.0):
+    x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.clip(x2 - x1 + off, 0, None) * np.clip(y2 - y1 + off, 0, None)
+    aa = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    ab = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    union = aa[:, None] + ab[None, :] - inter
+    return np.where(union > 0, inter / union, 0.0)
+
+
+def _nms_kernel(boxes, scores, nms_threshold, eta=1.0, top_k=-1,
+                normalized=True):
+    """Greedy hard-NMS indices, adaptive threshold via eta
+    (multiclass_nms_op.cc NMSFast)."""
+    order = np.argsort(-scores, kind="stable")
+    if top_k >= 0:
+        order = order[:top_k]
+    off = 0.0 if normalized else 1.0
+    keep = []
+    thr = float(nms_threshold)
+    areas = (boxes[:, 2] - boxes[:, 0] + off) * \
+            (boxes[:, 3] - boxes[:, 1] + off)
+    for i in order:
+        ok = True
+        for j in keep:
+            x1 = max(boxes[i, 0], boxes[j, 0])
+            y1 = max(boxes[i, 1], boxes[j, 1])
+            x2 = min(boxes[i, 2], boxes[j, 2])
+            y2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(0.0, x2 - x1 + off) * max(0.0, y2 - y1 + off)
+            union = areas[i] + areas[j] - inter
+            iou = inter / union if union > 0 else 0.0
+            if iou > thr:
+                ok = False
+                break
+        if ok:
+            keep.append(int(i))
+            if eta < 1.0 and thr > 0.5:
+                thr *= eta
+    return keep
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   rois_num=None, name=None):
+    """Batched multiclass hard NMS (multiclass_nms_op.cc:82; the v2/v3
+    ops add Index / NmsRoisNum outputs — both returned here).
+
+    bboxes: [B, M, 4]; scores: [B, C, M].  Returns (out [K,6],
+    index [K,1], nms_rois_num [B]); out rows are
+    (label, score, x1, y1, x2, y2).
+    """
+    b = _np(bboxes)
+    s = _np(scores)
+    assert b.ndim == 3 and s.ndim == 3, "expect [B,M,4] boxes, [B,C,M] scores"
+    B, M, _ = b.shape
+    C = s.shape[1]
+    all_rows, all_idx, rois_n = [], [], []
+    for bi in range(B):
+        cand = []   # (score, class, box_idx)
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = s[bi, c]
+            mask = sc > score_threshold
+            idxs = np.where(mask)[0]
+            if idxs.size == 0:
+                continue
+            keep = _nms_kernel(b[bi][idxs], sc[idxs], nms_threshold,
+                               nms_eta, nms_top_k, normalized)
+            for k in keep:
+                cand.append((float(sc[idxs[k]]), c, int(idxs[k])))
+        cand.sort(key=lambda t: -t[0])
+        if keep_top_k >= 0:
+            cand = cand[:keep_top_k]
+        rois_n.append(len(cand))
+        for score, c, bx in cand:
+            all_rows.append([c, score] + list(b[bi, bx]))
+            all_idx.append(bi * M + bx)
+    out = np.asarray(all_rows, np.float32).reshape(-1, 6)
+    idx = np.asarray(all_idx, np.int32).reshape(-1, 1)
+    nums = np.asarray(rois_n, np.int32)
+    res = (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(idx)),
+           Tensor(jnp.asarray(nums)))
+    return res if return_index else (res[0], res[2])
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Soft suppression via decayed scores (matrix_nms_op.cc:144).
+    Returns (out [K,6], rois_num [B][, index [K,1]])."""
+    b = _np(bboxes)
+    s = _np(scores)
+    B, M, _ = b.shape
+    C = s.shape[1]
+    off = 0.0 if normalized else 1.0
+    all_rows, all_idx, rois_n = [], [], []
+    for bi in range(B):
+        rows = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = s[bi, c]
+            sel = np.where(sc > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = sel[np.argsort(-sc[sel], kind="stable")][:nms_top_k]
+            bx = b[bi][order]
+            ss = sc[order].astype(np.float64)
+            n = len(order)
+            iou = np.triu(_iou_np(bx, bx, off), 1)       # iou[j, i]: j earlier (higher score)
+            # decay for i: min_{j<i} f(iou_ji) / f(compensate_j), where
+            # compensate_j = max_{k<j} iou_kj (how suppressed j itself is)
+            compensate = iou.max(axis=0)     # column max = per-box j
+            if use_gaussian:
+                decay = np.exp(-gaussian_sigma *
+                               (iou ** 2 - compensate[:, None] ** 2))
+            else:
+                decay = (1.0 - iou) / (1.0 - compensate[:, None] + 1e-10)
+            decay = np.where(np.triu(np.ones((n, n), bool), 1), decay, 1.0)
+            decay_i = decay.min(axis=0)
+            new_scores = ss * decay_i
+            for k in range(n):
+                if new_scores[k] > post_threshold:
+                    rows.append((float(new_scores[k]), c, int(order[k])))
+        rows.sort(key=lambda t: -t[0])
+        if keep_top_k >= 0:
+            rows = rows[:keep_top_k]
+        rois_n.append(len(rows))
+        for score, c, k in rows:
+            all_rows.append([c, score] + list(b[bi, k]))
+            all_idx.append(bi * M + k)
+    out = np.asarray(all_rows, np.float32).reshape(-1, 6)
+    idx = np.asarray(all_idx, np.int32).reshape(-1, 1)
+    nums = np.asarray(rois_n, np.int32)
+    res = [Tensor(jnp.asarray(out))]
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(nums)))
+    if return_index:
+        res.append(Tensor(jnp.asarray(idx)))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """paddle.vision.ops.nms-style single-image NMS.  Returns kept
+    indices sorted by score (or box order when scores is None)."""
+    b = _np(boxes)
+    s = (_np(scores) if scores is not None
+         else np.arange(b.shape[0], 0, -1, dtype=np.float32))
+    if category_idxs is None:
+        keep = _nms_kernel(b, s, iou_threshold)
+    else:
+        cats = _np(category_idxs)
+        keep = []
+        for c in (categories if categories is not None
+                  else np.unique(cats)):
+            idxs = np.where(cats == c)[0]
+            if idxs.size:
+                kept = _nms_kernel(b[idxs], s[idxs], iou_threshold)
+                keep.extend(int(idxs[k]) for k in kept)
+        keep.sort(key=lambda i: -s[i])
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(np.asarray(keep, np.int64)))
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """EAST-style NMS (locality_aware_nms_op.cc:186): consecutive
+    overlapping boxes are score-weighted-merged first, then hard NMS.
+    Single class typical; inputs as multiclass_nms."""
+    b = _np(bboxes).copy()
+    s = _np(scores).copy()
+    B, M, _ = b.shape
+    C = s.shape[1]
+    off = 0.0 if normalized else 1.0
+    all_rows, rois_n = [], []
+
+    def iou_one(p, q):
+        return float(_iou_np(np.asarray(p)[None], np.asarray(q)[None],
+                             off)[0, 0])
+
+    for bi in range(B):
+        cand = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = s[bi, c].copy()
+            bx = b[bi].copy()
+            # locality-aware merge pass over input order
+            merged_b, merged_s = [], []
+            for m in range(M):
+                if sc[m] <= score_threshold:
+                    continue
+                if merged_b and iou_one(merged_b[-1], bx[m]) > nms_threshold:
+                    w1, w2 = merged_s[-1], sc[m]
+                    tot = w1 + w2
+                    merged_b[-1] = (merged_b[-1] * w1 + bx[m] * w2) / tot
+                    merged_s[-1] = max(w1, w2)
+                else:
+                    merged_b.append(bx[m].astype(np.float64))
+                    merged_s.append(float(sc[m]))
+            if not merged_b:
+                continue
+            mb = np.asarray(merged_b)
+            ms = np.asarray(merged_s)
+            keep = _nms_kernel(mb, ms, nms_threshold, nms_eta, nms_top_k,
+                               normalized)
+            for k in keep:
+                cand.append((float(ms[k]), c, mb[k]))
+        cand.sort(key=lambda t: -t[0])
+        if keep_top_k >= 0:
+            cand = cand[:keep_top_k]
+        rois_n.append(len(cand))
+        for score, c, box in cand:
+            all_rows.append([c, score] + list(box))
+    out = np.asarray(all_rows, np.float32).reshape(-1, 6)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(
+        np.asarray(rois_n, np.int32)))
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=True, return_rois_num=True, name=None):
+    """RPN proposal generation (generate_proposals_op.cc:57; the _v2 op
+    swaps im_info for im_shape ≡ pixel_offset=False path).
+
+    scores [N,A,H,W], bbox_deltas [N,4A,H,W], im_info [N,3] (or im_shape
+    [N,2]), anchors [H,W,A,4]|[HWA,4], variances same.
+    Returns (rpn_rois [K,4], rpn_roi_probs [K,1], rois_num [N]).
+    """
+    sc = _np(scores)
+    bd = _np(bbox_deltas)
+    info = _np(im_info)
+    an = _np(anchors).reshape(-1, 4)
+    va = _np(variances).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    rois, probs, nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for n in range(N):
+        s1 = sc[n].transpose(1, 2, 0).reshape(-1)          # HWA
+        d1 = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s1, kind="stable")[:pre_nms_top_n]
+        s2, d2, an2, va2 = s1[order], d1[order], an[order], va[order]
+        # decode (variance-scaled center-size, like the reference's
+        # box_coder decode with per-anchor variance)
+        pw = an2[:, 2] - an2[:, 0] + off
+        ph = an2[:, 3] - an2[:, 1] + off
+        pcx = an2[:, 0] + pw / 2
+        pcy = an2[:, 1] + ph / 2
+        cx = va2[:, 0] * d2[:, 0] * pw + pcx
+        cy = va2[:, 1] * d2[:, 1] * ph + pcy
+        w = np.exp(np.minimum(va2[:, 2] * d2[:, 2], 10.0)) * pw
+        h = np.exp(np.minimum(va2[:, 3] * d2[:, 3], 10.0)) * ph
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], axis=1)
+        # clip to image
+        if info.shape[1] == 3:
+            ih, iw = info[n, 0], info[n, 1]
+            scale = info[n, 2]
+        else:
+            ih, iw = info[n, 0], info[n, 1]
+            scale = 1.0
+        boxes[:, 0] = np.clip(boxes[:, 0], 0, iw - off)
+        boxes[:, 1] = np.clip(boxes[:, 1], 0, ih - off)
+        boxes[:, 2] = np.clip(boxes[:, 2], 0, iw - off)
+        boxes[:, 3] = np.clip(boxes[:, 3], 0, ih - off)
+        # filter tiny boxes (min_size scaled to input image)
+        ms = max(min_size, 1.0) * scale if info.shape[1] == 3 else \
+            max(min_size, 1.0)
+        ww = boxes[:, 2] - boxes[:, 0] + off
+        hh = boxes[:, 3] - boxes[:, 1] + off
+        keep_mask = (ww >= ms) & (hh >= ms)
+        boxes, s3 = boxes[keep_mask], s2[keep_mask]
+        if boxes.shape[0] == 0:
+            nums.append(0)
+            continue
+        keep = _nms_kernel(boxes, s3, nms_thresh, eta,
+                           normalized=not pixel_offset)
+        keep = keep[:post_nms_top_n]
+        rois.append(boxes[keep])
+        probs.append(s3[keep])
+        nums.append(len(keep))
+    rois = (np.concatenate(rois) if rois
+            else np.zeros((0, 4), np.float32))
+    probs = (np.concatenate(probs) if probs
+             else np.zeros((0,), np.float32))
+    out = (Tensor(jnp.asarray(rois.astype(np.float32))),
+           Tensor(jnp.asarray(probs.astype(np.float32).reshape(-1, 1))))
+    if return_rois_num:
+        out = out + (Tensor(jnp.asarray(np.asarray(nums, np.int32))),)
+    return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=True, rois_num=None,
+                             name=None):
+    """Scatter RoIs onto FPN levels by scale
+    (distribute_fpn_proposals_op.h:47).  Returns (multi_rois list,
+    restore_ind [K,1], rois_num_per_level list)."""
+    rois = _np(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.clip(w * h, 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi, nums, order = [], [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.where(lvl == L)[0]
+        multi.append(Tensor(jnp.asarray(rois[idx])))
+        nums.append(Tensor(jnp.asarray(
+            np.asarray([idx.size], np.int32))))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(order.size)
+    return multi, Tensor(jnp.asarray(restore.reshape(-1, 1))), nums
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Gather per-level RoIs back, keep global top-N by score
+    (collect_fpn_proposals_op.h:55)."""
+    rois = np.concatenate([_np(r) for r in multi_rois], axis=0)
+    scores = np.concatenate([_np(s).reshape(-1) for s in multi_scores])
+    order = np.argsort(-scores, kind="stable")[:post_nms_top_n]
+    order = np.sort(order)          # reference re-sorts by original order
+    return Tensor(jnp.asarray(rois[order])), Tensor(jnp.asarray(
+        np.asarray([order.size], np.int32)))
+
+
+def _box_encode_np(anchors, gt, off=1.0):
+    pw = anchors[:, 2] - anchors[:, 0] + off
+    ph = anchors[:, 3] - anchors[:, 1] + off
+    pcx = anchors[:, 0] + pw / 2
+    pcy = anchors[:, 1] + ph / 2
+    gw = gt[:, 2] - gt[:, 0] + off
+    gh = gt[:, 3] - gt[:, 1] + off
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    return np.stack([(gcx - pcx) / pw, (gcy - pcy) / ph,
+                     np.log(gw / pw), np.log(gh / ph)], axis=1)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=False,
+                      name=None):
+    """Sample RPN anchors (rpn_target_assign_op.cc:214).  Single image.
+    Returns (loc_index, score_index, tgt_bbox, tgt_label) flat tensors.
+    use_random=False takes deterministic prefixes (the reference's test
+    mode)."""
+    anchors = _np(anchor_box).reshape(-1, 4)
+    gt = _np(gt_boxes).reshape(-1, 4)
+    A = anchors.shape[0]
+    iou = _iou_np(anchors, gt)                     # [A, G]
+    max_per_anchor = iou.max(axis=1)
+    argmax_per_anchor = iou.argmax(axis=1)
+    labels = np.full(A, -1, np.int64)
+    # positives: best anchor per gt + anchors above positive_overlap
+    best_per_gt = iou.argmax(axis=0)
+    labels[best_per_gt] = 1
+    labels[max_per_anchor >= rpn_positive_overlap] = 1
+    labels[(labels != 1) & (max_per_anchor < rpn_negative_overlap)] = 0
+    fg_cnt = int(rpn_batch_size_per_im * rpn_fg_fraction)
+    fg_idx = np.where(labels == 1)[0]
+    if fg_idx.size > fg_cnt:
+        disable = fg_idx[fg_cnt:] if not use_random else \
+            np.random.choice(fg_idx, fg_idx.size - fg_cnt, replace=False)
+        labels[disable] = -1
+        fg_idx = np.where(labels == 1)[0]
+    bg_cnt = rpn_batch_size_per_im - fg_idx.size
+    bg_idx = np.where(labels == 0)[0]
+    if bg_idx.size > bg_cnt:
+        disable = bg_idx[bg_cnt:] if not use_random else \
+            np.random.choice(bg_idx, bg_idx.size - bg_cnt, replace=False)
+        labels[disable] = -1
+    loc_index = np.where(labels == 1)[0]
+    score_index = np.where(labels >= 0)[0]
+    tgt_bbox = _box_encode_np(anchors[loc_index],
+                              gt[argmax_per_anchor[loc_index]])
+    tgt_label = labels[score_index].astype(np.int32)
+    return (Tensor(jnp.asarray(loc_index.astype(np.int32))),
+            Tensor(jnp.asarray(score_index.astype(np.int32))),
+            Tensor(jnp.asarray(tgt_bbox.astype(np.float32))),
+            Tensor(jnp.asarray(tgt_label.reshape(-1, 1))))
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4,
+                            name=None):
+    """Focal-loss target assignment (rpn_target_assign_op.cc:578): all
+    anchors keep labels (no sampling); returns fg_num too."""
+    anchors = _np(anchor_box).reshape(-1, 4)
+    gt = _np(gt_boxes).reshape(-1, 4)
+    gl = _np(gt_labels).reshape(-1)
+    A = anchors.shape[0]
+    iou = _iou_np(anchors, gt)
+    max_pa = iou.max(axis=1)
+    arg_pa = iou.argmax(axis=1)
+    labels = np.full(A, -1, np.int64)
+    labels[iou.argmax(axis=0)] = 1
+    labels[max_pa >= positive_overlap] = 1
+    labels[(labels != 1) & (max_pa < negative_overlap)] = 0
+    loc_index = np.where(labels == 1)[0]
+    score_index = np.where(labels >= 0)[0]
+    tgt_bbox = _box_encode_np(anchors[loc_index], gt[arg_pa[loc_index]])
+    # class target: gt label for positives, 0 for negatives
+    tgt_label = np.zeros(score_index.size, np.int32)
+    pos_mask = labels[score_index] == 1
+    tgt_label[pos_mask] = gl[arg_pa[score_index[pos_mask]]].astype(np.int32)
+    fg_num = np.asarray([int((labels == 1).sum()) + 1], np.int32)
+    return (Tensor(jnp.asarray(loc_index.astype(np.int32))),
+            Tensor(jnp.asarray(score_index.astype(np.int32))),
+            Tensor(jnp.asarray(tgt_bbox.astype(np.float32))),
+            Tensor(jnp.asarray(tgt_label.reshape(-1, 1))),
+            Tensor(jnp.asarray(fg_num)))
+
+
+def retinanet_detection_output(bboxes, scores, im_info, score_threshold=0.05,
+                               nms_top_k=1000, keep_top_k=100,
+                               nms_threshold=0.3, nms_eta=1.0, name=None):
+    """Decode-free RetinaNet head output collection
+    (retinanet_detection_output_op.cc:154): per-FPN-level top-k +
+    threshold, then cross-level multiclass NMS.  bboxes/scores are lists
+    of [N, Ai, 4] / [N, Ai, C] per level (already decoded boxes)."""
+    N = _np(bboxes[0]).shape[0]
+    all_rows, nums = [], []
+    for n in range(N):
+        cand_boxes, cand_scores, cand_cls = [], [], []
+        for lvl in range(len(bboxes)):
+            bx = _np(bboxes[lvl])[n]               # [A,4]
+            sc = _np(scores[lvl])[n]               # [A,C]
+            flat = sc.reshape(-1)
+            sel = np.where(flat > score_threshold)[0]
+            if sel.size > nms_top_k:
+                sel = sel[np.argsort(-flat[sel], kind="stable")[:nms_top_k]]
+            a_idx, c_idx = np.divmod(sel, sc.shape[1])
+            cand_boxes.append(bx[a_idx])
+            cand_scores.append(flat[sel])
+            cand_cls.append(c_idx)
+        if not cand_boxes:
+            nums.append(0)
+            continue
+        cb = np.concatenate(cand_boxes)
+        cs = np.concatenate(cand_scores)
+        cc = np.concatenate(cand_cls)
+        rows = []
+        for c in np.unique(cc):
+            m = cc == c
+            keep = _nms_kernel(cb[m], cs[m], nms_threshold, nms_eta,
+                               normalized=False)
+            idxs = np.where(m)[0]
+            for k in keep:
+                rows.append((float(cs[idxs[k]]), int(c) + 1, cb[idxs[k]]))
+        rows.sort(key=lambda t: -t[0])
+        rows = rows[:keep_top_k]
+        nums.append(len(rows))
+        for s, c, bx in rows:
+            all_rows.append([c, s] + list(bx))
+    out = np.asarray(all_rows, np.float32).reshape(-1, 6)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(
+        np.asarray(nums, np.int32)))
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=False,
+                             is_cls_agnostic=False, name=None):
+    """Sample 2nd-stage RoIs + regression targets
+    (generate_proposal_labels_op.cc:64).  Single image.  Returns (rois,
+    labels_int32, bbox_targets, bbox_inside_weights,
+    bbox_outside_weights)."""
+    rois = _np(rpn_rois).reshape(-1, 4)
+    gt = _np(gt_boxes).reshape(-1, 4)
+    gc = _np(gt_classes).reshape(-1)
+    # gt boxes join the proposal pool (reference appends them)
+    rois = np.concatenate([rois, gt], axis=0)
+    iou = _iou_np(rois, gt)
+    max_iou = iou.max(axis=1)
+    arg_iou = iou.argmax(axis=1)
+    fg = np.where(max_iou >= fg_thresh)[0]
+    bg = np.where((max_iou < bg_thresh_hi) & (max_iou >= bg_thresh_lo))[0]
+    fg_cnt = min(int(batch_size_per_im * fg_fraction), fg.size)
+    if use_random and fg.size > fg_cnt:
+        fg = np.random.choice(fg, fg_cnt, replace=False)
+    else:
+        fg = fg[:fg_cnt]
+    bg_cnt = min(batch_size_per_im - fg_cnt, bg.size)
+    if use_random and bg.size > bg_cnt:
+        bg = np.random.choice(bg, bg_cnt, replace=False)
+    else:
+        bg = bg[:bg_cnt]
+    keep = np.concatenate([fg, bg])
+    labels = np.zeros(keep.size, np.int32)
+    labels[:fg.size] = gc[arg_iou[fg]].astype(np.int32)
+    out_rois = rois[keep]
+    # per-class regression targets
+    weights = np.asarray(bbox_reg_weights, np.float64)
+    tgt = np.zeros((keep.size, 4 * class_nums), np.float32)
+    inw = np.zeros_like(tgt)
+    deltas = _box_encode_np(rois[fg], gt[arg_iou[fg]]) / weights
+    for i, cls in enumerate(labels[:fg.size]):
+        c = 1 if is_cls_agnostic else int(cls)
+        tgt[i, 4 * c:4 * c + 4] = deltas[i]
+        inw[i, 4 * c:4 * c + 4] = 1.0
+    outw = (inw > 0).astype(np.float32)
+    return (Tensor(jnp.asarray(out_rois.astype(np.float32))),
+            Tensor(jnp.asarray(labels.reshape(-1, 1))),
+            Tensor(jnp.asarray(tgt)),
+            Tensor(jnp.asarray(inw)),
+            Tensor(jnp.asarray(outw)))
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution, name=None):
+    """Mask targets for Mask R-CNN (generate_mask_labels_op.cc:82).
+    gt_segms: list (per gt) of polygons (list of [x0,y0,x1,y1,...]) OR a
+    [G, H, W] binary mask array.  Rasterizes polygons with PIL and
+    crop-resizes each fg roi's matched gt mask to resolution**2.
+    Returns (mask_rois, roi_has_mask_int32, mask_int32)."""
+    rois_np = _np(rois).reshape(-1, 4)
+    labels = _np(labels_int32).reshape(-1)
+    gt = None
+    info = _np(im_info).reshape(-1)
+    H, W = int(round(float(info[0]))), int(round(float(info[1])))
+    if isinstance(gt_segms, (list, tuple)):
+        from PIL import Image, ImageDraw
+        masks = []
+        for polys in gt_segms:
+            img = Image.new("L", (W, H), 0)
+            drw = ImageDraw.Draw(img)
+            for poly in (polys if isinstance(polys[0], (list, tuple,
+                                                        np.ndarray))
+                         else [polys]):
+                drw.polygon([float(v) for v in np.asarray(poly).reshape(-1)],
+                            fill=1)
+            masks.append(np.asarray(img, np.uint8))
+        gt = np.stack(masks) if masks else np.zeros((0, H, W), np.uint8)
+    else:
+        gt = _np(gt_segms).astype(np.uint8)
+    gt_boxes_from_masks = []
+    for m in gt:
+        ys, xs = np.where(m > 0)
+        if ys.size == 0:
+            gt_boxes_from_masks.append([0, 0, 0, 0])
+        else:
+            gt_boxes_from_masks.append([xs.min(), ys.min(),
+                                        xs.max(), ys.max()])
+    gtb = np.asarray(gt_boxes_from_masks, np.float64).reshape(-1, 4)
+    fg = np.where(labels > 0)[0]
+    mask_rois, has_mask, mask_tgts = [], [], []
+    for i in fg:
+        roi = rois_np[i]
+        iou = _iou_np(roi[None], gtb)[0]
+        g = int(iou.argmax()) if iou.size else 0
+        x1, y1, x2, y2 = [int(round(v)) for v in roi]
+        x2, y2 = max(x2, x1 + 1), max(y2, y1 + 1)
+        crop = gt[g][max(y1, 0):y2, max(x1, 0):x2] if gt.size else \
+            np.zeros((1, 1), np.uint8)
+        if crop.size == 0:
+            crop = np.zeros((1, 1), np.uint8)
+        from PIL import Image
+        m = np.asarray(Image.fromarray(crop * 255).resize(
+            (resolution, resolution), Image.NEAREST)) > 127
+        cls = int(labels[i])
+        tgt = np.full((num_classes, resolution, resolution), -1, np.int32)
+        tgt[cls] = m.astype(np.int32)
+        mask_rois.append(roi)
+        has_mask.append(int(i))
+        mask_tgts.append(tgt.reshape(-1))
+    mask_rois = (np.asarray(mask_rois, np.float32).reshape(-1, 4))
+    return (Tensor(jnp.asarray(mask_rois)),
+            Tensor(jnp.asarray(np.asarray(has_mask, np.int32)
+                               .reshape(-1, 1))),
+            Tensor(jnp.asarray(
+                np.asarray(mask_tgts, np.int32).reshape(len(mask_tgts), -1)
+                if mask_tgts else
+                np.zeros((0, num_classes * resolution ** 2), np.int32))))
+
+
+def mine_hard_examples(cls_loss, loc_loss=None, match_indices=None,
+                       match_dist=None, neg_pos_ratio=3.0,
+                       neg_dist_threshold=0.5, sample_size=None,
+                       mining_type="max_negative", name=None):
+    """OHEM negative mining for SSD (mine_hard_examples_op.cc:54).
+    cls_loss: [B, M]; match_indices: [B, M] (-1 = unmatched).
+    Returns (neg_indices ragged-as-padded [B, max_neg] int32 with -1
+    padding, updated_match_indices [B, M])."""
+    cl = _np(cls_loss)
+    if loc_loss is not None:
+        cl = cl + _np(loc_loss)
+    mi = _np(match_indices).astype(np.int64)
+    md = _np(match_dist) if match_dist is not None else None
+    B, M = mi.shape
+    neg_lists = []
+    upd = mi.copy()
+    for b in range(B):
+        pos = mi[b] >= 0
+        n_pos = int(pos.sum())
+        if mining_type == "max_negative":
+            neg_cand = np.where(~pos)[0]
+            if md is not None:
+                neg_cand = neg_cand[md[b][neg_cand] < neg_dist_threshold]
+            n_neg = int(n_pos * neg_pos_ratio)
+            if sample_size is not None:
+                n_neg = min(n_neg, int(sample_size))
+            order = neg_cand[np.argsort(-cl[b][neg_cand], kind="stable")]
+            sel = np.sort(order[:n_neg])
+            neg_lists.append(sel)
+        else:
+            raise NotImplementedError(mining_type)
+    width = max((len(s) for s in neg_lists), default=0)
+    out = np.full((B, width), -1, np.int32)
+    for b, s in enumerate(neg_lists):
+        out[b, :len(s)] = s
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(
+        upd.astype(np.int32)))
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_type="integral", name=None):
+    """Detection mAP (detection_map_op.h:52).  detect_res rows
+    (label, score, x1, y1, x2, y2); label rows
+    (label, x1, y1, x2, y2[, difficult]).  Single image, or pass
+    per-image lists.  Returns scalar mAP tensor."""
+    det = _np(detect_res).reshape(-1, 6)
+    gt = _np(label)
+    gt = gt.reshape(-1, gt.shape[-1])
+    has_diff = gt.shape[1] == 6
+    aps = []
+    for c in range(class_num):
+        if c == background_label:
+            continue
+        gmask = gt[:, 0] == c
+        gboxes = gt[gmask][:, 1:5]
+        diff = (gt[gmask][:, 5].astype(bool) if has_diff
+                else np.zeros(gmask.sum(), bool))
+        npos = int((~diff).sum()) if not evaluate_difficult else \
+            int(gmask.sum())
+        dmask = det[:, 0] == c
+        drows = det[dmask]
+        if drows.shape[0] == 0:
+            if npos > 0:
+                aps.append(0.0)
+            continue
+        order = np.argsort(-drows[:, 1], kind="stable")
+        drows = drows[order]
+        matched = np.zeros(gboxes.shape[0], bool)
+        tp = np.zeros(drows.shape[0])
+        fp = np.zeros(drows.shape[0])
+        for i, row in enumerate(drows):
+            if gboxes.shape[0] == 0:
+                fp[i] = 1
+                continue
+            iou = _iou_np(row[None, 2:6], gboxes, off=0.0)[0]
+            j = int(iou.argmax())
+            if iou[j] >= overlap_threshold and not matched[j]:
+                if not evaluate_difficult and diff[j]:
+                    continue    # ignore difficult matches entirely
+                matched[j] = True
+                tp[i] = 1
+            else:
+                fp[i] = 1
+        if npos == 0:
+            continue
+        ctp = np.cumsum(tp)
+        cfp = np.cumsum(fp)
+        rec = ctp / npos
+        prec = ctp / np.maximum(ctp + cfp, 1e-12)
+        if ap_type == "integral":
+            ap = 0.0
+            prev_rec = 0.0
+            for r, p in zip(rec, prec):
+                ap += p * (r - prev_rec)
+                prev_rec = r
+        else:                    # 11point
+            ap = 0.0
+            for t in np.arange(0.0, 1.1, 0.1):
+                pmax = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                ap += pmax / 11.0
+        aps.append(float(ap))
+    m = float(np.mean(aps)) if aps else 0.0
+    return Tensor(jnp.asarray(np.float32(m)))
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip_value=4.135, name=None):
+    """Decode per-class deltas and pick best-scoring class's box
+    (box_decoder_and_assign_op.h:27).  target_box: [N, 4C];
+    box_score: [N, C].  Returns (decode_box [N,4C],
+    output_assign_box [N,4])."""
+    pb = _np(prior_box).reshape(-1, 4)
+    pv = _np(prior_box_var)
+    tb = _np(target_box)
+    sc = _np(box_score)
+    N = pb.shape[0]
+    C = sc.shape[1]
+    pw = pb[:, 2] - pb[:, 0] + 1
+    ph = pb[:, 3] - pb[:, 1] + 1
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    dec = np.zeros_like(tb)
+    for c in range(C):
+        d = tb[:, 4 * c:4 * c + 4]
+        v = pv if pv.ndim == 1 else pv
+        vx, vy, vw, vh = (v[:, k] if v.ndim == 2 else v[k]
+                          for k in range(4))
+        cx = vx * d[:, 0] * pw + pcx
+        cy = vy * d[:, 1] * ph + pcy
+        w = np.exp(np.minimum(vw * d[:, 2], box_clip_value)) * pw
+        h = np.exp(np.minimum(vh * d[:, 3], box_clip_value)) * ph
+        dec[:, 4 * c + 0] = cx - w / 2
+        dec[:, 4 * c + 1] = cy - h / 2
+        dec[:, 4 * c + 2] = cx + w / 2 - 1
+        dec[:, 4 * c + 3] = cy + h / 2 - 1
+    best = sc.argmax(axis=1)
+    assign = dec.reshape(N, C, 4)[np.arange(N), best]
+    return (Tensor(jnp.asarray(dec.astype(np.float32))),
+            Tensor(jnp.asarray(assign.astype(np.float32))))

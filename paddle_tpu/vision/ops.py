@@ -9,7 +9,7 @@ wrappers; backed by the ~40 detection kernels under
 TPU-first: every op is dense, statically-shaped jnp — gathers/bilinear
 sampling vectorize over boxes and lower to XLA gather/dot; there is no
 per-box dynamic control flow (boxes_num selects by masking).  read_file/
-decode_jpeg are host I/O and live on the DataLoader side, not here.
+decode_jpeg run host-side (PIL decode) like the reference's CPU kernels.
 """
 from __future__ import annotations
 
@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import dispatch
-from ..core.tensor import to_tensor
+from ..core.tensor import Tensor, to_tensor
 from ..nn.layer_base import Layer
 
 __all__ = ["yolo_box", "yolo_loss", "deform_conv2d", "DeformConv2D",
@@ -510,3 +510,47 @@ class PSRoIPool(Layer):
     def forward(self, x, boxes, boxes_num):
         return psroi_pool(x, boxes, boxes_num, self.output_size,
                           self.spatial_scale)
+
+
+# ---------------------------------------------------------------------------
+# detection family re-exports + host image IO (reference
+# paddle/vision/ops.py nms; operators/detection/*; read_file /
+# decode_jpeg ops run host-side CPU kernels in the reference too)
+# ---------------------------------------------------------------------------
+from .detection import (  # noqa: E402,F401
+    nms, multiclass_nms, matrix_nms, distribute_fpn_proposals,
+    generate_proposals, prior_box, box_coder,
+)
+
+__all__ += ["nms", "multiclass_nms", "matrix_nms",
+            "distribute_fpn_proposals", "generate_proposals",
+            "prior_box", "box_coder", "read_file", "decode_jpeg"]
+
+
+def read_file(path, name=None):
+    """Read raw file bytes as a uint8 tensor (reference
+    operators/read_file_op.cc — a host-side kernel there as well)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference
+    operators/decode_jpeg_op.cu uses nvjpeg; host PIL decode is the TPU
+    translation — image decode feeds the input pipeline, not the MXU)."""
+    import io as _io
+    from PIL import Image as _Image
+    data = bytes(bytearray(np.asarray(
+        x._data if isinstance(x, Tensor) else x, dtype=np.uint8)))
+    img = _Image.open(_io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]                      # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)         # [C, H, W]
+    return Tensor(jnp.asarray(arr))

@@ -1,0 +1,524 @@
+"""Detection op family + op-tail tests (round-3 parity closure).
+
+Reference parity: ``paddle/fluid/operators/detection/*`` op tests
+(``test_multiclass_nms_op.py``, ``test_prior_box_op.py``,
+``test_box_coder_op.py``, ``test_bipartite_match_op.py``, ...) — numpy
+oracles computed independently here.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import detection as det
+
+
+def test_iou_similarity_oracle():
+    a = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 21, 21]],
+                 np.float32)
+    b = np.array([[0, 0, 10, 10], [8, 8, 12, 12]], np.float32)
+    got = det.iou_similarity(a, b).numpy()
+
+    def iou(p, q):
+        x1, y1 = max(p[0], q[0]), max(p[1], q[1])
+        x2, y2 = min(p[2], q[2]), min(p[3], q[3])
+        i = max(0, x2 - x1) * max(0, y2 - y1)
+        u = ((p[2] - p[0]) * (p[3] - p[1]) +
+             (q[2] - q[0]) * (q[3] - q[1]) - i)
+        return i / u if u > 0 else 0.0
+
+    want = np.array([[iou(a[i], b[j]) for j in range(2)]
+                     for i in range(3)])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_iou_similarity_pixel_coords():
+    # box_normalized=False adds +1 to extents (reference iou_similarity_op)
+    a = np.array([[0, 0, 9, 9]], np.float32)     # 10x10 pixels
+    got = det.iou_similarity(a, a, box_normalized=False).numpy()
+    np.testing.assert_allclose(got, [[1.0]], atol=1e-6)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    prior = np.sort(rng.rand(5, 4).astype(np.float32) * 50, axis=-1)
+    tgt = np.sort(rng.rand(3, 4).astype(np.float32) * 50, axis=-1)
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = det.box_coder(prior, var, tgt, code_type="encode_center_size")
+    dec = det.box_coder(prior, var, enc.numpy(),
+                        code_type="decode_center_size").numpy()
+    for i in range(3):
+        for j in range(5):
+            np.testing.assert_allclose(dec[i, j], tgt[i], atol=1e-3)
+
+
+def test_box_coder_tensor_variance_and_axis():
+    rng = np.random.RandomState(1)
+    prior = np.sort(rng.rand(4, 4).astype(np.float32) * 20, axis=-1)
+    pvar = np.abs(rng.rand(4, 4).astype(np.float32)) + 0.1
+    deltas = rng.randn(4, 1, 4).astype(np.float32) * 0.1
+    # axis=1: prior per row
+    out = det.box_coder(prior, pvar, deltas,
+                        code_type="decode_center_size", axis=1).numpy()
+    # hand-decode row 2
+    p = prior[2]
+    pw, ph = p[2] - p[0], p[3] - p[1]
+    pcx, pcy = p[0] + pw / 2, p[1] + ph / 2
+    d = deltas[2, 0]
+    v = pvar[2]
+    cx = v[0] * d[0] * pw + pcx
+    cy = v[1] * d[1] * ph + pcy
+    w = np.exp(v[2] * d[2]) * pw
+    h = np.exp(v[3] * d[3]) * ph
+    want = [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+    np.testing.assert_allclose(out[2, 0], want, rtol=1e-4)
+
+
+def test_box_clip():
+    boxes = np.array([[-5, -5, 30, 30], [2, 2, 8, 8]], np.float32)
+    im_info = np.array([[20, 25, 1.0]], np.float32)
+    out = det.box_clip(boxes[None], im_info).numpy()[0]
+    np.testing.assert_allclose(out[0], [0, 0, 24, 19])
+    np.testing.assert_allclose(out[1], [2, 2, 8, 8])
+
+
+def test_prior_box_reference_layout():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 16, 16), np.float32)
+    boxes, var = det.prior_box(feat, img, min_sizes=[4.0], max_sizes=[8.0],
+                               aspect_ratios=[2.0], flip=True,
+                               variance=[0.1, 0.1, 0.2, 0.2])
+    b = boxes.numpy()
+    assert b.shape == (2, 2, 4, 4)      # ars [1,2,.5] + 1 max prior
+    # first cell center = (0+0.5)*8 = 4; min_size prior is 4x4 -> /16
+    np.testing.assert_allclose(b[0, 0, 0], [2 / 16, 2 / 16, 6 / 16, 6 / 16],
+                               atol=1e-6)
+    # max prior: sqrt(4*8)/2 = 2.828
+    m = np.sqrt(32.0) / 2
+    np.testing.assert_allclose(
+        b[0, 0, 3], [(4 - m) / 16, (4 - m) / 16, (4 + m) / 16, (4 + m) / 16],
+        atol=1e-5)
+    assert var.numpy().shape == b.shape
+    np.testing.assert_allclose(var.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_prior_box_min_max_order():
+    feat = np.zeros((1, 8, 1, 1), np.float32)
+    img = np.zeros((1, 3, 8, 8), np.float32)
+    b1, _ = det.prior_box(feat, img, min_sizes=[4.0], max_sizes=[8.0],
+                          aspect_ratios=[2.0],
+                          min_max_aspect_ratios_order=True)
+    b2, _ = det.prior_box(feat, img, min_sizes=[4.0], max_sizes=[8.0],
+                          aspect_ratios=[2.0])
+    # same prior set, different order: min,max,ar vs min,ar,max
+    np.testing.assert_allclose(b1.numpy()[0, 0, 1], b2.numpy()[0, 0, 2],
+                               atol=1e-6)
+
+
+def test_density_prior_box_counts():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 16, 16), np.float32)
+    boxes, var = det.density_prior_box(feat, img, densities=[2, 1],
+                                       fixed_sizes=[4.0, 8.0],
+                                       fixed_ratios=[1.0],
+                                       flatten_to_2d=True)
+    # 2*2*1 + 1*1*1 = 5 priors per cell, 4 cells
+    assert boxes.numpy().shape == (20, 4)
+
+
+def test_anchor_generator_matches_hand():
+    feat = np.zeros((1, 1, 2, 2), np.float32)
+    an, av = det.anchor_generator(feat, anchor_sizes=[32.0],
+                                  aspect_ratios=[1.0], stride=[16.0, 16.0])
+    a = an.numpy()
+    assert a.shape == (2, 2, 1, 4)
+    # base 16x16 at ar 1 scaled by 32/16: 32x32 centered at (8,8)
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24], atol=1e-5)
+
+
+def test_bipartite_match_greedy_order():
+    dist = np.array([[0.5, 0.9, 0.1],
+                     [0.8, 0.7, 0.3]], np.float32)
+    mi, md = det.bipartite_match(dist)
+    # global max 0.9 at (0,1); then 0.8 at (1,0); col 2 unmatched
+    assert mi.numpy()[0].tolist() == [1, 0, -1]
+    np.testing.assert_allclose(md.numpy()[0], [0.8, 0.9, 0.0], atol=1e-6)
+
+
+def test_bipartite_match_per_prediction():
+    dist = np.array([[0.5, 0.9, 0.4],
+                     [0.8, 0.7, 0.3]], np.float32)
+    mi, _ = det.bipartite_match(dist, match_type="per_prediction",
+                                dist_threshold=0.35)
+    # col 2: best row 0 (0.4 >= 0.35) -> matched
+    assert mi.numpy()[0].tolist() == [1, 0, 0]
+
+
+def test_target_assign():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    mi = np.array([[2, -1, 0]], np.int32)
+    out, w = det.target_assign(x, mi, mismatch_value=7)
+    np.testing.assert_allclose(out.numpy()[0, 0], x[2])
+    np.testing.assert_allclose(out.numpy()[0, 1], [7, 7, 7, 7])
+    np.testing.assert_allclose(w.numpy()[0].ravel(), [1, 0, 1])
+
+
+def test_multiclass_nms_suppression_and_order():
+    bboxes = np.array([[[0, 0, 10, 10], [0.2, 0.2, 10.2, 10.2],
+                        [50, 50, 60, 60], [0, 0, 1, 1]]], np.float32)
+    scores = np.array([[
+        [0.0, 0.0, 0.0, 0.0],           # background
+        [0.9, 0.8, 0.6, 0.01],          # class 1
+        [0.05, 0.05, 0.7, 0.05],        # class 2
+    ]], np.float32)
+    out, idx, num = det.multiclass_nms(bboxes, scores, score_threshold=0.1,
+                                       nms_threshold=0.5,
+                                       return_index=True)
+    o = out.numpy()
+    assert num.numpy()[0] == 3
+    # sorted by score: (1,0.9), (2,0.7), (1,0.6); overlapping 0.8 box gone
+    assert o[:, 0].tolist() == [1, 2, 1]
+    np.testing.assert_allclose(o[:, 1], [0.9, 0.7, 0.6], atol=1e-6)
+    assert idx.numpy().ravel().tolist() == [0, 2, 2]
+
+
+def test_multiclass_nms_keep_top_k():
+    bboxes = np.tile(np.array([[i * 20.0, 0, i * 20 + 10, 10]
+                               for i in range(5)], np.float32), (1, 1, 1))
+    scores = np.zeros((1, 2, 5), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.6, 0.5]
+    out, num = det.multiclass_nms(bboxes, scores, score_threshold=0.1,
+                                  keep_top_k=2)
+    assert num.numpy()[0] == 2
+    np.testing.assert_allclose(out.numpy()[:, 1], [0.9, 0.8])
+
+
+def test_matrix_nms_decays_overlaps():
+    bboxes = np.array([[[0, 0, 10, 10], [2, 2, 12, 12],
+                        [50, 50, 60, 60]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    out, num, idx = det.matrix_nms(bboxes, scores, score_threshold=0.1,
+                                   post_threshold=0.0, return_index=True)
+    o = out.numpy()
+    assert num.numpy()[0] == 3          # soft NMS keeps all
+    # overlapping box decayed by linear kernel: s * (1 - iou)
+    x1, y1, x2, y2 = 2, 2, 10, 10
+    inter = (x2 - x1) * (y2 - y1)
+    iou = inter / (100 + 100 - inter)
+    decayed = o[np.isclose(o[:, 2], 2.0)]
+    np.testing.assert_allclose(decayed[0, 1], 0.8 * (1 - iou), atol=1e-5)
+    # far box undecayed, identical-box decay-to-zero is dropped
+    assert np.any(np.isclose(o[:, 1], 0.7))
+    out0, num0 = det.matrix_nms(
+        np.array([[[0, 0, 10, 10], [0, 0, 10, 10]]], np.float32),
+        np.array([[[0., 0.], [0.9, 0.8]]], np.float32),
+        score_threshold=0.1, post_threshold=0.0)
+    assert num0.numpy()[0] == 1
+
+
+def test_generate_proposals_pipeline():
+    rng = np.random.RandomState(0)
+    N, A, H, W = 1, 2, 3, 3
+    scores = rng.rand(N, A, H, W).astype(np.float32)
+    deltas = (rng.rand(N, 4 * A, H, W).astype(np.float32) - 0.5) * 0.2
+    anchors, variances = det.anchor_generator(
+        np.zeros((1, 1, H, W), np.float32), anchor_sizes=[8.0, 16.0],
+        aspect_ratios=[1.0], stride=[8.0, 8.0])
+    im_info = np.array([[24, 24, 1.0]], np.float32)
+    rois, probs, num = det.generate_proposals(
+        scores, deltas, im_info, anchors.numpy(), variances.numpy(),
+        pre_nms_top_n=10, post_nms_top_n=4, nms_thresh=0.7, min_size=2.0)
+    r = rois.numpy()
+    assert r.shape[0] == num.numpy()[0] <= 4
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 23).all()
+    # probs sorted descending (NMS keeps score order)
+    p = probs.numpy().ravel()
+    assert (np.diff(p) <= 1e-6).all()
+
+
+def test_distribute_and_collect_fpn():
+    rois = np.array([[0, 0, 20, 20], [0, 0, 200, 200], [0, 0, 60, 60],
+                     [0, 0, 110, 110]], np.float32)
+    multi, restore, nums = det.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    sizes = [m.shape[0] for m in multi]
+    assert sum(sizes) == 4
+    # restore index round-trips
+    cat = np.concatenate([m.numpy() for m in multi if m.shape[0]])
+    np.testing.assert_allclose(cat[restore.numpy().ravel()], rois)
+    col, cnt = det.collect_fpn_proposals(
+        multi, [np.arange(m.shape[0], dtype=np.float32) + i
+                for i, m in enumerate(multi)], 2, 5, post_nms_top_n=3)
+    assert col.numpy().shape == (3, 4)
+
+
+def test_mean_iou_oracle():
+    pred = np.array([[0, 1], [1, 2]])
+    lab = np.array([[0, 1], [2, 2]])
+    miou, wrong, correct = det.mean_iou(pred, lab, 3)
+    np.testing.assert_allclose(float(miou.numpy()),
+                               np.mean([1.0, 0.5, 0.5]), atol=1e-6)
+    assert correct.numpy().tolist() == [1, 1, 1]
+    assert wrong.numpy().tolist() == [0, 1, 0]
+
+
+def test_rpn_target_assign_counts():
+    anchors = det.anchor_generator(np.zeros((1, 1, 6, 6), np.float32),
+                                   [16.0], [1.0],
+                                   stride=[8.0, 8.0])[0].numpy()
+    gt = np.array([[8, 8, 24, 24], [30, 30, 44, 44]], np.float32)
+    loc_i, score_i, tgt_bbox, tgt_lab = det.rpn_target_assign(
+        None, None, anchors, None, gt, rpn_batch_size_per_im=16,
+        rpn_fg_fraction=0.5, rpn_positive_overlap=0.6,
+        rpn_negative_overlap=0.3)
+    lab = tgt_lab.numpy().ravel()
+    assert loc_i.numpy().size == (lab == 1).sum()
+    assert score_i.numpy().size == lab.size <= 16
+    assert tgt_bbox.numpy().shape == (loc_i.numpy().size, 4)
+
+
+def test_generate_proposal_labels_shapes():
+    rois = np.array([[0, 0, 20, 20], [100, 100, 120, 120],
+                     [8, 8, 26, 26]], np.float32)
+    gt = np.array([[10, 10, 28, 28]], np.float32)
+    gtc = np.array([3])
+    out = det.generate_proposal_labels(
+        rois, gtc, None, gt, None, batch_size_per_im=4, fg_fraction=0.5,
+        fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=5)
+    r, labels, tgt, inw, outw = out
+    lab = labels.numpy().ravel()
+    assert (lab[:1] == 3).all() or 3 in lab      # fg keeps gt class
+    assert tgt.numpy().shape[1] == 20
+    # inside weights nonzero exactly where class-3 slot targeted for fg
+    fg_rows = np.where(lab == 3)[0]
+    for i in fg_rows:
+        assert inw.numpy()[i, 12:16].sum() == 4
+
+
+def test_mine_hard_examples_ratio():
+    cls_loss = np.array([[5.0, 1.0, 4.0, 3.0, 2.0]], np.float32)
+    match = np.array([[0, -1, -1, -1, -1]], np.int32)
+    neg, upd = det.mine_hard_examples(cls_loss, match_indices=match,
+                                      neg_pos_ratio=2.0)
+    # 1 positive -> 2 negatives, hardest first: idx 2 (4.0), idx 3 (3.0)
+    assert sorted(neg.numpy().ravel().tolist()) == [2, 3]
+
+
+def test_detection_map_integral():
+    dets = np.array([[1, 0.9, 0, 0, 10, 10],
+                     [1, 0.8, 50, 50, 60, 60],
+                     [1, 0.7, 100, 100, 110, 110]], np.float32)
+    gts = np.array([[1, 0, 0, 10, 10],
+                    [1, 100, 100, 110, 110]], np.float32)
+    m = float(det.detection_map(dets, gts, class_num=2).numpy())
+    # tp,fp,tp -> rec 0.5,0.5,1.0; prec 1,0.5,2/3; AP = 1*0.5 + 2/3*0.5
+    np.testing.assert_allclose(m, 0.5 + 2 / 3 * 0.5, atol=1e-5)
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 10, 10]], np.float32)
+    pvar = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    deltas = np.zeros((1, 8), np.float32)      # 2 classes, zero deltas
+    score = np.array([[0.2, 0.8]], np.float32)
+    dec, assign = det.box_decoder_and_assign(prior, pvar, deltas, score)
+    # zero deltas decode back to the prior box
+    np.testing.assert_allclose(assign.numpy()[0], [0, 0, 10, 10], atol=1e-4)
+
+
+def test_locality_aware_nms_merges():
+    bboxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                        [40, 40, 50, 50]]], np.float32)
+    scores = np.zeros((1, 1, 3), np.float32)
+    scores[0, 0] = [0.9, 0.7, 0.8]
+    out, num = det.locality_aware_nms(bboxes, scores, score_threshold=0.1,
+                                      nms_top_k=10, keep_top_k=5,
+                                      nms_threshold=0.5,
+                                      background_label=-1)
+    o = out.numpy()
+    assert num.numpy()[0] == 2
+    # merged box is the score-weighted average of the overlapping pair
+    merged = (np.array([0, 0, 10, 10]) * 0.9 +
+              np.array([0.5, 0.5, 10.5, 10.5]) * 0.7) / 1.6
+    row = o[np.isclose(o[:, 1], 0.9)][0]
+    np.testing.assert_allclose(row[2:], merged, atol=1e-5)
+
+
+def test_generate_mask_labels_polygons():
+    im_info = np.array([32, 32, 1.0], np.float32)
+    rois = np.array([[4, 4, 20, 20], [0, 0, 30, 30]], np.float32)
+    labels = np.array([2, 0], np.int32)         # second roi is bg
+    # square polygon covering [4,4]..[20,20]
+    segms = [[[4, 4, 20, 4, 20, 20, 4, 20]]]
+    mask_rois, has_mask, masks = det.generate_mask_labels(
+        im_info, np.array([2]), None, segms, rois, labels,
+        num_classes=3, resolution=4)
+    assert mask_rois.numpy().shape == (1, 4)
+    m = masks.numpy().reshape(1, 3, 4, 4)
+    assert (m[0, 2] == 1).all()                 # roi == gt box: full mask
+    assert (m[0, 1] == -1).all()                # other classes ignored
+
+
+def test_retinanet_target_assign_all_anchors_labeled():
+    anchors = det.anchor_generator(np.zeros((1, 1, 4, 4), np.float32),
+                                   [16.0], [1.0],
+                                   stride=[8.0, 8.0])[0].numpy()
+    gt = np.array([[6, 6, 22, 22]], np.float32)
+    gl = np.array([4])
+    li, si, tb, tl, fg = det.retinanet_target_assign(
+        None, None, anchors, None, gt, gl, positive_overlap=0.5,
+        negative_overlap=0.4)
+    assert fg.numpy()[0] == li.numpy().size + 1
+    lab = tl.numpy().ravel()
+    assert (lab[:li.numpy().size] == 4).any() or 4 in lab
+
+
+def test_nms_public_api():
+    boxes = np.array([[0, 0, 10, 10], [0.2, 0.2, 10.2, 10.2],
+                      [30, 30, 40, 40]], np.float32)
+    scores = np.array([0.8, 0.9, 0.7], np.float32)
+    keep = paddle.vision.ops.nms(boxes, 0.5, scores=scores).numpy()
+    assert keep.tolist() == [1, 2]
+
+
+def test_affine_channel_grad():
+    from paddle_tpu.ops.nn_misc import affine_channel
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, 4, 4)
+                         .astype("float32"))
+    x.stop_gradient = False
+    s = paddle.to_tensor(np.array([2.0, 0.5, 1.5], np.float32))
+    s.stop_gradient = False
+    b = paddle.to_tensor(np.zeros(3, np.float32))
+    out = affine_channel(x, s, b)
+    paddle.sum(out).backward()
+    np.testing.assert_allclose(
+        x.grad.numpy()[0, 1], np.full((4, 4), 0.5), rtol=1e-6)
+    np.testing.assert_allclose(
+        s.grad.numpy(), x.numpy().sum(axis=(0, 2, 3)), rtol=1e-5)
+
+
+def test_nce_oracle_and_grads():
+    from paddle_tpu.ops.nn_misc import nce
+    N, D, V, S = 3, 6, 12, 4
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(N, D).astype("float32"))
+    x.stop_gradient = False
+    w = paddle.to_tensor((rng.rand(V, D) * 0.2).astype("float32"))
+    w.stop_gradient = False
+    b = paddle.to_tensor(np.zeros(V, np.float32))
+    lab = paddle.to_tensor(rng.randint(0, V, (N, 1)))
+    cost = nce(x, lab, w, b, num_total_classes=V, num_neg_samples=S,
+               seed=7)
+    # oracle
+    r2 = np.random.RandomState(7)
+    negs = r2.randint(0, V, size=(N, S))
+    samples = np.concatenate([lab.numpy().reshape(-1, 1), negs], axis=1)
+    q = np.full(samples.shape, S / V)
+    logits = np.einsum("nd,nsd->ns", x.numpy(), w.numpy()[samples])
+    o = 1 / (1 + np.exp(-logits))
+    want = (-np.log(o[:, :1] / (o[:, :1] + q[:, :1]))).sum(1) + \
+           (-np.log(q[:, 1:] / (o[:, 1:] + q[:, 1:]))).sum(1)
+    np.testing.assert_allclose(cost.numpy().ravel(), want, rtol=1e-4)
+    paddle.mean(cost).backward()
+    assert x.grad is not None and w.grad is not None
+
+
+def test_ftrl_and_decayed_adagrad_converge():
+    for cls, kw in [(paddle.optimizer.Ftrl, dict(learning_rate=1.0)),
+                    (paddle.optimizer.DecayedAdagrad,
+                     dict(learning_rate=0.05))]:
+        paddle.seed(0)
+        lin = paddle.nn.Linear(3, 1)
+        x = paddle.to_tensor(np.random.RandomState(0).rand(32, 3)
+                             .astype("float32"))
+        y = paddle.to_tensor(x.numpy() @ np.array([[1.], [2.], [-1.]],
+                                                  np.float32))
+        opt = cls(parameters=lin.parameters(), **kw)
+        for _ in range(250):
+            loss = paddle.mean((lin(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 0.05, (cls.__name__,
+                                            float(loss.numpy()))
+
+
+def test_ftrl_formula_single_step():
+    w0 = np.array([0.5, -0.3], np.float32)
+    g = np.array([0.2, 0.1], np.float32)
+    lr, l1, l2 = 0.1, 1e-10, 1e-10
+    sigma = np.sqrt(g * g) / lr
+    new_lin = g - sigma * w0
+    want = np.where(np.abs(new_lin) > l1,
+                    (l1 * np.sign(new_lin) - new_lin) /
+                    (np.sqrt(g * g) / lr + 2 * l2), 0.0)
+    p = paddle.to_tensor(w0.copy())
+    p.stop_gradient = False
+    opt = paddle.optimizer.Ftrl(learning_rate=lr, parameters=[p])
+    (p * paddle.to_tensor(g)).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), want, rtol=1e-5)
+
+
+def test_faster_tokenizer():
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3,
+             "hello": 4, "world": 5, "un": 6, "##affable": 7, ",": 8}
+    tok = paddle.text.FasterTokenizer(vocab)
+    ids, types = tok(["Hello, unaffable"])
+    assert ids.numpy()[0].tolist() == [2, 4, 8, 6, 7, 3]
+    ids2, t2 = tok(["hello"], ["world"], max_seq_len=8)
+    assert ids2.numpy()[0].tolist() == [2, 4, 3, 5, 3]
+    assert t2.numpy()[0].tolist() == [0, 0, 0, 1, 1]
+    # accent stripping + unknown word
+    ids3, _ = tok(["héllo zzz"])
+    assert ids3.numpy()[0].tolist() == [2, 4, 1, 3]
+
+
+def test_decode_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+    g = np.linspace(0, 255, 16, dtype=np.float32)
+    arr = np.stack([np.tile(g, (16, 1)), np.tile(g[:, None], (1, 16)),
+                    np.full((16, 16), 128, np.float32)], -1).astype("uint8")
+    p = str(tmp_path / "x.jpg")
+    Image.fromarray(arr).save(p, quality=95)
+    data = paddle.vision.ops.read_file(p)
+    img = paddle.vision.ops.decode_jpeg(data, mode="rgb")
+    assert img.numpy().shape == (3, 16, 16)
+    # lossy codec: mean error small
+    assert np.abs(img.numpy().transpose(1, 2, 0).astype(int)
+                  - arr.astype(int)).mean() < 20
+
+
+def test_nce_custom_dist_and_multi_true():
+    from paddle_tpu.ops.nn_misc import nce
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4)
+                         .astype("float32"))
+    w = paddle.to_tensor(np.random.RandomState(1).rand(8, 4)
+                         .astype("float32"))
+    lab = paddle.to_tensor(np.array([[1], [2]]))
+    c = nce(x, lab, w, num_total_classes=8, num_neg_samples=3,
+            sampler="custom_dist", custom_dist=[0.125] * 8, seed=1)
+    assert c.shape == [2, 1]
+    lab2 = paddle.to_tensor(np.array([[1, 4], [2, 5]]))
+    c2 = nce(x, lab2, w, num_total_classes=8, num_neg_samples=3, seed=1)
+    assert c2.shape == [2, 1]
+    c3 = nce(x, lab, w, num_total_classes=8, num_neg_samples=3,
+             sampler="log_uniform", seed=1)
+    assert np.isfinite(c3.numpy()).all()
+
+
+def test_tokenizer_tiny_max_seq_len():
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3, "a": 4,
+             "b": 5}
+    tok = paddle.text.FasterTokenizer(vocab)
+    ids, _ = tok(["a a"], ["b b"], max_seq_len=2)      # budget clamps to 0
+    assert ids.numpy().shape[0] == 1
+    ids2, _ = tok(["a a a"], max_seq_len=1, pad_to_max_seq_len=True)
+    assert ids2.numpy().shape == (1, 1)
+
+
+def test_optimizer_accepts_plain_tensor():
+    p = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    p.stop_gradient = False
+    opt = paddle.optimizer.Ftrl(learning_rate=0.5, parameters=[p])
+    (p * p).sum().backward()
+    opt.step()
+    assert np.isfinite(p.numpy()).all()

@@ -350,6 +350,10 @@ ALIASES = {
     "dequantize_abs_max": "quantization dequant",
     "dequantize_log": "quantization log-scale dequant (PTQ)",
     "yolov3_loss": "vision.ops.yolo_loss",
+    "ftrl": "optimizer.Ftrl",
+    "decayed_adagrad": "optimizer.DecayedAdagrad",
+    "faster_tokenizer": "text.FasterTokenizer",
+    "multiclass_nms3": "vision.detection.multiclass_nms (rois_num output)",
     "fetch_v2": "Executor.run(fetch_list=...) binding",
 }
 
