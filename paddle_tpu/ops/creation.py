@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dispatch import dispatch as _dispatch
 from ..core.dtype import dtype_to_jnp
 from ..core.random import default_generator
 from ..core.tensor import Tensor, to_tensor
@@ -57,17 +58,24 @@ def empty(shape, dtype=None, name=None):
 
 def zeros_like(x, dtype=None, name=None):
     x = to_tensor(x)
-    return Tensor(jnp.zeros_like(x._data, dtype=_dt(dtype, x._data.dtype)))
+    dt = _dt(dtype, x.dtype)
+    return _dispatch("fill_zeros_like",
+                     lambda a: jnp.zeros_like(a, dtype=dt), (x,), {})
 
 
 def ones_like(x, dtype=None, name=None):
     x = to_tensor(x)
-    return Tensor(jnp.ones_like(x._data, dtype=_dt(dtype, x._data.dtype)))
+    dt = _dt(dtype, x.dtype)
+    return _dispatch("ones_like",
+                     lambda a: jnp.ones_like(a, dtype=dt), (x,), {})
 
 
 def full_like(x, fill_value, dtype=None, name=None):
     x = to_tensor(x)
-    return Tensor(jnp.full_like(x._data, fill_value, dtype=_dt(dtype, x._data.dtype)))
+    dt = _dt(dtype, x.dtype)
+    fv = fill_value.item() if isinstance(fill_value, Tensor) else fill_value
+    return _dispatch("fill_any_like",
+                     lambda a: jnp.full_like(a, fv, dtype=dt), (x,), {})
 
 
 def empty_like(x, dtype=None, name=None):

@@ -7,7 +7,21 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..core.dispatch import dispatch
 from ..core.tensor import Tensor, to_tensor
+
+
+def _pred_dispatch(op_name, fn, tensors):
+    """Comparisons/predicates produce bool outputs with no gradient:
+    in eager mode run directly (no vjp tape, no retrace); under static
+    capture route through dispatch so they appear as program ops
+    (reference compare_op.cc)."""
+    from ..static import mode as _mode
+    if _mode.in_dynamic_mode():
+        out = Tensor(fn(*[t._data for t in tensors]))
+        out.stop_gradient = True
+        return out
+    return dispatch(op_name, fn, tensors, {})
 
 __all__ = [
     "equal", "not_equal", "greater_than", "greater_equal", "less_than",
@@ -22,13 +36,20 @@ def _pair(x, y):
     x = to_tensor(x)
     y = y if isinstance(y, Tensor) else to_tensor(
         jnp.asarray(y, dtype=x.dtype) if isinstance(y, (int, float, bool)) else y)
-    return x._data, y._data
+    return x, y
 
 
 def _cmp(op_name, fn):
     def op(x, y, name=None):
         a, b = _pair(x, y)
-        return Tensor(fn(a, b))
+        return _pred_dispatch(op_name, fn, (a, b))
+    op.__name__ = op_name
+    return op
+
+
+def _unary_pred(op_name, fn):
+    def op(x, name=None):
+        return _pred_dispatch(op_name, fn, (to_tensor(x),))
     op.__name__ = op_name
     return op
 
@@ -47,40 +68,34 @@ bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
 bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
 
 
-def logical_not(x, name=None):
-    return Tensor(jnp.logical_not(to_tensor(x)._data))
-
-
-def bitwise_not(x, name=None):
-    return Tensor(jnp.bitwise_not(to_tensor(x)._data))
+logical_not = _unary_pred("logical_not", jnp.logical_not)
+bitwise_not = _unary_pred("bitwise_not", jnp.bitwise_not)
+isnan = _unary_pred("isnan", jnp.isnan)
+isinf = _unary_pred("isinf", jnp.isinf)
+isfinite = _unary_pred("isfinite", jnp.isfinite)
 
 
 def equal_all(x, y, name=None):
     a, b = _pair(x, y)
-    return Tensor(jnp.array_equal(a, b))
+    return _pred_dispatch("equal_all",
+                          lambda p, q: jnp.array_equal(p, q), (a, b))
 
 
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
     a, b = _pair(x, y)
-    return Tensor(jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan))
+    return _pred_dispatch(
+        "allclose", lambda p, q: jnp.allclose(p, q, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan), (a, b))
 
 
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
     a, b = _pair(x, y)
-    return Tensor(jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan))
-
-
-def isnan(x, name=None):
-    return Tensor(jnp.isnan(to_tensor(x)._data))
-
-
-def isinf(x, name=None):
-    return Tensor(jnp.isinf(to_tensor(x)._data))
-
-
-def isfinite(x, name=None):
-    return Tensor(jnp.isfinite(to_tensor(x)._data))
+    return _pred_dispatch(
+        "isclose", lambda p, q: jnp.isclose(p, q, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan), (a, b))
 
 
 def is_empty(x, name=None):
-    return Tensor(jnp.asarray(to_tensor(x)._data.size == 0))
+    x = to_tensor(x)
+    return _pred_dispatch("is_empty",
+                          lambda a: jnp.asarray(a.size == 0), (x,))

@@ -23,3 +23,4 @@ from ..amp import *  # noqa: F401,F403  (paddle.static.amp parity)
 from .. import amp  # noqa: F401
 from . import nn  # noqa: F401  (static layer fns + layer classes)
 from .program import CompiledProgram as ParallelExecutor  # noqa: F401
+from .control_flow import cond, while_loop, switch_case, case  # noqa: F401

@@ -1,0 +1,310 @@
+"""Program-level structured control flow for the static graph.
+
+Reference parity: ``python/paddle/fluid/layers/control_flow.py`` (cond
+:2358, While/while_loop :1042, switch_case :3897, case :3491) and the op
+kernels in ``paddle/fluid/operators/controlflow/`` —
+``conditional_block_op.cc``, ``while_op.cc``, ``select_input`` /
+``select_output``.
+
+TPU-first design: the reference captures each branch/body into a
+sub-block of the ProgramDesc and runs it with a scoped executor; here
+each branch/body is captured into a **sub-Program** (same op-capture
+machinery as the main program) and the construct is appended as ONE op
+whose impl lowers to the structured XLA primitive — ``lax.cond`` /
+``lax.switch`` / ``lax.while_loop`` — inside the Executor's single-jit
+replay.  Branch-captured ops replay functionally inside the primitive,
+so XLA sees real structured control flow, not a host-side interpreter.
+
+Grad semantics: ``cond``/``case``/``switch_case`` are fully
+differentiable (``lax.cond`` has a VJP).  ``while_loop`` joins the
+graph stop-gradient (XLA's while has no reverse-mode transform; the
+reference's while_grad re-runs the block per iteration — the jit
+equivalent is a ``lax.scan`` dy2static loop, which IS differentiable
+and is what ``paddle.jit.to_static`` emits for bounded loops).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .mode import in_dynamic_mode
+from .program import (Program, Variable, capture_op, default_main_program,
+                      program_guard)
+
+__all__ = ["cond", "while_loop", "switch_case", "case"]
+
+
+def _capture_subprogram(fn: Callable, parent: Program):
+    """Run ``fn()`` with a fresh sub-Program as capture target; return
+    (subprog, outputs-as-list, tuple_output?)."""
+    sub = Program()
+    sub._parent = parent        # nested control flow resolves names up
+    with program_guard(sub):
+        outs = fn()
+    # parameters first referenced inside the branch belong to the whole
+    # program (the reference registers them on the root block too)
+    parent.parameters.update(sub.parameters)
+    if outs is None:
+        out_list, structure = [], None
+    elif isinstance(outs, (tuple, list)):
+        out_list, structure = list(outs), type(outs)
+    else:
+        out_list, structure = [outs], None
+    for o in out_list:
+        if not isinstance(o, (Variable, Tensor)):
+            raise TypeError(
+                f"control-flow branch must return Variables, got {type(o)}")
+    return sub, out_list, structure
+
+
+def _externals(sub: Program, exclude: Sequence[str] = ()):
+    """Names a sub-program reads but does not produce (and that are not
+    its own baked constants): the branch's closure over the parent."""
+    produced = set(sub.constants) | set(exclude)
+    ext: List[str] = []
+    for op in sub.ops:
+        if op.kind != "compute":
+            continue
+        for n in op.input_names:
+            if n not in produced and n not in ext:
+                ext.append(n)
+        produced.update(op.output_names)
+    return ext
+
+
+def _replayer(sub: Program, ext_names: Sequence[str],
+              out_names: Sequence[str]):
+    """Pure function replaying the sub-program's compute ops:
+    (ext_vals, extra_env) -> tuple(outputs)."""
+    ops = tuple(op for op in sub.ops if op.kind == "compute")
+    consts = dict(sub.constants)
+    ext_names = tuple(ext_names)
+    out_names = tuple(out_names)
+
+    def run(ext_vals, extra_env=None):
+        env = dict(consts)
+        if extra_env:
+            env.update(extra_env)
+        env.update(zip(ext_names, ext_vals))
+        for op in ops:
+            outs = op.impl(*[env[n] for n in op.input_names])
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for n, o in zip(op.output_names, outs):
+                env[n] = o
+        return tuple(env[n] for n in out_names)
+
+    return run
+
+
+def _resolve(parent: Program, names: Sequence[str]):
+    """Map external names to live objects appendable as op inputs,
+    walking up nested control-flow scopes (reference: block parent_idx
+    chain, framework.proto Block.parent_idx)."""
+    objs = []
+    for n in names:
+        v, prog = None, parent
+        while prog is not None and v is None:
+            v = prog._vars.get(n)       # explicit None checks: Tensor
+            if v is None:               # __bool__ is a device sync/raise
+                v = prog.parameters.get(n)
+            if v is None and n in prog.constants:
+                t = Tensor(prog.constants[n])
+                t.name = n
+                v = t
+            prog = getattr(prog, "_parent", None)
+        if v is None:
+            raise KeyError(
+                f"control-flow branch references '{n}' which is not in "
+                "the enclosing program (vars/params/constants)")
+        objs.append(v)
+    return objs
+
+
+def _restructure(outs, structure):
+    if structure is None:
+        return outs[0] if outs else None
+    return structure(outs)
+
+
+def _out_names(out_list):
+    return [o.name for o in out_list]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference control_flow.py:2358 / conditional_block_op.cc:1 —
+    both branches capture as sub-programs and lower to one ``lax.cond``.
+    Appears in ``prog.global_block().ops`` as ``conditional_block``."""
+    if in_dynamic_mode():
+        taken = bool(jnp.asarray(pred._data if isinstance(pred, Tensor)
+                                 else pred).reshape(()))
+        fn = true_fn if taken else false_fn
+        return fn() if fn is not None else None
+
+    parent = default_main_program()
+    t_sub, t_outs, t_struct = _capture_subprogram(true_fn, parent)
+    f_sub, f_outs, f_struct = _capture_subprogram(false_fn, parent)
+    if len(t_outs) != len(f_outs):
+        raise ValueError(
+            f"cond branches return different arities: {len(t_outs)} vs "
+            f"{len(f_outs)} (reference requires identical structures)")
+
+    t_ext = _externals(t_sub)
+    f_ext = _externals(f_sub)
+    ext = list(dict.fromkeys(t_ext + f_ext))
+    t_run = _replayer(t_sub, ext, _out_names(t_outs))
+    f_run = _replayer(f_sub, ext, _out_names(f_outs))
+
+    def impl(p, *ext_vals):
+        return jax.lax.cond(jnp.asarray(p).reshape(()).astype(bool),
+                            lambda e: t_run(e), lambda e: f_run(e),
+                            ext_vals)
+
+    args = [pred] + _resolve(parent, ext)
+    outs = capture_op(parent, "conditional_block", impl, args, {})
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    return _restructure(list(outs), t_struct)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference control_flow.py:3491 — first true predicate wins;
+    lowers to a chain of ``lax.cond``."""
+    if in_dynamic_mode():
+        for p, fn in pred_fn_pairs:
+            arr = jnp.asarray(p._data if isinstance(p, Tensor) else p)
+            if bool(arr.reshape(())):
+                return fn()
+        if default is None:
+            return pred_fn_pairs[-1][1]()
+        return default()
+
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        default = pairs[-1][1]     # reference: last branch is the default
+        pairs = pairs[:-1]
+
+    def build(pairs_left):
+        if not pairs_left:
+            return default
+        p, fn = pairs_left[0]
+        rest = build(pairs_left[1:])
+        return lambda: cond(p, fn, rest)
+
+    return build(pairs)()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference control_flow.py:3897 — exact index match, lowering to
+    one ``lax.switch`` over the (sorted) branch table + default."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(k), fn) for k, fn in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [int(k) for k, _ in items]
+    fns = [fn for _, fn in items]
+    if default is None:
+        default = fns[-1]          # reference: last branch doubles as default
+
+    if in_dynamic_mode():
+        arr = jnp.asarray(branch_index._data
+                          if isinstance(branch_index, Tensor)
+                          else branch_index)
+        idx = int(arr.reshape(()))
+        return fns[keys.index(idx)]() if idx in keys else default()
+
+    parent = default_main_program()
+    subs = [_capture_subprogram(fn, parent) for fn in fns]
+    d_sub = _capture_subprogram(default, parent)
+    all_subs = subs + [d_sub]
+    arities = {len(s[1]) for s in all_subs}
+    if len(arities) != 1:
+        raise ValueError("switch_case branches return different arities: "
+                         f"{sorted(arities)}")
+    ext = list(dict.fromkeys(
+        n for s, _, _ in all_subs for n in _externals(s)))
+    runs = [_replayer(s, ext, _out_names(o)) for s, o, _ in all_subs]
+    keys_arr = jnp.asarray(keys, jnp.int32)
+
+    def impl(bi, *ext_vals):
+        bi = jnp.asarray(bi).reshape(()).astype(jnp.int32)
+        # position of the exact key match, else the default (last) slot
+        matches = (keys_arr == bi)
+        sel = jnp.where(jnp.any(matches),
+                        jnp.argmax(matches), len(runs) - 1)
+        return jax.lax.switch(sel, [(lambda e, r=r: r(e)) for r in runs],
+                              ext_vals)
+
+    args = [branch_index] + _resolve(parent, ext)
+    outs = capture_op(parent, "switch_case", impl, args, {})
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    return _restructure(list(outs), all_subs[0][2])
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """reference control_flow.py:1042 / while_op.cc:1 — data-dependent
+    loop lowered to ``lax.while_loop`` inside the single-jit replay.
+    Appears as a ``while`` op.  Joins the graph stop-gradient (see
+    module docstring); loop-carried shapes/dtypes must be invariant,
+    exactly like the reference's requirement that the block writes back
+    the same vars."""
+    if not loop_vars:
+        raise ValueError("loop_vars must be non-empty")
+    if in_dynamic_mode():
+        vals = list(loop_vars)
+        while bool(jnp.asarray(
+                (cond_fn(*vals))._data).reshape(())):
+            out = body_fn(*vals)
+            vals = list(out) if isinstance(out, (tuple, list)) else [out]
+        return vals
+
+    parent = default_main_program()
+    carry_names = []
+    for v in loop_vars:
+        if not isinstance(v, (Variable, Tensor)):
+            raise TypeError(f"loop_vars must be Variables, got {type(v)}")
+        carry_names.append(v.name)
+
+    c_sub, c_outs, _ = _capture_subprogram(lambda: cond_fn(*loop_vars),
+                                           parent)
+    b_sub, b_outs, b_struct = _capture_subprogram(
+        lambda: body_fn(*loop_vars), parent)
+    if len(c_outs) != 1:
+        raise ValueError("while_loop cond_fn must return one boolean")
+    if len(b_outs) != len(loop_vars):
+        raise ValueError(
+            f"body_fn returns {len(b_outs)} vars, expected "
+            f"{len(loop_vars)} (loop-carried structure must be invariant)")
+
+    c_ext = [n for n in _externals(c_sub, exclude=carry_names)]
+    b_ext = [n for n in _externals(b_sub, exclude=carry_names)]
+    ext = list(dict.fromkeys(c_ext + b_ext))
+    c_run = _replayer(c_sub, ext, _out_names(c_outs))
+    b_run = _replayer(b_sub, ext, _out_names(b_outs))
+    n_ext = len(ext)
+
+    def impl(*args):
+        ext_vals = args[:n_ext]
+        init = tuple(args[n_ext:])
+
+        def cond_f(carry):
+            (flag,) = c_run(ext_vals, dict(zip(carry_names, carry)))
+            return jnp.asarray(flag).reshape(()).astype(bool)
+
+        def body_f(carry):
+            outs = b_run(ext_vals, dict(zip(carry_names, carry)))
+            return tuple(
+                jnp.asarray(o).astype(c.dtype).reshape(c.shape)
+                for o, c in zip(outs, carry))
+
+        return jax.lax.while_loop(cond_f, body_f, init)
+
+    args = _resolve(parent, ext) + list(loop_vars)
+    outs = capture_op(parent, "while", impl, args, {})
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    for o in outs:
+        o.stop_gradient = True      # XLA while has no reverse-mode
+    return list(outs)

@@ -129,3 +129,7 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100,
     return _ops.cross_entropy(input, label, soft_label=soft_label,
                               ignore_index=ignore_index, axis=axis,
                               reduction="none")
+
+
+# -- program-level control flow (reference fluid/layers/control_flow.py) --
+from .control_flow import cond, while_loop, switch_case, case  # noqa: E402,F401

@@ -494,6 +494,9 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     dep = set(trainable) | diff_feeds
     compute_ops = [op for op in prog.ops if op.kind == "compute"]
     for op in compute_ops:
+        if op.type == "while":
+            continue    # XLA while has no reverse-mode; outputs are
+                        # stop-gradient (static/control_flow.py docstring)
         if any(n in dep for n in op.input_names):
             dep.update(op.output_names)
 
@@ -506,6 +509,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     need = {loss.name}
     relevant: List[OpDesc] = []
     for op in reversed(compute_ops):
+        if op.type == "while":
+            continue
         if any(o in need for o in op.output_names) and \
                 any(i in dep for i in op.input_names):
             relevant.append(op)

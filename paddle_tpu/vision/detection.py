@@ -260,26 +260,28 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
     fixed_sizes = [float(s) for s in fixed_sizes]
     fixed_ratios = [float(r) for r in fixed_ratios]
 
-    rows = []
-    for h in range(fh):
-        for w in range(fw):
-            cx = (w + offset) * step_w
-            cy = (h + offset) * step_h
-            for size, dens in zip(fixed_sizes, densities):
-                for ar in fixed_ratios:
-                    bw = size * math.sqrt(ar)
-                    bh = size / math.sqrt(ar)
-                    shift = size / dens
-                    for di in range(dens):
-                        for dj in range(dens):
-                            c_x = cx - size / 2 + shift / 2 + dj * shift
-                            c_y = cy - size / 2 + shift / 2 + di * shift
-                            rows.append([(c_x - bw / 2) / iw,
-                                         (c_y - bh / 2) / ih,
-                                         (c_x + bw / 2) / iw,
-                                         (c_y + bh / 2) / ih])
-    num = sum(d * d * len(fixed_ratios) for d in densities)
-    boxes = np.asarray(rows, np.float32).reshape(fh, fw, num, 4)
+    # per-cell prior pattern is identical everywhere: compute the
+    # [num, 4] center offsets once, broadcast-add the center grid
+    offs = []
+    for size, dens in zip(fixed_sizes, densities):
+        for ar in fixed_ratios:
+            bw = size * math.sqrt(ar)
+            bh = size / math.sqrt(ar)
+            shift = size / dens
+            dj, di = np.meshgrid(np.arange(dens), np.arange(dens))
+            c_x = (-size / 2 + shift / 2 + dj * shift).reshape(-1)
+            c_y = (-size / 2 + shift / 2 + di * shift).reshape(-1)
+            offs.append(np.stack([c_x - bw / 2, c_y - bh / 2,
+                                  c_x + bw / 2, c_y + bh / 2], axis=1))
+    offs = np.concatenate(offs, axis=0)               # [num, 4]
+    num = offs.shape[0]
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    cx, cy = np.meshgrid(cx, cy)                      # [H, W]
+    centers = np.stack([cx, cy, cx, cy], axis=-1)     # [H, W, 4]
+    boxes = (centers[:, :, None, :] + offs[None, None]) / \
+        np.asarray([iw, ih, iw, ih], np.float64)
+    boxes = boxes.astype(np.float32)
     if clip:
         boxes = np.clip(boxes, 0.0, 1.0)
     var = np.broadcast_to(np.asarray(variance, np.float32),
@@ -300,16 +302,19 @@ def anchor_generator(input, anchor_sizes, aspect_ratios,
     whs = []
     for ar in aspect_ratios:
         for sz in anchor_sizes:
+            # reference convention (anchor_generator_op.h:80-95):
+            # base anchor from rounded sqrt of stride area / ar, scaled
+            # by size/stride; half-extent is 0.5*(w-1) pixel-inclusive
             area = sw * sh
             area_ratio = area / float(ar)
             base_w = round(math.sqrt(area_ratio))
             base_h = round(base_w * float(ar))
-            scale_w = float(sz) / sw
-            scale_h = float(sz) / sh
-            whs.append((scale_w * base_w / 2, scale_h * base_h / 2))
+            aw = (float(sz) / sw) * base_w
+            ah = (float(sz) / sh) * base_h
+            whs.append((0.5 * (aw - 1), 0.5 * (ah - 1)))
     wh = np.asarray(whs, np.float32)                  # [A,2]
-    cx = (np.arange(fw) + offset) * sw
-    cy = (np.arange(fh) + offset) * sh
+    cx = np.arange(fw) * sw + offset * (sw - 1)
+    cy = np.arange(fh) * sh + offset * (sh - 1)
     cx, cy = np.meshgrid(cx, cy)
     anchors = np.stack([
         cx[..., None] - wh[None, None, :, 0],
@@ -440,27 +445,18 @@ def _nms_kernel(boxes, scores, nms_threshold, eta=1.0, top_k=-1,
     if top_k >= 0:
         order = order[:top_k]
     off = 0.0 if normalized else 1.0
-    keep = []
+    keep: list = []
     thr = float(nms_threshold)
-    areas = (boxes[:, 2] - boxes[:, 0] + off) * \
-            (boxes[:, 3] - boxes[:, 1] + off)
+    boxes = np.asarray(boxes, np.float64)
     for i in order:
-        ok = True
-        for j in keep:
-            x1 = max(boxes[i, 0], boxes[j, 0])
-            y1 = max(boxes[i, 1], boxes[j, 1])
-            x2 = min(boxes[i, 2], boxes[j, 2])
-            y2 = min(boxes[i, 3], boxes[j, 3])
-            inter = max(0.0, x2 - x1 + off) * max(0.0, y2 - y1 + off)
-            union = areas[i] + areas[j] - inter
-            iou = inter / union if union > 0 else 0.0
-            if iou > thr:
-                ok = False
-                break
-        if ok:
-            keep.append(int(i))
-            if eta < 1.0 and thr > 0.5:
-                thr *= eta
+        if keep:
+            # one broadcasted IoU row per candidate vs the kept set
+            iou = _iou_np(boxes[i][None], boxes[np.asarray(keep)], off)[0]
+            if (iou > thr).any():
+                continue
+        keep.append(int(i))
+        if eta < 1.0 and thr > 0.5:
+            thr *= eta
     return keep
 
 
@@ -616,16 +612,17 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
                 continue
             sc = s[bi, c].copy()
             bx = b[bi].copy()
-            # locality-aware merge pass over input order
+            # locality-aware merge pass over input order (reference
+            # GetMaxScoreIndexWithLocalityAware: weighted-merge into the
+            # running box, score accumulates by SUM, and the
+            # score_threshold applies AFTER merging)
             merged_b, merged_s = [], []
             for m in range(M):
-                if sc[m] <= score_threshold:
-                    continue
                 if merged_b and iou_one(merged_b[-1], bx[m]) > nms_threshold:
-                    w1, w2 = merged_s[-1], sc[m]
-                    tot = w1 + w2
-                    merged_b[-1] = (merged_b[-1] * w1 + bx[m] * w2) / tot
-                    merged_s[-1] = max(w1, w2)
+                    w1, w2 = merged_s[-1], float(sc[m])
+                    merged_b[-1] = (merged_b[-1] * w1 + bx[m] * w2) / \
+                        (w1 + w2)
+                    merged_s[-1] = w1 + w2
                 else:
                     merged_b.append(bx[m].astype(np.float64))
                     merged_s.append(float(sc[m]))
@@ -633,6 +630,10 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
                 continue
             mb = np.asarray(merged_b)
             ms = np.asarray(merged_s)
+            keep_mask = ms > score_threshold
+            mb, ms = mb[keep_mask], ms[keep_mask]
+            if mb.shape[0] == 0:
+                continue
             keep = _nms_kernel(mb, ms, nms_threshold, nms_eta, nms_top_k,
                                normalized)
             for k in keep:
@@ -751,14 +752,36 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
 def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
                           post_nms_top_n, rois_num_per_level=None,
                           name=None):
-    """Gather per-level RoIs back, keep global top-N by score
-    (collect_fpn_proposals_op.h:55)."""
-    rois = np.concatenate([_np(r) for r in multi_rois], axis=0)
-    scores = np.concatenate([_np(s).reshape(-1) for s in multi_scores])
-    order = np.argsort(-scores, kind="stable")[:post_nms_top_n]
-    order = np.sort(order)          # reference re-sorts by original order
-    return Tensor(jnp.asarray(rois[order])), Tensor(jnp.asarray(
-        np.asarray([order.size], np.int32)))
+    """Gather per-level RoIs back, keep top-N by score PER IMAGE
+    (collect_fpn_proposals_op.h:55 — the reference groups by LoD batch
+    id).  ``rois_num_per_level``: per-level [B] counts; without it a
+    single-image batch is assumed.  Returns (fpn_rois, rois_num [B])."""
+    rois_l = [_np(r).reshape(-1, 4) for r in multi_rois]
+    scores_l = [_np(s).reshape(-1) for s in multi_scores]
+    if rois_num_per_level is None:
+        nums_l = [np.asarray([r.shape[0]], np.int64) for r in rois_l]
+    else:
+        nums_l = [_np(n).reshape(-1).astype(np.int64)
+                  for n in rois_num_per_level]
+    B = nums_l[0].shape[0]
+    out_rois, out_nums = [], []
+    for b in range(B):
+        rs, ss = [], []
+        for lvl in range(len(rois_l)):
+            start = int(nums_l[lvl][:b].sum())
+            cnt = int(nums_l[lvl][b])
+            rs.append(rois_l[lvl][start:start + cnt])
+            ss.append(scores_l[lvl][start:start + cnt])
+        rs = np.concatenate(rs) if rs else np.zeros((0, 4), np.float32)
+        ss = np.concatenate(ss) if ss else np.zeros((0,), np.float32)
+        order = np.argsort(-ss, kind="stable")[:post_nms_top_n]
+        order = np.sort(order)      # reference restores original order
+        out_rois.append(rs[order])
+        out_nums.append(order.size)
+    rois = (np.concatenate(out_rois) if out_rois
+            else np.zeros((0, 4), np.float32))
+    return (Tensor(jnp.asarray(rois.astype(np.float32))),
+            Tensor(jnp.asarray(np.asarray(out_nums, np.int32))))
 
 
 def _box_encode_np(anchors, gt, off=1.0):
@@ -787,15 +810,28 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     anchors = _np(anchor_box).reshape(-1, 4)
     gt = _np(gt_boxes).reshape(-1, 4)
     A = anchors.shape[0]
+    # straddle filter (reference rpn_target_assign_op.cc:99-119):
+    # with straddle_thresh >= 0 only anchors inside the image (within
+    # the threshold) are eligible; the rest keep label -1
+    inside = np.ones(A, bool)
+    if im_info is not None and rpn_straddle_thresh >= 0:
+        info = _np(im_info).reshape(-1)
+        ih, iw = float(info[0]), float(info[1])
+        st = float(rpn_straddle_thresh)
+        inside = ((anchors[:, 0] >= -st) & (anchors[:, 1] >= -st) &
+                  (anchors[:, 2] < iw + st) & (anchors[:, 3] < ih + st))
     iou = _iou_np(anchors, gt)                     # [A, G]
+    iou[~inside] = 0.0
     max_per_anchor = iou.max(axis=1)
     argmax_per_anchor = iou.argmax(axis=1)
     labels = np.full(A, -1, np.int64)
     # positives: best anchor per gt + anchors above positive_overlap
-    best_per_gt = iou.argmax(axis=0)
+    best_per_gt = np.where(inside.any(), iou.argmax(axis=0), 0)
     labels[best_per_gt] = 1
+    labels[~inside] = -1
     labels[max_per_anchor >= rpn_positive_overlap] = 1
     labels[(labels != 1) & (max_per_anchor < rpn_negative_overlap)] = 0
+    labels[~inside] = -1
     fg_cnt = int(rpn_batch_size_per_im * rpn_fg_fraction)
     fg_idx = np.where(labels == 1)[0]
     if fg_idx.size > fg_cnt:
@@ -1143,8 +1179,7 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box,
     dec = np.zeros_like(tb)
     for c in range(C):
         d = tb[:, 4 * c:4 * c + 4]
-        v = pv if pv.ndim == 1 else pv
-        vx, vy, vw, vh = (v[:, k] if v.ndim == 2 else v[k]
+        vx, vy, vw, vh = (pv[:, k] if pv.ndim == 2 else pv[k]
                           for k in range(4))
         cx = vx * d[:, 0] * pw + pcx
         cy = vy * d[:, 1] * ph + pcy

@@ -131,8 +131,9 @@ def test_anchor_generator_matches_hand():
                                   aspect_ratios=[1.0], stride=[16.0, 16.0])
     a = an.numpy()
     assert a.shape == (2, 2, 1, 4)
-    # base 16x16 at ar 1 scaled by 32/16: 32x32 centered at (8,8)
-    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24], atol=1e-5)
+    # reference convention (anchor_generator_op.h): ctr = idx*stride +
+    # offset*(stride-1) = 7.5; extent 0.5*(32-1) -> (-8, -8, 23, 23)
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 23, 23], atol=1e-5)
 
 
 def test_bipartite_match_greedy_order():
@@ -335,10 +336,10 @@ def test_locality_aware_nms_merges():
                                       background_label=-1)
     o = out.numpy()
     assert num.numpy()[0] == 2
-    # merged box is the score-weighted average of the overlapping pair
-    merged = (np.array([0, 0, 10, 10]) * 0.9 +
-              np.array([0.5, 0.5, 10.5, 10.5]) * 0.7) / 1.6
-    row = o[np.isclose(o[:, 1], 0.9)][0]
+    # reference semantics: weighted-merge box, score accumulates by SUM
+    merged = (np.array([0.5, 0.5, 10.5, 10.5]) * 0.7 +
+              np.array([0, 0, 10, 10]) * 0.9) / 1.6
+    row = o[np.isclose(o[:, 1], 1.6)][0]
     np.testing.assert_allclose(row[2:], merged, atol=1e-5)
 
 
@@ -522,3 +523,49 @@ def test_optimizer_accepts_plain_tensor():
     (p * p).sum().backward()
     opt.step()
     assert np.isfinite(p.numpy()).all()
+
+
+def test_rpn_target_assign_straddle_filter():
+    anchors = np.array([[-20, -20, -5, -5],      # fully outside
+                        [2, 2, 14, 14],          # inside
+                        [28, 28, 44, 44]], np.float32)  # straddles edge
+    gt = np.array([[2, 2, 14, 14]], np.float32)
+    im_info = np.array([[32, 32, 1.0]], np.float32)
+    li, si, tb, tl = det.rpn_target_assign(
+        None, None, anchors, None, gt, im_info=im_info,
+        rpn_straddle_thresh=0.0, rpn_positive_overlap=0.6,
+        rpn_negative_overlap=0.3)
+    # only the inside anchor is eligible; outside ones excluded entirely
+    assert li.numpy().tolist() == [1]
+    assert si.numpy().tolist() == [1]
+
+
+def test_collect_fpn_proposals_per_image():
+    # 2 images; level-0 has 2+1 rois, level-1 has 1+2 rois
+    l0 = np.array([[0, 0, 10, 10], [0, 0, 20, 20],
+                   [0, 0, 30, 30]], np.float32)
+    l1 = np.array([[0, 0, 40, 40], [0, 0, 50, 50],
+                   [0, 0, 60, 60]], np.float32)
+    s0 = np.array([0.9, 0.8, 0.1], np.float32)
+    s1 = np.array([0.7, 0.95, 0.2], np.float32)
+    n0 = np.array([2, 1], np.int32)
+    n1 = np.array([1, 2], np.int32)
+    rois, nums = det.collect_fpn_proposals(
+        [l0, l1], [s0, s1], 2, 3, post_nms_top_n=2,
+        rois_num_per_level=[n0, n1])
+    # image 0 candidates: scores .9 .8 .7 -> top2 = rows 0,1 of l0
+    # image 1 candidates: scores .1 .95 .2 -> top2 = l0[2], l1[1]
+    assert nums.numpy().tolist() == [2, 2]
+    got = rois.numpy()
+    np.testing.assert_allclose(got[0], [0, 0, 10, 10])
+    np.testing.assert_allclose(got[1], [0, 0, 20, 20])
+    assert got.shape == (4, 4)
+
+
+def test_eager_comparison_no_grad_tape():
+    x = paddle.to_tensor(np.random.RandomState(0).rand(16)
+                         .astype("float32"))
+    x.stop_gradient = False
+    m = x > 0.5
+    assert m.stop_gradient
+    assert m.numpy().dtype == np.bool_
