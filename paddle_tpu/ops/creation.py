@@ -73,9 +73,15 @@ def ones_like(x, dtype=None, name=None):
 def full_like(x, fill_value, dtype=None, name=None):
     x = to_tensor(x)
     dt = _dt(dtype, x.dtype)
-    fv = fill_value.item() if isinstance(fill_value, Tensor) else fill_value
+    if isinstance(fill_value, Tensor):
+        # tensor fill stays a graph input (symbolic-safe in static mode)
+        return _dispatch(
+            "fill_any_like",
+            lambda a, fv: jnp.full_like(a, 0).astype(dt) + fv.astype(dt),
+            (x, fill_value), {})
     return _dispatch("fill_any_like",
-                     lambda a: jnp.full_like(a, fv, dtype=dt), (x,), {})
+                     lambda a: jnp.full_like(a, fill_value, dtype=dt),
+                     (x,), {})
 
 
 def empty_like(x, dtype=None, name=None):

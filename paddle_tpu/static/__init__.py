@@ -24,3 +24,5 @@ from .. import amp  # noqa: F401
 from . import nn  # noqa: F401  (static layer fns + layer classes)
 from .program import CompiledProgram as ParallelExecutor  # noqa: F401
 from .control_flow import cond, while_loop, switch_case, case  # noqa: F401
+from .serialization import (save_program, load_program,  # noqa: F401
+                            LoadedProgram)

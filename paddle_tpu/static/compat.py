@@ -298,12 +298,27 @@ def set_program_state(program, state_dict):
 
 
 def save(program, model_path, protocol=4):
-    """reference static.save: persistables + program artifact."""
+    """reference static.save (io.py:2291): .pdparams + .pdopt for a
+    captured Program; legacy pickle fallback for scope-backed nets."""
+    from .program import Program
+    from . import serialization
+    prog = getattr(program, "program", program)
+    if isinstance(prog, Program) and (prog.parameters or prog.state_vars):
+        serialization.save(prog, model_path)
+        return
     with open(model_path + ".pdstate", "wb") as f:
         pickle.dump(_state_of(program), f, protocol=protocol)
 
 
 def load(program, model_path, executor=None, var_list=None):
+    import os
+    from .program import Program
+    from . import serialization
+    prog = getattr(program, "program", program)
+    if (isinstance(prog, (Program, serialization.LoadedProgram))
+            and os.path.exists(model_path + ".pdparams")):
+        serialization.load(prog, model_path)
+        return
     set_program_state(program, load_program_state(model_path))
 
 
@@ -326,19 +341,16 @@ def load_vars(executor, dirname, main_program=None, vars=None,
             v._data = jnp.asarray(data[v.name])
 
 
-def serialize_program(feed_vars, fetch_vars, program=None):
-    from .io import save_inference_model
-    import tempfile, os
-    with tempfile.TemporaryDirectory() as d:
-        prefix = save_inference_model(os.path.join(d, "m"), feed_vars,
-                                      fetch_vars, program=program)
-        with open(prefix + ".pdmodel", "rb") as f:
-            return f.read()
+def serialize_program(feed_vars=None, fetch_vars=None, program=None):
+    """Full-program serialization incl. backward/optimizer ops — see
+    static/serialization.py (training resumes from the bytes alone)."""
+    from . import serialization
+    return serialization.serialize_program(feed_vars, fetch_vars, program)
 
 
 def deserialize_program(data: bytes):
-    from jax import export as jax_export
-    return jax_export.deserialize(bytearray(data))
+    from . import serialization
+    return serialization.deserialize_program(data)
 
 
 def serialize_persistables(feed_vars, fetch_vars, program=None):

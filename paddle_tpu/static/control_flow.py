@@ -57,12 +57,19 @@ def _capture_subprogram(fn: Callable, parent: Program):
         if not isinstance(o, (Variable, Tensor)):
             raise TypeError(
                 f"control-flow branch must return Variables, got {type(o)}")
+        if not isinstance(o, Variable):
+            # eager constant returned from the branch (e.g. paddle.full
+            # in a constant branch): bake it into the sub-program
+            sub.constants.setdefault(o.name, o._data)
     return sub, out_list, structure
 
 
-def _externals(sub: Program, exclude: Sequence[str] = ()):
+def _externals(sub: Program, exclude: Sequence[str] = (),
+               out_names: Sequence[str] = ()):
     """Names a sub-program reads but does not produce (and that are not
-    its own baked constants): the branch's closure over the parent."""
+    its own baked constants): the branch's closure over the parent.
+    ``out_names`` covers pass-through returns (branch returns a parent
+    Variable no sub-op produced)."""
     produced = set(sub.constants) | set(exclude)
     ext: List[str] = []
     for op in sub.ops:
@@ -72,6 +79,9 @@ def _externals(sub: Program, exclude: Sequence[str] = ()):
             if n not in produced and n not in ext:
                 ext.append(n)
         produced.update(op.output_names)
+    for n in out_names:
+        if n not in produced and n not in ext:
+            ext.append(n)
     return ext
 
 
@@ -151,8 +161,8 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
             f"cond branches return different arities: {len(t_outs)} vs "
             f"{len(f_outs)} (reference requires identical structures)")
 
-    t_ext = _externals(t_sub)
-    f_ext = _externals(f_sub)
+    t_ext = _externals(t_sub, out_names=_out_names(t_outs))
+    f_ext = _externals(f_sub, out_names=_out_names(f_outs))
     ext = list(dict.fromkeys(t_ext + f_ext))
     t_run = _replayer(t_sub, ext, _out_names(t_outs))
     f_run = _replayer(f_sub, ext, _out_names(f_outs))
@@ -206,10 +216,10 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         items = list(enumerate(branch_fns))
     keys = [int(k) for k, _ in items]
     fns = [fn for _, fn in items]
-    if default is None:
-        default = fns[-1]          # reference: last branch doubles as default
 
     if in_dynamic_mode():
+        if default is None:
+            default = fns[-1]  # reference: last branch doubles as default
         arr = jnp.asarray(branch_index._data
                           if isinstance(branch_index, Tensor)
                           else branch_index)
@@ -218,23 +228,28 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
     parent = default_main_program()
     subs = [_capture_subprogram(fn, parent) for fn in fns]
-    d_sub = _capture_subprogram(default, parent)
-    all_subs = subs + [d_sub]
+    if default is None:
+        all_subs = subs            # last branch doubles as the default
+        default_slot = len(subs) - 1
+    else:
+        all_subs = subs + [_capture_subprogram(default, parent)]
+        default_slot = len(all_subs) - 1
     arities = {len(s[1]) for s in all_subs}
     if len(arities) != 1:
         raise ValueError("switch_case branches return different arities: "
                          f"{sorted(arities)}")
     ext = list(dict.fromkeys(
-        n for s, _, _ in all_subs for n in _externals(s)))
+        n for s, o, _ in all_subs
+        for n in _externals(s, out_names=_out_names(o))))
     runs = [_replayer(s, ext, _out_names(o)) for s, o, _ in all_subs]
     keys_arr = jnp.asarray(keys, jnp.int32)
 
     def impl(bi, *ext_vals):
         bi = jnp.asarray(bi).reshape(()).astype(jnp.int32)
-        # position of the exact key match, else the default (last) slot
+        # position of the exact key match, else the default slot
         matches = (keys_arr == bi)
         sel = jnp.where(jnp.any(matches),
-                        jnp.argmax(matches), len(runs) - 1)
+                        jnp.argmax(matches), default_slot)
         return jax.lax.switch(sel, [(lambda e, r=r: r(e)) for r in runs],
                               ext_vals)
 
@@ -279,8 +294,10 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
             f"body_fn returns {len(b_outs)} vars, expected "
             f"{len(loop_vars)} (loop-carried structure must be invariant)")
 
-    c_ext = [n for n in _externals(c_sub, exclude=carry_names)]
-    b_ext = [n for n in _externals(b_sub, exclude=carry_names)]
+    c_ext = _externals(c_sub, exclude=carry_names,
+                       out_names=_out_names(c_outs))
+    b_ext = _externals(b_sub, exclude=carry_names,
+                       out_names=_out_names(b_outs))
     ext = list(dict.fromkeys(c_ext + b_ext))
     c_run = _replayer(c_sub, ext, _out_names(c_outs))
     b_run = _replayer(b_sub, ext, _out_names(b_outs))

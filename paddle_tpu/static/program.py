@@ -691,6 +691,12 @@ class Executor:
         feed = feed or {}
         fetch_list = fetch_list if fetch_list is not None else []
         program = program or default_main_program()
+        from .serialization import LoadedProgram
+        if isinstance(program, LoadedProgram):
+            # deserialized train-step program (static/serialization.py)
+            outs = program.run_step(feed, fetch_list)
+            return [np.asarray(v) for v in outs] if return_numpy \
+                else [Tensor(v) for v in outs]
         dp_mesh = None
         if isinstance(program, CompiledProgram):
             dp_mesh = program._dp_mesh

@@ -1,0 +1,261 @@
+"""Program serialization: save/load params AND the program itself.
+
+Reference parity: ``paddle/fluid/framework/framework.proto:234``
+(ProgramDesc round-trips to disk), ``python/paddle/fluid/io.py:1847``
+(save/load of the program + persistables), and the 2.x surface
+``paddle.static.save/load/serialize_program/deserialize_program``
+(``python/paddle/fluid/io.py:2291,1694``).
+
+TPU-first design: a captured Program's op impls are Python closures, so
+instead of a protobuf op list that re-binds kernels by name, the
+serialized artifact is the **compiled training step itself** —
+``jax.export`` bytes of the single-jit replay (forward + vjp-backward +
+optimizer update), multi-platform (cpu+tpu), plus a JSON op-table for
+introspection and npz state for parameters/optimizer slots.  Stop,
+reload in a fresh process (no model code needed), continue training:
+the loss curve continues exactly — that is the reference's
+train-program checkpoint contract.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import zipfile
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .program import (_LR_NAME, Program, Variable, _build_runner,
+                      default_main_program)
+
+__all__ = ["save", "load", "serialize_program", "deserialize_program",
+           "save_program", "load_program", "LoadedProgram"]
+
+_MAGIC = b"PDTPU-PROG-1"
+
+
+# ---------------------------------------------------------------------------
+# parameter / optimizer-state save+load (reference static.save/load)
+# ---------------------------------------------------------------------------
+def save(program, path_prefix: str, protocol=None, **configs):
+    """reference ``paddle.static.save`` (io.py:2291): writes
+    ``{path}.pdparams`` (parameters) and ``{path}.pdopt`` (optimizer
+    state / non-param persistables)."""
+    program = getattr(program, "program", program)   # CompiledProgram
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    params = {n: np.asarray(p._data)
+              for n, p in program.parameters.items()}
+    np.savez(path_prefix + ".pdparams", **params)
+    os.replace(path_prefix + ".pdparams.npz", path_prefix + ".pdparams")
+    state = {n: np.asarray(a) for n, a in program.state_vars.items()}
+    np.savez(path_prefix + ".pdopt", **state)
+    os.replace(path_prefix + ".pdopt.npz", path_prefix + ".pdopt")
+
+
+def load(program, path_prefix: str, executor=None, var_list=None):
+    """reference ``paddle.static.load``: restores parameters and
+    optimizer state into the live program (or LoadedProgram) by name."""
+    program = getattr(program, "program", program)
+    with np.load(path_prefix + ".pdparams") as z:
+        params = {n: z[n] for n in z.files}
+    state = {}
+    if os.path.exists(path_prefix + ".pdopt"):
+        with np.load(path_prefix + ".pdopt") as z:
+            state = {n: z[n] for n in z.files}
+    if isinstance(program, LoadedProgram):
+        program.set_state(params, state)
+        return
+    for n, arr in params.items():
+        p = program.parameters.get(n)
+        if p is not None:
+            p._data = jnp.asarray(arr)
+    for n, arr in state.items():
+        if n in program.state_vars:
+            program.state_vars[n] = jnp.asarray(arr)
+
+
+# ---------------------------------------------------------------------------
+# full-program serialization (reference serialize_program / io.py:1847)
+# ---------------------------------------------------------------------------
+def _op_table(program) -> List[dict]:
+    rows = []
+    for op in program.ops:
+        rows.append({
+            "type": op.type, "kind": op.kind,
+            "inputs": list(op.input_names),
+            "outputs": list(op.output_names),
+            "attrs": {k: v for k, v in op.attrs.items()
+                      if isinstance(v, (bool, int, float, str, list,
+                                        tuple, type(None)))},
+            "fwd_idx": op.fwd_idx,
+        })
+    return rows
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      lr: float = 0.0, **kwargs) -> bytes:
+    """Serialize the program (reference ``static.serialize_program``) —
+    including its backward/optimizer ops, so the artifact resumes
+    TRAINING, not just inference.  feed_vars default to the program's
+    placeholders; fetch_vars must be named Variables."""
+    program = getattr(program, "program", program) or \
+        default_main_program()
+    if feed_vars is None:
+        feed_vars = list(program._placeholders.values())
+    feed_vars = [v for v in (feed_vars if isinstance(feed_vars,
+                                                     (list, tuple))
+                             else [feed_vars])]
+    fetch_vars = [v for v in (fetch_vars if isinstance(
+        fetch_vars, (list, tuple)) else [fetch_vars] if fetch_vars
+        is not None else [])]
+
+    feed_specs = {}
+    for v in feed_vars:
+        if any(s is None or int(s) < 0 for s in v.shape):
+            raise ValueError(
+                f"feed '{v.name}' has dynamic shape {v.shape}; serialize "
+                "requires concrete shapes (pass feed_vars with resolved "
+                "shapes)")
+        feed_specs[v.name] = ([int(s) for s in v.shape], str(np.dtype(
+            jnp.zeros((), v.dtype).dtype)))
+    fetch_names = tuple(v if isinstance(v, str) else v.name
+                        for v in fetch_vars)
+
+    written = tuple(sorted({
+        n for op in program.ops if op.kind in ("optimize", "compute")
+        for n in op.output_names
+        if n in program.parameters or n in program.state_vars}))
+
+    runner = _build_runner(program, fetch_names, written)
+    feeds_aval = {n: jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                  for n, (s, d) in feed_specs.items()}
+    mut_aval = {n: jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
+                for n, p in program.parameters.items()}
+    mut_aval.update({n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for n, a in program.state_vars.items()})
+    lr_aval = jax.ShapeDtypeStruct((), np.dtype("float32"))
+    exported = jax.export.export(runner, platforms=["cpu", "tpu"])(
+        feeds_aval, mut_aval, lr_aval)
+
+    header = {
+        "fetch_names": list(fetch_names),
+        "written": list(written),
+        "feed_specs": feed_specs,
+        "param_names": list(program.parameters),
+        "state_names": list(program.state_vars),
+        "lr": float(program._lr_provider()) if program._lr_provider
+              else float(lr),
+        "ops": _op_table(program),
+    }
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("magic", _MAGIC)
+        z.writestr("header.json", json.dumps(header))
+        z.writestr("step.jaxexport", exported.serialize())
+        st = io.BytesIO()
+        np.savez(st, **{n: np.asarray(p._data)
+                        for n, p in program.parameters.items()},
+                 **{n: np.asarray(a)
+                    for n, a in program.state_vars.items()})
+        z.writestr("state.npz", st.getvalue())
+    return buf.getvalue()
+
+
+def deserialize_program(data: bytes) -> "LoadedProgram":
+    """reference ``static.deserialize_program``: rebuild a runnable
+    program from bytes — no model code needed in the new process."""
+    buf = io.BytesIO(data)
+    with zipfile.ZipFile(buf) as z:
+        if z.read("magic") != _MAGIC:
+            raise ValueError(
+                "not a paddle_tpu serialized program (bad magic); was "
+                "this artifact produced by static.serialize_program?")
+        header = json.loads(z.read("header.json"))
+        exported = jax.export.deserialize(z.read("step.jaxexport"))
+        with np.load(io.BytesIO(z.read("state.npz"))) as st:
+            state = {n: st[n] for n in st.files}
+    return LoadedProgram(header, exported, state)
+
+
+def save_program(program, path: str, feed_vars=None, fetch_vars=None):
+    """reference ``static.save_to_file`` of serialize_program bytes
+    (conventionally ``{path}.pdmodel``)."""
+    data = serialize_program(feed_vars, fetch_vars, program)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def load_program(path: str) -> "LoadedProgram":
+    with open(path, "rb") as f:
+        return deserialize_program(f.read())
+
+
+class LoadedProgram:
+    """A deserialized, runnable training program.
+
+    ``Executor.run(loaded, feed=..., fetch_list=[...])`` executes one
+    step of the original forward+backward+update graph; parameters and
+    optimizer slots live on the object and update in place, so training
+    resumes exactly where the saving process stopped (reference:
+    load_program + load_persistables then Executor.run, io.py:1847).
+
+    Learning rate: the artifact stores the SAVE-TIME lr value only —
+    an LRScheduler is host-side Python and does not serialize.  For a
+    scheduled lr, advance the schedule in the driving loop and pass the
+    current value per step: ``loaded.run_step(feed, lr=sched.get_lr())``
+    (or set ``loaded.lr``).
+    """
+
+    def __init__(self, header: dict, exported, state: Dict[str, np.ndarray]):
+        self.header = header
+        self._exported = exported
+        self.fetch_names = list(header["fetch_names"])
+        self.param_names = list(header["param_names"])
+        self.state_names = list(header["state_names"])
+        self.feed_specs = dict(header["feed_specs"])
+        self.lr = float(header.get("lr", 0.0))
+        self._mut = {n: jnp.asarray(a) for n, a in state.items()}
+        self.ops = list(header.get("ops", []))
+
+    # introspection parity with Program
+    def global_block(self):
+        return self
+
+    @property
+    def parameters(self):
+        return {n: Tensor(self._mut[n]) for n in self.param_names
+                if n in self._mut}
+
+    def set_state(self, params: Dict[str, np.ndarray],
+                  state: Optional[Dict[str, np.ndarray]] = None):
+        for n, a in {**params, **(state or {})}.items():
+            if n in self._mut:
+                self._mut[n] = jnp.asarray(a)
+
+    def state_dict(self):
+        return {n: np.asarray(a) for n, a in self._mut.items()}
+
+    def run_step(self, feed: Dict[str, np.ndarray],
+                 fetch_list: Optional[Sequence[str]] = None,
+                 lr: Optional[float] = None):
+        names = [f if isinstance(f, str) else f.name
+                 for f in (fetch_list or self.fetch_names)]
+        for n in names:
+            if n not in self.fetch_names:
+                raise KeyError(
+                    f"fetch '{n}' not in the serialized fetch set "
+                    f"{self.fetch_names} (chosen at save_program time)")
+        feeds = {}
+        for n, (shape, dt) in self.feed_specs.items():
+            if n not in feed:
+                raise KeyError(f"missing feed '{n}'")
+            feeds[n] = jnp.asarray(feed[n], np.dtype(dt))
+        lr_val = jnp.asarray(self.lr if lr is None else lr, jnp.float32)
+        fetches, new_mut = self._exported.call(feeds, self._mut, lr_val)
+        self._mut.update(new_mut)
+        idx = {n: i for i, n in enumerate(self.fetch_names)}
+        return [fetches[idx[n]] for n in names]

@@ -212,3 +212,51 @@ def test_dygraph_control_flow_parity():
     z = paddle.static.nn.switch_case(paddle.to_tensor(3),
                                      {1: lambda: x, 3: lambda: x * 5})
     assert float(paddle.sum(z).numpy()) == 20.0
+
+
+def test_cond_passthrough_and_constant_branches(static_mode):
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [4], "float32")
+        p = paddle.static.data("p", [], "bool")
+        # true: computed; false: pass-through of the parent var
+        y1 = paddle.static.nn.cond(p, lambda: x * 2.0, lambda: x)
+        # constant branches (eager tensors baked as constants)
+        y2 = paddle.static.nn.cond(p, lambda: paddle.full([4], 1.0),
+                                   lambda: paddle.full([4], 2.0))
+        out = paddle.sum(y1) + paddle.sum(y2)
+    exe = paddle.static.Executor()
+    xv = np.ones(4, np.float32)
+    rt = exe.run(prog, feed={"x": xv, "p": np.array(True)},
+                 fetch_list=[out])
+    rf = exe.run(prog, feed={"x": xv, "p": np.array(False)},
+                 fetch_list=[out])
+    assert float(rt[0]) == 8.0 + 4.0
+    assert float(rf[0]) == 4.0 + 8.0
+
+
+def test_switch_case_no_default_single_capture(static_mode):
+    prog = paddle.static.Program()
+    sp = paddle.static.Program()
+    with paddle.static.program_guard(prog, sp):
+        i = paddle.static.data("i", [], "int32")
+        x = paddle.static.data("x", [4], "float32")
+        lin = paddle.nn.Linear(4, 2)
+        z = paddle.static.nn.switch_case(
+            i, [lambda: x[:2], lambda: paddle.mean(lin(x), keepdim=True)
+                * paddle.ones([2])])
+    # the Linear branch captured once -> exactly 2 params registered
+    assert len(prog.all_parameters()) == 2
+
+
+def test_full_like_symbolic_fill_value(static_mode):
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [3], "float32")
+        v = paddle.static.data("v", [], "float32")
+        y = paddle.full_like(x, v)
+        out = paddle.sum(y)
+    exe = paddle.static.Executor()
+    r = exe.run(prog, feed={"x": np.zeros(3, np.float32),
+                            "v": np.float32(2.5)}, fetch_list=[out])
+    assert float(r[0]) == 7.5
