@@ -241,3 +241,5 @@ class _Fleet:
 
 
 fleet = _Fleet()
+
+from .sharded_trainer import build_sharded_trainer, ShardedTrainer  # noqa: F401,E402

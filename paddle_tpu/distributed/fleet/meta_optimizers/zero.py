@@ -43,6 +43,13 @@ def add_sharding_axis(ns: NamedSharding, shape, axis: str = "sharding",
     mesh = ns.mesh
     n = mesh.shape.get(axis, 1)
     spec = list(ns.spec) + [None] * (len(shape) - len(ns.spec))
+    if any(axis == p or (isinstance(p, tuple) and axis in p)
+           for p in spec):
+        # already sharded over this axis (tp placement) — still honor a
+        # requested memory kind (offload must not silently drop)
+        if memory_kind and getattr(ns, "memory_kind", None) != memory_kind:
+            return NamedSharding(mesh, ns.spec, memory_kind=memory_kind)
+        return ns
     if n > 1:
         for i, (p, s) in enumerate(zip(spec, shape)):
             if p is None and s % n == 0 and s >= n:

@@ -1,0 +1,202 @@
+"""Generic ZeRO/hybrid compiled trainer for ARBITRARY nn.Layer models.
+
+Reference parity: ``fleet/meta_optimizers/sharding_optimizer.py:45`` —
+the reference's sharding optimizer rewrites ANY program (param/grad/
+optimizer-state partitioning, broadcast-on-use); it is not tied to one
+model.  Round 2 wired ZeRO only into the GPT trainer
+(models/gpt_spmd.py); this module closes that gap: any Layer + any
+paddle optimizer routes through one jitted train step whose placement
+implements ZeRO stages 1/2/3 (+ pinned-host offload) over the mesh's
+``sharding`` axis, with optional per-parameter tensor-parallel specs.
+
+TPU-first mechanism (same as meta_optimizers/zero.py): the stages are
+PartitionSpecs + one gradient sharding constraint; GSPMD inserts the
+all-gathers / reduce-scatters the reference implements with explicit
+collective ops.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core import autograd
+from ...core.random import rng_scope, default_generator
+from ...core.tensor import Tensor
+from .meta_optimizers.zero import add_sharding_axis
+
+__all__ = ["ShardedTrainer", "build_sharded_trainer"]
+
+
+def build_sharded_trainer(layer, loss_fn: Callable, optimizer, mesh: Mesh,
+                          *, sharding_stage: int = 2, offload: bool = False,
+                          param_specs: Optional[Dict[str, P]] = None,
+                          batch_axes: Sequence[str] = ("dp", "sharding"),
+                          donate: bool = True) -> "ShardedTrainer":
+    """One compiled ZeRO train step for any Layer.
+
+    loss_fn(model, *batch_tensors) -> scalar loss Tensor — the same
+    imperative code a user writes eagerly; it traces functionally.
+    param_specs: optional {param_name: PartitionSpec} tensor-parallel
+    placements (unlisted params replicate).
+    """
+    return ShardedTrainer(layer, loss_fn, optimizer, mesh,
+                          sharding_stage=sharding_stage, offload=offload,
+                          param_specs=param_specs, batch_axes=batch_axes,
+                          donate=donate)
+
+
+class ShardedTrainer:
+    def __init__(self, layer, loss_fn, optimizer, mesh, *,
+                 sharding_stage=2, offload=False, param_specs=None,
+                 batch_axes=("dp", "sharding"), donate=True):
+        self.layer = layer
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.stage = int(sharding_stage)
+        self.offload = bool(offload)
+        axes = [a for a in batch_axes if mesh.shape.get(a, 1) > 1]
+        self.batch_spec = P(tuple(axes) if axes else None)
+        param_specs = dict(param_specs or {})
+
+        params, buffers = layer.functional_state()
+        self._buffers = dict(buffers)
+
+        def base_ns(name, arr):
+            return NamedSharding(mesh, param_specs.get(name, P()))
+
+        # ZeRO placement decisions (zero.py): state always sharded over
+        # the axis; stage-3 shards the resident params too
+        self._param_sh = {n: base_ns(n, a) for n, a in params.items()}
+        self._grad_sh = {
+            n: add_sharding_axis(ns, params[n].shape)
+            for n, ns in self._param_sh.items()}
+        if self.stage >= 3:
+            self._resident_param_sh = dict(self._grad_sh)
+        else:
+            self._resident_param_sh = dict(self._param_sh)
+
+        mk = "pinned_host" if offload else None
+
+        def state_ns(path_params_ns, arr):
+            return add_sharding_axis(path_params_ns, arr.shape,
+                                     memory_kind=mk)
+
+        opt_state = optimizer.functional_init(params)
+        self._state0 = opt_state
+
+        def slot_sharding(tree):
+            out = {}
+            for n, slots in tree.items():
+                out[n] = {k: state_ns(self._param_sh[n], v)
+                          for k, v in slots.items()}
+            return out
+
+        self._state_sh = {
+            "slots": slot_sharding(opt_state["slots"]),
+            "master": {n: state_ns(self._param_sh[n], a)
+                       for n, a in opt_state["master"].items()},
+            "step": NamedSharding(mesh, P()),
+        }
+        self._buffer_sh = {n: NamedSharding(mesh, P())
+                           for n in buffers}
+
+        # place initial values
+        self._donate = bool(donate)
+        self.params = {n: jax.device_put(a, self._resident_param_sh[n])
+                       for n, a in params.items()}
+        self.opt_state = jax.tree.map(
+            lambda a, s: jax.device_put(a, s), opt_state, self._state_sh,
+            is_leaf=lambda x: isinstance(x, (jnp.ndarray, np.ndarray)))
+        self._compiled = {}
+
+    # -- the step ---------------------------------------------------------
+    def _build(self, n_batch):
+        layer, loss_fn, optimizer = self.layer, self.loss_fn, self.optimizer
+        grad_sh, mesh = self._grad_sh, self.mesh
+        stage = self.stage
+
+        def step(params, buffers, opt_state, key, lr, *batch):
+            def pure_loss(p):
+                with rng_scope(key):
+                    with autograd.no_grad():
+                        layer.load_functional_state(p, buffers)
+                        loss = loss_fn(layer,
+                                       *[Tensor(a) for a in batch])
+                        new_buf = {n: b._data
+                                   for n, b in layer.named_buffers()}
+                return loss._data.astype(jnp.float32), new_buf
+
+            (loss, new_buf), grads = jax.value_and_grad(
+                pure_loss, has_aux=True)(params)
+            if stage >= 2:
+                # grads land sharded -> GSPMD reduce-scatters the dp sum
+                grads = {
+                    n: jax.lax.with_sharding_constraint(g, grad_sh[n])
+                    for n, g in grads.items()}
+            # lr is a traced argument so LRScheduler/set_lr changes take
+            # effect without retracing (hapi/model.py does the same)
+            new_params, new_state = optimizer.functional_apply(
+                params, grads, opt_state, lr=lr)
+            return loss, new_params, new_buf, new_state
+
+        in_sh = (self._resident_param_sh, self._buffer_sh, self._state_sh,
+                 NamedSharding(mesh, P()), NamedSharding(mesh, P())) +             tuple(NamedSharding(mesh, self.batch_spec)
+                  for _ in range(n_batch))
+        out_sh = (NamedSharding(mesh, P()), self._resident_param_sh,
+                  self._buffer_sh, self._state_sh)
+        donate = (0, 2) if self._donate else ()
+        return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnums=donate)
+
+    def train_step(self, *batch):
+        arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        key = default_generator.next_key()
+        sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        fn = self._compiled.get(sig)
+        if fn is None:
+            fn = self._build(len(arrays))
+            self._compiled[sig] = fn
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss, self.params, self._buffers, self.opt_state = fn(
+            self.params, self._buffers, self.opt_state, key, lr, *arrays)
+        # drop leaked tracers from the live layer (eager use between
+        # steps must see real arrays; full values need sync_to_layer())
+        self.layer.load_functional_state(
+            {n: a for n, a in self.params.items()},
+            {n: a for n, a in self._buffers.items()})
+        return Tensor(loss)
+
+    # -- state round-trip --------------------------------------------------
+    def sync_to_layer(self):
+        """Write the (possibly sharded) params back into the live Layer
+        (full arrays; XLA gathers shards)."""
+        self.layer.load_functional_state(
+            {n: jax.device_get(a) for n, a in self.params.items()},
+            {n: jax.device_get(a) for n, a in self._buffers.items()})
+
+    def state_dict(self):
+        return {"params": {n: np.asarray(a)
+                           for n, a in self.params.items()},
+                "opt": jax.tree.map(np.asarray, self.opt_state)}
+
+    def per_device_state_bytes(self):
+        """Per-device bytes of optimizer slots + master + resident
+        params (the ZeRO memory-shrink observable asserted in tests)."""
+        total = 0
+
+        def add(a):
+            nonlocal total
+            # bytes of THIS array per device = shard size on device 0
+            shard = a.addressable_shards[0]
+            total += int(np.prod(shard.data.shape) *
+                         shard.data.dtype.itemsize)
+        for a in self.params.values():
+            add(a)
+        jax.tree.map(add, self.opt_state)
+        return total

@@ -115,8 +115,9 @@ def make_block_fn(cfg: GPTConfig, sp_axis: Optional[str] = None):
         ctx = ctx.reshape(B, T, D)
         x = x + ctx @ p["out_w"] + p["out_b"]
         y = _layernorm(x, p["ln2_g"], p["ln2_b"])
-        x = x + jax.nn.gelu(y @ p["up_w"] + p["up_b"]) @ p["down_w"] \
-            + p["down_b"]
+        up = checkpoint_name(jax.nn.gelu(y @ p["up_w"] + p["up_b"]),
+                             "ffn_up")
+        x = x + up @ p["down_w"] + p["down_b"]
         return x
     return block_fn
 
@@ -173,6 +174,14 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
             return jax.checkpoint(
                 f, policy=jax.checkpoint_policies.save_only_these_names(
                     "attn_ctx"))
+    elif remat_policy == "ctx_ffn":
+        # save attention outputs AND the gelu(ffn-up) activation: the
+        # backward skips the two biggest recomputed matmuls; fits only
+        # because the chunked CE freed the (B, T, V) logits HBM
+        def maybe_remat(f):
+            return jax.checkpoint(
+                f, policy=jax.checkpoint_policies.save_only_these_names(
+                    "attn_ctx", "ffn_up"))
     elif remat_policy == "dots":
         def maybe_remat(f):
             return jax.checkpoint(
@@ -235,17 +244,71 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
         x = _layernorm(x, params["ln_f_g"], params["ln_f_b"])
         return x @ params["head_w"]
 
-    def loss_fn(params, ids, labels):
-        # fusion-friendly CE: two reductions + one gather over the bf16
-        # logits — never materialises an f32 (B, T, V) log_softmax copy
-        # (at BERT-base bench shapes that copy is 8 GB of HBM traffic)
-        logits = forward(params, ids)
-        m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    def trunk(params, ids):
+        """forward() minus the head matmul: (B, T, D) final hidden
+        (non-pp/non-sp path only — the chunked-CE caller)."""
+        if compute_dtype != jnp.float32:
+            params = jax.tree.map(
+                lambda a: a.astype(compute_dtype)
+                if a.dtype == jnp.float32 else a, params)
+        x = params["wte"][ids] + params["wpe"][:ids.shape[1]][None]
+
+        def body(h, p):
+            return maybe_remat(block_fn)(p, h), None
+        x, _ = lax.scan(body, x, params["blocks"])
+        return _layernorm(x, params["ln_f_g"], params["ln_f_b"])
+
+    # The loss head is the single biggest HBM consumer at bench shapes:
+    # full (B, T, V) bf16 logits are 4 GB (B=128 T=512 V=30k), and the
+    # reference hand-fuses exactly this op
+    # (operators/collective/c_softmax_with_cross_entropy_op.cu:1).  The
+    # TPU translation is a CHUNKED head: scan over row blocks, each
+    # chunk computes its logits + CE and the backward recomputes them
+    # (jax.checkpoint), so live logits are chunk x V instead of BT x V.
+    CE_CHUNK = 4096
+
+    def _ce_rows(xc, head_w, lc):
+        # xc: (C, D) hidden rows; lc: (C,) labels -> summed CE
+        logits = xc @ head_w                              # (C, V)
+        m = jax.lax.stop_gradient(jnp.max(logits, -1, keepdims=True))
         shifted = (logits - m).astype(jnp.float32)
         lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
-        at_label = jnp.take_along_axis(shifted, labels[..., None],
-                                       axis=-1)[..., 0]
-        return jnp.mean(lse - at_label)
+        at = jnp.take_along_axis(shifted, lc[:, None], axis=-1)[..., 0]
+        return jnp.sum(lse - at)
+
+    def chunked_ce(x, head_w, labels):
+        B, T, D = x.shape
+        n = B * T
+        xf = x.reshape(n, D)
+        lf = labels.reshape(n)
+        if n % CE_CHUNK != 0:
+            return _ce_rows(xf, head_w, lf) / n
+        nc = n // CE_CHUNK
+        ce = jax.checkpoint(_ce_rows)
+
+        def body(acc, args):
+            xc, lc = args
+            return acc + ce(xc, head_w, lc), None
+        total, _ = lax.scan(body, jnp.zeros((), jnp.float32),
+                            (xf.reshape(nc, CE_CHUNK, D),
+                             lf.reshape(nc, CE_CHUNK)))
+        return total / n
+
+    def loss_fn(params, ids, labels):
+        if use_pp or use_sp:
+            # pipelined/sequence-parallel paths keep the fused whole-
+            # logits CE (head runs inside their shard_map schedules)
+            logits = forward(params, ids)
+            m = jax.lax.stop_gradient(
+                jnp.max(logits, axis=-1, keepdims=True))
+            shifted = (logits - m).astype(jnp.float32)
+            lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
+            at_label = jnp.take_along_axis(shifted, labels[..., None],
+                                           axis=-1)[..., 0]
+            return jnp.mean(lse - at_label)
+        x = trunk(params, ids)
+        head_w = params["head_w"].astype(x.dtype)
+        return chunked_ce(x, head_w, labels)
 
     def adamw_update(params, grads, opt_state):
         step = opt_state["step"] + 1

@@ -277,6 +277,17 @@ def _state_of(program):
 
 
 def load_program_state(model_path, var_list=None):
+    import os
+    import numpy as np
+    if os.path.exists(model_path + ".pdparams"):
+        # paired with the npz-writing static.save (serialization.py)
+        with np.load(model_path + ".pdparams") as z:
+            params = {n: z[n] for n in z.files}
+        buffers = {}
+        if os.path.exists(model_path + ".pdopt"):
+            with np.load(model_path + ".pdopt") as z:
+                buffers = {n: z[n] for n in z.files}
+        return {"params": params, "buffers": buffers}
     with open(model_path + ".pdstate" if not model_path.endswith(".pdstate")
               else model_path, "rb") as f:
         return pickle.load(f)
@@ -299,10 +310,14 @@ def set_program_state(program, state_dict):
 
 def save(program, model_path, protocol=4):
     """reference static.save (io.py:2291): .pdparams + .pdopt for a
-    captured Program; legacy pickle fallback for scope-backed nets."""
+    captured Program (or resumed LoadedProgram); legacy pickle fallback
+    for scope-backed nets."""
     from .program import Program
     from . import serialization
     prog = getattr(program, "program", program)
+    if isinstance(prog, serialization.LoadedProgram):
+        serialization.save(prog, model_path)
+        return
     if isinstance(prog, Program) and (prog.parameters or prog.state_vars):
         serialization.save(prog, model_path)
         return

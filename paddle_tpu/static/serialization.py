@@ -47,11 +47,18 @@ def save(program, path_prefix: str, protocol=None, **configs):
     state / non-param persistables)."""
     program = getattr(program, "program", program)   # CompiledProgram
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
-    params = {n: np.asarray(p._data)
-              for n, p in program.parameters.items()}
+    if isinstance(program, LoadedProgram):
+        # checkpoint a resumed program: split its live state by name
+        params = {n: np.asarray(program._mut[n])
+                  for n in program.param_names if n in program._mut}
+        state = {n: np.asarray(a) for n, a in program._mut.items()
+                 if n not in set(program.param_names)}
+    else:
+        params = {n: np.asarray(p._data)
+                  for n, p in program.parameters.items()}
+        state = {n: np.asarray(a) for n, a in program.state_vars.items()}
     np.savez(path_prefix + ".pdparams", **params)
     os.replace(path_prefix + ".pdparams.npz", path_prefix + ".pdparams")
-    state = {n: np.asarray(a) for n, a in program.state_vars.items()}
     np.savez(path_prefix + ".pdopt", **state)
     os.replace(path_prefix + ".pdopt.npz", path_prefix + ".pdopt")
 

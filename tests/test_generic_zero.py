@@ -1,0 +1,183 @@
+"""Generic ZeRO trainer for arbitrary nn.Layer (round-3 VERDICT item 4).
+
+Reference parity: ``fleet/meta_optimizers/sharding_optimizer.py:45`` —
+works on any program, not just one model.  Same assertions as
+test_zero_sharding.py (stage parity, per-device memory shrink), but on
+a plain MLP and ResNet, via fleet.build_sharded_trainer.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import build_sharded_trainer
+from paddle_tpu.distributed.topology import build_mesh
+
+
+def _loss_fn(model, x, y):
+    return paddle.mean((model(x) - y) ** 2)
+
+
+def _mlp():
+    return paddle.nn.Sequential(paddle.nn.Linear(16, 64),
+                                paddle.nn.ReLU(),
+                                paddle.nn.Linear(64, 1))
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    xv = rng.rand(32, 16).astype(np.float32)
+    yv = xv @ rng.rand(16, 1).astype(np.float32)
+    return xv, yv
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh({"dp": 2, "sharding": 4})
+
+
+def _run_stage(mesh, stage, steps=12):
+    paddle.seed(0)
+    mlp = _mlp()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, weight_decay=0.01,
+                                 parameters=mlp.parameters())
+    tr = build_sharded_trainer(mlp, _loss_fn, opt, mesh,
+                               sharding_stage=stage)
+    xv, yv = _data()
+    losses = [float(tr.train_step(paddle.to_tensor(xv),
+                                  paddle.to_tensor(yv)).numpy())
+              for _ in range(steps)]
+    return losses, tr
+
+
+def test_stage_parity_and_memory_shrink(mesh):
+    l1, t1 = _run_stage(mesh, 1)
+    l2, t2 = _run_stage(mesh, 2)
+    l3, t3 = _run_stage(mesh, 3)
+    np.testing.assert_allclose(l1, l2, rtol=1e-4)
+    np.testing.assert_allclose(l1, l3, rtol=1e-4)
+    # stage 3: resident params sharded too -> strictly less per device
+    assert t3.per_device_state_bytes() < t1.per_device_state_bytes()
+
+
+def test_matches_eager_single_device(mesh):
+    paddle.seed(0)
+    m1 = _mlp()
+    o1 = paddle.optimizer.AdamW(learning_rate=0.01, weight_decay=0.01,
+                                parameters=m1.parameters())
+    xv, yv = _data()
+    eager = []
+    for _ in range(8):
+        loss = _loss_fn(m1, paddle.to_tensor(xv), paddle.to_tensor(yv))
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+        eager.append(float(loss.numpy()))
+    sharded, _ = _run_stage(mesh, 2, steps=8)
+    np.testing.assert_allclose(eager, sharded, rtol=2e-4)
+
+
+def test_grad_reduce_scatter_constraint_in_lowering(mesh):
+    """Stage-2 lowers with the gradient sharding constraint present
+    (XLA:CPU never forms reduce-scatter, so assert on the constraint
+    like test_zero_sharding does)."""
+    paddle.seed(0)
+    mlp = _mlp()
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=mlp.parameters())
+    tr = build_sharded_trainer(mlp, _loss_fn, opt, mesh, sharding_stage=2)
+    xv, yv = _data()
+    import jax
+    import jax.numpy as jnp
+    fn = tr._build(2)
+    key = jax.random.PRNGKey(0)
+    txt = fn.lower(tr.params, tr._buffers, tr.opt_state, key,
+                   jnp.float32(0.01), jnp.asarray(xv),
+                   jnp.asarray(yv)).as_text()
+    assert "sharding_constraint" in txt or "sdy.sharding" in txt
+
+
+def test_sync_back_and_state_dict(mesh):
+    losses, tr = _run_stage(mesh, 3, steps=3)
+    tr.sync_to_layer()
+    # layer params hold full (gathered) values after sync
+    for _, p in tr.layer.named_parameters():
+        assert np.isfinite(np.asarray(p._data)).all()
+    sd = tr.state_dict()
+    assert set(sd) == {"params", "opt"}
+    assert all(np.isfinite(a).all() for a in sd["params"].values())
+
+
+def test_resnet_trains_with_sharding(mesh):
+    paddle.seed(1)
+    # resnet18 keeps the CPU test fast; same conv/bn/buffer machinery
+    net = paddle.vision.models.resnet18(num_classes=10)
+
+    def ce(model, x, y):
+        import paddle_tpu.nn.functional as F
+        return F.cross_entropy(model(x), y)
+
+    opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                    parameters=net.parameters())
+    tr = build_sharded_trainer(net, ce, opt, mesh, sharding_stage=3)
+    rng = np.random.RandomState(0)
+    xb = paddle.to_tensor(rng.rand(8, 3, 32, 32).astype("float32"))
+    yb = paddle.to_tensor(rng.randint(0, 10, (8,)))
+    ls = [float(tr.train_step(xb, yb).numpy()) for _ in range(4)]
+    assert ls[-1] < ls[0]
+    # batch-norm running stats updated through the compiled step
+    rm = [b for n, b in net.named_buffers() if "_mean" in n]
+    trained_mean = tr._buffers
+    assert any(np.abs(np.asarray(a)).sum() > 0
+               for n, a in trained_mean.items() if "_mean" in n)
+
+
+def test_tensor_parallel_param_specs(mesh):
+    """param_specs places a named weight over the sharding axis
+    (tensor-parallel placement for the generic trainer)."""
+    from jax.sharding import PartitionSpec as P
+    paddle.seed(0)
+    mlp = _mlp()
+    name = [n for n, _ in mlp.named_parameters()][0]  # first Linear W
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=mlp.parameters())
+    tr = build_sharded_trainer(mlp, _loss_fn, opt, mesh,
+                               sharding_stage=1,
+                               param_specs={name: P(None, "sharding")})
+    xv, yv = _data()
+    l0 = float(tr.train_step(paddle.to_tensor(xv),
+                             paddle.to_tensor(yv)).numpy())
+    l5 = [float(tr.train_step(paddle.to_tensor(xv),
+                              paddle.to_tensor(yv)).numpy())
+          for _ in range(5)][-1]
+    assert l5 < l0
+    spec = tr.params[name].sharding.spec
+    assert "sharding" in tuple(spec)
+
+
+def test_no_leaked_tracers_in_layer(mesh):
+    paddle.seed(0)
+    mlp = _mlp()
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=mlp.parameters())
+    tr = build_sharded_trainer(mlp, _loss_fn, opt, mesh, sharding_stage=2)
+    xv, yv = _data()
+    tr.train_step(paddle.to_tensor(xv), paddle.to_tensor(yv))
+    # eager use right after a compiled step must see real arrays
+    out = mlp(paddle.to_tensor(xv))
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_lr_changes_take_effect(mesh):
+    paddle.seed(0)
+    mlp = _mlp()
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=mlp.parameters())
+    tr = build_sharded_trainer(mlp, _loss_fn, opt, mesh,
+                               sharding_stage=1, donate=False)
+    xv, yv = _data()
+    tr.train_step(paddle.to_tensor(xv), paddle.to_tensor(yv))
+    before = {n: np.asarray(a) for n, a in tr.params.items()}
+    opt.set_lr(0.0)
+    tr.train_step(paddle.to_tensor(xv), paddle.to_tensor(yv))
+    for n, a in tr.params.items():
+        np.testing.assert_allclose(np.asarray(a), before[n])
