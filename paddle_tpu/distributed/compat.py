@@ -129,29 +129,50 @@ class _DatasetBase:
         self._use_vars = list(var_list)
 
     def set_pipe_command(self, cmd):
-        if callable(cmd):
-            self._pipe = cmd
-        else:
-            raise ValueError(
-                "the TPU build takes a python callable per line instead of "
-                "a shell pipe command")
+        """Python callable (per line) OR a real shell pipe command
+        (reference data_feed.cc runs ``cat file | cmd`` per file —
+        ``set_pipe_command("awk '{...}'")``)."""
+        self._pipe = cmd
 
-    def _iter_lines(self):
-        for path in self._filelist:
+    def _iter_lines(self, filelist=None):
+        import subprocess
+        files = self._filelist if filelist is None else filelist
+        shell_cmd = self._pipe if isinstance(self._pipe, str) else None
+        for path in files:
+            if shell_cmd:
+                # one subprocess per file, exactly the reference shape
+                # (framework/data_feed.cc fp_ = shell_popen)
+                proc = subprocess.Popen(
+                    shell_cmd, shell=True, stdin=open(path, "rb"),
+                    stdout=subprocess.PIPE, text=True)
+                try:
+                    for line in proc.stdout:
+                        yield line.rstrip("\n")
+                finally:
+                    proc.stdout.close()
+                    rc = proc.wait()
+                    if rc != 0:
+                        raise RuntimeError(
+                            f"pipe_command {shell_cmd!r} failed with exit "
+                            f"code {rc} on {path}")
+                continue
             with open(path) as f:
                 for line in f:
                     line = line.rstrip("\n")
-                    yield self._pipe(line) if self._pipe else line
+                    yield self._pipe(line) if callable(self._pipe) else line
 
-    def __iter__(self):
+    def _iter_batches(self, filelist=None):
         batch = []
-        for sample in self._iter_lines():
+        for sample in self._iter_lines(filelist):
             batch.append(sample)
             if len(batch) == self._batch_size:
                 yield batch
                 batch = []
         if batch:
             yield batch
+
+    def __iter__(self):
+        yield from self._iter_batches()
 
 
 class InMemoryDataset(_DatasetBase):

@@ -87,7 +87,6 @@ class ShardedTrainer:
                                      memory_kind=mk)
 
         opt_state = optimizer.functional_init(params)
-        self._state0 = opt_state
 
         def slot_sharding(tree):
             out = {}

@@ -156,7 +156,10 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
     sharding_n = mesh.shape.get("sharding", 1)
     use_pp, use_sp = pp > 1, sp > 1
     use_zero = sharding_n > 1
-    batch_axes = ("dp", "sharding") if use_zero else "dp"
+    # only axes actually present in the mesh shard the batch (a pp-only
+    # mesh has no dp axis at all; size-1 axes are no-ops)
+    batch_axes = tuple(a for a in ("dp", "sharding")
+                       if mesh.shape.get(a, 1) > 1) or None
     sp_axis = "sp" if use_sp else None
     block_fn = make_block_fn(cfg, sp_axis=sp_axis)
 
@@ -193,7 +196,25 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
     if use_pp and L % pp != 0:
         raise ValueError(f"num_layers {L} must divide pp {pp}")
 
+    def trunk(params, ids):
+        """Non-pp/non-sp forward minus the head matmul: the shared path
+        for plain forward() and the chunked-CE loss."""
+        if compute_dtype != jnp.float32:
+            params = jax.tree.map(
+                lambda a: a.astype(compute_dtype)
+                if a.dtype == jnp.float32 else a, params)
+        x = params["wte"][ids] + params["wpe"][:ids.shape[1]][None]
+
+        def body(h, p):
+            return maybe_remat(block_fn)(p, h), None
+        x, _ = lax.scan(body, x, params["blocks"])
+        return _layernorm(x, params["ln_f_g"], params["ln_f_b"])
+
     def forward(params, ids):
+        if not (use_pp or use_sp):
+            x = trunk(params, ids)
+            head_w = params["head_w"]
+            return x @ head_w.astype(x.dtype)
         if compute_dtype != jnp.float32:
             # AMP O2: f32 master params, bf16 matmuls on the MXU
             params = jax.tree.map(
@@ -222,7 +243,7 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
                                                        else set()),
                 check_vma=False)(params["blocks"], xm)
             x = xm.reshape(B, T, cfg.hidden_size)
-        elif use_sp:
+        else:
             # sequence parallel without pp: shard T over sp, ring
             # attention inside; blocks scanned locally
             def seq_par(bp, xi):
@@ -234,29 +255,8 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
                 seq_par, mesh=mesh, in_specs=(P(None), P(None, "sp")),
                 out_specs=P(None, "sp"), axis_names={"sp"},
                 check_vma=False)(params["blocks"], x)
-        else:
-            # remat each block: O(1) layer activations live at once, the
-            # backward recomputes (reference recompute_optimizer default
-            # posture — HBM is the bottleneck, MXU flops are cheap)
-            def body(h, p):
-                return maybe_remat(block_fn)(p, h), None
-            x, _ = lax.scan(body, x, params["blocks"])
         x = _layernorm(x, params["ln_f_g"], params["ln_f_b"])
         return x @ params["head_w"]
-
-    def trunk(params, ids):
-        """forward() minus the head matmul: (B, T, D) final hidden
-        (non-pp/non-sp path only — the chunked-CE caller)."""
-        if compute_dtype != jnp.float32:
-            params = jax.tree.map(
-                lambda a: a.astype(compute_dtype)
-                if a.dtype == jnp.float32 else a, params)
-        x = params["wte"][ids] + params["wpe"][:ids.shape[1]][None]
-
-        def body(h, p):
-            return maybe_remat(block_fn)(p, h), None
-        x, _ = lax.scan(body, x, params["blocks"])
-        return _layernorm(x, params["ln_f_g"], params["ln_f_b"])
 
     # The loss head is the single biggest HBM consumer at bench shapes:
     # full (B, T, V) bf16 logits are 4 GB (B=128 T=512 V=30k), and the
@@ -281,17 +281,22 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
         n = B * T
         xf = x.reshape(n, D)
         lf = labels.reshape(n)
-        if n % CE_CHUNK != 0:
-            return _ce_rows(xf, head_w, lf) / n
-        nc = n // CE_CHUNK
         ce = jax.checkpoint(_ce_rows)
-
-        def body(acc, args):
-            xc, lc = args
-            return acc + ce(xc, head_w, lc), None
-        total, _ = lax.scan(body, jnp.zeros((), jnp.float32),
-                            (xf.reshape(nc, CE_CHUNK, D),
-                             lf.reshape(nc, CE_CHUNK)))
+        nc = n // CE_CHUNK
+        total = jnp.zeros((), jnp.float32)
+        if nc:
+            def body(acc, args):
+                xc, lc = args
+                return acc + ce(xc, head_w, lc), None
+            head_n = nc * CE_CHUNK
+            total, _ = lax.scan(body, total,
+                                (xf[:head_n].reshape(nc, CE_CHUNK, D),
+                                 lf[:head_n].reshape(nc, CE_CHUNK)))
+        if n % CE_CHUNK:
+            # remainder rows get their own (still-checkpointed) chunk so
+            # odd batch sizes never fall back to whole-logits CE
+            total = total + ce(xf[nc * CE_CHUNK:], head_w,
+                               lf[nc * CE_CHUNK:])
         return total / n
 
     def loss_fn(params, ids, labels):

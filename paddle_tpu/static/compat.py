@@ -294,9 +294,23 @@ def load_program_state(model_path, var_list=None):
 
 
 def set_program_state(program, state_dict):
+    from .program import Program as _Prog
+    prog = getattr(program, "program", program)
+    if isinstance(prog, _Prog) and prog.parameters:
+        # captured Program: params by name; optimizer slots (saved under
+        # 'buffers' by the npz load_program_state) into state_vars
+        for n, arr in state_dict.get("params", state_dict).items():
+            if n in prog.parameters:
+                prog.parameters[n]._data = jnp.asarray(arr)
+        for n, arr in state_dict.get("buffers", {}).items():
+            if n in prog.state_vars:
+                prog.state_vars[n] = jnp.asarray(arr)
+        return
     net = getattr(program, "_network", None)
     if net is None:
         for n, arr in state_dict.get("params", state_dict).items():
+            global_scope().set_var(n, Tensor(jnp.asarray(arr)))
+        for n, arr in state_dict.get("buffers", {}).items():
             global_scope().set_var(n, Tensor(jnp.asarray(arr)))
         return
     lookup = dict(net.named_parameters())

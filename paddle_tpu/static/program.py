@@ -813,8 +813,26 @@ class Executor:
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [getattr(f, "name", str(f))
                                     for f in fetch_list]
-        last_fetch = None
-        for step, batch in enumerate(dataset):
+
+        # per-sample slot widths for parsing raw pipe-command lines
+        # (reference MultiSlotDataFeed: line = concatenated slot values)
+        widths = []
+        for v in use_vars:
+            shp = getattr(v, "shape", None)
+            widths.append(int(np.prod([s for s in shp[1:]])) if shp and
+                          len(shp) > 1 else 1)
+
+        def parse_line(line):
+            vals = [float(t) for t in line.split()]
+            out, off = [], 0
+            for w in widths:
+                out.append(np.asarray(vals[off:off + w], np.float32))
+                off += w
+            return tuple(out)
+
+        def to_feed(batch):
+            if batch and isinstance(batch[0], str):
+                batch = [parse_line(s) for s in batch]
             cols = list(zip(*batch)) if batch and isinstance(
                 batch[0], (tuple, list)) else [batch]
             if len(cols) != len(names):
@@ -823,8 +841,23 @@ class Executor:
                     f"set_use_var declared {len(names)} variable(s) "
                     f"({names}); the pipe command must emit one value "
                     "per use_var")
-            feed = {n: np.stack([np.asarray(s) for s in col])
+            return {n: np.stack([np.asarray(s) for s in col])
                     for n, col in zip(names, cols)}
+
+        thread = int(thread or getattr(dataset, "_thread_num", 1) or 1)
+        filelist = list(getattr(dataset, "_filelist", []))
+        can_thread = (thread > 1 and len(filelist) > 1
+                      and hasattr(dataset, "_iter_batches")
+                      and getattr(dataset, "_records", None) is None)
+        if can_thread:
+            batches = self._threaded_batches(dataset, filelist,
+                                             min(thread, len(filelist)),
+                                             to_feed)
+        else:
+            batches = (to_feed(b) for b in dataset)
+
+        last_fetch = None
+        for step, feed in enumerate(batches):
             out = self.run(program, feed=feed, fetch_list=fetch_list)
             last_fetch = out
             if debug and fetch_list and step % max(1, print_period) == 0:
@@ -832,6 +865,119 @@ class Executor:
                                 for i, v in zip(fetch_info, out))
                 print(f"[train_from_dataset] step {step}: {msg}")
         return last_fetch
+
+    @staticmethod
+    def _threaded_batches(dataset, filelist, nthread, to_feed):
+        """MultiTrainer-style ingest (reference framework/trainer.h:57 —
+        thread-per-channel workers feeding DataFeed queues): N threads
+        each own a file partition, parse+batch through the pipe command
+        and push numpy feeds into the native BlockingQueue; the consumer
+        overlaps compiled-program compute with ingest (queue waits drop
+        the GIL in native.cc)."""
+        import pickle
+        import queue as pyqueue
+        import threading
+
+        try:
+            from .. import native
+            q = native.BlockingQueue(capacity=4 * nthread)
+            use_native = True
+        except Exception:            # native lib unavailable: py queue
+            q = pyqueue.Queue(maxsize=4 * nthread)
+            use_native = False
+        done = threading.Event()
+        errors = []
+        remaining = [nthread]
+        lock = threading.Lock()
+
+        def put(obj):
+            # native queue carries bytes; the py fallback carries the
+            # object itself (no pointless pickle round-trip)
+            data = pickle.dumps(obj, protocol=4) if use_native else obj
+            while not done.is_set():
+                if use_native:
+                    if q.push(data, timeout_ms=200):
+                        return
+                else:
+                    try:
+                        q.put(data, timeout=0.2)
+                        return
+                    except pyqueue.Full:
+                        continue
+
+        def worker(files):
+            # full batches stream out; the per-partition TAIL (fewer
+            # than batch_size samples) is forwarded raw so the consumer
+            # can re-batch tails together — keeping batch shapes
+            # identical to the serial path (no shape-miss recompiles)
+            try:
+                bs = dataset._batch_size
+                buf = []
+                for sample in dataset._iter_lines(files):
+                    if done.is_set():
+                        return
+                    buf.append(sample)
+                    if len(buf) == bs:
+                        put(("batch", to_feed(buf)))
+                        buf = []
+                if buf:
+                    put(("tail", buf))
+            except BaseException as e:   # surfaced on the consumer side
+                errors.append(e)
+            finally:
+                with lock:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        put(("eof", None))
+
+        parts = [filelist[i::nthread] for i in range(nthread)]
+        threads = [threading.Thread(target=worker, args=(p,), daemon=True)
+                   for p in parts if p]
+        remaining[0] = len(threads)
+        for t in threads:
+            t.start()
+        tails = []
+        try:
+            while True:
+                if use_native:
+                    try:
+                        data = q.pop(timeout_ms=200)
+                    except TimeoutError:
+                        if errors:
+                            raise errors[0]
+                        continue
+                    if data is None:   # closed + drained
+                        if errors:
+                            raise errors[0]
+                        break
+                    tag, payload = pickle.loads(data)
+                else:
+                    try:
+                        tag, payload = q.get(timeout=0.2)
+                    except pyqueue.Empty:
+                        if errors:
+                            raise errors[0]
+                        continue
+                if tag == "eof":
+                    if errors:
+                        raise errors[0]
+                    break
+                if errors:
+                    raise errors[0]
+                if tag == "tail":
+                    tails.extend(payload)
+                    bs = dataset._batch_size
+                    while len(tails) >= bs:
+                        yield to_feed(tails[:bs])
+                        tails = tails[bs:]
+                    continue
+                yield payload
+            if tails:
+                yield to_feed(tails)    # single final partial batch
+        finally:
+            done.set()
+            for t in threads:
+                t.join(timeout=5)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

