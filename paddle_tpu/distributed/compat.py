@@ -132,6 +132,10 @@ class _DatasetBase:
         """Python callable (per line) OR a real shell pipe command
         (reference data_feed.cc runs ``cat file | cmd`` per file —
         ``set_pipe_command("awk '{...}'")``)."""
+        if not (callable(cmd) or isinstance(cmd, str)):
+            raise ValueError(
+                "pipe_command must be a python callable or a shell "
+                f"command string, got {type(cmd).__name__}")
         self._pipe = cmd
 
     def _iter_lines(self, filelist=None):
@@ -145,13 +149,18 @@ class _DatasetBase:
                 proc = subprocess.Popen(
                     shell_cmd, shell=True, stdin=open(path, "rb"),
                     stdout=subprocess.PIPE, text=True)
+                finished = False
                 try:
                     for line in proc.stdout:
                         yield line.rstrip("\n")
+                    finished = True
                 finally:
                     proc.stdout.close()
                     rc = proc.wait()
-                    if rc != 0:
+                    # early consumer exit (GeneratorExit) kills the
+                    # child via SIGPIPE — only a rc on a run we read to
+                    # completion is a real pipe failure
+                    if finished and rc != 0:
                         raise RuntimeError(
                             f"pipe_command {shell_cmd!r} failed with exit "
                             f"code {rc} on {path}")

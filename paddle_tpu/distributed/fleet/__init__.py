@@ -243,3 +243,5 @@ class _Fleet:
 fleet = _Fleet()
 
 from .sharded_trainer import build_sharded_trainer, ShardedTrainer  # noqa: F401,E402
+from .heter_ps import (HeterEmbeddingTable, HeterPSEmbedding,  # noqa: F401,E402
+                       HeterCache)

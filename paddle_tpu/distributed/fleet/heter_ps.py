@@ -1,0 +1,289 @@
+"""Heterogeneous parameter-server tier — the TPU-meaningful analog.
+
+Reference parity: ``paddle/fluid/framework/fleet/heter_ps/heter_comm.h``
+(GPU-cached embedding tables over a host/SSD tier),
+``distributed/service/heter_client.h:67`` / ``heter_server.h:151`` (the
+RPC plumbing between the cached tier and the PS).
+
+TPU translation: the reference keeps hot embedding rows resident on the
+accelerator and the full table in host RAM, pulling misses on demand
+and pushing gradient updates back through the PS.  Here:
+
+- ``HeterEmbeddingTable`` — the full table lives in HOST RAM (numpy);
+  a fixed-capacity DEVICE cache holds the hot rows (frequency-admitted,
+  LRU-evicted).  Lookups gather hits from the device cache and misses
+  from host; ``prefetch()`` warms the cache asynchronously for the next
+  batch (the heter_comm pull pipeline).
+- ``HeterPSEmbedding`` — an ``nn.Layer`` over the table: forward
+  looks rows up, backward applies the row-sparse update to the host
+  tier and writes through to cached copies.
+- ``HeterCache`` — the same cache layered in front of a PS client's
+  ``pull_sparse`` (reference heter_client): DistributedEmbedding pulls
+  only cache-missing rows from the remote table.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import autograd
+from ...core.tensor import Tensor, to_tensor
+from ...nn.layer_base import Layer
+
+__all__ = ["HeterEmbeddingTable", "HeterPSEmbedding", "HeterCache"]
+
+
+class HeterEmbeddingTable:
+    """Host-RAM table + device hot-row cache (heter_comm.h analog)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 cache_rows: int = 4096, dtype=np.float32,
+                 initializer=None, seed: int = 0, admit_after: int = 2):
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        rng = np.random.RandomState(seed)
+        if initializer is None:
+            scale = 1.0 / np.sqrt(embedding_dim)
+            self.host = rng.uniform(-scale, scale,
+                                    (num_embeddings, embedding_dim)
+                                    ).astype(dtype)
+        else:
+            self.host = np.asarray(initializer, dtype).reshape(
+                num_embeddings, embedding_dim)
+        C = max(1, min(int(cache_rows), self.num_embeddings))
+        self.cache_rows = C
+        self._cache = jnp.zeros((C, embedding_dim), dtype)
+        self._slot_of: Dict[int, int] = {}       # row id -> cache slot
+        self._id_at = np.full(C, -1, np.int64)   # cache slot -> row id
+        self._clock = np.zeros(C, np.int64)      # LRU stamps
+        self._freq: Dict[int, int] = {}          # admission counter
+        self._tick = 0
+        self._admit_after = int(admit_after)
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self._prefetch_thread: Optional[threading.Thread] = None
+
+    # -- cache mechanics ---------------------------------------------------
+    def _admit(self, row_ids: np.ndarray):
+        """Install rows into cache slots (evicting LRU) with ONE batched
+        device scatter for the whole call."""
+        new_ids, slots = [], []
+        for rid in row_ids:
+            rid = int(rid)
+            if rid in self._slot_of:
+                continue
+            if len(self._slot_of) < self.cache_rows:
+                slot = len(self._slot_of)
+            else:
+                slot = int(np.argmin(self._clock))
+                old = int(self._id_at[slot])
+                if old >= 0:
+                    self._slot_of.pop(old, None)
+            self._slot_of[rid] = slot
+            self._id_at[slot] = rid
+            self._tick += 1
+            self._clock[slot] = self._tick
+            new_ids.append(rid)
+            slots.append(slot)
+        if new_ids:
+            self._cache = self._cache.at[jnp.asarray(slots)].set(
+                jnp.asarray(self.host[new_ids]))
+
+    def _touch(self, slots):
+        self._tick += 1
+        self._clock[slots] = self._tick
+
+    def lookup(self, ids) -> jnp.ndarray:
+        """Gather rows for flat int ids -> (n, D) device array."""
+        flat = np.asarray(ids).reshape(-1)
+        with self._lock:
+            uniq, inverse = np.unique(flat, return_inverse=True)
+            slots = np.asarray([self._slot_of.get(int(u), -1)
+                                for u in uniq])
+            hit = slots >= 0
+            self.hits += int(hit.sum())
+            self.misses += int((~hit).sum())
+            n, D = uniq.size, self.embedding_dim
+            rows = np.empty((n, D), self.host.dtype)
+            if (~hit).any():
+                rows[~hit] = self.host[uniq[~hit]]
+            out = jnp.asarray(rows)
+            if hit.any():
+                out = out.at[jnp.asarray(np.where(hit)[0])].set(
+                    self._cache[jnp.asarray(slots[hit])])
+                self._touch(slots[hit])
+            # admission: rows seen often enough move onto the device
+            for u in uniq[~hit]:
+                u = int(u)
+                self._freq[u] = self._freq.get(u, 0) + 1
+                if self._freq[u] >= self._admit_after:
+                    self._admit(np.asarray([u]))
+                    self._freq.pop(u, None)
+            return out[jnp.asarray(inverse)]
+
+    def prefetch(self, ids):
+        """Async warm-up for an upcoming batch (heter pull pipeline):
+        admits the batch's rows on a background thread."""
+        flat = np.unique(np.asarray(ids).reshape(-1))
+
+        def work():
+            with self._lock:
+                self._admit(flat)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        self._prefetch_thread = t
+        return t
+
+    def wait_prefetch(self):
+        if self._prefetch_thread is not None:
+            self._prefetch_thread.join()
+            self._prefetch_thread = None
+
+    # -- sparse update ------------------------------------------------------
+    def apply_grads(self, ids, grads, lr: float):
+        """Row-sparse SGD on the host tier + write-through to cached
+        copies (reference heter push_sparse -> optimizer on the table)."""
+        flat = np.asarray(ids).reshape(-1)
+        g = np.asarray(grads).reshape(flat.size, self.embedding_dim)
+        with self._lock:
+            uniq, inverse = np.unique(flat, return_inverse=True)
+            merged = np.zeros((uniq.size, self.embedding_dim),
+                              self.host.dtype)
+            np.add.at(merged, inverse, g)
+            self.host[uniq] -= lr * merged
+            cached = [(i, self._slot_of[int(u)]) for i, u in
+                      enumerate(uniq) if int(u) in self._slot_of]
+            if cached:
+                idxs = jnp.asarray([s for _, s in cached])
+                vals = jnp.asarray(self.host[[uniq[i]
+                                              for i, _ in cached]])
+                self._cache = self._cache.at[idxs].set(vals)
+
+    @property
+    def hit_rate(self):
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def state_dict(self):
+        return {"host": self.host.copy()}
+
+    def load_state_dict(self, sd):
+        self.host[...] = sd["host"]
+        with self._lock:
+            # refresh any cached copies from the restored host tier
+            live = [(int(r), s) for r, s in self._slot_of.items()]
+            for rid, slot in live:
+                self._cache = self._cache.at[slot].set(
+                    jnp.asarray(self.host[rid]))
+
+
+class HeterPSEmbedding(Layer):
+    """Trainable embedding over a HeterEmbeddingTable (the heter-PS
+    user surface: same contract as nn.Embedding(sparse=True), rows
+    resident host-side with a device cache)."""
+
+    def __init__(self, num_embeddings, embedding_dim, cache_rows=4096,
+                 learning_rate=0.1, seed=0, name=None):
+        super().__init__()
+        self.table = HeterEmbeddingTable(num_embeddings, embedding_dim,
+                                         cache_rows=cache_rows, seed=seed)
+        self._lr = float(learning_rate)
+
+    def forward(self, x):
+        x = to_tensor(x)
+        ids = np.asarray(x._data)
+        out = self.table.lookup(ids).reshape(
+            ids.shape + (self.table.embedding_dim,))
+        if autograd.is_grad_enabled() and self.training:
+            table, lr = self.table, self._lr
+            flat_ids = ids.reshape(-1)
+
+            def vjp_fn(cot):
+                table.apply_grads(flat_ids, np.asarray(cot), lr)
+                gx = np.zeros(ids.shape, jax.dtypes.float0)
+                return (gx,)
+
+            node = autograd.GradNode(
+                "heter_embedding_grad", vjp_fn, [x], [False],
+                [(out.shape, out.dtype)], False)
+            t = Tensor(out, stop_gradient=False)
+            t._grad_node = node
+            t._output_index = 0
+            return t
+        return Tensor(out, stop_gradient=True)
+
+    def extra_repr(self):
+        return (f"{self.table.num_embeddings}x"
+                f"{self.table.embedding_dim}, "
+                f"cache={self.table.cache_rows}, "
+                f"hit_rate={self.table.hit_rate:.2f}")
+
+
+class HeterCache:
+    """Device cache in front of a PS client (heter_client.h analog):
+    ``pull(table, ids)`` serves hits locally and pulls only misses from
+    the PS; ``push`` forwards grads and invalidates touched rows."""
+
+    def __init__(self, comm, embedding_dim: int, cache_rows: int = 4096):
+        self._comm = comm
+        self.embedding_dim = int(embedding_dim)
+        self.cache_rows = int(cache_rows)
+        self._rows: Dict[str, Dict[int, np.ndarray]] = {}
+        self._order: Dict[str, list] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def pull_sparse(self, table: str, ids):
+        ids = np.asarray(ids).reshape(-1)
+        cache = self._rows.setdefault(table, {})
+        order = self._order.setdefault(table, [])
+        out = np.empty((ids.size, self.embedding_dim), np.float32)
+        missing, mpos = [], []
+        for i, rid in enumerate(ids):
+            rid = int(rid)
+            row = cache.get(rid)
+            if row is None:
+                missing.append(rid)
+                mpos.append(i)
+            else:
+                out[i] = row
+                self.hits += 1
+        if missing:
+            self.misses += len(missing)
+            pulled = np.asarray(self._comm.pull_sparse(table,
+                                                       np.asarray(missing)),
+                                np.float32)
+            for rid, row, i in zip(missing, pulled, mpos):
+                out[i] = row
+                if rid in cache:       # refreshed row keeps its order
+                    cache[rid] = row
+                    continue
+                cache[rid] = row
+                order.append(rid)
+                while len(cache) > self.cache_rows and order:
+                    cache.pop(order.pop(0), None)
+        return out
+
+    def push_sparse(self, table: str, ids, grads):
+        # write-through: the PS applies its SGD rule; drop stale copies
+        # AND their order entries (else re-pulled rows double-book the
+        # FIFO and the freshest rows evict first)
+        self._comm.push_sparse(table, ids, grads)
+        cache = self._rows.get(table, {})
+        dropped = set()
+        for rid in np.asarray(ids).reshape(-1):
+            rid = int(rid)
+            if cache.pop(rid, None) is not None:
+                dropped.add(rid)
+        if dropped and table in self._order:
+            self._order[table] = [r for r in self._order[table]
+                                  if r not in dropped]
+
+    def __getattr__(self, item):     # barrier(), save(), etc pass through
+        return getattr(self._comm, item)
