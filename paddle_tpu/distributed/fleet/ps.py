@@ -198,6 +198,8 @@ class PSServer:
         self._pending_load: Optional[str] = None
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     def add_dense_table(self, name: str, shape, rule=None):
         self._tables[name] = DenseTable(shape, rule=rule)
@@ -264,19 +266,32 @@ class PSServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                while True:
-                    msg = _recv_msg(self.request)
-                    if msg is None:
-                        return
-                    try:
-                        out = ("ok", outer._handle(msg))
-                    except Exception as e:  # surface errors to the client
-                        out = ("err", f"{type(e).__name__}: {e}")
-                    _send_msg(self.request, out)
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        if msg is None:
+                            return
+                        try:
+                            out = ("ok", outer._handle(msg))
+                        except Exception as e:  # surface to the client
+                            out = ("err", f"{type(e).__name__}: {e}")
+                        _send_msg(self.request, out)
+                except OSError:
+                    return          # connection severed by stop()
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
 
         socketserver.ThreadingTCPServer.allow_reuse_address = True
         self._server = socketserver.ThreadingTCPServer(
             (self._host, self._port), Handler)
+        # stop() must not hang on handler threads parked in recv() on
+        # live client connections: don't join them on server_close
+        # (reference brpc Stop() aborts in-flight RPCs the same way)
+        self._server.daemon_threads = True
+        self._server.block_on_close = False
         if self._pending_load:
             # restore this shard's tables from a fleet.init_server(path)
             shard_file = os.path.join(self._pending_load,
@@ -297,6 +312,17 @@ class PSServer:
     def stop(self):
         if self._server is not None:
             self._server.shutdown()
+            # sever in-flight connections so clients observe the death
+            # instead of being served by lingering handler threads
+            with self._conns_lock:
+                conns = list(self._conns)
+                self._conns.clear()
+            for c in conns:
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                c.close()
             self._server.server_close()
             self._server = None
 
@@ -310,8 +336,9 @@ class PSClient:
     shard across servers by ``key % n_servers``; dense tables live on
     ``hash(name) % n_servers``."""
 
-    def __init__(self, endpoints: List[str]):
+    def __init__(self, endpoints: List[str], timeout: float = 60.0):
         self._endpoints = list(endpoints)
+        self._timeout = float(timeout)
         self._socks: Dict[str, socket.socket] = {}
         # per-endpoint locks exist up-front so concurrent async pushes
         # can never race the lazy socket creation or interleave frames
@@ -325,11 +352,25 @@ class PSClient:
             if sock is None:
                 host, port = ep.rsplit(":", 1)
                 sock = socket.create_connection((host, int(port)),
-                                                timeout=60)
+                                                timeout=self._timeout)
                 self._socks[ep] = sock
-            _send_msg(sock, msg)
-            resp = _recv_msg(sock)
+            try:
+                _send_msg(sock, msg)
+                resp = _recv_msg(sock)
+            except socket.timeout as e:
+                # a wedged/killed server must surface, not hang forever
+                # (reference brpc RPC deadline semantics)
+                self._socks.pop(ep, None)
+                sock.close()
+                raise ConnectionError(
+                    f"ps server {ep} did not respond within "
+                    f"{self._timeout}s") from e
+            except OSError:
+                self._socks.pop(ep, None)
+                sock.close()
+                raise
         if resp is None:
+            self._socks.pop(ep, None)
             raise ConnectionError(f"ps server {ep} closed the connection")
         status, payload = resp
         if status != "ok":

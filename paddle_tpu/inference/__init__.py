@@ -141,8 +141,11 @@ class Config:
                 ("device", self._device),
                 ("precision", self._precision.name),
                 ("ir_optim (XLA)", self._ir_optim),
-                ("memory_optim", self._memory_optim),
-                ("cpu math threads", self._cpu_math_threads)]
+                ("memory_optim", f"{self._memory_optim} "
+                 "(no-op on TPU: XLA owns buffer reuse)"),
+                ("mkldnn", "no-op on TPU (XLA is the backend)"),
+                ("cpu math threads", f"{self._cpu_math_threads} "
+                 "(no-op on TPU)")]
         width = max(len(k) for k, _ in rows)
         return "\n".join(f"{k.ljust(width)}  {v}" for k, v in rows)
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from ..core.errors import UnimplementedError
 from .export import export as _onnx_export
+from .export import supported_ops  # noqa: F401
 
 __all__ = ["export"]
 

@@ -741,6 +741,17 @@ class Executor:
                             for n, a in feed_arrays.items())))
         fn = self._cache.get(key) if use_program_cache else None
         if fn is None:
+            if use_program_cache and self._cache:
+                # a NEW feed signature silently recompiles; surface it
+                # like the reference's FLAGS-gated program-cache logging
+                from ..utils import flags as _flags
+                if _flags.get_flag("FLAGS_log_recompile"):
+                    import sys as _sys
+                    print(f"[executor] recompiling program {program._id} "
+                          f"for new feed signature "
+                          f"{[(n, a.shape) for n, a in feed_arrays.items()]}"
+                          f" (cache size {len(self._cache)})",
+                          file=_sys.stderr)
             fn = _build_runner(program, fetch_names, written)
             if use_program_cache:
                 self._cache[key] = fn

@@ -90,6 +90,9 @@ define_flag("FLAGS_init_allocated_mem", False, "parity no-op")
 define_flag("FLAGS_default_dtype", "float32", "default floating dtype")
 define_flag("FLAGS_matmul_precision", "default",
             "jax matmul precision: default|high|highest")
+define_flag("FLAGS_log_recompile", False,
+            "announce Executor program recompiles on new feed "
+            "signatures (each new shape compiles a new XLA program)")
 
 # flags may arrive via env at import time — seed the dispatch fast path
 _refresh_debug_cache()

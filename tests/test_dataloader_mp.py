@@ -49,7 +49,7 @@ class BoomDataset(Dataset):
 @pytest.mark.parametrize("use_shared_memory", [True, False])
 def test_process_loader_order_and_content(use_shared_memory):
     ds = ArrayDataset()
-    loader = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False,
+    loader = DataLoader(ds, batch_size=4, num_workers=2, timeout=8.0, shuffle=False,
                         use_shared_memory=use_shared_memory)
     xs, idx = [], []
     for bx, bi in loader:
@@ -63,7 +63,8 @@ def test_process_loader_order_and_content(use_shared_memory):
 def test_workers_are_real_processes():
     import warnings
 
-    loader = DataLoader(PidDataset(), batch_size=2, num_workers=2)
+    loader = DataLoader(PidDataset(), batch_size=2, num_workers=2,
+                        timeout=8.0)
     pids, wids = set(), set()
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")

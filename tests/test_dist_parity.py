@@ -95,6 +95,7 @@ def _run(tmp_path, nproc, devices_per_proc, tag, trainer=None):
     return json.load(open(out))
 
 
+@pytest.mark.slow
 def test_single_vs_multiprocess_loss_parity(tmp_path):
     single = _run(tmp_path, 1, 4, "single")
     multi = _run(tmp_path, 2, 2, "multi")
@@ -151,6 +152,7 @@ if jax.process_index() == 0:
 """
 
 
+@pytest.mark.slow
 def test_mp_across_processes_loss_parity(tmp_path):
     """Megatron tensor parallel sharded across 2 launcher-spawned
     processes matches the single-process run (reference
@@ -211,6 +213,7 @@ if jax.process_index() == 0:
 """
 
 
+@pytest.mark.slow
 def test_pp_across_processes_loss_parity(tmp_path):
     """spmd_pipeline_1f1b sharded across 2 launcher-spawned processes
     (stage-to-stage ppermutes cross the process boundary) matches the

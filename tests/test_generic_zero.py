@@ -107,6 +107,7 @@ def test_sync_back_and_state_dict(mesh):
     assert all(np.isfinite(a).all() for a in sd["params"].values())
 
 
+@pytest.mark.slow
 def test_resnet_trains_with_sharding(mesh):
     paddle.seed(1)
     # resnet18 keeps the CPU test fast; same conv/bn/buffer machinery

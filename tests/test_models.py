@@ -62,6 +62,7 @@ def test_gpt_eager_trains():
     assert l1 < l0
 
 
+@pytest.mark.slow
 def test_spmd_step_single_vs_pipelined():
     """pp=2 pipelined step must produce the same loss as pp=1 on
     identical params (1-proc vs N-proc parity, test_dist_base style)."""

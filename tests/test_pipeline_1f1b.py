@@ -99,6 +99,7 @@ def test_1f1b_activation_footprint_is_o_stages():
     assert f"{2 * (S - 1) + 1},{mb},{T},{D}" in str(jaxpr).replace(" ", "")
 
 
+@pytest.mark.slow
 def test_gpt_spmd_1f1b_step_parity():
     """build_spmd_train_step(schedule_mode='1F1B') produces the same loss
     and updated params as the autodiff F-then-B path on a dp2/pp2/mp2

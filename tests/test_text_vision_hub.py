@@ -124,6 +124,7 @@ def test_uci_housing_local_file(tmp_path):
 
 
 # -- new vision models -------------------------------------------------------
+@pytest.mark.slow
 @pytest.mark.parametrize("factory,size,params_expected", [
     ("densenet121", 64, 6964106),
     ("resnext50_32x4d", 64, 23000394),
@@ -140,6 +141,7 @@ def test_vision_model_forward(factory, size, params_expected):
     assert n_params == params_expected
 
 
+@pytest.mark.slow
 def test_inception_v3_forward():
     from paddle_tpu.vision.models import inception_v3
     net = inception_v3(num_classes=10)
