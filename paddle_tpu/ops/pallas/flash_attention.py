@@ -33,10 +33,16 @@ __all__ = ["flash_attention"]
 
 NEG_INF = -1e30
 
+# Largest K-length whose full (T, T) score block comfortably fits VMEM
+# f32 alongside the resident K/V blocks — the "small-T" kernel regime.
+SMALL_T_MAX = 1024
+
 
 def _pallas_mode(seq_q: int, seq_k: int, causal: bool):
-    """(use_pallas, interpret) — static decision from shapes + env so the
-    forward and backward of one call always agree.
+    """(mode, interpret) — static decision from shapes + env so the
+    forward and backward of one call always agree.  mode is one of
+    "small" (full-K-resident batched kernel), "stream" (online-softmax
+    streaming kernel for long sequences), "xla" (fallback math).
 
     causal with seq_q > seq_k has fully-masked query rows whose lse
     degenerates to NEG_INF (float cancellation makes exp(s - lse) == 1 in
@@ -44,19 +50,22 @@ def _pallas_mode(seq_q: int, seq_k: int, causal: bool):
     path.
     """
     if causal and seq_q > seq_k:
-        return False, False
+        return "xla", False
+    aligned = seq_q % 128 == 0 and seq_k % 128 == 0
+    small = aligned and seq_k <= SMALL_T_MAX and seq_q <= SMALL_T_MAX
     if os.environ.get("PADDLE_PALLAS_FORCE") == "1":
-        ok = seq_q % 128 == 0 and seq_k % 128 == 0
-        return ok, jax.default_backend() == "cpu"
-    # measured on v5e (bf16, d=64, fwd+bwd): the kernel is at parity
-    # with XLA's fused attention from T=512 through T=8192 (XLA fuses
-    # attention into flash-like VMEM loops on TPU).  The kernel still
-    # earns its keep as the per-shard primitive ring attention composes
-    # over (sequence_parallel.py) and as the guaranteed-O(T) -memory
-    # path; keep the gate at long sequences
-    ok = (seq_q % 128 == 0 and seq_k % 128 == 0 and seq_k >= 1024
-          and jax.default_backend() not in ("cpu",))
-    return ok, False
+        if not aligned:
+            return "xla", False
+        return ("small" if small else "stream"), \
+            jax.default_backend() == "cpu"
+    if jax.default_backend() in ("cpu",) or not aligned:
+        return "xla", False
+    # v5e, bf16, d=64, B*H=1536 (profiled round 4): XLA's attention at
+    # T=512 materialises f32 (T, T) score tensors in the backward and
+    # costs ~21 ms/layer fwd+bwd; the small-T kernel pair (full-K
+    # resident, G batch-heads per grid step, one fused backward) beats
+    # it.  The streaming kernel owns the long-sequence regime.
+    return ("small" if small else "stream"), False
 
 
 # ---------------------------------------------------------------------------
@@ -163,6 +172,148 @@ def _flash_fwd(q, k, v, scale: float, causal: bool,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# small-T kernels: full K/V rows resident in VMEM, G batch-heads per grid
+# step.  At the flagship regime (T=512, d=64, B*H=1536) the streaming
+# kernels' grid has 1536+ steps of tiny matmuls and the per-step
+# DMA/bookkeeping dominates (~27 TFLOP/s effective, profiled r4); batching
+# G consecutive batch-heads per step amortises it, and with the whole row
+# in VMEM the softmax needs no online rescaling.
+# ---------------------------------------------------------------------------
+def _small_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float,
+                      causal: bool, block_q: int, seq_q: int, seq_k: int,
+                      G: int):
+    qi = pl.program_id(1)
+    offset = seq_k - seq_q
+    for g in range(G):
+        q = q_ref[g]                                     # (bq, d)
+        k = k_ref[g]                                     # (Tk, d)
+        v = v_ref[g]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, Tk)
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + qi * block_q + offset
+            cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_ref[g] = (pv / l).astype(o_ref.dtype)
+
+
+def _small_flash_fwd(q, k, v, scale: float, causal: bool,
+                     block_q: int = 512, G: int = None,
+                     interpret: bool = False):
+    """q/k/v: (BH, T, d) -> out (BH, T, d).  No lse output: the fused
+    backward rebuilds it from the inputs, so the custom_vjp residuals
+    are pure inputs and remat policies never re-run this kernel."""
+    if G is None:
+        G = int(os.environ.get("PADDLE_FLASH_G_FWD", "8"))
+    BH, T, d = q.shape
+    Tk = k.shape[1]
+    block_q, _ = _block_sizes(T, Tk, block_q, Tk)
+    # scale the head-batching down as the resident (block_q, Tk) score
+    # block grows so the per-step VMEM footprint stays ~flat
+    G = max(1, min(G, (8 * 512 * 512) // (block_q * Tk)))
+    while BH % G:
+        G //= 2
+    grid = (BH // G, T // block_q)
+    kernel = functools.partial(_small_fwd_kernel, scale=scale,
+                               causal=causal, block_q=block_q,
+                               seq_q=T, seq_k=Tk, G=G)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((G, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((G, Tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((G, Tk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((G, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _small_bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
+                      *, scale: float, causal: bool, seq_q: int,
+                      seq_k: int, G: int):
+    offset = seq_k - seq_q
+    for g in range(G):
+        q = q_ref[g]                                     # (T, d)
+        k = k_ref[g]
+        v = v_ref[g]
+        do = do_ref[g]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (T, Tk)
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, s.shape, 0) + offset
+            cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            live = rows >= cols
+            s = jnp.where(live, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        l = jnp.sum(e, axis=-1, keepdims=True)
+        p = e / l                                        # softmax, f32
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (T, Tk)
+        # delta_i = sum_j p_ij dp_ij  (== rowsum(dO * O), derived
+        # in-kernel so O need not be a residual)
+        delta = jnp.sum(p * dp, axis=-1, keepdims=True)
+        pb = p.astype(do.dtype)
+        dv_ref[g] = jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        ds = (p * (dp - delta)).astype(q.dtype)          # (T, Tk)
+        dq_ref[g] = (scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)).astype(dq_ref.dtype)
+        dk_ref[g] = (scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)).astype(dk_ref.dtype)
+
+
+def _small_flash_bwd(q, k, v, do, scale: float, causal: bool,
+                     G: int = None, interpret: bool = False):
+    """One fused kernel: dq/dk/dv from (q, k, v, do) alone — lse and
+    delta are rebuilt in-VMEM (2 extra vector passes, zero extra
+    matmuls vs. the 7 the two-kernel streaming backward spends)."""
+    if G is None:
+        G = int(os.environ.get("PADDLE_FLASH_G_BWD", "2"))
+    BH, T, d = q.shape
+    Tk = k.shape[1]
+    # the backward holds several f32 (T, Tk) intermediates per unrolled
+    # group; shrink G as the row grows so VMEM stays bounded
+    G = max(1, min(G, (2 * 512 * 512) // (T * Tk)))
+    while BH % G:
+        G //= 2
+    kernel = functools.partial(_small_bwd_kernel, scale=scale,
+                               causal=causal, seq_q=T, seq_k=Tk, G=G)
+    qs = pl.BlockSpec((G, T, d), lambda b: (b, 0, 0))
+    ks = pl.BlockSpec((G, Tk, d), lambda b: (b, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(BH // G,),
+        in_specs=[qs, ks, ks, qs],
+        out_specs=[qs, ks, ks],
+        out_shape=[jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+                   jax.ShapeDtypeStruct((BH, Tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tk, d), v.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(q, k, v, do)
 
 
 # ---------------------------------------------------------------------------
@@ -336,16 +487,25 @@ def _xla_attention(q, k, v, scale, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, scale, causal):
-    use_pallas, interpret = _pallas_mode(q.shape[1], k.shape[1], causal)
-    if use_pallas:
+    mode, interpret = _pallas_mode(q.shape[1], k.shape[1], causal)
+    if mode == "small":
+        return _small_flash_fwd(q, k, v, scale, causal,
+                                interpret=interpret)
+    if mode == "stream":
         out, _ = _flash_fwd(q, k, v, scale, causal, interpret=interpret)
         return out
     return _xla_attention(q, k, v, scale, causal).astype(q.dtype)
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal):
-    use_pallas, interpret = _pallas_mode(q.shape[1], k.shape[1], causal)
-    if use_pallas:
+    mode, interpret = _pallas_mode(q.shape[1], k.shape[1], causal)
+    if mode == "small":
+        # residuals are the raw inputs: under remat they rebuild from
+        # the (cheap) qkv projection, never by re-running the kernel
+        out = _small_flash_fwd(q, k, v, scale, causal,
+                               interpret=interpret)
+        return out, (q, k, v, None, None)
+    if mode == "stream":
         out, lse = _flash_fwd(q, k, v, scale, causal, interpret=interpret)
         return out, (q, k, v, out, lse)
     return _xla_attention(q, k, v, scale, causal).astype(q.dtype), \
@@ -354,8 +514,11 @@ def _flash_vjp_fwd(q, k, v, scale, causal):
 
 def _flash_vjp_bwd(scale, causal, res, g):
     q, k, v, o, lse = res
-    use_pallas, interpret = _pallas_mode(q.shape[1], k.shape[1], causal)
-    if use_pallas and lse is not None:
+    mode, interpret = _pallas_mode(q.shape[1], k.shape[1], causal)
+    if mode == "small":
+        return _small_flash_bwd(q, k, v, g, scale, causal,
+                                interpret=interpret)
+    if mode == "stream" and lse is not None:
         return _flash_bwd(q, k, v, o, lse, g, scale, causal,
                           interpret=interpret)
     _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, scale, causal)

@@ -36,7 +36,8 @@ def _ref_attention(q, k, v, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("tq,tk", [(256, 256), (128, 256)])
+@pytest.mark.parametrize("tq,tk", [(256, 256), (128, 256), (512, 512),
+                                   (1024, 1024), (1152, 1152), (640, 1280)])
 def test_flash_fwd_bwd_vs_xla(force_pallas, causal, tq, tk):
     rs = np.random.RandomState(0)
     B, H, D = 2, 2, 64
@@ -81,13 +82,21 @@ def test_flash_under_jit(force_pallas):
                                atol=5e-5)
 
 
-def test_causal_cross_attention_gated_off():
+def test_causal_cross_attention_gated_off(monkeypatch):
     # causal with seq_q > seq_k degenerates (fully-masked rows) — must
     # stay on the XLA path regardless of the force flag
-    use, _ = fa._pallas_mode(384, 128, True)
-    assert not use
-    use, _ = fa._pallas_mode(128, 384, True)   # kv-cache decode shape: ok
-    assert use or jax.default_backend() == "cpu"
+    mode, _ = fa._pallas_mode(384, 128, True)
+    assert mode == "xla"
+    mode, _ = fa._pallas_mode(128, 384, True)  # kv-cache decode shape: ok
+    if jax.default_backend() == "cpu":
+        assert mode == "xla"
+    else:
+        assert mode == "small"
+    # regime split: short sequences take the full-K-resident kernels,
+    # long ones the online-softmax streaming kernels
+    monkeypatch.setenv("PADDLE_PALLAS_FORCE", "1")
+    assert fa._pallas_mode(512, 512, True)[0] == "small"
+    assert fa._pallas_mode(2048, 2048, True)[0] == "stream"
 
 
 def test_lse_matches_logsumexp(force_pallas):

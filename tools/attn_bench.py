@@ -1,0 +1,80 @@
+"""Bench the routed flash_attention (mode gate as shipped) vs XLA math
+across T, using device-time-truthful big-loop timing: run N calls inside
+one jit (lax.scan chaining) so per-dispatch tunnel overhead amortizes.
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def chain_bench(f, args, iters=8):
+    """loss-like scalar chained through iterations inside ONE jit."""
+    def body(c, _):
+        out = f(*[a + c.astype(a.dtype) for a in args])
+        return jnp.sum(out.astype(jnp.float32)) * 1e-20, None
+
+    @jax.jit
+    def run(args):
+        c, _ = lax.scan(body, jnp.zeros(()), None,
+                        length=iters)
+        return c
+
+    r = run(args)
+    float(r)
+    t0 = time.perf_counter()
+    r = run(args)
+    float(r)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--B", type=int, default=128)
+    ap.add_argument("--H", type=int, default=12)
+    ap.add_argument("--d", type=int, default=64)
+    ap.add_argument("--Ts", default="512,1024")
+    ap.add_argument("--grad", action="store_true")
+    args = ap.parse_args()
+    import importlib
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+    for T in [int(t) for t in args.Ts.split(",")]:
+        B = args.B * 512 // T  # constant tokens
+        rng = np.random.RandomState(0)
+        shape = (B, T, args.H, args.d)
+        q = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+        flops = 2 * 2 * B * args.H * T * T * args.d
+
+        def pall(q):
+            return fa.flash_attention(q, q, q, causal=True)
+
+        def xla(q):
+            qf = jnp.swapaxes(q, 1, 2).reshape(B * args.H, T, args.d)
+            o = fa._xla_attention(qf, qf, qf, 1.0 / np.sqrt(args.d), True)
+            return jnp.swapaxes(o.reshape(B, args.H, T, args.d), 1, 2)
+
+        for name, f in [("pallas", pall), ("xla", xla)]:
+            if args.grad:
+                g = lambda q, f=f: jax.grad(
+                    lambda x: jnp.sum(f(x).astype(jnp.float32)))(q)
+                t = chain_bench(g, (q,))
+                eff = 3 * flops / t / 1e12
+            else:
+                t = chain_bench(f, (q,))
+                eff = flops / t / 1e12
+            print(f"T={T:5d} B={B:4d} {name:7s} "
+                  f"{'fwd+bwd' if args.grad else 'fwd':7s} "
+                  f"{t*1e3:8.2f} ms  ({eff:5.1f} T eff)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Per-op device profile of the flagship BERT-base training step.
+
+Runs the exact bench.py config under jax.profiler.trace and converts the
+xplane capture to an HLO-op-level time breakdown (xprof's hlo_op_stats),
+printing a table of where the step's wall-clock actually goes — the
+profile the round-3 verdict asked to commit alongside BASELINE.md.
+
+Usage: python tools/profile_bert.py [--remat ctx] [--batch 128] [--steps 5]
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(remat: str, batch: int, steps: int, logdir: str,
+            seq: int = 512) -> float:
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.topology import build_mesh
+    from paddle_tpu.models import GPTConfig
+    from paddle_tpu.models.gpt_spmd import build_spmd_train_step
+
+    cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=seq)
+    mesh = build_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step, init_fn = build_spmd_train_step(cfg, mesh,
+                                          compute_dtype=jnp.bfloat16,
+                                          remat_policy=remat)
+    params, opt_state = init_fn(seed=0)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    loss, params, opt_state = step(params, opt_state, ids, labels)
+    float(loss)
+    jax.block_until_ready(params)
+
+    import time
+    with jax.profiler.trace(logdir):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, params, opt_state = step(params, opt_state, ids, labels)
+        float(loss)
+        jax.block_until_ready(params)
+        dt = time.perf_counter() - t0
+    print(f"[capture] {steps} steps in {dt:.3f}s -> "
+          f"{batch * steps / dt:.1f} seq/s", file=sys.stderr)
+    return dt
+
+
+def summarize(logdir: str, top: int = 40):
+    from xprof.convert import raw_to_tool_data as rtd
+    paths = sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not paths:
+        print("no xplane.pb captured", file=sys.stderr)
+        return None
+    data, _ = rtd.xspace_to_tool_data([paths[-1]], "hlo_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", "replace")
+    return data
+
+
+def print_table(text: str, top: int):
+    # gviz JSON: {"cols": [{id,label},...], "rows": [{"c": [{"v": ...}]}]}
+    tbl = json.loads(text)
+    ids = [c["id"] for c in tbl["cols"]]
+    agg = {}
+    for row in tbl["rows"]:
+        r = {i: (c or {}).get("v") for i, c in zip(ids, row["c"])}
+        cat = r.get("category") or "?"
+        name = (r.get("hlo_op_expression") or r.get("hlo_op_name") or "?")
+        t = float(r.get("total_self_time") or 0.0)
+        occ = int(r.get("occurrences") or 0)
+        key = (cat, name[:110])
+        a = agg.setdefault(key, [0.0, 0])
+        a[0] += t
+        a[1] += occ
+    total = sum(a[0] for a in agg.values()) or 1.0
+    print(f"{'%':>6} {'self-us':>12} {'occ':>6}  category / op")
+    cat_tot = {}
+    for (cat, _), a in agg.items():
+        cat_tot[cat] = cat_tot.get(cat, 0.0) + a[0]
+    print("== by category ==")
+    for cat, t in sorted(cat_tot.items(), key=lambda kv: -kv[1]):
+        print(f"{100*t/total:6.2f} {t:12.0f}        {cat}")
+    print("== top ops ==")
+    for (cat, name), a in sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]:
+        print(f"{100*a[0]/total:6.2f} {a[0]:12.0f} {a[1]:6d}  [{cat}] {name}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--remat", default="ctx")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--logdir", default="/tmp/bert_profile")
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--reuse", action="store_true",
+                    help="skip capture, summarize existing logdir")
+    args = ap.parse_args()
+    if not args.reuse:
+        os.makedirs(args.logdir, exist_ok=True)
+        capture(args.remat, args.batch, args.steps, args.logdir, args.seq)
+    data = summarize(args.logdir)
+    if data:
+        print_table(data, args.top)
+
+
+if __name__ == "__main__":
+    main()
