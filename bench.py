@@ -99,12 +99,19 @@ def main():
 
     seq_per_sec = B * steps / best_dt
     target = 0.8 * 107.0  # see module docstring
+    # model FLOPs utilization: fwd+bwd matmul+attention flops only (no
+    # remat recompute counted — the standard MFU convention), against
+    # peak 197 bf16 TFLOP/s for one v5e chip
+    D, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    flops_per_tok = 6 * (L * 12 * D * D + D * V) + 12 * L * T * D
+    mfu = seq_per_sec * T * flops_per_tok / 197e12
     result = {
         "metric": f"gpt_bert_base_train_seq_per_sec_per_chip[{backend}]"
         if on_tpu else f"gpt_small_train_seq_per_sec[{backend}]",
         "value": round(seq_per_sec, 2),
         "unit": "seq/s",
         "vs_baseline": round(seq_per_sec / target, 3),
+        "mfu": round(mfu, 3),
     }
     try:
         result["extra"] = {"resnet50": bench_resnet(on_tpu)}

@@ -29,7 +29,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_qkv"]
 
 NEG_INF = -1e30
 
@@ -242,6 +242,198 @@ def _small_flash_fwd(q, k, v, scale: float, causal: bool,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# packed-QKV small-T kernels: consume the raw (B, T, 3*H*d) projection
+# output directly.  Each grid step takes one 128-lane column block
+# (= 128//d heads, e.g. a head pair at d=64) of q, k and v, slicing the
+# per-head (rows, d) operands in VMEM.  Zero transposes or head-split
+# copies materialise in HBM (profiled r4: those cost ~14% of the train
+# step), and the backward writes the d(qkv) cotangent blocks the
+# projection matmul's vjp consumes.
+# ---------------------------------------------------------------------------
+def _qkv_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float,
+                    causal: bool, block_q: int, seq_q: int, seq_k: int,
+                    G: int, P: int, d: int):
+    qi = pl.program_id(2)
+    offset = seq_k - seq_q
+    for g in range(G):
+        for h in range(P):
+            q = q_ref[g][:, h * d:(h + 1) * d]           # (bq, d)
+            k = k_ref[g][:, h * d:(h + 1) * d]           # (Tk, d)
+            v = v_ref[g][:, h * d:(h + 1) * d]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                rows = lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                    + qi * block_q + offset
+                cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.exp(s - m)
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            o_ref[g, :, h * d:(h + 1) * d] = (pv / l).astype(o_ref.dtype)
+
+
+def _qkv_bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
+                    *, scale: float, causal: bool, seq_q: int, seq_k: int,
+                    G: int, P: int, d: int):
+    offset = seq_k - seq_q
+    for g in range(G):
+        for h in range(P):
+            q = q_ref[g][:, h * d:(h + 1) * d]           # (T, d)
+            k = k_ref[g][:, h * d:(h + 1) * d]
+            v = v_ref[g][:, h * d:(h + 1) * d]
+            do = do_ref[g][:, h * d:(h + 1) * d]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                rows = lax.broadcasted_iota(jnp.int32, s.shape, 0) + offset
+                cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            e = jnp.exp(s - m)
+            l = jnp.sum(e, axis=-1, keepdims=True)
+            p = e / l
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            delta = jnp.sum(p * dp, axis=-1, keepdims=True)
+            pb = p.astype(do.dtype)
+            dv_ref[g, :, h * d:(h + 1) * d] = jax.lax.dot_general(
+                pb, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+            ds = (p * (dp - delta)).astype(q.dtype)
+            dq_ref[g, :, h * d:(h + 1) * d] = (scale * jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)).astype(dq_ref.dtype)
+            dk_ref[g, :, h * d:(h + 1) * d] = (scale * jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)).astype(dk_ref.dtype)
+
+
+def _qkv_small_fwd(qkv, num_heads: int, scale: float, causal: bool,
+                   block_q: int = 512, G: int = None,
+                   interpret: bool = False):
+    """qkv: (B, T, 3*H*d) head-major packed -> ctx (B, T, H*d)."""
+    if G is None:
+        G = int(os.environ.get("PADDLE_FLASH_G_FWD", "4"))
+    B, T, F3 = qkv.shape
+    F = F3 // 3
+    d = F // num_heads
+    P = 128 // d                       # heads per 128-lane column block
+    HP = num_heads // P                # column blocks per tensor
+    block_q, _ = _block_sizes(T, T, block_q, T)
+    G = max(1, min(G, (4 * 512 * 512) // (block_q * T)))
+    while B % G:
+        G //= 2
+    grid = (B // G, HP, T // block_q)
+    kernel = functools.partial(_qkv_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, seq_q=T, seq_k=T, G=G,
+                               P=P, d=d)
+
+    def col(base):
+        return lambda b, hp, i: (b, 0, base + hp)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((G, block_q, 128),
+                         lambda b, hp, i: (b, i, hp)),
+            pl.BlockSpec((G, T, 128), col(HP)),
+            pl.BlockSpec((G, T, 128), col(2 * HP)),
+        ],
+        out_specs=pl.BlockSpec((G, block_q, 128),
+                               lambda b, hp, i: (b, i, hp)),
+        out_shape=jax.ShapeDtypeStruct((B, T, F), qkv.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qkv, qkv, qkv)
+
+
+def _qkv_small_bwd(qkv, do, num_heads: int, scale: float, causal: bool,
+                   G: int = None, interpret: bool = False):
+    """-> (dq, dk, dv) each (B, T, H*d); caller concatenates to dqkv."""
+    if G is None:
+        G = int(os.environ.get("PADDLE_FLASH_G_BWD", "2"))
+    B, T, F3 = qkv.shape
+    F = F3 // 3
+    d = F // num_heads
+    P = 128 // d
+    HP = num_heads // P
+    G = max(1, min(G, (2 * 512 * 512) // (T * T)))
+    while B % G:
+        G //= 2
+    kernel = functools.partial(_qkv_bwd_kernel, scale=scale, causal=causal,
+                               seq_q=T, seq_k=T, G=G, P=P, d=d)
+
+    def col(base):
+        return lambda b, hp: (b, 0, base + hp)
+
+    out_spec = pl.BlockSpec((G, T, 128), lambda b, hp: (b, 0, hp))
+    return pl.pallas_call(
+        kernel,
+        grid=(B // G, HP),
+        in_specs=[pl.BlockSpec((G, T, 128), col(0)),
+                  pl.BlockSpec((G, T, 128), col(HP)),
+                  pl.BlockSpec((G, T, 128), col(2 * HP)),
+                  pl.BlockSpec((G, T, 128), lambda b, hp: (b, 0, hp))],
+        out_specs=[out_spec, out_spec, out_spec],
+        out_shape=[jax.ShapeDtypeStruct((B, T, F), qkv.dtype)] * 3,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(qkv, qkv, qkv, do)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _flash_qkv(qkv, num_heads, scale, causal):
+    _, interpret = _pallas_mode(qkv.shape[1], qkv.shape[1], causal)
+    return _qkv_small_fwd(qkv, num_heads, scale, causal,
+                          interpret=interpret)
+
+
+def _flash_qkv_vjp_fwd(qkv, num_heads, scale, causal):
+    return _flash_qkv(qkv, num_heads, scale, causal), qkv
+
+
+def _flash_qkv_vjp_bwd(num_heads, scale, causal, qkv, g):
+    _, interpret = _pallas_mode(qkv.shape[1], qkv.shape[1], causal)
+    dq, dk, dv = _qkv_small_bwd(qkv, g, num_heads, scale, causal,
+                                interpret=interpret)
+    return (jnp.concatenate([dq, dk, dv], axis=-1),)
+
+
+_flash_qkv.defvjp(_flash_qkv_vjp_fwd, _flash_qkv_vjp_bwd)
+
+
+def flash_attention_qkv(qkv, num_heads: int, *, causal: bool = False,
+                        scale=None):
+    """Attention straight from the fused projection output.
+
+    qkv: (B, T, 3*H*d) laid out [q_h0 .. q_h{H-1} | k_h0 .. | v_h0 ..]
+    (the ``reshape(B, T, 3H, d)`` + ``split`` convention) -> ctx
+    (B, T, H*d), ready for the output projection.  Falls back to the
+    split + generic path when the packed small-T kernels don't apply.
+    """
+    B, T, F3 = qkv.shape
+    d = F3 // 3 // num_heads
+    s = float(scale) if scale is not None else float(1.0 / np.sqrt(d))
+    mode, _ = _pallas_mode(T, T, causal)
+    if mode == "small" and d in (32, 64, 128) and \
+            num_heads % max(1, 128 // d) == 0:
+        return _flash_qkv(qkv, num_heads, s, causal)
+    q, k, v = jnp.split(qkv.reshape(B, T, 3 * num_heads, d), 3, axis=2)
+    out = flash_attention(q, k, v, causal=causal, scale=scale)
+    return out.reshape(B, T, num_heads * d)
 
 
 def _small_bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
@@ -530,7 +722,14 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale=None):
-    """q/k/v: (B, S, H, D) paddle layout -> (B, S, H, D)."""
+    """q/k/v: (B, S, H, D) paddle layout -> (B, S, H, D).
+
+    All modes go through the folded (B*H, T, d) layout — TPU tiling
+    forbids blocking the head dim of (B, T, H, d) directly (the last
+    two array dims must tile (8, 128)).  Models that want the
+    transpose-free hot path should call :func:`flash_attention_qkv`
+    on the fused projection output instead.
+    """
     B, T, H, D = q.shape
     Tk = k.shape[1]
     s = float(scale) if scale is not None else float(1.0 / np.sqrt(D))

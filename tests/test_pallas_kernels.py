@@ -99,6 +99,28 @@ def test_causal_cross_attention_gated_off(monkeypatch):
     assert fa._pallas_mode(2048, 2048, True)[0] == "stream"
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_qkv_packed(force_pallas, causal):
+    # packed projection-output entry: same numbers as split + generic
+    rs = np.random.RandomState(3)
+    B, T, H, D = 2, 256, 4, 64
+    qkv = jnp.asarray(rs.rand(B, T, 3 * H * D), jnp.float32)
+    out = fa.flash_attention_qkv(qkv, H, causal=causal)
+    q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, D), 3, axis=2)
+    ref = _ref_attention(q, k, v, causal).reshape(B, T, H * D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    g = jnp.asarray(rs.rand(B, T, H * D), jnp.float32)
+    dqkv = jax.vjp(lambda a: fa.flash_attention_qkv(a, H, causal=causal),
+                   qkv)[1](g)[0]
+    ref_d = jax.vjp(
+        lambda a: _ref_attention(
+            *jnp.split(a.reshape(B, T, 3 * H, D), 3, axis=2),
+            causal).reshape(B, T, H * D), qkv)[1](g)[0]
+    np.testing.assert_allclose(np.asarray(dqkv), np.asarray(ref_d),
+                               atol=5e-5)
+
+
 def test_lse_matches_logsumexp(force_pallas):
     rs = np.random.RandomState(2)
     BH, T, D = 2, 256, 32
