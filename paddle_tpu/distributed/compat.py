@@ -146,9 +146,14 @@ class _DatasetBase:
             if shell_cmd:
                 # one subprocess per file, exactly the reference shape
                 # (framework/data_feed.cc fp_ = shell_popen)
-                proc = subprocess.Popen(
-                    shell_cmd, shell=True, stdin=open(path, "rb"),
-                    stdout=subprocess.PIPE, text=True)
+                fin = open(path, "rb")
+                try:
+                    proc = subprocess.Popen(
+                        shell_cmd, shell=True, stdin=fin,
+                        stdout=subprocess.PIPE, text=True)
+                except BaseException:
+                    fin.close()
+                    raise
                 finished = False
                 try:
                     for line in proc.stdout:
@@ -156,6 +161,7 @@ class _DatasetBase:
                     finished = True
                 finally:
                     proc.stdout.close()
+                    fin.close()
                     rc = proc.wait()
                     # early consumer exit (GeneratorExit) kills the
                     # child via SIGPIPE — only a rc on a run we read to

@@ -66,7 +66,7 @@ class HeterEmbeddingTable:
         self._lock = threading.RLock()
         self.hits = 0
         self.misses = 0
-        self._prefetch_thread: Optional[threading.Thread] = None
+        self._prefetch_threads: list = []
 
     # -- cache mechanics ---------------------------------------------------
     def _admit(self, row_ids: np.ndarray):
@@ -137,13 +137,20 @@ class HeterEmbeddingTable:
 
         t = threading.Thread(target=work, daemon=True)
         t.start()
-        self._prefetch_thread = t
+        # prune finished threads so fire-and-forget callers (who rely on
+        # the table lock, never calling wait_prefetch) don't accumulate
+        self._prefetch_threads = [
+            p for p in self._prefetch_threads if p.is_alive()]
+        self._prefetch_threads.append(t)
         return t
 
     def wait_prefetch(self):
-        if self._prefetch_thread is not None:
-            self._prefetch_thread.join()
-            self._prefetch_thread = None
+        # join ALL outstanding prefetches, not just the latest — an
+        # earlier still-running admission thread must not keep mutating
+        # the cache after this returns
+        threads, self._prefetch_threads = self._prefetch_threads, []
+        for t in threads:
+            t.join()
 
     # -- sparse update ------------------------------------------------------
     def apply_grads(self, ids, grads, lr: float):

@@ -154,18 +154,48 @@ class SparseTable:
 
 
 # ---------------------------------------------------------------------------
-# wire protocol: 8-byte length prefix + pickle
+# wire protocol: 16-byte header (magic + version + length) + pickle.
+#
+# TRUSTED NETWORKS ONLY: the payload is pickle (unpickling is code
+# execution by construction — brpc gives the reference typed protobuf
+# messages; this shim trades that for zero deps).  Deploy the PS only
+# on a private interconnect, exactly like the reference's brpc endpoints
+# which are also unauthenticated within the cluster.  The header bounds
+# what a confused/hostile peer can make us allocate: bad magic/version
+# or an oversized frame tears the connection down instead of OOMing.
 # ---------------------------------------------------------------------------
+_WIRE_MAGIC = 0x50505354          # "PPST"
+_WIRE_VERSION = 1
+# generous for sparse-embedding batches (dense pulls of a 1 GB table
+# would exceed this by design — shard the table instead)
+MAX_FRAME_BYTES = int(os.environ.get("PADDLE_PS_MAX_FRAME",
+                                     256 * 1024 * 1024))
+
+
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=4)
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"PS message of {len(payload)} bytes exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}); shard the request or raise "
+            "PADDLE_PS_MAX_FRAME")
+    sock.sendall(struct.pack("<IIQ", _WIRE_MAGIC, _WIRE_VERSION,
+                             len(payload)) + payload)
 
 
 def _recv_msg(sock):
-    header = _recv_exact(sock, 8)
+    header = _recv_exact(sock, 16)
     if header is None:
         return None
-    (n,) = struct.unpack("<Q", header)
+    magic, version, n = struct.unpack("<IIQ", header)
+    if magic != _WIRE_MAGIC or version != _WIRE_VERSION:
+        raise ConnectionError(
+            f"PS wire: bad frame header (magic={magic:#x}, "
+            f"version={version}) — peer is not a paddle_tpu PS v1")
+    if n > MAX_FRAME_BYTES:
+        raise ConnectionError(
+            f"PS wire: frame of {n} bytes exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}); refusing to allocate")
     body = _recv_exact(sock, n)
     return pickle.loads(body) if body is not None else None
 

@@ -379,13 +379,22 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
                 loss, (dh, dout) = jax.value_and_grad(
                     head_loss, argnums=(0, 1))(hp, out_mb)
                 return loss, dout, dh
-            return spmd_pipeline_1f1b(
+            loss, dbp, dxi, dhp = spmd_pipeline_1f1b(
                 maybe_remat(block_fn), bp, xi, lab, last_fn,
                 axis="pp", num_stages=pp, num_microbatches=M)
+            if use_sp:
+                # each sp shard saw only its sequence slice: loss and the
+                # (replicated-per-shard) block/head grads are partials —
+                # reduce over sp (dxi stays sharded: it IS per-slice)
+                loss = lax.psum(loss, "sp")
+                dbp = jax.tree.map(lambda a: lax.psum(a, "sp"), dbp)
+                dhp = jax.tree.map(lambda a: lax.psum(a, "sp"), dhp)
+            return loss, dbp, dxi, dhp
 
+        lab_spec = P(None, None, "sp") if use_sp else P(None)
         loss, dblocks, dx, dhead = jax.shard_map(
             run, mesh=mesh,
-            in_specs=(P("pp"), x_spec, P(None), P()),
+            in_specs=(P("pp"), x_spec, lab_spec, P()),
             out_specs=(P(), P("pp"), x_spec, P()),
             axis_names={"pp"} | ({"sp"} if use_sp else set()),
             check_vma=False)(cp["blocks"], x, labels_m, head)

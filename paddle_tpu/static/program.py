@@ -490,13 +490,21 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                   if not v.stop_gradient and n not in no_grad}
     diff_feeds |= {getattr(v, "name", v) for v in _diff_vars}
 
-    # pass 1 (forward): vars transitively depending on a trainable input
+    # pass 1 (forward): vars transitively depending on a trainable input.
+    # while-op outputs propagate into `dep` ONLY for taint tracking: if
+    # the loss turns out to depend on one (pass 2), append_backward
+    # raises instead of silently dropping that gradient path — the
+    # reference while_op IS differentiable (while_grad,
+    # operators/controlflow/while_op.cc); this runtime's is not.
     dep = set(trainable) | diff_feeds
+    while_tainted: set = set()
     compute_ops = [op for op in prog.ops if op.kind == "compute"]
     for op in compute_ops:
         if op.type == "while":
-            continue    # XLA while has no reverse-mode; outputs are
-                        # stop-gradient (static/control_flow.py docstring)
+            if any(n in dep for n in op.input_names):
+                while_tainted.update(op.output_names)
+                dep.update(op.output_names)
+            continue
         if any(n in dep for n in op.input_names):
             dep.update(op.output_names)
 
@@ -510,11 +518,18 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     relevant: List[OpDesc] = []
     for op in reversed(compute_ops):
         if op.type == "while":
-            continue
+            continue   # tainted outputs are caught after the pass
         if any(o in need for o in op.output_names) and \
                 any(i in dep for i in op.input_names):
             relevant.append(op)
             need.update(i for i in op.input_names if i in dep)
+    if while_tainted & need:
+        raise RuntimeError(
+            "append_backward: the loss depends on while-op outputs "
+            f"{sorted(while_tainted & need)} whose gradient is not "
+            "defined in this runtime (XLA while has no reverse-mode). "
+            "Rewrite the loop with a bounded construct that lowers to "
+            "lax.scan, or stop_gradient its inputs explicitly.")
 
     # seed: d(loss)/d(loss) = 1 (reference emits fill_constant for this)
     seed_name = _grad_name(loss.name)
@@ -830,8 +845,17 @@ class Executor:
         widths = []
         for v in use_vars:
             shp = getattr(v, "shape", None)
-            widths.append(int(np.prod([s for s in shp[1:]])) if shp and
-                          len(shp) > 1 else 1)
+            if shp and len(shp) > 1:
+                tail = list(shp[1:])
+                if any(s is None or int(s) < 0 for s in tail):
+                    raise ValueError(
+                        f"train_from_dataset: feed var "
+                        f"'{getattr(v, 'name', v)}' has non-concrete "
+                        f"non-batch dims {shp} — slot widths for raw "
+                        "line parsing need fixed per-sample shapes")
+                widths.append(int(np.prod(tail)))
+            else:
+                widths.append(1)
 
         def parse_line(line):
             vals = [float(t) for t in line.split()]

@@ -736,12 +736,29 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     scale = np.sqrt(np.clip(w * h, 0, None))
     lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    # per-image grouping: with rois_num = [B] counts, each level's
+    # rois_num_per_level is the per-image [B] count at that level
+    # (reference groups by LoD/RoisNum, distribute_fpn_proposals_op.h:47)
+    if rois_num is not None:
+        per_img = _np(rois_num).reshape(-1).astype(np.int64)
+        img_of = np.repeat(np.arange(per_img.size), per_img)
+        if img_of.size != rois.shape[0]:
+            raise ValueError(
+                f"rois_num sums to {int(per_img.sum())} but fpn_rois has "
+                f"{rois.shape[0]} rows")
+    else:
+        per_img = None
+        img_of = None
     multi, nums, order = [], [], []
     for L in range(min_level, max_level + 1):
         idx = np.where(lvl == L)[0]
         multi.append(Tensor(jnp.asarray(rois[idx])))
-        nums.append(Tensor(jnp.asarray(
-            np.asarray([idx.size], np.int32))))
+        if per_img is not None:
+            counts = np.bincount(img_of[idx], minlength=per_img.size)
+            nums.append(Tensor(jnp.asarray(counts.astype(np.int32))))
+        else:
+            nums.append(Tensor(jnp.asarray(
+                np.asarray([idx.size], np.int32))))
         order.append(idx)
     order = np.concatenate(order) if order else np.zeros(0, np.int64)
     restore = np.empty_like(order)

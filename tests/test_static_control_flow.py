@@ -260,3 +260,24 @@ def test_full_like_symbolic_fill_value(static_mode):
     r = exe.run(prog, feed={"x": np.zeros(3, np.float32),
                             "v": np.float32(2.5)}, fetch_list=[out])
     assert float(r[0]) == 7.5
+
+
+def test_while_on_grad_path_raises(static_mode):
+    """A while op on the loss->param path must fail LOUDLY in
+    append_backward (this runtime's while has no reverse-mode; the
+    reference while_op is differentiable) instead of silently training
+    with dropped gradients."""
+    import pytest
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [4, 8], "float32")
+        h = paddle.static.nn.fc(x, 4, bias_attr=False)  # trainable w
+        i = paddle.full([], 0, "int32")
+        acc = paddle.zeros_like(h)
+        _, acc = paddle.static.nn.while_loop(
+            lambda i, a: i < 3,
+            lambda i, a: [i + 1, a + h],
+            [i, acc])
+        loss = paddle.sum(acc)
+        with pytest.raises(RuntimeError, match="while"):
+            paddle.static.append_backward(loss)

@@ -1,0 +1,59 @@
+"""Per-op device profile of the ResNet50 Model.train_batch step (the
+bench.py resnet leg), via xprof hlo_stats — same harness as
+tools/profile_bert.py."""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(batch: int, steps: int, logdir: str):
+    import time
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    net = paddle.vision.models.resnet50(num_classes=1000)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=net.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(), amp_configs="O2")
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 3, 224, 224), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 1000, (batch, 1)), jnp.int32)
+    model.train_batch([x], [y])
+    p0 = next(iter(net.parameters()))
+    jax.block_until_ready(p0._data)
+    with jax.profiler.trace(logdir):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            model.train_batch([x], [y])
+        jax.block_until_ready(p0._data)
+        dt = time.perf_counter() - t0
+    print(f"[capture] {steps} steps in {dt:.3f}s -> "
+          f"{batch * steps / dt:.1f} imgs/s", file=sys.stderr)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--logdir", default="/tmp/resnet_profile")
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--reuse", action="store_true")
+    args = ap.parse_args()
+    if not args.reuse:
+        os.makedirs(args.logdir, exist_ok=True)
+        capture(args.batch, args.steps, args.logdir)
+    from profile_bert import summarize, print_table
+    data = summarize(args.logdir)
+    if data:
+        print_table(data, args.top)
+
+
+if __name__ == "__main__":
+    main()
