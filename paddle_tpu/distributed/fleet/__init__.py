@@ -245,3 +245,5 @@ fleet = _Fleet()
 from .sharded_trainer import build_sharded_trainer, ShardedTrainer  # noqa: F401,E402
 from .heter_ps import (HeterEmbeddingTable, HeterPSEmbedding,  # noqa: F401,E402
                        HeterCache)
+from . import auto  # noqa: F401,E402
+fleet.auto = auto  # fleet.auto.shard(model, mesh)

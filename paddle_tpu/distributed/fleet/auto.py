@@ -1,0 +1,10 @@
+"""``fleet.auto`` — auto-parallel entry points (reference
+``paddle.distributed.auto_parallel`` fleet integration: engine.py /
+strategy "semi-auto" mode).  ``shard(model, mesh)`` completes parameter
+shardings with the planner's comm-volume cost model and places the
+parameters; see ``distributed/auto_parallel/planner.py``.
+"""
+from ..auto_parallel.planner import (  # noqa: F401
+    CostReport, Plan, plan_model, shard)
+
+__all__ = ["shard", "plan_model", "Plan", "CostReport"]

@@ -138,17 +138,20 @@ class HeterEmbeddingTable:
         t = threading.Thread(target=work, daemon=True)
         t.start()
         # prune finished threads so fire-and-forget callers (who rely on
-        # the table lock, never calling wait_prefetch) don't accumulate
-        self._prefetch_threads = [
-            p for p in self._prefetch_threads if p.is_alive()]
-        self._prefetch_threads.append(t)
+        # the table lock, never calling wait_prefetch) don't accumulate;
+        # under _lock so concurrent prefetch() calls can't lose a thread
+        with self._lock:
+            self._prefetch_threads = [
+                p for p in self._prefetch_threads if p.is_alive()]
+            self._prefetch_threads.append(t)
         return t
 
     def wait_prefetch(self):
         # join ALL outstanding prefetches, not just the latest — an
         # earlier still-running admission thread must not keep mutating
         # the cache after this returns
-        threads, self._prefetch_threads = self._prefetch_threads, []
+        with self._lock:
+            threads, self._prefetch_threads = self._prefetch_threads, []
         for t in threads:
             t.join()
 

@@ -42,7 +42,8 @@ class _LazyScalar(numbers.Real):
     ``Model.train_batch`` spent ~100 ms/step blocked on the loss fetch
     against ~112 ms of device compute.  Keeping the scalar lazy lets
     consecutive steps pipeline; printing/comparing/formatting the loss
-    coerces it via ``__float__`` exactly like a float.
+    coerces it via ``__float__`` exactly like a float.  (For JSON
+    serialization, coerce explicitly: ``float(logs["loss"])``.)
     """
 
     __slots__ = ("_arr", "_val")
@@ -65,6 +66,12 @@ class _LazyScalar(numbers.Real):
 
     def __bool__(self):
         return bool(float(self))
+
+    def __int__(self):
+        return int(float(self))
+
+    def __index__(self):
+        return int(float(self))
 
     def __array__(self, dtype=None, copy=None):
         return np.asarray(float(self), dtype=dtype or np.float64)

@@ -1,0 +1,149 @@
+"""Auto-parallel planner: completion + cost model golden tests
+(round-3 verdict item 4 — reference completion.py:429 complete_annotation
++ cost_model.py:720 estimate_cost).
+
+The GPT golden: ``fleet.auto.shard`` on the eager GPT must reproduce the
+hand-written Megatron pattern of ``models/gpt_spmd.gpt_param_shardings``
+— qkv/up column-parallel, out/down row-parallel, vocab-parallel wte,
+column-parallel head, replicated wpe/norms.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import fleet
+from paddle_tpu.models import GPT, GPTConfig
+
+
+def _mesh(dp, mp):
+    devs = np.asarray(jax.devices()[:dp * mp]).reshape(dp, mp)
+    return Mesh(devs, ("dp", "mp"))
+
+
+TOKENS = 128 * 512   # flagship global batch*seq
+
+
+@pytest.fixture
+def gpt():
+    # hybrid-pod flagship scale (BASELINE milestone 5, BERT/ERNIE-large
+    # class) — the regime the hand shardings were written for
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=30528, hidden_size=1536, num_layers=2,
+                    num_heads=16, max_seq_len=128)
+    return GPT(cfg)
+
+
+def test_gpt_plan_matches_hand_shardings(gpt):
+    """The planner must rediscover the hand-tuned gpt_param_shardings
+    pattern (models/gpt_spmd.py) from the cost model alone."""
+    mesh = _mesh(4, 2)   # pod-style: dp-major, mp within
+    ids = paddle.to_tensor(
+        np.zeros((2, 8), np.int32))
+    plan = fleet.auto.plan_model(gpt, mesh, tokens=TOKENS,
+                                 sample_input=ids)
+    s = plan.param_specs
+    for l in range(2):
+        assert s[f"blocks.{l}.attn.qkv.weight"] == P(None, "mp"), \
+            (l, s[f"blocks.{l}.attn.qkv.weight"])          # column
+        assert s[f"blocks.{l}.attn.out.weight"] == P("mp", None)  # row
+        assert s[f"blocks.{l}.up.weight"] == P(None, "mp")        # column
+        assert s[f"blocks.{l}.down.weight"] == P("mp", None)      # row
+        assert s[f"blocks.{l}.attn.qkv.bias"] == P("mp")
+        assert s[f"blocks.{l}.attn.out.bias"] == P(None)
+        # norms replicated
+        assert s[f"blocks.{l}.ln1.weight"] == P(None)
+    assert s["wte.weight"] == P("mp", None)       # vocab-parallel
+    assert s["wpe.weight"] == P(None, None)       # tiny: replicated
+    assert s["head.weight"] == P(None, "mp")      # column head
+    assert s["ln_f.weight"] == P(None)
+    # cost report is populated and self-consistent
+    r = plan.report
+    assert r.compute_s > 0 and r.mp_comm_bytes > 0
+    assert r.param_bytes_per_device < sum(
+        int(np.prod(p.shape)) * 4 for _, p in gpt.named_parameters())
+
+
+def test_plan_applies_and_trains(gpt):
+    """shard() places params on the mesh and a jitted loss step still
+    runs under GSPMD with the planned shardings."""
+    mesh = _mesh(2, 2)
+    ids_np = np.random.RandomState(0).randint(0, 30528, (4, 16))
+    plan = fleet.auto.shard(gpt, mesh, tokens=TOKENS,
+                            sample_input=paddle.to_tensor(
+                                ids_np.astype(np.int32)))
+    p0 = dict(gpt.named_parameters())["blocks.0.attn.qkv.weight"]
+    assert p0._data.sharding.spec == P(None, "mp")
+    # drive through the compiled Model engine (one jitted program per
+    # step — the supported path for mp-sharded params; eager per-op
+    # dispatch would interleave collectives)
+    model = paddle.Model(gpt)
+    model.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=gpt.parameters()),
+                  paddle.nn.CrossEntropyLoss())
+    y = ids_np.reshape(4, 16, 1).astype(np.int64)
+    l0 = float(model.train_batch([ids_np.astype(np.int32)], [y])["loss"])
+    for _ in range(3):
+        l = float(model.train_batch([ids_np.astype(np.int32)],
+                                    [y])["loss"])
+    assert np.isfinite(l) and l < l0
+
+
+def test_base_width_attention_stays_replicated():
+    """Cost-model honesty check: at BERT-base width with mp=2, the
+    attention matmuls' FLOP saving is smaller than the activation
+    all-reduces, so the planner keeps qkv/out replicated while still
+    sharding the (4x wider) FFN — strategy choice really is
+    cost-driven, not a hardcoded Megatron template."""
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=1,
+                    num_heads=12, max_seq_len=128)
+    g = GPT(cfg)
+    ids = paddle.to_tensor(np.zeros((2, 8), np.int32))
+    plan = fleet.auto.plan_model(g, _mesh(4, 2), tokens=TOKENS,
+                                 sample_input=ids)
+    assert plan.choices["blocks.0.attn.qkv"] == "rep"
+    assert plan.choices["blocks.0.up"] == "col"
+    assert plan.choices["blocks.0.down"] == "row"
+
+
+def test_cnn_plan_is_data_parallel_only():
+    """A small CNN: the cost model keeps every conv/linear replicated
+    over mp (sharding tiny layers costs more comm than it saves), i.e.
+    pure data parallelism — the hand-practice answer for ResNet-class
+    models at this scale."""
+    paddle.seed(0)
+    net = paddle.vision.models.LeNet(num_classes=10)
+    mesh = _mesh(4, 2)
+    x = paddle.to_tensor(
+        np.zeros((2, 1, 28, 28), np.float32))
+    plan = fleet.auto.plan_model(net, mesh, tokens=256, sample_input=x)
+    for name, spec in plan.param_specs.items():
+        assert all(a is None for a in spec), (name, spec)
+
+
+def test_pinned_partial_annotation_completed(gpt):
+    """Partial annotation (reference complete_annotation input): pin one
+    weight replicated; the planner keeps it and completes the rest."""
+    mesh = _mesh(4, 2)
+    ids = paddle.to_tensor(np.zeros((2, 8), np.int32))
+    plan = fleet.auto.plan_model(
+        gpt, mesh, tokens=TOKENS, sample_input=ids,
+        pinned={"blocks.0.attn.qkv.weight": P(None, None)})
+    s = plan.param_specs
+    assert s["blocks.0.attn.qkv.weight"] == P(None, None)   # respected
+    assert s["blocks.0.up.weight"] == P(None, "mp")         # completed
+    assert s["blocks.0.down.weight"] == P("mp", None)
+
+
+def test_pinned_conflict_raises():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=1,
+                    num_heads=2, max_seq_len=32)
+    gpt = GPT(cfg)
+    with pytest.raises(ValueError, match="pinned"):
+        fleet.auto.plan_model(
+            gpt, _mesh(4, 2),
+            pinned={"blocks.0.up.weight": P("dp", "mp")})
