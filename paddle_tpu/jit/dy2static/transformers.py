@@ -11,10 +11,13 @@ lifts tensor-dependent ``if``/``while``/``for range``/``and/or/not`` into
 
 Scoping model: a statement's *assigned names* become the branch/loop
 state tuple; names only read resolve through the closure of the generated
-nested functions.  Constructs the rewrite cannot represent (return/break/
-continue inside the block, attribute/subscript-only mutation) leave the
-statement untouched — concrete conditions still work, traced ones get
-jax's standard tracer error.
+nested functions.  break/continue lower to carried bool flags
+(BreakContinueTransformer), early returns to continuation-captured
+if/else plus a flag+break form inside loops (ReturnTransformer) — both
+then ride the if/while conversion.  Constructs the rewrite cannot
+represent (attribute/subscript-only mutation, with-as/def bindings in
+the block) leave the statement untouched — concrete conditions still
+work, traced ones get jax's standard tracer error.
 """
 from __future__ import annotations
 
@@ -247,7 +250,10 @@ class BreakContinueTransformer(ast.NodeTransformer):
                             operand=ast.Name(id=brk, ctx=ast.Load())),
                 test])
         new_loop = ast.While(test=test, body=new_body, orelse=[])
-        return [_assign_bool(brk, False), new_loop]
+        # both flags init before the loop: their carry slots need a
+        # concrete (promotable) type from iteration zero
+        return [_assign_bool(brk, False), _assign_bool(cont, False),
+                new_loop]
 
     def visit_For(self, node: ast.For):
         self.generic_visit(node)
@@ -267,25 +273,231 @@ class BreakContinueTransformer(ast.NodeTransformer):
         cont = _uid("cont").replace("__pt_", "_jst_")
         body, _ = self._rewrite_body(list(node.body), brk, cont)
         if not has_brk:
-            return ast.For(target=node.target, iter=node.iter,
-                           body=[_assign_bool(cont, False)] + body,
-                           orelse=[])
-        # for i in range(...) with break -> while with the break conjunct
+            # brk stays False but the guard chain references both flags
+            return [_assign_bool(brk, False), _assign_bool(cont, False),
+                    ast.For(target=node.target, iter=node.iter,
+                            body=[_assign_bool(cont, False)] + body,
+                            orelse=[])]
+        # for i in range(...) with break -> while with the break conjunct.
+        # An internal counter drives the loop and the user variable binds
+        # at the TOP of each iteration (python leaves it at the last
+        # iterated value on break/exhaustion); the stop expression is
+        # snapshotted once, like range() materializing its args.
         i = node.target.id
+        it_v = _uid("it").replace("__pt_", "_jst_")
+        stop_v = _uid("stop").replace("__pt_", "_jst_")
         start = ast.Constant(value=0) if len(it.args) == 1 else it.args[0]
         stop = it.args[-1]
         test = ast.BoolOp(op=ast.And(), values=[
             ast.UnaryOp(op=ast.Not(),
                         operand=ast.Name(id=brk, ctx=ast.Load())),
-            ast.Compare(left=ast.Name(id=i, ctx=ast.Load()),
-                        ops=[ast.Lt()], comparators=[stop])])
-        incr = ast.AugAssign(target=ast.Name(id=i, ctx=ast.Store()),
+            ast.Compare(left=ast.Name(id=it_v, ctx=ast.Load()),
+                        ops=[ast.Lt()],
+                        comparators=[ast.Name(id=stop_v, ctx=ast.Load())])])
+        bind_i = ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+                            value=ast.Name(id=it_v, ctx=ast.Load()))
+        incr = ast.AugAssign(target=ast.Name(id=it_v, ctx=ast.Store()),
                              op=ast.Add(), value=ast.Constant(value=1))
-        new_body = [_assign_bool(cont, False)] + body + [incr]
-        return [ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+        new_body = [_assign_bool(cont, False), bind_i] + body + [incr]
+        return [ast.Assign(targets=[ast.Name(id=stop_v, ctx=ast.Store())],
+                           value=stop),
+                ast.Assign(targets=[ast.Name(id=it_v, ctx=ast.Store())],
                            value=start),
-                _assign_bool(brk, False),
+                _assign_bool(brk, False), _assign_bool(cont, False),
                 ast.While(test=test, body=new_body, orelse=[])]
+
+
+class ReturnTransformer(ast.NodeTransformer):
+    """Early ``return`` inside control flow -> convertible structure
+    (reference ``dygraph_to_static/return_transformer.py:136``).
+
+    Two mechanisms, composed recursively:
+
+    - **continuation capture** for ifs: ``if c: return X\n rest`` becomes
+      ``if c: return X else: rest`` — a tail-return if, which the
+      ControlFlowTransformer lowers to ``lax.cond`` with both branches
+      producing full same-typed values (traced conditions fully work);
+    - **flag + break** for loops: ``return X`` inside a loop body becomes
+      ``flag, value = True, X`` + ``break`` (the BreakContinueTransformer
+      then carries the break through the traced loop), and the loop is
+      followed by ``if flag: return value else: <continuation>``.
+
+    Runs FIRST so the generated break/not/if ride the subsequent
+    Break/Logical/ControlFlow rewrites.
+    """
+
+    @classmethod
+    def _has_nested_return(cls, stmts) -> bool:
+        """Any Return inside an if/while/for of THIS function scope."""
+        return any(cls._has_return_somewhere(s) for s in stmts
+                   if isinstance(s, (ast.If, ast.While, ast.For)))
+
+    @staticmethod
+    def _always_returns(stmts) -> bool:
+        if not stmts:
+            return False
+        last = stmts[-1]
+        if isinstance(last, ast.Return):
+            return True
+        if isinstance(last, ast.If):
+            return ReturnTransformer._always_returns(last.body) and \
+                ReturnTransformer._always_returns(last.orelse)
+        return False
+
+    def _flag_loop_body(self, stmts, rf, rv):
+        """Inside a loop: Return -> flag+value+break; guard the rest.
+        Returns (new_stmts, may_return)."""
+        out, may = [], False
+        for idx, st in enumerate(stmts):
+            if isinstance(st, ast.Return):
+                out.append(_assign_bool(rf, True))
+                out.append(ast.Assign(
+                    targets=[ast.Name(id=rv, ctx=ast.Store())],
+                    value=st.value if st.value is not None
+                    else ast.Constant(value=None)))
+                out.append(ast.Break())
+                return out, True                  # rest unreachable
+            if isinstance(st, ast.If):
+                st.body, m1 = self._flag_loop_body(list(st.body), rf, rv)
+                st.orelse, m2 = self._flag_loop_body(list(st.orelse),
+                                                     rf, rv) \
+                    if st.orelse else ([], False)
+                out.append(st)
+                if m1 or m2:
+                    may = True
+                    rest, _ = self._flag_loop_body(stmts[idx + 1:],
+                                                   rf, rv)
+                    out.append(ast.If(
+                        test=ast.Name(id=rf, ctx=ast.Load()),
+                        body=[ast.Break()], orelse=rest or []))
+                    return out, may
+                continue
+            if isinstance(st, (ast.While, ast.For)):
+                st.body, m = self._flag_loop_body(list(st.body), rf, rv)
+                out.append(st)
+                if m:
+                    may = True
+                    rest, _ = self._flag_loop_body(stmts[idx + 1:],
+                                                   rf, rv)
+                    out.append(ast.If(
+                        test=ast.Name(id=rf, ctx=ast.Load()),
+                        body=[ast.Break()], orelse=rest or []))
+                    return out, may
+                continue
+            out.append(st)
+        return out, may
+
+    def _tail(self, stmts, rf, rv, used):
+        """Function-scope statement list: continuation-capture early
+        returns; flag machinery for loops.  Mutates ``used`` (list) when
+        the flag prologue is needed."""
+        out = []
+        for idx, st in enumerate(stmts):
+            rest = stmts[idx + 1:]
+            if isinstance(st, ast.If) and self._has_return_somewhere(st):
+                body_ret = self._always_returns(st.body)
+                orelse_ret = bool(st.orelse) and \
+                    self._always_returns(st.orelse)
+                if body_ret and orelse_ret:
+                    st.body = self._tail(list(st.body), rf, rv, used)
+                    st.orelse = self._tail(list(st.orelse), rf, rv, used)
+                    out.append(st)
+                    return out                    # rest unreachable
+                if body_ret:
+                    # continuation joins the fall-through side (covers
+                    # empty orelse AND elif/else chains that fall out)
+                    st.body = self._tail(list(st.body), rf, rv, used)
+                    st.orelse = self._tail(list(st.orelse) + list(rest),
+                                           rf, rv, used)
+                    out.append(st)
+                    return out
+                if orelse_ret and not body_ret:
+                    st.orelse = self._tail(list(st.orelse), rf, rv, used)
+                    st.body = self._tail(list(st.body) + list(rest),
+                                         rf, rv, used)
+                    out.append(st)
+                    return out
+                # partial return (some sub-path returns): flag fallback
+                used.append(True)
+                st2, may = self._flag_loop_body([st], rf, rv)
+                # _flag_loop_body emits Break for loop context; strip any
+                # top-level trailing Break guard by regenerating: in
+                # function scope the guard is an if-else continuation
+                out.extend(self._strip_breaks(st2))
+                if may:
+                    cont = self._tail(list(rest), rf, rv, used)
+                    out.append(ast.If(
+                        test=ast.Name(id=rf, ctx=ast.Load()),
+                        body=[ast.Return(
+                            value=ast.Name(id=rv, ctx=ast.Load()))],
+                        orelse=cont or [ast.Return(
+                            value=ast.Constant(value=None))]))
+                    return out
+                continue
+            if isinstance(st, (ast.While, ast.For)) and \
+                    self._has_return_somewhere(st):
+                used.append(True)
+                st.body, may = self._flag_loop_body(list(st.body), rf, rv)
+                out.append(st)
+                if may:
+                    cont = self._tail(list(rest), rf, rv, used)
+                    out.append(ast.If(
+                        test=ast.Name(id=rf, ctx=ast.Load()),
+                        body=[ast.Return(
+                            value=ast.Name(id=rv, ctx=ast.Load()))],
+                        orelse=cont or [ast.Return(
+                            value=ast.Constant(value=None))]))
+                    return out
+                continue
+            out.append(st)
+        return out
+
+    @staticmethod
+    def _strip_breaks(stmts):
+        """Remove loop-context Breaks emitted by _flag_loop_body when the
+        construct is being used at function scope."""
+        out = []
+        for st in stmts:
+            if isinstance(st, ast.Break):
+                continue
+            if isinstance(st, ast.If):
+                st.body = ReturnTransformer._strip_breaks(st.body)
+                st.orelse = ReturnTransformer._strip_breaks(st.orelse)
+                if not st.body:
+                    if st.orelse:
+                        st.body, st.orelse = st.orelse, []
+                        st.test = ast.UnaryOp(op=ast.Not(),
+                                              operand=st.test)
+                    else:
+                        continue
+            out.append(st)
+        return out
+
+    @staticmethod
+    def _has_return_somewhere(node) -> bool:
+        def scan(n):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                return False
+            if isinstance(n, ast.Return):
+                return True
+            return any(scan(c) for c in ast.iter_child_nodes(n))
+        return scan(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self.generic_visit(node)                  # nested defs first
+        if not self._has_nested_return(node.body):
+            return node
+        rf = _uid("rf").replace("__pt_", "_jst_")
+        rv = _uid("rv").replace("__pt_", "_jst_")
+        used: List[bool] = []
+        node.body = self._tail(list(node.body), rf, rv, used)
+        if used:
+            node.body = [_assign_bool(rf, False),
+                         ast.Assign(
+                             targets=[ast.Name(id=rv, ctx=ast.Store())],
+                             value=ast.Constant(value=None))] + node.body
+        return node
 
 
 class LogicalTransformer(ast.NodeTransformer):
@@ -391,17 +603,27 @@ class ControlFlowTransformer(ast.NodeTransformer):
     def _tail_return_if(self, node: ast.If):
         tf, ff, param = _uid("true_fn"), _uid("false_fn"), _uid("vars")
         ret = _uid("ret")
-        true_body = list(node.body[:-1]) + \
+        # names a branch assigns AND reads-before-write resolve through
+        # the carried tuple, not the closure (an assignment would make
+        # them unbound locals of the generated branch function)
+        rbw = _read_before_write([], list(node.body)) | \
+            _read_before_write([], list(node.orelse))
+        assigned = _assigned_names(node.body) | \
+            _assigned_names(node.orelse)
+        names = self._clean(assigned & rbw)
+        unpack = [_unpack_stmt(names, param)] if names else []
+        true_body = unpack + list(node.body[:-1]) + \
             [ast.Return(value=ast.Tuple(elts=[node.body[-1].value],
                                         ctx=ast.Load()))]
-        false_body = list(node.orelse[:-1]) + \
+        false_body = unpack + list(node.orelse[:-1]) + \
             [ast.Return(value=ast.Tuple(elts=[node.orelse[-1].value],
                                         ctx=ast.Load()))]
         call = _jst_call("convert_ifelse",
                          [node.test,
                           ast.Name(id=tf, ctx=ast.Load()),
                           ast.Name(id=ff, ctx=ast.Load()),
-                          ast.Tuple(elts=[], ctx=ast.Load())])
+                          _init_tuple(names) if names
+                          else ast.Tuple(elts=[], ctx=ast.Load())])
         return [
             _make_fn(tf, param, true_body),
             _make_fn(ff, param, false_body),
@@ -515,6 +737,8 @@ class ControlFlowTransformer(ast.NodeTransformer):
 
 
 def transform_ast(tree: ast.AST) -> ast.AST:
+    tree = ReturnTransformer().visit(tree)
+    tree = BreakContinueTransformer().visit(tree)
     tree = LogicalTransformer().visit(tree)
     tree = ControlFlowTransformer(_loads_with_pos(tree)).visit(tree)
     ast.fix_missing_locations(tree)
