@@ -26,7 +26,8 @@ from typing import Dict, List, Optional
 ELASTIC_EXIT_CODE = 101  # keep in sync with distributed/launch.py
 
 __all__ = ["ELASTIC_EXIT_CODE", "ElasticStatus", "ElasticManager",
-           "FileStore", "MemoryStore", "enable_elastic", "launch_elastic"]
+           "FileStore", "MemoryStore", "KVServer", "TCPStore",
+           "store_from_spec", "enable_elastic", "launch_elastic"]
 
 
 class ElasticStatus:
@@ -194,6 +195,133 @@ class FileStore(Store):
 
 
 # ---------------------------------------------------------------------------
+# network store: TCP KV server + client — the multi-host path
+# (reference manager.py:147-150 connects to etcd3 at
+# PADDLE_ELASTIC_SERVER; this is the TPU-pod stand-in with the same TTL
+# semantics, speaking length-bounded JSON lines over TCP)
+# ---------------------------------------------------------------------------
+_KV_MAX_LINE = 1 << 20     # 1 MiB per request/response line
+
+
+class KVServer:
+    """Threaded TCP server fronting a MemoryStore.
+
+    Run ONE per job (typically on the coordinator host, like the etcd
+    cluster in the reference deployment); clients connect per request —
+    heartbeat traffic is ~1 req/s per host, so connection setup cost is
+    irrelevant and server restarts need no client-side state.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import socketserver
+
+        backing = MemoryStore()
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                line = self.rfile.readline(_KV_MAX_LINE + 1)
+                if not line or len(line) > _KV_MAX_LINE:
+                    return
+                try:
+                    req = json.loads(line)
+                    op = req["op"]
+                    if op == "put":
+                        backing.put(req["k"], req["v"], req.get("ttl"))
+                        resp = {"ok": True}
+                    elif op == "get":
+                        resp = {"ok": True, "v": backing.get(req["k"])}
+                    elif op == "delete":
+                        backing.delete(req["k"])
+                        resp = {"ok": True}
+                    elif op == "list":
+                        resp = {"ok": True,
+                                "v": backing.list_prefix(req["k"])}
+                    elif op == "purge":
+                        backing.purge_expired(req.get("grace", 0.0))
+                        resp = {"ok": True}
+                    else:
+                        resp = {"ok": False, "err": f"bad op {op!r}"}
+                except Exception as e:  # malformed request: report, keep serving
+                    resp = {"ok": False, "err": str(e)}
+                self.wfile.write(json.dumps(resp).encode() + b"\n")
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Srv((host, port), Handler)
+        self.endpoint = "%s:%d" % self._srv.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class TCPStore(Store):
+    """Store client for a :class:`KVServer` endpoint ("host:port")."""
+
+    def __init__(self, endpoint: str, timeout: float = 10.0):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+
+    def _call(self, req: dict):
+        data = json.dumps(req).encode() + b"\n"
+        if len(data) > _KV_MAX_LINE:
+            raise ValueError(f"KV request of {len(data)} bytes exceeds "
+                             f"the {_KV_MAX_LINE} line bound")
+        with socket.create_connection(self._addr,
+                                      timeout=self._timeout) as s:
+            s.sendall(data)
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                if len(buf) > _KV_MAX_LINE:
+                    raise ConnectionError("KV response exceeds line bound")
+        resp = json.loads(buf or b"{}")
+        if not resp.get("ok"):
+            raise ConnectionError(
+                f"KV server error: {resp.get('err', 'no response')}")
+        return resp.get("v")
+
+    def put(self, key, value, ttl=None):
+        self._call({"op": "put", "k": key, "v": value, "ttl": ttl})
+
+    def get(self, key):
+        return self._call({"op": "get", "k": key})
+
+    def delete(self, key):
+        self._call({"op": "delete", "k": key})
+
+    def list_prefix(self, prefix):
+        return self._call({"op": "list", "k": prefix})
+
+    def purge_expired(self, grace: float = 0.0):
+        self._call({"op": "purge", "grace": grace})
+
+
+def store_from_spec(spec: str) -> Store:
+    """'tcp://host:port' -> TCPStore; anything else is a FileStore root
+    (the shared-filesystem deployment)."""
+    if spec.startswith("tcp://"):
+        return TCPStore(spec[len("tcp://"):])
+    return FileStore(spec)
+
+
+# ---------------------------------------------------------------------------
 # manager
 # ---------------------------------------------------------------------------
 def _parse_np(np_spec) -> tuple:
@@ -239,10 +367,18 @@ class ElasticManager:
         def beat():
             n = 0
             while not self._stop.wait(self.interval):
-                self.store.put(self._key(), "alive", ttl=self.ttl)
-                n += 1
-                if n % 10 == 0:  # GC crashed hosts' stale entries
-                    self.store.purge_expired(grace=3.0 * self.ttl)
+                # transient store outages (network blip, KVServer
+                # restart) must not kill the heartbeat: the TTL gives
+                # several intervals of slack to ride them out
+                try:
+                    self.store.put(self._key(), "alive", ttl=self.ttl)
+                    n += 1
+                    if n % 10 == 0:  # GC crashed hosts' stale entries
+                        self.store.purge_expired(grace=3.0 * self.ttl)
+                except Exception as e:
+                    import sys
+                    print(f"elastic heartbeat: store unreachable "
+                          f"({e!r}); retrying", file=sys.stderr)
 
         self._hb_thread = threading.Thread(target=beat, daemon=True)
         self._hb_thread.start()
@@ -306,10 +442,11 @@ def enable_elastic(args=None) -> bool:
 def launch_elastic(np_spec, store_root: Optional[str] = None,
                    job_id: str = "default") -> ElasticManager:
     """Construct a manager from env/args (reference elastic collective
-    entry): FileStore rooted at PADDLE_ELASTIC_STORE_ROOT."""
+    entry): ``tcp://host:port`` selects the network KV store (etcd
+    analog), any other value is a shared-filesystem FileStore root."""
     root = store_root or os.environ.get("PADDLE_ELASTIC_STORE_ROOT")
     if not root:
         raise ValueError("set PADDLE_ELASTIC_STORE_ROOT or pass store_root")
-    mgr = ElasticManager(np_spec, FileStore(root), job_id=job_id)
+    mgr = ElasticManager(np_spec, store_from_spec(root), job_id=job_id)
     mgr.register()
     return mgr

@@ -44,6 +44,11 @@ def _parse_args(argv=None):
     p.add_argument("--elastic", action="store_true",
                    help=f"relaunch the pod when a proc exits with code "
                         f"{ELASTIC_EXIT_CODE}")
+    p.add_argument("--np", type=str, default=None,
+                   help="MIN:MAX elastic world bounds — each (re)launch "
+                        "sizes the pod to the live member count in the "
+                        "elastic store (PADDLE_ELASTIC_STORE_ROOT), like "
+                        "the reference's etcd-driven scale in/out")
     p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -160,7 +165,41 @@ def launch(argv=None):
         sys.exit(1)
 
     signal.signal(signal.SIGTERM, _sig)
+
+    def _elastic_world():
+        """Size the pod to the live membership (reference manager.py
+        etcd host set -> np within [min, max])."""
+        if not (args.elastic and args.np and
+                os.environ.get("PADDLE_ELASTIC_STORE_ROOT")):
+            return
+        from .fleet.elastic.manager import (ElasticManager, _parse_np,
+                                            store_from_spec)
+        lo, hi = _parse_np(args.np)
+        store = store_from_spec(os.environ["PADDLE_ELASTIC_STORE_ROOT"])
+        job = os.environ.get("PADDLE_ELASTIC_JOB_ID", "default")
+        pfx = f"{ElasticManager.PREFIX}{job}/"
+        deadline = time.time() + float(
+            os.environ.get("PADDLE_ELASTIC_WAIT_S", "60"))
+        live = None
+        while True:
+            try:
+                live = len(store.list_prefix(pfx))
+            except Exception as e:
+                # store briefly unreachable mid-recovery: keep the
+                # previous world size rather than dying
+                print(f"launch: elastic store unreachable ({e!r})",
+                      file=sys.stderr)
+            if (live is not None and live >= lo) or                     time.time() > deadline:
+                break
+            time.sleep(0.5)
+        if live is None:
+            return
+        args.nproc = max(lo, min(hi, live if live else args.nproc))
+        print(f"launch: elastic world = {args.nproc} "
+              f"(live members {live}, bounds {lo}:{hi})", file=sys.stderr)
+
     while True:
+        _elastic_world()
         pod = PodLauncher(args, tail)
         pod_ref["pod"] = pod
         pod.launch()

@@ -142,6 +142,23 @@ def _statics_match(a, b) -> bool:
     return True
 
 
+def _safe_repr(vals) -> str:
+    """repr for diagnostics that never materializes a tracer (a plain
+    repr of a Tensor holding a tracer raises TracerArrayConversionError
+    — possibly nested inside a tuple slot — masking the real error)."""
+    parts = []
+    for v in vals:
+        a = _arr(v)
+        if isinstance(a, jax.core.Tracer):
+            parts.append(f"<traced {a.aval}>")
+            continue
+        try:
+            parts.append(repr(v))
+        except Exception:
+            parts.append(f"<{type(v).__name__}>")
+    return "(" + ", ".join(parts) + ")"
+
+
 # ---------------------------------------------------------------------------
 # converters
 # ---------------------------------------------------------------------------
@@ -170,7 +187,8 @@ def convert_ifelse(pred, true_fn, false_fn, init_vars: tuple):
     if t_tags != f_tags or not _statics_match(t_statics, f_statics):
         raise TypeError(
             "converted if/else branches disagree on non-tensor state "
-            f"(true: {t_statics}, false: {f_statics}); only Tensor "
+            f"(true: {_safe_repr(t_statics)}, "
+            f"false: {_safe_repr(f_statics)}); only Tensor "
             "variables may differ between traced branches")
     return _merge_state(list(out_ops), t_tags, t_statics)
 

@@ -417,23 +417,18 @@ class ReturnTransformer(ast.NodeTransformer):
                                          rf, rv, used)
                     out.append(st)
                     return out
-                # partial return (some sub-path returns): flag fallback
-                used.append(True)
-                st2, may = self._flag_loop_body([st], rf, rv)
-                # _flag_loop_body emits Break for loop context; strip any
-                # top-level trailing Break guard by regenerating: in
-                # function scope the guard is an if-else continuation
-                out.extend(self._strip_breaks(st2))
-                if may:
-                    cont = self._tail(list(rest), rf, rv, used)
-                    out.append(ast.If(
-                        test=ast.Name(id=rf, ctx=ast.Load()),
-                        body=[ast.Return(
-                            value=ast.Name(id=rv, ctx=ast.Load()))],
-                        orelse=cont or [ast.Return(
-                            value=ast.Constant(value=None))]))
-                    return out
-                continue
+                # partial return (e.g. a guard clause nested one level
+                # deeper): duplicate the continuation into BOTH arms —
+                # only one executes, and every arm then terminates in a
+                # Return, so the rewrite stays fully traceable (no
+                # untypeable None-seeded flag state)
+                import copy
+                st.body = self._tail(list(st.body) + copy.deepcopy(rest),
+                                     rf, rv, used)
+                st.orelse = self._tail(list(st.orelse) + list(rest),
+                                       rf, rv, used)
+                out.append(st)
+                return out
             if isinstance(st, (ast.While, ast.For)) and \
                     self._has_return_somewhere(st):
                 used.append(True)
@@ -449,27 +444,6 @@ class ReturnTransformer(ast.NodeTransformer):
                             value=ast.Constant(value=None))]))
                     return out
                 continue
-            out.append(st)
-        return out
-
-    @staticmethod
-    def _strip_breaks(stmts):
-        """Remove loop-context Breaks emitted by _flag_loop_body when the
-        construct is being used at function scope."""
-        out = []
-        for st in stmts:
-            if isinstance(st, ast.Break):
-                continue
-            if isinstance(st, ast.If):
-                st.body = ReturnTransformer._strip_breaks(st.body)
-                st.orelse = ReturnTransformer._strip_breaks(st.orelse)
-                if not st.body:
-                    if st.orelse:
-                        st.body, st.orelse = st.orelse, []
-                        st.test = ast.UnaryOp(op=ast.Not(),
-                                              operand=st.test)
-                    else:
-                        continue
             out.append(st)
         return out
 
@@ -491,7 +465,12 @@ class ReturnTransformer(ast.NodeTransformer):
         rf = _uid("rf").replace("__pt_", "_jst_")
         rv = _uid("rv").replace("__pt_", "_jst_")
         used: List[bool] = []
-        node.body = self._tail(list(node.body), rf, rv, used)
+        body = list(node.body)
+        if not self._always_returns(body):
+            # establish the terminator invariant every _tail list relies
+            # on: all control paths end in an explicit Return
+            body.append(ast.Return(value=ast.Constant(value=None)))
+        node.body = self._tail(body, rf, rv, used)
         if used:
             node.body = [_assign_bool(rf, False),
                          ast.Assign(

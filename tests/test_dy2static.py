@@ -505,3 +505,32 @@ def test_break_loop_var_and_range_snapshot_semantics():
         return i
 
     assert convert_to_static(f2)(_f32([1.0])) == 3   # exhaustion: stop-1
+
+
+def test_guard_clause_nested_early_return_traced():
+    """A partial early return one level deep (classic guard clause) —
+    the continuation duplicates into both arms, staying fully traceable."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            if paddle.sum(x) > 5:
+                return x * 10.0
+            x = x + 1.0
+        return x * 2.0
+
+    g = to_static(f)
+    for v, want in [(7.0, 70.0), (2.0, 6.0), (-1.0, -2.0)]:
+        np.testing.assert_allclose(g(_f32([v])).numpy(), [want])
+
+
+def test_tuple_early_return_raises_clear_type_error():
+    # tuple-valued traced early returns can't ride a scalar cond slot:
+    # the failure must be the converter's own diagnostic, not a masked
+    # TracerArrayConversionError from repr-ing a traced Tensor
+    def f(x):
+        if paddle.sum(x) > 0:
+            return x, x * 2.0
+        return x * 3.0, x
+
+    g = to_static(f)
+    with pytest.raises(TypeError, match="disagree|structure"):
+        g(_f32([1.0]))

@@ -162,3 +162,70 @@ class TestDynamicBatchExport:
         cfg.set_model("whatever")
         assert cfg.cpu_math_library_num_threads() == 8
         assert not cfg.ir_optim()
+
+
+class TestPrecisionPipeline:
+    """Round-4: precision knobs are functional (verdict item 7) — the
+    param residency dtype and output dtype actually change."""
+
+    def _load(self, prefix, precision):
+        cfg = paddle.inference.Config(prefix)
+        cfg.set_precision(precision)
+        return paddle.inference.create_predictor(cfg)
+
+    def test_bfloat16_changes_dtypes(self, artifact):
+        import jax.numpy as jnp
+        prefix, x, want = artifact
+        pred = self._load(prefix, paddle.inference.PrecisionType.Bfloat16)
+        # params resident in bf16 (half the HBM)
+        dts = {str(v.dtype) for v in pred._params.values()}
+        assert dts == {"bfloat16"}, dts
+        (out,) = pred.run([x])
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32), want,
+                                   rtol=3e-2, atol=3e-2)
+
+    def test_half_changes_dtypes(self, artifact):
+        prefix, x, want = artifact
+        pred = self._load(prefix, paddle.inference.PrecisionType.Half)
+        assert {str(v.dtype) for v in pred._params.values()} == {"float16"}
+        (out,) = pred.run([x])
+        assert out.dtype == np.float16
+        np.testing.assert_allclose(np.asarray(out, np.float32), want,
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_int8_weight_only_quant(self, artifact):
+        from paddle_tpu.quantization import QuantizedW
+        prefix, x, want = artifact
+        pred = self._load(prefix, paddle.inference.PrecisionType.Int8)
+        kinds = [type(v).__name__ for v in pred._params.values()]
+        assert "QuantizedW" in kinds, kinds
+        qb = sum(v.q.size + 4 * v.scales.size
+                 for v in pred._params.values()
+                 if isinstance(v, QuantizedW))
+        assert qb > 0
+        (out,) = pred.run([x])
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, want, rtol=5e-2, atol=5e-2)
+        # quantized clone shares the quantized params
+        c = pred.clone()
+        (out2,) = c.run([x])
+        np.testing.assert_allclose(out2, out)
+
+    def test_float32_unchanged_and_exact(self, artifact):
+        prefix, x, want = artifact
+        pred = self._load(prefix, paddle.inference.PrecisionType.Float32)
+        assert {str(v.dtype) for v in pred._params.values()} == {"float32"}
+        (out,) = pred.run([x])
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_tensorrt_knob_warns_loudly(self, artifact):
+        import warnings
+        prefix, _, _ = artifact
+        cfg = paddle.inference.Config(prefix)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            cfg.enable_tensorrt_engine(
+                precision_mode=paddle.inference.PrecisionType.Half)
+        assert any("TensorRT" in str(x.message) for x in w)
+        assert cfg._precision == paddle.inference.PrecisionType.Half

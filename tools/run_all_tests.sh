@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Full test suite INCLUDING the slow tier (multi-process launcher parity,
+# elastic scale-in, 32-virtual-device all-axes dryrun, ...).  This is the
+# artifact-path invocation — the default `pytest tests/ -q` auto-skips
+# @pytest.mark.slow; CI-style runs must use this script so the hardest
+# distributed tests actually gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -q --runslow "$@"
