@@ -31,8 +31,11 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from collections import OrderedDict
+
 __all__ = ["SparseSGDRule", "NaiveSGDRule", "AdagradSGDRule", "DenseTable",
-           "SparseTable", "PSServer", "PSClient", "Communicator", "role_from_env"]
+           "SparseTable", "SSDSparseTable", "PSServer", "PSClient",
+           "Communicator", "role_from_env"]
 
 
 # ---------------------------------------------------------------------------
@@ -137,8 +140,11 @@ class SparseTable:
                 k = int(k)
                 acc[k] = acc[k] + g if k in acc else g.copy()
             for k, g in acc.items():
+                # fault the row in FIRST (the SSD table restores its
+                # spilled opt-state too); only then bind the state dict
+                row = self._row(k)
                 st = self._states.setdefault(k, {})
-                self._rows[k] = self._rule.update(self._row(k), g, st)
+                self._rows[k] = self._rule.update(row, g, st)
 
     def __len__(self):
         return len(self._rows)
@@ -151,6 +157,120 @@ class SparseTable:
         with self._lock:
             self._rows = dict(st["rows"])
             self._states = dict(st["states"])
+
+
+class SSDSparseTable(SparseTable):
+    """Sparse table with a bounded in-RAM hot set and disk spill for the
+    cold tail (reference ``table/ssd_sparse_table.h:21`` — RocksDB-backed
+    CommonSparseTable with a top-k RAM cache).
+
+    TPU-first shim mechanics: rows beyond ``cache_rows`` LRU-spill to an
+    append-only record file (pickled (value, opt-state) per row, offset
+    index in RAM); touching a spilled row faults it back in.  This is
+    what lets PS embedding tables exceed host RAM — the capability the
+    heter_ps device-cache tier composes over.  The spill file compacts
+    on ``save``/``state()``.
+    """
+
+    def __init__(self, dim: int, rule=None, init_std: float = 0.01,
+                 seed: int = 0, cache_rows: int = 100_000,
+                 path: Optional[str] = None):
+        super().__init__(dim, rule=rule, init_std=init_std, seed=seed)
+        import tempfile
+        self.cache_rows = max(1, int(cache_rows))
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        if path is None:
+            f = tempfile.NamedTemporaryFile(prefix="pt_ssd_", delete=False)
+            path = f.name
+            f.close()
+        self._path = path
+        self._file = open(path, "a+b")
+        self._offsets: Dict[int, tuple] = {}   # key -> (offset, length)
+        self._spills = 0
+        self._faults = 0
+
+    # -- spill machinery (caller holds self._lock) -------------------------
+    def _touch(self, key: int):
+        self._lru.pop(key, None)
+        self._lru[key] = None
+
+    def _spill_cold(self):
+        import pickle as pkl
+        while len(self._rows) > self.cache_rows and self._lru:
+            cold, _ = self._lru.popitem(last=False)
+            if cold not in self._rows:
+                continue
+            rec = pkl.dumps((self._rows.pop(cold),
+                             self._states.pop(cold, None)),
+                            protocol=4)
+            self._file.seek(0, os.SEEK_END)
+            off = self._file.tell()
+            self._file.write(rec)
+            self._offsets[cold] = (off, len(rec))
+            self._spills += 1
+
+    def _fault_in(self, key: int):
+        import pickle as pkl
+        off, length = self._offsets.pop(key)
+        self._file.seek(off)
+        row, state = pkl.loads(self._file.read(length))
+        self._rows[key] = row
+        if state is not None:
+            self._states[key] = state
+        self._touch(key)
+        self._faults += 1
+
+    def _row(self, key: int) -> np.ndarray:
+        if key not in self._rows and key in self._offsets:
+            self._fault_in(key)
+        row = super()._row(key)
+        self._touch(key)
+        self._spill_cold()
+        return row
+
+    def __len__(self):
+        return len(self._rows) + len(self._offsets)
+
+    @property
+    def resident_rows(self) -> int:
+        return len(self._rows)
+
+    def state(self):
+        """Full snapshot for the PS save/shard-recovery protocol.  The
+        spilled tail is STREAMED off disk into the snapshot dict — the
+        table's resident set stays bounded (the snapshot itself is
+        O(table), inherent to the dict-snapshot contract)."""
+        import pickle as pkl
+        with self._lock:
+            rows = dict(self._rows)
+            states = dict(self._states)
+            for key, (off, length) in self._offsets.items():
+                self._file.seek(off)
+                row, state = pkl.loads(self._file.read(length))
+                rows[key] = row
+                if state is not None:
+                    states[key] = state
+            return {"rows": rows, "states": states}
+
+    def load_state(self, st):
+        with self._lock:
+            # drop every spilled/stale record: the restored snapshot is
+            # the whole truth (stale offsets would resurrect old rows)
+            self._offsets.clear()
+            self._lru.clear()
+            self._file.truncate(0)
+            self._rows = dict(st["rows"])
+            self._states = dict(st["states"])
+            for k in self._rows:
+                self._touch(k)
+            self._spill_cold()
+
+    def close(self):
+        try:
+            self._file.close()
+            os.unlink(self._path)
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -234,8 +354,14 @@ class PSServer:
     def add_dense_table(self, name: str, shape, rule=None):
         self._tables[name] = DenseTable(shape, rule=rule)
 
-    def add_sparse_table(self, name: str, dim: int, rule=None, seed=0):
-        self._tables[name] = SparseTable(dim, rule=rule, seed=seed)
+    def add_sparse_table(self, name: str, dim: int, rule=None, seed=0,
+                         ssd: bool = False, cache_rows: int = 100_000,
+                         path: Optional[str] = None):
+        """``ssd=True`` -> disk-spilling table (SSDSparseTable): the
+        embeddings-bigger-than-RAM deployment."""
+        cls = SSDSparseTable if ssd else SparseTable
+        kw = {"cache_rows": cache_rows, "path": path} if ssd else {}
+        self._tables[name] = cls(dim, rule=rule, seed=seed, **kw)
 
     def _handle(self, msg):
         op = msg[0]

@@ -231,6 +231,9 @@ class Predictor:
             self._output_names = list(src._output_names)
             self._out_dtype = src._out_dtype
             self._dequant = src._dequant
+            self._reduced_keys = getattr(src, "_reduced_keys", set())
+            if getattr(src, "_mat_params", None) is not None:
+                self._mat_params = src._mat_params  # share, don't redo
             self._inputs = {n: Tensor(n) for n in self._input_names}
             self._outputs = {n: Tensor(n) for n in self._output_names}
             return
@@ -282,8 +285,10 @@ class Predictor:
         if prec in (PrecisionType.Half, PrecisionType.Bfloat16):
             tgt = jnp.float16 if prec == PrecisionType.Half \
                 else jnp.bfloat16
+            self._reduced_keys = {k for k, v in self._params.items()
+                                  if v.dtype == jnp.float32}
             self._params = {
-                k: v.astype(tgt) if v.dtype == jnp.float32 else v
+                k: v.astype(tgt) if k in self._reduced_keys else v
                 for k, v in self._params.items()}
             self._out_dtype = tgt
         elif prec == PrecisionType.Int8:
@@ -312,8 +317,10 @@ class Predictor:
                    if isinstance(v, QuantizedW) else v
                    for k, v in self._params.items()}
         elif self._out_dtype is not None:
+            # cast back ONLY the params we reduced — a natively-bf16
+            # param must keep its dtype or the exported signature breaks
             mat = {k: v.astype(jnp.float32)
-                   if v.dtype == self._out_dtype else v
+                   if k in self._reduced_keys else v
                    for k, v in self._params.items()}
         else:
             return self._params

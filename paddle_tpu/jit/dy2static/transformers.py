@@ -387,7 +387,9 @@ class ReturnTransformer(ast.NodeTransformer):
             out.append(st)
         return out, may
 
-    def _tail(self, stmts, rf, rv, used):
+    _MAX_DUP_DEPTH = 8   # partial-return duplication bound (see below)
+
+    def _tail(self, stmts, rf, rv, used, dup_depth: int = 0):
         """Function-scope statement list: continuation-capture early
         returns; flag machinery for loops.  Mutates ``used`` (list) when
         the flag prologue is needed."""
@@ -399,34 +401,45 @@ class ReturnTransformer(ast.NodeTransformer):
                 orelse_ret = bool(st.orelse) and \
                     self._always_returns(st.orelse)
                 if body_ret and orelse_ret:
-                    st.body = self._tail(list(st.body), rf, rv, used)
-                    st.orelse = self._tail(list(st.orelse), rf, rv, used)
+                    st.body = self._tail(list(st.body), rf, rv, used,
+                                         dup_depth)
+                    st.orelse = self._tail(list(st.orelse), rf, rv,
+                                           used, dup_depth)
                     out.append(st)
                     return out                    # rest unreachable
                 if body_ret:
                     # continuation joins the fall-through side (covers
                     # empty orelse AND elif/else chains that fall out)
-                    st.body = self._tail(list(st.body), rf, rv, used)
+                    st.body = self._tail(list(st.body), rf, rv, used,
+                                         dup_depth)
                     st.orelse = self._tail(list(st.orelse) + list(rest),
-                                           rf, rv, used)
+                                           rf, rv, used, dup_depth)
                     out.append(st)
                     return out
                 if orelse_ret and not body_ret:
-                    st.orelse = self._tail(list(st.orelse), rf, rv, used)
+                    st.orelse = self._tail(list(st.orelse), rf, rv,
+                                           used, dup_depth)
                     st.body = self._tail(list(st.body) + list(rest),
-                                         rf, rv, used)
+                                         rf, rv, used, dup_depth)
                     out.append(st)
                     return out
                 # partial return (e.g. a guard clause nested one level
                 # deeper): duplicate the continuation into BOTH arms —
                 # only one executes, and every arm then terminates in a
                 # Return, so the rewrite stays fully traceable (no
-                # untypeable None-seeded flag state)
+                # untypeable None-seeded flag state).  Duplication is
+                # bounded: a long chain of partial guards would grow
+                # O(2^N), so past the bound the If is left untouched
+                # (python semantics still exact; traced conditions get
+                # jax's standard tracer error)
+                if dup_depth >= self._MAX_DUP_DEPTH:
+                    out.append(st)
+                    continue
                 import copy
                 st.body = self._tail(list(st.body) + copy.deepcopy(rest),
-                                     rf, rv, used)
+                                     rf, rv, used, dup_depth + 1)
                 st.orelse = self._tail(list(st.orelse) + list(rest),
-                                       rf, rv, used)
+                                       rf, rv, used, dup_depth + 1)
                 out.append(st)
                 return out
             if isinstance(st, (ast.While, ast.For)) and \
