@@ -8,14 +8,16 @@ Reference parity: ``paddle/fluid/distributed/`` — ``PSClient``
 and the fleet facade's init_server/init_worker/run_server lifecycle
 (``fleet/base/fleet_base.py``).
 
-TPU-first scoping (SURVEY §7e): the full brpc/CTR stack is out of scope;
-this is a functional small-scale PS with the same interface — a threaded
-TCP server with a length-prefixed pickle protocol instead of brpc, dense
-tables as jnp arrays, sparse tables as hash maps with lazy row init and
-pluggable SGD rules, sparse keys sharded across servers by hash.  Dense
-training on TPU should use the collective path; the PS exists for the
-sparse-embedding workloads the reference serves (recsys-style lookup
-tables too large for device memory).
+TPU-first scoping (SURVEY §7e): brpc itself is replaced by a threaded
+TCP server with a bounded magic/version frame protocol; the table
+family covers the reference's range — dense tables as arrays, sparse
+hash tables with lazy row init and pluggable SGD rules, SSDSparseTable
+(disk spill for bigger-than-RAM embeddings, ssd_sparse_table.h analog),
+CTRSparseTable (show/click feature lifecycle with decay + shrink,
+ctr_accessor.h analog), and GraphTable (weighted neighbor sampling for
+GNN workloads, common_graph_table.h analog); sparse keys shard across
+servers by hash.  Dense training on TPU should use the collective path;
+the PS exists for the sparse-embedding workloads the reference serves.
 """
 from __future__ import annotations
 
@@ -34,8 +36,8 @@ import numpy as np
 from collections import OrderedDict
 
 __all__ = ["SparseSGDRule", "NaiveSGDRule", "AdagradSGDRule", "DenseTable",
-           "SparseTable", "SSDSparseTable", "PSServer", "PSClient",
-           "Communicator", "role_from_env"]
+           "SparseTable", "SSDSparseTable", "CTRSparseTable", "GraphTable",
+           "PSServer", "PSClient", "Communicator", "role_from_env"]
 
 
 # ---------------------------------------------------------------------------
@@ -131,20 +133,23 @@ class SparseTable:
         with self._lock:
             return np.stack([self._row(int(k)) for k in keys])
 
+    def _push_locked(self, keys, grads):
+        # duplicate keys in one batch accumulate (reference push_sparse)
+        acc: Dict[int, np.ndarray] = {}
+        for k, g in zip(keys, grads):
+            k = int(k)
+            acc[k] = acc[k] + g if k in acc else g.copy()
+        for k, g in acc.items():
+            # fault the row in FIRST (the SSD table restores its
+            # spilled opt-state too); only then bind the state dict
+            row = self._row(k)
+            st = self._states.setdefault(k, {})
+            self._rows[k] = self._rule.update(row, g, st)
+
     def push(self, keys: Sequence[int], grads: np.ndarray):
         grads = np.asarray(grads, np.float32)
         with self._lock:
-            # duplicate keys in one batch accumulate (reference push_sparse)
-            acc: Dict[int, np.ndarray] = {}
-            for k, g in zip(keys, grads):
-                k = int(k)
-                acc[k] = acc[k] + g if k in acc else g.copy()
-            for k, g in acc.items():
-                # fault the row in FIRST (the SSD table restores its
-                # spilled opt-state too); only then bind the state dict
-                row = self._row(k)
-                st = self._states.setdefault(k, {})
-                self._rows[k] = self._rule.update(row, g, st)
+            self._push_locked(keys, grads)
 
     def __len__(self):
         return len(self._rows)
@@ -168,8 +173,9 @@ class SSDSparseTable(SparseTable):
     append-only record file (pickled (value, opt-state) per row, offset
     index in RAM); touching a spilled row faults it back in.  This is
     what lets PS embedding tables exceed host RAM — the capability the
-    heter_ps device-cache tier composes over.  The spill file compacts
-    on ``save``/``state()``.
+    heter_ps device-cache tier composes over.  Dead record bytes from
+    re-spills are reclaimed by ``compact()``, which ``state()`` runs
+    after each snapshot.
     """
 
     def __init__(self, dim: int, rule=None, init_std: float = 0.01,
@@ -188,6 +194,19 @@ class SSDSparseTable(SparseTable):
         self._offsets: Dict[int, tuple] = {}   # key -> (offset, length)
         self._spills = 0
         self._faults = 0
+        import weakref
+        # spill files must not outlive the table (NamedTemporaryFile is
+        # created with delete=False so it survives the open/close dance)
+        self._finalizer = weakref.finalize(
+            self, SSDSparseTable._cleanup, self._file, self._path)
+
+    @staticmethod
+    def _cleanup(file, path):
+        try:
+            file.close()
+            os.unlink(path)
+        except OSError:
+            pass
 
     # -- spill machinery (caller holds self._lock) -------------------------
     def _touch(self, key: int):
@@ -250,7 +269,33 @@ class SSDSparseTable(SparseTable):
                 rows[key] = row
                 if state is not None:
                     states[key] = state
+            self._compact_locked()
             return {"rows": rows, "states": states}
+
+    def _compact_locked(self):
+        """Rewrite only the LIVE spilled records, dropping dead bytes
+        from re-spill churn (streaming: one record resident at a time)."""
+        import pickle as pkl
+        new_path = self._path + ".compact"
+        with open(new_path, "wb") as nf:
+            new_offsets = {}
+            for key, (off, length) in self._offsets.items():
+                self._file.seek(off)
+                rec = self._file.read(length)
+                new_offsets[key] = (nf.tell(), len(rec))
+                nf.write(rec)
+        self._file.close()
+        os.replace(new_path, self._path)
+        self._file = open(self._path, "a+b")
+        self._offsets = new_offsets
+        self._finalizer.detach()
+        import weakref
+        self._finalizer = weakref.finalize(
+            self, SSDSparseTable._cleanup, self._file, self._path)
+
+    def compact(self):
+        with self._lock:
+            self._compact_locked()
 
     def load_state(self, st):
         with self._lock:
@@ -258,6 +303,7 @@ class SSDSparseTable(SparseTable):
             # the whole truth (stale offsets would resurrect old rows)
             self._offsets.clear()
             self._lru.clear()
+            self._file.seek(0)
             self._file.truncate(0)
             self._rows = dict(st["rows"])
             self._states = dict(st["states"])
@@ -271,6 +317,189 @@ class SSDSparseTable(SparseTable):
             os.unlink(self._path)
         except OSError:
             pass
+
+
+class CTRSparseTable(SparseTable):
+    """Sparse table with CTR feature metadata and lifecycle (reference
+    ``table/ctr_accessor.h:27`` CtrCommonAccessor: per-feature show/
+    click/unseen_days/delta_score with decay + threshold shrink).
+
+    Each row carries {show, click, unseen_days}; ``push`` takes the
+    batch's show/click increments; ``decay_and_shrink`` applies the
+    accessor's update_rule (show/click *= decay, unseen_days++), scores
+    rows by ``show_click_score = show*show_coeff + click*click_coeff``
+    and deletes those below ``delete_threshold`` or unseen too long —
+    the feature-admission/eviction loop of the reference CTR pipeline.
+    """
+
+    def __init__(self, dim: int, rule=None, init_std: float = 0.01,
+                 seed: int = 0, show_coeff: float = 0.25,
+                 click_coeff: float = 9.0):
+        super().__init__(dim, rule=rule, init_std=init_std, seed=seed)
+        self.show_coeff = float(show_coeff)
+        self.click_coeff = float(click_coeff)
+        self._meta: Dict[int, dict] = {}   # key -> show/click/unseen
+
+    def _meta_of(self, key: int) -> dict:
+        return self._meta.setdefault(
+            int(key), {"show": 0.0, "click": 0.0, "unseen_days": 0.0})
+
+    def push(self, keys, grads, shows=None, clicks=None):
+        grads = np.asarray(grads, np.float32)
+        with self._lock:       # one critical section: grads + meta move
+            self._push_locked(keys, grads)   # together or not at all
+            n = len(keys)
+            shows = np.ones(n) if shows is None else np.asarray(shows)
+            clicks = np.zeros(n) if clicks is None else np.asarray(clicks)
+            for k, sh, ck in zip(keys, shows, clicks):
+                m = self._meta_of(k)
+                m["show"] += float(sh)
+                m["click"] += float(ck)
+                m["unseen_days"] = 0.0
+
+    def _score(self, m: dict) -> float:
+        return m["show"] * self.show_coeff + m["click"] * self.click_coeff
+
+    def show_click_score(self, key: int) -> float:
+        return self._score(self._meta_of(key))
+
+    def decay_and_shrink(self, decay_rate: float = 0.98,
+                         delete_threshold: float = 0.8,
+                         delete_after_unseen_days: float = 30.0) -> int:
+        """One accessor day-tick (reference ctr_accessor.cc:80-90):
+        decay show/click, age unseen rows, evict low-score/stale rows.
+        Returns the number of rows removed."""
+        removed = 0
+        with self._lock:
+            for key in list(self._rows):
+                m = self._meta_of(key)
+                m["show"] *= decay_rate
+                m["click"] *= decay_rate
+                m["unseen_days"] += 1.0
+                score = self._score(m)
+                if score < delete_threshold or \
+                        m["unseen_days"] > delete_after_unseen_days:
+                    self._rows.pop(key, None)
+                    self._states.pop(key, None)
+                    self._meta.pop(key, None)
+                    removed += 1
+        return removed
+
+    def state(self):
+        st = super().state()
+        st["meta"] = dict(self._meta)
+        return st
+
+    def load_state(self, st):
+        super().load_state(st)
+        self._meta = dict(st.get("meta", {}))
+
+
+class GraphTable:
+    """Graph-topology PS table (reference ``table/common_graph_table.h:365``
+    GraphTable): nodes with features, weighted adjacency, and the
+    sampling primitives GNN trainers pull through the PS — weighted
+    ``random_sample_neighbors``, uniform ``random_sample_nodes``, and
+    range scans (``pull_graph_list``)."""
+
+    def __init__(self, seed: int = 0):
+        self._adj: Dict[int, list] = {}       # src -> [(dst, weight)]
+        self._feat: Dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    def add_graph_node(self, ids, features=None):
+        with self._lock:
+            for i, nid in enumerate(ids):
+                self._adj.setdefault(int(nid), [])
+                if features is not None:
+                    self._feat[int(nid)] = np.asarray(features[i],
+                                                      np.float32)
+
+    def remove_graph_node(self, ids):
+        with self._lock:
+            for nid in ids:
+                self._adj.pop(int(nid), None)
+                self._feat.pop(int(nid), None)
+
+    def add_edges(self, src, dst, weights=None):
+        with self._lock:
+            for i, (s, d) in enumerate(zip(src, dst)):
+                w = 1.0 if weights is None else float(weights[i])
+                self._adj.setdefault(int(s), []).append((int(d), w))
+                self._adj.setdefault(int(d), [])
+
+    def load_edges(self, path: str, reverse: bool = False):
+        """'src\\tdst[\\tweight]' per line (reference load_edges)."""
+        src, dst, w = [], [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 2:
+                    continue
+                a, b = int(parts[0]), int(parts[1])
+                if reverse:
+                    a, b = b, a
+                src.append(a)
+                dst.append(b)
+                w.append(float(parts[2]) if len(parts) > 2 else 1.0)
+        self.add_edges(src, dst, w)
+        return len(src)
+
+    def random_sample_neighbors(self, node_ids, sample_size: int):
+        """Per node: ``sample_size`` neighbors, weighted without
+        replacement (falls back to all neighbors when fewer exist)."""
+        out = []
+        with self._lock:
+            for nid in node_ids:
+                nbrs = self._adj.get(int(nid), [])
+                if not nbrs:
+                    out.append(np.zeros((0,), np.int64))
+                    continue
+                ids = np.asarray([d for d, _ in nbrs], np.int64)
+                ws = np.asarray([w for _, w in nbrs], np.float64)
+                total = ws.sum()
+                if total <= 0:          # all-zero weights: uniform
+                    p = None
+                    k = min(sample_size, ids.size)
+                else:
+                    p = ws / total
+                    # without replacement needs k <= nonzero entries
+                    k = min(sample_size, int((ws > 0).sum()))
+                out.append(self._rng.choice(ids, size=k, replace=False,
+                                            p=p))
+        return out
+
+    def random_sample_nodes(self, sample_size: int) -> np.ndarray:
+        with self._lock:   # _rng is shared: mutate only under the lock
+            ids = np.fromiter(self._adj.keys(), np.int64,
+                              count=len(self._adj))
+            if ids.size == 0:
+                return ids
+            k = min(sample_size, ids.size)
+            return self._rng.choice(ids, size=k, replace=False)
+
+    def pull_graph_list(self, start: int, size: int):
+        with self._lock:
+            ids = sorted(self._adj)
+        return np.asarray(ids[start:start + size], np.int64)
+
+    def get_node_feat(self, ids) -> List[Optional[np.ndarray]]:
+        with self._lock:
+            return [self._feat.get(int(i)) for i in ids]
+
+    def __len__(self):
+        return len(self._adj)
+
+    def state(self):
+        with self._lock:
+            return {"adj": {k: list(v) for k, v in self._adj.items()},
+                    "feat": dict(self._feat)}
+
+    def load_state(self, st):
+        with self._lock:
+            self._adj = {int(k): list(v) for k, v in st["adj"].items()}
+            self._feat = dict(st.get("feat", {}))
 
 
 # ---------------------------------------------------------------------------
@@ -363,6 +592,15 @@ class PSServer:
         kw = {"cache_rows": cache_rows, "path": path} if ssd else {}
         self._tables[name] = cls(dim, rule=rule, seed=seed, **kw)
 
+    def add_ctr_table(self, name: str, dim: int, rule=None, seed=0,
+                      show_coeff: float = 0.25, click_coeff: float = 9.0):
+        self._tables[name] = CTRSparseTable(
+            dim, rule=rule, seed=seed, show_coeff=show_coeff,
+            click_coeff=click_coeff)
+
+    def add_graph_table(self, name: str, seed: int = 0):
+        self._tables[name] = GraphTable(seed=seed)
+
     def _handle(self, msg):
         op = msg[0]
         if op == "pull_dense":
@@ -377,6 +615,22 @@ class PSServer:
             return self._tables[msg[1]].pull(msg[2])
         if op == "push_sparse":
             self._tables[msg[1]].push(msg[2], msg[3])
+            return True
+        if op == "push_sparse_ctr":
+            self._tables[msg[1]].push(msg[2], msg[3], shows=msg[4],
+                                      clicks=msg[5])
+            return True
+        if op == "ctr_shrink":
+            return self._tables[msg[1]].decay_and_shrink(*msg[2:])
+        if op == "graph_sample_neighbors":
+            return self._tables[msg[1]].random_sample_neighbors(msg[2],
+                                                                msg[3])
+        if op == "graph_sample_nodes":
+            return self._tables[msg[1]].random_sample_nodes(msg[2])
+        if op == "graph_pull_list":
+            return self._tables[msg[1]].pull_graph_list(msg[2], msg[3])
+        if op == "graph_add_edges":
+            self._tables[msg[1]].add_edges(msg[2], msg[3], msg[4])
             return True
         if op == "barrier":
             target = msg[1]
@@ -466,6 +720,9 @@ class PSServer:
         self._thread.join()
 
     def stop(self):
+        for t in self._tables.values():
+            if hasattr(t, "close"):
+                t.close()   # SSD tables unlink their spill files
         if self._server is not None:
             self._server.shutdown()
             # sever in-flight connections so clients observe the death
@@ -577,6 +834,48 @@ class PSClient:
             if idx.size:
                 self._call(self._endpoints[shard],
                            ("push_sparse", table, keys[idx], grads[idx]))
+
+    def push_sparse_ctr(self, table: str, keys, grads, shows=None,
+                        clicks=None) -> None:
+        """CTR push: gradients + show/click increments
+        (reference CtrCommonPushValue)."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        n = len(self._endpoints)
+        shows = np.ones(keys.size) if shows is None else np.asarray(shows)
+        clicks = np.zeros(keys.size) if clicks is None             else np.asarray(clicks)
+        for shard in range(n):
+            idx = np.nonzero(keys % n == shard)[0]
+            if idx.size:
+                self._call(self._endpoints[shard],
+                           ("push_sparse_ctr", table, keys[idx],
+                            grads[idx], shows[idx], clicks[idx]))
+
+    def ctr_shrink(self, table: str, decay_rate: float = 0.98,
+                   delete_threshold: float = 0.8,
+                   delete_after_unseen_days: float = 30.0) -> int:
+        return sum(self._call(ep, ("ctr_shrink", table, decay_rate,
+                                   delete_threshold,
+                                   delete_after_unseen_days))
+                   for ep in self._endpoints)
+
+    # -- graph -------------------------------------------------------------
+    def graph_add_edges(self, table: str, src, dst, weights=None):
+        # single-shard graph placement (reference shards by node id; the
+        # shim keeps one topology table per server entry 0)
+        self._call(self._endpoints[0],
+                   ("graph_add_edges", table, list(map(int, src)),
+                    list(map(int, dst)),
+                    None if weights is None else list(weights)))
+
+    def sample_neighbors(self, table: str, node_ids, sample_size: int):
+        return self._call(self._endpoints[0],
+                          ("graph_sample_neighbors", table,
+                           list(map(int, node_ids)), int(sample_size)))
+
+    def sample_nodes(self, table: str, sample_size: int):
+        return self._call(self._endpoints[0],
+                          ("graph_sample_nodes", table, int(sample_size)))
 
     def push_sparse_async(self, table: str, keys, grads) -> Future:
         return self._pool.submit(self.push_sparse, table, keys, grads)

@@ -232,8 +232,13 @@ class Predictor:
             self._out_dtype = src._out_dtype
             self._dequant = src._dequant
             self._reduced_keys = getattr(src, "_reduced_keys", set())
-            if getattr(src, "_mat_params", None) is not None:
-                self._mat_params = src._mat_params  # share, don't redo
+            if self._dequant or self._out_dtype is not None:
+                # materialize in the SOURCE first so every clone —
+                # including pre-warm clones made before any run() —
+                # shares ONE materialized dict instead of each holding
+                # a private full-precision copy
+                self._mat_params = src._materialize_params()
+                self._params = src._params
             self._inputs = {n: Tensor(n) for n in self._input_names}
             self._outputs = {n: Tensor(n) for n in self._output_names}
             return

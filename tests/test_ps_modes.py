@@ -242,3 +242,79 @@ def test_ssd_table_through_ps_server(tmp_path):
         assert srv._tables["emb"].resident_rows <= 8
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# CTR accessor table (reference table/ctr_accessor.h:27) + graph table
+# (reference table/common_graph_table.h:365)
+# ---------------------------------------------------------------------------
+def test_ctr_table_decay_and_shrink():
+    import numpy as np
+    from paddle_tpu.distributed.fleet.ps import CTRSparseTable
+    t = CTRSparseTable(4, show_coeff=0.25, click_coeff=9.0)
+    hot, cold = np.array([1, 2]), np.array([7, 8])
+    g = np.zeros((2, 4), np.float32)
+    t.push(cold, g, shows=[1, 1], clicks=[0, 0])
+    for _ in range(5):
+        t.push(hot, g, shows=[4, 4], clicks=[1, 1])
+    assert t.show_click_score(1) > t.show_click_score(7)
+    # one day-tick: cold rows (score 0.25*0.98 < 0.8) evict, hot stay
+    removed = t.decay_and_shrink(decay_rate=0.98, delete_threshold=0.8)
+    assert removed == 2 and len(t) == 2
+    # unseen aging: after 30 untouched days even hot rows evict
+    for _ in range(31):
+        t.decay_and_shrink(delete_threshold=0.0)
+    assert len(t) == 0
+    # metadata survives a state round trip
+    t.push(hot, g, shows=[2, 2], clicks=[1, 1])
+    st = t.state()
+    t2 = CTRSparseTable(4)
+    t2.load_state(st)
+    assert t2.show_click_score(1) == t.show_click_score(1)
+
+
+def test_graph_table_sampling_and_ps_round_trip(tmp_path):
+    import numpy as np
+    from paddle_tpu.distributed.fleet.ps import (GraphTable, PSServer,
+                                                 PSClient)
+    g = GraphTable(seed=0)
+    g.add_graph_node([0, 1, 2, 3], features=np.eye(4, dtype=np.float32))
+    g.add_edges([0, 0, 0, 1], [1, 2, 3, 2], weights=[100.0, 1.0, 1.0, 1.0])
+    # weighted sampling: node 0's heavy edge (->1) dominates 1-samples
+    hits = sum(int(g.random_sample_neighbors([0], 1)[0][0] == 1)
+               for _ in range(50))
+    assert hits > 35, hits
+    s3 = g.random_sample_neighbors([0], 3)[0]
+    assert sorted(s3.tolist()) == [1, 2, 3]     # without replacement
+    assert g.random_sample_neighbors([3], 2)[0].size == 0  # no out-edges
+    assert g.pull_graph_list(1, 2).tolist() == [1, 2]
+    assert set(g.random_sample_nodes(4).tolist()) == {0, 1, 2, 3}
+    # file loading
+    p = tmp_path / "edges.txt"
+    p.write_text("10 11 2.0\n10 12\n")
+    assert g.load_edges(str(p)) == 2
+    assert sorted(g.random_sample_neighbors([10], 5)[0].tolist()) == \
+        [11, 12]
+
+    # through the PS wire
+    ep = f"127.0.0.1:{free_port()}"
+    srv = PSServer(ep)
+    srv.add_graph_table("graph")
+    srv.add_ctr_table("ctr_emb", 4)
+    srv.start()
+    try:
+        cli = PSClient([ep])
+        cli.graph_add_edges("graph", [5, 5], [6, 7])
+        nbrs = cli.sample_neighbors("graph", [5], 2)[0]
+        assert sorted(nbrs.tolist()) == [6, 7]
+        assert set(cli.sample_nodes("graph", 3).tolist()) <= {5, 6, 7}
+        keys = np.array([11, 12])
+        cli.push_sparse_ctr("ctr_emb", keys,
+                            np.ones((2, 4), np.float32),
+                            shows=[5, 5], clicks=[2, 2])
+        removed = cli.ctr_shrink("ctr_emb", delete_threshold=0.1)
+        assert removed == 0
+        removed = cli.ctr_shrink("ctr_emb", delete_threshold=1e9)
+        assert removed == 2
+    finally:
+        srv.stop()
