@@ -30,6 +30,18 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
     persistable params through its LayerHelper)."""
     from .fleet.meta_parallel.mp_layers import (
         VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear)
+    from ..static.mode import in_dynamic_mode
+    from ..static.program import Variable as _StaticVariable
+    if isinstance(x, _StaticVariable) or not in_dynamic_mode():
+        raise NotImplementedError(
+            "paddle.distributed.split under static-graph capture is "
+            "not supported in this runtime: static tensor parallelism "
+            "goes through GSPMD parameter shardings instead of "
+            "per-rank program rewriting. Use one of: (a) the dygraph "
+            "parallel layers (this same split() in dynamic mode), "
+            "(b) fleet.build_sharded_trainer(param_specs=...) for the "
+            "compiled static path, or (c) fleet.auto.shard(model, mesh) "
+            "to derive the shardings automatically.")
     if name is None:
         # key unnamed layers by their call site so two different unnamed
         # projections never share parameters, while the same line reuses

@@ -173,3 +173,12 @@ def test_data_parallel_input_sharding():
     sh = arrs[0].sharding
     assert isinstance(sh, NamedSharding)
     assert sh.spec == P("dp")
+
+
+def test_static_split_raises_with_guidance():
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("xs", [4, 16], "float32")
+        with pytest.raises(NotImplementedError,
+                           match="sharded_trainer|auto.shard"):
+            dist.split(x, (16, 32), "linear", axis=1, num_partitions=2)
