@@ -15,7 +15,10 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+import numpy as np
+
 import jax
+import jax.numpy as jnp
 
 __all__ = ["Generator", "default_generator", "seed", "get_rng_state",
            "set_rng_state", "rng_scope", "RNGStatesTracker", "get_rng_tracker"]
@@ -43,10 +46,12 @@ class Generator:
         # no backend is reachable)
         self._seed = seed_val
         self._key = None
+        self._counter = 0
 
     def seed(self, seed_val: int):
         self._seed = seed_val
-        self._key = jax.random.PRNGKey(seed_val)
+        self._key = None        # counter-derived stream (see next_key)
+        self._counter = 0
         return self
 
     manual_seed = seed
@@ -59,17 +64,48 @@ class Generator:
         if scope is not None:
             return scope.next_key()
         if self._key is None:
-            self._key = jax.random.PRNGKey(self._seed)
+            # seed-derived stream: build the threefry key ON HOST from
+            # (seed, counter) — distinct key data means an independent
+            # stream, and no tiny device op lands between training-step
+            # dispatches (each such op serializes with the big execute
+            # on remote-runtime transports; measured ~3 ms/step).  The
+            # seed mixes through splitmix64 and the top bit is forced so
+            # these keys can never collide with jax.random.PRNGKey(n)
+            # (= [0, n]) keys rooted elsewhere (e.g. the mp RNG tracker)
+            self._counter += 1
+            mixed = _splitmix64(self._seed)
+            hi = ((mixed >> 32) | 0x80000000) & 0xFFFFFFFF
+            lo = (mixed ^ self._counter) & 0xFFFFFFFF
+            return jnp.asarray(np.array([hi, lo], np.uint32))
         self._key, sub = jax.random.split(self._key)
         return sub
 
     def get_state(self):
         if self._key is None:
-            self._key = jax.random.PRNGKey(self._seed)
+            # counter-stream state: exactly resumable via set_state
+            return {"seed": self._seed, "counter": self._counter}
         return self._key
 
     def set_state(self, key):
-        self._key = key
+        if isinstance(key, dict):
+            if not {"seed", "counter"} <= set(key):
+                raise ValueError(
+                    "generator state dict must have 'seed' and "
+                    f"'counter' keys, got {sorted(key)}")
+            self._seed = int(key["seed"])
+            self._counter = int(key["counter"])
+            self._key = None
+        else:
+            self._key = key
+
+
+def _splitmix64(x: int) -> int:
+    """Host-side 64-bit mix (splitmix64 finalizer): full-seed diffusion
+    for the counter-derived key stream."""
+    x = (x + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return x ^ (x >> 31)
 
 
 default_generator = Generator(0)

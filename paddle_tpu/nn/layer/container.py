@@ -75,6 +75,8 @@ class LayerList(Layer):
         self._sub_layers.clear()
         for i, l in enumerate(layers):
             self._sub_layers[str(i)] = l
+        from ..layer_base import Layer as _L
+        _L._struct_version += 1
 
     def extend(self, layers):
         for layer in layers:
@@ -117,6 +119,8 @@ class LayerDict(Layer):
 
     def __delitem__(self, key):
         del self._sub_layers[key]
+        from ..layer_base import Layer as _L
+        _L._struct_version += 1
 
     def __len__(self):
         return len(self._sub_layers)
@@ -132,6 +136,8 @@ class LayerDict(Layer):
 
     def pop(self, key):
         layer = self._sub_layers.pop(key)
+        from ..layer_base import Layer as _L
+        _L._struct_version += 1
         return layer
 
     def keys(self):

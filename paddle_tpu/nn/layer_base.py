@@ -47,6 +47,11 @@ class Layer:
     # ------------------------------------------------------------------
     # registration
     # ------------------------------------------------------------------
+    # bumped on every registration change anywhere; functional-state ref
+    # caches key on it (coarse, but structure changes are rare and the
+    # walk they replace runs every training step)
+    _struct_version = 0
+
     def __setattr__(self, name, value):
         params = self.__dict__.get("_parameters")
         subs = self.__dict__.get("_sub_layers")
@@ -58,20 +63,24 @@ class Layer:
             subs.pop(name, None) if subs else None
             if bufs:
                 bufs.pop(name, None)
+            Layer._struct_version += 1
             object.__setattr__(self, name, value)
         elif isinstance(value, Layer):
             subs[name] = value
             if params:
                 params.pop(name, None)
+            Layer._struct_version += 1
             object.__setattr__(self, name, value)
         else:
             if params and name in params and value is None:
                 params.pop(name)
+                Layer._struct_version += 1
             if bufs is not None and name in bufs:
                 if isinstance(value, Tensor):
                     bufs[name] = value
                 else:
                     bufs.pop(name)
+                Layer._struct_version += 1
             object.__setattr__(self, name, value)
 
     def __getattr__(self, name):
@@ -84,6 +93,7 @@ class Layer:
             f"'{type(self).__name__}' object has no attribute '{name}'")
 
     def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        Layer._struct_version += 1
         if parameter is None:
             self._parameters[name] = None
         else:
@@ -92,12 +102,14 @@ class Layer:
         return parameter
 
     def add_sublayer(self, name: str, sublayer: "Layer"):
+        Layer._struct_version += 1
         self._sub_layers[name] = sublayer
         object.__setattr__(self, name, sublayer)
         return sublayer
 
     def register_buffer(self, name: str, tensor: Optional[Tensor],
                         persistable: bool = True):
+        Layer._struct_version += 1
         if tensor is not None and not isinstance(tensor, Tensor):
             tensor = to_tensor(tensor)
         self._buffers[name] = tensor
@@ -321,22 +333,35 @@ class Layer:
     # ------------------------------------------------------------------
     # functional state bridge (jit path)
     # ------------------------------------------------------------------
+    def _functional_refs(self):
+        """Cached (name, tensor) lists for the jit-path state bridge:
+        the recursive walk costs ~10 ms/step on a ResNet50-sized tree,
+        paid every training step without this."""
+        cache = self.__dict__.get("_fn_ref_cache")
+        cv = Layer._struct_version
+        if cache is not None and cache[0] == cv:
+            return cache[1], cache[2]
+        prefs = dict(self.named_parameters())
+        brefs = dict(self.named_buffers())
+        object.__setattr__(self, "_fn_ref_cache", (cv, prefs, brefs))
+        return prefs, brefs
+
     def functional_state(self):
         """Return ({name: jax.Array params}, {name: jax.Array buffers})."""
-        params = {n: p._data for n, p in self.named_parameters()}
-        buffers = {n: b._data for n, b in self.named_buffers()}
+        prefs, brefs = self._functional_refs()
+        params = {n: p._data for n, p in prefs.items()}
+        buffers = {n: b._data for n, b in brefs.items()}
         return params, buffers
 
     def load_functional_state(self, params=None, buffers=None):
         """Rebind arrays (traced or concrete) into the live tensors."""
+        prefs, brefs = self._functional_refs()
         if params:
-            lookup = dict(self.named_parameters())
             for n, a in params.items():
-                lookup[n]._data = a
+                prefs[n]._data = a
         if buffers:
-            lookup = dict(self.named_buffers())
             for n, a in buffers.items():
-                lookup[n]._data = a
+                brefs[n]._data = a
 
     def full_name(self):
         return self._name_scope

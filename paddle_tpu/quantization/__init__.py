@@ -132,6 +132,8 @@ def _replace_linears(layer: Layer, in_scales: Optional[Dict] = None):
                 else np.asarray(sub.bias._data)
             in_scale = None if in_scales is None else \
                 in_scales.get(id(sub))
+            from ..nn.layer_base import Layer as _L
+            _L._struct_version += 1
             layer._sub_layers[attr] = QuantizedLinear(
                 q, scales, b, in_scale=in_scale)
         else:
