@@ -100,10 +100,13 @@ def test_causal_cross_attention_gated_off(monkeypatch):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_attention_qkv_packed(force_pallas, causal):
-    # packed projection-output entry: same numbers as split + generic
+@pytest.mark.parametrize("H,D", [(4, 64), (8, 32), (2, 128)])
+def test_flash_attention_qkv_packed(force_pallas, causal, H, D):
+    # packed projection-output entry: same numbers as split + generic,
+    # across the head-packing regimes (P = 128//d heads per column
+    # block: 2 at d=64, 4 at d=32, 1 at d=128)
     rs = np.random.RandomState(3)
-    B, T, H, D = 2, 256, 4, 64
+    B, T = 2, 256
     qkv = jnp.asarray(rs.rand(B, T, 3 * H * D), jnp.float32)
     out = fa.flash_attention_qkv(qkv, H, causal=causal)
     q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, D), 3, axis=2)
