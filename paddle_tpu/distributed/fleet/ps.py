@@ -422,12 +422,16 @@ class GraphTable:
                 self._adj.pop(int(nid), None)
                 self._feat.pop(int(nid), None)
 
-    def add_edges(self, src, dst, weights=None):
+    def add_edges(self, src, dst, weights=None, register_dst: bool = True):
+        """``register_dst=False`` when the table is one shard of a
+        node-id-sharded graph: the dst node is owned by (and registered
+        on) ``dst % n_shards``'s server, not this one."""
         with self._lock:
             for i, (s, d) in enumerate(zip(src, dst)):
                 w = 1.0 if weights is None else float(weights[i])
                 self._adj.setdefault(int(s), []).append((int(d), w))
-                self._adj.setdefault(int(d), [])
+                if register_dst:
+                    self._adj.setdefault(int(d), [])
 
     def load_edges(self, path: str, reverse: bool = False):
         """'src\\tdst[\\tweight]' per line (reference load_edges)."""
@@ -630,8 +634,17 @@ class PSServer:
         if op == "graph_pull_list":
             return self._tables[msg[1]].pull_graph_list(msg[2], msg[3])
         if op == "graph_add_edges":
-            self._tables[msg[1]].add_edges(msg[2], msg[3], msg[4])
+            self._tables[msg[1]].add_edges(
+                msg[2], msg[3], msg[4],
+                register_dst=msg[5] if len(msg) > 5 else True)
             return True
+        if op == "graph_add_nodes":
+            self._tables[msg[1]].add_graph_node(msg[2], msg[3])
+            return True
+        if op == "graph_len":
+            return len(self._tables[msg[1]])
+        if op == "graph_get_feat":
+            return self._tables[msg[1]].get_node_feat(msg[2])
         if op == "barrier":
             target = msg[1]
             with self._barrier_cv:
@@ -860,22 +873,105 @@ class PSClient:
                    for ep in self._endpoints)
 
     # -- graph -------------------------------------------------------------
+    # Graph storage shards by node id (``node % n_servers``), the same
+    # routing every sparse key uses (reference common_graph_table.h:365
+    # get_partition/shard_num).  Each server owns the adjacency lists and
+    # features of its resident nodes; cross-shard ops fan out and merge.
     def graph_add_edges(self, table: str, src, dst, weights=None):
-        # single-shard graph placement (reference shards by node id; the
-        # shim keeps one topology table per server entry 0)
-        self._call(self._endpoints[0],
-                   ("graph_add_edges", table, list(map(int, src)),
-                    list(map(int, dst)),
-                    None if weights is None else list(weights)))
+        src = np.asarray(list(map(int, src)), np.int64)
+        dst = np.asarray(list(map(int, dst)), np.int64)
+        ws = None if weights is None else np.asarray(list(weights),
+                                                     np.float64)
+        n = len(self._endpoints)
+        for shard in range(n):
+            idx = np.nonzero(src % n == shard)[0]
+            if idx.size:
+                self._call(self._endpoints[shard],
+                           ("graph_add_edges", table,
+                            src[idx].tolist(), dst[idx].tolist(),
+                            None if ws is None else ws[idx].tolist(),
+                            False))
+            # dst nodes register on their OWN shard (they own no edge
+            # here, but must exist for node sampling / range scans)
+            didx = np.nonzero(dst % n == shard)[0]
+            if didx.size:
+                self._call(self._endpoints[shard],
+                           ("graph_add_nodes", table,
+                            np.unique(dst[didx]).tolist(), None))
+
+    def graph_add_nodes(self, table: str, ids, features=None):
+        ids = np.asarray(list(map(int, ids)), np.int64)
+        feats = None if features is None else np.asarray(features,
+                                                         np.float32)
+        n = len(self._endpoints)
+        for shard in range(n):
+            idx = np.nonzero(ids % n == shard)[0]
+            if idx.size:
+                self._call(self._endpoints[shard],
+                           ("graph_add_nodes", table, ids[idx].tolist(),
+                            None if feats is None else feats[idx]))
 
     def sample_neighbors(self, table: str, node_ids, sample_size: int):
-        return self._call(self._endpoints[0],
-                          ("graph_sample_neighbors", table,
-                           list(map(int, node_ids)), int(sample_size)))
+        node_ids = np.asarray(list(map(int, node_ids)), np.int64)
+        n = len(self._endpoints)
+        out: List[Optional[np.ndarray]] = [None] * node_ids.size
+        futs = []
+        for shard in range(n):
+            idx = np.nonzero(node_ids % n == shard)[0]
+            if idx.size:
+                futs.append((idx, self._pool.submit(
+                    self._call, self._endpoints[shard],
+                    ("graph_sample_neighbors", table,
+                     node_ids[idx].tolist(), int(sample_size)))))
+        for idx, fut in futs:          # merge in query order
+            for pos, res in zip(idx, fut.result()):
+                out[int(pos)] = res
+        return out
 
     def sample_nodes(self, table: str, sample_size: int):
-        return self._call(self._endpoints[0],
-                          ("graph_sample_nodes", table, int(sample_size)))
+        """Uniform over the global node set: per-shard counts allocate
+        the sample multivariate-hypergeometrically, then each shard
+        draws its quota without replacement."""
+        n = len(self._endpoints)
+        counts = [self._call(ep, ("graph_len", table))
+                  for ep in self._endpoints]
+        total = sum(counts)
+        k = min(int(sample_size), total)
+        if k == 0:
+            return np.zeros((0,), np.int64)
+        quota = np.random.default_rng().multivariate_hypergeometric(
+            counts, k)
+        parts = [self._call(self._endpoints[s],
+                            ("graph_sample_nodes", table, int(q)))
+                 for s, q in enumerate(quota) if q]
+        return np.concatenate(parts) if parts else np.zeros((0,), np.int64)
+
+    def pull_graph_list(self, table: str, start: int, size: int):
+        """Global sorted-id range scan: merge the shards' sorted lists."""
+        parts = [self._call(ep, ("graph_pull_list", table, 0, 1 << 62))
+                 for ep in self._endpoints]
+        allids = np.sort(np.concatenate(
+            [np.asarray(p, np.int64).reshape(-1) for p in parts]))
+        return allids[start:start + size]
+
+    def get_node_feat(self, table: str, ids):
+        ids = np.asarray(list(map(int, ids)), np.int64)
+        n = len(self._endpoints)
+        out: List[Optional[np.ndarray]] = [None] * ids.size
+        for shard in range(n):
+            idx = np.nonzero(ids % n == shard)[0]
+            if idx.size:
+                feats = self._call(self._endpoints[shard],
+                                   ("graph_get_feat", table,
+                                    ids[idx].tolist()))
+                for pos, f in zip(idx, feats):
+                    out[int(pos)] = f
+        return out
+
+    def graph_shard_sizes(self, table: str) -> List[int]:
+        """Per-server resident-node counts (placement observability)."""
+        return [self._call(ep, ("graph_len", table))
+                for ep in self._endpoints]
 
     def push_sparse_async(self, table: str, keys, grads) -> Future:
         return self._pool.submit(self.push_sparse, table, keys, grads)

@@ -318,3 +318,51 @@ def test_graph_table_sampling_and_ps_round_trip(tmp_path):
         assert removed == 2
     finally:
         srv.stop()
+
+
+def test_graph_table_sharded_across_two_servers():
+    """Node-id-sharded graph placement (reference
+    common_graph_table.h:365 shards by node id across PS servers): the
+    topology spreads over both shards, sampling fans out and merges."""
+    import numpy as np
+    from paddle_tpu.distributed.fleet.ps import PSServer, PSClient
+    eps = [f"127.0.0.1:{free_port()}" for _ in range(2)]
+    srvs = [PSServer(ep, shard_id=i).start()
+            for i, ep in enumerate(eps)]
+    for s in srvs:
+        s.add_graph_table("g", seed=s.shard_id)
+    try:
+        cli = PSClient(eps)
+        # even src nodes (0, 2, 4) land on shard 0; odd (1, 3) on shard 1
+        src = [0, 0, 1, 2, 3, 4]
+        dst = [1, 2, 2, 3, 4, 0]
+        cli.graph_add_edges("g", src, dst, weights=[1.0] * 6)
+        sizes = cli.graph_shard_sizes("g")
+        # shard 0 owns nodes {0, 2, 4}, shard 1 owns {1, 3}: the graph
+        # is genuinely spread, not pinned to server 0
+        assert sizes == [3, 2], sizes
+        per_server_rows = [len(s._tables["g"]) for s in srvs]
+        assert per_server_rows == [3, 2], per_server_rows
+        # cross-shard neighbor sampling merges in query order
+        nbrs = cli.sample_neighbors("g", [0, 1, 3, 4], 5)
+        assert sorted(nbrs[0].tolist()) == [1, 2]
+        assert nbrs[1].tolist() == [2]
+        assert nbrs[2].tolist() == [4]
+        assert nbrs[3].tolist() == [0]
+        # global uniform node sampling covers both shards
+        seen = set()
+        for _ in range(20):
+            seen |= set(cli.sample_nodes("g", 5).tolist())
+        assert seen == {0, 1, 2, 3, 4}
+        # global range scan merges the shards' sorted id spaces
+        assert cli.pull_graph_list("g", 1, 3).tolist() == [1, 2, 3]
+        # features live with their owning shard
+        cli.graph_add_nodes("g", [0, 1], features=np.eye(2,
+                                                        dtype=np.float32))
+        f = cli.get_node_feat("g", [1, 0])
+        assert f[0].tolist() == [0.0, 1.0] and f[1].tolist() == [1.0, 0.0]
+        assert len(srvs[0]._tables["g"]._feat) == 1
+        assert len(srvs[1]._tables["g"]._feat) == 1
+    finally:
+        for s in srvs:
+            s.stop()
