@@ -119,10 +119,21 @@ def seed(seed_val: int):
 
 
 def get_rng_state():
+    """Opaque resumable RNG state for :func:`set_rng_state`.
+
+    In the default (counter-derived key stream) mode this is a
+    ``{"seed": int, "counter": int}`` dict, NOT a PRNGKey array — do not
+    feed it to ``jax.random.*`` directly; it only round-trips through
+    ``set_rng_state``/``Generator.set_state``.  After an explicit
+    ``Generator.set_state(key_array)`` the split-chain mode returns the
+    raw PRNGKey array as before.
+    """
     return default_generator.get_state()
 
 
 def set_rng_state(key):
+    """Restore state captured by :func:`get_rng_state` (dict or PRNGKey
+    array — see get_rng_state for the two forms)."""
     default_generator.set_state(key)
 
 

@@ -33,15 +33,10 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
     from ..static.mode import in_dynamic_mode
     from ..static.program import Variable as _StaticVariable
     if isinstance(x, _StaticVariable) or not in_dynamic_mode():
-        raise NotImplementedError(
-            "paddle.distributed.split under static-graph capture is "
-            "not supported in this runtime: static tensor parallelism "
-            "goes through GSPMD parameter shardings instead of "
-            "per-rank program rewriting. Use one of: (a) the dygraph "
-            "parallel layers (this same split() in dynamic mode), "
-            "(b) fleet.build_sharded_trainer(param_specs=...) for the "
-            "compiled static path, or (c) fleet.auto.shard(model, mesh) "
-            "to derive the shardings automatically.")
+        return _static_split(x, size, operation, axis=axis,
+                             gather_out=gather_out,
+                             weight_attr=weight_attr, bias_attr=bias_attr,
+                             name=name)
     if name is None:
         # key unnamed layers by their call site so two different unnamed
         # projections never share parameters, while the same line reuses
@@ -70,6 +65,66 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
                 f"unsupported split operation {operation!r}/axis {axis}")
         _split_layers[key] = layer
     return layer(x)
+
+
+def _static_split(x, size, operation, axis=0, gather_out=True,
+                  weight_attr=None, bias_attr=None, name=None):
+    """Static-capture lowering of ``distributed.split`` (reference
+    ``collective.py:1094`` _parallel_linear / :1233 split).
+
+    The reference rewrites the per-rank program with sliced weights and
+    hand-placed c_allreduce/c_concat ops.  The GSPMD translation keeps
+    the captured program LOGICALLY full-size: the layer's parameters are
+    registered in ``program.param_specs`` with their Megatron placement
+    over the ``mp`` mesh axis — column-parallel weight ``(None, 'mp')``,
+    row-parallel weight ``('mp', None)``, vocab-parallel embedding
+    ``('mp', None)`` — and the Executor (armed via
+    ``CompiledProgram.with_hybrid_parallel(mesh)``) places the params so
+    the partitioner inserts the same collectives the reference splices
+    in by hand.  The math is bit-identical to the unsplit program, which
+    is exactly the reference's gather_out=True contract."""
+    from .. import nn
+    from ..static.program import default_main_program
+    prog = default_main_program()
+    if not gather_out:
+        import warnings
+        warnings.warn(
+            "static distributed.split(gather_out=False): the GSPMD "
+            "lowering keeps the program logically full-size, so the "
+            "output has the FULL feature dimension (the reference "
+            "returns the per-rank shard). Chained col(gather_out=False)"
+            " -> row(input_is_parallel=True) stacks compute the same "
+            "math here; code that reshapes to per-shard sizes must use "
+            "the dygraph path", UserWarning, stacklevel=3)
+    if name is None:
+        import inspect
+        frame = inspect.currentframe().f_back.f_back
+        name = f"split@{frame.f_code.co_filename}:{frame.f_lineno}"
+    # cache lives ON the program so discarded Programs free their layers
+    cache = prog.__dict__.setdefault("_split_layer_cache", {})
+    key = f"{name}_{operation}_{size}_{axis}"
+    layer = cache.get(key)
+    if layer is None:
+        if operation == "embedding":
+            layer = nn.Embedding(size[0], size[1], weight_attr=weight_attr)
+        elif operation == "linear":
+            layer = nn.Linear(size[0], size[1], weight_attr=weight_attr,
+                              bias_attr=bias_attr)
+        else:
+            raise ValueError(
+                f"unsupported split operation {operation!r}/axis {axis}")
+        cache[key] = layer
+    if operation == "embedding":
+        specs = {layer.weight.name: ("mp", None)}
+    elif axis == 1:   # column parallel: out features over mp
+        specs = {layer.weight.name: (None, "mp")}
+        if getattr(layer, "bias", None) is not None:
+            specs[layer.bias.name] = ("mp",)
+    else:             # row parallel: in features over mp; bias replicated
+        specs = {layer.weight.name: ("mp", None)}
+    out = layer(x)
+    prog.param_specs.update(specs)
+    return out
 
 
 def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):

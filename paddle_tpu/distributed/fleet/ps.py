@@ -762,7 +762,8 @@ class PSClient:
     shard across servers by ``key % n_servers``; dense tables live on
     ``hash(name) % n_servers``."""
 
-    def __init__(self, endpoints: List[str], timeout: float = 60.0):
+    def __init__(self, endpoints: List[str], timeout: float = 60.0,
+                 seed: int = 0):
         self._endpoints = list(endpoints)
         self._timeout = float(timeout)
         self._socks: Dict[str, socket.socket] = {}
@@ -771,6 +772,9 @@ class PSClient:
         self._locks: Dict[str, threading.Lock] = {
             ep: threading.Lock() for ep in self._endpoints}
         self._pool = ThreadPoolExecutor(max_workers=4)
+        # seeded so sample_nodes' quota draws reproduce like the seeded
+        # per-table samplers they compose with
+        self._rng = np.random.default_rng(seed)
 
     def _call(self, ep: str, msg):
         with self._locks[ep]:
@@ -883,33 +887,41 @@ class PSClient:
         ws = None if weights is None else np.asarray(list(weights),
                                                      np.float64)
         n = len(self._endpoints)
+        futs = []
         for shard in range(n):
             idx = np.nonzero(src % n == shard)[0]
             if idx.size:
-                self._call(self._endpoints[shard],
-                           ("graph_add_edges", table,
-                            src[idx].tolist(), dst[idx].tolist(),
-                            None if ws is None else ws[idx].tolist(),
-                            False))
+                futs.append(self._pool.submit(
+                    self._call, self._endpoints[shard],
+                    ("graph_add_edges", table,
+                     src[idx].tolist(), dst[idx].tolist(),
+                     None if ws is None else ws[idx].tolist(), False)))
             # dst nodes register on their OWN shard (they own no edge
             # here, but must exist for node sampling / range scans)
             didx = np.nonzero(dst % n == shard)[0]
             if didx.size:
-                self._call(self._endpoints[shard],
-                           ("graph_add_nodes", table,
-                            np.unique(dst[didx]).tolist(), None))
+                futs.append(self._pool.submit(
+                    self._call, self._endpoints[shard],
+                    ("graph_add_nodes", table,
+                     np.unique(dst[didx]).tolist(), None)))
+        for f in futs:
+            f.result()
 
     def graph_add_nodes(self, table: str, ids, features=None):
         ids = np.asarray(list(map(int, ids)), np.int64)
         feats = None if features is None else np.asarray(features,
                                                          np.float32)
         n = len(self._endpoints)
+        futs = []
         for shard in range(n):
             idx = np.nonzero(ids % n == shard)[0]
             if idx.size:
-                self._call(self._endpoints[shard],
-                           ("graph_add_nodes", table, ids[idx].tolist(),
-                            None if feats is None else feats[idx]))
+                futs.append(self._pool.submit(
+                    self._call, self._endpoints[shard],
+                    ("graph_add_nodes", table, ids[idx].tolist(),
+                     None if feats is None else feats[idx])))
+        for f in futs:
+            f.result()
 
     def sample_neighbors(self, table: str, node_ids, sample_size: int):
         node_ids = np.asarray(list(map(int, node_ids)), np.int64)
@@ -932,24 +944,30 @@ class PSClient:
         """Uniform over the global node set: per-shard counts allocate
         the sample multivariate-hypergeometrically, then each shard
         draws its quota without replacement."""
-        n = len(self._endpoints)
-        counts = [self._call(ep, ("graph_len", table))
-                  for ep in self._endpoints]
+        counts = [f.result() for f in [
+            self._pool.submit(self._call, ep, ("graph_len", table))
+            for ep in self._endpoints]]
         total = sum(counts)
         k = min(int(sample_size), total)
         if k == 0:
             return np.zeros((0,), np.int64)
-        quota = np.random.default_rng().multivariate_hypergeometric(
-            counts, k)
-        parts = [self._call(self._endpoints[s],
-                            ("graph_sample_nodes", table, int(q)))
-                 for s, q in enumerate(quota) if q]
+        quota = self._rng.multivariate_hypergeometric(counts, k)
+        futs = [self._pool.submit(self._call, self._endpoints[s],
+                                  ("graph_sample_nodes", table, int(q)))
+                for s, q in enumerate(quota) if q]
+        parts = [f.result() for f in futs]
         return np.concatenate(parts) if parts else np.zeros((0,), np.int64)
 
     def pull_graph_list(self, table: str, start: int, size: int):
-        """Global sorted-id range scan: merge the shards' sorted lists."""
-        parts = [self._call(ep, ("graph_pull_list", table, 0, 1 << 62))
-                 for ep in self._endpoints]
+        """Global sorted-id range scan.  Each shard's contribution to
+        the window [start, start+size) lies within its own first
+        start+size sorted ids, so only that prefix ships per shard
+        (never the whole id space) before the merge."""
+        need = int(start) + int(size)
+        futs = [self._pool.submit(self._call, ep,
+                                  ("graph_pull_list", table, 0, need))
+                for ep in self._endpoints]
+        parts = [f.result() for f in futs]
         allids = np.sort(np.concatenate(
             [np.asarray(p, np.int64).reshape(-1) for p in parts]))
         return allids[start:start + size]
@@ -958,14 +976,16 @@ class PSClient:
         ids = np.asarray(list(map(int, ids)), np.int64)
         n = len(self._endpoints)
         out: List[Optional[np.ndarray]] = [None] * ids.size
+        futs = []
         for shard in range(n):
             idx = np.nonzero(ids % n == shard)[0]
             if idx.size:
-                feats = self._call(self._endpoints[shard],
-                                   ("graph_get_feat", table,
-                                    ids[idx].tolist()))
-                for pos, f in zip(idx, feats):
-                    out[int(pos)] = f
+                futs.append((idx, self._pool.submit(
+                    self._call, self._endpoints[shard],
+                    ("graph_get_feat", table, ids[idx].tolist()))))
+        for idx, fut in futs:
+            for pos, f in zip(idx, fut.result()):
+                out[int(pos)] = f
         return out
 
     def graph_shard_sizes(self, table: str) -> List[int]:

@@ -44,17 +44,35 @@ class _LazyScalar(numbers.Real):
     consecutive steps pipeline; printing/comparing/formatting the loss
     coerces it via ``__float__`` exactly like a float.  (For JSON
     serialization, coerce explicitly: ``float(logs["loss"])``.)
+
+    **Deferred-error contract**: a device fault in the step (or an
+    XLA runtime error) surfaces at the first coercion of this scalar —
+    potentially lines away from the ``train_batch`` call that queued the
+    step.  Every coercion failure is re-raised annotated with the step
+    index that produced the value, so the failing batch is always
+    attributable.  For eager per-step surfacing (and NaN/Inf loss
+    detection at the producing step), enable ``FLAGS_check_nan_inf`` —
+    ``train_batch`` then materialises the loss before returning, at the
+    documented pipeline cost.
     """
 
-    __slots__ = ("_arr", "_val")
+    __slots__ = ("_arr", "_val", "_origin")
 
-    def __init__(self, arr):
+    def __init__(self, arr, origin: str = None):
         self._arr = arr
         self._val = None
+        self._origin = origin
 
     def __float__(self):
         if self._val is None:
-            self._val = float(self._arr)
+            try:
+                self._val = float(self._arr)
+            except Exception as e:
+                raise RuntimeError(
+                    f"device computation for {self._origin or 'this value'}"
+                    f" failed; the error belongs to that step, not the "
+                    f"line coercing the value (lazy-loss contract — see "
+                    f"Model.train_batch)") from e
             self._arr = None
         return self._val
 
@@ -240,7 +258,19 @@ class Model:
         if opt._lr_scheduler is None and hasattr(opt, "_global_step"):
             opt._global_step += 1
         metrics = self._update_metrics(outs, labels)
-        return self._pack_logs(_LazyScalar(loss), metrics)
+        self._train_step_count = getattr(self, "_train_step_count", 0) + 1
+        lazy = _LazyScalar(loss,
+                           origin=f"train step {self._train_step_count}")
+        from ..utils import flags as _flags
+        if _flags.get_flag("FLAGS_check_nan_inf"):
+            # numeric-guard mode: surface device faults and NaN/Inf loss
+            # AT the producing step (trades away the async pipeline)
+            v = float(lazy)
+            if not np.isfinite(v):
+                raise FloatingPointError(
+                    f"loss is {v} at train step {self._train_step_count} "
+                    f"(FLAGS_check_nan_inf enabled)")
+        return self._pack_logs(lazy, metrics)
 
     def _train_batch_eager(self, inputs, labels, update=True):
         net, opt = self.network, self._optimizer

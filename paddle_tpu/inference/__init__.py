@@ -110,18 +110,35 @@ class Config:
     def cpu_math_library_num_threads(self):
         return self._cpu_math_threads
 
-    # -- optimization knobs (XLA always optimizes; recorded only) ------
+    # -- optimization knobs (XLA always optimizes; warn only when the
+    # requested state DIVERGES from what the XLA path will actually do) -
     def switch_ir_optim(self, flag: bool = True):
+        if not flag:
+            import warnings
+            warnings.warn(
+                "switch_ir_optim(False) is a no-op on the TPU stack: the "
+                "exported StableHLO always compiles through XLA's full "
+                "pass pipeline (no unoptimized executor exists)",
+                UserWarning, stacklevel=2)
         self._ir_optim = bool(flag)
 
     def ir_optim(self):
         return self._ir_optim
 
     def enable_memory_optim(self, flag: bool = True):
+        if not flag:
+            import warnings
+            warnings.warn(
+                "enable_memory_optim(False) is a no-op on the TPU stack: "
+                "XLA owns buffer assignment/reuse for the compiled "
+                "program and always reuses", UserWarning, stacklevel=2)
         self._memory_optim = bool(flag)
 
     def enable_mkldnn(self):
-        pass
+        import warnings
+        warnings.warn(
+            "enable_mkldnn is a no-op on the TPU stack (no oneDNN "
+            "kernels; XLA is the backend)", UserWarning, stacklevel=2)
 
     def enable_tensorrt_engine(self, workspace_size: int = 1 << 30,
                                max_batch_size: int = 1,
@@ -150,18 +167,23 @@ class Config:
         pass
 
     def set_precision(self, p: PrecisionType):
-        """Functional since round 4 (the knob the round-3 verdict flagged
-        as a silent no-op).  The exported XLA program's compute dtypes
-        are fixed at save time, so the TPU translation of the reference's
-        precision passes (paddle_pass_builder.cc:132) is weight-residency
-        conversion with boundary casts fused by XLA:
+        """Select the precision variant of the artifact to EXECUTE
+        (reference parity: the precision passes swap executed kernels —
+        paddle_pass_builder.cc:132, mkldnn_quantizer.cc:1).  Artifacts
+        written by ``paddle_tpu.jit.save`` carry per-precision program
+        variants traced at save time:
 
-        - ``Half``/``Bfloat16``: parameters are stored on device in the
-          reduced dtype (2x HBM saving) and cast at the program boundary;
-          outputs come back in the reduced dtype.
-        - ``Int8``: weight-only quantization through the quantization
-          module's scheme — int8 rows + f32 scales (4x HBM saving),
-          dequantized at the boundary.
+        - ``Half``/``Bfloat16``: the reduced-dtype program runs — every
+          dot/conv executes in the target dtype on the MXU, parameters
+          are device-resident in the reduced dtype (2x steady-state HBM
+          saving), outputs come back reduced.
+        - ``Int8``: weights are resident as int8 rows + per-channel f32
+          scales (4x HBM saving) and dequantize to bf16 in-program at
+          each use; compute executes in bf16 on the MXU.
+
+        Legacy artifacts without program variants fall back to reduced
+        *storage* with boundary casts (the f32 program executes
+        unchanged) and warn.
         """
         self._precision = p
 
@@ -231,8 +253,11 @@ class Predictor:
             self._output_names = list(src._output_names)
             self._out_dtype = src._out_dtype
             self._dequant = src._dequant
+            self._native_precision = getattr(src, "_native_precision",
+                                             False)
             self._reduced_keys = getattr(src, "_reduced_keys", set())
-            if self._dequant or self._out_dtype is not None:
+            if not self._native_precision and (
+                    self._dequant or self._out_dtype is not None):
                 # materialize in the SOURCE first so every clone —
                 # including pre-warm clones made before any run() —
                 # shares ONE materialized dict instead of each holding
@@ -277,6 +302,7 @@ class Predictor:
     def _apply_precision(self, config: Config):
         self._out_dtype = None
         self._dequant = None
+        self._native_precision = False
         prec = config._precision
         if prec == PrecisionType.Float32:
             return
@@ -287,6 +313,41 @@ class Predictor:
                 "(params stored beside the program); this program-kind "
                 "artifact stays Float32", UserWarning, stacklevel=3)
             return
+        blob = (self._meta.get("programs") or {}).get(prec.name)
+        if blob:
+            # v2 artifact: swap in the program TRACED at this precision —
+            # the executed dots/convs are bf16/f16 (or int8-resident
+            # dequant-to-bf16) on the MXU, and weights stay device-
+            # resident in the reduced form (real steady-state HBM cut)
+            from jax import export as jax_export
+            self._exported = jax_export.deserialize(bytearray(blob))
+            self._native_precision = True
+            if prec in (PrecisionType.Half, PrecisionType.Bfloat16):
+                tgt = jnp.float16 if prec == PrecisionType.Half \
+                    else jnp.bfloat16
+                self._params = {
+                    k: v.astype(tgt) if v.dtype == jnp.float32 else v
+                    for k, v in self._params.items()}
+                self._buffers = {
+                    k: v.astype(tgt) if v.dtype == jnp.float32 else v
+                    for k, v in self._buffers.items()}
+            else:  # Int8: params packed as (int8 rows, per-channel scales)
+                from ..quantization import quantize_weight_int8
+                keys = set(self._meta.get("int8_keys", ()))
+                self._params = {
+                    k: ((lambda qw: (qw.q, qw.scales))(
+                        quantize_weight_int8(v)) if k in keys else v)
+                    for k, v in self._params.items()}
+            return
+        # legacy (pre-r5) artifact: single f32 program — fall back to
+        # storage/transfer reduction with boundary casts, and say so
+        import warnings
+        warnings.warn(
+            f"precision {prec.name}: artifact has no {prec.name} program "
+            "variant (saved before multi-precision export); executing the "
+            "Float32 program with reduced-dtype storage only — re-save "
+            "with paddle_tpu.jit.save for reduced-precision compute",
+            UserWarning, stacklevel=3)
         if prec in (PrecisionType.Half, PrecisionType.Bfloat16):
             tgt = jnp.float16 if prec == PrecisionType.Half \
                 else jnp.bfloat16
@@ -300,7 +361,7 @@ class Predictor:
             from ..quantization import quantize_weight_int8
             q = {}
             for k, v in self._params.items():
-                if v.dtype == jnp.float32 and v.ndim >= 1 and v.size > 16:
+                if v.dtype == jnp.float32 and v.ndim >= 2 and v.size > 16:
                     q[k] = quantize_weight_int8(v)
                 else:
                     q[k] = v
@@ -314,6 +375,10 @@ class Predictor:
         once materialized, so steady-state HBM holds one f32 copy — the
         same as Float32 — while artifacts on disk/transfer stay small;
         serving loops get zero per-call overhead)."""
+        if getattr(self, "_native_precision", False):
+            # precision-native program: the resident (reduced) params ARE
+            # the program's parameter signature — nothing to cast back
+            return self._params
         if getattr(self, "_mat_params", None) is not None:
             return self._mat_params
         if self._dequant:

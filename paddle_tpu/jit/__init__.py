@@ -252,20 +252,104 @@ def save(layer, path, input_spec=None, **configs):
             "input_avals": [([-1 if d in (None, -1) else int(d)
                               for d in shape], str(np.dtype(dt)))
                             for shape, dt in shapes_dtypes]}
+    p_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in params.items()}
+    b_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in buffers.items()}
     exported_bytes = None
     try:
-        exp = export_with_dynamic_dims(
-            jax.jit(infer), shapes_dtypes,
-            {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in
-             params.items()},
-            {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in
-             buffers.items()})
+        exp = export_with_dynamic_dims(jax.jit(infer), shapes_dtypes,
+                                       p_avals, b_avals)
         exported_bytes = exp.serialize()
     except Exception as e:  # pragma: no cover - export unsupported path
         meta["export_error"] = str(e)
     finally:
         # tracing rebinds the live layer's tensors to tracers; restore
         layer.load_functional_state(params, buffers)
+
+    # Reduced-precision program variants (reference parity: the
+    # inference precision passes swap the *executed kernels* —
+    # paddle_pass_builder.cc:132; the TPU translation re-traces the
+    # layer so matmuls/convs run in the target dtype on the MXU).  The
+    # Predictor picks the variant matching Config.set_precision; weights
+    # then live on device in the reduced dtype (real HBM saving) and
+    # every dot executes reduced.  Inputs keep the declared (f32)
+    # signature and are cast at program entry.
+    if exported_bytes is not None:
+        meta["programs"] = {}
+        for prec_name, tgt in (("Bfloat16", jnp.bfloat16),
+                               ("Half", jnp.float16)):
+            def infer_reduced(params, buffers, *arrays, _t=tgt):
+                arrays = [a.astype(_t) if a.dtype == jnp.float32 else a
+                          for a in arrays]
+                return infer(params, buffers, *arrays)
+
+            def red(avals, _t=tgt):
+                return {k: jax.ShapeDtypeStruct(
+                    a.shape, _t if a.dtype == jnp.float32 else a.dtype)
+                    for k, a in avals.items()}
+            try:
+                exp_r = export_with_dynamic_dims(
+                    jax.jit(infer_reduced), shapes_dtypes,
+                    red(p_avals), red(b_avals))
+                meta["programs"][prec_name] = exp_r.serialize()
+            except Exception as e:  # pragma: no cover
+                meta.setdefault("precision_export_errors",
+                                {})[prec_name] = str(e)
+            finally:
+                layer.load_functional_state(params, buffers)
+        # Int8: weight-only quantized execution — int8 rows + per-channel
+        # scales are the *resident* form (4x HBM), dequantized to bf16
+        # in-program right at each weight's use so the dots ride the MXU
+        # in bf16 (mkldnn_quantizer.cc:1 is the reference's calibrated
+        # analog; weight-only is the TPU-profitable scheme).
+        # matmul/conv weights only (ndim >= 2): a 1-D bias "quantized"
+        # with per-channel (== per-element) scales would be BIGGER than
+        # its f32 original
+        int8_keys = sorted(k for k, v in params.items()
+                           if v.dtype == jnp.float32 and v.ndim >= 2
+                           and v.size > 16)
+
+        def infer_int8(qparams, buffers, *arrays):
+            dq = {}
+            for k, v in qparams.items():
+                if k in set(int8_keys):
+                    q, scales = v
+                    shape = [1] * q.ndim
+                    shape[q.ndim - 1] = -1
+                    dq[k] = q.astype(jnp.bfloat16) * \
+                        scales.astype(jnp.bfloat16).reshape(shape)
+                else:
+                    # below-threshold f32 params (biases, norms) cast to
+                    # the compute dtype too, or they'd re-promote every
+                    # downstream op back to f32
+                    dq[k] = v.astype(jnp.bfloat16) \
+                        if v.dtype == jnp.float32 else v
+            buffers = {k: v.astype(jnp.bfloat16)
+                       if v.dtype == jnp.float32 else v
+                       for k, v in buffers.items()}
+            arrays = [a.astype(jnp.bfloat16)
+                      if a.dtype == jnp.float32 else a for a in arrays]
+            return infer(dq, buffers, *arrays)
+
+        q_avals = {}
+        for k, a in p_avals.items():
+            if k in int8_keys:
+                q_avals[k] = (jax.ShapeDtypeStruct(a.shape, jnp.int8),
+                              jax.ShapeDtypeStruct((a.shape[-1],),
+                                                   jnp.float32))
+            else:
+                q_avals[k] = a
+        try:
+            exp_q = export_with_dynamic_dims(
+                jax.jit(infer_int8), shapes_dtypes, q_avals, b_avals)
+            meta["programs"]["Int8"] = exp_q.serialize()
+            meta["int8_keys"] = int8_keys
+        except Exception as e:  # pragma: no cover
+            meta.setdefault("precision_export_errors", {})["Int8"] = str(e)
+        finally:
+            layer.load_functional_state(params, buffers)
+
     with open(path + ".pdmodel", "wb") as f:
         f.write(exported_bytes or b"")
     with open(path + ".pdiparams", "wb") as f:

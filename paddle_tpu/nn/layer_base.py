@@ -336,14 +336,34 @@ class Layer:
     def _functional_refs(self):
         """Cached (name, tensor) lists for the jit-path state bridge:
         the recursive walk costs ~10 ms/step on a ResNet50-sized tree,
-        paid every training step without this."""
+        paid every training step without this.
+
+        INVARIANT: any mutation of a layer's ``_parameters`` /
+        ``_sub_layers`` / ``_buffers`` dicts must bump
+        ``Layer._struct_version`` (the registration paths above and
+        container/quantization swaps all do).  As a safety net against
+        direct dict mutation that skips the bump, the cache also keys on
+        the walked entry counts — a add/remove that dodged the version
+        bump still invalidates; only a same-count swap could serve stale
+        refs."""
+        def raw_count(l=self):
+            # raw registration-dict sizes (not the deduped/None-skipping
+            # named_* walks), computed identically at build and check
+            # time.  Count-only recursion — no prefix-string building —
+            # so the per-step cache check stays ~free
+            n = len(l._parameters) + len(l._buffers)
+            for c in l._sub_layers.values():
+                n += raw_count(c)
+            return n
+
         cache = self.__dict__.get("_fn_ref_cache")
         cv = Layer._struct_version
-        if cache is not None and cache[0] == cv:
+        if cache is not None and cache[0] == cv and cache[3] == raw_count():
             return cache[1], cache[2]
         prefs = dict(self.named_parameters())
         brefs = dict(self.named_buffers())
-        object.__setattr__(self, "_fn_ref_cache", (cv, prefs, brefs))
+        object.__setattr__(self, "_fn_ref_cache",
+                           (cv, prefs, brefs, raw_count()))
         return prefs, brefs
 
     def functional_state(self):

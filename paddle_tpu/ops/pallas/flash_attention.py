@@ -280,11 +280,19 @@ def _qkv_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float,
             o_ref[g, :, h * d:(h + 1) * d] = (pv / l).astype(o_ref.dtype)
 
 
-def _qkv_bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
+def _qkv_bwd_kernel(q_ref, k_ref, v_ref, do_ref, dqkv_ref,
                     *, scale: float, causal: bool, seq_q: int, seq_k: int,
                     G: int, P: int, d: int):
+    """Writes dq/dk/dv straight into their column blocks of ONE
+    (G, T, 3F)-shaped output ref — the exact cotangent layout of the
+    packed projection, so no (B, T, F)x3 -> (B, T, 3F) concatenate pass
+    ever lands in HBM (profiled r5: that concat alone was ~9 ms/step on
+    the flagship)."""
     offset = seq_k - seq_q
+    F = dqkv_ref.shape[-1] // 3
+    hp = pl.program_id(1)              # which 128-lane head-pair block
     for g in range(G):
+        dq_parts, dk_parts, dv_parts = [], [], []
         for h in range(P):
             q = q_ref[g][:, h * d:(h + 1) * d]           # (T, d)
             k = k_ref[g][:, h * d:(h + 1) * d]
@@ -306,16 +314,28 @@ def _qkv_bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
                 preferred_element_type=jnp.float32)
             delta = jnp.sum(p * dp, axis=-1, keepdims=True)
             pb = p.astype(do.dtype)
-            dv_ref[g, :, h * d:(h + 1) * d] = jax.lax.dot_general(
+            dv_parts.append(jax.lax.dot_general(
                 pb, do, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+                preferred_element_type=jnp.float32
+            ).astype(dqkv_ref.dtype))
             ds = (p * (dp - delta)).astype(q.dtype)
-            dq_ref[g, :, h * d:(h + 1) * d] = (scale * jax.lax.dot_general(
+            dq_parts.append((scale * jax.lax.dot_general(
                 ds, k, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)).astype(dq_ref.dtype)
-            dk_ref[g, :, h * d:(h + 1) * d] = (scale * jax.lax.dot_general(
+                preferred_element_type=jnp.float32)
+            ).astype(dqkv_ref.dtype))
+            dk_parts.append((scale * jax.lax.dot_general(
                 ds, q, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)).astype(dk_ref.dtype)
+                preferred_element_type=jnp.float32)
+            ).astype(dqkv_ref.dtype))
+        # one 128-lane tile per tensor, stored at block-aligned lane
+        # offsets (Mosaic rejects dynamic stores not provably 128-
+        # aligned; hp*128 + const*F qualifies, hp*128 + h*d does not)
+        dqkv_ref[g, :, pl.ds(hp * 128, 128)] = \
+            jnp.concatenate(dq_parts, axis=-1)
+        dqkv_ref[g, :, pl.ds(F + hp * 128, 128)] = \
+            jnp.concatenate(dk_parts, axis=-1)
+        dqkv_ref[g, :, pl.ds(2 * F + hp * 128, 128)] = \
+            jnp.concatenate(dv_parts, axis=-1)
 
 
 def _qkv_small_fwd(qkv, num_heads: int, scale: float, causal: bool,
@@ -361,7 +381,10 @@ def _qkv_small_fwd(qkv, num_heads: int, scale: float, causal: bool,
 
 def _qkv_small_bwd(qkv, do, num_heads: int, scale: float, causal: bool,
                    G: int = None, interpret: bool = False):
-    """-> (dq, dk, dv) each (B, T, H*d); caller concatenates to dqkv."""
+    """-> dqkv (B, T, 3*H*d), written column-block-wise by the kernel
+    (the (G, T, 3F) output block stays VMEM-resident across the
+    consecutive head-pair grid steps that each fill 3 of its 128-lane
+    column blocks, flushing once per batch group)."""
     if G is None:
         G = int(os.environ.get("PADDLE_FLASH_G_BWD", "2"))
     B, T, F3 = qkv.shape
@@ -369,7 +392,10 @@ def _qkv_small_bwd(qkv, do, num_heads: int, scale: float, causal: bool,
     d = F // num_heads
     P = 128 // d
     HP = num_heads // P
-    G = max(1, min(G, (2 * 512 * 512) // (T * T)))
+    # the full-width (G, T, 3F) output block is VMEM-resident alongside
+    # ~4 f32 (T, T) intermediates: G=2 at T=512 busts the 16M scoped
+    # limit (measured 16.92M), G=1 fits
+    G = max(1, min(G, (512 * 512) // (T * T)))
     while B % G:
         G //= 2
     kernel = functools.partial(_qkv_bwd_kernel, scale=scale, causal=causal,
@@ -378,7 +404,6 @@ def _qkv_small_bwd(qkv, do, num_heads: int, scale: float, causal: bool,
     def col(base):
         return lambda b, hp: (b, 0, base + hp)
 
-    out_spec = pl.BlockSpec((G, T, 128), lambda b, hp: (b, 0, hp))
     return pl.pallas_call(
         kernel,
         grid=(B // G, HP),
@@ -386,10 +411,10 @@ def _qkv_small_bwd(qkv, do, num_heads: int, scale: float, causal: bool,
                   pl.BlockSpec((G, T, 128), col(HP)),
                   pl.BlockSpec((G, T, 128), col(2 * HP)),
                   pl.BlockSpec((G, T, 128), lambda b, hp: (b, 0, hp))],
-        out_specs=[out_spec, out_spec, out_spec],
-        out_shape=[jax.ShapeDtypeStruct((B, T, F), qkv.dtype)] * 3,
+        out_specs=pl.BlockSpec((G, T, F3), lambda b, hp: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, T, F3), qkv.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")),
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(qkv, qkv, qkv, do)
 
@@ -407,9 +432,8 @@ def _flash_qkv_vjp_fwd(qkv, num_heads, scale, causal):
 
 def _flash_qkv_vjp_bwd(num_heads, scale, causal, qkv, g):
     _, interpret = _pallas_mode(qkv.shape[1], qkv.shape[1], causal)
-    dq, dk, dv = _qkv_small_bwd(qkv, g, num_heads, scale, causal,
-                                interpret=interpret)
-    return (jnp.concatenate([dq, dk, dv], axis=-1),)
+    return (_qkv_small_bwd(qkv, g, num_heads, scale, causal,
+                           interpret=interpret),)
 
 
 _flash_qkv.defvjp(_flash_qkv_vjp_fwd, _flash_qkv_vjp_bwd)
@@ -428,7 +452,11 @@ def flash_attention_qkv(qkv, num_heads: int, *, causal: bool = False,
     d = F3 // 3 // num_heads
     s = float(scale) if scale is not None else float(1.0 / np.sqrt(d))
     mode, _ = _pallas_mode(T, T, causal)
-    if mode == "small" and d in (32, 64, 128) and \
+    # packed kernels: T <= 512 only — the single-output backward holds
+    # the (G, T, 3F) cotangent block plus f32 (T, T) intermediates in
+    # VMEM, which busts the 16M scoped limit at T=1024; longer T folds
+    # to (BH, T, d) and takes the generic kernels
+    if mode == "small" and T <= 512 and d in (32, 64, 128) and \
             num_heads % max(1, 128 // d) == 0:
         return _flash_qkv(qkv, num_heads, s, causal)
     q, k, v = jnp.split(qkv.reshape(B, T, 3 * num_heads, d), 3, axis=2)

@@ -229,6 +229,12 @@ class Program:
         self._version = 0
         self._lr_provider: Optional[Callable[[], float]] = None
         self._build_fn = None  # legacy round-1 escape hatch (still honored)
+        # {param_name: partition-spec tuple of mesh-axis names/None} —
+        # written by distributed.split's static lowering (GSPMD tensor
+        # parallel; reference collective.py:1233 _parallel_linear builds
+        # per-rank programs instead), consumed by Executor when the
+        # program runs under CompiledProgram.with_hybrid_parallel(mesh)
+        self.param_specs: Dict[str, tuple] = {}
         self._block = Block(self)
         self.random_seed = 0
         self._appending_grads = False
@@ -623,6 +629,18 @@ class CompiledProgram:
         self._loss_name = loss_name
         return self
 
+    def with_hybrid_parallel(self, mesh, batch_axes=("dp",)):
+        """Run the captured program SPMD over ``mesh``: feeds shard over
+        the present ``batch_axes``, parameters follow the program's
+        ``param_specs`` (written by ``distributed.split`` static
+        lowering), everything else replicates — GSPMD inserts the
+        Megatron collectives the reference's tensor_parallel_optimizer
+        rewrites in by hand."""
+        self._dp_mesh = mesh
+        self._batch_axes = tuple(a for a in batch_axes
+                                 if mesh.shape.get(a, 1) > 1)
+        return self
+
     def __getattr__(self, item):
         return getattr(self.program, item)
 
@@ -713,8 +731,10 @@ class Executor:
             return [np.asarray(v) for v in outs] if return_numpy \
                 else [Tensor(v) for v in outs]
         dp_mesh = None
+        batch_axes = ("dp",)
         if isinstance(program, CompiledProgram):
             dp_mesh = program._dp_mesh
+            batch_axes = getattr(program, "_batch_axes", ("dp",))
             program = program.program
 
         # round-1 escape hatch: hand-assigned build function
@@ -794,14 +814,49 @@ class Executor:
             mutables.update(program.state_vars)
 
         if dp_mesh is not None:
-            # reference ParallelExecutor: batch over devices, params
-            # replicated; GSPMD emits the gradient all-reduce
+            # reference ParallelExecutor: batch over devices; params
+            # replicate unless distributed.split recorded a tensor-
+            # parallel spec for them — GSPMD then emits the gradient
+            # all-reduce AND the Megatron mp collectives
             from jax.sharding import NamedSharding, PartitionSpec as Pspec
-            batch = NamedSharding(dp_mesh, Pspec("dp"))
+            axes = tuple(a for a in batch_axes
+                         if dp_mesh.shape.get(a, 1) > 1)
+            batch = NamedSharding(dp_mesh, Pspec(axes if axes else None))
             rep = NamedSharding(dp_mesh, Pspec())
-            feed_arrays = {n: jax.device_put(a, batch)
+
+            def param_sharding(n):
+                spec = program.param_specs.get(n)
+                if not spec:
+                    return rep
+                spec = tuple(s if (s in dp_mesh.axis_names and
+                                   dp_mesh.shape[s] > 1) else None
+                             for s in spec)
+                return NamedSharding(dp_mesh, Pspec(*spec))
+
+            def put(a, s):
+                # multi-process (launcher) meshes contain non-addressable
+                # devices: build the global array from this process's
+                # shards (every process holds the same global value —
+                # the parity-test contract for feeds and params)
+                if isinstance(a, jax.Array) and (
+                        a.sharding == s or not all(
+                            d.process_index == jax.process_index()
+                            for d in a.sharding.device_set)):
+                    # already placed / already a global multi-host array
+                    # from the previous step (the partitioner's chosen
+                    # output sharding is authoritative — respecifying
+                    # would force a host round-trip it can't do anyway)
+                    return a
+                if all(d.process_index == jax.process_index()
+                       for d in s.device_set):
+                    return jax.device_put(a, s)
+                a = np.asarray(a)
+                return jax.make_array_from_callback(
+                    a.shape, s, lambda idx: a[idx])
+
+            feed_arrays = {n: put(a, batch)
                            for n, a in feed_arrays.items()}
-            mutables = {n: jax.device_put(a, rep)
+            mutables = {n: put(a, param_sharding(n))
                         for n, a in mutables.items()}
 
         lr = jnp.asarray(

