@@ -222,6 +222,13 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
         split = {k: jnp.split(v, L, axis=0) for k, v in blocks.items()}
         for i in range(L):
             p_i = {k: jnp.squeeze(split[k][i], axis=0) for k in split}
+            # materialize the per-layer weight slices: left as bitcast
+            # views of the stacked (L, ...) arrays, XLA fuses the slice
+            # into the consuming convolution and picks a half-rate
+            # batch-in-sublanes emitter (profiled r5: the down-proj+LN
+            # fusion ran 3.43 ms vs 1.81 with materialized weights —
+            # the copies themselves are ~0.1 ms/layer)
+            p_i = lax.optimization_barrier(p_i)
             x = maybe_remat(block_fn)(p_i, x)
         return _layernorm(x, params["ln_f_g"], params["ln_f_b"])
 

@@ -36,13 +36,23 @@ NEG_INF = -1e30
 # Largest K-length whose full (T, T) score block comfortably fits VMEM
 # f32 alongside the resident K/V blocks — the "small-T" kernel regime.
 SMALL_T_MAX = 1024
+# Largest K-length whose FULL K/V rows stay VMEM-resident while q tiles
+# stream through (the "mid" regime: q-block-tiled forward + one fused
+# backward with in-kernel lse/delta — the r4 small-T techniques carried
+# into the long-context shapes the r4 streaming kernels only tied XLA
+# on).  Bounded by the backward's VMEM: ~3 live f32 (block_q, Tk)
+# intermediates + 2 f32 (Tk, d) accumulators; at Tk=4096/block_q=256
+# that is ~8 MB of 16.  Beyond this the streaming kernels take over
+# with O(T) memory.
+MID_T_MAX = 4096
 
 
 def _pallas_mode(seq_q: int, seq_k: int, causal: bool):
     """(mode, interpret) — static decision from shapes + env so the
     forward and backward of one call always agree.  mode is one of
-    "small" (full-K-resident batched kernel), "stream" (online-softmax
-    streaming kernel for long sequences), "xla" (fallback math).
+    "small" (full-K-resident batched kernel), "mid" (full-K-resident,
+    q-block-tiled), "stream" (online-softmax streaming kernel for
+    arbitrarily long sequences), "xla" (fallback math).
 
     causal with seq_q > seq_k has fully-masked query rows whose lse
     degenerates to NEG_INF (float cancellation makes exp(s - lse) == 1 in
@@ -53,10 +63,12 @@ def _pallas_mode(seq_q: int, seq_k: int, causal: bool):
         return "xla", False
     aligned = seq_q % 128 == 0 and seq_k % 128 == 0
     small = aligned and seq_k <= SMALL_T_MAX and seq_q <= SMALL_T_MAX
+    mid = aligned and not small and seq_k <= MID_T_MAX \
+        and seq_q <= MID_T_MAX
     if os.environ.get("PADDLE_PALLAS_FORCE") == "1":
         if not aligned:
             return "xla", False
-        return ("small" if small else "stream"), \
+        return ("small" if small else "mid" if mid else "stream"), \
             jax.default_backend() == "cpu"
     if jax.default_backend() in ("cpu",) or not aligned:
         return "xla", False
@@ -64,8 +76,9 @@ def _pallas_mode(seq_q: int, seq_k: int, causal: bool):
     # T=512 materialises f32 (T, T) score tensors in the backward and
     # costs ~21 ms/layer fwd+bwd; the small-T kernel pair (full-K
     # resident, G batch-heads per grid step, one fused backward) beats
-    # it.  The streaming kernel owns the long-sequence regime.
-    return ("small" if small else "stream"), False
+    # it.  The mid kernels carry the same design to T<=MID_T_MAX (4096); the
+    # streaming kernels own anything longer with O(T) memory.
+    return ("small" if small else "mid" if mid else "stream"), False
 
 
 # ---------------------------------------------------------------------------
@@ -464,6 +477,100 @@ def flash_attention_qkv(qkv, num_heads: int, *, causal: bool = False,
     return out.reshape(B, T, num_heads * d)
 
 
+def _mid_flash_fwd(q, k, v, scale: float, causal: bool,
+                   interpret: bool = False):
+    """Full-K-resident forward for the mid regime (1024 < T <= 4096):
+    the small-T kernel with q-block tiling and VMEM-scaled batching.
+    No lse output — the fused tiled backward rebuilds it in-kernel, so
+    residuals stay pure inputs (remat never re-runs the kernel)."""
+    Tk = k.shape[1]
+    block_q = 512 if Tk <= 1024 else 256
+    G = max(1, (4 * 512 * 512) // (block_q * Tk))
+    return _small_flash_fwd(q, k, v, scale, causal, block_q=block_q,
+                            G=G, interpret=interpret)
+
+
+def _tiled_bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
+                      dk_scr, dv_scr, *, scale: float, causal: bool,
+                      block_q: int, nq: int, seq_q: int, seq_k: int):
+    """One fused backward for the mid regime: q blocks ride the inner
+    ('arbitrary') grid dim with the full K/V rows resident, lse and
+    delta derived in-kernel from the full score row (no online
+    rescaling, no residuals), dq written per block and dK/dV
+    accumulated in f32 scratch until the last q block."""
+    qi = pl.program_id(1)
+    offset = seq_k - seq_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0]                                         # (bq, d)
+    k = k_ref[0]                                         # (Tk, d)
+    v = v_ref[0]
+    do = do_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # (bq, Tk)
+    if causal:
+        rows = lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+            + qi * block_q + offset
+        cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    p = e / l
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (bq, Tk)
+    delta = jnp.sum(p * dp, axis=-1, keepdims=True)
+    pb = p.astype(do.dtype)
+    dv_scr[...] += jax.lax.dot_general(
+        pb, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (Tk, d)
+    ds = (p * (dp - delta)).astype(q.dtype)
+    dq_ref[0] = (scale * jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)).astype(dq_ref.dtype)
+    dk_scr[...] += scale * jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (Tk, d)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _tiled_flash_bwd(q, k, v, do, scale: float, causal: bool,
+                     interpret: bool = False):
+    """(BH, T, d) fused backward, full-K-resident, q-block tiled."""
+    BH, T, d = q.shape
+    Tk = k.shape[1]
+    block_q = 512 if Tk <= 1024 else 256
+    block_q, _ = _block_sizes(T, Tk, block_q, Tk)
+    nq = T // block_q
+    qs = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
+    ks = pl.BlockSpec((1, Tk, d), lambda b, i: (b, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_tiled_bwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, nq=nq, seq_q=T, seq_k=Tk),
+        grid=(BH, nq),
+        in_specs=[qs, ks, ks, qs],
+        out_specs=[qs, ks, ks],
+        out_shape=[jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+                   jax.ShapeDtypeStruct((BH, Tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((Tk, d), jnp.float32),
+                        pltpu.VMEM((Tk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do)
+
+
 def _small_bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
                       *, scale: float, causal: bool, seq_q: int,
                       seq_k: int, G: int):
@@ -711,6 +818,9 @@ def _flash(q, k, v, scale, causal):
     if mode == "small":
         return _small_flash_fwd(q, k, v, scale, causal,
                                 interpret=interpret)
+    if mode == "mid":
+        return _mid_flash_fwd(q, k, v, scale, causal,
+                              interpret=interpret)
     if mode == "stream":
         out, _ = _flash_fwd(q, k, v, scale, causal, interpret=interpret)
         return out
@@ -725,6 +835,9 @@ def _flash_vjp_fwd(q, k, v, scale, causal):
         out = _small_flash_fwd(q, k, v, scale, causal,
                                interpret=interpret)
         return out, (q, k, v, None, None)
+    if mode == "mid":
+        out = _mid_flash_fwd(q, k, v, scale, causal, interpret=interpret)
+        return out, (q, k, v, None, None)
     if mode == "stream":
         out, lse = _flash_fwd(q, k, v, scale, causal, interpret=interpret)
         return out, (q, k, v, out, lse)
@@ -736,7 +849,17 @@ def _flash_vjp_bwd(scale, causal, res, g):
     q, k, v, o, lse = res
     mode, interpret = _pallas_mode(q.shape[1], k.shape[1], causal)
     if mode == "small":
+        if k.shape[1] > 512:
+            # the fully-unrolled small backward holds ~5 live f32
+            # (T, Tk) tensors: beyond T=512 that brushes the 16M VMEM
+            # limit (ADVICE r4) — the tiled backward is the same math
+            # with bounded residency
+            return _tiled_flash_bwd(q, k, v, g, scale, causal,
+                                    interpret=interpret)
         return _small_flash_bwd(q, k, v, g, scale, causal,
+                                interpret=interpret)
+    if mode == "mid":
+        return _tiled_flash_bwd(q, k, v, g, scale, causal,
                                 interpret=interpret)
     if mode == "stream" and lse is not None:
         return _flash_bwd(q, k, v, o, lse, g, scale, causal,
