@@ -165,8 +165,15 @@ def bench_resnet(on_tpu: bool):
         float(jnp.sum(p0._data.astype(jnp.float32)))
         best = min(best or 9e9, time.perf_counter() - t0)
     imgs = B * steps / best
+    # ResNet50 fwd ~4.1 GFLOP/img at 224^2; fwd+bwd ~3x (no remat on
+    # the conv path), against one v5e chip's 197 bf16 TFLOP/s peak —
+    # conv-path MFU is structurally lower than the transformer's (small
+    # channel counts early in the net under-fill the MXU; profiled
+    # conv-path table in BASELINE.md)
+    mfu = imgs * 3 * 4.1e9 / 197e12
     return {"value": round(imgs, 1), "unit": "imgs/s",
-            "vs_baseline": round(imgs / (0.8 * 390.0), 3)}
+            "vs_baseline": round(imgs / (0.8 * 390.0), 3),
+            "mfu": round(mfu, 3)}
 
 
 if __name__ == "__main__":

@@ -174,7 +174,15 @@ class Model:
         net, opt, loss_fn = self.network, self._optimizer, self._loss
         amp_level = self._amp_level
 
-        def step(params, buffers, opt_state, key, lr, *data):
+        def step(params, buffers, opt_state, key_base, rng_ctr, lr,
+                 *data):
+            # rng key derived IN-JIT from a device-resident counter
+            # (same (seed, counter) stream as Generator.next_key): a
+            # host-built key per step is a tiny host->device transfer
+            # that serializes with the big execute on the axon tunnel —
+            # measured ~14 ms/step of the ResNet50 wall time (r5)
+            rng_ctr = rng_ctr + jnp.uint32(1)
+            key = jnp.stack([key_base[0], key_base[1] ^ rng_ctr])
             inputs = [Tensor(a) for a in data[:n_inputs]]
             labels = [Tensor(a) for a in data[n_inputs:]]
 
@@ -198,9 +206,44 @@ class Model:
                 loss_of, has_aux=True)(params)
             new_params, new_opt_state = opt.functional_apply(
                 params, grads, opt_state, lr)
-            return loss, outs, new_buffers, new_params, new_opt_state
+            return loss, outs, new_buffers, new_params, new_opt_state, \
+                rng_ctr
 
         return jax.jit(step, donate_argnums=(0, 2))
+
+    def _device_rng_state(self):
+        """(key_base, rng_ctr) device scalars for the jitted step,
+        cached so the steady-state training loop does ZERO per-step
+        host->device transfers.  Mirrors Generator.next_key's
+        (splitmix64(seed), counter) stream exactly; resyncs whenever
+        the host generator moved independently (reseed, eager draws,
+        set_rng_state) and falls back to None in split-chain mode."""
+        from ..core.random import _splitmix64, _state
+        gen = default_generator
+        if gen._key is not None or getattr(_state, "scope", None) \
+                is not None:
+            # explicit-key mode, or an active rng_scope (which must
+            # keep routing every draw): legacy per-step key path
+            return None, None
+        mixed = _splitmix64(gen._seed)
+        hi = ((mixed >> 32) | 0x80000000) & 0xFFFFFFFF
+        lo = mixed & 0xFFFFFFFF
+        cache = getattr(self, "_rng_dev_cache", None)
+        if cache is not None and cache[0] == (gen._seed, gen._counter):
+            base, ctr = cache[1], cache[2]
+        else:                          # first step / host moved: resync
+            base = jnp.asarray(np.array([hi, lo], np.uint32))
+            ctr = jnp.asarray(np.uint32(gen._counter))
+        return base, ctr
+
+    def _lr_device(self):
+        lr_val = float(self._optimizer.get_lr())
+        cache = getattr(self, "_lr_dev_cache", None)
+        if cache is not None and cache[0] == lr_val:
+            return cache[1]
+        arr = jnp.asarray(lr_val, jnp.float32)
+        self._lr_dev_cache = (lr_val, arr)
+        return arr
 
     def _build_jit_eval_step(self, n_inputs, n_labels, with_loss):
         net, loss_fn = self.network, self._loss
@@ -245,14 +288,34 @@ class Model:
         params, buffers = net.functional_state()
         if not hasattr(opt, "_fn_state") or opt._fn_state is None:
             opt._fn_state = opt.functional_init(params)
-        key = default_generator.next_key()
-        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        key_base, rng_ctr = self._device_rng_state()
+        if key_base is None:
+            # split-chain mode: a per-step host-built key (transfer) —
+            # correctness over the zero-transfer fast path.  The step
+            # derives key = [base0, base1 ^ (ctr+1)]; pre-XOR base1 so
+            # the derived key equals the generator's key exactly
+            key = default_generator.next_key()
+            key_base = jnp.stack([key[0], key[1] ^ jnp.uint32(1)])
+            rng_ctr = jnp.uint32(0)
+            split_chain = True
+        else:
+            split_chain = False
+        lr = self._lr_device()
         try:
-            loss, outs, new_buffers, new_params, new_state = step(
-                params, buffers, opt._fn_state, key, lr, *arrays)
+            loss, outs, new_buffers, new_params, new_state, new_ctr = \
+                step(params, buffers, opt._fn_state, key_base, rng_ctr,
+                     *([lr] + arrays))
         except Exception:
             net.load_functional_state(params, buffers)  # drop leaked tracers
             raise
+        if not split_chain:
+            # mirror the in-jit counter bump on the host generator so
+            # get_rng_state()/eager draws stay consistent, and keep the
+            # device counter for the next step (zero transfers)
+            default_generator._counter += 1
+            self._rng_dev_cache = ((default_generator._seed,
+                                    default_generator._counter),
+                                   key_base, new_ctr)
         opt._fn_state = new_state
         net.load_functional_state(new_params, new_buffers)
         if opt._lr_scheduler is None and hasattr(opt, "_global_step"):

@@ -70,7 +70,9 @@ def _pallas_mode(seq_q: int, seq_k: int, causal: bool):
             return "xla", False
         return ("small" if small else "mid" if mid else "stream"), \
             jax.default_backend() == "cpu"
-    if jax.default_backend() in ("cpu",) or not aligned:
+    if jax.default_backend() != "tpu" or not aligned:
+        # non-TPU backends (cpu, gpu) take the portable XLA math — the
+        # pallas kernels here are Mosaic/TPU-only
         return "xla", False
     # v5e, bf16, d=64, B*H=1536 (profiled round 4): XLA's attention at
     # T=512 materialises f32 (T, T) score tensors in the backward and
@@ -549,7 +551,10 @@ def _tiled_flash_bwd(q, k, v, do, scale: float, causal: bool,
     """(BH, T, d) fused backward, full-K-resident, q-block tiled."""
     BH, T, d = q.shape
     Tk = k.shape[1]
-    block_q = 512 if Tk <= 1024 else 256
+    # ~5 live f32 (block_q, Tk) intermediates + 2 f32 (Tk, d) scratch
+    # accumulators: at Tk=4096, block_q=256 measured 22.2M and even 128
+    # sat 176K over the 16M scoped VMEM — 64 leaves ~5M headroom
+    block_q = 512 if Tk <= 1024 else 256 if Tk <= 2048 else 64
     block_q, _ = _block_sizes(T, Tk, block_q, Tk)
     nq = T // block_q
     qs = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))

@@ -175,10 +175,25 @@ def test_data_parallel_input_sharding():
     assert sh.spec == P("dp")
 
 
-def test_static_split_raises_with_guidance():
-    prog = paddle.static.Program()
-    with paddle.static.program_guard(prog):
-        x = paddle.static.data("xs", [4, 16], "float32")
-        with pytest.raises(NotImplementedError,
-                           match="sharded_trainer|auto.shard"):
-            dist.split(x, (16, 32), "linear", axis=1, num_partitions=2)
+def test_static_split_lowers_to_param_specs():
+    """Round-5: static split no longer refuses — it captures the
+    full-size layer and records GSPMD placements on the program (see
+    tests/test_static_split.py for execution parity under the
+    launcher)."""
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x = paddle.static.data("xs", [4, 16], "float32")
+            out = dist.split(x, (16, 32), "linear", axis=1,
+                             num_partitions=2)
+            emb = dist.split(paddle.static.data("ids", [4], "int64"),
+                             (64, 16), "embedding", num_partitions=2)
+    finally:
+        paddle.disable_static()
+    assert list(out.shape)[-1] == 32          # logically full-size
+    specs = prog.param_specs
+    assert (None, "mp") in specs.values()     # column weight
+    assert ("mp", None) in specs.values()     # vocab-parallel embedding
+    # repeated capture at one call site reuses the cached layer
+    assert len(prog._split_layer_cache) == 2

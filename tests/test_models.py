@@ -99,6 +99,42 @@ def test_lazy_loss_failure_semantics():
         float(lazy)
 
 
+def test_device_rng_counter_stream_consistency():
+    """The zero-transfer device RNG counter must reproduce the host
+    generator's (seed, counter) stream: identical reruns match exactly,
+    interleaved eager draws resync instead of repeating keys, and
+    get_rng_state reflects every jit step."""
+    def run(n, poke_eager=False):
+        paddle.seed(42)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                   paddle.nn.Dropout(0.5),
+                                   paddle.nn.Linear(16, 2))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(
+            0.01, parameters=net.parameters()), paddle.nn.MSELoss())
+        x = np.ones((4, 8), np.float32)
+        y = np.zeros((4, 2), np.float32)
+        losses = []
+        for i in range(n):
+            if poke_eager and i == 2:
+                # an eager draw advances the host counter; the model
+                # must resync, not reuse a stale device counter
+                paddle.rand([2, 2])
+            losses.append(float(model.train_batch([x], [y])["loss"]))
+        return losses
+
+    a = run(5)
+    b = run(5)
+    assert a == b, (a, b)                      # exact reproducibility
+    # dropout differs step to step (counter really advances)
+    assert len(set(a)) > 1, a
+    c = run(5, poke_eager=True)
+    assert c[:2] == a[:2] and c[2:] != a[2:], (a, c)
+    # host state tracks the jit steps
+    st = paddle.get_rng_state()
+    assert st["counter"] >= 5 + 1
+
+
 @pytest.mark.slow
 def test_spmd_step_single_vs_pipelined():
     """pp=2 pipelined step must produce the same loss as pp=1 on

@@ -1,39 +1,21 @@
 """Bench the routed flash_attention (mode gate as shipped) vs XLA math
-across T, using device-time-truthful big-loop timing: run N calls inside
-one jit (lax.scan chaining) so per-dispatch tunnel overhead amortizes.
+across T.  Default timing is DEVICE SELF-TIME from an xprof capture of
+the chained loop (contention-immune on the shared axon chip); pass
+--wall for wall-clock.
 """
 import argparse
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
-
-def chain_bench(f, args, iters=8):
-    """loss-like scalar chained through iterations inside ONE jit."""
-    def body(c, _):
-        out = f(*[a + c.astype(a.dtype) for a in args])
-        return jnp.sum(out.astype(jnp.float32)) * 1e-20, None
-
-    @jax.jit
-    def run(args):
-        c, _ = lax.scan(body, jnp.zeros(()), None,
-                        length=iters)
-        return c
-
-    r = run(args)
-    float(r)
-    t0 = time.perf_counter()
-    r = run(args)
-    float(r)
-    return (time.perf_counter() - t0) / iters
+from _device_bench import device_time, wall_time
 
 
 def main():
@@ -43,7 +25,18 @@ def main():
     ap.add_argument("--d", type=int, default=64)
     ap.add_argument("--Ts", default="512,1024")
     ap.add_argument("--grad", action="store_true")
+    ap.add_argument("--wall", action="store_true")
     args = ap.parse_args()
+    use_wall = args.wall
+    if not use_wall:
+        try:
+            import xprof  # noqa: F401 — device_time needs its converter
+        except ImportError:
+            print("xprof not installed: falling back to --wall timing "
+                  "(contention-sensitive on shared chips)",
+                  file=sys.stderr)
+            use_wall = True
+    timer = wall_time if use_wall else device_time
     import importlib
     fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
 
@@ -66,10 +59,10 @@ def main():
             if args.grad:
                 g = lambda q, f=f: jax.grad(
                     lambda x: jnp.sum(f(x).astype(jnp.float32)))(q)
-                t = chain_bench(g, (q,))
+                t = timer(g, (q,))
                 eff = 3 * flops / t / 1e12
             else:
-                t = chain_bench(f, (q,))
+                t = timer(f, (q,))
                 eff = flops / t / 1e12
             print(f"T={T:5d} B={B:4d} {name:7s} "
                   f"{'fwd+bwd' if args.grad else 'fwd':7s} "
