@@ -73,9 +73,8 @@ class Generator:
             # these keys can never collide with jax.random.PRNGKey(n)
             # (= [0, n]) keys rooted elsewhere (e.g. the mp RNG tracker)
             self._counter += 1
-            mixed = _splitmix64(self._seed)
-            hi = ((mixed >> 32) | 0x80000000) & 0xFFFFFFFF
-            lo = (mixed ^ self._counter) & 0xFFFFFFFF
+            hi, lo0 = counter_stream_key_words(self._seed)
+            lo = (lo0 ^ self._counter) & 0xFFFFFFFF
             return jnp.asarray(np.array([hi, lo], np.uint32))
         self._key, sub = jax.random.split(self._key)
         return sub
@@ -106,6 +105,18 @@ def _splitmix64(x: int) -> int:
     x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
     x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
     return x ^ (x >> 31)
+
+
+def counter_stream_key_words(seed_val: int):
+    """(hi, lo) uint32 words of the counter-stream key base for a seed;
+    a draw at counter c uses (hi, lo ^ c).  The SINGLE source of the
+    derivation — Generator.next_key and the hapi zero-transfer device
+    stream (hapi/model.py _device_rng_state) both call this, so the
+    host and in-jit streams cannot drift."""
+    mixed = _splitmix64(int(seed_val))
+    hi = ((mixed >> 32) | 0x80000000) & 0xFFFFFFFF
+    lo = mixed & 0xFFFFFFFF
+    return hi, lo
 
 
 default_generator = Generator(0)

@@ -218,16 +218,14 @@ class Model:
         (splitmix64(seed), counter) stream exactly; resyncs whenever
         the host generator moved independently (reseed, eager draws,
         set_rng_state) and falls back to None in split-chain mode."""
-        from ..core.random import _splitmix64, _state
+        from ..core.random import counter_stream_key_words, _state
         gen = default_generator
         if gen._key is not None or getattr(_state, "scope", None) \
                 is not None:
             # explicit-key mode, or an active rng_scope (which must
             # keep routing every draw): legacy per-step key path
             return None, None
-        mixed = _splitmix64(gen._seed)
-        hi = ((mixed >> 32) | 0x80000000) & 0xFFFFFFFF
-        lo = mixed & 0xFFFFFFFF
+        hi, lo = counter_stream_key_words(gen._seed)
         cache = getattr(self, "_rng_dev_cache", None)
         if cache is not None and cache[0] == (gen._seed, gen._counter):
             base, ctr = cache[1], cache[2]

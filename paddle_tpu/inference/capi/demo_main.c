@@ -1,0 +1,87 @@
+/* Minimal C serving demo for the paddle_tpu C inference API.
+ *
+ * Reference analog: paddle/fluid/inference/capi_exp/lod_demo.cc (the
+ * reference's in-tree C API usage sample).  Usage:
+ *
+ *   demo <artifact_prefix> <rows> <cols>
+ *
+ * Feeds a rows x cols float32 ramp into the artifact's single input,
+ * runs it, and prints shape + values of the first output, one value
+ * per line ("v <float>"), so a harness can diff against the Python
+ * predictor bit-for-bit.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "pd_inference_api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <artifact_prefix> <rows> <cols>\n", argv[0]);
+    return 2;
+  }
+  const char* prefix = argv[1];
+  int rows = atoi(argv[2]);
+  int cols = atoi(argv[3]);
+
+  PD_Config* config = PD_ConfigCreate();
+  PD_ConfigSetProgFile(config, prefix);
+  PD_ConfigDisableGpu(config);
+
+  PD_Predictor* predictor = PD_PredictorCreate(config);
+  PD_ConfigDestroy(config);
+  if (!predictor) {
+    fprintf(stderr, "create failed: %s\n", PD_GetLastErrorMessage());
+    return 1;
+  }
+  printf("version %s\n", PD_GetVersion());
+
+  PD_OneDimArrayCstr* in_names = PD_PredictorGetInputNames(predictor);
+  if (!in_names || in_names->size < 1) {
+    fprintf(stderr, "no inputs: %s\n", PD_GetLastErrorMessage());
+    return 1;
+  }
+  printf("inputs %zu outputs %zu\n", PD_PredictorGetInputNum(predictor),
+         PD_PredictorGetOutputNum(predictor));
+
+  PD_Tensor* input =
+      PD_PredictorGetInputHandle(predictor, in_names->data[0]);
+  int32_t shape[2] = {rows, cols};
+  PD_TensorReshape(input, 2, shape);
+
+  float* feed = (float*)malloc(sizeof(float) * rows * cols);
+  for (int i = 0; i < rows * cols; ++i) feed[i] = 0.01f * i - 1.0f;
+  PD_TensorCopyFromCpuFloat(input, feed);
+
+  if (!PD_PredictorRun(predictor)) {
+    fprintf(stderr, "run failed: %s\n", PD_GetLastErrorMessage());
+    return 1;
+  }
+
+  PD_OneDimArrayCstr* out_names = PD_PredictorGetOutputNames(predictor);
+  PD_Tensor* output =
+      PD_PredictorGetOutputHandle(predictor, out_names->data[0]);
+  PD_OneDimArrayInt32* out_shape = PD_TensorGetShape(output);
+
+  size_t total = 1;
+  printf("shape");
+  for (size_t i = 0; i < out_shape->size; ++i) {
+    printf(" %d", out_shape->data[i]);
+    total *= (size_t)out_shape->data[i];
+  }
+  printf("\ndtype %d\n", (int)PD_TensorGetDataType(output));
+
+  float* out = (float*)malloc(sizeof(float) * total);
+  PD_TensorCopyToCpuFloat(output, out);
+  for (size_t i = 0; i < total; ++i) printf("v %.6f\n", out[i]);
+
+  free(feed);
+  free(out);
+  PD_OneDimArrayInt32Destroy(out_shape);
+  PD_OneDimArrayCstrDestroy(in_names);
+  PD_OneDimArrayCstrDestroy(out_names);
+  PD_TensorDestroy(input);
+  PD_TensorDestroy(output);
+  PD_PredictorDestroy(predictor);
+  return 0;
+}

@@ -1,0 +1,613 @@
+// paddle_tpu C inference API — implementation.
+//
+// Reference parity: paddle/fluid/inference/capi_exp/pd_predictor.cc,
+// pd_config.cc, pd_tensor.cc (C ABI over the C++ AnalysisPredictor).
+// TPU-native translation: the inference engine on this stack is
+// paddle_tpu.inference.Predictor (StableHLO artifacts executed through
+// XLA), which lives in Python.  This library therefore embeds a CPython
+// interpreter — boot on first PD_PredictorCreate, PyGILState discipline
+// on every entry point so any C thread may call in — and marshals
+// buffers zero-copy-in (memoryview -> np.frombuffer) / single-copy-out
+// (buffer protocol memcpy).  No numpy C headers are required; all
+// Python interop goes through the stable object protocol.
+
+#include "pd_inference_api.h"
+
+#include <Python.h>
+
+#include <cstdlib>
+#include <atomic>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+// Format the pending Python exception into g_last_error and clear it.
+void capture_py_error(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  std::string msg = std::string(where) + ": ";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) msg += c;
+      Py_DECREF(s);
+    }
+  } else {
+    msg += "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  set_error(msg);
+}
+
+// RAII PyObject* owner.
+struct PyRef {
+  PyObject* p;
+  explicit PyRef(PyObject* o = nullptr) : p(o) {}
+  ~PyRef() { Py_XDECREF(p); }
+  PyRef(const PyRef&) = delete;
+  PyRef& operator=(const PyRef&) = delete;
+  PyObject* release() {
+    PyObject* o = p;
+    p = nullptr;
+    return o;
+  }
+  explicit operator bool() const { return p != nullptr; }
+};
+
+// RAII GIL hold.
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+std::atomic<bool> g_booted{false};
+std::mutex g_boot_mutex;
+
+// Boot the embedded interpreter if this process has none.  When the
+// host process IS Python (e.g. the library is exercised via ctypes from
+// tests), Py_IsInitialized() is already true and we only attach.
+// Serialized: concurrent first calls (Go schedules goroutines across OS
+// threads) must not race Py_InitializeEx.
+bool ensure_python() {
+  if (g_booted.load(std::memory_order_acquire)) return true;
+  std::lock_guard<std::mutex> lock(g_boot_mutex);
+  if (g_booted.load(std::memory_order_acquire)) return true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Site customizations may force-override the JAX platform list at
+    // interpreter start; re-honor the caller's JAX_PLATFORMS so a
+    // serving host can pin cpu/tpu explicitly (same workaround as the
+    // repo's __graft_entry__).
+    PyRun_SimpleString(
+        "import os\n"
+        "try:\n"
+        "    _p = os.environ.get('JAX_PLATFORMS')\n"
+        "    if _p:\n"
+        "        import jax\n"
+        "        if jax.config.jax_platforms != _p:\n"
+        "            jax.config.update('jax_platforms', _p)\n"
+        "except Exception:\n"
+        "    pass\n");
+    // Release the GIL acquired by initialization so PyGILState_Ensure
+    // works uniformly from any thread (including this one).
+    PyEval_SaveThread();
+  }
+  g_booted.store(true, std::memory_order_release);
+  return true;
+}
+
+PyObject* import_inference() {
+  return PyImport_ImportModule("paddle_tpu.inference");
+}
+
+}  // namespace
+
+struct PD_Config {
+  std::string prog_file;
+  std::string params_file;
+  std::string device = "default";  // default / tpu / cpu
+  int32_t device_id = 0;
+  int32_t precision = PD_PRECISION_FLOAT32;
+  int32_t cpu_threads = 1;
+};
+
+struct PD_Predictor {
+  PyObject* predictor;  // paddle_tpu.inference.Predictor
+};
+
+struct PD_Tensor {
+  PyObject* handle;  // paddle_tpu.inference.Tensor
+  std::string name;
+  std::vector<int32_t> shape;  // last PD_TensorReshape value
+};
+
+extern "C" {
+
+const char* PD_GetVersion() {
+  static std::string version = "unknown";
+  if (!g_booted.load(std::memory_order_acquire)) return version.c_str();
+  Gil gil;
+  PyRef mod(import_inference());
+  if (!mod) {
+    PyErr_Clear();
+    return version.c_str();
+  }
+  PyRef v(PyObject_CallMethod(mod.p, "get_version", nullptr));
+  if (v) {
+    const char* c = PyUnicode_AsUTF8(v.p);
+    if (c) version = c;
+  } else {
+    PyErr_Clear();
+  }
+  return version.c_str();
+}
+
+const char* PD_GetLastErrorMessage() { return g_last_error.c_str(); }
+
+/* ---- config ------------------------------------------------------ */
+
+PD_Config* PD_ConfigCreate() { return new PD_Config(); }
+
+void PD_ConfigDestroy(PD_Config* config) { delete config; }
+
+void PD_ConfigSetModel(PD_Config* config, const char* prog,
+                       const char* params) {
+  if (prog) config->prog_file = prog;
+  if (params) config->params_file = params;
+}
+
+void PD_ConfigSetProgFile(PD_Config* config, const char* prog) {
+  if (prog) config->prog_file = prog;
+}
+
+void PD_ConfigSetParamsFile(PD_Config* config, const char* params) {
+  if (params) config->params_file = params;
+}
+
+const char* PD_ConfigGetProgFile(PD_Config* config) {
+  return config->prog_file.c_str();
+}
+
+const char* PD_ConfigGetParamsFile(PD_Config* config) {
+  return config->params_file.c_str();
+}
+
+void PD_ConfigEnableTpu(PD_Config* config, int32_t device_id) {
+  config->device = "tpu";
+  config->device_id = device_id;
+}
+
+void PD_ConfigEnableUseGpu(PD_Config* config, uint64_t, int32_t device_id) {
+  // No GPU on this stack; reference deployments calling EnableUseGpu
+  // get the accelerator (matches Python Config.enable_use_gpu).
+  PD_ConfigEnableTpu(config, device_id);
+}
+
+void PD_ConfigDisableGpu(PD_Config* config) { config->device = "cpu"; }
+
+PD_Bool PD_ConfigUseTpu(PD_Config* config) {
+  return config->device == "tpu" ? 1 : 0;
+}
+
+PD_Bool PD_ConfigUseGpu(PD_Config*) { return 0; }
+
+void PD_ConfigSetPrecision(PD_Config* config, PD_PrecisionType precision) {
+  config->precision = precision;
+}
+
+void PD_ConfigSetCpuMathLibraryNumThreads(PD_Config* config,
+                                          int32_t num_threads) {
+  config->cpu_threads = num_threads;
+}
+
+/* ---- predictor --------------------------------------------------- */
+
+PD_Predictor* PD_PredictorCreate(PD_Config* config) {
+  if (!config || config->prog_file.empty()) {
+    set_error("PD_PredictorCreate: config has no model file");
+    return nullptr;
+  }
+  if (!ensure_python()) return nullptr;
+  Gil gil;
+  PyRef mod(import_inference());
+  if (!mod) {
+    capture_py_error("PD_PredictorCreate: import paddle_tpu.inference");
+    return nullptr;
+  }
+  PyRef py_cfg(
+      config->params_file.empty()
+          ? PyObject_CallMethod(mod.p, "Config", "s",
+                                config->prog_file.c_str())
+          : PyObject_CallMethod(mod.p, "Config", "ss",
+                                config->prog_file.c_str(),
+                                config->params_file.c_str()));
+  if (!py_cfg) {
+    capture_py_error("PD_PredictorCreate: Config");
+    return nullptr;
+  }
+  PyRef r;
+  if (config->device == "cpu") {
+    r.p = PyObject_CallMethod(py_cfg.p, "disable_gpu", nullptr);
+  } else if (config->device == "tpu") {
+    r.p = PyObject_CallMethod(py_cfg.p, "enable_tpu", "i",
+                              config->device_id);
+  } else {
+    r.p = Py_None;
+    Py_INCREF(Py_None);
+  }
+  if (!r) {
+    capture_py_error("PD_PredictorCreate: device");
+    return nullptr;
+  }
+  if (config->precision != PD_PRECISION_FLOAT32) {
+    PyRef ptype(PyObject_GetAttrString(mod.p, "PrecisionType"));
+    if (!ptype) {
+      capture_py_error("PD_PredictorCreate: PrecisionType");
+      return nullptr;
+    }
+    PyRef pval(PyObject_CallFunction(ptype.p, "i", config->precision));
+    if (!pval) {
+      capture_py_error("PD_PredictorCreate: PrecisionType value");
+      return nullptr;
+    }
+    PyRef pr(PyObject_CallMethod(py_cfg.p, "set_precision", "O", pval.p));
+    if (!pr) {
+      capture_py_error("PD_PredictorCreate: set_precision");
+      return nullptr;
+    }
+  }
+  PyRef thr(PyObject_CallMethod(py_cfg.p,
+                                "set_cpu_math_library_num_threads", "i",
+                                config->cpu_threads));
+  if (!thr) PyErr_Clear();
+  PyRef pred(PyObject_CallMethod(mod.p, "create_predictor", "O", py_cfg.p));
+  if (!pred) {
+    capture_py_error("PD_PredictorCreate: create_predictor");
+    return nullptr;
+  }
+  PD_Predictor* out = new PD_Predictor();
+  out->predictor = pred.release();
+  return out;
+}
+
+PD_Predictor* PD_PredictorClone(PD_Predictor* predictor) {
+  if (!predictor) return nullptr;
+  Gil gil;
+  PyRef c(PyObject_CallMethod(predictor->predictor, "clone", nullptr));
+  if (!c) {
+    capture_py_error("PD_PredictorClone");
+    return nullptr;
+  }
+  PD_Predictor* out = new PD_Predictor();
+  out->predictor = c.release();
+  return out;
+}
+
+void PD_PredictorDestroy(PD_Predictor* predictor) {
+  if (!predictor) return;
+  if (g_booted.load(std::memory_order_acquire) && Py_IsInitialized()) {
+    Gil gil;
+    Py_XDECREF(predictor->predictor);
+  }
+  delete predictor;
+}
+
+namespace {
+
+PyObject* call_names(PD_Predictor* predictor, const char* method) {
+  return PyObject_CallMethod(predictor->predictor, method, nullptr);
+}
+
+size_t names_num(PD_Predictor* predictor, const char* method) {
+  if (!predictor) return 0;
+  Gil gil;
+  PyRef names(call_names(predictor, method));
+  if (!names) {
+    capture_py_error(method);
+    return 0;
+  }
+  Py_ssize_t n = PySequence_Size(names.p);
+  return n < 0 ? 0 : static_cast<size_t>(n);
+}
+
+PD_OneDimArrayCstr* names_array(PD_Predictor* predictor,
+                                const char* method) {
+  if (!predictor) return nullptr;
+  Gil gil;
+  PyRef names(call_names(predictor, method));
+  if (!names) {
+    capture_py_error(method);
+    return nullptr;
+  }
+  PyRef fast(PySequence_Fast(names.p, method));
+  if (!fast) {
+    capture_py_error(method);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast.p);
+  PD_OneDimArrayCstr* arr =
+      static_cast<PD_OneDimArrayCstr*>(malloc(sizeof(PD_OneDimArrayCstr)));
+  arr->size = static_cast<size_t>(n);
+  arr->data = static_cast<char**>(malloc(sizeof(char*) * (n > 0 ? n : 1)));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* c = PyUnicode_AsUTF8(PySequence_Fast_GET_ITEM(fast.p, i));
+    arr->data[i] = strdup(c ? c : "");
+  }
+  return arr;
+}
+
+PD_Tensor* tensor_handle(PD_Predictor* predictor, const char* method,
+                         const char* name) {
+  if (!predictor || !name) return nullptr;
+  Gil gil;
+  PyRef h(PyObject_CallMethod(predictor->predictor, method, "s", name));
+  if (!h) {
+    capture_py_error(method);
+    return nullptr;
+  }
+  PD_Tensor* t = new PD_Tensor();
+  t->handle = h.release();
+  t->name = name;
+  return t;
+}
+
+}  // namespace
+
+size_t PD_PredictorGetInputNum(PD_Predictor* predictor) {
+  return names_num(predictor, "get_input_names");
+}
+
+size_t PD_PredictorGetOutputNum(PD_Predictor* predictor) {
+  return names_num(predictor, "get_output_names");
+}
+
+PD_OneDimArrayCstr* PD_PredictorGetInputNames(PD_Predictor* predictor) {
+  return names_array(predictor, "get_input_names");
+}
+
+PD_OneDimArrayCstr* PD_PredictorGetOutputNames(PD_Predictor* predictor) {
+  return names_array(predictor, "get_output_names");
+}
+
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* predictor,
+                                      const char* name) {
+  return tensor_handle(predictor, "get_input_handle", name);
+}
+
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* predictor,
+                                       const char* name) {
+  return tensor_handle(predictor, "get_output_handle", name);
+}
+
+PD_Bool PD_PredictorRun(PD_Predictor* predictor) {
+  if (!predictor) return 0;
+  Gil gil;
+  PyRef r(PyObject_CallMethod(predictor->predictor, "run", nullptr));
+  if (!r) {
+    capture_py_error("PD_PredictorRun");
+    return 0;
+  }
+  return 1;
+}
+
+void PD_PredictorClearIntermediateTensor(PD_Predictor* predictor) {
+  if (!predictor) return;
+  Gil gil;
+  PyRef r(PyObject_CallMethod(predictor->predictor,
+                              "clear_intermediate_tensor", nullptr));
+  if (!r) PyErr_Clear();
+}
+
+/* ---- tensor ------------------------------------------------------ */
+
+void PD_TensorDestroy(PD_Tensor* tensor) {
+  if (!tensor) return;
+  if (g_booted.load(std::memory_order_acquire) && Py_IsInitialized()) {
+    Gil gil;
+    Py_XDECREF(tensor->handle);
+  }
+  delete tensor;
+}
+
+void PD_TensorReshape(PD_Tensor* tensor, size_t shape_size, int32_t* shape) {
+  if (!tensor) return;
+  tensor->shape.assign(shape, shape + shape_size);
+  Gil gil;
+  PyRef tup(PyTuple_New(shape_size));
+  for (size_t i = 0; i < shape_size; ++i)
+    PyTuple_SET_ITEM(tup.p, i, PyLong_FromLong(shape[i]));
+  PyRef r(PyObject_CallMethod(tensor->handle, "reshape", "O", tup.p));
+  if (!r) capture_py_error("PD_TensorReshape");
+}
+
+namespace {
+
+size_t shape_elems(const std::vector<int32_t>& shape) {
+  size_t n = 1;
+  for (int32_t d : shape) n *= static_cast<size_t>(d > 0 ? d : 0);
+  return n;
+}
+
+// copy_from: wrap the caller's buffer in a read-only memoryview, view
+// it as a numpy array of the tensor's PD_TensorReshape shape, and hand
+// it to Tensor.copy_from_cpu (which copies onto the device).
+void copy_from(PD_Tensor* tensor, const void* data, const char* np_dtype,
+               size_t elem_size) {
+  if (!tensor || !data) return;
+  if (tensor->shape.empty()) {
+    set_error("PD_TensorCopyFromCpu*: call PD_TensorReshape first");
+    return;
+  }
+  Gil gil;
+  size_t nbytes = shape_elems(tensor->shape) * elem_size;
+  PyRef mv(PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)),
+      static_cast<Py_ssize_t>(nbytes), PyBUF_READ));
+  if (!mv) {
+    capture_py_error("PD_TensorCopyFromCpu: memoryview");
+    return;
+  }
+  PyRef np(PyImport_ImportModule("numpy"));
+  if (!np) {
+    capture_py_error("PD_TensorCopyFromCpu: import numpy");
+    return;
+  }
+  PyRef flat(PyObject_CallMethod(np.p, "frombuffer", "Os", mv.p, np_dtype));
+  if (!flat) {
+    capture_py_error("PD_TensorCopyFromCpu: frombuffer");
+    return;
+  }
+  PyRef shape_tup(PyTuple_New(tensor->shape.size()));
+  for (size_t i = 0; i < tensor->shape.size(); ++i)
+    PyTuple_SET_ITEM(shape_tup.p, i, PyLong_FromLong(tensor->shape[i]));
+  PyRef arr(PyObject_CallMethod(flat.p, "reshape", "O", shape_tup.p));
+  if (!arr) {
+    capture_py_error("PD_TensorCopyFromCpu: reshape");
+    return;
+  }
+  PyRef r(PyObject_CallMethod(tensor->handle, "copy_from_cpu", "O", arr.p));
+  if (!r) capture_py_error("PD_TensorCopyFromCpu: copy_from_cpu");
+}
+
+// copy_to: fetch the output as a host ndarray, cast to the requested
+// dtype if the artifact produced a different one (e.g. bf16 under a
+// reduced-precision config), and memcpy out via the buffer protocol.
+void copy_to(PD_Tensor* tensor, void* data, const char* np_dtype) {
+  if (!tensor || !data) return;
+  Gil gil;
+  PyRef arr(PyObject_CallMethod(tensor->handle, "copy_to_cpu", nullptr));
+  if (!arr) {
+    capture_py_error("PD_TensorCopyToCpu: copy_to_cpu");
+    return;
+  }
+  PyRef np(PyImport_ImportModule("numpy"));
+  if (!np) {
+    capture_py_error("PD_TensorCopyToCpu: import numpy");
+    return;
+  }
+  PyRef cast(PyObject_CallMethod(np.p, "ascontiguousarray", "Os", arr.p,
+                                 np_dtype));
+  if (!cast) {
+    capture_py_error("PD_TensorCopyToCpu: ascontiguousarray");
+    return;
+  }
+  Py_buffer view;
+  if (PyObject_GetBuffer(cast.p, &view, PyBUF_CONTIG_RO) != 0) {
+    capture_py_error("PD_TensorCopyToCpu: buffer");
+    return;
+  }
+  memcpy(data, view.buf, static_cast<size_t>(view.len));
+  PyBuffer_Release(&view);
+}
+
+}  // namespace
+
+void PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* d) {
+  copy_from(t, d, "float32", 4);
+}
+void PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* d) {
+  copy_from(t, d, "int64", 8);
+}
+void PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* d) {
+  copy_from(t, d, "int32", 4);
+}
+void PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* d) {
+  copy_from(t, d, "uint8", 1);
+}
+void PD_TensorCopyFromCpuInt8(PD_Tensor* t, const int8_t* d) {
+  copy_from(t, d, "int8", 1);
+}
+
+void PD_TensorCopyToCpuFloat(PD_Tensor* t, float* d) {
+  copy_to(t, d, "float32");
+}
+void PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* d) {
+  copy_to(t, d, "int64");
+}
+void PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* d) {
+  copy_to(t, d, "int32");
+}
+void PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* d) {
+  copy_to(t, d, "uint8");
+}
+void PD_TensorCopyToCpuInt8(PD_Tensor* t, int8_t* d) { copy_to(t, d, "int8"); }
+
+PD_OneDimArrayInt32* PD_TensorGetShape(PD_Tensor* tensor) {
+  if (!tensor) return nullptr;
+  Gil gil;
+  PyRef shp(PyObject_CallMethod(tensor->handle, "shape", nullptr));
+  if (!shp) {
+    capture_py_error("PD_TensorGetShape");
+    return nullptr;
+  }
+  PyRef fast(PySequence_Fast(shp.p, "PD_TensorGetShape"));
+  if (!fast) {
+    capture_py_error("PD_TensorGetShape");
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast.p);
+  PD_OneDimArrayInt32* arr = static_cast<PD_OneDimArrayInt32*>(
+      malloc(sizeof(PD_OneDimArrayInt32)));
+  arr->size = static_cast<size_t>(n);
+  arr->data =
+      static_cast<int32_t*>(malloc(sizeof(int32_t) * (n > 0 ? n : 1)));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    arr->data[i] = static_cast<int32_t>(
+        PyLong_AsLong(PySequence_Fast_GET_ITEM(fast.p, i)));
+  return arr;
+}
+
+PD_DataType PD_TensorGetDataType(PD_Tensor* tensor) {
+  if (!tensor) return PD_DATA_UNK;
+  Gil gil;
+  PyRef ty(PyObject_CallMethod(tensor->handle, "type", nullptr));
+  if (!ty) {
+    capture_py_error("PD_TensorGetDataType");
+    return PD_DATA_UNK;
+  }
+  PyRef s(PyObject_Str(ty.p));
+  const char* c = s ? PyUnicode_AsUTF8(s.p) : nullptr;
+  if (!c) return PD_DATA_UNK;
+  std::string d(c);
+  if (d.find("float32") != std::string::npos) return PD_DATA_FLOAT32;
+  if (d.find("bfloat16") != std::string::npos) return PD_DATA_BFLOAT16;
+  if (d.find("float16") != std::string::npos) return PD_DATA_FLOAT16;
+  if (d.find("int64") != std::string::npos) return PD_DATA_INT64;
+  if (d.find("int32") != std::string::npos) return PD_DATA_INT32;
+  if (d.find("uint8") != std::string::npos) return PD_DATA_UINT8;
+  if (d.find("int8") != std::string::npos) return PD_DATA_INT8;
+  return PD_DATA_UNK;
+}
+
+const char* PD_TensorGetName(PD_Tensor* tensor) {
+  return tensor ? tensor->name.c_str() : "";
+}
+
+/* ---- array destroyers -------------------------------------------- */
+
+void PD_OneDimArrayInt32Destroy(PD_OneDimArrayInt32* array) {
+  if (!array) return;
+  free(array->data);
+  free(array);
+}
+
+void PD_OneDimArrayCstrDestroy(PD_OneDimArrayCstr* array) {
+  if (!array) return;
+  for (size_t i = 0; i < array->size; ++i) free(array->data[i]);
+  free(array->data);
+  free(array);
+}
+
+}  // extern "C"

@@ -1,0 +1,173 @@
+/* paddle_tpu C inference API.
+ *
+ * Reference parity: paddle/fluid/inference/capi_exp/pd_inference_api.h:1
+ * (PD_Config / PD_Predictor / PD_Tensor C ABI over AnalysisPredictor).
+ * TPU-native translation: the engine behind this ABI is the StableHLO
+ * artifact executor (paddle_tpu.inference.Predictor over jax.export);
+ * the C layer owns an embedded CPython interpreter and marshals buffers
+ * through the Python buffer protocol.  Same calling conventions as the
+ * reference: __pd_give pointers are owned by the caller (destroy with
+ * the matching *Destroy), __pd_keep pointers stay owned by the callee.
+ *
+ * Usage from a plain C program:
+ *   1. ensure PYTHONPATH contains the paddle_tpu repo root (the library
+ *      boots an embedded interpreter on first PD_PredictorCreate);
+ *   2. link against libpaddle_tpu_capi.so (which links libpython);
+ *   3. drive the PD_* calls exactly like the reference C API.
+ */
+#ifndef PADDLE_TPU_PD_INFERENCE_API_H_
+#define PADDLE_TPU_PD_INFERENCE_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#if defined(__cplusplus)
+extern "C" {
+#endif
+
+#define PD_CAPI_EXPORT __attribute__((visibility("default")))
+
+typedef int32_t PD_Bool;
+
+typedef enum PD_DataType {
+  PD_DATA_UNK = -1,
+  PD_DATA_FLOAT32 = 0,
+  PD_DATA_INT64 = 1,
+  PD_DATA_INT32 = 2,
+  PD_DATA_UINT8 = 3,
+  PD_DATA_INT8 = 4,
+  PD_DATA_FLOAT16 = 5,
+  PD_DATA_BFLOAT16 = 6,
+} PD_DataType;
+
+typedef enum PD_PrecisionType {
+  PD_PRECISION_FLOAT32 = 0,
+  PD_PRECISION_HALF = 1,
+  PD_PRECISION_BFLOAT16 = 2,
+  PD_PRECISION_INT8 = 3,
+} PD_PrecisionType;
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+
+typedef struct PD_OneDimArrayInt32 {
+  size_t size;
+  int32_t* data;
+} PD_OneDimArrayInt32;
+
+typedef struct PD_OneDimArrayCstr {
+  size_t size;
+  char** data;
+} PD_OneDimArrayCstr;
+
+/* ---- library ----------------------------------------------------- */
+
+/* Version string of the underlying paddle_tpu package ("unknown"
+ * before the first predictor boots the interpreter). */
+PD_CAPI_EXPORT const char* PD_GetVersion();
+
+/* Thread-local message of the last failed call ("" if none). */
+PD_CAPI_EXPORT const char* PD_GetLastErrorMessage();
+
+/* ---- config ------------------------------------------------------ */
+
+PD_CAPI_EXPORT PD_Config* PD_ConfigCreate();
+PD_CAPI_EXPORT void PD_ConfigDestroy(PD_Config* config);
+
+/* Artifact location: <prefix>.pdmodel + <prefix>.pdiparams pair
+ * written by paddle_tpu.jit.save / static.save_inference_model. */
+PD_CAPI_EXPORT void PD_ConfigSetModel(PD_Config* config,
+                                      const char* prog_file_path,
+                                      const char* params_file_path);
+PD_CAPI_EXPORT void PD_ConfigSetProgFile(PD_Config* config,
+                                         const char* prog_file_path);
+PD_CAPI_EXPORT void PD_ConfigSetParamsFile(PD_Config* config,
+                                           const char* params_file_path);
+PD_CAPI_EXPORT const char* PD_ConfigGetProgFile(PD_Config* config);
+PD_CAPI_EXPORT const char* PD_ConfigGetParamsFile(PD_Config* config);
+
+/* Device selection.  EnableUseGpu routes to the accelerator for
+ * source compatibility with reference deployments. */
+PD_CAPI_EXPORT void PD_ConfigEnableTpu(PD_Config* config,
+                                       int32_t device_id);
+PD_CAPI_EXPORT void PD_ConfigEnableUseGpu(PD_Config* config,
+                                          uint64_t memory_pool_init_size_mb,
+                                          int32_t device_id);
+PD_CAPI_EXPORT void PD_ConfigDisableGpu(PD_Config* config);
+PD_CAPI_EXPORT PD_Bool PD_ConfigUseTpu(PD_Config* config);
+PD_CAPI_EXPORT PD_Bool PD_ConfigUseGpu(PD_Config* config);
+
+/* Reduced-precision execution (re-traces the artifact; see
+ * paddle_tpu.inference.Config.set_precision). */
+PD_CAPI_EXPORT void PD_ConfigSetPrecision(PD_Config* config,
+                                          PD_PrecisionType precision);
+
+PD_CAPI_EXPORT void PD_ConfigSetCpuMathLibraryNumThreads(
+    PD_Config* config, int32_t num_threads);
+
+/* ---- predictor --------------------------------------------------- */
+
+/* Boots the embedded interpreter on first call; returns NULL on
+ * failure (see PD_GetLastErrorMessage). Takes ownership semantics of
+ * the reference: the config may be destroyed after this returns. */
+PD_CAPI_EXPORT PD_Predictor* PD_PredictorCreate(PD_Config* config);
+PD_CAPI_EXPORT PD_Predictor* PD_PredictorClone(PD_Predictor* predictor);
+PD_CAPI_EXPORT void PD_PredictorDestroy(PD_Predictor* predictor);
+
+PD_CAPI_EXPORT size_t PD_PredictorGetInputNum(PD_Predictor* predictor);
+PD_CAPI_EXPORT size_t PD_PredictorGetOutputNum(PD_Predictor* predictor);
+PD_CAPI_EXPORT PD_OneDimArrayCstr* PD_PredictorGetInputNames(
+    PD_Predictor* predictor);
+PD_CAPI_EXPORT PD_OneDimArrayCstr* PD_PredictorGetOutputNames(
+    PD_Predictor* predictor);
+PD_CAPI_EXPORT PD_Tensor* PD_PredictorGetInputHandle(
+    PD_Predictor* predictor, const char* name);
+PD_CAPI_EXPORT PD_Tensor* PD_PredictorGetOutputHandle(
+    PD_Predictor* predictor, const char* name);
+
+PD_CAPI_EXPORT PD_Bool PD_PredictorRun(PD_Predictor* predictor);
+
+PD_CAPI_EXPORT void PD_PredictorClearIntermediateTensor(
+    PD_Predictor* predictor);
+
+/* ---- tensor ------------------------------------------------------ */
+
+PD_CAPI_EXPORT void PD_TensorDestroy(PD_Tensor* tensor);
+PD_CAPI_EXPORT void PD_TensorReshape(PD_Tensor* tensor, size_t shape_size,
+                                     int32_t* shape);
+
+PD_CAPI_EXPORT void PD_TensorCopyFromCpuFloat(PD_Tensor* tensor,
+                                              const float* data);
+PD_CAPI_EXPORT void PD_TensorCopyFromCpuInt64(PD_Tensor* tensor,
+                                              const int64_t* data);
+PD_CAPI_EXPORT void PD_TensorCopyFromCpuInt32(PD_Tensor* tensor,
+                                              const int32_t* data);
+PD_CAPI_EXPORT void PD_TensorCopyFromCpuUint8(PD_Tensor* tensor,
+                                              const uint8_t* data);
+PD_CAPI_EXPORT void PD_TensorCopyFromCpuInt8(PD_Tensor* tensor,
+                                             const int8_t* data);
+
+PD_CAPI_EXPORT void PD_TensorCopyToCpuFloat(PD_Tensor* tensor, float* data);
+PD_CAPI_EXPORT void PD_TensorCopyToCpuInt64(PD_Tensor* tensor,
+                                            int64_t* data);
+PD_CAPI_EXPORT void PD_TensorCopyToCpuInt32(PD_Tensor* tensor,
+                                            int32_t* data);
+PD_CAPI_EXPORT void PD_TensorCopyToCpuUint8(PD_Tensor* tensor,
+                                            uint8_t* data);
+PD_CAPI_EXPORT void PD_TensorCopyToCpuInt8(PD_Tensor* tensor, int8_t* data);
+
+PD_CAPI_EXPORT PD_OneDimArrayInt32* PD_TensorGetShape(PD_Tensor* tensor);
+PD_CAPI_EXPORT PD_DataType PD_TensorGetDataType(PD_Tensor* tensor);
+PD_CAPI_EXPORT const char* PD_TensorGetName(PD_Tensor* tensor);
+
+/* ---- array destroyers -------------------------------------------- */
+
+PD_CAPI_EXPORT void PD_OneDimArrayInt32Destroy(PD_OneDimArrayInt32* array);
+PD_CAPI_EXPORT void PD_OneDimArrayCstrDestroy(PD_OneDimArrayCstr* array);
+
+#if defined(__cplusplus)
+}
+#endif
+
+#endif /* PADDLE_TPU_PD_INFERENCE_API_H_ */

@@ -1,0 +1,204 @@
+// Package paddle — Go serving API for paddle_tpu inference.
+//
+// Reference parity: paddle/fluid/inference/goapi/ (config.go,
+// predictor.go, tensor.go — cgo over the C inference ABI).  This
+// wrapper binds the same surface to paddle_tpu's C ABI
+// (libpaddle_tpu_capi.so, header pd_inference_api.h), whose engine is
+// the StableHLO artifact executor.
+//
+// Build: the shared library must be built first
+// (python -c "import paddle_tpu.inference.capi as c; c.build()") and
+// PYTHONPATH must contain the repo root when the predictor boots the
+// embedded interpreter.  NOTE: the build image for this repo carries no
+// Go toolchain, so this file is shipped as source parity and is
+// exercised only through the C ABI tests (tests/test_capi.py), which
+// cover every function this wrapper calls.
+package paddle
+
+/*
+#cgo CFLAGS: -I${SRCDIR}/../capi
+#cgo LDFLAGS: -L${SRCDIR}/../capi -lpaddle_tpu_capi
+#include <stdlib.h>
+#include "pd_inference_api.h"
+*/
+import "C"
+
+import (
+	"runtime"
+	"unsafe"
+)
+
+// Precision mirrors PD_PrecisionType.
+type Precision int32
+
+const (
+	PrecisionFloat32  Precision = 0
+	PrecisionHalf     Precision = 1
+	PrecisionBfloat16 Precision = 2
+	PrecisionInt8     Precision = 3
+)
+
+// Config mirrors paddle_tpu.inference.Config.
+type Config struct {
+	c *C.PD_Config
+}
+
+func NewConfig() *Config {
+	cfg := &Config{c: C.PD_ConfigCreate()}
+	runtime.SetFinalizer(cfg, func(c *Config) { C.PD_ConfigDestroy(c.c) })
+	return cfg
+}
+
+// SetModel points at a <prefix>.pdmodel/<prefix>.pdiparams artifact pair.
+func (c *Config) SetModel(prog, params string) {
+	p := C.CString(prog)
+	q := C.CString(params)
+	defer C.free(unsafe.Pointer(p))
+	defer C.free(unsafe.Pointer(q))
+	C.PD_ConfigSetModel(c.c, p, q)
+}
+
+func (c *Config) SetProgFile(prog string) {
+	p := C.CString(prog)
+	defer C.free(unsafe.Pointer(p))
+	C.PD_ConfigSetProgFile(c.c, p)
+}
+
+func (c *Config) EnableTpu(deviceID int32) {
+	C.PD_ConfigEnableTpu(c.c, C.int32_t(deviceID))
+}
+
+func (c *Config) DisableGpu() { C.PD_ConfigDisableGpu(c.c) }
+
+func (c *Config) SetPrecision(p Precision) {
+	C.PD_ConfigSetPrecision(c.c, C.PD_PrecisionType(p))
+}
+
+// Predictor mirrors paddle_tpu.inference.Predictor.
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+func NewPredictor(cfg *Config) *Predictor {
+	p := C.PD_PredictorCreate(cfg.c)
+	if p == nil {
+		return nil
+	}
+	pred := &Predictor{c: p}
+	runtime.SetFinalizer(pred, func(p *Predictor) {
+		C.PD_PredictorDestroy(p.c)
+	})
+	return pred
+}
+
+func (p *Predictor) Clone() *Predictor {
+	cl := C.PD_PredictorClone(p.c)
+	if cl == nil {
+		return nil
+	}
+	out := &Predictor{c: cl}
+	runtime.SetFinalizer(out, func(p *Predictor) {
+		C.PD_PredictorDestroy(p.c)
+	})
+	return out
+}
+
+func cstrArray(arr *C.PD_OneDimArrayCstr) []string {
+	if arr == nil {
+		// C side failed; caller can read GetLastErrorMessage().
+		return nil
+	}
+	defer C.PD_OneDimArrayCstrDestroy(arr)
+	n := int(arr.size)
+	out := make([]string, n)
+	data := unsafe.Slice(arr.data, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(data[i])
+	}
+	return out
+}
+
+func (p *Predictor) GetInputNames() []string {
+	return cstrArray(C.PD_PredictorGetInputNames(p.c))
+}
+
+func (p *Predictor) GetOutputNames() []string {
+	return cstrArray(C.PD_PredictorGetOutputNames(p.c))
+}
+
+func (p *Predictor) GetInputHandle(name string) *Tensor {
+	n := C.CString(name)
+	defer C.free(unsafe.Pointer(n))
+	return newTensor(C.PD_PredictorGetInputHandle(p.c, n))
+}
+
+func (p *Predictor) GetOutputHandle(name string) *Tensor {
+	n := C.CString(name)
+	defer C.free(unsafe.Pointer(n))
+	return newTensor(C.PD_PredictorGetOutputHandle(p.c, n))
+}
+
+func (p *Predictor) Run() bool { return C.PD_PredictorRun(p.c) != 0 }
+
+// Tensor mirrors the PD_Tensor IO handle.
+type Tensor struct {
+	c *C.PD_Tensor
+}
+
+func newTensor(c *C.PD_Tensor) *Tensor {
+	if c == nil {
+		return nil
+	}
+	t := &Tensor{c: c}
+	runtime.SetFinalizer(t, func(t *Tensor) { C.PD_TensorDestroy(t.c) })
+	return t
+}
+
+func (t *Tensor) Reshape(shape []int32) {
+	C.PD_TensorReshape(t.c, C.size_t(len(shape)),
+		(*C.int32_t)(unsafe.Pointer(&shape[0])))
+}
+
+func (t *Tensor) CopyFromCpuFloat(data []float32) {
+	C.PD_TensorCopyFromCpuFloat(t.c,
+		(*C.float)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) CopyFromCpuInt64(data []int64) {
+	C.PD_TensorCopyFromCpuInt64(t.c,
+		(*C.int64_t)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) CopyToCpuFloat(data []float32) {
+	C.PD_TensorCopyToCpuFloat(t.c,
+		(*C.float)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) CopyToCpuInt64(data []int64) {
+	C.PD_TensorCopyToCpuInt64(t.c,
+		(*C.int64_t)(unsafe.Pointer(&data[0])))
+}
+
+func (t *Tensor) Shape() []int32 {
+	arr := C.PD_TensorGetShape(t.c)
+	if arr == nil {
+		return nil
+	}
+	defer C.PD_OneDimArrayInt32Destroy(arr)
+	n := int(arr.size)
+	out := make([]int32, n)
+	data := unsafe.Slice(arr.data, n)
+	for i := 0; i < n; i++ {
+		out[i] = int32(data[i])
+	}
+	return out
+}
+
+// GetVersion returns the underlying paddle_tpu package version.
+func GetVersion() string { return C.GoString(C.PD_GetVersion()) }
+
+// GetLastErrorMessage returns the thread-local error of the last
+// failed call.
+func GetLastErrorMessage() string {
+	return C.GoString(C.PD_GetLastErrorMessage())
+}

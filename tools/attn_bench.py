@@ -30,13 +30,30 @@ def main():
     use_wall = args.wall
     if not use_wall:
         try:
-            import xprof  # noqa: F401 — device_time needs its converter
+            # probe the exact dependency device_time uses, not just the
+            # top-level package (version skew can lack the converter)
+            from xprof.convert import raw_to_tool_data  # noqa: F401
         except ImportError:
-            print("xprof not installed: falling back to --wall timing "
-                  "(contention-sensitive on shared chips)",
+            print("xprof converter not importable: falling back to "
+                  "--wall timing (contention-sensitive on shared chips)",
                   file=sys.stderr)
             use_wall = True
-    timer = wall_time if use_wall else device_time
+    if use_wall:
+        timer = wall_time
+    else:
+        def timer(f, a):
+            # device_time returns None when the capture produced no
+            # xplane, 0.0 when hlo_stats had no self-time rows, and can
+            # raise on converter skew; fall back to wall per-row.
+            try:
+                t = device_time(f, a)
+            except Exception as e:
+                print(f"device capture failed ({e!r}): wall timing for "
+                      "this row", file=sys.stderr)
+                t = None
+            if not t:
+                t = wall_time(f, a)
+            return t
     import importlib
     fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
 
