@@ -5,3 +5,7 @@ from .ops_mod import (softmax_mask_fuse,  # noqa: F401
                       softmax_mask_fuse_upper_triangle, segment_sum,
                       segment_mean, segment_min, segment_max)
 from .optimizer_mod import LookAhead, ModelAverage  # noqa: F401
+# CTR-stack contrib layers (reference fluid/contrib/layers/nn.py:785
+# shuffle_batch, :1498 batch_fc; fluid/layers hash)
+from ..ops.ctr import (shuffle_batch, batch_fc,  # noqa: F401
+                       hash_op)

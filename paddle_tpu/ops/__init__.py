@@ -23,6 +23,7 @@ from .amp_ops import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .fused_ops import *  # noqa: F401,F403
+from .ctr import *  # noqa: F401,F403
 
 from . import creation, math, reduction, manipulation, logic, linalg, \
     activation, conv, norm_ops, loss, nn_misc, amp_ops, extras, \

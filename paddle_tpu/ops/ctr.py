@@ -1,0 +1,299 @@
+"""CTR-stack layer ops: continuous_value_model (cvm), data_norm, hash,
+shuffle_batch, batch_fc.
+
+Reference parity: ``operators/cvm_op.h`` (CvmComputeKernel /
+CvmGradComputeKernel), ``operators/data_norm_op.cc:269`` (DataNormKernel:
+means = batch_sum / batch_size, scales = sqrt(batch_size /
+batch_square_sum), slot-dim show-gating), ``operators/hash_op.h``
+(XXH64(row, seed=j) % mod_by per hash), ``operators/shuffle_batch_op.h``
+(seeded row permutation + ShuffleIdx, grad = un-shuffle),
+``fluid/contrib/layers/nn.py:1498`` batch_fc (per-slot batched FC).
+These are the user-facing ops of the sparse/CTR tier whose storage side
+(SSD/CTR PS tables) lives in ``distributed/fleet/ps.py``.
+
+TPU-first notes: cvm/data_norm/batch_fc/shuffle_batch are pure jax
+lowerings (shuffle_batch draws its permutation key from the framework
+counter-stream generator so it is jit-replayable); ``hash`` is a host
+(numpy) op — the reference runs it CPU-only inside the data pipeline
+(no CUDA kernel exists there either), and uint64 xxhash arithmetic is
+unrepresentable on the x64-disabled device path by design.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["continuous_value_model", "data_norm", "hash_op",
+           "shuffle_batch", "batch_fc"]
+
+
+# ---------------------------------------------------------------------------
+# continuous_value_model
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _cvm(x, cvm, use_cvm):
+    if use_cvm:
+        show = jnp.log(x[:, :1] + 1.0)
+        click = jnp.log(x[:, 1:2] + 1.0) - show
+        return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+def _cvm_fwd(x, cvm, use_cvm):
+    return _cvm(x, cvm, use_cvm), cvm
+
+
+def _cvm_bwd(use_cvm, cvm, dy):
+    # reference CvmGradComputeKernel: the show/click columns of dX are
+    # OVERWRITTEN with the CVM values (not differentiated through the
+    # log transform) — cvm_op.h:44-51
+    if use_cvm:
+        dx = jnp.concatenate([cvm.astype(dy.dtype), dy[:, 2:]], axis=1)
+    else:
+        dx = jnp.concatenate([cvm.astype(dy.dtype), dy], axis=1)
+    return dx, jnp.zeros(cvm.shape, cvm.dtype)
+
+
+_cvm.defvjp(_cvm_fwd, _cvm_bwd)
+
+
+def continuous_value_model(input, cvm, use_cvm: bool = True):
+    """CTR show/click preprocessing (reference
+    ``fluid/layers/nn.py:14142``): input (N, D) with show/click in the
+    first two columns; use_cvm=True log-transforms them in place
+    (out (N, D)), False strips them (out (N, D-2))."""
+    x, c = to_tensor(input), to_tensor(cvm)
+    return dispatch("cvm", lambda x, c: _cvm(x, c, bool(use_cvm)),
+                    [x, c], {})
+
+
+# ---------------------------------------------------------------------------
+# data_norm
+# ---------------------------------------------------------------------------
+def data_norm(x, batch_size, batch_sum, batch_square_sum,
+              epsilon: float = 1e-4, slot_dim: int = -1):
+    """Normalize with accumulated global statistics (reference
+    ``data_norm_op.cc:269``): means = batch_sum / batch_size, scales =
+    sqrt(batch_size / batch_square_sum); y = (x - mean) * scale.  With
+    slot_dim > 0, a slot whose leading (show) element is ~0 emits zeros
+    for that slot (un-shown CTR feature gating, data_norm_op.cc:317-330).
+
+    Returns (y, means, scales).  Statistic updates are the caller's
+    policy (the DataNorm layer accumulates them per batch with the
+    summary decay; the reference routes them through optimizer-applied
+    gradients — equivalent accumulation, different carrier)."""
+    xs = [to_tensor(t) for t in (x, batch_size, batch_sum,
+                                 batch_square_sum)]
+
+    def impl(x, bsize, bsum, bsq):
+        means = bsum / bsize
+        scales = jnp.sqrt(bsize / jnp.maximum(bsq, epsilon))
+        y = (x - means[None, :]) * scales[None, :]
+        if slot_dim > 0:
+            D = x.shape[-1]
+            show = x[:, 0:D:slot_dim]                      # (N, D/slot)
+            live = (jnp.abs(show) >= 1e-7)
+            y = y * jnp.repeat(live.astype(y.dtype), slot_dim, axis=1)
+        return y, means, scales
+
+    out = dispatch("data_norm", impl, xs, {})
+    return out[0], out[1], out[2]
+
+
+# ---------------------------------------------------------------------------
+# hash (XXH64, host-side like the reference's CPU-only kernel)
+# ---------------------------------------------------------------------------
+_P1 = np.uint64(11400714785074694791)
+_P2 = np.uint64(14029467366897019727)
+_P3 = np.uint64(1609587929392839161)
+_P4 = np.uint64(9650029242287828579)
+_P5 = np.uint64(2870177450012600261)
+
+
+def _rotl(x, r):
+    r = np.uint64(r)
+    return np.uint64((x << r) | (x >> (np.uint64(64) - r)))
+
+
+def _xxh64_round(acc, lane):
+    acc = np.uint64(acc + lane * _P2)
+    return np.uint64(_rotl(acc, 31) * _P1)
+
+
+def _xxh64(data: bytes, seed: int) -> int:
+    """XXH64 over a byte string (numpy-uint64 port of the public
+    xxhash reference algorithm; validated against its published test
+    vectors in tests/test_ctr_ops.py)."""
+    with np.errstate(over="ignore"):
+        seed = np.uint64(seed)
+        n = len(data)
+        arr = np.frombuffer(data, np.uint8)
+        i = 0
+        if n >= 32:
+            v1 = np.uint64(seed + _P1 + _P2)
+            v2 = np.uint64(seed + _P2)
+            v3 = np.uint64(seed)
+            v4 = np.uint64(seed - _P1)
+            while i + 32 <= n:
+                lanes = arr[i:i + 32].view(np.uint64)
+                v1 = _xxh64_round(v1, lanes[0])
+                v2 = _xxh64_round(v2, lanes[1])
+                v3 = _xxh64_round(v3, lanes[2])
+                v4 = _xxh64_round(v4, lanes[3])
+                i += 32
+            h = np.uint64(_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12)
+                          + _rotl(v4, 18))
+            for v in (v1, v2, v3, v4):
+                h = np.uint64((h ^ _xxh64_round(np.uint64(0), v)) * _P1
+                              + _P4)
+        else:
+            h = np.uint64(seed + _P5)
+        h = np.uint64(h + np.uint64(n))
+        while i + 8 <= n:
+            k = _xxh64_round(np.uint64(0), arr[i:i + 8].view(np.uint64)[0])
+            h = np.uint64(_rotl(h ^ k, 27) * _P1 + _P4)
+            i += 8
+        if i + 4 <= n:
+            k = np.uint64(arr[i:i + 4].view(np.uint32)[0])
+            h = np.uint64(_rotl(h ^ np.uint64(k * _P1), 23) * _P2 + _P3)
+            i += 4
+        while i < n:
+            h = np.uint64(_rotl(h ^ np.uint64(arr[i] * _P5), 11) * _P1)
+            i += 1
+        h = np.uint64((h ^ (h >> np.uint64(33))) * _P2)
+        h = np.uint64((h ^ (h >> np.uint64(29))) * _P3)
+        return int(h ^ (h >> np.uint64(32)))
+
+
+def _xxh64_rows(lanes: np.ndarray, seed: int) -> np.ndarray:
+    """Vectorized XXH64 over N equal-length rows of uint64 lanes
+    (CTR id rows are fixed-width, so the lane loop runs over the short
+    row length while every step vectorizes over N).  Row byte length is
+    a multiple of 8, so only the 32-byte stripe + 8-byte lane paths of
+    the algorithm apply.  Bit-identical to _xxh64 (pinned in tests)."""
+    with np.errstate(over="ignore"):
+        N, L = lanes.shape
+        n = np.uint64(L * 8)
+        seed = np.uint64(seed)
+        i = 0
+        if L >= 4:
+            v1 = np.full(N, seed + _P1 + _P2, np.uint64)
+            v2 = np.full(N, seed + _P2, np.uint64)
+            v3 = np.full(N, seed, np.uint64)
+            v4 = np.full(N, seed - _P1, np.uint64)
+            while i + 4 <= L:
+                v1 = _xxh64_round(v1, lanes[:, i])
+                v2 = _xxh64_round(v2, lanes[:, i + 1])
+                v3 = _xxh64_round(v3, lanes[:, i + 2])
+                v4 = _xxh64_round(v4, lanes[:, i + 3])
+                i += 4
+            h = np.uint64(_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12)
+                          + _rotl(v4, 18))
+            for v in (v1, v2, v3, v4):
+                h = np.uint64((h ^ _xxh64_round(np.uint64(0), v)) * _P1
+                              + _P4)
+        else:
+            h = np.full(N, seed + _P5, np.uint64)
+        h = np.uint64(h + n)
+        while i < L:
+            k = _xxh64_round(np.uint64(0), lanes[:, i])
+            h = np.uint64(_rotl(h ^ k, 27) * _P1 + _P4)
+            i += 1
+        h = np.uint64((h ^ (h >> np.uint64(33))) * _P2)
+        h = np.uint64((h ^ (h >> np.uint64(29))) * _P3)
+        return np.uint64(h ^ (h >> np.uint64(32)))
+
+
+def hash_op(x, hash_size: int, num_hash: int = 1):
+    """Bucketed multi-hash of id rows (reference ``operators/hash_op.h``:
+    out[i, j] = XXH64(row_i_bytes, seed=j) % mod_by, output shape
+    (..., num_hash, 1)).  The hash itself runs on host (the reference's
+    kernel is CPU-only too — it lives in the data pipeline); under jit
+    tracing it rides jax.pure_callback, so it composes with compiled
+    programs.  Output dtype is int32 (x64-disabled canonical int; bucket
+    ids are < hash_size which must fit int32)."""
+    if hash_size > np.iinfo(np.int32).max:
+        raise ValueError("hash_op: hash_size must fit int32 on the "
+                         f"x64-disabled device path, got {hash_size}")
+    t = to_tensor(x)
+    data = t._data
+    if data.ndim == 1:
+        data = data[:, None]
+    lead, last = data.shape[:-1], data.shape[-1]
+    out_shape = (*lead, num_hash, 1)
+
+    def host_hash(arr):
+        flat = np.asarray(arr).reshape(-1, last).astype(np.int64)
+        lanes = flat.view(np.uint64)
+        cols = [(_xxh64_rows(lanes, j) % np.uint64(hash_size))
+                .astype(np.int32) for j in range(num_hash)]
+        return np.stack(cols, axis=1).reshape(out_shape)
+
+    if isinstance(data, jax.core.Tracer):
+        out = jax.pure_callback(
+            host_hash, jax.ShapeDtypeStruct(out_shape, jnp.int32), data)
+    else:
+        out = jnp.asarray(host_hash(data))
+    return Tensor(out)
+
+
+# ---------------------------------------------------------------------------
+# shuffle_batch
+# ---------------------------------------------------------------------------
+def _shuffle(x, idx):
+    # jnp.take's autodiff transpose is scatter-add at idx, which for a
+    # permutation IS the reference shuffle_batch_grad (un-shuffle)
+    flat = x.reshape(-1, x.shape[-1])
+    return jnp.take(flat, idx, axis=0).reshape(x.shape)
+
+
+def shuffle_batch(x, seed=None):
+    """Random row shuffle along the flattened leading dims (reference
+    ``fluid/contrib/layers/nn.py:785`` / ``shuffle_batch_op.h``) —
+    decorrelates in-batch negatives in CTR training.  Returns the
+    shuffled tensor (reference contrib surface); the gradient
+    un-shuffles."""
+    t = to_tensor(x)
+    rows = int(np.prod(t.shape[:-1]))
+    if seed is not None:
+        key = jax.random.PRNGKey(int(seed))
+    else:
+        from ..core.random import default_generator
+        key = default_generator.next_key()
+    idx = jax.random.permutation(key, rows)
+    return dispatch("shuffle_batch", lambda x, i: _shuffle(x, i),
+                    [t, Tensor(idx)], {})
+
+
+# ---------------------------------------------------------------------------
+# batch_fc
+# ---------------------------------------------------------------------------
+def batch_fc(input, w, bias=None, act=None):
+    """Per-slot batched FC (reference ``contrib/layers/nn.py:1498`` /
+    ``operators/batch_fc_op``): input (slot, B, in) @ w (slot, in, out)
+    + bias (slot, 1, out) -> (slot, B, out).  One einsum — the MXU runs
+    it as a batched matmul."""
+    xs = [to_tensor(input), to_tensor(w)]
+    if bias is not None:
+        xs.append(to_tensor(bias))
+
+    if act is not None and not hasattr(jax.nn, act):
+        raise ValueError(f"batch_fc: unknown activation {act!r}")
+
+    def impl(x, w, b=None):
+        y = jnp.einsum("sbi,sio->sbo", x, w)
+        if b is not None:
+            y = y + b
+        if act is not None:
+            # reference append_activation: any registered activation name
+            y = getattr(jax.nn, act)(y)
+        return y
+
+    return dispatch("batch_fc", impl, xs, {})

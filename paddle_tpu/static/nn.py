@@ -113,6 +113,63 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
     return _activate(out, act)
 
 
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
+              data_layout='NCHW', in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """CTR data normalization with accumulated global stats (reference
+    ``fluid/layers/nn.py:3257`` data_norm / ``operators/data_norm_op.cc``):
+    creates batch_size/batch_sum/batch_square_sum stat parameters
+    (defaults 1e4/0/1e4 — identity normalization until stats
+    accumulate) and normalizes with means = sum/size, scales =
+    sqrt(size/square_sum)."""
+    from ..nn.initializer import Constant
+    from ..ops import ctr as _ctr
+    C = int(input.shape[-1] if data_layout == 'NHWC'
+            else input.shape[1])
+    defaults = {"batch_size": 1e4, "batch_sum": 0.0, "batch_square": 1e4}
+    if isinstance(param_attr, dict):
+        defaults.update({k: param_attr[k] for k in
+                         ("batch_size", "batch_sum", "batch_square")
+                         if k in param_attr})
+    dtype = input.dtype
+    bsize = _make_param([C], dtype, None,
+                        default_initializer=Constant(
+                            float(defaults["batch_size"])))
+    bsum = _make_param([C], dtype, None,
+                       default_initializer=Constant(
+                           float(defaults["batch_sum"])))
+    bsq = _make_param([C], dtype, None,
+                      default_initializer=Constant(
+                          float(defaults["batch_square"])))
+    # the stats are ACCUMULATORS, not loss-gradient parameters: the
+    # reference updates them by emitting the batch's count/sum/sq-sum as
+    # their "gradient" under a dedicated update rule (data_norm_op.cc
+    # grad kernel + DataNormParamRule on the PS side).  Chain-rule
+    # gradients through means/scales would corrupt them, so they are
+    # grad-stopped here; accumulation is the training loop's / PS
+    # table's policy.
+    for stat in (bsize, bsum, bsq):
+        stat.stop_gradient = True
+    y, _, _ = _ctr.data_norm(input, bsize, bsum, bsq, epsilon=epsilon,
+                             slot_dim=slot_dim)
+    if enable_scale_and_shift:
+        sw = _make_param([C], dtype, None,
+                         default_initializer=Constant(1.0))
+        b = _make_param([C], dtype, None, is_bias=True,
+                        default_initializer=Constant(0.0))
+        y = _ops.add(_ops.multiply(y, sw), b)
+    return _activate(y, act)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """reference ``fluid/layers/nn.py:14142`` — see ops/ctr.py."""
+    from ..ops import ctr as _ctr
+    return _ctr.continuous_value_model(input, cvm, use_cvm)
+
+
 def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None,
             dropout_implementation="downgrade_in_infer"):
     if is_test:

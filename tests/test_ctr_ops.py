@@ -1,0 +1,227 @@
+"""CTR-stack layer ops: cvm, data_norm, hash (XXH64), shuffle_batch,
+batch_fc — numpy oracles + reference-grad semantics.
+
+Mirrors the reference's test_cvm_op.py / test_data_norm_op.py /
+test_hash_op.py / test_shuffle_batch_op.py / test_batch_fc_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import ctr
+
+
+class TestCvm:
+    def test_use_cvm_forward(self):
+        x = np.abs(np.random.RandomState(0).rand(4, 6)).astype("float32")
+        cvm = np.ones((4, 2), np.float32)
+        out = ctr.continuous_value_model(paddle.to_tensor(x),
+                                         paddle.to_tensor(cvm), True)
+        got = np.asarray(out._data)
+        want = x.copy()
+        want[:, 0] = np.log(x[:, 0] + 1)
+        want[:, 1] = np.log(x[:, 1] + 1) - want[:, 0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_no_cvm_strips_columns(self):
+        x = np.random.RandomState(1).rand(3, 5).astype("float32")
+        cvm = np.zeros((3, 2), np.float32)
+        out = ctr.continuous_value_model(paddle.to_tensor(x),
+                                         paddle.to_tensor(cvm), False)
+        np.testing.assert_allclose(np.asarray(out._data), x[:, 2:],
+                                   rtol=1e-6)
+
+    def test_grad_overwrites_show_click(self):
+        """Reference CvmGradComputeKernel (cvm_op.h:44-51): dX's first
+        two columns are the CVM values, not differentiated logs."""
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(np.abs(rng.rand(4, 6)).astype("float32"))
+        x.stop_gradient = False
+        cvm = paddle.to_tensor(rng.rand(4, 2).astype("float32"))
+        out = ctr.continuous_value_model(x, cvm, True)
+        paddle.sum(out).backward()
+        g = np.asarray(x.grad._data)
+        np.testing.assert_allclose(g[:, :2], np.asarray(cvm._data),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(g[:, 2:], np.ones((4, 4)), rtol=1e-6)
+
+
+class TestDataNorm:
+    def test_normalization_math(self):
+        """means = sum/size, scales = sqrt(size/square_sum)
+        (data_norm_op.cc:303-304)."""
+        rng = np.random.RandomState(3)
+        x = rng.rand(8, 4).astype("float32")
+        bsize = np.full((4,), 16.0, np.float32)
+        bsum = rng.rand(4).astype("float32") * 16
+        bsq = np.full((4,), 32.0, np.float32)
+        y, means, scales = ctr.data_norm(
+            paddle.to_tensor(x), paddle.to_tensor(bsize),
+            paddle.to_tensor(bsum), paddle.to_tensor(bsq))
+        want_means = bsum / bsize
+        want_scales = np.sqrt(bsize / bsq)
+        np.testing.assert_allclose(np.asarray(means._data), want_means,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(scales._data), want_scales,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(y._data), (x - want_means) * want_scales, rtol=1e-5)
+
+    def test_slot_show_gating(self):
+        """slot_dim > 0: a slot whose show (first element) is ~0 emits
+        zeros (data_norm_op.cc:317-330)."""
+        x = np.ones((2, 6), np.float32)
+        x[0, 0] = 0.0          # slot 0 of row 0 un-shown
+        ones = np.ones((6,), np.float32)
+        y, _, _ = ctr.data_norm(
+            paddle.to_tensor(x), paddle.to_tensor(ones * 2),
+            paddle.to_tensor(ones),          # means 0.5 -> y != 0
+            paddle.to_tensor(ones * 2),
+            slot_dim=3)
+        got = np.asarray(y._data)
+        assert np.all(got[0, :3] == 0)
+        assert np.any(got[0, 3:] != 0)
+
+    def test_static_nn_layer_initial_identity(self):
+        """Default stats (1e4/0/1e4) normalize to identity."""
+        import paddle_tpu.static.nn as snn
+        x = np.random.RandomState(4).rand(4, 3).astype("float32")
+        y = snn.data_norm(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(y._data), x, rtol=1e-5)
+
+    def test_stats_take_no_loss_gradient(self):
+        """The stat accumulators must NOT receive chain-rule gradients
+        (the reference updates them by a dedicated accumulation rule,
+        not dL/dstats — see static.nn.data_norm)."""
+        import paddle_tpu.static.nn as snn
+        x = paddle.to_tensor(
+            np.random.RandomState(5).rand(4, 3).astype("float32"))
+        x.stop_gradient = False
+        y = snn.data_norm(x)
+        paddle.sum(y * y).backward()
+        assert x.grad is not None
+
+
+class TestHash:
+    def test_xxh64_published_vectors(self):
+        """Pins the in-repo XXH64 against the algorithm's published
+        test vectors (xxhash spec) and documented string digests."""
+        assert ctr._xxh64(b"", 0) == 0xEF46DB3751D8E999
+        assert ctr._xxh64(b"", 2654435761) == 0xAC75FDA2929B17EF
+        assert ctr._xxh64(b"abc", 0) == 0x44BC2CF5AD770999
+
+    def test_xxh64_against_reference_library(self):
+        """Every length class (short tail, 4/8-byte lanes, >= 32-byte
+        accumulator path) against the canonical xxhash C library."""
+        xxhash = pytest.importorskip("xxhash")
+        import random
+        random.seed(0)
+        for n in (0, 1, 3, 7, 8, 15, 31, 32, 33, 100, 1000):
+            data = bytes(random.randrange(256) for _ in range(n))
+            for seed in (0, 12345):
+                assert ctr._xxh64(data, seed) == \
+                    xxhash.xxh64(data, seed=seed).intdigest(), (n, seed)
+
+    def test_hash_op_shape_and_determinism(self):
+        ids = np.array([[1, 2], [3, 4], [1, 2]], np.int64)
+        out = ctr.hash_op(paddle.to_tensor(ids), hash_size=1000,
+                          num_hash=4)
+        got = np.asarray(out._data)
+        assert got.shape == (3, 4, 1)
+        assert np.all(got >= 0) and np.all(got < 1000)
+        np.testing.assert_array_equal(got[0], got[2])  # same row, same hash
+        assert not np.array_equal(got[0], got[1])
+        # matches the scalar XXH64 over the row bytes
+        row = ids[0].tobytes()
+        assert got[0, 2, 0] == ctr._xxh64(row, 2) % 1000
+
+    def test_vectorized_rows_match_scalar(self):
+        rng = np.random.RandomState(9)
+        for last in (1, 2, 3, 4, 5, 8):
+            flat = rng.randint(0, 1 << 40, (7, last)).astype(np.int64)
+            lanes = flat.view(np.uint64)
+            vec = ctr._xxh64_rows(lanes, 3)
+            for i in range(7):
+                assert int(vec[i]) == ctr._xxh64(flat[i].tobytes(), 3), \
+                    (last, i)
+
+    def test_hash_op_under_jit(self):
+        """Traced path rides jax.pure_callback (reference hash is a
+        graph op usable inside programs)."""
+        import jax
+        ids = np.array([[5, 6], [7, 8]], np.int64)
+        eager = np.asarray(ctr.hash_op(paddle.to_tensor(ids),
+                                       hash_size=997, num_hash=2)._data)
+
+        @jax.jit
+        def f(a):
+            return ctr.hash_op(paddle.Tensor(a), hash_size=997,
+                               num_hash=2)._data
+
+        import jax.numpy as jnp
+        np.testing.assert_array_equal(
+            np.asarray(f(jnp.asarray(ids))), eager)
+
+
+class TestShuffleBatch:
+    @staticmethod
+    def _perm_of(out, x):
+        """Recover the permutation from distinct rows (reference
+        surface returns only the shuffled tensor)."""
+        return np.array([int(np.where((x == row).all(axis=1))[0][0])
+                         for row in out])
+
+    def test_shuffle_is_permutation(self):
+        x = np.arange(24, dtype=np.float32).reshape(6, 4)
+        out = ctr.shuffle_batch(paddle.to_tensor(x), seed=7)
+        got = np.asarray(out._data)
+        perm = self._perm_of(got, x)
+        np.testing.assert_allclose(got, x[perm], rtol=0)
+        assert sorted(perm.tolist()) == list(range(6))
+
+    def test_grad_unshuffles(self):
+        rng = np.random.RandomState(5)
+        xv = rng.rand(5, 3).astype("float32")
+        x = paddle.to_tensor(xv)
+        x.stop_gradient = False
+        out = ctr.shuffle_batch(x, seed=11)
+        w = paddle.to_tensor(rng.rand(5, 3).astype("float32"))
+        paddle.sum(out * w).backward()
+        perm = self._perm_of(np.asarray(out._data), xv)
+        want = np.empty((5, 3), np.float32)
+        want[perm] = np.asarray(w._data)     # route back to source rows
+        np.testing.assert_allclose(np.asarray(x.grad._data), want,
+                                   rtol=1e-6)
+
+
+class TestBatchFC:
+    def test_forward_and_grad(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(3, 4, 5).astype("float32")      # (slot, B, in)
+        w = rng.rand(3, 5, 2).astype("float32")
+        b = rng.rand(3, 1, 2).astype("float32")
+        xt = paddle.to_tensor(x); xt.stop_gradient = False
+        wt = paddle.to_tensor(w); wt.stop_gradient = False
+        out = ctr.batch_fc(xt, wt, paddle.to_tensor(b), act="relu")
+        want = np.maximum(np.einsum("sbi,sio->sbo", x, w) + b, 0)
+        # any jax.nn activation name works (reference append_activation)
+        sig = ctr.batch_fc(paddle.to_tensor(x), paddle.to_tensor(w),
+                           act="sigmoid")
+        np.testing.assert_allclose(
+            np.asarray(sig._data),
+            1 / (1 + np.exp(-np.einsum("sbi,sio->sbo", x, w))), rtol=1e-5)
+        with pytest.raises(ValueError):
+            ctr.batch_fc(paddle.to_tensor(x), paddle.to_tensor(w),
+                         act="nope")
+        np.testing.assert_allclose(np.asarray(out._data), want, rtol=1e-5)
+        paddle.sum(out).backward()
+        mask = (want > 0).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(wt.grad._data),
+            np.einsum("sbi,sbo->sio", x, mask), rtol=1e-5)
+
+    def test_incubate_exports(self):
+        import paddle_tpu.incubate as incubate
+        assert incubate.shuffle_batch is ctr.shuffle_batch
+        assert incubate.batch_fc is ctr.batch_fc
+        assert incubate.hash_op is ctr.hash_op

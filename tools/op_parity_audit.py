@@ -91,6 +91,8 @@ def our_api_names():
 # same collapse paddle 2.x itself performed on these fluid-era op names).
 # ---------------------------------------------------------------------------
 ALIASES = {
+    # CTR-stack ops implemented in ops/ctr.py (r5)
+    "hash": "incubate.hash_op (host XXH64, ops/ctr.py)",
     # fluid-era double names: the v1/suffix-2 op is the same kernel
     "lookup_table": "nn.Embedding / nn.functional.embedding",
     "lookup_table_v2": "nn.Embedding / nn.functional.embedding",
@@ -403,10 +405,9 @@ SCOPED = {
     "pull_box_sparse": SCOPE_PS_CTR, "push_box_sparse": SCOPE_PS_CTR,
     "push_box_extended_sparse": SCOPE_PS_CTR,
     "pull_box_extended_sparse": SCOPE_PS_CTR, "push_gpups_sparse": SCOPE_PS_CTR,
-    "pyramid_hash": SCOPE_PS_CTR, "hash": SCOPE_PS_CTR,
-    "filter_by_instag": SCOPE_PS_CTR, "shuffle_batch": SCOPE_PS_CTR,
-    "cvm": SCOPE_PS_CTR, "data_norm": SCOPE_PS_CTR,
-    "rank_attention": SCOPE_PS_CTR, "batch_fc": SCOPE_PS_CTR,
+    "pyramid_hash": SCOPE_PS_CTR,
+    "filter_by_instag": SCOPE_PS_CTR,
+    "rank_attention": SCOPE_PS_CTR,
     "tdm_child": SCOPE_PS_CTR, "tdm_sampler": SCOPE_PS_CTR,
     "cos_sim": SCOPE_DEPRECATED,
     "im2sequence": SCOPE_DEPRECATED,
