@@ -91,6 +91,10 @@ def data_norm(x, batch_size, batch_sum, batch_square_sum,
     gradients — equivalent accumulation, different carrier)."""
     xs = [to_tensor(t) for t in (x, batch_size, batch_sum,
                                  batch_square_sum)]
+    if slot_dim > 0 and xs[0].shape[-1] % slot_dim != 0:
+        raise ValueError(
+            f"data_norm: feature width {xs[0].shape[-1]} is not a "
+            f"multiple of slot_dim {slot_dim}")
 
     def impl(x, bsize, bsum, bsq):
         means = bsum / bsize
@@ -218,12 +222,21 @@ def hash_op(x, hash_size: int, num_hash: int = 1):
     kernel is CPU-only too — it lives in the data pipeline); under jit
     tracing it rides jax.pure_callback, so it composes with compiled
     programs.  Output dtype is int32 (x64-disabled canonical int; bucket
-    ids are < hash_size which must fit int32)."""
+    ids are < hash_size which must fit int32).
+
+    Pass the RAW numpy id array (the data-pipeline stage the reference
+    runs this in): int64 ids hash at full 64-bit width.  A framework
+    Tensor input works too, but Tensors are int32-canonicalized at
+    creation (x64 off), so ids >= 2^31 passed through to_tensor were
+    already truncated BEFORE reaching this op — hash the host array."""
     if hash_size > np.iinfo(np.int32).max:
         raise ValueError("hash_op: hash_size must fit int32 on the "
                          f"x64-disabled device path, got {hash_size}")
-    t = to_tensor(x)
-    data = t._data
+    if isinstance(x, (np.ndarray, list, tuple)):
+        # host path: no device round-trip, no int64 -> int32 truncation
+        data = np.asarray(x)
+    else:
+        data = to_tensor(x)._data
     if data.ndim == 1:
         data = data[:, None]
     lead, last = data.shape[:-1], data.shape[-1]

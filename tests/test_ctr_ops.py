@@ -89,17 +89,38 @@ class TestDataNorm:
         y = snn.data_norm(paddle.to_tensor(x))
         np.testing.assert_allclose(np.asarray(y._data), x, rtol=1e-5)
 
-    def test_stats_take_no_loss_gradient(self):
+    def test_stats_take_no_loss_gradient(self, monkeypatch):
         """The stat accumulators must NOT receive chain-rule gradients
         (the reference updates them by a dedicated accumulation rule,
         not dL/dstats — see static.nn.data_norm)."""
         import paddle_tpu.static.nn as snn
+        created = []
+        orig = snn._make_param
+
+        def capture(*a, **k):
+            p = orig(*a, **k)
+            created.append(p)
+            return p
+
+        monkeypatch.setattr(snn, "_make_param", capture)
         x = paddle.to_tensor(
             np.random.RandomState(5).rand(4, 3).astype("float32"))
         x.stop_gradient = False
         y = snn.data_norm(x)
         paddle.sum(y * y).backward()
         assert x.grad is not None
+        assert len(created) == 3
+        for p in created:
+            assert p.stop_gradient
+            assert getattr(p, "grad", None) is None
+
+    def test_slot_dim_must_divide_width(self):
+        ones = np.ones((5,), np.float32)
+        with pytest.raises(ValueError, match="slot_dim"):
+            ctr.data_norm(
+                paddle.to_tensor(np.ones((2, 5), np.float32)),
+                paddle.to_tensor(ones), paddle.to_tensor(ones),
+                paddle.to_tensor(ones), slot_dim=3)
 
 
 class TestHash:
@@ -134,6 +155,18 @@ class TestHash:
         # matches the scalar XXH64 over the row bytes
         row = ids[0].tobytes()
         assert got[0, 2, 0] == ctr._xxh64(row, 2) % 1000
+
+    def test_full_64bit_ids_on_host_path(self):
+        """Raw numpy ids hash at full 64-bit width — no int32
+        canonicalization (the silent-truncation hazard of routing CTR
+        ids through to_tensor)."""
+        big = np.array([[(1 << 40) + 123]], np.int64)
+        out = np.asarray(ctr.hash_op(big, hash_size=1_000_000)._data)
+        want = ctr._xxh64(big[0].tobytes(), 0) % 1_000_000
+        assert out[0, 0, 0] == want
+        # and it differs from the truncated-int32 hash
+        trunc = big.astype(np.int32).astype(np.int64)
+        assert want != ctr._xxh64(trunc[0].tobytes(), 0) % 1_000_000
 
     def test_vectorized_rows_match_scalar(self):
         rng = np.random.RandomState(9)
