@@ -31,7 +31,8 @@ from ..core.dispatch import dispatch
 from ..core.tensor import Tensor, to_tensor
 
 __all__ = ["continuous_value_model", "data_norm", "hash_op",
-           "shuffle_batch", "batch_fc"]
+           "shuffle_batch", "batch_fc", "tdm_child",
+           "lookup_table_dequant", "filter_by_instag"]
 
 
 # ---------------------------------------------------------------------------
@@ -310,3 +311,101 @@ def batch_fc(input, w, bias=None, act=None):
         return y
 
     return dispatch("batch_fc", impl, xs, {})
+
+
+# ---------------------------------------------------------------------------
+# tdm_child (tree-based deep match: child lookup)
+# ---------------------------------------------------------------------------
+def tdm_child(x, tree_info, child_nums: int):
+    """Children of each tree node (reference ``operators/tdm_child_op.h``
+    TDMChildInner): tree_info rows are [item_id, layer_id, ancestor,
+    child_0 .. child_{n-1}]; a node has children iff id != 0 and
+    child_0 != 0; emitted mask marks children that are leaf items
+    (item_id != 0).  Pure gathers — jit/TPU friendly.
+
+    x (..., ) int node ids -> (child (..., child_nums), leaf_mask
+    (..., child_nums)) int32."""
+    xt, info = to_tensor(x), to_tensor(tree_info)
+
+    def impl(ids, info):
+        kids = info[ids, 3:3 + child_nums]            # (..., child_nums)
+        has_child = ((ids != 0) & (info[ids, 3] != 0))[..., None]
+        kids = jnp.where(has_child, kids, 0)
+        is_item = (info[kids, 0] != 0) & has_child
+        return kids.astype(jnp.int32), is_item.astype(jnp.int32)
+
+    out = dispatch("tdm_child", impl, [xt, info], {})
+    return out[0], out[1]
+
+
+# ---------------------------------------------------------------------------
+# lookup_table_dequant (int8-quantized embedding lookup)
+# ---------------------------------------------------------------------------
+def lookup_table_dequant(w, ids, padding_idx: int = -1):
+    """Embedding lookup over a row-quantized table (reference
+    ``operators/lookup_table_dequant_op.h``): each f32 table row is
+    [min, max, packed uint8 codes x4-per-float]; out = (max - min)/256
+    * code + min, row width (cols - 2) * 4.  The unpack is a device
+    bitcast (lax.bitcast_convert_type f32 -> 4x uint8), so the lookup
+    stays on-device and jittable — only the ROWS TOUCHED are ever
+    dequantized (the reference's rationale: serving-size tables at 1/4
+    HBM)."""
+    wt, idt = to_tensor(w), to_tensor(ids)
+
+    def impl(w, ids):
+        shape = ids.shape
+        flat = ids.reshape(-1)
+        rows = jnp.take(w, flat, axis=0)              # (N, cols)
+        mn, mx = rows[:, 0:1], rows[:, 1:2]
+        codes = jax.lax.bitcast_convert_type(
+            rows[:, 2:], jnp.uint8).reshape(flat.shape[0], -1)
+        out = (mx - mn) / 256.0 * codes.astype(jnp.float32) + mn
+        if padding_idx != -1:
+            out = jnp.where((flat == padding_idx)[:, None],
+                            jnp.zeros_like(out), out)
+        return out.reshape(*shape, out.shape[-1])
+
+    return dispatch("lookup_table_dequant", impl, [wt, idt], {})
+
+
+# ---------------------------------------------------------------------------
+# filter_by_instag (host op: output row count is data-dependent)
+# ---------------------------------------------------------------------------
+def filter_by_instag(ins, ins_tag, filter_tag, out_val_if_empty: int = 0):
+    """Keep instances whose tag set intersects filter_tag (reference
+    ``operators/filter_by_instag_op.h``).  Host/data-pipeline op — the
+    output row count is data-dependent (the reference kernel is
+    CPU-only for the same reason).
+
+    ins: (N, D) rows, one instance per row; ins_tag: list of per-
+    instance tag lists (the LoD form collapses to this); filter_tag:
+    iterable of tags.  Returns (out rows, index_map (k, 3) of
+    [out_start, in_start, len], loss_weight (k, 1)); when nothing
+    survives, one row filled with out_val_if_empty, loss_weight 0 and
+    index_map [[0, 1, 1]] (reference empty-branch values).
+
+    Being a host op it cannot carry autograd (the reference registers
+    FilterByInstagGradKernel to scatter d(Out) back through IndexMap);
+    filtering a differentiable mid-network activation therefore raises
+    instead of silently detaching — filter the (non-grad) input features
+    in the data pipeline, the op's primary reference use."""
+    t = to_tensor(ins)
+    if not t.stop_gradient:
+        raise ValueError(
+            "filter_by_instag is a host/data-pipeline op and does not "
+            "propagate gradients (ins.stop_gradient is False); filter "
+            "before the differentiable part of the network")
+    x = np.asarray(t._data)
+    tags = [set(int(t) for t in row) for row in ins_tag]
+    keep = set(int(t) for t in filter_tag)
+    idx = [i for i, row in enumerate(tags) if row & keep]
+    if idx:
+        out = x[idx]
+        imap = np.array([[o, i, 1] for o, i in enumerate(idx)], np.int64)
+        lw = np.ones((len(idx), 1), np.float32)
+    else:
+        out = np.full((1, x.shape[1]), out_val_if_empty, x.dtype)
+        imap = np.array([[0, 1, 1]], np.int64)   # reference empty branch
+        lw = np.zeros((1, 1), np.float32)
+    return (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(imap)),
+            Tensor(jnp.asarray(lw)))

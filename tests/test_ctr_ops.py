@@ -258,3 +258,96 @@ class TestBatchFC:
         assert incubate.shuffle_batch is ctr.shuffle_batch
         assert incubate.batch_fc is ctr.batch_fc
         assert incubate.hash_op is ctr.hash_op
+
+
+class TestTdmChild:
+    def test_children_and_leaf_mask(self):
+        # node: [item_id, layer, ancestor, child0, child1]
+        info = np.array([
+            [0, 0, 0, 0, 0],     # 0: null
+            [0, 0, 0, 2, 3],     # 1: root (non-item), children 2,3
+            [5, 1, 1, 4, 0],     # 2: item 5, child 4
+            [6, 1, 1, 0, 0],     # 3: item 6, leaf (no children)
+            [7, 2, 2, 0, 0],     # 4: item 7, leaf
+        ], np.int32)
+        ids = paddle.to_tensor(np.array([1, 2, 3], np.int32))
+        child, mask = ctr.tdm_child(ids, paddle.to_tensor(info),
+                                    child_nums=2)
+        np.testing.assert_array_equal(
+            np.asarray(child._data), [[2, 3], [4, 0], [0, 0]])
+        # child 2 -> item 5 (mask 1), child 3 -> item 6 (mask 1);
+        # node 3 has no children -> zeros
+        np.testing.assert_array_equal(
+            np.asarray(mask._data), [[1, 1], [1, 0], [0, 0]])
+
+
+class TestLookupTableDequant:
+    def test_dequant_roundtrip(self):
+        """Quantize known rows into the reference layout ([min, max,
+        4-codes-per-float]) and check the lookup dequantizes them."""
+        rng = np.random.RandomState(0)
+        rows, width = 5, 8
+        dense = rng.randn(rows, width).astype(np.float32)
+        table = np.zeros((rows, 2 + width // 4), np.float32)
+        for r in range(rows):
+            mn, mx = dense[r].min(), dense[r].max()
+            scale = (mx - mn) / 256.0
+            codes = np.clip((dense[r] - mn) / max(scale, 1e-12), 0,
+                            255).astype(np.uint8)
+            table[r, 0], table[r, 1] = mn, mx
+            table[r, 2:] = codes.view(np.float32)
+        ids = paddle.to_tensor(np.array([3, 0, 3], np.int32))
+        out = ctr.lookup_table_dequant(paddle.to_tensor(table), ids)
+        got = np.asarray(out._data)
+        assert got.shape == (3, width)
+        scale3 = (table[3, 1] - table[3, 0]) / 256.0
+        np.testing.assert_allclose(got[0], got[2], rtol=0)
+        np.testing.assert_allclose(got[0], dense[3], atol=scale3 + 1e-6)
+
+    def test_padding_idx_zeros(self):
+        table = np.zeros((2, 3), np.float32)
+        table[:, 1] = 1.0
+        out = ctr.lookup_table_dequant(
+            paddle.to_tensor(table),
+            paddle.to_tensor(np.array([0, 1], np.int32)), padding_idx=1)
+        got = np.asarray(out._data)
+        assert np.all(got[1] == 0)
+
+
+class TestFilterByInstag:
+    def test_filters_matching_instances(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        tags = [[1], [2, 3], [4], [3]]
+        out, imap, lw = ctr.filter_by_instag(paddle.to_tensor(x), tags,
+                                             [3])
+        np.testing.assert_allclose(np.asarray(out._data), x[[1, 3]])
+        np.testing.assert_array_equal(np.asarray(imap._data)[:, 1],
+                                      [1, 3])
+        np.testing.assert_allclose(np.asarray(lw._data),
+                                   np.ones((2, 1)))
+
+    def test_empty_match_fallback(self):
+        x = np.ones((2, 3), np.float32)
+        out, imap, lw = ctr.filter_by_instag(
+            paddle.to_tensor(x), [[1], [2]], [9], out_val_if_empty=7)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.full((1, 3), 7.0))
+        # reference empty branch: map_data = [0, 1, 1]
+        np.testing.assert_array_equal(np.asarray(imap._data), [[0, 1, 1]])
+        np.testing.assert_allclose(np.asarray(lw._data),
+                                   np.zeros((1, 1)))
+
+    def test_differentiable_input_raises(self):
+        """Host op cannot carry autograd (reference registers a grad
+        kernel); a requires-grad input must error, not silently
+        detach."""
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        x.stop_gradient = False
+        with pytest.raises(ValueError, match="stop_gradient"):
+            ctr.filter_by_instag(x, [[1], [2]], [1])
+
+    def test_incubate_ctr_surface(self):
+        import paddle_tpu.incubate as incubate
+        assert incubate.tdm_child is ctr.tdm_child
+        assert incubate.lookup_table_dequant is ctr.lookup_table_dequant
+        assert incubate.filter_by_instag is ctr.filter_by_instag

@@ -406,9 +406,8 @@ SCOPED = {
     "push_box_extended_sparse": SCOPE_PS_CTR,
     "pull_box_extended_sparse": SCOPE_PS_CTR, "push_gpups_sparse": SCOPE_PS_CTR,
     "pyramid_hash": SCOPE_PS_CTR,
-    "filter_by_instag": SCOPE_PS_CTR,
     "rank_attention": SCOPE_PS_CTR,
-    "tdm_child": SCOPE_PS_CTR, "tdm_sampler": SCOPE_PS_CTR,
+    "tdm_sampler": SCOPE_PS_CTR,
     "cos_sim": SCOPE_DEPRECATED,
     "im2sequence": SCOPE_DEPRECATED,
     "conv_shift": SCOPE_DEPRECATED,
@@ -444,7 +443,6 @@ SCOPED = {
     "fused_elemwise_add_activation": SCOPE_FUSION_CPU,
     "fused_fc_elementwise_layernorm": SCOPE_FUSION_CPU,
     "fusion_transpose_flatten_concat": SCOPE_FUSION_CPU,
-    "lookup_table_dequant": SCOPE_PS_CTR,
     # deprecated fluid-1.x surface paddle 2.x removed
     "add_position_encoding": SCOPE_DEPRECATED,
     "modified_huber_loss": SCOPE_DEPRECATED,
