@@ -6,8 +6,8 @@ from .ops_mod import (softmax_mask_fuse,  # noqa: F401
                       segment_mean, segment_min, segment_max)
 from .optimizer_mod import LookAhead, ModelAverage  # noqa: F401
 # CTR-stack contrib layers (reference fluid/contrib/layers/nn.py:785
-# shuffle_batch, :1498 batch_fc, tdm_child, filter_by_instag;
+# shuffle_batch, :1498 batch_fc, tdm_child/tdm_sampler, filter_by_instag;
 # fluid/layers hash; operators/lookup_table_dequant_op.h)
 from ..ops.ctr import (shuffle_batch, batch_fc,  # noqa: F401
                        hash_op, tdm_child, lookup_table_dequant,
-                       filter_by_instag)
+                       filter_by_instag, tdm_sampler)

@@ -32,7 +32,8 @@ from ..core.tensor import Tensor, to_tensor
 
 __all__ = ["continuous_value_model", "data_norm", "hash_op",
            "shuffle_batch", "batch_fc", "tdm_child",
-           "lookup_table_dequant", "filter_by_instag"]
+           "lookup_table_dequant", "filter_by_instag",
+           "tdm_sampler"]
 
 
 # ---------------------------------------------------------------------------
@@ -326,6 +327,11 @@ def tdm_child(x, tree_info, child_nums: int):
     x (..., ) int node ids -> (child (..., child_nums), leaf_mask
     (..., child_nums)) int32."""
     xt, info = to_tensor(x), to_tensor(tree_info)
+    if 3 + child_nums > info.shape[1]:
+        raise ValueError(
+            f"tdm_child: tree_info rows have {info.shape[1]} columns "
+            f"({info.shape[1] - 3} child slots); child_nums="
+            f"{child_nums} does not fit")
 
     def impl(ids, info):
         kids = info[ids, 3:3 + child_nums]            # (..., child_nums)
@@ -409,3 +415,73 @@ def filter_by_instag(ins, ins_tag, filter_tag, out_val_if_empty: int = 0):
         lw = np.zeros((1, 1), np.float32)
     return (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(imap)),
             Tensor(jnp.asarray(lw)))
+
+
+# ---------------------------------------------------------------------------
+# tdm_sampler (host op: per-layer rejection sampling without replacement)
+# ---------------------------------------------------------------------------
+def tdm_sampler(x, travel, layer, neg_samples_num_list,
+                layer_offset_lod, output_positive: bool = True,
+                seed=None):
+    """Layer-wise positive+negative sampling along TDM tree paths
+    (reference ``operators/tdm_sampler_op.h`` TDMSamplerInner): for each
+    input item, each tree layer contributes [its travel-path node
+    (label 1)] + neg_samples_num uniform negatives drawn from that
+    layer's nodes WITHOUT replacement and excluding the positive
+    (label 0) — the reference's do-while rejects both the positive and
+    already-drawn indices and enforces sample_num <= node_nums - 1
+    (tdm_sampler_op.h:115,178-186), which this mirrors; a padding
+    positive (node 0) zeros the layer's slots with mask 0.  Host op
+    like the reference's CPU-only kernel (runs in the sample/data
+    stage).  With seed=None each call draws a fresh stream from the
+    framework generator (matching shuffle_batch's convention); pass an
+    int seed for reproducible sampling.
+
+    x: (N,) int item ids; travel: (num_items, layer_nums) path node
+    ids; layer: flat per-layer node ids with ``layer_offset_lod``
+    boundaries.  Returns (out, labels, mask), each
+    (N, sum(neg + output_positive)) int32."""
+    ids = np.asarray(to_tensor(x)._data).reshape(-1)
+    trav = np.asarray(to_tensor(travel)._data)
+    layer_data = np.asarray(to_tensor(layer)._data).reshape(-1)
+    layer_nums = len(neg_samples_num_list)
+    pos = 1 if output_positive else 0
+    width = sum(n + pos for n in neg_samples_num_list)
+    if seed is None:
+        from ..core.random import default_generator
+        key = np.asarray(default_generator.next_key())
+        seed = int(np.uint32(key[0]) ^ np.uint32(key[1]))
+    rng = np.random.RandomState(seed)
+
+    N = ids.shape[0]
+    out = np.zeros((N, width), np.int32)
+    labels = np.zeros((N, width), np.int32)
+    mask = np.ones((N, width), np.int32)
+    for i, item in enumerate(ids):
+        off = 0
+        for li in range(layer_nums):
+            lo, hi = layer_offset_lod[li], layer_offset_lod[li + 1]
+            node_nums = hi - lo
+            neg = neg_samples_num_list[li]
+            if neg > node_nums - 1:
+                raise ValueError(
+                    f"tdm_sampler: layer {li} has {node_nums} nodes; "
+                    f"cannot draw {neg} negatives (positive excluded)")
+            positive = int(trav[int(item), li])
+            if positive == 0:                       # padding path
+                out[i, off:off + neg + pos] = 0
+                labels[i, off:off + neg + pos] = 0
+                mask[i, off:off + neg + pos] = 0
+                off += neg + pos
+                continue
+            if pos:
+                out[i, off] = positive
+                labels[i, off] = 1
+                off += 1
+            nodes = layer_data[lo:hi]
+            cand = nodes[nodes != positive]
+            picks = rng.choice(cand.shape[0], size=neg, replace=False)
+            out[i, off:off + neg] = cand[picks]
+            off += neg
+    return (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(labels)),
+            Tensor(jnp.asarray(mask)))

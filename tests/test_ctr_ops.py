@@ -351,3 +351,73 @@ class TestFilterByInstag:
         assert incubate.tdm_child is ctr.tdm_child
         assert incubate.lookup_table_dequant is ctr.lookup_table_dequant
         assert incubate.filter_by_instag is ctr.filter_by_instag
+        assert incubate.tdm_sampler is ctr.tdm_sampler
+
+
+class TestTdmSampler:
+    def _tree(self):
+        # 2 layers: layer0 nodes [1,2], layer1 nodes [3,4,5,6]
+        layer = np.array([1, 2, 3, 4, 5, 6], np.int32)
+        offsets = [0, 2, 6]
+        # item i travels [layer0 node, layer1 node]
+        travel = np.array([[1, 3], [1, 4], [2, 5], [2, 6],
+                           [0, 0]], np.int32)  # item 4: padding path
+        return layer, offsets, travel
+
+    def test_positive_negative_structure(self):
+        layer, offsets, travel = self._tree()
+        ids = paddle.to_tensor(np.array([0, 2], np.int32))
+        out, labels, mask = ctr.tdm_sampler(
+            ids, paddle.to_tensor(travel), paddle.to_tensor(layer),
+            neg_samples_num_list=[1, 2], layer_offset_lod=offsets,
+            output_positive=True, seed=3)
+        o, l, m = (np.asarray(t._data) for t in (out, labels, mask))
+        assert o.shape == (2, 5)               # (1+1) + (1+2)
+        # layer0: positive first, then 1 negative != positive
+        assert o[0, 0] == 1 and l[0, 0] == 1
+        assert o[0, 1] == 2 and l[0, 1] == 0   # only other layer0 node
+        # layer1: positive then 2 distinct negatives from layer1
+        assert o[0, 2] == 3 and l[0, 2] == 1
+        negs = set(o[0, 3:5].tolist())
+        assert len(negs) == 2 and 3 not in negs
+        assert negs <= {4, 5, 6}
+        assert np.all(m == 1)
+        # second input (item 2, travel [2, 5])
+        assert o[1, 0] == 2 and o[1, 2] == 5
+
+    def test_padding_path_masks_out(self):
+        layer, offsets, travel = self._tree()
+        out, labels, mask = ctr.tdm_sampler(
+            paddle.to_tensor(np.array([4], np.int32)),
+            paddle.to_tensor(travel), paddle.to_tensor(layer),
+            neg_samples_num_list=[1, 1], layer_offset_lod=offsets,
+            output_positive=True, seed=0)
+        assert np.all(np.asarray(out._data) == 0)
+        assert np.all(np.asarray(mask._data) == 0)
+
+    def test_default_seed_varies_per_call(self):
+        """seed=None draws from the framework generator — successive
+        calls must not repeat the same negatives byte-for-byte."""
+        layer, offsets, travel = self._tree()
+        paddle.seed(123)
+        ids = paddle.to_tensor(np.arange(4, dtype=np.int32))
+        draws = [np.asarray(ctr.tdm_sampler(
+            ids, paddle.to_tensor(travel), paddle.to_tensor(layer),
+            neg_samples_num_list=[1, 2], layer_offset_lod=offsets)[0]
+            ._data) for _ in range(4)]
+        assert any(not np.array_equal(draws[0], d) for d in draws[1:])
+
+    def test_child_nums_width_check(self):
+        layer, offsets, travel = self._tree()
+        info = np.zeros((3, 5), np.int32)
+        with pytest.raises(ValueError, match="child_nums"):
+            ctr.tdm_child(paddle.to_tensor(np.array([1], np.int32)),
+                          paddle.to_tensor(info), child_nums=4)
+
+    def test_too_many_negatives_raises(self):
+        layer, offsets, travel = self._tree()
+        with pytest.raises(ValueError, match="negatives"):
+            ctr.tdm_sampler(
+                paddle.to_tensor(np.array([0], np.int32)),
+                paddle.to_tensor(travel), paddle.to_tensor(layer),
+                neg_samples_num_list=[2, 1], layer_offset_lod=offsets)
