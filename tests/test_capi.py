@@ -270,7 +270,12 @@ class TestCapiEmbedded:
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH",
                                                              "")
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the artifact fixture exports on the CPU backend; pin the
+        # demo's embedded interpreter to cpu too (the ambient env may
+        # carry JAX_PLATFORMS=axon, so setdefault is not enough), and
+        # skip axon plugin registration for a fast, tunnel-free boot
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
         proc = subprocess.run([exe, prefix, "2", "8"], env=env,
                               capture_output=True, text=True, timeout=600)
         assert proc.returncode == 0, proc.stderr[-2000:]
