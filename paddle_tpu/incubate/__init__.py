@@ -10,4 +10,4 @@ from .optimizer_mod import LookAhead, ModelAverage  # noqa: F401
 # fluid/layers hash; operators/lookup_table_dequant_op.h)
 from ..ops.ctr import (shuffle_batch, batch_fc,  # noqa: F401
                        hash_op, tdm_child, lookup_table_dequant,
-                       filter_by_instag, tdm_sampler)
+                       filter_by_instag, tdm_sampler, rank_attention)

@@ -33,7 +33,7 @@ from ..core.tensor import Tensor, to_tensor
 __all__ = ["continuous_value_model", "data_norm", "hash_op",
            "shuffle_batch", "batch_fc", "tdm_child",
            "lookup_table_dequant", "filter_by_instag",
-           "tdm_sampler"]
+           "tdm_sampler", "rank_attention"]
 
 
 # ---------------------------------------------------------------------------
@@ -485,3 +485,54 @@ def tdm_sampler(x, travel, layer, neg_samples_num_list,
             off += neg
     return (Tensor(jnp.asarray(out)), Tensor(jnp.asarray(labels)),
             Tensor(jnp.asarray(mask)))
+
+
+# ---------------------------------------------------------------------------
+# rank_attention
+# ---------------------------------------------------------------------------
+def rank_attention(x, rank_offset, rank_param, max_rank: int):
+    """Rank-conditioned attention over in-batch instances (reference
+    ``operators/rank_attention.cu.h``): for instance i with rank r_i,
+    gather the features of up to max_rank related instances
+    (rank_offset rows: [rank_i, (rank_k, index_k) x max_rank], 1-based
+    ranks, 0 = absent) and contract them against the (r_i, r_k)-indexed
+    block of rank_param — out[i] = sum_k X[index_k] @ P[(r_i-1)*R +
+    (r_k-1)].  One gather + one batched einsum on the MXU; autodiff
+    reproduces the reference's scatter-merge grad kernels.
+
+    x (N, F); rank_offset (N, 2*max_rank+1) int; rank_param
+    (R*R*F, C).  Returns (out (N, C), input_help (N, R*F), ins_rank
+    (N, 1))."""
+    xt = to_tensor(x)
+    ro = to_tensor(rank_offset)
+    pt = to_tensor(rank_param)
+    if ro.shape[1] != 2 * max_rank + 1:
+        raise ValueError(
+            f"rank_attention: rank_offset has {ro.shape[1]} columns, "
+            f"expected 2*max_rank+1 = {2 * max_rank + 1}")
+    if pt.shape[0] != max_rank * max_rank * xt.shape[1]:
+        # jnp.take clamps out-of-bounds rows, which would turn a
+        # mis-blocked param into silently wrong output — validate here
+        raise ValueError(
+            f"rank_attention: rank_param has {pt.shape[0]} rows, "
+            f"expected max_rank^2 * fea = "
+            f"{max_rank * max_rank * xt.shape[1]}")
+
+    def impl(x, ro, param):
+        N, fea = x.shape
+        lower = ro[:, 0] - 1                       # (N,)
+        faster = ro[:, 1::2] - 1                   # (N, R)
+        index = ro[:, 2::2]                        # (N, R)
+        valid = (lower[:, None] >= 0) & (faster >= 0)
+        gathered = jnp.take(x, jnp.where(valid, index, 0), axis=0)
+        ih = jnp.where(valid[..., None], gathered, 0.0)  # (N, R, F)
+        start = jnp.where(valid, lower[:, None] * max_rank + faster, 0)
+        blocks = param.reshape(max_rank * max_rank, fea, param.shape[1])
+        pb = jnp.take(blocks, start, axis=0)       # (N, R, F, C)
+        pb = jnp.where(valid[..., None, None], pb, 0.0)
+        out = jnp.einsum("nrf,nrfc->nc", ih, pb)
+        return (out, ih.reshape(N, max_rank * fea),
+                ro[:, 0].astype(x.dtype)[:, None])
+
+    out = dispatch("rank_attention", impl, [xt, ro, pt], {})
+    return out[0], out[1], out[2]

@@ -421,3 +421,73 @@ class TestTdmSampler:
                 paddle.to_tensor(np.array([0], np.int32)),
                 paddle.to_tensor(travel), paddle.to_tensor(layer),
                 neg_samples_num_list=[2, 1], layer_offset_lod=offsets)
+
+
+class TestRankAttention:
+    def test_against_numpy_oracle(self):
+        """Direct port of the reference expand kernels' index math as a
+        numpy oracle (rank_attention.cu.h expand_input_by_rank_kernel /
+        expand_rank_attention_param_kernel)."""
+        rng = np.random.RandomState(0)
+        N, F, C, R = 5, 3, 4, 2
+        x = rng.rand(N, F).astype(np.float32)
+        param = rng.rand(R * R * F, C).astype(np.float32)
+        # ranks 1-based; instance 3 invalid (rank 0); one absent slot
+        ro = np.array([
+            [1, 1, 0, 2, 1],
+            [2, 1, 0, 2, 2],
+            [1, 2, 4, 0, 0],     # slot 1 absent (rank 0)
+            [0, 0, 0, 0, 0],     # invalid instance
+            [2, 1, 3, 2, 4],
+        ], np.int32)
+        want = np.zeros((N, C), np.float32)
+        want_ih = np.zeros((N, R * F), np.float32)
+        for i in range(N):
+            lower = ro[i, 0] - 1
+            for k in range(R):
+                faster = ro[i, 1 + 2 * k] - 1
+                if lower < 0 or faster < 0:
+                    continue
+                idx = ro[i, 2 + 2 * k]
+                want_ih[i, k * F:(k + 1) * F] = x[idx]
+                start = lower * R + faster
+                block = param[start * F:(start + 1) * F]   # (F, C)
+                want[i] += x[idx] @ block
+        out, ih, ins_rank = ctr.rank_attention(
+            paddle.to_tensor(x), paddle.to_tensor(ro),
+            paddle.to_tensor(param), max_rank=R)
+        np.testing.assert_allclose(np.asarray(out._data), want, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ih._data), want_ih,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ins_rank._data),
+                                   ro[:, :1].astype(np.float32))
+
+    def test_gradients_flow_to_x_and_param(self):
+        rng = np.random.RandomState(1)
+        N, F, C, R = 4, 2, 3, 2
+        x = paddle.to_tensor(rng.rand(N, F).astype(np.float32))
+        x.stop_gradient = False
+        p = paddle.to_tensor(rng.rand(R * R * F, C).astype(np.float32))
+        p.stop_gradient = False
+        ro = np.array([[1, 1, 1, 2, 2]] * N, np.int32)
+        out, _, _ = ctr.rank_attention(x, paddle.to_tensor(ro), p,
+                                       max_rank=R)
+        paddle.sum(out).backward()
+        assert x.grad is not None and p.grad is not None
+        assert float(paddle.sum(paddle.abs(p.grad))) > 0
+
+    def test_offset_width_validation(self):
+        with pytest.raises(ValueError, match="rank_offset"):
+            ctr.rank_attention(
+                paddle.to_tensor(np.ones((2, 3), np.float32)),
+                paddle.to_tensor(np.ones((2, 4), np.int32)),
+                paddle.to_tensor(np.ones((12, 2), np.float32)),
+                max_rank=2)
+
+    def test_param_shape_validation(self):
+        with pytest.raises(ValueError, match="rank_param"):
+            ctr.rank_attention(
+                paddle.to_tensor(np.ones((2, 3), np.float32)),
+                paddle.to_tensor(np.ones((2, 5), np.int32)),
+                paddle.to_tensor(np.ones((6, 2), np.float32)),  # R*F rows
+                max_rank=2)

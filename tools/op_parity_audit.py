@@ -406,7 +406,6 @@ SCOPED = {
     "push_box_extended_sparse": SCOPE_PS_CTR,
     "pull_box_extended_sparse": SCOPE_PS_CTR, "push_gpups_sparse": SCOPE_PS_CTR,
     "pyramid_hash": SCOPE_PS_CTR,
-    "rank_attention": SCOPE_PS_CTR,
     "cos_sim": SCOPE_DEPRECATED,
     "im2sequence": SCOPE_DEPRECATED,
     "conv_shift": SCOPE_DEPRECATED,
