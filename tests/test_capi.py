@@ -242,6 +242,43 @@ class TestCapiInProcess:
         lib.PD_TensorDestroy(inp)
         lib.PD_PredictorDestroy(predictor)
 
+    def test_concurrent_predictors_thread_safety(self, lib, artifact):
+        """Serving ABI contract: any C thread may call in (PyGILState
+        discipline).  ctypes releases the GIL around the foreign call,
+        so N python threads driving N predictor clones exercises real
+        concurrent entry into the C ABI."""
+        import threading
+        prefix, x, want = artifact
+        cfg = lib.PD_ConfigCreate()
+        lib.PD_ConfigSetProgFile(cfg, prefix.encode())
+        lib.PD_ConfigDisableGpu(cfg)
+        base = lib.PD_PredictorCreate(cfg)
+        lib.PD_ConfigDestroy(cfg)
+        assert base, lib.PD_GetLastErrorMessage().decode()
+        _run_c_path(lib, base, x)        # warm (lazy output names)
+        clones = [lib.PD_PredictorClone(base) for _ in range(4)]
+        results, errors = [None] * 4, []
+
+        def drive(i):
+            try:
+                for _ in range(5):
+                    results[i] = _run_c_path(lib, clones[i], x)
+            except Exception as e:       # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=drive, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for got in results:
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        for c in clones:
+            lib.PD_PredictorDestroy(c)
+        lib.PD_PredictorDestroy(base)
+
     def test_error_message_on_bad_model(self, lib, tmp_path):
         cfg = lib.PD_ConfigCreate()
         lib.PD_ConfigSetProgFile(cfg,
