@@ -434,6 +434,146 @@ def _qkv_small_bwd(qkv, do, num_heads: int, scale: float, causal: bool,
     )(qkv, qkv, qkv, do)
 
 
+def _qkv_mid_bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref,
+                        dv_ref, dk_scr, dv_scr, *, scale: float,
+                        causal: bool, block_q: int, nq: int, seq_q: int,
+                        seq_k: int, P: int, d: int):
+    """Packed mid-regime backward: one 128-lane column block (= P heads)
+    of q/k/v per (b, hp) grid cell, q blocks riding the inner
+    'arbitrary' dim with dK/dV accumulated in f32 scratch across them
+    (the _tiled_bwd_kernel design applied to the packed layout).  Per-
+    head results concatenate into single full-lane-block stores (Mosaic
+    requires provably 128-aligned stores)."""
+    qi = pl.program_id(2)
+    offset = seq_k - seq_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    dq_parts, dk_parts, dv_parts = [], [], []
+    for h in range(P):
+        q = q_ref[0][:, h * d:(h + 1) * d]               # (bq, d)
+        k = k_ref[0][:, h * d:(h + 1) * d]               # (Tk, d)
+        v = v_ref[0][:, h * d:(h + 1) * d]
+        do = do_ref[0][:, h * d:(h + 1) * d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, Tk)
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+                + qi * block_q + offset
+            cols = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        l = jnp.sum(e, axis=-1, keepdims=True)
+        p = e / l
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, Tk)
+        delta = jnp.sum(p * dp, axis=-1, keepdims=True)
+        pb = p.astype(do.dtype)
+        dv_parts.append(jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))         # (Tk, d)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dq_parts.append((scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)).astype(dq_ref.dtype))
+        dk_parts.append(scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))         # (Tk, d)
+    dq_ref[0] = jnp.concatenate(dq_parts, axis=-1)
+    dk_scr[...] += jnp.concatenate(dk_parts, axis=-1)
+    dv_scr[...] += jnp.concatenate(dv_parts, axis=-1)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _qkv_mid_block_q(T: int, Tk: int, itemsize: int) -> int:
+    # ~4 live f32 (block_q, Tk) intermediates + 2 f32 (Tk, 128) scratch
+    # accumulators + 2 resident (Tk, 128) K/V column blocks: bf16
+    # blocks at block_q=256/Tk=2048 total ~13 MB of the 16 MB scoped
+    # VMEM; f32 K/V doubles the resident blocks and measured 16.84 MB
+    # (860K over) at the same shape, so f32 halves block_q
+    block_q = 256 if Tk <= 2048 else 128
+    if itemsize >= 4:
+        block_q //= 2
+    block_q, _ = _block_sizes(T, Tk, block_q, Tk)
+    return block_q
+
+
+def _qkv_mid_bwd(qkv, do, num_heads: int, scale: float, causal: bool,
+                 interpret: bool = False):
+    """-> dqkv (B, T, 3F) for the packed mid regime: three column-
+    blocked outputs + one concatenate (the (1, T, 3F) single-output
+    block of the small-T design is ~28 MB at T=2048 — VMEM-infeasible —
+    so dq/dk/dv emit separately; the concat is one bandwidth-bound pass,
+    ~6x smaller than the split+fold transposes it replaces)."""
+    B, T, F3 = qkv.shape
+    F = F3 // 3
+    d = F // num_heads
+    P = 128 // d
+    HP = num_heads // P
+    block_q = _qkv_mid_block_q(T, T, qkv.dtype.itemsize)
+    nq = T // block_q
+    kernel = functools.partial(_qkv_mid_bwd_kernel, scale=scale,
+                               causal=causal, block_q=block_q, nq=nq,
+                               seq_q=T, seq_k=T, P=P, d=d)
+
+    def col(base):
+        return lambda b, hp, i: (b, 0, base + hp)
+
+    qs = pl.BlockSpec((1, block_q, 128), lambda b, hp, i: (b, i, hp))
+    ks = pl.BlockSpec((1, T, 128), col(HP))
+    vs = pl.BlockSpec((1, T, 128), col(2 * HP))
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(B, HP, nq),
+        in_specs=[qs, ks, vs,
+                  pl.BlockSpec((1, block_q, 128),
+                               lambda b, hp, i: (b, i, hp))],
+        out_specs=[qs,
+                   pl.BlockSpec((1, T, 128), lambda b, hp, i: (b, 0, hp)),
+                   pl.BlockSpec((1, T, 128), lambda b, hp, i: (b, 0, hp))],
+        out_shape=[jax.ShapeDtypeStruct((B, T, F), qkv.dtype)] * 3,
+        scratch_shapes=[pltpu.VMEM((T, 128), jnp.float32),
+                        pltpu.VMEM((T, 128), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qkv, qkv, qkv, do)
+    return jnp.concatenate([dq, dk, dv], axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _flash_qkv_mid(qkv, num_heads, scale, causal):
+    _, interpret = _pallas_mode(qkv.shape[1], qkv.shape[1], causal)
+    T = qkv.shape[1]
+    return _qkv_small_fwd(qkv, num_heads, scale, causal,
+                          block_q=_qkv_mid_block_q(
+                              T, T, qkv.dtype.itemsize),
+                          G=1, interpret=interpret)
+
+
+def _flash_qkv_mid_vjp_fwd(qkv, num_heads, scale, causal):
+    return _flash_qkv_mid(qkv, num_heads, scale, causal), qkv
+
+
+def _flash_qkv_mid_vjp_bwd(num_heads, scale, causal, qkv, g):
+    _, interpret = _pallas_mode(qkv.shape[1], qkv.shape[1], causal)
+    return (_qkv_mid_bwd(qkv, g, num_heads, scale, causal,
+                         interpret=interpret),)
+
+
+_flash_qkv_mid.defvjp(_flash_qkv_mid_vjp_fwd, _flash_qkv_mid_vjp_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def _flash_qkv(qkv, num_heads, scale, causal):
     _, interpret = _pallas_mode(qkv.shape[1], qkv.shape[1], causal)
@@ -467,13 +607,22 @@ def flash_attention_qkv(qkv, num_heads: int, *, causal: bool = False,
     d = F3 // 3 // num_heads
     s = float(scale) if scale is not None else float(1.0 / np.sqrt(d))
     mode, _ = _pallas_mode(T, T, causal)
-    # packed kernels: T <= 512 only — the single-output backward holds
+    packed_ok = d in (32, 64, 128) and num_heads % max(1, 128 // d) == 0
+    # packed small kernels: T <= 512 — the single-output backward holds
     # the (G, T, 3F) cotangent block plus f32 (T, T) intermediates in
-    # VMEM, which busts the 16M scoped limit at T=1024; longer T folds
-    # to (BH, T, d) and takes the generic kernels
-    if mode == "small" and T <= 512 and d in (32, 64, 128) and \
-            num_heads % max(1, 128 // d) == 0:
+    # VMEM, which busts the 16M scoped limit at T=1024
+    if mode == "small" and T <= 512 and packed_ok:
         return _flash_qkv(qkv, num_heads, s, causal)
+    # packed mid kernels: 512 < T <= 2048 — q-block-tiled backward with
+    # dK/dV scratch accumulation per 128-lane column block keeps VMEM
+    # bounded, and the packed entry kills the split+fold head transposes
+    # that cost ~12% of a T=2048 train step (profiled r5; measured
+    # 1.23x/1.13x over split+generic at T=1024/2048 end-to-end).  At
+    # Tk=4096 the packed fwd+bwd pair trips the axon compile-helper
+    # budget (same opaque wall as the 8192 mid experiment, see
+    # BASELINE.md) — 4096 stays on the split+generic mid path.
+    if mode in ("small", "mid") and T <= 2048 and packed_ok:
+        return _flash_qkv_mid(qkv, num_heads, s, causal)
     q, k, v = jnp.split(qkv.reshape(B, T, 3 * num_heads, d), 3, axis=2)
     out = flash_attention(q, k, v, causal=causal, scale=scale)
     return out.reshape(B, T, num_heads * d)

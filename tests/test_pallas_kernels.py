@@ -128,6 +128,55 @@ def test_flash_attention_qkv_packed(force_pallas, causal, H, D):
 
 
 @pytest.mark.slow
+def test_packed_mid_qkv_t1024_gradient(force_pallas):
+    """Pins the packed mid-regime entry (512 < T <= 2048): attention
+    straight from the (B, T, 3F) projection output with the q-block-
+    tiled backward accumulating dK/dV per 128-lane column block —
+    forward and dqkv must match the split + XLA reference."""
+    rs = np.random.RandomState(11)
+    B, T, H, D = 1, 1024, 2, 64
+    qkv = jnp.asarray(rs.rand(B, T, 3 * H * D), jnp.float32)
+    out = fa.flash_attention_qkv(qkv, H, causal=True)
+    q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, D), 3, axis=2)
+    ref = _ref_attention(q, k, v, True).reshape(B, T, H * D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+    g = jnp.asarray(rs.rand(B, T, H * D), jnp.float32)
+    dqkv = jax.vjp(lambda a: fa.flash_attention_qkv(a, H, causal=True),
+                   qkv)[1](g)[0]
+    ref_d = jax.vjp(
+        lambda a: _ref_attention(
+            *jnp.split(a.reshape(B, T, 3 * H, D), 3, axis=2),
+            True).reshape(B, T, H * D), qkv)[1](g)[0]
+    np.testing.assert_allclose(np.asarray(dqkv), np.asarray(ref_d),
+                               atol=5e-5)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("T,H,D", [(768, 4, 32), (2048, 2, 64)])
+def test_packed_mid_qkv_more_shapes(force_pallas, T, H, D):
+    """Packed mid entry across head-packing regimes and at the 2048
+    boundary (where the f32 VMEM budget halves block_q)."""
+    rs = np.random.RandomState(13)
+    B = 1
+    qkv = jnp.asarray(rs.rand(B, T, 3 * H * D), jnp.float32)
+    out = fa.flash_attention_qkv(qkv, H, causal=True)
+    q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, D), 3, axis=2)
+    ref = _ref_attention(q, k, v, True).reshape(B, T, H * D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+    g = jnp.asarray(rs.rand(B, T, H * D), jnp.float32)
+    dqkv = jax.vjp(lambda a: fa.flash_attention_qkv(a, H, causal=True),
+                   qkv)[1](g)[0]
+    ref_d = jax.vjp(
+        lambda a: _ref_attention(
+            *jnp.split(a.reshape(B, T, 3 * H, D), 3, axis=2),
+            True).reshape(B, T, H * D), qkv)[1](g)[0]
+    np.testing.assert_allclose(np.asarray(dqkv), np.asarray(ref_d),
+                               atol=5e-5)
+
+
+@pytest.mark.slow
 def test_mid_regime_t2048_gradient(force_pallas):
     """Pins the long-context (mid-regime) kernel pair at T=2048: the
     full-K-resident tiled forward/backward must match XLA math — this
