@@ -14,7 +14,8 @@ __all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
            "AlphaDropout", "Flatten", "Identity", "Upsample",
            "UpsamplingBilinear2D", "UpsamplingNearest2D", "Pad1D", "Pad2D",
            "Pad3D", "ZeroPad2D", "Bilinear", "CosineSimilarity",
-           "PairwiseDistance", "Unfold", "PixelShuffle"]
+           "PairwiseDistance", "Unfold", "PixelShuffle",
+           "PixelUnshuffle", "ChannelShuffle"]
 
 
 class Linear(Layer):
@@ -254,3 +255,24 @@ class PixelShuffle(Layer):
 
     def forward(self, x):
         return ops.conv.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.conv.pixel_unshuffle(x, self.downscale_factor,
+                                        self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.conv.channel_shuffle(x, self.groups, self.data_format)

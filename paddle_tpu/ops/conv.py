@@ -20,7 +20,8 @@ __all__ = [
     "avg_pool1d", "avg_pool2d", "avg_pool3d", "adaptive_avg_pool1d",
     "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
     "adaptive_max_pool2d", "adaptive_max_pool3d", "max_unpool2d",
-    "interpolate", "upsample", "pixel_shuffle", "unfold", "grid_sample",
+    "interpolate", "upsample", "pixel_shuffle", "pixel_unshuffle",
+    "channel_shuffle", "unfold", "grid_sample",
 ]
 
 
@@ -449,11 +450,50 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
             a = a.reshape(n, c // (r * r), r, r, h, w)
             a = a.transpose(0, 1, 4, 2, 5, 3)
             return a.reshape(n, c // (r * r), h * r, w * r)
+        # reference NHWC convention is channel-major: input channel
+        # index = ch * r^2 + a * r + b (pixel_shuffle_op.h resizes to
+        # {n, h, w, c_out, r, r} and transposes {0,1,4,2,5,3})
         n, h, w, c = a.shape
-        a = a.reshape(n, h, w, r, r, c // (r * r))
-        a = a.transpose(0, 1, 3, 2, 4, 5)
+        a = a.reshape(n, h, w, c // (r * r), r, r)
+        a = a.transpose(0, 1, 4, 2, 5, 3)
         return a.reshape(n, h * r, w * r, c // (r * r))
     return dispatch("pixel_shuffle", impl, (x,), {})
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    """Inverse of pixel_shuffle (reference space_to_depth op — the 1.x
+    name for the same rearrangement)."""
+    x = to_tensor(x)
+    r = downscale_factor
+
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        # exact inverse of the channel-major NHWC pixel_shuffle above
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return dispatch("pixel_unshuffle", impl, (x,), {})
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """ShuffleNet channel shuffle (reference shuffle_channel op)."""
+    x = to_tensor(x)
+    g = groups
+
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, g, c // g, h, w).transpose(
+                0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, g, c // g).transpose(
+            0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return dispatch("channel_shuffle", impl, (x,), {})
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):

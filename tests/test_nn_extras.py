@@ -123,3 +123,62 @@ def test_beam_search_decode_end_to_end():
     # beams sorted best-first
     lp = log_probs.numpy()
     assert (np.diff(lp, axis=1) <= 1e-5).all()
+
+
+class TestPixelChannelShuffles:
+    def test_pixel_unshuffle_inverts_shuffle(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(2, 8, 4, 6).astype("float32"))
+        up = F.pixel_shuffle(x, 2)          # (2, 2, 8, 12)
+        back = F.pixel_unshuffle(up, 2)
+        np.testing.assert_allclose(np.asarray(back._data),
+                                   np.asarray(x._data), rtol=0)
+        assert paddle.nn.PixelUnshuffle(2)(up).shape == list(x.shape)
+
+    def test_channel_shuffle_groups(self):
+        import paddle_tpu.nn.functional as F
+        x = np.arange(2 * 6 * 1 * 1, dtype=np.float32).reshape(2, 6, 1, 1)
+        out = np.asarray(F.channel_shuffle(paddle.to_tensor(x), 3)._data)
+        # (g=3, c/g=2) transpose: channels [0,2,4,1,3,5]
+        np.testing.assert_allclose(out[0, :, 0, 0], x[0, [0, 2, 4, 1, 3, 5],
+                                                      0, 0])
+        assert paddle.nn.ChannelShuffle(3)(
+            paddle.to_tensor(x)).shape == [2, 6, 1, 1]
+
+    def test_nhwc_variants(self):
+        """NHWC follows the reference's CHANNEL-MAJOR convention
+        (pixel_shuffle_op.h: resize {n,h,w,c_out,r,r}, transpose
+        {0,1,4,2,5,3}) — values pinned, not just shapes."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(1)
+        xn = rng.rand(1, 4, 6, 8).astype("float32")
+        x = paddle.to_tensor(xn)
+        u = np.asarray(F.pixel_unshuffle(x, 2, data_format="NHWC")._data)
+        assert u.shape == (1, 2, 3, 32)
+        # out[..., ch*4 + a*2 + b] == in[2i+a, 2j+b, ch]
+        for ch in range(8):
+            for a in range(2):
+                for b in range(2):
+                    np.testing.assert_allclose(
+                        u[0, 1, 2, ch * 4 + a * 2 + b],
+                        xn[0, 2 + a, 4 + b, ch])
+        # shuffle inverts unshuffle in NHWC too
+        back = F.pixel_shuffle(
+            paddle.to_tensor(u), 2, data_format="NHWC")
+        np.testing.assert_allclose(np.asarray(back._data), xn, rtol=0)
+        c = F.channel_shuffle(x, 2, data_format="NHWC")
+        assert c.shape == [1, 4, 6, 8]
+
+    def test_nchw_pixel_shuffle_reference_layout(self):
+        """NCHW channel-major layout (in ch = ch*r^2 + a*r + b)."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(2)
+        xn = rng.rand(1, 8, 2, 3).astype("float32")
+        up = np.asarray(F.pixel_shuffle(paddle.to_tensor(xn), 2)._data)
+        for ch in range(2):
+            for a in range(2):
+                for b in range(2):
+                    np.testing.assert_allclose(
+                        up[0, ch, 2 * 1 + a, 2 * 2 + b],
+                        xn[0, ch * 4 + a * 2 + b, 1, 2])

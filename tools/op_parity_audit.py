@@ -93,6 +93,15 @@ def our_api_names():
 ALIASES = {
     # CTR-stack ops implemented in ops/ctr.py (r5)
     "hash": "incubate.hash_op (host XXH64, ops/ctr.py)",
+    # fluid-1.x names whose capability ships under the 2.x surface
+    "cos_sim": "nn.functional.cosine_similarity",
+    "margin_rank_loss": "nn.functional.margin_ranking_loss",
+    "space_to_depth": "nn.functional.pixel_unshuffle / nn.PixelUnshuffle",
+    "shuffle_channel": "nn.functional.channel_shuffle / nn.ChannelShuffle",
+    "beam_search": "nn.BeamSearchDecoder + dynamic_decode",
+    "squared_l2_distance": "paddle.sum((x-y)**2, -1, keepdim=True) — elementwise composition",
+    "gaussian_random_batch_size_like": "paddle.randn(shape) — batch_size_like is shape plumbing",
+    "uniform_random_batch_size_like": "paddle.uniform(shape) — batch_size_like is shape plumbing",
     # fluid-era double names: the v1/suffix-2 op is the same kernel
     "lookup_table": "nn.Embedding / nn.functional.embedding",
     "lookup_table_v2": "nn.Embedding / nn.functional.embedding",
@@ -406,11 +415,9 @@ SCOPED = {
     "push_box_extended_sparse": SCOPE_PS_CTR,
     "pull_box_extended_sparse": SCOPE_PS_CTR, "push_gpups_sparse": SCOPE_PS_CTR,
     "pyramid_hash": SCOPE_PS_CTR,
-    "cos_sim": SCOPE_DEPRECATED,
     "im2sequence": SCOPE_DEPRECATED,
     "conv_shift": SCOPE_DEPRECATED,
     "fsp": SCOPE_DEPRECATED,
-    "margin_rank_loss": SCOPE_DEPRECATED,
     "rank_loss": SCOPE_DEPRECATED,
     "bpr_loss": SCOPE_DEPRECATED,
     "center_loss": SCOPE_DEPRECATED,
@@ -420,10 +427,7 @@ SCOPED = {
     "var_conv_2d": SCOPE_DEPRECATED,
     "row_conv": SCOPE_DEPRECATED,
     "sample_logits": SCOPE_DEPRECATED,
-    "space_to_depth": SCOPE_DEPRECATED,
-    "shuffle_channel": SCOPE_DEPRECATED,
     "deformable_conv_v1": SCOPE_DEPRECATED,
-    "beam_search": SCOPE_DEPRECATED,
     "shrink_rnn_memory": SCOPE_DEPRECATED,
     "lod_tensor_to_array": SCOPE_DEPRECATED,
     "array_to_lod_tensor": SCOPE_DEPRECATED,
@@ -444,7 +448,6 @@ SCOPED = {
     # deprecated fluid-1.x surface paddle 2.x removed
     "add_position_encoding": SCOPE_DEPRECATED,
     "modified_huber_loss": SCOPE_DEPRECATED,
-    "squared_l2_distance": SCOPE_DEPRECATED,
     "teacher_student_sigmoid_loss": SCOPE_DEPRECATED,
     "similarity_focus": SCOPE_DEPRECATED,
     "sequence_topk_avg_pooling": SCOPE_DEPRECATED,
@@ -453,8 +456,6 @@ SCOPED = {
     "polygon_box_transform": SCOPE_DEPRECATED,
     "prroi_pool": SCOPE_DEPRECATED + " (roi_align covers interp pooling)",
     "deformable_psroi_pooling": SCOPE_DEPRECATED,
-    "gaussian_random_batch_size_like": SCOPE_DEPRECATED,
-    "uniform_random_batch_size_like": SCOPE_DEPRECATED,
     "lod_array_length": SCOPE_DEPRECATED + " (DynamicRNN machinery)",
     "lod_rank_table": SCOPE_DEPRECATED + " (DynamicRNN machinery)",
     "max_sequence_len": SCOPE_DEPRECATED + " (DynamicRNN machinery)",
