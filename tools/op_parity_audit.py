@@ -314,6 +314,7 @@ ALIASES = {
     "depthwise_conv2d": "nn.functional.conv2d(groups=C_in)",
     "depthwise_conv2d_transpose": "nn.functional.conv2d_transpose(groups)",
     "deformable_conv": "vision.ops.deform_conv2d",
+    "deformable_conv_v1": "vision.ops.deform_conv2d (mask=None == v1 without modulation)",
     "grad_add": "jax.vjp cotangent accumulation (autodiff internal)",
     "c_allreduce_max": "distributed.all_reduce(op=MAX)",
     "c_allreduce_min": "distributed.all_reduce(op=MIN)",
@@ -427,7 +428,6 @@ SCOPED = {
     "var_conv_2d": SCOPE_DEPRECATED,
     "row_conv": SCOPE_DEPRECATED,
     "sample_logits": SCOPE_DEPRECATED,
-    "deformable_conv_v1": SCOPE_DEPRECATED,
     "shrink_rnn_memory": SCOPE_DEPRECATED,
     "lod_tensor_to_array": SCOPE_DEPRECATED,
     "array_to_lod_tensor": SCOPE_DEPRECATED,
