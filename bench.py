@@ -17,6 +17,33 @@ import time
 
 import numpy as np
 
+# BENCH_PROFILE=1: run with the host tracer live and embed an
+# observability snapshot (jit-cache hit rate, step p50/p95) in the JSON.
+# Off by default — tracing adds per-op host overhead to the eager paths.
+PROFILE = os.environ.get("BENCH_PROFILE", "") not in ("", "0")
+
+
+def _metrics_snapshot():
+    """Selected profiler metrics for the BENCH JSON."""
+    from paddle_tpu.profiler import metrics as pm
+    snap = pm.snapshot()
+    hits = snap.get("dispatch.jit_cache.hit", 0)
+    misses = snap.get("dispatch.jit_cache.miss", 0)
+    out = {
+        "dispatch_count": snap.get("dispatch.count", 0),
+        "jit_cache": {
+            "hits": hits, "misses": misses,
+            "hit_rate": round(hits / (hits + misses), 4)
+            if (hits + misses) else None,
+        },
+    }
+    steps = snap.get("bench.step_latency_ms")
+    if isinstance(steps, dict) and steps.get("count"):
+        out["step_latency_ms"] = {k: round(steps[k], 3)
+                                  for k in ("p50", "p95", "avg", "max")
+                                  if steps.get(k) is not None}
+    return out
+
 
 def _probe_backend(timeout_s: float = 240.0) -> bool:
     """True if the default (TPU/axon) backend initializes in a fresh
@@ -45,6 +72,10 @@ def main():
             print("bench: accelerator backend unreachable; CPU fallback",
                   file=sys.stderr)
             jax.config.update("jax_platforms", "cpu")
+
+    if PROFILE:
+        from paddle_tpu.profiler import enable_host_tracer
+        enable_host_tracer()
 
     import jax.numpy as jnp
     from paddle_tpu.distributed.topology import build_mesh
@@ -117,6 +148,11 @@ def main():
         result["extra"] = {"resnet50": bench_resnet(on_tpu)}
     except Exception as e:  # the headline metric must still print
         print(f"bench: resnet leg failed: {e!r}", file=sys.stderr)
+    if PROFILE:
+        try:
+            result["metrics"] = _metrics_snapshot()
+        except Exception as e:
+            print(f"bench: metrics snapshot failed: {e!r}", file=sys.stderr)
     print(json.dumps(result))
 
 
@@ -159,7 +195,12 @@ def bench_resnet(on_tpu: bool):
             # loss comes back lazy (hapi _LazyScalar), so consecutive
             # steps pipeline on-device; force full materialization of
             # the final step's params + loss before stopping the clock
+            ts = time.perf_counter() if PROFILE else 0
             logs = model.train_batch([x], [y])
+            if PROFILE:
+                from paddle_tpu.profiler import metrics as pm
+                pm.histogram("bench.step_latency_ms").observe(
+                    (time.perf_counter() - ts) * 1e3)
         float(logs["loss"])
         jax.block_until_ready(p0._data)
         float(jnp.sum(p0._data.astype(jnp.float32)))

@@ -1,7 +1,8 @@
 """paddle.callbacks namespace (reference python/paddle/callbacks.py)."""
 from .hapi.callbacks import (Callback, ProgBarLogger,  # noqa: F401
                              ModelCheckpoint, EarlyStopping, VisualDL,
+                             ProfilerCallback,
                              LRSchedulerCallback as LRScheduler)
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "VisualDL"]
+           "EarlyStopping", "VisualDL", "ProfilerCallback"]

@@ -12,6 +12,7 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -19,6 +20,7 @@ import jax.numpy as jnp
 
 from . import autograd
 from ..utils import flags as _flags_mod
+from ..profiler import tracer as _tracer
 
 __all__ = ["register_kernel", "get_kernel", "dispatch", "KernelKey"]
 
@@ -210,10 +212,15 @@ def _cached_pair(op_name, fn, kwargs, arrays):
     """(fwd_jit, bwd_jit) for a cacheable dispatch, else None."""
     if not _flags_mod.get_flag("FLAGS_eager_jit_cache"):
         return None
+    trace = _tracer.active
     fkey = _closure_key(fn)
     if fkey is None:
+        if trace:
+            _tracer.on_cache_event("uncacheable")
         return None
     if kwargs and not all(_attr_hashable(v) for v in kwargs.values()):
+        if trace:
+            _tracer.on_cache_event("uncacheable")
         return None
     avals = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
     akey = tuple(sorted((k, _freeze(v)) for k, v in kwargs.items()))
@@ -221,8 +228,12 @@ def _cached_pair(op_name, fn, kwargs, arrays):
     try:
         entry = _EAGER_CACHE.get(key)
     except TypeError:        # unhashable payload slipped past the checks
+        if trace:
+            _tracer.on_cache_event("uncacheable")
         return None          # -> uncached per-call path, not a crash
 
+    if trace:
+        _tracer.on_cache_event("hit" if entry is not None else "miss")
     if entry is None:
         closed = functools.partial(fn, **kwargs) if kwargs else fn
         fwd = jax.jit(closed)
@@ -257,6 +268,10 @@ def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
         if prog is not None:
             return _static_program.capture_op(prog, op_name, fn,
                                               tensor_args, kwargs)
+
+    # host-span + metrics instrumentation (profiler v2): one predicate
+    # read when tracing is off, span + counters when on
+    _t0 = time.perf_counter_ns() if _tracer.active else 0
 
     # kernel-registry consultation (reference operator.cc:1296 ChooseKernel
     # / pten kernel_factory.h:255): when the caller passed the registered
@@ -296,6 +311,10 @@ def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
                     # int outputs take float0 cotangents, which cannot
                     # cross a jit boundary — rare; pay the retrace
                     out, vjp_fn = jax.vjp(closed, *arrays)
+            elif _t0:
+                _tt = time.perf_counter_ns()
+                out, vjp_fn = jax.vjp(closed, *arrays)
+                _tracer.on_trace_time(time.perf_counter_ns() - _tt)
             else:
                 out, vjp_fn = jax.vjp(closed, *arrays)
             node = autograd.record(op_name, closed, tensor_args, arrays,
@@ -325,6 +344,8 @@ def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
             t._grad_node = node
             t._output_index = i
         wrapped.append(t)
+    if _t0:
+        _tracer.on_dispatch(op_name, _t0)
     return tuple(wrapped) if tuple_output else wrapped[0]
 
 

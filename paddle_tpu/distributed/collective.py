@@ -18,6 +18,7 @@ stack per-rank state on one host (test_dist_base.py:778).
 """
 from __future__ import annotations
 
+import functools
 import threading
 from dataclasses import dataclass, field
 from functools import partial
@@ -29,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ..profiler import tracer as _obs
 
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
@@ -172,15 +175,70 @@ def _eager_collective(fn, group: Group, x, out_specs=None, extra=()):
     mesh = group.mesh()
     in_specs = (P(ax),) + tuple(P() for _ in extra)
     out_specs = P(ax) if out_specs is None else out_specs
-    shmapped = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
+    try:
+        shmapped = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+    except (AttributeError, TypeError):
+        # older jax: shard_map still experimental / check_rep spelling
+        from jax.experimental.shard_map import shard_map as _sm
+        shmapped = _sm(fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
     return shmapped(x, *extra)
+
+
+# ---------------------------------------------------------------------------
+# observability: per-collective op count + payload bytes + host span
+# (reference platform profiler's comm-op event rows).  Zero overhead
+# when tracing is off: one predicate read per call.
+# ---------------------------------------------------------------------------
+
+def _payload_nbytes(x) -> int:
+    x = getattr(x, "_data", x)
+    if isinstance(x, (list, tuple)):
+        return sum(_payload_nbytes(e) for e in x)
+    try:
+        return int(x.size) * x.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def _instrumented(fn):
+    name = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _obs.active:
+            return fn(*args, **kwargs)
+        # payload = largest tensor-ish argument: handles both call
+        # shapes of all_gather/scatter (payload may be the 2nd arg or a
+        # tensor list) and group passed positionally or by keyword.
+        # Measured BEFORE the call so output lists fn mutates in place
+        # (paddle-signature all_gather(out_list, tensor)) don't count.
+        g = kwargs.get("group")
+        nbytes = 0
+        for v in list(args) + [v for k, v in kwargs.items()
+                               if k != "group"]:
+            if isinstance(v, Group):
+                if g is None:
+                    g = v
+                continue
+            n = _payload_nbytes(v)
+            if n > nbytes:
+                nbytes = n
+        t0 = _obs.now_ns()
+        out = fn(*args, **kwargs)
+        _obs.on_collective(name, t0, nbytes,
+                           world=g.nranks if g is not None else 0)
+        return out
+
+    return wrapper
 
 
 # ---------------------------------------------------------------------------
 # collectives
 # ---------------------------------------------------------------------------
 
+@_instrumented
 def all_reduce(tensor, op: int = ReduceOp.SUM, group: Optional[Group] = None,
                sync_op: bool = True, use_calc_stream: bool = True):
     """reference collective.py all_reduce / c_allreduce_op.h:341.
@@ -206,6 +264,7 @@ def all_reduce(tensor, op: int = ReduceOp.SUM, group: Optional[Group] = None,
     return _wrap_like(tensor, out)
 
 
+@_instrumented
 def all_gather(tensor_or_list, tensor=None, group: Optional[Group] = None,
                sync_op: bool = True):
     """reference collective.py all_gather(tensor_list, tensor).
@@ -239,6 +298,7 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[Group] = None,
     return _wrap_like(src, gathered)
 
 
+@_instrumented
 def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
               sync_op: bool = True):
     """reference collective.py broadcast / c_broadcast_op."""
@@ -260,6 +320,7 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
     return _wrap_like(tensor, out)
 
 
+@_instrumented
 def reduce(tensor, dst: int = 0, op: int = ReduceOp.SUM,
            group: Optional[Group] = None, sync_op: bool = True):
     """reference c_reduce_op: reduce to dst rank; other ranks keep input."""
@@ -286,6 +347,7 @@ def reduce(tensor, dst: int = 0, op: int = ReduceOp.SUM,
     return _wrap_like(tensor, out)
 
 
+@_instrumented
 def scatter(tensor, tensor_list=None, src: int = 0,
             group: Optional[Group] = None, sync_op: bool = True):
     """reference collective.py scatter: src rank's list → one per rank."""
@@ -302,6 +364,7 @@ def scatter(tensor, tensor_list=None, src: int = 0,
     return _wrap_like(tensor, stacked)
 
 
+@_instrumented
 def alltoall(in_tensor_list, out_tensor_list=None,
              group: Optional[Group] = None, sync_op: bool = True):
     """reference collective.py alltoall / alltoall op.
@@ -346,6 +409,7 @@ def alltoall(in_tensor_list, out_tensor_list=None,
 all_to_all = alltoall
 
 
+@_instrumented
 def reduce_scatter(tensor, tensor_list=None, op: int = ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op: bool = True):
     """reference c_reducescatter_op: reduce then scatter chunks."""
@@ -379,6 +443,7 @@ def reduce_scatter(tensor, tensor_list=None, op: int = ReduceOp.SUM,
     return _wrap_like(tensor, out)
 
 
+@_instrumented
 def send(tensor, dst: int = 0, group: Optional[Group] = None,
          sync_op: bool = True):
     """reference send_v2 (collective/send_v2_op.cu.cc).
@@ -400,6 +465,7 @@ def send(tensor, dst: int = 0, group: Optional[Group] = None,
     return tensor
 
 
+@_instrumented
 def recv(tensor, src: int = 0, group: Optional[Group] = None,
          sync_op: bool = True):
     """reference recv_v2. Eager pair of send(); see send() for in-trace."""
@@ -422,6 +488,7 @@ def recv(tensor, src: int = 0, group: Optional[Group] = None,
 _P2P_BOX = {}
 
 
+@_instrumented
 def barrier(group: Optional[Group] = None):
     """reference barrier op — on TPU a device sync is enough in-process."""
     g = _resolve(group)

@@ -8,7 +8,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRSchedulerCallback", "VisualDL", "config_callbacks"]
+           "LRSchedulerCallback", "VisualDL", "ProfilerCallback",
+           "config_callbacks"]
 
 
 class CallbackList:
@@ -105,10 +106,14 @@ class ProgBarLogger(Callback):
         self.epoch = epoch
         self.step = 0
         self._epoch_t0 = time.time()
+        self._ips_t0 = self._epoch_t0
+        self._ips_samples = 0
 
     def _fmt(self, logs):
         parts = []
         for k, v in (logs or {}).items():
+            if k == "batch_size":        # loop metadata, not a metric
+                continue
             if isinstance(v, numbers.Number):
                 parts.append(f"{k}: {v:.4f}")
             elif isinstance(v, (list, tuple, np.ndarray)):
@@ -118,10 +123,21 @@ class ProgBarLogger(Callback):
 
     def on_train_batch_end(self, step, logs=None):
         self.step = step
+        self._ips_samples += ((logs or {}).get("batch_size")
+                              or self.params.get("batch_size") or 0)
         if self.verbose >= 2 and step % self.log_freq == 0:
             total = self.params.get("steps")
-            print(f"Epoch {self.epoch + 1}/{self.epochs} "
-                  f"step {step}/{total} - {self._fmt(logs)}", flush=True)
+            msg = (f"Epoch {self.epoch + 1}/{self.epochs} "
+                   f"step {step}/{total} - {self._fmt(logs)}")
+            # ips over the window since the last log line (reference
+            # hapi ProgBarLogger reports "ips: N samples/sec")
+            now = time.time()
+            dt = now - self._ips_t0
+            if self._ips_samples and dt > 0:
+                msg += f" - ips: {self._ips_samples / dt:.2f} samples/s"
+            self._ips_t0 = now
+            self._ips_samples = 0
+            print(msg, flush=True)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose >= 1:
@@ -235,13 +251,43 @@ class VisualDL(Callback):
         if self._f and logs:
             rec = {"step": step}
             for k, v in logs.items():
-                if isinstance(v, numbers.Number):
+                if k != "batch_size" and isinstance(v, numbers.Number):
                     rec[k] = float(v)
             self._f.write(json.dumps(rec) + "\n")
 
     def on_train_end(self, logs=None):
         if self._f:
             self._f.close()
+
+
+class ProfilerCallback(Callback):
+    """Drives a ``paddle.profiler.Profiler`` across ``Model.fit``.
+
+    Pass a ready Profiler, or scheduler/on_trace_ready/etc. kwargs to
+    build one.  ``start()`` fires at train begin, ``step(num_samples)``
+    after every batch (so step latency and ips are measured around the
+    real train step), ``stop()`` + optional ``summary()`` at train end.
+    """
+
+    def __init__(self, profiler=None, summary=True, **profiler_kwargs):
+        super().__init__()
+        if profiler is None:
+            from .. import profiler as _prof_mod
+            profiler = _prof_mod.Profiler(**profiler_kwargs)
+        self.profiler = profiler
+        self.print_summary = summary
+
+    def on_train_begin(self, logs=None):
+        self.profiler.start()
+
+    def on_train_batch_end(self, step, logs=None):
+        self.profiler.step(num_samples=((logs or {}).get("batch_size")
+                                        or self.params.get("batch_size")))
+
+    def on_train_end(self, logs=None):
+        self.profiler.stop()
+        if self.print_summary:
+            self.profiler.summary()
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

@@ -24,6 +24,7 @@ from ..core import autograd
 from ..core.random import default_generator, rng_scope
 from ..core.tensor import Tensor, to_tensor
 from ..metric import Metric
+from ..profiler import tracer as _obs
 from .callbacks import config_callbacks
 
 __all__ = ["Model"]
@@ -134,6 +135,14 @@ def _to_list(x):
     if x is None:
         return []
     return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _batch_len(ins) -> int:
+    """Samples in one batch (leading dim of the first input), 0 if moot."""
+    try:
+        return int(ins[0].shape[0])
+    except Exception:
+        return 0
 
 
 class Model:
@@ -448,6 +457,9 @@ class Model:
             for step, batch in enumerate(train_loader):
                 cbks.on_train_batch_begin(step)
                 ins, lbls = self._split_batch(batch)
+                # profiler v2 hot-path hook: with the host tracer off
+                # this whole block is one predicate read per step
+                _t0 = _obs.now_ns() if _obs.active else 0
                 if accumulate_grad_batches > 1:
                     # grad accumulation rides the eager tape: backward
                     # accumulates into .grad, step fires on the boundary
@@ -456,6 +468,12 @@ class Model:
                     logs = self._train_batch_eager(ins, lbls, update=update)
                 else:
                     logs = self.train_batch(ins, lbls)
+                if _t0:
+                    _obs.on_hapi_step(_t0, num_samples=_batch_len(ins),
+                                      mode="train")
+                # reference hapi: callbacks see the ACTUAL batch size so
+                # ips stays honest on the final partial batch
+                logs["batch_size"] = _batch_len(ins)
                 cbks.on_train_batch_end(step, logs)
                 step_count += 1
                 if num_iters is not None and step_count >= num_iters:
@@ -499,9 +517,14 @@ class Model:
         for step, batch in enumerate(loader):
             cbks.on_eval_batch_begin(step)
             ins, lbls = self._split_batch(batch)
+            _t0 = _obs.now_ns() if _obs.active else 0
             logs = self.eval_batch(ins, lbls)
+            if _t0:
+                _obs.on_hapi_step(_t0, num_samples=_batch_len(ins),
+                                  mode="eval")
             if "loss" in logs:
                 losses.append(logs["loss"])
+            logs["batch_size"] = _batch_len(ins)
             cbks.on_eval_batch_end(step, logs)
         final = {}
         if losses:

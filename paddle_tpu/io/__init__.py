@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
+from ..profiler import tracer as _tracer
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
@@ -319,6 +320,24 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def __iter__(self):
+        # observability wrapper: when the host tracer is live, each
+        # batch handoff records a consumer-wait span + wait-time
+        # histogram (queue starvation is the classic input-bound
+        # signature); off, the cost is one predicate read per batch
+        it = self._iter_batches()
+        while True:
+            trace = _tracer.active
+            t0 = _tracer.now_ns() if trace else 0
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            if trace:
+                _tracer.on_data_wait(t0, depth=self._prefetch_depth)
+            yield batch
+
+    def _iter_batches(self):
+        self._prefetch_depth = None
         if self._iterable_mode:
             yield from self._iter_iterable()
             return
@@ -609,6 +628,8 @@ class DataLoader:
             if i not in pending:
                 raise RuntimeError("DataLoader workers exited early")
             data, err = pending.pop(i)
+            if _tracer.active:
+                self._prefetch_depth = len(pending)
             if err is not None:
                 raise RuntimeError(
                     f"DataLoader worker failed on batch {i}") from err
@@ -660,6 +681,8 @@ class DataLoader:
                 while i not in results:
                     cond.wait(timeout=120)
                 data = results.pop(i)
+                if _tracer.active:
+                    self._prefetch_depth = len(results)
                 cond.notify_all()
             if isinstance(data, _WorkerError):
                 raise RuntimeError(

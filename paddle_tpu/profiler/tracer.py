@@ -1,0 +1,213 @@
+"""Pure-Python host-span tracer with chrome://tracing export.
+
+Reference parity: ``platform/profiler.h:216`` (RecordEvent host events,
+bounded event buffer, chrome-trace report).  This is the always-available
+collector — no native ``.so``, no jax import — so every layer of the
+framework can be instrumented unconditionally and the whole thing still
+works in a bare interpreter.  Device-side traces remain jax.profiler's
+job (TensorBoard/Perfetto); the file this module exports can be loaded
+into the same Perfetto UI alongside them.
+
+Hot-path contract: ``active`` is a module-level bool.  Instrumented code
+does ONE predicate read when tracing is off::
+
+    if tracer.active:
+        t0 = tracer.now_ns()
+    ...
+    if tracer.active:
+        tracer.on_dispatch(op, t0)
+
+Spans live in a bounded ring buffer (``FLAGS_host_tracer_capacity``);
+beyond capacity the oldest spans drop, so an unbounded training run
+cannot OOM the host through its own profiler.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import flags as _flags
+from . import metrics as _metrics
+
+__all__ = ["active", "enable", "disable", "is_enabled", "clear", "events",
+           "drain", "record", "now_ns", "chrome_trace_dict",
+           "export_chrome_tracing", "summarize"]
+
+# module-level fast predicate — the single check hot paths gate on
+active = False
+
+_lock = threading.Lock()
+_events: collections.deque = collections.deque(maxlen=1 << 20)
+
+# event tuple layout: (name, start_ns, end_ns, tid, cat, args)
+_Event = Tuple[str, int, int, int, str, Optional[dict]]
+
+now_ns = time.perf_counter_ns
+
+
+def enable(capacity: Optional[int] = None):
+    """Start collecting host spans (ring capacity from the flag unless
+    given).  Re-enabling with a new capacity preserves buffered spans."""
+    global active, _events
+    cap = int(capacity or _flags.get_flag("FLAGS_host_tracer_capacity"))
+    with _lock:
+        if _events.maxlen != cap:
+            _events = collections.deque(_events, maxlen=cap)
+        active = True
+
+
+def disable():
+    global active
+    active = False
+
+
+def is_enabled() -> bool:
+    return active
+
+
+def clear():
+    _events.clear()
+
+
+def events() -> List[_Event]:
+    return list(_events)
+
+
+def drain() -> List[_Event]:
+    """Snapshot and empty the buffer (one profiler record window)."""
+    with _lock:
+        evs = list(_events)
+        _events.clear()
+    return evs
+
+
+def record(name: str, start_ns: int, end_ns: int, tid: Optional[int] = None,
+           cat: str = "host", args: Optional[dict] = None):
+    """Append one completed span.  Timestamps are ``now_ns()`` values."""
+    _events.append((name, start_ns, end_ns,
+                    tid if tid is not None
+                    else threading.get_ident() % (1 << 31), cat, args))
+
+
+# ---------------------------------------------------------------------------
+# instrumentation hooks — called by framework hot paths AFTER checking
+# ``active``, so each one may allocate freely
+# ---------------------------------------------------------------------------
+
+def on_dispatch(op_name: str, start_ns: int):
+    """One eager op went through core.dispatch."""
+    end_ns = time.perf_counter_ns()
+    record("op::" + op_name, start_ns, end_ns, cat="dispatch")
+    _metrics.counter("dispatch.count").inc()
+    _metrics.counter("dispatch.op." + op_name).inc()
+    _metrics.counter("dispatch.time_ns").inc(end_ns - start_ns)
+
+
+def on_cache_event(kind: str):
+    """Eager jit/vjp cache outcome: 'hit' | 'miss' | 'uncacheable'."""
+    _metrics.counter("dispatch.jit_cache." + kind).inc()
+
+
+def on_trace_time(ns: int):
+    """Time spent re-tracing (jax.vjp / jit build) — what the cache saves."""
+    _metrics.counter("dispatch.trace_time_ns").inc(ns)
+
+
+def on_collective(name: str, start_ns: int, nbytes: int, world: int = 0):
+    end_ns = time.perf_counter_ns()
+    args: Dict[str, Any] = {"bytes": nbytes}
+    if world:
+        args["world"] = world
+    record("cc::" + name, start_ns, end_ns, cat="collective", args=args)
+    _metrics.counter(f"collective.{name}.count").inc()
+    _metrics.counter(f"collective.{name}.bytes").inc(nbytes)
+
+
+def on_data_wait(start_ns: int, depth: Optional[int] = None):
+    """Consumer-side wait for the next DataLoader batch."""
+    end_ns = time.perf_counter_ns()
+    record("io::batch_wait", start_ns, end_ns, cat="dataloader")
+    _metrics.counter("dataloader.batches").inc()
+    _metrics.histogram("dataloader.batch_wait_ms").observe(
+        (end_ns - start_ns) / 1e6)
+    if depth is not None:
+        _metrics.gauge("dataloader.queue_depth").set(depth)
+
+
+def on_queue_depth(name: str, depth: int):
+    _metrics.gauge(name + ".queue_depth").set(depth)
+
+
+def on_hapi_step(start_ns: int, num_samples: int = 0, mode: str = "train"):
+    """One hapi Model loop step (latency is host wall time; with the
+    lazy-loss pipeline this is enqueue latency, not device step time)."""
+    end_ns = time.perf_counter_ns()
+    record(f"hapi::{mode}_step", start_ns, end_ns, cat="hapi")
+    dt_ns = end_ns - start_ns
+    _metrics.histogram(f"hapi.{mode}_step_latency_ms").observe(dt_ns / 1e6)
+    if num_samples:
+        _metrics.counter(f"hapi.{mode}_samples").inc(num_samples)
+        if dt_ns > 0:
+            _metrics.gauge(f"hapi.{mode}_ips").set(
+                num_samples / (dt_ns / 1e9))
+
+
+# ---------------------------------------------------------------------------
+# export / aggregation
+# ---------------------------------------------------------------------------
+
+def chrome_trace_dict(evs: Optional[List[_Event]] = None) -> dict:
+    """chrome://tracing document ('X' complete events; ts/dur in us).
+    Overlapping spans on one tid render nested in Perfetto/chrome."""
+    if evs is None:
+        evs = events()
+    pid = os.getpid()
+    tevs = []
+    for name, t0, t1, tid, cat, args in evs:
+        e = {"name": name, "cat": cat or "host", "ph": "X",
+             "ts": t0 / 1e3, "dur": (t1 - t0) / 1e3,
+             "pid": pid, "tid": tid}
+        if args:
+            e["args"] = dict(args)
+        tevs.append(e)
+    return {"traceEvents": tevs, "displayTimeUnit": "ms"}
+
+
+def export_chrome_tracing(path: str,
+                          evs: Optional[List[_Event]] = None) -> str:
+    """Write the buffered (or given) spans as a chrome-trace JSON file.
+    Prefer :func:`paddle_tpu.profiler.export_chrome_tracing`, which also
+    merges spans from the native collector when that is in use."""
+    doc = chrome_trace_dict(evs)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def summarize(evs: Optional[List[_Event]] = None) -> Dict[str, dict]:
+    """Aggregate spans by name: calls, total/avg/max/min ns."""
+    if evs is None:
+        evs = events()
+    out: Dict[str, dict] = {}
+    for name, t0, t1, _tid, _cat, _args in evs:
+        dur = t1 - t0
+        s = out.get(name)
+        if s is None:
+            out[name] = {"calls": 1, "total_ns": dur,
+                         "max_ns": dur, "min_ns": dur}
+        else:
+            s["calls"] += 1
+            s["total_ns"] += dur
+            if dur > s["max_ns"]:
+                s["max_ns"] = dur
+            if dur < s["min_ns"]:
+                s["min_ns"] = dur
+    for s in out.values():
+        s["avg_ns"] = s["total_ns"] / s["calls"]
+    return out

@@ -93,6 +93,9 @@ define_flag("FLAGS_matmul_precision", "default",
 define_flag("FLAGS_log_recompile", False,
             "announce Executor program recompiles on new feed "
             "signatures (each new shape compiles a new XLA program)")
+define_flag("FLAGS_host_tracer_capacity", 1 << 20,
+            "max host spans held by the profiler ring buffer; oldest "
+            "spans drop beyond this (reference host_trace_level buffer)")
 
 # flags may arrive via env at import time — seed the dispatch fast path
 _refresh_debug_cache()

@@ -6,4 +6,8 @@
 # distributed tests actually gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# Observability gate first: the profiler/metrics layer is what every perf
+# number reports through, so a broken tracer fails the sweep in seconds
+# instead of after the slow tier (the full run below includes it again).
+python -m pytest tests/test_profiler.py -q
 exec python -m pytest tests/ -q --runslow "$@"

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""trace_summary — chrome-trace JSON -> top-N ops table.
+
+Reads a trace written by ``paddle_tpu.profiler.export_chrome_tracing``
+(or any chrome://tracing file with 'X' complete events) and prints the
+per-name aggregate the in-process ``Profiler.summary()`` would show:
+call count, total/avg/max duration and share of the traced wall time.
+
+    python tools/trace_summary.py trace.json
+    python tools/trace_summary.py trace.json -n 20 --sort avg --cat dispatch
+
+Pure stdlib so it runs anywhere the trace file lands (CI artifact
+viewers, dev laptops without the framework installed).
+"""
+import argparse
+import json
+import sys
+
+
+def aggregate(events, cat=None):
+    """{name: {calls, total_us, avg_us, max_us}} over 'X' events."""
+    stats = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if cat and e.get("cat") != cat:
+            continue
+        dur = float(e.get("dur", 0.0))
+        s = stats.setdefault(e.get("name", "?"),
+                             {"calls": 0, "total_us": 0.0, "max_us": 0.0})
+        s["calls"] += 1
+        s["total_us"] += dur
+        if dur > s["max_us"]:
+            s["max_us"] = dur
+    for s in stats.values():
+        s["avg_us"] = s["total_us"] / s["calls"]
+    return stats
+
+
+def format_table(stats, sort="total", top=None):
+    key = {"total": "total_us", "avg": "avg_us", "max": "max_us",
+           "calls": "calls"}[sort]
+    rows = sorted(stats.items(), key=lambda kv: kv[1][key], reverse=True)
+    if top:
+        rows = rows[:top]
+    grand = sum(s["total_us"] for s in stats.values()) or 1.0
+    name_w = max([len(n) for n, _ in rows] + [10])
+    head = (f"{'name':<{name_w}} {'calls':>7} {'total_ms':>10} "
+            f"{'avg_ms':>9} {'max_ms':>9} {'ratio':>6}")
+    lines = [head, "-" * len(head)]
+    for name, s in rows:
+        lines.append(
+            f"{name:<{name_w}} {s['calls']:>7} {s['total_us'] / 1e3:>10.3f} "
+            f"{s['avg_us'] / 1e3:>9.3f} {s['max_us'] / 1e3:>9.3f} "
+            f"{100.0 * s['total_us'] / grand:>5.1f}%")
+    if not rows:
+        lines.append("(no complete events in trace)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="chrome-trace JSON file")
+    ap.add_argument("-n", "--top", type=int, default=30,
+                    help="show only the top N rows (default 30)")
+    ap.add_argument("--sort", choices=("total", "avg", "max", "calls"),
+                    default="total")
+    ap.add_argument("--cat", default=None,
+                    help="restrict to one category (dispatch, collective, "
+                         "dataloader, hapi, ...)")
+    args = ap.parse_args(argv)
+    with open(args.trace) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    print(format_table(aggregate(events, cat=args.cat),
+                       sort=args.sort, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
