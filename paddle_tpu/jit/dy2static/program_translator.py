@@ -45,6 +45,49 @@ class ProgramTranslator:
     def enable_to_static(self):
         return type(self)._enabled
 
+    # -- prog-san integration (static/passes) ------------------------------
+    def get_program(self, fn, input_spec):
+        """Capture the AST-converted ``fn`` into a fresh static Program
+        (one feed slot per spec; reference
+        ``ProgramTranslator.get_program``).  Returns
+        ``(program, feed_vars, fetch_vars)``."""
+        from ...static import mode as _mode
+        from ...static import program as _prog_mod
+
+        converted = convert_to_static(fn)
+        prog = _prog_mod.Program()
+        was_dynamic = _mode.in_dynamic_mode()
+        _mode.enable_static()
+        try:
+            with _prog_mod.program_guard(prog):
+                feeds = []
+                for i, spec in enumerate(input_spec):
+                    name = getattr(spec, "name", None) or f"input_{i}"
+                    shape = list(spec.shape)
+                    dtype = getattr(spec, "dtype", "float32")
+                    feeds.append(_prog_mod.data(name, shape, dtype))
+                out = converted(*feeds)
+        finally:
+            if was_dynamic:
+                _mode.disable_static()
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        fetch = [o for o in outs if isinstance(o, _prog_mod.Variable)]
+        return prog, feeds, fetch
+
+    def check_program(self, fn, input_spec, feed_shapes=None,
+                      raise_on_error=True):
+        """Validate the Program dy2static generates for ``fn`` with the
+        static-analysis pass bundle (verifier, shape inference against
+        ``feed_shapes``, liveness, SPMD lint) *before* any Executor
+        compile.  Raises ``ProgramVerificationError`` on defects when
+        ``raise_on_error``; always returns the ``AnalysisReport``."""
+        prog, _, fetch = self.get_program(fn, input_spec)
+        report = prog.analysis_report(feed_shapes=feed_shapes,
+                                      fetch_list=fetch)
+        if raise_on_error:
+            report.raise_on_error()
+        return report
+
 
 def _closure_cells(fn) -> dict:
     if fn.__closure__ is None:

@@ -12,9 +12,9 @@ from __future__ import annotations
 
 from ..core.errors import UnimplementedError
 from .export import export as _onnx_export
-from .export import supported_ops  # noqa: F401
+from .export import export_program, supported_ops  # noqa: F401
 
-__all__ = ["export"]
+__all__ = ["export", "export_program"]
 
 
 def export(layer, path, input_spec=None, opset_version=13,

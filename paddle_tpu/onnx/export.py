@@ -22,7 +22,7 @@ from ..core.errors import UnimplementedError
 from ..core.tensor import Tensor
 from . import proto as P
 
-__all__ = ["export", "supported_ops"]
+__all__ = ["export", "export_program", "supported_ops"]
 
 
 class _Ctx:
@@ -102,6 +102,8 @@ def _convert_eqn(ctx: _Ctx, eqn):
         mid = ctx.fresh("sqrt")
         ctx.add("Sqrt", ins, [mid])
         ctx.add("Reciprocal", [mid], outs)
+    elif prim == "square":
+        ctx.add("Mul", [ins[0], ins[0]], outs)
     elif prim == "integer_pow":
         y = eqn.params["y"]
         if y == 2:
@@ -320,6 +322,76 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
     return out_path
 
 
+def export_program(program, path: str, fetch_list, feed_shapes=None,
+                   opset_version: int = 13):
+    """Export a captured static Program's inference surface to ONNX.
+
+    Static-analysis integration (static/passes): the program is first
+    run through the verifier + shape-inference passes with the real
+    ``feed_shapes``, so a malformed program fails here with a diagnostic
+    naming the op and var, and the exported graph's input/output
+    value_info carries the *inferred* shapes — dynamic (``-1``) dims
+    resolve to the fed batch size instead of the capture-time ``-1 -> 1``
+    concretization.  Grad/optimizer ops are dropped via
+    ``clone(for_test=True)`` (eval-mode impls where registered).
+    """
+    from ..static.passes import analyze
+
+    infer_prog = program.clone(for_test=True)
+    fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
+    report = analyze(infer_prog, feed_shapes=feed_shapes,
+                     fetch_names=fetch_names,
+                     passes=("verify", "shape_inference"))
+    report.raise_on_error()
+    inferred = report.inferred
+
+    consts = dict(infer_prog.constants)
+    consts.update({n: p._data for n, p in infer_prog.parameters.items()})
+    consts.update(infer_prog.state_vars)
+    # replay only the fetch cone: exporting `pred` from a training
+    # program must not drag the loss/metric ops (and their possibly
+    # ONNX-unmappable primitives) into the graph
+    needed = set(fetch_names)
+    cone = []
+    for op in reversed([o for o in infer_prog.ops if o.kind == "compute"]):
+        if any(n in needed for n in op.output_names):
+            cone.append(op)
+            needed.update(op.input_names)
+    ops = cone[::-1]
+    feed_names = [n for n in infer_prog._placeholders if n in needed]
+
+    def replay(*feed_arrays):
+        env = dict(consts)
+        env.update(zip(feed_names, feed_arrays))
+        for op in ops:
+            outs = op.impl(*[env[n] for n in op.input_names])
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for n, o in zip(op.output_names, outs):
+                env[n] = o
+        return tuple(env[n] for n in fetch_names)
+
+    in_avals = [jax.ShapeDtypeStruct(tuple(inferred[n].shape),
+                                     inferred[n].dtype)
+                for n in feed_names]
+    closed = jax.make_jaxpr(replay)(*in_avals)
+    ctx = _Ctx()
+    _convert_jaxpr(ctx, closed.jaxpr, feed_names, fetch_names,
+                   [np.asarray(c) for c in closed.consts])
+
+    inputs = [P.value_info(n, str(np.dtype(a.dtype)), a.shape)
+              for n, a in zip(feed_names, in_avals)]
+    outputs = [P.value_info(n, str(np.dtype(var.aval.dtype)),
+                            var.aval.shape)
+               for n, var in zip(fetch_names, closed.jaxpr.outvars)]
+    graph = P.graph_proto("paddle_tpu_program", ctx.nodes,
+                          ctx.initializers, inputs, outputs)
+    model = P.model_proto(graph, opset=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model)
+    return out_path
+
+
 def supported_ops():
     """The jaxpr-primitive -> ONNX coverage matrix (VERDICT asked for
     the supported surface to be documented/queryable).  Anything outside
@@ -328,7 +400,8 @@ def supported_ops():
     return sorted({
         "add", "sub", "mul", "div", "max", "min", "pow", "exp", "log",
         "tanh", "logistic", "sqrt", "neg", "abs", "erf", "erfc", "rsqrt",
-        "floor", "ceil", "sign", "sin", "cos", "integer_pow", "select_n",
+        "floor", "ceil", "sign", "sin", "cos", "integer_pow", "square",
+        "select_n",
         "dot_general (matmul / leading-batch batched-matmul layouts)",
         "conv_general_dilated", "reshape", "squeeze", "transpose",
         "broadcast_in_dim", "convert_element_type", "reduce_sum",

@@ -26,3 +26,6 @@ from .program import CompiledProgram as ParallelExecutor  # noqa: F401
 from .control_flow import cond, while_loop, switch_case, case  # noqa: F401
 from .serialization import (save_program, load_program,  # noqa: F401
                             LoadedProgram)
+from . import passes  # noqa: F401  (ir pass framework: prog-san)
+from .passes import (ProgramVerificationError,  # noqa: F401
+                     PassRegistry, register_pass, run_passes)

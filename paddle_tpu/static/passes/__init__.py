@@ -1,0 +1,113 @@
+"""paddle_tpu.static.passes — Program IR pass framework ("prog-san").
+
+Reference parity: ``framework/ir/pass.h:51`` + ``REGISTER_PASS``
+(``ir/pass.h:315``) re-targeted at the captured op-level Program.
+
+Built-in passes (all registered in ``PassRegistry``):
+
+- ``verify``               def-before-use, dangling inputs, WAW, @GRAD
+- ``shape_inference``      re-propagate avals with real feed shapes
+- ``liveness_report``      report ops that feed neither fetch nor state
+- ``dead_op_eliminate``    strip those ops (transform pass)
+- ``spmd_collective_lint`` Megatron placement / collective ordering
+
+Entry points: ``run_passes(program, names, ctx)`` for composition,
+``analyze(program, ...)`` for the all-analysis bundle Executor-side
+validation and ``Program.analysis_report()`` build on.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from .pass_base import (Diagnostic, Pass, PassContext, PassRegistry,
+                        PassResult, ProgramVerificationError, register_pass,
+                        get_pass, run_passes, ERROR, WARNING, INFO)
+from .graph import DefUseGraph
+from .verifier import VerifyPass
+from .shape_inference import ShapeInferencePass
+from .liveness import (LivenessReportPass, DeadOpEliminationPass,
+                       find_dead_ops)
+from .spmd_lint import (SpmdCollectiveLintPass, lint_hlo_collectives,
+                        lint_spmd_train_step, HloCollective)
+
+__all__ = ["Diagnostic", "Pass", "PassContext", "PassRegistry",
+           "PassResult", "ProgramVerificationError", "register_pass",
+           "get_pass", "run_passes", "DefUseGraph", "VerifyPass",
+           "ShapeInferencePass", "LivenessReportPass",
+           "DeadOpEliminationPass", "SpmdCollectiveLintPass",
+           "find_dead_ops", "lint_hlo_collectives",
+           "lint_spmd_train_step", "HloCollective", "analyze",
+           "AnalysisReport", "ERROR", "WARNING", "INFO"]
+
+_ANALYSIS_PASSES = ("verify", "shape_inference", "liveness_report",
+                    "spmd_collective_lint")
+
+
+class AnalysisReport:
+    """Bundle of PassResults with a human-readable rendering."""
+
+    def __init__(self, program, results: Sequence[PassResult]):
+        self.program = program
+        self.results = list(results)
+
+    @property
+    def diagnostics(self):
+        return [d for r in self.results for d in r.diagnostics]
+
+    @property
+    def errors(self):
+        return [d for r in self.results for d in r.errors]
+
+    @property
+    def warnings(self):
+        return [d for r in self.results for d in r.warnings]
+
+    @property
+    def inferred(self) -> Dict:
+        for r in self.results:
+            if r.inferred:
+                return r.inferred
+        return {}
+
+    @property
+    def dead_ops(self):
+        for r in self.results:
+            if r.pass_name in ("liveness_report", "dead_op_eliminate"):
+                return r.dead_ops
+        return []
+
+    def ok(self) -> bool:
+        return not self.errors
+
+    def raise_on_error(self):
+        if self.errors:
+            raise ProgramVerificationError(self.results)
+
+    def __str__(self):
+        lines = [f"== analysis report: {self.program!r} =="]
+        for r in self.results:
+            lines.append(f"-- {r.pass_name}: {len(r.errors)} error(s), "
+                         f"{len(r.warnings)} warning(s)")
+            for d in r.diagnostics:
+                lines.append(f"   {d!r}")
+        status = "FAIL" if self.errors else "OK"
+        lines.append(f"== {status}: {len(self.errors)} error(s), "
+                     f"{len(self.warnings)} warning(s), "
+                     f"{len(self.dead_ops)} dead op(s) ==")
+        return "\n".join(lines)
+
+    __repr__ = __str__
+
+
+def analyze(program, feed_shapes: Optional[Dict] = None,
+            feed_dtypes: Optional[Dict] = None,
+            fetch_names: Optional[Sequence[str]] = None,
+            mesh_axes: Optional[Sequence[str]] = None,
+            passes: Sequence[str] = _ANALYSIS_PASSES,
+            require_full_feed: bool = False) -> AnalysisReport:
+    """Run the analysis bundle and return the combined report."""
+    ctx = PassContext(feed_shapes=feed_shapes, feed_dtypes=feed_dtypes,
+                      fetch_names=fetch_names, mesh_axes=mesh_axes,
+                      require_full_feed=require_full_feed)
+    _, results = run_passes(program, passes, ctx)
+    return AnalysisReport(program, results)

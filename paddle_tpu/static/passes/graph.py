@@ -1,0 +1,69 @@
+"""Def/use graph view over a captured Program.
+
+Reference parity: ``framework/ir/graph.h:83`` builds a node graph from a
+ProgramDesc; here the Program's op list is already in topological
+(program) order, so the graph is an index: for every var name, which ops
+define it and which consume it, plus the set of names that exist as
+inputs without a producing op (feeds, parameters, constants, state).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from ..program import _LR_NAME
+
+__all__ = ["DefUseGraph"]
+
+
+class DefUseGraph:
+    """Immutable index over ``program.ops``; build once per analysis."""
+
+    def __init__(self, program):
+        self.program = program
+        self.defs: Dict[str, List[int]] = {}
+        self.uses: Dict[str, List[int]] = {}
+        for op in program.ops:
+            for n in op.input_names:
+                self.uses.setdefault(n, []).append(op.idx)
+            for n in op.output_names:
+                self.defs.setdefault(n, []).append(op.idx)
+
+    # -- sources: names readable without any producing op ----------------
+    def source_names(self) -> Set[str]:
+        p = self.program
+        src = set(p._placeholders)
+        src.update(p.parameters)
+        src.update(p.constants)
+        src.update(p.state_vars)
+        src.add(_LR_NAME)
+        return src
+
+    def known_names(self) -> Set[str]:
+        """Every name the program has registered anywhere — an input not
+        in this set was never declared at all (a *dangling* input)."""
+        known = self.source_names()
+        known.update(self.program._vars)
+        for op in self.program.ops:
+            known.update(op.output_names)
+        return known
+
+    def producers(self, name: str) -> List[int]:
+        return self.defs.get(name, [])
+
+    def consumers(self, name: str) -> List[int]:
+        return self.uses.get(name, [])
+
+    def is_mutable_state(self, name: str) -> bool:
+        """Parameters and state vars are legitimately multiply-written
+        (optimizer updates, batch-norm running stats)."""
+        p = self.program
+        return name in p.parameters or name in p.state_vars
+
+    def fanout(self, name: str) -> int:
+        return len(self.uses.get(name, ()))
+
+    def unused_outputs(self) -> List[str]:
+        """Output names nothing reads (liveness seeds these as
+        candidate-dead unless fetched or mutable state)."""
+        return [n for n in self.defs
+                if n not in self.uses and not self.is_mutable_state(n)]

@@ -1,0 +1,140 @@
+"""Liveness analysis + dead-op elimination.
+
+Reference parity: ``ir/graph_helper`` reachability + the reference's
+``Program._prune`` (clone(for_test) pruning / ``use_prune``).  An op is
+live when it (transitively) feeds a fetch target, updates a parameter or
+state var, or is the forward op a live grad op replays.  Everything else
+is dead weight: it still costs capture, trace, and XLA compile time on
+every new feed signature.
+
+``liveness_report`` only reports; ``dead_op_eliminate`` returns a new
+Program with dead ops stripped and grad ``fwd_idx`` links remapped.
+Removal counts are exported through the PR-1 metrics registry
+(``static.pass.dead_ops_eliminated``).
+"""
+from __future__ import annotations
+
+from typing import List, Set
+
+from ..program import OpDesc, Program
+from .pass_base import Pass, PassContext, PassResult, register_pass
+
+__all__ = ["LivenessReportPass", "DeadOpEliminationPass", "find_dead_ops"]
+
+
+def find_dead_ops(program, fetch_names) -> List[int]:
+    """Indices of ops that neither reach a fetch nor mutate state."""
+    fetch = set(fetch_names or ())
+    mutable = set(program.parameters) | set(program.state_vars)
+    live_names: Set[str] = set(fetch)
+    live_ops: Set[int] = set()
+    forced_fwd: Set[int] = set()
+    n_ops = len(program.ops)
+    # fixpoint sweep: one reversed pass suffices for well-formed programs,
+    # but a grad op whose fwd_idx points *later* (the grad-pairing defect
+    # the verifier reports) would otherwise force a forward op after it
+    # was already classified dead — and DCE runs by default on
+    # CompiledProgram, possibly before any verify pass
+    changed = True
+    while changed:
+        changed = False
+        for op in reversed(program.ops):
+            if op.idx in live_ops:
+                continue
+            essential = op.kind == "optimize" or any(
+                n in mutable for n in op.output_names)
+            live = (essential or op.idx in forced_fwd or
+                    any(n in live_names for n in op.output_names))
+            if not live:
+                continue
+            live_ops.add(op.idx)
+            changed = True
+            live_names.update(op.input_names)
+            if op.kind == "grad" and op.fwd_idx is not None and \
+                    0 <= op.fwd_idx < n_ops:
+                # the replayed vjp closure is captured at the forward op:
+                # a live grad keeps its forward alive even if the
+                # forward's outputs are otherwise unused
+                forced_fwd.add(op.fwd_idx)
+    return [op.idx for op in program.ops if op.idx not in live_ops]
+
+
+def _strip(program, dead: List[int]) -> Program:
+    """New Program without ``dead`` ops; shares vars/params/constants
+    with the original (parameter writes must hit the same objects)."""
+    p = Program()
+    p._placeholders = dict(program._placeholders)
+    p.parameters = program.parameters          # shared: same live objects
+    p.constants = dict(program.constants)
+    p.state_vars = program.state_vars
+    p._vars = dict(program._vars)
+    p._lr_provider = program._lr_provider
+    p._build_fn = program._build_fn
+    p.param_specs = dict(program.param_specs)
+    p.random_seed = program.random_seed
+    dead_set = set(dead)
+    remap = {}
+    for op in program.ops:
+        if op.idx in dead_set:
+            continue
+        clone = OpDesc(op.type, op.kind, op.impl, op.input_names,
+                       op.output_names, op.attrs, op.fwd_idx,
+                       op.grad_input_mask, op.eval_impl)
+        p._append(clone)
+        remap[op.idx] = clone.idx
+    for op in p.ops:
+        if op.fwd_idx is not None:
+            # .get: an out-of-range fwd_idx (grad-pairing defect) has no
+            # remap entry; carry None rather than crash — the verifier
+            # owns reporting it
+            op.fwd_idx = remap.get(op.fwd_idx)
+    return p
+
+
+class _LivenessBase(Pass):
+
+    def _analyze(self, program, context: PassContext,
+                 result: PassResult) -> List[int]:
+        dead = find_dead_ops(program, context.fetch_names)
+        for idx in dead:
+            op = program.ops[idx]
+            result.warning(
+                "dead-op",
+                f"op#{op.idx} '{op.type}' outputs {op.output_names} are "
+                "neither consumed by a live op nor fetched"
+                + ("" if context.fetch_names else
+                   " (no fetch list given: only state-updating ops count "
+                   "as roots)"),
+                op_idx=op.idx, op_type=op.type,
+                var=op.output_names[0] if op.output_names else None)
+        result.dead_ops = dead
+        return dead
+
+
+@register_pass("liveness_report")
+class LivenessReportPass(_LivenessBase):
+
+    def run(self, program, context, result):
+        self._analyze(program, context, result)
+
+
+@register_pass("dead_op_eliminate")
+class DeadOpEliminationPass(_LivenessBase):
+
+    is_transform = True
+
+    def run(self, program, context, result):
+        dead = self._analyze(program, context, result)
+        if not dead:
+            result.program = program
+            return
+        result.program = _strip(program, dead)
+        from ...profiler import metrics as _metrics
+        _metrics.counter(
+            "static.pass.dead_ops_eliminated",
+            "ops stripped from Programs by dead_op_eliminate").inc(
+            len(dead))
+        result.info(
+            "dce-summary",
+            f"eliminated {len(dead)} dead op(s) of {len(program.ops)} "
+            f"({[program.ops[i].type for i in dead]})")

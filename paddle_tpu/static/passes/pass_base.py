@@ -1,0 +1,215 @@
+"""Program IR pass framework: Pass base class + registry + driver.
+
+Reference parity: ``framework/ir/pass.h:51`` (Pass::Apply over an ir::Graph)
+and ``REGISTER_PASS`` (``ir/pass.h:315``).  The TPU-native translation
+works on the captured op-level ``Program`` (static/program.py) instead of
+a C++ graph: a pass receives the Program plus a ``PassContext`` (feed
+shapes, fetch names, mesh) and returns a ``PassResult`` carrying typed
+``Diagnostic`` records and, for transform passes, a rewritten Program.
+
+Analysis passes never mutate the input Program; transform passes
+(dead-op elimination) return a new Program and leave the original
+untouched, so Executor caches keyed by ``program._id`` stay valid.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Diagnostic", "PassResult", "PassContext", "Pass",
+           "PassRegistry", "register_pass", "get_pass", "run_passes",
+           "ProgramVerificationError", "ERROR", "WARNING", "INFO"]
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+
+class Diagnostic:
+    """One finding: defect class (``code``), location (op idx/type, var
+    name), severity, and a human-readable message."""
+
+    __slots__ = ("level", "code", "message", "op_idx", "op_type", "var")
+
+    def __init__(self, level: str, code: str, message: str,
+                 op_idx: Optional[int] = None, op_type: Optional[str] = None,
+                 var: Optional[str] = None):
+        self.level = level
+        self.code = code
+        self.message = message
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.var = var
+
+    def location(self) -> str:
+        loc = []
+        if self.op_idx is not None:
+            loc.append(f"op#{self.op_idx}")
+        if self.op_type:
+            loc.append(self.op_type)
+        if self.var:
+            loc.append(f"var '{self.var}'")
+        return " ".join(loc) if loc else "<program>"
+
+    def __repr__(self):
+        return (f"[{self.level}] {self.code} @ {self.location()}: "
+                f"{self.message}")
+
+
+class PassResult:
+    """Diagnostics plus (for transform passes) the rewritten program."""
+
+    def __init__(self, pass_name: str):
+        self.pass_name = pass_name
+        self.diagnostics: List[Diagnostic] = []
+        self.program = None          # set by transform passes
+        self.inferred: Dict = {}     # set by shape inference: name -> aval
+        self.dead_ops: List[int] = []   # set by liveness: dead op idxs
+
+    def add(self, level: str, code: str, message: str, **loc):
+        self.diagnostics.append(Diagnostic(level, code, message, **loc))
+
+    def error(self, code: str, message: str, **loc):
+        self.add(ERROR, code, message, **loc)
+
+    def warning(self, code: str, message: str, **loc):
+        self.add(WARNING, code, message, **loc)
+
+    def info(self, code: str, message: str, **loc):
+        self.add(INFO, code, message, **loc)
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.level == ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.level == WARNING]
+
+    def __bool__(self):
+        return not self.errors
+
+    def __repr__(self):
+        return (f"PassResult({self.pass_name}: "
+                f"{len(self.errors)} errors, "
+                f"{len(self.warnings)} warnings)")
+
+
+class PassContext:
+    """Everything a pass may consult beyond the Program itself.
+
+    ``feed_shapes``: {feed name: concrete shape tuple} — real run-time
+    shapes, so shape inference resolves ``-1`` dims precisely.
+    ``feed_dtypes``: optional {feed name: dtype}.
+    ``fetch_names``: fetch targets — roots for liveness.
+    ``mesh_axes``: mesh axis names the program will run under (SPMD lint).
+    ``require_full_feed``: True only on the Executor validation path,
+    where ``feed_shapes`` IS the run's feed dict and a consumed feed
+    slot missing from it is an error; everywhere else (analysis_report,
+    onnx export) feed_shapes are optional hints.
+    """
+
+    def __init__(self, feed_shapes: Optional[Dict] = None,
+                 feed_dtypes: Optional[Dict] = None,
+                 fetch_names: Optional[Sequence[str]] = None,
+                 mesh_axes: Optional[Sequence[str]] = None,
+                 require_full_feed: bool = False):
+        self.feed_shapes = dict(feed_shapes or {})
+        self.feed_dtypes = dict(feed_dtypes or {})
+        self.fetch_names = tuple(fetch_names or ())
+        self.mesh_axes = tuple(mesh_axes) if mesh_axes is not None else None
+        self.require_full_feed = bool(require_full_feed)
+
+
+class Pass:
+    """Base class.  Subclasses set ``name`` and implement ``run``."""
+
+    name: str = ""
+    # analysis passes only read; transform passes may return a program
+    is_transform: bool = False
+
+    def run(self, program, context: PassContext,
+            result: PassResult) -> None:
+        raise NotImplementedError
+
+    def apply(self, program, context: Optional[PassContext] = None
+              ) -> PassResult:
+        context = context or PassContext()
+        result = PassResult(self.name or type(self).__name__)
+        self.run(program, context, result)
+        return result
+
+
+class PassRegistry:
+    """name -> Pass class (reference ``PassRegistry::Instance()``)."""
+
+    _passes: Dict[str, type] = {}
+
+    @classmethod
+    def register(cls, pass_cls: type, name: Optional[str] = None):
+        name = name or pass_cls.name
+        if not name:
+            raise ValueError(f"pass class {pass_cls.__name__} needs a name")
+        pass_cls.name = name
+        existing = cls._passes.get(name)
+        if existing is not None and existing is not pass_cls:
+            raise ValueError(f"pass '{name}' already registered "
+                             f"({existing.__name__})")
+        cls._passes[name] = pass_cls
+        return pass_cls
+
+    @classmethod
+    def get(cls, name: str) -> type:
+        try:
+            return cls._passes[name]
+        except KeyError:
+            raise KeyError(
+                f"no pass registered under '{name}'; available: "
+                f"{sorted(cls._passes)}") from None
+
+    @classmethod
+    def names(cls) -> List[str]:
+        return sorted(cls._passes)
+
+
+def register_pass(name: str) -> Callable[[type], type]:
+    """The ``REGISTER_PASS(name, Class)`` analog, as a decorator."""
+    def deco(pass_cls: type) -> type:
+        return PassRegistry.register(pass_cls, name)
+    return deco
+
+
+def get_pass(name: str) -> Pass:
+    return PassRegistry.get(name)()
+
+
+def run_passes(program, names: Sequence[str],
+               context: Optional[PassContext] = None
+               ) -> Tuple[object, List[PassResult]]:
+    """Run ``names`` in order; transform passes thread their rewritten
+    program into the next pass.  Returns (final_program, results)."""
+    context = context or PassContext()
+    results: List[PassResult] = []
+    for name in names:
+        p = get_pass(name)
+        res = p.apply(program, context)
+        results.append(res)
+        if p.is_transform and res.program is not None:
+            program = res.program
+    return program, results
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised by Executor.run / analysis entry points when a pass reports
+    errors: carries the structured diagnostics."""
+
+    def __init__(self, results: Sequence[PassResult]):
+        self.results = list(results)
+        self.diagnostics = [d for r in self.results for d in r.errors]
+        lines = ["program verification failed "
+                 f"({len(self.diagnostics)} error(s)):"]
+        for d in self.diagnostics:
+            lines.append(f"  {d!r}")
+        lines.append(
+            "  (set FLAGS_check_program=0 or Executor.run(validate=False) "
+            "to skip validation)")
+        super().__init__("\n".join(lines))

@@ -1,0 +1,193 @@
+"""Shape/dtype inference pass.
+
+Reference parity: per-op ``InferShape``/``InferDtype`` the reference runs
+at compile time on every OpDesc.  At capture time this runtime
+concretizes unknown (``-1``) dims to 1 (``Variable.aval``), so a shape
+bug involving a dynamic batch dim only explodes at ``jax.jit`` trace
+time inside Executor.run with an XLA-flavoured error.  This pass
+re-propagates ``jax.eval_shape`` avals through the op list with the
+*real* feed shapes before any compile, so mismatches become precise
+analysis-time diagnostics naming the op and variable.
+
+Codes: ``feed-shape-mismatch`` (feed array vs declared slot),
+``shape-infer`` (an op's impl rejects the real input shapes),
+``shape-mismatch`` (gradient accumulation / cotangent disagreement),
+``probe-shaped`` (warning: op's shapes came from the execute-on-zeros
+probe at capture and resist abstract evaluation).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+
+from ..program import _LR_NAME
+from .pass_base import Pass, PassContext, PassResult, register_pass
+
+__all__ = ["ShapeInferencePass"]
+
+
+def _first_line(exc: Exception) -> str:
+    msg = str(exc).strip().splitlines()
+    return msg[0] if msg else type(exc).__name__
+
+
+def _fmt(avals) -> str:
+    return ", ".join(f"{tuple(a.shape)}:{a.dtype}" for a in avals)
+
+
+@register_pass("shape_inference")
+class ShapeInferencePass(Pass):
+
+    def run(self, program, context: PassContext, result: PassResult):
+        import jax.numpy as jnp
+        env: Dict[str, jax.ShapeDtypeStruct] = {}
+
+        # -- sources ------------------------------------------------------
+        for n, a in program.constants.items():
+            env[n] = jax.ShapeDtypeStruct(a.shape, a.dtype)
+        for n, p in program.parameters.items():
+            env[n] = jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
+        for n, a in program.state_vars.items():
+            env[n] = jax.ShapeDtypeStruct(a.shape, a.dtype)
+        env[_LR_NAME] = jax.ShapeDtypeStruct((), jnp.float32)
+
+        for name, ph in program._placeholders.items():
+            declared = tuple(ph._shape)
+            fed = context.feed_shapes.get(name)
+            if fed is not None:
+                fed = tuple(int(s) for s in fed)
+                ok = len(fed) == len(declared) and all(
+                    d < 0 or d == f for d, f in zip(declared, fed))
+                if not ok:
+                    result.error(
+                        "feed-shape-mismatch",
+                        f"feed '{name}' has shape {fed} but the slot "
+                        f"declares {ph.declared_shape} (-1/None dims are "
+                        "free; all other dims must match exactly)",
+                        var=name)
+                    continue
+                shape = fed
+            else:
+                if any(d < 0 for d in declared):
+                    result.info(
+                        "unresolved-dim",
+                        f"feed slot '{name}' has unknown dims "
+                        f"{ph.declared_shape} and no feed shape was "
+                        "provided; analyzing with -1 -> 1",
+                        var=name)
+                shape = tuple(1 if d < 0 else d for d in declared)
+            dtype = context.feed_dtypes.get(name, ph._dtype)
+            env[name] = jax.ShapeDtypeStruct(shape, dtype)
+
+        # -- propagate ----------------------------------------------------
+        in_avals_of: Dict[int, List] = {}
+        for op in program.ops:
+            if op.kind == "grad":
+                self._infer_grad(program, op, env, in_avals_of, result)
+                continue
+            ins, missing = [], None
+            for n in op.input_names:
+                a = env.get(n)
+                if a is None:
+                    missing = n
+                    break
+                ins.append(a)
+            if missing is not None:
+                # the verifier owns undefined-input reporting; record
+                # nothing and keep going so later ops still get checked
+                continue
+            in_avals_of[op.idx] = ins
+            try:
+                out = jax.eval_shape(op.impl, *ins)
+            except Exception as e:
+                self._report_infer_failure(program, op, ins, e, result)
+                out = self._fallback_avals(program, op)
+                if out is None:
+                    continue
+            outs = out if isinstance(out, tuple) else (out,)
+            for n, a in zip(op.output_names, outs):
+                env[n] = jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+        result.inferred = dict(env)
+
+    # -- helpers ----------------------------------------------------------
+    def _infer_grad(self, program, op, env, in_avals_of, result):
+        if op.fwd_idx is None or not (0 <= op.fwd_idx < len(program.ops)):
+            return  # verifier reports the broken pairing
+        fwd = program.ops[op.fwd_idx]
+        # cotangent shapes must match the paired forward outputs
+        for cot_name, out_name in zip(op.input_names, fwd.output_names):
+            cot, out = env.get(cot_name), env.get(out_name)
+            if cot is not None and out is not None and \
+                    tuple(cot.shape) != tuple(out.shape):
+                result.error(
+                    "shape-mismatch",
+                    f"grad op#{op.idx} '{op.type}' cotangent "
+                    f"'{cot_name}' has shape {tuple(cot.shape)} but "
+                    f"forward output '{out_name}' of op#{fwd.idx} "
+                    f"'{fwd.type}' has shape {tuple(out.shape)}",
+                    op_idx=op.idx, op_type=op.type, var=cot_name)
+        fwd_ins = in_avals_of.get(op.fwd_idx)
+        if fwd_ins is None or op.grad_input_mask is None:
+            return
+        it = iter(op.output_names)
+        for a, m in zip(fwd_ins, op.grad_input_mask):
+            if not m:
+                continue
+            gname = next(it, None)
+            if gname is None:
+                break
+            want = jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+            have = env.get(gname)
+            if have is not None and tuple(have.shape) != tuple(want.shape):
+                result.error(
+                    "shape-mismatch",
+                    f"gradient '{gname}' accumulates shapes "
+                    f"{tuple(have.shape)} and {tuple(want.shape)} at "
+                    f"grad op#{op.idx} '{op.type}' (fan-out grads must "
+                    "agree elementwise)",
+                    op_idx=op.idx, op_type=op.type, var=gname)
+            env[gname] = want
+
+    def _report_infer_failure(self, program, op, ins, exc, result):
+        pairs = list(zip(op.input_names, ins))
+        detail = _first_line(exc)
+        # name the most likely culprit: an input fed through a slot that
+        # declared a -1 dim, else the op's first input
+        culprit = op.input_names[0] if op.input_names else None
+        for n, _ in pairs:
+            v = program._vars.get(n)
+            if v is not None and any(
+                    d in (None, -1) for d in
+                    getattr(v, "declared_shape", ())):
+                culprit = n
+                break
+        if op.attrs.get("__shape_probed__"):
+            result.warning(
+                "probe-shaped",
+                f"op#{op.idx} '{op.type}' resists abstract evaluation "
+                "(its capture-time shapes came from the execute-on-zeros "
+                f"probe); cannot re-check with real shapes: {detail}",
+                op_idx=op.idx, op_type=op.type, var=culprit)
+            return
+        result.error(
+            "shape-infer",
+            f"op#{op.idx} '{op.type}' rejects its input shapes "
+            f"[{_fmt(ins)}] for inputs {op.input_names}: {detail}",
+            op_idx=op.idx, op_type=op.type, var=culprit)
+
+    def _fallback_avals(self, program, op) -> Optional[tuple]:
+        """Captured var shapes keep the walk alive after a failure."""
+        outs = []
+        for n in op.output_names:
+            v = program._vars.get(n)
+            if v is None:
+                p = program.parameters.get(n)
+                if p is None:
+                    return None
+                outs.append(jax.ShapeDtypeStruct(p._data.shape,
+                                                 p._data.dtype))
+            else:
+                outs.append(jax.ShapeDtypeStruct(
+                    tuple(1 if s < 0 else s for s in v._shape), v._dtype))
+        return tuple(outs)

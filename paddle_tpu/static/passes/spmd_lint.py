@@ -1,0 +1,300 @@
+"""SPMD collective-order lint.
+
+Two surfaces, matching how this runtime expresses parallelism:
+
+1. ``spmd_collective_lint`` (a Program pass) — checks the Megatron
+   placement contract that ``distributed.split``'s static lowering
+   records in ``program.param_specs`` (distributed/compat.py): axis
+   names must exist on the target mesh, spec ranks must fit the
+   parameter, column-parallel matmuls should feed row-parallel matmuls
+   (chaining two column-parallel layers, or reducing over the sharded
+   feature dim in between, makes GSPMD materialise an extra all-gather
+   — the exact ordering bug the reference's hand-spliced
+   c_allreduce/c_concat ops encode structurally), and the bias rules
+   (column bias sharded ``('mp',)``, row bias replicated).
+
+2. ``lint_hlo_collectives`` — for programs built by
+   ``models/gpt_spmd.py`` / ``distributed/`` the collectives live in the
+   compiled HLO, not the op list.  This helper extracts the ordered
+   collective sequence and checks structural invariants:
+   collective-permute ``source_target_pairs`` must be a partial
+   permutation (duplicate sources/targets deadlock or drop data) and
+   ``replica_groups`` must be disjoint.  ``lint_spmd_train_step``
+   wires it to ``build_spmd_train_step`` end to end.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .graph import DefUseGraph
+from .pass_base import (Diagnostic, Pass, PassContext, PassResult,
+                        register_pass, ERROR, WARNING)
+
+__all__ = ["SpmdCollectiveLintPass", "lint_hlo_collectives",
+           "lint_spmd_train_step", "HloCollective"]
+
+_KNOWN_AXES = ("dp", "mp", "pp", "sp", "sharding")
+
+# ops that preserve the feature-dim sharding of their tensor input
+_ELEMENTWISE = {"add", "subtract", "multiply", "divide", "scale", "relu",
+                "gelu", "tanh", "sigmoid", "cast", "dropout", "elu",
+                "leaky_relu", "hardswish", "swish", "silu", "clip", "abs",
+                "square", "exp", "pow"}
+# ops that mix/reduce the (mp-sharded) feature dim: running one between a
+# column-parallel and row-parallel matmul forces an all-gather first
+_FEATURE_MIXING = {"softmax", "log_softmax", "reduce_sum", "reduce_mean",
+                   "reduce_max", "reduce_min", "layer_norm", "batch_norm",
+                   "cross_entropy", "softmax_with_cross_entropy"}
+_MATMUL_TYPES = {"matmul", "mul", "matmul_v2"}
+
+
+def _spec_kind(spec) -> Optional[str]:
+    """'col' when the last spec dim is mp-sharded, 'row' when the first
+    is; None for replicated / batch-only specs."""
+    if not spec:
+        return None
+    if spec[-1] == "mp":
+        return "col"
+    if spec[0] == "mp":
+        return "row"
+    return None
+
+
+@register_pass("spmd_collective_lint")
+class SpmdCollectiveLintPass(Pass):
+
+    def run(self, program, context: PassContext, result: PassResult):
+        specs: Dict[str, tuple] = dict(program.param_specs)
+        if not specs:
+            return
+        axes = tuple(context.mesh_axes) if context.mesh_axes is not None \
+            else _KNOWN_AXES
+
+        for name, spec in specs.items():
+            for ax in spec:
+                if ax is not None and ax not in axes:
+                    result.error(
+                        "spec-axis-unknown",
+                        f"param '{name}' partition spec {spec} names "
+                        f"axis '{ax}' which is not on the target mesh "
+                        f"(axes: {list(axes)})", var=name)
+            p = program.parameters.get(name)
+            if p is not None and len(spec) > p._data.ndim:
+                result.error(
+                    "spec-rank-mismatch",
+                    f"param '{name}' partition spec {spec} has rank "
+                    f"{len(spec)} but the parameter is "
+                    f"{p._data.ndim}-dimensional", var=name)
+
+        g = DefUseGraph(program)
+        for op in program.ops:
+            if op.type not in _MATMUL_TYPES or op.kind != "compute" or \
+                    len(op.input_names) < 2:
+                continue
+            w = op.input_names[1]
+            kind = _spec_kind(specs.get(w))
+            if kind is None:
+                continue
+            out = op.output_names[0] if op.output_names else None
+            if out is None:
+                continue
+            if kind == "col":
+                self._walk_col_output(program, g, specs, op, w, out,
+                                      result)
+            # row-parallel bias rule: the implicit all-reduce happens at
+            # the matmul; a bias added after must be replicated
+            if kind == "row":
+                for c_idx in g.consumers(out):
+                    cop = program.ops[c_idx]
+                    if cop.type != "add":
+                        continue
+                    for other in cop.input_names:
+                        if other != out and \
+                                _spec_kind(specs.get(other)) is not None:
+                            result.error(
+                                "mp-bias",
+                                f"bias '{other}' added after "
+                                f"row-parallel matmul op#{op.idx} has "
+                                f"partition spec {specs[other]}; the "
+                                "row-parallel output is already "
+                                "all-reduced to full width, so its bias "
+                                "must be replicated",
+                                op_idx=cop.idx, op_type=cop.type,
+                                var=other)
+
+    def _walk_col_output(self, program, g, specs, col_op, w, out_name,
+                         result):
+        """Follow the column-parallel output through elementwise ops to
+        the next spec'd matmul; flag ordering that forces a gather."""
+        frontier = [out_name]
+        seen = set()
+        for _ in range(32):  # bounded walk
+            if not frontier:
+                return
+            name = frontier.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for c_idx in g.consumers(name):
+                cop = program.ops[c_idx]
+                if cop.kind != "compute":
+                    continue
+                if cop.type in _MATMUL_TYPES and len(cop.input_names) >= 2:
+                    nxt = _spec_kind(specs.get(cop.input_names[1]))
+                    if nxt == "col":
+                        result.warning(
+                            "mp-order",
+                            f"column-parallel matmul op#{col_op.idx} "
+                            f"(weight '{w}') feeds column-parallel "
+                            f"matmul op#{cop.idx} (weight "
+                            f"'{cop.input_names[1]}'); GSPMD must "
+                            "all-gather the activation between them — "
+                            "pair column-parallel with row-parallel "
+                            "(Megatron f/g ordering)",
+                            op_idx=cop.idx, op_type=cop.type,
+                            var=cop.input_names[1])
+                    continue  # any matmul terminates this branch
+                if cop.type in _FEATURE_MIXING:
+                    result.warning(
+                        "mp-order",
+                        f"op#{cop.idx} '{cop.type}' mixes the feature "
+                        "dim of the column-parallel activation from "
+                        f"matmul op#{col_op.idx} (weight '{w}') before "
+                        "any row-parallel matmul consumed it; GSPMD "
+                        "must all-gather the mp-sharded activation "
+                        "first", op_idx=cop.idx, op_type=cop.type,
+                        var=name)
+                    continue
+                if cop.type == "add":
+                    # column-parallel bias should be sharded over mp
+                    for other in cop.input_names:
+                        if other == name:
+                            continue
+                        if other in program.parameters and \
+                                _spec_kind(specs.get(other)) is None:
+                            result.warning(
+                                "mp-bias",
+                                f"bias '{other}' added to the "
+                                "column-parallel activation of matmul "
+                                f"op#{col_op.idx} has no partition "
+                                "spec; shard it ('mp',) or GSPMD "
+                                "replicates it and reshards the sum",
+                                op_idx=cop.idx, op_type=cop.type,
+                                var=other)
+                if cop.type in _ELEMENTWISE:
+                    frontier.extend(cop.output_names)
+
+
+# ---------------------------------------------------------------------------
+# HLO-level collective lint (gpt_spmd / distributed jit programs)
+# ---------------------------------------------------------------------------
+class HloCollective:
+    """One collective instruction in compiled-HLO program order."""
+
+    __slots__ = ("kind", "line_no", "pairs", "groups", "text")
+
+    def __init__(self, kind, line_no, pairs, groups, text):
+        self.kind = kind
+        self.line_no = line_no
+        self.pairs = pairs      # [(src, dst)] for collective-permute
+        self.groups = groups    # [[ranks]] for reductions/gathers
+        self.text = text
+
+    def __repr__(self):
+        extra = f" pairs={self.pairs}" if self.pairs else \
+            (f" groups={self.groups}" if self.groups else "")
+        return f"HloCollective({self.kind}@L{self.line_no}{extra})"
+
+
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)\b")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}")
+_GROUPS_RE = re.compile(r"replica_groups=\{((?:\{[\d,]+\},?)+)\}")
+
+
+def lint_hlo_collectives(hlo_text: str) -> Tuple[List[HloCollective],
+                                                 List[Diagnostic]]:
+    """Extract the ordered collective sequence from compiled HLO text and
+    check structural invariants.  Returns (collectives, diagnostics)."""
+    collectives: List[HloCollective] = []
+    diags: List[Diagnostic] = []
+    for line_no, line in enumerate(hlo_text.splitlines(), 1):
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        if f"{kind}-done" in line:
+            continue  # async pair: the -start line carries the attrs
+        pairs, groups = [], []
+        pm = _PAIRS_RE.search(line)
+        if pm:
+            pairs = [tuple(int(x) for x in p.split(","))
+                     for p in re.findall(r"\{(\d+,\d+)\}", pm.group(1))]
+        gm = _GROUPS_RE.search(line)
+        if gm:
+            groups = [[int(x) for x in grp.split(",") if x]
+                      for grp in re.findall(r"\{([\d,]+)\}", gm.group(1))]
+        col = HloCollective(kind, line_no, pairs, groups, line.strip())
+        collectives.append(col)
+
+        if kind == "collective-permute" and pairs:
+            srcs = [s for s, _ in pairs]
+            dsts = [d for _, d in pairs]
+            if len(set(srcs)) != len(srcs):
+                diags.append(Diagnostic(
+                    ERROR, "permute-duplicate-source",
+                    f"collective-permute at HLO line {line_no} routes "
+                    f"one source to multiple targets ({pairs}); "
+                    "source_target_pairs must be a partial permutation",
+                    var=f"hlo:{line_no}"))
+            if len(set(dsts)) != len(dsts):
+                diags.append(Diagnostic(
+                    ERROR, "permute-duplicate-target",
+                    f"collective-permute at HLO line {line_no} routes "
+                    f"multiple sources into one target ({pairs}); the "
+                    "later write clobbers the earlier one",
+                    var=f"hlo:{line_no}"))
+        if groups:
+            seen_ranks: Dict[int, int] = {}
+            for gi, grp in enumerate(groups):
+                for r in grp:
+                    if r in seen_ranks:
+                        diags.append(Diagnostic(
+                            ERROR, "replica-groups-overlap",
+                            f"{kind} at HLO line {line_no}: rank {r} "
+                            f"appears in replica groups "
+                            f"{seen_ranks[r]} and {gi} — groups must "
+                            "be disjoint", var=f"hlo:{line_no}"))
+                    seen_ranks[r] = gi
+    return collectives, diags
+
+
+def lint_spmd_train_step(cfg, mesh, batch: int = 8,
+                         **build_kw) -> Tuple[List[HloCollective],
+                                              List[Diagnostic]]:
+    """Build ``models.gpt_spmd.build_spmd_train_step(cfg, mesh)``, compile
+    it (deviceless CPU-mesh compile is fine), and lint the collectives in
+    the resulting HLO.  The integration point for linting the SPMD
+    programs that never materialise as a static Program."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ...models.gpt_spmd import build_spmd_train_step
+
+    step, init = build_spmd_train_step(cfg, mesh, **build_kw)
+    params, opt_state = init(seed=0)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size,
+                                 (batch, cfg.max_seq_len)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, cfg.vocab_size,
+                                    (batch, cfg.max_seq_len)), jnp.int32)
+    sharding = NamedSharding(
+        mesh, P("dp" if "dp" in mesh.axis_names else None))
+    ids = jax.device_put(ids, sharding)
+    labels = jax.device_put(labels, sharding)
+    hlo = jax.jit(step).lower(params, opt_state, ids,
+                              labels).compile().as_text()
+    return lint_hlo_collectives(hlo)

@@ -1,0 +1,176 @@
+"""Program verifier pass.
+
+Reference parity: compile-time OpDesc verification + ``InferShape``
+checks the reference runs on every op append (``framework/op_desc.cc``,
+``ir/graph_helper``).  Defect classes reported (codes):
+
+- ``dangling-input``      input name never registered / never produced
+- ``def-after-use``       input produced only by a *later* op
+- ``write-after-write``   a plain var written by more than one op
+- ``duplicate-output``    one op lists the same output twice
+- ``grad-pairing``        broken ``@GRAD`` <-> forward pairing
+- ``unfed-placeholder``   consumed feed slot missing from the feed dict
+                          (only when the context carries feed info)
+
+Every diagnostic names the offending op (index + type) and variable.
+"""
+from __future__ import annotations
+
+from ..program import _grad_name
+from .graph import DefUseGraph
+from .pass_base import Pass, PassContext, PassResult, register_pass
+
+__all__ = ["VerifyPass"]
+
+
+@register_pass("verify")
+class VerifyPass(Pass):
+
+    def run(self, program, context: PassContext, result: PassResult):
+        g = DefUseGraph(program)
+        sources = g.source_names()
+        known = g.known_names()
+
+        # -- def-before-use / dangling inputs ----------------------------
+        defined = set(sources)
+        for op in program.ops:
+            if op.kind != "grad":
+                for n in op.input_names:
+                    if n in defined:
+                        continue
+                    if n not in known:
+                        result.error(
+                            "dangling-input",
+                            f"input '{n}' of op#{op.idx} '{op.type}' was "
+                            "never registered in the program (no feed, "
+                            "parameter, constant, or producing op)",
+                            op_idx=op.idx, op_type=op.type, var=n)
+                    elif any(d > op.idx for d in g.producers(n)):
+                        result.error(
+                            "def-after-use",
+                            f"input '{n}' of op#{op.idx} '{op.type}' is "
+                            f"only produced later (by op(s) "
+                            f"{[d for d in g.producers(n) if d > op.idx]})",
+                            op_idx=op.idx, op_type=op.type, var=n)
+                    else:
+                        result.error(
+                            "dangling-input",
+                            f"input '{n}' of op#{op.idx} '{op.type}' is "
+                            "registered but has no producer and is not a "
+                            "feed/parameter/constant",
+                            op_idx=op.idx, op_type=op.type, var=n)
+            # duplicate outputs within one op
+            seen = set()
+            for n in op.output_names:
+                if n in seen:
+                    result.error(
+                        "duplicate-output",
+                        f"op#{op.idx} '{op.type}' lists output '{n}' "
+                        "more than once",
+                        op_idx=op.idx, op_type=op.type, var=n)
+                seen.add(n)
+            defined.update(op.output_names)
+
+        # -- write-after-write -------------------------------------------
+        for name, writers in g.defs.items():
+            if len(writers) < 2 or g.is_mutable_state(name):
+                continue
+            writer_ops = [program.ops[i] for i in writers]
+            if name.endswith("@GRAD") and all(
+                    o.kind == "grad" or o.type == "fill_constant"
+                    for o in writer_ops):
+                continue  # legal gradient accumulation (fanout sum)
+            last = writer_ops[-1]
+            result.error(
+                "write-after-write",
+                f"var '{name}' is written by ops "
+                f"{[(o.idx, o.type) for o in writer_ops]}; the write at "
+                f"op#{last.idx} '{last.type}' silently overwrites the "
+                "earlier value (only parameters/state vars may be "
+                "rebound)",
+                op_idx=last.idx, op_type=last.type, var=name)
+
+        # -- @GRAD pairing ------------------------------------------------
+        n_ops = len(program.ops)
+        for op in program.ops:
+            if op.kind != "grad":
+                continue
+            if op.fwd_idx is None or not (0 <= op.fwd_idx < n_ops):
+                result.error(
+                    "grad-pairing",
+                    f"grad op#{op.idx} '{op.type}' has no valid forward "
+                    f"op (fwd_idx={op.fwd_idx})",
+                    op_idx=op.idx, op_type=op.type)
+                continue
+            fwd = program.ops[op.fwd_idx]
+            if fwd.kind != "compute":
+                result.error(
+                    "grad-pairing",
+                    f"grad op#{op.idx} '{op.type}' pairs with op#"
+                    f"{fwd.idx} '{fwd.type}' of kind '{fwd.kind}' "
+                    "(must replay a 'compute' op's vjp)",
+                    op_idx=op.idx, op_type=op.type)
+                continue
+            if fwd.idx >= op.idx:
+                result.error(
+                    "grad-pairing",
+                    f"grad op#{op.idx} '{op.type}' replays op#{fwd.idx} "
+                    "which has not executed yet",
+                    op_idx=op.idx, op_type=op.type)
+            want_in = [_grad_name(o) for o in fwd.output_names]
+            if list(op.input_names) != want_in:
+                result.error(
+                    "grad-pairing",
+                    f"grad op#{op.idx} '{op.type}' cotangent inputs "
+                    f"{op.input_names} do not match forward op#{fwd.idx} "
+                    f"'{fwd.type}' outputs + @GRAD ({want_in})",
+                    op_idx=op.idx, op_type=op.type,
+                    var=op.input_names[0] if op.input_names else None)
+            mask = op.grad_input_mask
+            if mask is None or len(mask) != len(fwd.input_names):
+                result.error(
+                    "grad-pairing",
+                    f"grad op#{op.idx} '{op.type}' grad_input_mask "
+                    f"{mask} does not cover forward op#{fwd.idx} inputs "
+                    f"{fwd.input_names}",
+                    op_idx=op.idx, op_type=op.type)
+            else:
+                want_out = [_grad_name(n) for n, m in
+                            zip(fwd.input_names, mask) if m]
+                if list(op.output_names) != want_out:
+                    result.error(
+                        "grad-pairing",
+                        f"grad op#{op.idx} '{op.type}' outputs "
+                        f"{op.output_names} do not match the masked "
+                        f"forward inputs + @GRAD ({want_out}) of op#"
+                        f"{fwd.idx} '{fwd.type}'",
+                        op_idx=op.idx, op_type=op.type,
+                        var=(op.output_names or want_out or [None])[0])
+
+        # -- fetch coverage ----------------------------------------------
+        fetchable = sources | set(g.defs)
+        for n in context.fetch_names:
+            if n not in fetchable:
+                detail = "registered but never produced by any op" \
+                    if n in known else "unknown to this program"
+                result.error(
+                    "dangling-fetch",
+                    f"fetch target '{n}' is {detail} (not a "
+                    "feed/parameter/constant either)", var=n)
+
+        # -- feed coverage (Executor validation path only: there
+        # feed_shapes IS the feed dict — possibly empty! — while in
+        # analysis/export contexts the shapes are optional hints and
+        # absence is not a defect) --------------------------------------
+        if context.require_full_feed:
+            fed = set(context.feed_shapes)
+            for name, ph in program._placeholders.items():
+                if name in fed or not g.consumers(name):
+                    continue
+                first = program.ops[g.consumers(name)[0]]
+                result.error(
+                    "unfed-placeholder",
+                    f"feed slot '{name}' (declared {ph.declared_shape}) "
+                    f"is consumed by op#{first.idx} '{first.type}' but "
+                    "missing from the feed dict",
+                    op_idx=first.idx, op_type=first.type, var=name)

@@ -38,6 +38,7 @@ __all__ = ["Program", "Variable", "OpDesc", "Block", "default_main_program",
 _state = threading.local()
 
 _LR_NAME = "@LR@"
+_probe_warned = False  # one-shot warning for the eval_shape probe fallback
 
 
 class Variable(Tensor):
@@ -100,9 +101,17 @@ class Variable(Tensor):
 
     @property
     def size(self):
+        if any(s < 0 for s in self._shape):
+            raise ValueError(
+                f"Variable '{self.name}' has unknown (-1) dims "
+                f"{self.declared_shape}: its element count is undefined "
+                "until real feed shapes are known. Run the program (the "
+                "Executor resolves dims from the feed) or use "
+                "Program.analysis_report(feed_shapes=...) to infer "
+                "shapes analytically.")
         n = 1
         for s in self._shape:
-            n *= max(s, 1)
+            n *= s
         return n
 
     def aval(self):
@@ -316,6 +325,22 @@ class Program:
             p._append(clone_op)
         return p
 
+    def analysis_report(self, feed_shapes=None, feed_dtypes=None,
+                        fetch_list=None, mesh_axes=None):
+        """Run the static-analysis pass bundle (verify, shape inference
+        with real ``feed_shapes``, liveness, SPMD lint) and return an
+        ``AnalysisReport`` (see static/passes).  Read-only: the program
+        is never mutated."""
+        from . import passes as _passes
+        fetch_names = None
+        if fetch_list is not None:
+            fetch_names = [f if isinstance(f, str) else f.name
+                           for f in fetch_list]
+        return _passes.analyze(self, feed_shapes=feed_shapes,
+                               feed_dtypes=feed_dtypes,
+                               fetch_names=fetch_names,
+                               mesh_axes=mesh_axes)
+
     def __repr__(self):
         return (f"Program(id={self._id}, ops={len(self.ops)}, "
                 f"feeds={list(self._placeholders)}, "
@@ -416,11 +441,32 @@ def capture_op(prog: Program, op_name: str, fn: Callable,
             in_avals.append(jax.ShapeDtypeStruct(t._data.shape,
                                                  t._data.dtype))
 
+    shape_probed = False
     try:
         out_avals = jax.eval_shape(closed, *in_avals)
     except Exception:
         # impls that resist abstract evaluation (host callbacks etc.):
-        # infer shapes by running on zeros
+        # infer shapes by running on zeros.  The probe EXECUTES the impl,
+        # so host callbacks with side effects fire at capture time —
+        # surface it once and count every occurrence so the pass layer
+        # and dashboards can see which programs rely on it.
+        global _probe_warned
+        if not _probe_warned:
+            _probe_warned = True
+            import warnings
+            warnings.warn(
+                f"op '{op_name}' resists jax.eval_shape; inferring its "
+                "output shapes by EXECUTING it on zeros. Host callbacks "
+                "inside the impl run with side effects at capture time. "
+                "(warned once; metrics counter "
+                "'static.capture.shape_probe' counts every occurrence)",
+                UserWarning, stacklevel=3)
+        from ..profiler import metrics as _metrics
+        _metrics.counter(
+            "static.capture.shape_probe",
+            "op captures that fell back to the execute-on-zeros shape "
+            "probe (jax.eval_shape failed)").inc()
+        shape_probed = True
         zeros = [jnp.zeros(a.shape, a.dtype) for a in in_avals]
         probe = closed(*zeros)
         out_avals = jax.tree_util.tree_map(
@@ -446,6 +492,10 @@ def capture_op(prog: Program, op_name: str, fn: Callable,
     static_attrs = {k: v for k, v in kwargs.items()
                     if isinstance(v, (bool, int, float, str, list, tuple,
                                       type(None)))}
+    if shape_probed:
+        # analysis marker: shape_inference treats eval_shape failures on
+        # this op as expected (warning, not error)
+        static_attrs["__shape_probed__"] = True
     op = prog._append(OpDesc(op_name, "compute", closed, in_names,
                              [v.name for v in out_vars], static_attrs,
                              eval_impl=eval_impl))
@@ -619,6 +669,10 @@ class CompiledProgram:
         self.build_strategy = build_strategy
         self._dp_mesh = None
         self._loss_name = None
+        # fetch-signature -> dead-op-eliminated program (ir pass layer);
+        # keyed on the source program's version so late op appends
+        # invalidate stale prunes
+        self._dce_cache: Dict = {}
 
     def with_data_parallel(self, loss_name=None, places=None, **kw):
         from jax.sharding import Mesh
@@ -641,8 +695,38 @@ class CompiledProgram:
                                  if mesh.shape.get(a, 1) > 1)
         return self
 
+    def _optimized_program(self, fetch_names: Tuple[str, ...]):
+        """Dead-op-eliminated view of the program for these fetches
+        (reference: build_strategy-driven ir passes in compiler.py).
+        Gated by FLAGS_program_dce; bit-exact by construction — only ops
+        reaching neither a fetch nor a parameter/state write are cut."""
+        from ..utils import flags as _flags
+        if not _flags.get_flag("FLAGS_program_dce"):
+            return self.program
+        return _dce_cached(self.program, fetch_names, self._dce_cache)
+
     def __getattr__(self, item):
         return getattr(self.program, item)
+
+
+def _dce_cached(program: Program, fetch_names: Tuple[str, ...],
+                cache: Dict) -> Program:
+    """Dead-op-eliminated program for these fetches, memoized on
+    (program version, fetch signature).  Entries for stale versions can
+    never hit again (the version only moves forward), so they are
+    evicted on miss — the cache holds only the live version's fetch
+    signatures instead of growing per mutation+run cycle."""
+    key = (program._version, fetch_names)
+    prog = cache.get(key)
+    if prog is None:
+        for stale in [k for k in cache if k[0] != program._version]:
+            del cache[stale]
+        from . import passes as _passes
+        res = _passes.DeadOpEliminationPass().apply(
+            program, _passes.PassContext(fetch_names=fetch_names))
+        prog = res.program if res.program is not None else program
+        cache[key] = prog
+    return prog
 
 
 def _build_runner(program: Program, fetch_names: Tuple[str, ...],
@@ -718,9 +802,27 @@ class Executor:
     def close(self):
         self._cache.clear()
 
+    @staticmethod
+    def _validate(program, feed_arrays, fetch_names):
+        """Pre-compile static analysis (FLAGS_check_program /
+        run(validate=True)): the verifier + shape inference with the
+        REAL feed shapes, so a malformed program fails here with a
+        diagnostic naming the op and var instead of an XLA trace error
+        inside jax.jit."""
+        from . import passes as _passes
+        report = _passes.analyze(
+            program,
+            feed_shapes={n: tuple(a.shape)
+                         for n, a in feed_arrays.items()},
+            feed_dtypes={n: a.dtype for n, a in feed_arrays.items()},
+            fetch_names=fetch_names,
+            passes=("verify", "shape_inference"),
+            require_full_feed=True)  # here feed_shapes IS the feed dict
+        report.raise_on_error()
+
     def run(self, program=None, feed=None, fetch_list=None,
             scope=None, return_numpy=True, use_program_cache=True,
-            use_prune=False):
+            use_prune=False, validate=None):
         feed = feed or {}
         fetch_list = fetch_list if fetch_list is not None else []
         program = program or default_main_program()
@@ -732,7 +834,9 @@ class Executor:
                 else [Tensor(v) for v in outs]
         dp_mesh = None
         batch_axes = ("dp",)
+        compiled = None
         if isinstance(program, CompiledProgram):
+            compiled = program
             dp_mesh = program._dp_mesh
             batch_axes = getattr(program, "_batch_axes", ("dp",))
             program = program.program
@@ -758,6 +862,17 @@ class Executor:
 
         fetch_names = tuple(
             f if isinstance(f, str) else f.name for f in fetch_list)
+
+        # ir-pass layer: dead-op elimination.  CompiledProgram applies it
+        # by default (FLAGS_program_dce); plain programs opt in via
+        # use_prune (reference executor.py use_prune -> Program._prune).
+        if compiled is not None:
+            program = compiled._optimized_program(fetch_names)
+        elif use_prune:
+            program = _dce_cached(
+                program, fetch_names,
+                program.__dict__.setdefault("_prune_cache", {}))
+
         feed_arrays = {}
         for n, v in feed.items():
             if isinstance(v, Tensor):
@@ -775,11 +890,21 @@ class Executor:
                tuple(sorted((n, a.shape, str(a.dtype))
                             for n, a in feed_arrays.items())))
         fn = self._cache.get(key) if use_program_cache else None
+        from ..utils import flags as _flags
+        # three modes: validate=True always runs, False never, and the
+        # default None validates via flag on compile misses only
+        if validate or (validate is None and fn is None and
+                        _flags.get_flag("FLAGS_check_program")):
+            # flag-driven validation piggybacks the compile cache (once
+            # per program/fetch/feed-signature, never on the cached hot
+            # path); an EXPLICIT validate=True always runs — the caller
+            # is asking for diagnostics on a program that may compile
+            # fine yet compute wrong results (e.g. write-after-write)
+            self._validate(program, feed_arrays, fetch_names)
         if fn is None:
             if use_program_cache and self._cache:
                 # a NEW feed signature silently recompiles; surface it
                 # like the reference's FLAGS-gated program-cache logging
-                from ..utils import flags as _flags
                 if _flags.get_flag("FLAGS_log_recompile"):
                     import sys as _sys
                     print(f"[executor] recompiling program {program._id} "

@@ -93,6 +93,19 @@ define_flag("FLAGS_matmul_precision", "default",
 define_flag("FLAGS_log_recompile", False,
             "announce Executor program recompiles on new feed "
             "signatures (each new shape compiles a new XLA program)")
+define_flag("FLAGS_check_program", False,
+            "run the static-analysis pass bundle (verifier + shape "
+            "inference with real feed shapes) on every new Executor "
+            "compile; malformed programs raise "
+            "ProgramVerificationError naming the op and var instead of "
+            "failing inside jax.jit (reference: per-OpDesc InferShape/"
+            "verification at compile time)")
+define_flag("FLAGS_program_dce", True,
+            "apply the dead_op_eliminate ir pass when running a "
+            "CompiledProgram: ops reaching neither a fetch target nor a "
+            "parameter/state update are stripped before compile "
+            "(bit-exact; saves trace+XLA-compile time per feed "
+            "signature)")
 define_flag("FLAGS_host_tracer_capacity", 1 << 20,
             "max host spans held by the profiler ring buffer; oldest "
             "spans drop beyond this (reference host_trace_level buffer)")

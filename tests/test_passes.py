@@ -1,0 +1,665 @@
+"""Static-analysis pass framework ("prog-san") tests.
+
+Verifier coverage works by *program mutation*: take the golden programs
+from test_static_graph.py, break one thing (delete/rename an op or var,
+cross-wire an output, snap a grad link), and assert the pass reports the
+exact defect class AND names the offending op and variable.  Also covers
+shape inference with real feed shapes, dead-op elimination
+bit-exactness, SPMD collective lint, Executor validation gating,
+dy2static program checking, ONNX export of analyzed programs, and the
+framework AST linter (tools/framework_lint.py).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static import passes
+from paddle_tpu.static.passes import ProgramVerificationError
+from paddle_tpu.utils import flags as flags_mod
+from paddle_tpu.profiler import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+@pytest.fixture
+def _flags_guard():
+    saved = {k: flags_mod.get_flag(k)
+             for k in ("FLAGS_check_program", "FLAGS_program_dce")}
+    yield
+    flags_mod.set_flags(saved)
+
+
+def _forward_program(extra_dead=False):
+    """x -> fc(16, relu) -> fc(1); optionally a dead fc branch."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 8], "float32")
+        h = static.nn.fc(x, 16, activation="relu")
+        pred = static.nn.fc(h, 1)
+        if extra_dead:
+            static.nn.fc(x, 4)  # output never consumed or fetched
+    return main, pred
+
+
+def _train_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        label = static.data("label", [None, 1], "float32")
+        h = static.nn.fc(x, 16, activation="relu")
+        pred = static.nn.fc(h, 1)
+        loss = paddle.mean(paddle.square(pred - label))
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _codes(report):
+    return {d.code for d in report.diagnostics}
+
+
+def _find(report, code):
+    ds = [d for d in report.diagnostics if d.code == code]
+    assert ds, f"no diagnostic with code {code!r} in:\n{report}"
+    return ds[0]
+
+
+class TestPassRegistry:
+    def test_builtin_passes_registered(self):
+        names = passes.PassRegistry.names()
+        for n in ("verify", "shape_inference", "liveness_report",
+                  "dead_op_eliminate", "spmd_collective_lint"):
+            assert n in names
+
+    def test_register_pass_decorator_and_dup_rejection(self):
+        @passes.register_pass("test_noop_pass")
+        class NoopPass(passes.Pass):
+            def run(self, program, context, result):
+                result.info("noop", "ok")
+        assert passes.get_pass("test_noop_pass").__class__ is NoopPass
+        with pytest.raises(ValueError, match="already registered"):
+            @passes.register_pass("test_noop_pass")
+            class Other(passes.Pass):
+                def run(self, program, context, result):
+                    pass
+
+    def test_unknown_pass_name(self):
+        with pytest.raises(KeyError, match="no pass registered"):
+            passes.get_pass("does_not_exist")
+
+
+class TestVerifierMutations:
+    def test_golden_program_verifies_clean(self):
+        main, pred = _forward_program()
+        report = main.analysis_report(fetch_list=[pred])
+        assert report.ok(), str(report)
+
+    def test_train_program_verifies_clean(self):
+        main, _, loss = _train_program()
+        report = main.analysis_report(fetch_list=[loss])
+        assert report.ok(), str(report)
+
+    def test_dangling_input_mutation(self):
+        main, pred = _forward_program()
+        op = main.global_block().ops[3]       # second matmul
+        op.input_names[0] = "never_declared"
+        report = main.analysis_report(fetch_list=[pred])
+        d = _find(report, "dangling-input")
+        assert d.level == passes.ERROR
+        assert d.var == "never_declared"
+        assert d.op_idx == 3 and d.op_type == "matmul"
+
+    def test_deleted_producer_reports_dangling(self):
+        main, pred = _forward_program()
+        removed = main.ops.pop(0)             # delete the first matmul
+        for i, op in enumerate(main.ops):
+            op.idx = i
+        report = main.analysis_report(fetch_list=[pred])
+        d = _find(report, "dangling-input")
+        assert d.var == removed.output_names[0]
+
+    def test_write_after_write_mutation(self):
+        main, pred = _forward_program()
+        ops = main.global_block().ops
+        ops[2].output_names[0] = ops[0].output_names[0]  # relu clobbers
+        report = main.analysis_report(fetch_list=[pred])
+        d = _find(report, "write-after-write")
+        assert d.var == ops[0].output_names[0]
+        assert d.op_type == "relu"
+
+    def test_duplicate_output_mutation(self):
+        main, pred = _forward_program()
+        op = main.global_block().ops[0]
+        op.output_names.append(op.output_names[0])
+        report = main.analysis_report(fetch_list=[pred])
+        d = _find(report, "duplicate-output")
+        assert d.op_idx == 0 and d.var == op.output_names[0]
+
+    def test_grad_pairing_broken_fwd_idx(self):
+        main, _, loss = _train_program()
+        grad_ops = [op for op in main.ops if op.kind == "grad"]
+        grad_ops[0].fwd_idx = None
+        report = main.analysis_report(fetch_list=[loss])
+        d = _find(report, "grad-pairing")
+        assert d.op_type == grad_ops[0].type
+
+    def test_grad_pairing_crosswired_forward(self):
+        main, _, loss = _train_program()
+        grad_ops = [op for op in main.ops if op.kind == "grad"]
+        # point a grad op at a different (mismatched) forward op
+        victim = grad_ops[-1]
+        wrong = next(op.idx for op in main.ops
+                     if op.kind == "compute"
+                     and op.idx != victim.fwd_idx
+                     and op.output_names[0] + "@GRAD"
+                     != victim.input_names[0])
+        victim.fwd_idx = wrong
+        report = main.analysis_report(fetch_list=[loss])
+        d = _find(report, "grad-pairing")
+        assert f"op#{victim.idx}" in repr(d)
+
+    def test_dangling_fetch(self):
+        main, _ = _forward_program()
+        report = main.analysis_report(fetch_list=["no_such_var"])
+        d = _find(report, "dangling-fetch")
+        assert d.var == "no_such_var"
+
+    def test_partial_feed_shapes_are_hints_not_errors(self):
+        """analysis_report / export take feed_shapes as optional hints:
+        a slot without a hint is NOT an unfed-placeholder defect."""
+        main, _, loss = _train_program()
+        report = main.analysis_report(feed_shapes={"x": (4, 8)},
+                                      fetch_list=[loss])
+        assert "unfed-placeholder" not in _codes(report)
+
+    def test_unfed_placeholder_on_executor_path(self):
+        """On the Executor validation path feed_shapes IS the feed dict,
+        so a consumed-but-unfed slot is reported before compile."""
+        main, _, loss = _train_program()
+        exe = static.Executor()
+        with pytest.raises(ProgramVerificationError) as ei:
+            exe.run(main, feed={"x": np.zeros((4, 8), np.float32)},
+                    fetch_list=[loss], validate=True)
+        assert "unfed-placeholder" in str(ei.value)
+        assert "label" in str(ei.value)
+
+    def test_unfed_placeholder_with_empty_feed(self):
+        """A completely empty feed dict must still trip the coverage
+        check (not fall through to a KeyError inside the jitted replay)."""
+        main, pred = _forward_program()
+        exe = static.Executor()
+        with pytest.raises(ProgramVerificationError,
+                           match="unfed-placeholder"):
+            exe.run(main, feed={}, fetch_list=[pred], validate=True)
+
+
+class TestShapeInference:
+    def test_feed_shape_mismatch_on_declared_dim(self):
+        main, pred = _forward_program()
+        report = main.analysis_report(feed_shapes={"x": (4, 7)},
+                                      fetch_list=[pred])
+        d = _find(report, "feed-shape-mismatch")
+        assert d.var == "x" and "(4, 7)" in d.message
+
+    def test_minus_one_dim_mismatch_names_op_and_var(self):
+        """Batch dims concretize to 1 at capture (program.py aval), so
+        x@B=4 vs label@B=3 only explodes inside jax.jit today; the pass
+        reports it precisely, before any compile."""
+        main, _, loss = _train_program()
+        report = main.analysis_report(
+            feed_shapes={"x": (4, 8), "label": (3, 1)},
+            fetch_list=[loss])
+        d = _find(report, "shape-infer")
+        assert d.op_type == "subtract"
+        assert d.var == "label"
+        assert "(4, 1)" in d.message and "(3, 1)" in d.message
+
+    def test_inferred_avals_resolve_dynamic_batch(self):
+        main, pred = _forward_program()
+        report = main.analysis_report(feed_shapes={"x": (12, 8)},
+                                      fetch_list=[pred])
+        assert report.ok(), str(report)
+        assert tuple(report.inferred[pred.name].shape) == (12, 1)
+
+    def test_no_feed_shapes_analyzes_with_unit_dims(self):
+        main, pred = _forward_program()
+        report = main.analysis_report(fetch_list=[pred])
+        assert tuple(report.inferred[pred.name].shape) == (1, 1)
+        assert "unresolved-dim" in _codes(report)
+
+
+class TestExecutorValidation:
+    def test_flag_gated_validation_rejects_bad_feed(self, _flags_guard):
+        main, _, loss = _train_program()
+        exe = static.Executor()
+        flags_mod.set_flags({"FLAGS_check_program": True})
+        rng = np.random.RandomState(0)
+        with pytest.raises(ProgramVerificationError) as ei:
+            exe.run(main,
+                    feed={"x": rng.rand(4, 8).astype("float32"),
+                          "label": rng.rand(3, 1).astype("float32")},
+                    fetch_list=[loss])
+        msg = str(ei.value)
+        assert "subtract" in msg and "label" in msg
+        assert "FLAGS_check_program" in msg  # tells the user the off-switch
+
+    def test_validate_kwarg_without_flag(self):
+        main, pred = _forward_program()
+        main.global_block().ops[3].input_names[0] = "ghost"
+        exe = static.Executor()
+        with pytest.raises(ProgramVerificationError, match="ghost"):
+            exe.run(main, feed={"x": np.zeros((2, 8), np.float32)},
+                    fetch_list=[pred], validate=True)
+
+    def test_valid_program_runs_with_validation_on(self, _flags_guard):
+        flags_mod.set_flags({"FLAGS_check_program": True})
+        main, pred = _forward_program()
+        exe = static.Executor()
+        out, = exe.run(main, feed={"x": np.ones((5, 8), np.float32)},
+                       fetch_list=[pred])
+        assert out.shape == (5, 1)
+
+    def test_explicit_validate_not_skipped_by_compile_cache(self):
+        """validate=True must run even when the compiled fn is cached:
+        a write-after-write compiles fine but computes wrong results,
+        and the user re-runs with validate=True exactly to diagnose."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+            pred = static.nn.fc(h, 1)
+            paddle.add(x, x)                   # trailing op...
+        ops = main.global_block().ops
+        # ...rebound to clobber the first matmul's output AFTER all its
+        # consumers ran: executes fine, computes as if nothing happened
+        ops[-1].output_names[0] = ops[0].output_names[0]
+        exe = static.Executor()
+        xb = np.ones((2, 8), np.float32)
+        exe.run(main, feed={"x": xb}, fetch_list=[pred])  # populate cache
+        with pytest.raises(ProgramVerificationError,
+                           match="write-after-write"):
+            exe.run(main, feed={"x": xb}, fetch_list=[pred],
+                    validate=True)
+
+
+class TestDeadOpElimination:
+    def test_liveness_finds_dead_branch(self):
+        main, pred = _forward_program(extra_dead=True)
+        dead = passes.find_dead_ops(main, [pred.name])
+        assert len(dead) == 2  # matmul + add of the unused fc
+        types = [main.ops[i].type for i in dead]
+        assert types == ["matmul", "add"]
+
+    def test_liveness_report_diagnostics_name_ops(self):
+        main, pred = _forward_program(extra_dead=True)
+        report = main.analysis_report(fetch_list=[pred])
+        d = _find(report, "dead-op")
+        assert d.op_type in ("matmul", "add") and d.var is not None
+
+    def test_dce_bit_exact_and_strips(self, _flags_guard):
+        flags_mod.set_flags({"FLAGS_program_dce": True})
+        main, pred = _forward_program(extra_dead=True)
+        xb = np.random.RandomState(0).rand(6, 8).astype("float32")
+        exe = static.Executor()
+        plain, = exe.run(main, feed={"x": xb}, fetch_list=[pred],
+                         use_program_cache=False)
+        compiled = static.CompiledProgram(main)
+        opt = compiled._optimized_program((pred.name,))
+        assert len(opt.ops) == len(main.ops) - 2
+        pruned, = exe.run(compiled, feed={"x": xb}, fetch_list=[pred],
+                          use_program_cache=False)
+        assert np.array_equal(plain, pruned)  # bit-exact
+
+    def test_train_program_has_no_dead_ops(self):
+        main, _, loss = _train_program()
+        assert passes.find_dead_ops(main, [loss.name]) == []
+
+    def test_use_prune_on_plain_executor(self):
+        main, pred = _forward_program(extra_dead=True)
+        exe = static.Executor()
+        xb = np.ones((3, 8), np.float32)
+        a, = exe.run(main, feed={"x": xb}, fetch_list=[pred])
+        b, = exe.run(main, feed={"x": xb}, fetch_list=[pred],
+                     use_prune=True)
+        assert np.array_equal(a, b)
+
+    def test_dce_metrics_counter(self):
+        before = metrics.counter("static.pass.dead_ops_eliminated").value
+        main, pred = _forward_program(extra_dead=True)
+        res = passes.DeadOpEliminationPass().apply(
+            main, passes.PassContext(fetch_names=(pred.name,)))
+        assert len(res.program.ops) == len(main.ops) - 2
+        after = metrics.counter("static.pass.dead_ops_eliminated").value
+        assert after == before + 2
+
+    def test_dce_survives_malformed_grad_pairing(self):
+        """A grad op whose fwd_idx points *later* (the grad-pairing
+        defect) must not crash DCE — it runs by default on
+        CompiledProgram, possibly before any verify pass."""
+        main, _, loss = _train_program()
+        g = next(op for op in main.ops if op.kind == "grad")
+        g.fwd_idx = len(main.ops) - 1          # forward "after" the grad
+        dead = passes.find_dead_ops(main, [loss.name])
+        assert g.idx not in dead               # grad is live (feeds sgd)
+        assert g.fwd_idx not in dead           # forced forward kept too
+        res = passes.DeadOpEliminationPass().apply(
+            main, passes.PassContext(fetch_names=(loss.name,)))
+        assert res.program is not None         # no KeyError
+
+    def test_dce_cache_evicts_stale_versions(self):
+        main, pred = _forward_program(extra_dead=True)
+        compiled = static.CompiledProgram(main)
+        compiled._optimized_program((pred.name,))
+        v0 = main._version
+        with static.program_guard(main):
+            extra = static.nn.fc(main._placeholders["x"], 2)
+        compiled._optimized_program((pred.name,))
+        compiled._optimized_program((extra.name,))
+        assert all(k[0] == main._version for k in compiled._dce_cache)
+        assert not any(k[0] == v0 for k in compiled._dce_cache)
+        assert len(compiled._dce_cache) == 2   # both live fetch sigs kept
+
+    def test_grad_keeps_forward_alive(self):
+        """A live grad op pins the forward op whose vjp it replays even
+        when the forward output itself is not fetched."""
+        main, _, loss = _train_program()
+        g = next(op for op in main.ops if op.kind == "grad")
+        assert g.fwd_idx not in passes.find_dead_ops(
+            main, [loss.name + "@GRAD"])
+
+
+class TestVariableSizeRegression:
+    def test_size_raises_on_unknown_dims(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8], "float32")
+        with pytest.raises(ValueError, match="unknown \\(-1\\) dims"):
+            _ = x.size
+        with pytest.raises(ValueError, match="'x'"):
+            x.numel()
+
+    def test_size_exact_on_concrete_dims(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+        assert x.size == 32 and x.numel() == 32
+
+
+class TestShapeProbeFallback:
+    def test_probe_warns_once_counts_and_marks(self):
+        from paddle_tpu.static import program as prog_mod
+        import jax.numpy as jnp
+
+        def host_impl(a):
+            return jnp.asarray(np.asarray(a) * 2.0)  # defeats eval_shape
+
+        prog_mod._probe_warned = False
+        before = metrics.counter("static.capture.shape_probe").value
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 3], "float32")
+            with pytest.warns(UserWarning, match="resists jax.eval_shape"):
+                out = prog_mod.capture_op(main, "host_op", host_impl,
+                                          [x], {})
+            import warnings
+            with warnings.catch_warnings(record=True) as record:
+                warnings.simplefilter("always")   # second probe: no warning
+                prog_mod.capture_op(main, "host_op", host_impl, [out], {})
+        assert not [w for w in record
+                    if "resists jax.eval_shape" in str(w.message)]
+        assert metrics.counter("static.capture.shape_probe").value \
+            == before + 2
+        assert main.ops[0].attrs.get("__shape_probed__") is True
+
+    def test_shape_inference_downgrades_probed_op(self):
+        from paddle_tpu.static import program as prog_mod
+        import jax.numpy as jnp
+        prog_mod._probe_warned = True  # silence
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 3], "float32")
+            out = prog_mod.capture_op(
+                main, "host_op",
+                lambda a: jnp.asarray(np.asarray(a) * 2.0), [x], {})
+        report = main.analysis_report(feed_shapes={"x": (4, 3)},
+                                      fetch_list=[out])
+        assert report.ok()  # probe-shaped is a warning, not an error
+        assert "probe-shaped" in _codes(report)
+
+
+class TestSpmdCollectiveLint:
+    def _mp_program(self, w1_spec, w2_spec):
+        main, pred = _forward_program()
+        mm = [op for op in main.ops if op.type == "matmul"]
+        w1, w2 = mm[0].input_names[1], mm[1].input_names[1]
+        main.param_specs[w1] = w1_spec
+        main.param_specs[w2] = w2_spec
+        return main, pred
+
+    def test_megatron_pairing_clean(self):
+        main, pred = self._mp_program((None, "mp"), ("mp", None))
+        report = main.analysis_report(fetch_list=[pred],
+                                      mesh_axes=("dp", "mp"))
+        assert "mp-order" not in _codes(report)
+
+    def test_col_col_chain_flagged(self):
+        main, pred = self._mp_program((None, "mp"), (None, "mp"))
+        report = main.analysis_report(fetch_list=[pred],
+                                      mesh_axes=("dp", "mp"))
+        d = _find(report, "mp-order")
+        assert "all-gather" in d.message
+        assert d.op_type == "matmul"
+
+    def test_unknown_mesh_axis(self):
+        main, pred = self._mp_program(("tp", None), (None, None))
+        report = main.analysis_report(fetch_list=[pred],
+                                      mesh_axes=("dp", "mp"))
+        d = _find(report, "spec-axis-unknown")
+        assert "'tp'" in d.message
+
+    def test_hlo_permute_and_group_invariants(self):
+        hlo = "\n".join([
+            "%ok = f32[8] collective-permute(%p0), "
+            "source_target_pairs={{0,1},{1,0}}",
+            "%bad = f32[8] collective-permute(%p0), "
+            "source_target_pairs={{0,1},{0,2}}",
+            "%ar = f32[8] all-reduce(%p1), replica_groups={{0,1},{1,2}}",
+        ])
+        cols, diags = passes.lint_hlo_collectives(hlo)
+        assert [c.kind for c in cols] == ["collective-permute",
+                                         "collective-permute",
+                                         "all-reduce"]
+        codes = {d.code for d in diags}
+        assert "permute-duplicate-source" in codes
+        assert "replica-groups-overlap" in codes
+        assert cols[0].pairs == [(0, 1), (1, 0)]
+
+
+class TestDy2StaticValidation:
+    def test_check_program_clean(self):
+        from paddle_tpu.jit import InputSpec, ProgramTranslator
+
+        def f(a, b):
+            return paddle.mean(paddle.square(a + b))
+
+        pt = ProgramTranslator()
+        report = pt.check_program(
+            f, [InputSpec([None, 4]), InputSpec([None, 4])])
+        assert report.ok(), str(report)
+
+    def test_check_program_catches_feed_mismatch(self):
+        from paddle_tpu.jit import InputSpec, ProgramTranslator
+
+        def f(a, b):
+            return paddle.mean(paddle.square(a + b))
+
+        pt = ProgramTranslator()
+        with pytest.raises(ProgramVerificationError, match="add"):
+            pt.check_program(
+                f, [InputSpec([None, 4]), InputSpec([None, 4])],
+                feed_shapes={"input_0": (4, 4), "input_1": (5, 4)})
+
+    def test_get_program_captures_ops(self):
+        from paddle_tpu.jit import InputSpec, ProgramTranslator
+
+        def f(a):
+            return paddle.square(a)
+
+        prog, feeds, fetch = ProgramTranslator().get_program(
+            f, [InputSpec([3, 3], name="inp")])
+        assert [op.type for op in prog.ops] == ["square"]
+        assert feeds[0].name == "inp" and len(fetch) == 1
+
+
+class TestOnnxExportProgram:
+    def test_export_program_uses_inferred_shapes(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tests"))
+        from onnx_mini_runtime import parse_model, run_model
+        main, pred = _forward_program()
+        path = paddle.onnx.export_program(
+            main, str(tmp_path / "prog"), fetch_list=[pred],
+            feed_shapes={"x": (3, 8)})
+        model = parse_model(open(path, "rb").read())
+        xb = np.random.RandomState(0).rand(3, 8).astype("float32")
+        got, = run_model(model, {"x": xb})
+        exe = static.Executor()
+        want, = exe.run(main, feed={"x": xb}, fetch_list=[pred])
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_export_pred_from_train_program(self, tmp_path):
+        """Exporting `pred` from a TRAIN program must only take the
+        fetch cone — loss ops (square/reduce_mean) and the backward/
+        optimizer surface stay out of the ONNX graph."""
+        sys.path.insert(0, os.path.join(REPO, "tests"))
+        from onnx_mini_runtime import parse_model, run_model
+        main, _, _ = _train_program()
+        pred_name = main.ops[3].output_names[0]  # second fc's add
+        path = paddle.onnx.export_program(
+            main, str(tmp_path / "train"), fetch_list=[pred_name],
+            feed_shapes={"x": (5, 8)})
+        model = parse_model(open(path, "rb").read())
+        xb = np.random.RandomState(1).rand(5, 8).astype("float32")
+        got, = run_model(model, {"x": xb})
+        exe = static.Executor()
+        want, = exe.run(main, feed={"x": xb,
+                                    "label": np.zeros((5, 1), np.float32)},
+                        fetch_list=[pred_name])
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_export_program_rejects_malformed(self, tmp_path):
+        main, pred = _forward_program()
+        main.global_block().ops[0].input_names[0] = "ghost"
+        with pytest.raises(ProgramVerificationError, match="ghost"):
+            paddle.onnx.export_program(main, str(tmp_path / "bad"),
+                                       fetch_list=[pred],
+                                       feed_shapes={"x": (2, 8)})
+
+
+class TestFrameworkLint:
+    @pytest.fixture()
+    def lint(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import framework_lint
+        return framework_lint
+
+    def test_rules_fire_on_violations(self, lint):
+        src = (
+            "import functools, jax\n"
+            "import numpy as np\n"
+            "from paddle_tpu.utils.flags import get_flag\n"
+            "from paddle_tpu.core.dispatch import dispatch, "
+            "register_kernel\n"
+            "FROZEN = get_flag('FLAGS_use_pallas')\n"
+            "def bad(x, acc=[]):\n"
+            "    return acc\n"
+            "@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))\n"
+            "def my_op(x, axis):\n"
+            "    return x\n"
+            "def _impl(a):\n"
+            "    return float(a) + a.item() + np.asarray(a)\n"
+            "def caller(t):\n"
+            "    return dispatch('op', _impl, [t], {})\n")
+        codes = sorted(f.code for f in lint.lint_source(src, "x.py"))
+        assert codes == ["FL01", "HS01", "HS01", "HS01", "MD01", "VJ01"]
+
+    def test_impl_detection_via_register_kernel(self, lint):
+        src = ("import numpy as np\n"
+               "from paddle_tpu.core.dispatch import register_kernel\n"
+               "@register_kernel('relu', 'pallas')\n"
+               "def relu_impl(x):\n"
+               "    return np.asarray(x)\n")
+        fs = lint.lint_source(src, "x.py")
+        assert [f.code for f in fs] == ["HS01"]
+        assert fs[0].scope == "relu_impl"
+
+    def test_clean_code_passes(self, lint):
+        src = ("import jax.numpy as jnp\n"
+               "def impl(a):\n"
+               "    return jnp.maximum(a, 0)\n"
+               "def f(x, opts=None):\n"
+               "    from paddle_tpu.utils.flags import get_flag\n"
+               "    return impl(x) if get_flag('FLAGS_use_pallas') "
+               "else x\n")
+        assert lint.lint_source(src, "x.py") == []
+
+    def test_nested_def_in_impl_not_flagged(self, lint):
+        """HS01 must not scan nested function bodies against the outer
+        impl's parameter names."""
+        src = ("import numpy as np\n"
+               "from paddle_tpu.core.dispatch import dispatch\n"
+               "def _impl(a):\n"
+               "    def helper(a):\n"
+               "        return np.asarray(a)\n"
+               "    return a\n"
+               "def caller(t):\n"
+               "    return dispatch('op', _impl, [t], {})\n")
+        assert lint.lint_source(src, "x.py") == []
+
+    def test_duplicate_violations_get_distinct_keys(self, lint):
+        """A baselined violation must not mask a NEW identical one in
+        the same function: keys carry an occurrence index."""
+        src = ("import numpy as np\n"
+               "from paddle_tpu.core.dispatch import dispatch\n"
+               "def _impl(a):\n"
+               "    return a.item() + a.item()\n"
+               "def caller(t):\n"
+               "    return dispatch('op', _impl, [t], {})\n")
+        fs = lint.lint_source(src, "x.py")
+        assert len(fs) == 2 and fs[0].key() != fs[1].key()
+
+    def test_baseline_keys_are_line_stable(self, lint):
+        a = lint.lint_source("def f(x=[]):\n    return x\n", "p.py")[0]
+        b = lint.lint_source("# moved\n\ndef f(x=[]):\n    return x\n",
+                             "p.py")[0]
+        assert a.key() == b.key() and a.line != b.line
+
+    def test_repo_lints_clean_against_baseline(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "framework_lint.py")],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_new_violation_fails_ci(self, lint, tmp_path):
+        bad = tmp_path / "newmod.py"
+        bad.write_text("def f(x=[]):\n    return x\n")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "framework_lint.py"),
+             str(bad)],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 1
+        assert "MD01" in proc.stdout and "NEW" in proc.stdout

@@ -1,0 +1,377 @@
+#!/usr/bin/env python
+"""AST lint over the paddle_tpu framework itself (CI gate).
+
+The IR passes in ``paddle_tpu/static/passes`` sanitize user *programs*;
+this tool sanitizes the *framework source* — the defect classes that
+repeatedly cost debugging time on the TPU path:
+
+- ``HS01 host-sync-in-impl``: a jit-traceable op impl (a function passed
+  to ``core.dispatch.dispatch`` or decorated ``@register_kernel``) calls
+  ``.item()``, ``float()/int()/bool()``, or ``np.asarray/np.array`` on a
+  traced argument.  Under ``jax.jit``/static capture these either raise
+  a ``TracerArrayConversionError`` at trace time or, worse, silently
+  force a device->host sync per call in eager mode.
+- ``MD01 mutable-default-arg``: ``def f(x=[])`` / ``{}`` / ``set()`` —
+  shared across calls; a classic source of cross-test state bleed.
+- ``VJ01 custom-vjp-without-defvjp``: a function is wrapped in
+  ``jax.custom_vjp`` (decorator or ``functools.partial`` form) but the
+  module never calls ``<name>.defvjp(...)`` — dispatching such an op
+  raises ``CustomVJPException`` only when someone first differentiates
+  it, typically deep inside a user's training loop.
+- ``FL01 import-time-flag-read``: module-top-level ``get_flag(...)``
+  freezes the flag's value at import, so ``set_flags`` after import is
+  silently ignored for that code path.
+
+Usage::
+
+    python tools/framework_lint.py [paths...] [--baseline FILE]
+    python tools/framework_lint.py --write-baseline   # re-seed baseline
+
+Exit status is nonzero iff a finding is NOT in the baseline file
+(``tools/framework_lint_baseline.txt``) — pre-existing findings are
+suppressed explicitly so only *new* violations fail CI.  Baseline keys
+deliberately omit line numbers (``path|code|scope|detail``) so unrelated
+edits don't invalidate them.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "framework_lint_baseline.txt")
+
+_HOST_SYNC_BUILTINS = {"float", "int", "bool"}
+_NP_SYNC_FUNCS = {"asarray", "array"}
+
+
+class Finding:
+    __slots__ = ("path", "line", "code", "scope", "detail", "message",
+                 "occurrence")
+
+    def __init__(self, path, line, code, scope, detail, message):
+        self.path = path
+        self.line = line
+        self.code = code
+        self.scope = scope
+        self.detail = detail
+        self.message = message
+        self.occurrence = 0  # per-(path,code,scope,detail) index, set
+        #                      by _assign_occurrences — keeps keys unique
+        #                      so a baselined violation can't mask a NEW
+        #                      identical one added to the same function
+
+    def key(self) -> str:
+        return (f"{self.path}|{self.code}|{self.scope}|{self.detail}"
+                f"|{self.occurrence}")
+
+    def __repr__(self):
+        return (f"{self.path}:{self.line}: {self.code} [{self.scope}] "
+                f"{self.message}")
+
+
+def _call_name(func) -> str:
+    """Dotted tail of a call target: Name 'f' -> 'f', Attribute a.b.f ->
+    'a.b.f' (best effort)."""
+    parts = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _names_in(node) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_custom_vjp_decorator(dec) -> bool:
+    """@jax.custom_vjp or @functools.partial(jax.custom_vjp, ...)."""
+    name = _call_name(dec.func) if isinstance(dec, ast.Call) else \
+        _call_name(dec)
+    if name.endswith("custom_vjp"):
+        return True
+    if isinstance(dec, ast.Call) and name.endswith("partial") and dec.args:
+        return _call_name(dec.args[0]).endswith("custom_vjp")
+    return False
+
+
+def _mutable_default(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        return _call_name(node.func) in ("list", "dict", "set")
+    return False
+
+
+def _func_params(fn) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _collect_impl_functions(tree) -> Dict[str, ast.AST]:
+    """Functions that execute under jax tracing: dispatch targets
+    (2nd positional arg of a ``dispatch(...)`` call, by name or inline
+    lambda) and ``@register_kernel(...)``-decorated defs."""
+    impl_names: Set[str] = set()
+    inline: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                _call_name(node.func).split(".")[-1] == "dispatch" and \
+                len(node.args) >= 2:
+            target = node.args[1]
+            if isinstance(target, ast.Name):
+                impl_names.add(target.id)
+            elif isinstance(target, (ast.Lambda,)):
+                inline.append(target)
+    impls: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in impl_names:
+                impls.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                dname = _call_name(dec.func) if isinstance(dec, ast.Call) \
+                    else _call_name(dec)
+                if dname.split(".")[-1] == "register_kernel":
+                    impls.setdefault(node.name, node)
+    for i, lam in enumerate(inline):
+        impls[f"<lambda#{i}>"] = lam
+    return impls
+
+
+def _walk_skipping_defs(root):
+    """ast.walk that does NOT descend into nested function defs — their
+    bodies have their own parameter scope (and their own HS01 run if
+    they are dispatched themselves)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
+
+
+def _lint_host_sync(path, scope, fn, out: List[Finding]):
+    params = _func_params(fn)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested def at statement level: own scope
+        for node in _walk_skipping_defs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = _call_name(node.func)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                out.append(Finding(
+                    path, node.lineno, "HS01", scope, "item",
+                    "`.item()` inside a jit-traceable op impl forces a "
+                    "device->host sync / fails under tracing"))
+                continue
+            tail = cname.split(".")
+            if len(tail) >= 2 and tail[-2] in ("np", "numpy") and \
+                    tail[-1] in _NP_SYNC_FUNCS and node.args:
+                touched = _names_in(node.args[0]) & params
+                if touched:
+                    out.append(Finding(
+                        path, node.lineno, "HS01", scope,
+                        f"np.{tail[-1]}:{sorted(touched)[0]}",
+                        f"`np.{tail[-1]}` on traced arg "
+                        f"'{sorted(touched)[0]}' materialises on host "
+                        "(use jnp, or mark the op __shape_probed__)"))
+            elif cname in _HOST_SYNC_BUILTINS and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in params:
+                out.append(Finding(
+                    path, node.lineno, "HS01", scope,
+                    f"{cname}:{node.args[0].id}",
+                    f"`{cname}()` on traced arg '{node.args[0].id}' "
+                    "raises ConcretizationTypeError under jit"))
+
+
+def _module_level_nodes(tree):
+    """Every AST node whose code runs at import time: module/class-body
+    statements and their sub-expressions, plus function decorators and
+    default-arg expressions — but never the inside of a function body."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # the def statement's decorators and defaults evaluate at
+            # import; the body does not
+            stack.extend(node.decorator_list)
+            stack.extend(d for d in node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def lint_source(src: str, path: str) -> List[Finding]:
+    tree = ast.parse(src)
+    findings: List[Finding] = []
+
+    # HS01 — host syncs inside jit-traceable impls
+    for name, fn in _collect_impl_functions(tree).items():
+        _lint_host_sync(path, name, fn, findings)
+
+    # MD01 — mutable default args (whole file)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for default in list(node.args.defaults) + \
+                [d for d in node.args.kw_defaults if d is not None]:
+            if _mutable_default(default):
+                kind = type(default).__name__.lower() \
+                    if not isinstance(default, ast.Call) \
+                    else _call_name(default.func)
+                findings.append(Finding(
+                    path, default.lineno, "MD01", node.name, kind,
+                    f"mutable default argument ({kind}) is shared "
+                    "across calls — default to None and construct "
+                    "inside"))
+
+    # VJ01 — custom_vjp without defvjp
+    vjp_defs: Dict[str, int] = {}
+    defvjp_called: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_custom_vjp_decorator(d)
+                   for d in node.decorator_list):
+                vjp_defs[node.name] = node.lineno
+        elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call) and _call_name(
+                node.value.func).endswith("custom_vjp"):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    vjp_defs[tgt.id] = node.lineno
+        elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and node.func.attr == "defvjp":
+            base = node.func.value
+            if isinstance(base, ast.Name):
+                defvjp_called.add(base.id)
+            elif isinstance(base, ast.Attribute):
+                defvjp_called.add(base.attr)
+    for name, line in sorted(vjp_defs.items()):
+        if name not in defvjp_called:
+            findings.append(Finding(
+                path, line, "VJ01", name, "defvjp",
+                f"'{name}' is wrapped in jax.custom_vjp but this module "
+                "never calls its .defvjp(fwd, bwd) — differentiating "
+                "the op will raise at first use"))
+
+    # FL01 — import-time flag reads
+    for node in _module_level_nodes(tree):
+        if isinstance(node, ast.Call) and \
+                _call_name(node.func).split(".")[-1] == "get_flag":
+            arg = node.args[0].value if node.args and isinstance(
+                node.args[0], ast.Constant) else "?"
+            findings.append(Finding(
+                path, node.lineno, "FL01", "<module>", str(arg),
+                f"get_flag({arg!r}) at import time freezes the "
+                "value — read it inside the function that needs it"))
+    return _assign_occurrences(findings)
+
+
+def _assign_occurrences(findings: List[Finding]) -> List[Finding]:
+    counts: Dict[str, int] = {}
+    for f in sorted(findings, key=lambda f: f.line):
+        base = f"{f.path}|{f.code}|{f.scope}|{f.detail}"
+        f.occurrence = counts.get(base, 0)
+        counts[base] = f.occurrence + 1
+    return findings
+
+
+def lint_paths(paths: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for root in paths:
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        for f in sorted(files):
+            rel = os.path.relpath(f, REPO)
+            try:
+                with open(f, encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError as e:
+                print(f"framework_lint: cannot read {rel}: {e}",
+                      file=sys.stderr)
+                continue
+            try:
+                findings.extend(lint_source(src, rel))
+            except SyntaxError as e:
+                findings.append(Finding(rel, e.lineno or 0, "SYN", "?",
+                                        "syntax", f"syntax error: {e}"))
+    return findings
+
+
+def load_baseline(path: str) -> Set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        return {line.strip() for line in f
+                if line.strip() and not line.startswith("#")}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO, "paddle_tpu")])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-seed the suppression list from the current "
+                         "findings (reviewed, never automatic in CI)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding (exit 1 if any)")
+    args = ap.parse_args(argv)
+
+    findings = lint_paths(args.paths)
+    if args.write_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write("# framework_lint baseline — pre-existing findings "
+                    "suppressed in CI.\n# Regenerate (after review!) "
+                    "with: python tools/framework_lint.py "
+                    "--write-baseline\n")
+            for k in sorted({fi.key() for fi in findings}):
+                f.write(k + "\n")
+        print(f"framework_lint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(args.baseline, REPO)}")
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    new = [f for f in findings if f.key() not in baseline]
+    for f in findings:
+        tag = "" if f.key() in baseline else "  <-- NEW"
+        print(f"{f!r}{tag}")
+    print(f"framework_lint: {len(findings)} finding(s), "
+          f"{len(findings) - len(new)} baseline-suppressed, "
+          f"{len(new)} new")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,4 +10,12 @@ cd "$(dirname "$0")/.."
 # number reports through, so a broken tracer fails the sweep in seconds
 # instead of after the slow tier (the full run below includes it again).
 python -m pytest tests/test_profiler.py -q
+# Static-analysis gates: (1) the framework AST linter must stay clean
+# against its baseline (tools/framework_lint_baseline.txt — new
+# findings fail, pre-existing ones are suppressed explicitly); (2) the
+# verifier-on-golden-programs check — test_passes.py mutates the golden
+# programs from test_static_graph.py and asserts every defect class is
+# caught with the op and var named.
+python tools/framework_lint.py
+python -m pytest tests/test_passes.py -q
 exec python -m pytest tests/ -q --runslow "$@"
