@@ -1,4 +1,4 @@
-"""Distributed sharded checkpointing.
+"""Distributed sharded checkpointing — verified and atomic.
 
 Reference parity: ``python/paddle/framework/io.py:553,769``
 (paddle.save/load) + the hybrid-parallel save/load flows
@@ -9,14 +9,28 @@ TPU-first (SURVEY §5): checkpoints are *sharded by the mesh* — each host
 writes only the array shards it owns, restore re-places shards onto the
 (possibly different) target mesh — and writes are async so training
 continues while the previous step's state flushes.  Orbax provides the
-storage engine (OCDBT + tensorstore); this module adapts it to the
-framework's (params, buffers, opt_state) world and to nn.Layer /
-Optimizer objects.
+storage engine; this module adapts it to the framework's
+(params, buffers, opt_state) world and to nn.Layer / Optimizer objects.
+
+Fault-tolerance layer (Check-N-Run, Eisenman et al., NSDI'22): every
+committed checkpoint carries a per-file checksum manifest
+(``_paddle_manifest.json``) plus step/framework metadata, and commits
+atomically — write to a temp dir, fsync, rename into place, then drop a
+``_PADDLE_COMMITTED`` marker.  ``load_state(verify=True)`` re-hashes the
+tree and rejects torn or corrupt checkpoints with
+:class:`CheckpointCorruptError`; :class:`AsyncCheckpointer.restore`
+quarantines corrupt steps and falls back to the newest intact one, and
+its GC never deletes the last verified step.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import shutil
 import threading
+import time
+import warnings
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -24,11 +38,25 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..utils import chaos as _chaos
+from ..utils import resilience as _resilience
+from ..profiler import metrics as _metrics
+
 __all__ = ["save_state", "load_state", "save_layer", "load_layer",
-           "AsyncCheckpointer", "wait_all"]
+           "AsyncCheckpointer", "wait_all", "verify_checkpoint",
+           "checkpoint_metadata", "CheckpointCorruptError",
+           "MANIFEST_NAME", "COMMITTED_NAME"]
+
+MANIFEST_NAME = "_paddle_manifest.json"
+COMMITTED_NAME = "_PADDLE_COMMITTED"
 
 _pending = []
 _plock = threading.Lock()
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint tree failed verification (torn write, flipped bytes,
+    truncated file, or missing manifest/commit marker)."""
 
 
 def _ocp():
@@ -36,50 +64,284 @@ def _ocp():
     return ocp
 
 
+# ---------------------------------------------------------------------------
+# manifest + atomic commit
+# ---------------------------------------------------------------------------
+def _hash_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_file(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems reject fsync on directories
+    finally:
+        os.close(fd)
+
+
+def _walk_files(root: str):
+    for base, _dirs, files in os.walk(root):
+        for name in files:
+            if name in (MANIFEST_NAME, COMMITTED_NAME):
+                continue
+            full = os.path.join(base, name)
+            yield os.path.relpath(full, root), full
+
+
+def _write_manifest(root: str, step: Optional[int]) -> str:
+    """Hash every data file under ``root`` and write the manifest.
+    Returns the manifest's own sha256 (recorded in the commit marker)."""
+    files = {}
+    for rel, full in sorted(_walk_files(root)):
+        files[rel] = {"size": os.path.getsize(full),
+                      "sha256": _hash_file(full)}
+        _fsync_file(full)  # data durable before the manifest claims it
+    manifest = {
+        "format": 1,
+        "framework": "paddle_tpu",
+        "step": None if step is None else int(step),
+        "created": time.time(),
+        "files": files,
+    }
+    mpath = os.path.join(root, MANIFEST_NAME)
+    blob = json.dumps(manifest, indent=1, sort_keys=True).encode()
+    with open(mpath, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _commit(tmp: str, final: str, *, step: Optional[int],
+            overwrite: bool):
+    """tmp dir -> fsync -> rename -> COMMITTED marker (the atomic-commit
+    sequence; a crash at any point leaves either the old checkpoint, an
+    intact tree stranded at ``final + '.old'``, or a detectably-
+    uncommitted tree — never a silently torn one).  When several
+    processes race the commit of one shared tree (multi-host writers on
+    a shared filesystem), the first rename wins and the losers return
+    once they see the winner's marker."""
+    manifest_sha = _write_manifest(tmp, step)
+    _fsync_dir(tmp)
+    aside = None
+    if os.path.exists(final):
+        if not overwrite:
+            raise FileExistsError(final)
+        aside = final + ".old"
+        if os.path.exists(aside):
+            shutil.rmtree(aside, ignore_errors=True)
+        os.rename(final, aside)
+    try:
+        os.rename(tmp, final)
+    except OSError:
+        if os.path.exists(os.path.join(final, COMMITTED_NAME)):
+            return   # concurrent committer won the rename race
+        if aside is not None and not os.path.exists(final):
+            os.rename(aside, final)   # roll the old tree back in
+        raise
+    _fsync_dir(os.path.dirname(final))
+    if aside is not None:
+        shutil.rmtree(aside, ignore_errors=True)
+    # between the rename above and the marker below is the torn window a
+    # verified load must detect; both hooks let tests/chaos cut it open
+    _resilience.fail_point("ckpt.commit")
+    if _chaos.active:
+        _chaos.hit("ckpt.write")
+    marker = {"step": None if step is None else int(step),
+              "manifest_sha256": manifest_sha,
+              "committed": time.time()}
+    mpath = os.path.join(final, COMMITTED_NAME)
+    with open(mpath, "w") as f:
+        json.dump(marker, f)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(final)
+
+
+def verify_checkpoint(path: str) -> Dict[str, Any]:
+    """Re-hash a checkpoint tree against its manifest.  Returns the
+    manifest dict; raises :class:`CheckpointCorruptError` naming the
+    first offending file (and counts ``ckpt.verify_fail``)."""
+    path = os.path.abspath(path)
+
+    def _fail(reason):
+        _metrics.counter("ckpt.verify_fail",
+                         "checkpoints rejected by manifest "
+                         "verification").inc()
+        raise CheckpointCorruptError(f"checkpoint {path}: {reason}")
+
+    if not os.path.isdir(path):
+        _fail("not a directory")
+    if not os.path.exists(os.path.join(path, COMMITTED_NAME)):
+        _fail(f"no {COMMITTED_NAME} marker (interrupted commit)")
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        _fail(f"missing {MANIFEST_NAME}")
+    try:
+        with open(mpath, "rb") as f:
+            manifest_blob = f.read()
+        manifest = json.loads(manifest_blob)
+    except (OSError, json.JSONDecodeError) as e:
+        _fail(f"unreadable manifest ({e})")
+    # the commit marker pins the manifest's own hash: a manifest that was
+    # rewritten (or copied in from another step) after commit is caught
+    # here even when its entries are self-consistent
+    try:
+        with open(os.path.join(path, COMMITTED_NAME)) as f:
+            marker = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        _fail(f"unreadable {COMMITTED_NAME} marker ({e})")
+    expect = marker.get("manifest_sha256")
+    if expect and hashlib.sha256(manifest_blob).hexdigest() != expect:
+        _fail("manifest does not match the hash recorded at commit "
+              "(manifest tampered or replaced)")
+    for rel, meta in manifest.get("files", {}).items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            _fail(f"missing file {rel!r}")
+        size = os.path.getsize(full)
+        if size != meta["size"]:
+            _fail(f"file {rel!r} truncated/resized "
+                  f"({size} bytes, manifest says {meta['size']})")
+        if _hash_file(full) != meta["sha256"]:
+            _fail(f"file {rel!r} checksum mismatch (flipped bytes)")
+    return manifest
+
+
+def checkpoint_metadata(path: str) -> Optional[Dict[str, Any]]:
+    """The manifest's step/framework metadata, or None if absent."""
+    mpath = os.path.join(os.path.abspath(path), MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return {k: manifest.get(k)
+            for k in ("step", "framework", "format", "created")}
+
+
+# ---------------------------------------------------------------------------
+# save / load
+# ---------------------------------------------------------------------------
+def _tmp_path(path: str) -> str:
+    """Stable (pid-free) tmp name: a multi-host coordinated orbax write
+    must land every process's shards in ONE tree, so all processes have
+    to agree on the path.  Same-path writers within one process are
+    serialized by :func:`save_state` flushing a pending async save that
+    holds the tmp before starting a new one."""
+    tmp = f"{path}.tmp-commit"
+    try:
+        # clear a leftover from a crashed earlier attempt, but never a
+        # tree a concurrent (multi-host) writer is actively filling
+        if time.time() - os.path.getmtime(tmp) > 60.0:
+            shutil.rmtree(tmp, ignore_errors=True)
+    except OSError:
+        pass
+    return tmp
+
+
 def save_state(path: str, tree: Dict[str, Any], *, overwrite: bool = True,
-               use_async: bool = False):
-    """Save a pytree of (possibly sharded) jax arrays.
+               use_async: bool = False, step: Optional[int] = None):
+    """Save a pytree of (possibly sharded) jax arrays with a verified
+    atomic commit.
 
     Each process writes its own shards (multi-host safe); with
     ``use_async`` the write happens in the background — call
-    :func:`wait_all` (or save again) to join."""
+    :func:`wait_all` (which finalizes the commit) to join."""
     ocp = _ocp()
     path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     tree = jax.tree.map(
         lambda a: a._data if hasattr(a, "_data") else a, tree)
+    if not overwrite and os.path.exists(path):
+        raise FileExistsError(path)
+    _flush_pending(path)   # a prior async save to this path must land
+    tmp = _tmp_path(path)  # first — the commit tmp tree is shared
     if use_async:
         ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
-        ckptr.save(path, args=ocp.args.StandardSave(tree), force=overwrite)
+        ckptr.save(tmp, args=ocp.args.StandardSave(tree), force=True)
         with _plock:
-            _pending.append(ckptr)
+            _pending.append((ckptr, tmp, path, step, overwrite))
         return ckptr
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, tree, force=overwrite)
+    ckptr.save(tmp, tree, force=True)
     # StandardCheckpointer finalizes on a background thread — join it so
     # "sync" save really means the checkpoint is on disk
     ckptr.wait_until_finished()
     ckptr.close()
+    _commit(tmp, path, step=step, overwrite=overwrite)
     return None
 
 
+def _finalize(entry):
+    ckptr, tmp, path, step, overwrite = entry
+    ckptr.wait_until_finished()
+    _commit(tmp, path, step=step, overwrite=overwrite)
+
+
+def _flush_pending(path: str):
+    """Land any pending async save targeting ``path`` before a new save
+    reuses its commit tmp tree."""
+    with _plock:
+        mine = [e for e in _pending if e[2] == path]
+        _pending[:] = [e for e in _pending if e[2] != path]
+    for entry in mine:
+        _finalize(entry)
+
+
 def wait_all():
-    """Block until every async save has landed (reference: the barrier
-    before PS-table snapshot completion)."""
+    """Block until every async save has landed AND committed (reference:
+    the barrier before PS-table snapshot completion).  One failing
+    commit never strands the others: every pending save is finalized
+    and the first error re-raised afterwards."""
     with _plock:
         pending, _pending[:] = list(_pending), []
-    for c in pending:
-        c.wait_until_finished()
+    first_err = None
+    for entry in pending:
+        try:
+            _finalize(entry)
+        except BaseException as e:  # noqa: BLE001 — finalize the rest
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
 
 
 def load_state(path: str, template: Optional[Dict[str, Any]] = None,
-               shardings: Optional[Dict[str, Any]] = None):
+               shardings: Optional[Dict[str, Any]] = None, *,
+               verify: bool = False):
     """Restore a pytree.  `template` (a matching pytree of arrays or
     ShapeDtypeStructs) drives dtype/shape; `shardings` (same structure of
     NamedSharding) re-places shards onto the target mesh — pass the
     current mesh's shardings to restore a checkpoint written on a
-    different topology (elastic resume)."""
+    different topology (elastic resume).
+
+    With ``verify=True`` the tree is checked against its checksum
+    manifest first and torn/corrupt checkpoints raise
+    :class:`CheckpointCorruptError` instead of loading garbage."""
     ocp = _ocp()
     path = os.path.abspath(path)
+    if verify:
+        verify_checkpoint(path)
     ckptr = ocp.StandardCheckpointer()
     if template is None:
         return ckptr.restore(path)
@@ -95,7 +357,8 @@ def load_state(path: str, template: Optional[Dict[str, Any]] = None,
     return ckptr.restore(path, tpl)
 
 
-def save_layer(path: str, layer, optimizer=None, *, use_async: bool = False):
+def save_layer(path: str, layer, optimizer=None, *, use_async: bool = False,
+               step: Optional[int] = None):
     """Checkpoint an nn.Layer (+ optionally its optimizer functional
     state) with whatever mesh placements the arrays carry."""
     params, buffers = layer.functional_state()
@@ -103,10 +366,11 @@ def save_layer(path: str, layer, optimizer=None, *, use_async: bool = False):
     if optimizer is not None and getattr(optimizer, "_fn_state", None) \
             is not None:
         tree["opt"] = optimizer._fn_state
-    return save_state(path, tree, use_async=use_async)
+    return save_state(path, tree, use_async=use_async, step=step)
 
 
-def load_layer(path: str, layer, optimizer=None, *, mesh=None):
+def load_layer(path: str, layer, optimizer=None, *, mesh=None,
+               verify: bool = False):
     """Restore into a live nn.Layer.  With `mesh`, parameters are
     re-placed by their `placements` dist attrs (topology-change resume)."""
     params, buffers = layer.functional_state()
@@ -122,55 +386,207 @@ def load_layer(path: str, layer, optimizer=None, *, mesh=None):
         rep = NamedSharding(mesh, P())
         shardings = jax.tree.map(lambda a: rep, tree)
         shardings["params"] = psh
-    restored = load_state(path, tree, shardings)
+    restored = load_state(path, tree, shardings, verify=verify)
     layer.load_functional_state(restored["params"], restored["buffers"])
     if optimizer is not None and "opt" in restored:
         optimizer._fn_state = restored["opt"]
     return restored
 
 
+# ---------------------------------------------------------------------------
+# step-managed async checkpointing
+# ---------------------------------------------------------------------------
 class AsyncCheckpointer:
-    """Step-managed async checkpointing (orbax CheckpointManager):
-    keep-N rotation + async writes — the hapi ModelCheckpoint callback
-    (reference hapi/callbacks.py:533) upgraded to sharded async."""
+    """Step-managed async checkpointing: keep-N rotation + background
+    writes + verified restore — the hapi ModelCheckpoint callback
+    (reference hapi/callbacks.py:533) upgraded to fault tolerance.
+
+    Layout: ``directory/<step>/`` per step, each a committed
+    :func:`save_state` tree.  ``save`` snapshots the arrays to host in
+    the caller's thread (so donated device buffers can't be invalidated
+    mid-write) and commits on a single background writer; a failed
+    write is counted (``ckpt.write_fail``) and warned, never raised
+    into the training loop — the step simply doesn't commit and the
+    previous intact one remains restorable.
+
+    ``restore()`` walks steps newest-first, quarantines any that fail
+    verification (``directory/_quarantine/<step>``, counted as
+    ``ckpt.quarantined``) and loads the newest intact tree.  GC keeps
+    ``max_to_keep`` committed steps and never deletes the last one.
+    """
+
+    QUARANTINE = "_quarantine"
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1):
-        ocp = _ocp()
-        self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                save_interval_steps=save_interval_steps,
-                enable_async_checkpointing=True))
+        from concurrent.futures import ThreadPoolExecutor
+        _ocp()   # pay the lazy orbax import at construction, NOT inside
+        # the first background write — a gang killed seconds into
+        # training must already have commits on disk
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._max_to_keep = max(1, int(max_to_keep))
+        self._interval = max(1, int(save_interval_steps))
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="paddle-ckpt")
+        self._futures = []
+        self._last_requested: Optional[int] = None
+        self.last_error: Optional[BaseException] = None
 
+    # -- paths -------------------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
+    def _step_dirs(self):
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        return sorted(int(n) for n in names if n.isdigit())
+
+    def _committed_steps(self):
+        return [s for s in self._step_dirs()
+                if os.path.exists(os.path.join(self._step_dir(s),
+                                               COMMITTED_NAME))]
+
+    # -- write path --------------------------------------------------------
     def save(self, step: int, tree: Dict[str, Any]) -> bool:
-        ocp = _ocp()
-        tree = jax.tree.map(
-            lambda a: a._data if hasattr(a, "_data") else a, tree)
-        return self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        """Queue an async save of ``tree`` at ``step``.  Returns False
+        (and writes nothing) inside the save-interval window."""
+        step = int(step)
+        if self._last_requested is not None and \
+                step - self._last_requested < self._interval:
+            return False
+        self._last_requested = step
+        # prune completed futures so a million-step run doesn't hold a
+        # million dead Future objects until wait_until_finished
+        self._futures = [f for f in self._futures if not f.done()]
+        # host snapshot NOW, with an owned copy: the train step may
+        # donate these buffers on its next invocation, and np.asarray
+        # can alias a CPU jax buffer zero-copy — the background writer
+        # must never read loop-owned device memory
+        def snapshot(a):
+            a = a._data if hasattr(a, "_data") else a
+            if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                # a host-local copy of a multi-host array is impossible;
+                # route sharded state through save_state (each process
+                # writes its own shards) instead of per-step rotation
+                raise TypeError(
+                    "AsyncCheckpointer.save got a non-fully-addressable "
+                    "(multi-host sharded) array; use "
+                    "checkpoint.save_state for coordinated sharded "
+                    "writes")
+            return np.array(a, copy=True)
+        host = jax.tree.map(snapshot, tree)
+        self._futures.append(self._pool.submit(self._write, step, host))
+        return True
+
+    def _write(self, step: int, tree):
+        try:
+            save_state(self._step_dir(step), tree, overwrite=True,
+                       step=step)
+            self._gc()
+        except BaseException as e:  # noqa: BLE001 — writer must survive
+            self.last_error = e
+            _metrics.counter("ckpt.write_fail",
+                             "async checkpoint writes that failed "
+                             "before commit").inc()
+            warnings.warn(f"checkpoint save for step {step} failed "
+                          f"({e!r}); the previous intact step remains "
+                          f"restorable")
+
+    def _gc(self):
+        """Rotate committed steps down to ``max_to_keep`` and clear
+        torn leftovers older than the newest commit.  The newest
+        committed step is never deleted — max_to_keep has a floor of 1,
+        and only the oldest entries go."""
+        committed = self._committed_steps()
+        victims = committed[:-self._max_to_keep] if \
+            len(committed) > self._max_to_keep else []
+        for s in victims:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        if committed:
+            newest = committed[-1]
+            for s in self._step_dirs():
+                if s < newest and s not in committed:
+                    # uncommitted torn tree shadowed by a newer intact
+                    # step: it will never be restored, drop it
+                    shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        # orphaned tmp/aside trees from a process killed mid-write (the
+        # supervisor's whole job) would otherwise leak one checkpoint
+        # of disk per relaunch.  Age-gated: a FRESH tmp tree may be a
+        # concurrent writer's in-flight save, so only clearly-abandoned
+        # ones (no write activity for minutes) go.  Our own in-flight
+        # tmp can't be present — GC runs on the single writer thread
+        # after its commit completes.
+        now = time.time()
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            names = []
+        for name in names:
+            if ".tmp-commit" not in name and not name.endswith(".old"):
+                continue
+            full = os.path.join(self.directory, name)
+            try:
+                if now - os.path.getmtime(full) > 300.0:
+                    shutil.rmtree(full, ignore_errors=True)
+            except OSError:
+                pass
+
+    # -- read path ---------------------------------------------------------
+    def _quarantine(self, step: int, err: BaseException):
+        qroot = os.path.join(self.directory, self.QUARANTINE)
+        os.makedirs(qroot, exist_ok=True)
+        dst = os.path.join(qroot, str(step))
+        if os.path.exists(dst):
+            shutil.rmtree(dst, ignore_errors=True)
+        try:
+            os.rename(self._step_dir(step), dst)
+        except OSError:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)
+        _metrics.counter("ckpt.quarantined",
+                         "corrupt checkpoint steps moved aside by "
+                         "restore").inc()
+        warnings.warn(f"checkpoint step {step} failed verification "
+                      f"({err}); quarantined under {qroot}")
 
     def restore(self, step: Optional[int] = None,
-                template: Optional[Dict[str, Any]] = None):
-        ocp = _ocp()
-        step = self._mgr.latest_step() if step is None else step
-        if template is None:
-            return self._mgr.restore(step)
-        tpl = jax.tree.map(
-            lambda a: a._data if hasattr(a, "_data") else a, template)
-        tpl = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tpl)
-        return self._mgr.restore(step,
-                                 args=ocp.args.StandardRestore(tpl))
+                template: Optional[Dict[str, Any]] = None,
+                shardings: Optional[Dict[str, Any]] = None, *,
+                verify: bool = True):
+        """Restore ``step`` (or, when None, the newest step that passes
+        verification — corrupt/torn steps are quarantined and skipped).
+        Raises :class:`CheckpointCorruptError` when nothing intact
+        remains."""
+        if step is not None:
+            return load_state(self._step_dir(step), template, shardings,
+                              verify=verify)
+        candidates = sorted(self._step_dirs(), reverse=True)
+        for s in candidates:
+            if verify:
+                try:
+                    verify_checkpoint(self._step_dir(s))
+                except CheckpointCorruptError as e:
+                    self._quarantine(s, e)
+                    continue
+            return load_state(self._step_dir(s), template, shardings,
+                              verify=False)
+        raise CheckpointCorruptError(
+            f"no intact checkpoint under {self.directory}")
 
-    def latest_step(self):
-        return self._mgr.latest_step()
+    def latest_step(self) -> Optional[int]:
+        committed = self._committed_steps()
+        return committed[-1] if committed else None
 
     def all_steps(self):
-        return list(self._mgr.all_steps())
+        return self._committed_steps()
 
     def wait_until_finished(self):
-        self._mgr.wait_until_finished()
+        futures, self._futures = self._futures, []
+        for f in futures:
+            f.result()  # _write never raises; .result() just joins
 
     def close(self):
-        self._mgr.close()
+        self.wait_until_finished()
+        self._pool.shutdown(wait=True)

@@ -23,6 +23,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ....utils import chaos as _chaos
+from ....utils import resilience as _resilience
+
 ELASTIC_EXIT_CODE = 101  # keep in sync with distributed/launch.py
 
 __all__ = ["ELASTIC_EXIT_CODE", "ElasticStatus", "ElasticManager",
@@ -268,14 +271,30 @@ class KVServer:
 
 
 class TCPStore(Store):
-    """Store client for a :class:`KVServer` endpoint ("host:port")."""
+    """Store client for a :class:`KVServer` endpoint ("host:port").
 
-    def __init__(self, endpoint: str, timeout: float = 10.0):
+    ``_call`` retries refused connections and socket timeouts with
+    bounded exponential backoff: during a KVServer restart window (the
+    coordinator host relaunching, reference etcd leader churn) clients
+    ride through instead of failing the heartbeat/rendezvous on the
+    first ECONNREFUSED.  Requests are idempotent KV ops, so a retried
+    call that already landed server-side is harmless."""
+
+    def __init__(self, endpoint: str, timeout: float = 10.0,
+                 retries: int = 5, retry_base_delay: float = 0.05):
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self._timeout = timeout
+        self._call = _resilience.retry(
+            retry_on=(ConnectionRefusedError, ConnectionResetError,
+                      ConnectionAbortedError, socket.timeout,
+                      TimeoutError),
+            max_tries=max(1, retries), base_delay=retry_base_delay,
+            max_delay=1.0, deadline=3.0 * timeout)(self._call_once)
 
-    def _call(self, req: dict):
+    def _call_once(self, req: dict):
+        if _chaos.active:
+            _chaos.hit("store.rpc", exc=ConnectionRefusedError)
         data = json.dumps(req).encode() + b"\n"
         if len(data) > _KV_MAX_LINE:
             raise ValueError(f"KV request of {len(data)} bytes exceeds "

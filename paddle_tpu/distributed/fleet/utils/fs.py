@@ -11,18 +11,28 @@ raises UnavailableError with a clear message instead of half-working.
 """
 from __future__ import annotations
 
+import errno
 import os
 import shutil
 import subprocess
 from typing import List, Tuple
 
 from ....core.errors import UnavailableError
+from ....utils import chaos as _chaos
+from ....utils import resilience as _resilience
 
-__all__ = ["FS", "LocalFS", "HDFSClient"]
+__all__ = ["FS", "LocalFS", "HDFSClient", "ExecuteError"]
 
 
 class ExecuteError(RuntimeError):
-    pass
+    """A shell-out failed.  Carries ``returncode``/``stderr`` so retry
+    policies can classify transient failures (connection refused,
+    timeouts) apart from permanent ones (file not found)."""
+
+    def __init__(self, msg, returncode: int = None, stderr: str = ""):
+        super().__init__(msg)
+        self.returncode = returncode
+        self.stderr = stderr
 
 
 class FS:
@@ -67,6 +77,8 @@ class LocalFS(FS):
         os.makedirs(path, exist_ok=True)
 
     def rename(self, src, dst):
+        if _chaos.active:
+            _chaos.hit("fs.rename", exc=OSError)
         os.rename(src, dst)
 
     def delete(self, path):
@@ -95,14 +107,52 @@ class LocalFS(FS):
         with open(path, "a"):
             pass
 
+    @staticmethod
+    def _rename_any(src, dst):
+        """os.rename with a cross-device fallback (the only case where
+        the move can't be a single atomic syscall)."""
+        try:
+            os.rename(src, dst)
+        except OSError as e:
+            if e.errno != errno.EXDEV:
+                raise
+            shutil.move(src, dst)
+
     def mv(self, src, dst, overwrite=False, test_exists=True):
+        """Move with an *atomic* overwrite: no delete-then-rename window
+        in which a crash (or a concurrent reader) sees the destination
+        missing.  Files go through ``os.replace``; an existing directory
+        is renamed aside first, the source renamed in, then the aside
+        copy dropped — a crash mid-sequence leaves either the old or the
+        new tree at ``dst``, never neither."""
         if test_exists and not self.is_exist(src):
             raise FileNotFoundError(src)
-        if self.is_exist(dst):
-            if not overwrite:
-                raise FileExistsError(dst)
-            self.delete(dst)
-        shutil.move(src, dst)
+        if _chaos.active:
+            _chaos.hit("fs.rename", exc=OSError)
+        if not self.is_exist(dst):
+            self._rename_any(src, dst)
+            return
+        if not overwrite:
+            raise FileExistsError(dst)
+        if os.path.isdir(dst):
+            aside = f"{dst}.old.{os.getpid()}"
+            if os.path.exists(aside):
+                shutil.rmtree(aside, ignore_errors=True)
+            os.rename(dst, aside)
+            try:
+                self._rename_any(src, dst)
+            except BaseException:
+                os.rename(aside, dst)   # roll the old tree back in
+                raise
+            _resilience.fail_point("fs.mv.post_swap")
+            shutil.rmtree(aside, ignore_errors=True)
+        else:
+            try:
+                os.replace(src, dst)    # atomic same-fs file swap
+            except OSError as e:
+                if e.errno != errno.EXDEV:
+                    raise
+                shutil.move(src, dst)
 
     def list_dirs(self, path) -> List[str]:
         return self.ls_dir(path)[0]
@@ -124,14 +174,42 @@ class HDFSClient(FS):
     back to LocalFS (the reference raises ExecuteError on a missing
     binary the same way)."""
 
+    # exit codes / stderr signatures worth retrying: a hadoop shell-out
+    # dies with 255 on RPC-level connection failures, and transient
+    # namenode churn surfaces as these stderr phrases with generic codes
+    _TRANSIENT_EXIT_CODES = frozenset({255})
+    _TRANSIENT_STDERR = ("connection refused", "connection reset",
+                         "timed out", "connecttimeout", "retry",
+                         "safe mode", "temporarily unavailable")
+
+    @classmethod
+    def _is_transient(cls, exc: BaseException) -> bool:
+        if not isinstance(exc, ExecuteError):
+            return False
+        if exc.returncode in cls._TRANSIENT_EXIT_CODES:
+            return True
+        err = (exc.stderr or "").lower()
+        return any(sig in err for sig in cls._TRANSIENT_STDERR)
+
     def __init__(self, hadoop_home=None, configs=None,
-                 time_out=5 * 60 * 1000, sleep_inter=1000):
+                 time_out=5 * 60 * 1000, sleep_inter=1000,
+                 retry_times=8):
         self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
             if hadoop_home else "hadoop"
         self._configs = configs or {}
         self._available = shutil.which(self._hadoop) is not None
+        # reference fs.py _handle_errors(max_time_out): shell-outs retry
+        # until the ms deadline with sleep_inter ms between attempts —
+        # but ONLY for transient failures (classified above); a clean
+        # nonzero like `-test -e` on a missing path raises immediately
+        self._run = _resilience.retry(
+            retry_on=(ExecuteError,), classify=self._is_transient,
+            max_tries=max(1, int(retry_times)),
+            base_delay=sleep_inter / 1000.0,
+            max_delay=max(1.0, sleep_inter / 1000.0 * 4),
+            deadline=time_out / 1000.0)(self._run_once)
 
-    def _run(self, *args) -> str:
+    def _run_once(self, *args) -> str:
         if not self._available:
             raise UnavailableError(
                 "UNAVAILABLE: no `hadoop` binary on PATH — the zero-"
@@ -143,7 +221,8 @@ class HDFSClient(FS):
         cmd += list(args)
         r = subprocess.run(cmd, capture_output=True, text=True)
         if r.returncode != 0:
-            raise ExecuteError(f"{' '.join(cmd)}: {r.stderr[-500:]}")
+            raise ExecuteError(f"{' '.join(cmd)}: {r.stderr[-500:]}",
+                               returncode=r.returncode, stderr=r.stderr)
         return r.stdout
 
     def ls_dir(self, path):

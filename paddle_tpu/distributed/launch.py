@@ -11,10 +11,22 @@ coordinator address + process id; ``--nproc`` > 1 on a single machine is
 the CPU-simulation path, where each process gets an
 ``xla_force_host_platform_device_count`` virtual mesh for test parity
 (reference TestDistBase's localhost multi-process cluster).
+
+Supervisor mode (``--supervise``, TorchElastic-style): the launcher
+heartbeats workers through the elastic ``Store`` (workers put TTL'd
+step counters under ``/paddle/supervise/<job>/<rank>`` — hapi
+``Model.fit`` does this automatically when ``PADDLE_SUPERVISE_STORE``
+is set), detects both crashes (nonzero exit) and hung steps (no
+heartbeat advance within ``FLAGS_watchdog_timeout``), kills the gang,
+bumps ``PADDLE_RESTART_GENERATION``, and relaunches up to
+``--max_restarts`` times.  Workers are expected to resume from the
+newest intact checkpoint (``AsyncCheckpointer.restore``), so a restart
+costs re-execution since the last commit, not the whole run.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import shlex
 import signal
@@ -24,6 +36,8 @@ import time
 
 # single source of truth for the relaunch protocol
 from .fleet.elastic.manager import ELASTIC_EXIT_CODE  # noqa: E402
+
+SUPERVISE_PREFIX = "/paddle/supervise/"
 
 
 def _parse_args(argv=None):
@@ -50,9 +64,27 @@ def _parse_args(argv=None):
                         "elastic store (PADDLE_ELASTIC_STORE_ROOT), like "
                         "the reference's etcd-driven scale in/out")
     p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--supervise", action="store_true",
+                   help="babysit the gang: relaunch on ANY worker crash "
+                        "or hung-step stall (watchdog over store "
+                        "heartbeats), bumping PADDLE_RESTART_GENERATION "
+                        "each attempt, up to --max_restarts")
+    p.add_argument("--watchdog_timeout", type=float, default=None,
+                   help="seconds without heartbeat-step progress before "
+                        "a worker counts as hung (default: "
+                        "FLAGS_watchdog_timeout); 0 disables stall "
+                        "detection")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.supervise and args.elastic:
+        # the supervisor already relaunches on every failure; silently
+        # counting elastic-resize exits against its restart budget (and
+        # never resizing) would corrupt both protocols
+        p.error("--supervise and --elastic are mutually exclusive: "
+                "use --supervise for crash/hang recovery at fixed "
+                "world size, --elastic for membership-driven resizing")
+    return args
 
 
 def get_cluster_env(rank, world_size, endpoints, coordinator):
@@ -71,9 +103,10 @@ class PodLauncher:
     """Spawn + babysit one host's trainer processes
     (reference fleet/elastic/manager.py:37 LauncherInterface)."""
 
-    def __init__(self, args, argv_tail):
+    def __init__(self, args, argv_tail, extra_env=None):
         self.args = args
         self.argv_tail = argv_tail
+        self.extra_env = dict(extra_env or {})
         self.procs = []
         self.log_files = []
 
@@ -91,6 +124,7 @@ class PodLauncher:
             rank = a.host_rank * a.nproc + local
             env = dict(os.environ)
             env.update(get_cluster_env(rank, world, endpoints, coordinator))
+            env.update(self.extra_env)
             # children must import the same framework as this parent even
             # when it is run from a source tree rather than installed
             pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -131,9 +165,7 @@ class PodLauncher:
                     pending.clear()
                     break
             time.sleep(0.1)
-        for f in self.log_files:
-            f.close()
-        self.log_files = []
+        self._close_logs()
         return code
 
     def stop(self):
@@ -147,6 +179,70 @@ class PodLauncher:
                 p.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+    def _close_logs(self):
+        for f in self.log_files:
+            f.close()
+        self.log_files = []
+
+    def supervise(self, store, job: str, watchdog: float,
+                  poll: float = 0.2):
+        """Babysit the gang: returns ("done", 0) when every worker exits
+        cleanly, ("crash", code) on the first nonzero exit, or
+        ("stall", rank_key) when a worker that has heartbeated stops
+        advancing its step for ``watchdog`` seconds.  Crash/stall kills
+        the whole gang (partial pods can't make progress — reference
+        launch.py terminate_local_procs).
+
+        Stall detection is opt-in by construction: a worker that never
+        writes a heartbeat (a script not using Model.fit) is only
+        covered by crash detection — the watchdog can't distinguish
+        "doesn't heartbeat" from "hung before the first beat", and
+        killing every non-heartbeating script would be worse."""
+        last = {}  # heartbeat key -> (value, t_last_changed)
+        beat_t = 0.0
+        a = self.args
+        try:
+            while True:
+                rcs = [p.poll() for p in self.procs]
+                bad = next((rc for rc in rcs if rc not in (None, 0)),
+                           None)
+                if bad is not None:
+                    self.stop()
+                    return "crash", bad
+                if all(rc == 0 for rc in rcs):
+                    return "done", 0
+                # a cleanly-exited worker's heartbeat stops advancing by
+                # definition — it must never trip the stall watchdog
+                done_ranks = {str(a.host_rank * a.nproc + local)
+                              for local, rc in enumerate(rcs) if rc == 0}
+                now = time.monotonic()
+                if watchdog and store is not None and \
+                        now - beat_t >= poll:
+                    beat_t = now
+                    try:
+                        beats = store.list_prefix(
+                            f"{SUPERVISE_PREFIX}{job}/")
+                    except Exception:
+                        beats = None   # store blip: skip this round
+                    if beats is not None:
+                        for k, v in beats.items():
+                            if last.get(k, (object(),))[0] != v:
+                                last[k] = (v, now)
+                        for k, (v, t) in last.items():
+                            if k.rsplit("/", 1)[-1] in done_ranks:
+                                continue
+                            if now - t > watchdog:
+                                print(f"launch: worker heartbeat {k} "
+                                      f"stuck at {v!r} for "
+                                      f"{now - t:.1f}s (watchdog "
+                                      f"{watchdog}s) — killing the "
+                                      f"gang", file=sys.stderr)
+                                self.stop()
+                                return "stall", k
+                time.sleep(poll)
+        finally:
+            self._close_logs()
 
 
 def launch(argv=None):
@@ -198,6 +294,9 @@ def launch(argv=None):
         print(f"launch: elastic world = {args.nproc} "
               f"(live members {live}, bounds {lo}:{hi})", file=sys.stderr)
 
+    if args.supervise:
+        return _supervised_loop(args, tail, pod_ref)
+
     while True:
         _elastic_world()
         pod = PodLauncher(args, tail)
@@ -216,6 +315,72 @@ def launch(argv=None):
               f"(cmd: {shlex.join([args.training_script] + tail)})",
               file=sys.stderr)
         return code
+
+
+def _supervised_loop(args, tail, pod_ref):
+    """Supervisor mode: spawn, babysit, and relaunch the gang until it
+    completes or the restart budget is spent.  Each attempt runs with
+    PADDLE_RESTART_GENERATION set so workers know they are a resume."""
+    from .fleet.elastic.manager import KVServer, store_from_spec
+    from ..profiler import metrics as _metrics
+    from ..utils import flags as _flags
+
+    watchdog = args.watchdog_timeout
+    if watchdog is None:
+        watchdog = _flags.get_flag("FLAGS_watchdog_timeout")
+    job = os.environ.get("PADDLE_SUPERVISE_JOB",
+                         f"job-{os.getpid()}")
+    spec = os.environ.get("PADDLE_ELASTIC_STORE_ROOT")
+    server = None
+    if not spec:
+        # no store configured: run the KV endpoint ourselves (the
+        # coordinator-host etcd analog) so heartbeats have a home
+        server = KVServer().start()
+        spec = f"tcp://{server.endpoint}"
+    store = store_from_spec(spec)
+    interval = os.environ.get("PADDLE_HEARTBEAT_INTERVAL", "1.0")
+    restarts = 0
+    counter = _metrics.counter(
+        "launch.restarts", "supervised gang relaunches (crash or "
+        "watchdog stall)")
+    outcome = {"kind": "done", "code": 0}
+    try:
+        while True:
+            pod = PodLauncher(args, tail, extra_env={
+                "PADDLE_SUPERVISE_STORE": spec,
+                "PADDLE_SUPERVISE_JOB": job,
+                "PADDLE_HEARTBEAT_INTERVAL": str(interval),
+                "PADDLE_RESTART_GENERATION": str(restarts),
+            })
+            pod_ref["pod"] = pod
+            pod.launch()
+            kind, detail = pod.supervise(store, job, watchdog)
+            if kind == "done":
+                outcome = {"kind": "done", "code": 0}
+                return 0
+            if restarts < args.max_restarts:
+                restarts += 1
+                counter.inc()
+                print(f"launch: worker {kind} ({detail}); supervised "
+                      f"relaunch {restarts}/{args.max_restarts} "
+                      f"(workers resume from the newest intact "
+                      f"checkpoint)", file=sys.stderr)
+                continue
+            code = detail if kind == "crash" else 1
+            print(f"launch: {kind} ({detail}) with restart budget "
+                  f"spent ({args.max_restarts}); giving up",
+                  file=sys.stderr)
+            outcome = {"kind": kind, "code": code}
+            return code if code else 1
+    finally:
+        report = os.environ.get("PADDLE_SUPERVISE_REPORT")
+        if report:
+            with open(report, "w") as f:
+                json.dump({"restarts": restarts,
+                           "restarts_metric": counter.value,
+                           **outcome}, f)
+        if server is not None:
+            server.stop()
 
 
 if __name__ == "__main__":

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import os
 import pickle
+import time
+import warnings
 from typing import List, Optional
 
 import jax
@@ -25,6 +27,7 @@ from ..core.random import default_generator, rng_scope
 from ..core.tensor import Tensor, to_tensor
 from ..metric import Metric
 from ..profiler import tracer as _obs
+from ..utils import chaos as _chaos
 from .callbacks import config_callbacks
 
 __all__ = ["Model"]
@@ -331,6 +334,10 @@ class Model:
         self._train_step_count = getattr(self, "_train_step_count", 0) + 1
         lazy = _LazyScalar(loss,
                            origin=f"train step {self._train_step_count}")
+        if _chaos.active and _chaos.hit("step.loss") == "nan":
+            # chaos layer: poison this step's loss so the anomaly guard
+            # / nan-check paths can be exercised deterministically
+            lazy = float("nan")
         from ..utils import flags as _flags
         if _flags.get_flag("FLAGS_check_nan_inf"):
             # numeric-guard mode: surface device faults and NaN/Inf loss
@@ -417,12 +424,143 @@ class Model:
         return logs
 
     # ------------------------------------------------------------------
+    # fault tolerance: checkpoint resume, heartbeats, anomaly guard
+    # ------------------------------------------------------------------
+    def _ckpt_tree(self, step_count: int):
+        """(params, buffers, opt, rng, step) as one checkpointable tree —
+        everything a relaunched worker needs to continue bit-exactly."""
+        params, buffers = self.network.functional_state()
+        opt = self._optimizer
+        if getattr(opt, "_fn_state", None) is None:
+            opt._fn_state = opt.functional_init(params)
+        gen = default_generator
+        return {"params": params, "buffers": buffers,
+                "opt": opt._fn_state,
+                "meta": {"step": np.int64(step_count),
+                         "rng_seed": np.uint64(gen._seed),
+                         "rng_counter": np.uint64(gen._counter)}}
+
+    def _fit_resume(self, checkpointer) -> Optional[int]:
+        """Restore the newest intact checkpoint (corrupt steps are
+        quarantined by the checkpointer); returns the global step to
+        resume from, or None when nothing intact exists (cold start —
+        the live state is left untouched)."""
+        from ..distributed.checkpoint import CheckpointCorruptError
+        template = self._ckpt_tree(0)
+        try:
+            restored = checkpointer.restore(template=template)
+        except CheckpointCorruptError:
+            if checkpointer.all_steps():
+                warnings.warn(
+                    "fit: no intact checkpoint survived verification; "
+                    "starting from scratch")
+            return None
+        self.network.load_functional_state(restored["params"],
+                                           restored["buffers"])
+        self._optimizer._fn_state = restored["opt"]
+        meta = restored["meta"]
+        gen = default_generator
+        gen._seed = int(meta["rng_seed"])
+        gen._counter = int(meta["rng_counter"])
+        gen._key = None
+        self._rng_dev_cache = None     # device counter resyncs next step
+        step = int(meta["step"])
+        warnings.warn(f"fit: resumed from checkpoint at step {step} "
+                      f"(generation "
+                      f"{os.environ.get('PADDLE_RESTART_GENERATION', '0')})")
+        return step
+
+    def _make_heartbeat(self):
+        """Supervised-launch heartbeat: when the launcher exported
+        PADDLE_SUPERVISE_STORE, put this rank's step counter under the
+        supervise prefix so the watchdog can tell progress from a hang.
+        Returns None (zero per-step cost) when unsupervised."""
+        spec = os.environ.get("PADDLE_SUPERVISE_STORE")
+        if not spec:
+            return None
+        from ..distributed.fleet.elastic.manager import store_from_spec
+        from ..distributed.launch import SUPERVISE_PREFIX
+        store = store_from_spec(spec)
+        key = (f"{SUPERVISE_PREFIX}"
+               f"{os.environ.get('PADDLE_SUPERVISE_JOB', 'default')}/"
+               f"{os.environ.get('PADDLE_TRAINER_ID', '0')}")
+        interval = float(os.environ.get("PADDLE_HEARTBEAT_INTERVAL",
+                                        "1.0"))
+        state = {"t": 0.0}
+
+        def beat(step):
+            now = time.monotonic()
+            if now - state["t"] < interval:
+                return
+            state["t"] = now
+            try:
+                store.put(key, str(step))
+            except Exception:
+                pass   # store blip: the TTL/watchdog slack absorbs it
+
+        return beat
+
+    def _state_refs(self):
+        # deep copies, not refs: the jitted step DONATES params/opt
+        # buffers (donate_argnums), so the pre-step arrays are dead the
+        # moment the step runs — reverting must restore surviving copies
+        def cp(a):
+            return jnp.array(a._data if hasattr(a, "_data") else a,
+                             copy=True)
+        params, buffers = self.network.functional_state()
+        opt_state = getattr(self._optimizer, "_fn_state", None)
+        return (jax.tree.map(cp, params), jax.tree.map(cp, buffers),
+                None if opt_state is None else jax.tree.map(cp, opt_state))
+
+    def _restore_state_refs(self, snap):
+        params, buffers, opt_state = snap
+        self.network.load_functional_state(params, buffers)
+        if opt_state is not None:
+            self._optimizer._fn_state = opt_state
+
+    def _handle_anomaly(self, action, value, step_count, snap,
+                        checkpointer):
+        """nan/inf loss policy (FLAGS_anomaly_action).  'skip' reverts
+        this step's update; 'rollback' restores the newest intact
+        checkpoint (data is not rewound — training continues with the
+        next batch either way)."""
+        from ..profiler import metrics as _metrics
+        _metrics.counter("train.anomaly",
+                         "nan/inf losses caught by the fit anomaly "
+                         "guard").inc()
+        if action == "raise":
+            raise FloatingPointError(
+                f"loss is {value} at train step {step_count} "
+                f"(FLAGS_anomaly_action=raise)")
+        if action == "rollback" and checkpointer is not None:
+            restored = self._fit_resume(checkpointer)
+            if restored is not None:
+                warnings.warn(f"anomalous loss {value} at step "
+                              f"{step_count}: rolled back to checkpoint "
+                              f"step {restored}")
+                return
+            warnings.warn("FLAGS_anomaly_action=rollback: no intact "
+                          "checkpoint yet, reverting this step instead")
+        elif action == "rollback":
+            warnings.warn("FLAGS_anomaly_action=rollback without a "
+                          "checkpointer: reverting this step instead")
+        self._restore_state_refs(snap)
+        # the eager/accumulation path has already backward()ed the
+        # poisoned loss into .grad — flush it or the next boundary
+        # opt.step() applies the NaN update anyway (no-op on the
+        # functional jit path, which carries no .grad state)
+        if hasattr(self._optimizer, "clear_grad"):
+            self._optimizer.clear_grad()
+        warnings.warn(f"anomalous loss {value} at step {step_count}: "
+                      f"step reverted, continuing")
+
+    # ------------------------------------------------------------------
     # loop-level API
     # ------------------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, checkpointer=None):
         from ..io import DataLoader, Dataset
         self._save_dir = save_dir
         if isinstance(train_data, Dataset):
@@ -447,6 +585,16 @@ class Model:
                                 save_freq=save_freq, save_dir=save_dir,
                                 metrics=["loss"] + [m.name() for m in
                                                     self._metrics])
+        # fault-tolerance hooks: all of them cost one predicate read per
+        # step when unconfigured (no supervisor env, no checkpointer, no
+        # anomaly flag) — the PR-1 instrumentation discipline
+        from ..utils import flags as _flags
+        anomaly = _flags.get_flag("FLAGS_anomaly_action")
+        heartbeat = self._heartbeat = self._make_heartbeat()
+        start_step = 0
+        if checkpointer is not None and self._optimizer is not None:
+            start_step = self._fit_resume(checkpointer) or 0
+
         cbks.on_train_begin()
         step_count = 0
         for epoch in range(epochs):
@@ -455,11 +603,22 @@ class Model:
                 m.reset()
             logs = {}
             for step, batch in enumerate(train_loader):
+                if step_count < start_step:
+                    # resumed run: this batch's update is already inside
+                    # the restored state — replay the data order without
+                    # re-training (shuffle must be deterministic/off for
+                    # exact continuation, as in the reference resume)
+                    step_count += 1
+                    continue
                 cbks.on_train_batch_begin(step)
                 ins, lbls = self._split_batch(batch)
                 # profiler v2 hot-path hook: with the host tracer off
                 # this whole block is one predicate read per step
                 _t0 = _obs.now_ns() if _obs.active else 0
+                if anomaly:
+                    # pre-step copies (the jit step donates its inputs);
+                    # this is the guard's per-step cost
+                    snap = self._state_refs()
                 if accumulate_grad_batches > 1:
                     # grad accumulation rides the eager tape: backward
                     # accumulates into .grad, step fires on the boundary
@@ -471,11 +630,24 @@ class Model:
                 if _t0:
                     _obs.on_hapi_step(_t0, num_samples=_batch_len(ins),
                                       mode="train")
+                step_count += 1
+                if anomaly and "loss" in logs:
+                    # guard mode materialises the loss at the producing
+                    # step (trades away the lazy-loss pipeline)
+                    v = float(logs["loss"])
+                    if not np.isfinite(v):
+                        self._handle_anomaly(anomaly, v, step_count,
+                                             snap, checkpointer)
+                        logs["loss"] = v
+                if heartbeat is not None:
+                    heartbeat(step_count)
+                if checkpointer is not None:
+                    checkpointer.save(step_count,
+                                      self._ckpt_tree(step_count))
                 # reference hapi: callbacks see the ACTUAL batch size so
                 # ips stays honest on the final partial batch
                 logs["batch_size"] = _batch_len(ins)
                 cbks.on_train_batch_end(step, logs)
-                step_count += 1
                 if num_iters is not None and step_count >= num_iters:
                     break
             cbks.on_epoch_end(epoch, logs)
@@ -488,6 +660,11 @@ class Model:
             if num_iters is not None and step_count >= num_iters:
                 break
         cbks.on_train_end()
+        if checkpointer is not None:
+            # the final step's async write must land before fit returns
+            # (a supervisor relaunch right after fit would otherwise
+            # resume one step short)
+            checkpointer.wait_until_finished()
 
     def _split_batch(self, batch):
         if isinstance(batch, (list, tuple)):
@@ -514,9 +691,14 @@ class Model:
         cbks.on_eval_begin()
         losses = []
         logs = {}
+        heartbeat = getattr(self, "_heartbeat", None)
         for step, batch in enumerate(loader):
             cbks.on_eval_batch_begin(step)
             ins, lbls = self._split_batch(batch)
+            if heartbeat is not None:
+                # epoch-end evaluation advances the heartbeat too, so a
+                # long eval pass isn't misread as a hung train step
+                heartbeat(f"eval-{step}")
             _t0 = _obs.now_ns() if _obs.active else 0
             logs = self.eval_batch(ins, lbls)
             if _t0:

@@ -17,7 +17,9 @@ import jax
 import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
+from ..profiler import metrics as _metrics
 from ..profiler import tracer as _tracer
+from ..utils import chaos as _chaos
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
@@ -307,6 +309,8 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
+        if _chaos.active:
+            _chaos.hit("loader.worker")
         return self.collate_fn([self.dataset[i] for i in indices])
 
     def _iter_iterable(self):
@@ -403,6 +407,29 @@ class DataLoader:
             return {k: DataLoader._unpack(v, arrays)
                     for k, v in payload.items()}
         return payload
+
+    @staticmethod
+    def _worker_exit_details(procs) -> str:
+        """'worker 0: signal 9 (SIGKILL), worker 1: exit code 1, ...' —
+        the postmortem the fallback warning carries so a reaped pool is
+        attributable (reference dataloader_iter.py names the dead worker
+        and its signal in _shutdown_on_error)."""
+        import signal as _signal
+        parts = []
+        for wid, pr in enumerate(procs):
+            code = pr.exitcode
+            if code is None:
+                desc = "alive"
+            elif code < 0:
+                try:
+                    name = _signal.Signals(-code).name
+                except ValueError:
+                    name = "unknown signal"
+                desc = f"signal {-code} ({name})"
+            else:
+                desc = f"exit code {code}"
+            parts.append(f"worker {wid}: {desc}")
+        return ", ".join(parts)
 
     def _prefetch_iter_process(self):
         """Fork worker processes; batches return through POSIX shared
@@ -535,8 +562,17 @@ class DataLoader:
                             if dead or _time.monotonic() - last > watchdog:
                                 _warnings.warn(
                                     "DataLoader process workers "
-                                    f"{'died' if dead else 'stalled'}; "
-                                    "falling back to in-process loading")
+                                    f"{'died' if dead else 'stalled'} "
+                                    f"({self._worker_exit_details(procs)})"
+                                    "; falling back to in-process "
+                                    "loading")
+                                _metrics.counter(
+                                    "io.loader.worker_death",
+                                    "DataLoader process workers that "
+                                    "died/stalled, triggering the "
+                                    "in-process fallback").inc(
+                                    sum(1 for pr in procs
+                                        if not pr.is_alive()))
                                 for pr in procs:
                                     pr.terminate()
                                 fallback = True

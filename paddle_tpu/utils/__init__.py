@@ -1,3 +1,5 @@
 from . import flags  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
+from . import resilience  # noqa: F401
+from . import chaos  # noqa: F401
 from . import cpp_extension  # noqa: F401

@@ -48,6 +48,17 @@ def set_flags(flags: Dict[str, Any]):
                 raise KeyError(f"unknown flag '{k}'")
             _FLAGS[k] = v
     _refresh_debug_cache()
+    for fn in _observers:
+        fn()
+
+
+# modules that cache flag-derived fast paths (chaos registry, ...)
+# register a refresher here; set_flags invokes each after an update
+_observers = []
+
+
+def on_change(fn):
+    _observers.append(fn)
 
 
 # cached fast-path predicate for the per-op dispatch hot loop: one module
@@ -109,6 +120,26 @@ define_flag("FLAGS_program_dce", True,
 define_flag("FLAGS_host_tracer_capacity", 1 << 20,
             "max host spans held by the profiler ring buffer; oldest "
             "spans drop beyond this (reference host_trace_level buffer)")
+define_flag("FLAGS_chaos_spec", "",
+            "deterministic fault-injection spec, e.g. "
+            "'ckpt.write:fail@3;store.rpc:delay=0.5@2-4' — named sites "
+            "(ckpt.write, store.rpc, fs.rename, loader.worker, "
+            "step.loss) fail/stall/poison on a seeded schedule; empty "
+            "means every site costs one predicate read (utils/chaos.py)")
+define_flag("FLAGS_chaos_seed", 0,
+            "seed for probabilistic chaos selectors (p=...); same seed "
+            "+ same call pattern = same injection schedule")
+define_flag("FLAGS_watchdog_timeout", 60.0,
+            "supervisor mode (distributed.launch --supervise): a worker "
+            "whose heartbeat step has not advanced for this many "
+            "seconds is declared hung; the gang is killed and "
+            "relaunched (TorchElastic-style supervised restart)")
+define_flag("FLAGS_anomaly_action", "",
+            "hapi Model.fit guard on nan/inf loss: '' (off, keeps the "
+            "lazy-loss pipeline), 'raise' (FloatingPointError at the "
+            "producing step), 'skip' (revert this step's update and "
+            "continue), 'rollback' (restore the newest intact "
+            "checkpoint when fit(checkpointer=...) is set, else skip)")
 
 # flags may arrive via env at import time — seed the dispatch fast path
 _refresh_debug_cache()

@@ -1,0 +1,212 @@
+"""Checkpoint corruption matrix (Check-N-Run-style verified restore):
+truncated leaf file, flipped bytes, missing manifest, interrupted
+rename (no COMMITTED marker) — each must be detected by
+``load_state(verify=True)``, and ``AsyncCheckpointer.restore`` must
+quarantine the corrupt step and fall back to the newest intact one."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.profiler import metrics
+from paddle_tpu.utils import chaos, resilience
+
+
+@pytest.fixture(autouse=True)
+def _teardown():
+    yield
+    chaos.reset()
+    resilience.clear_fail_points()
+
+
+def _tree(v: float):
+    return {"w": jnp.full((16, 16), v), "b": jnp.full((4,), v),
+            "step": jnp.asarray(int(v), jnp.int32)}
+
+
+def _largest_data_file(path):
+    best, size = None, -1
+    for base, _dirs, files in os.walk(path):
+        for name in files:
+            if name in (ckpt.MANIFEST_NAME, ckpt.COMMITTED_NAME):
+                continue
+            full = os.path.join(base, name)
+            if os.path.getsize(full) > size:
+                best, size = full, os.path.getsize(full)
+    assert best is not None
+    return best
+
+
+# ---------------------------------------------------------------------------
+# the corruption matrix against save_state/load_state
+# ---------------------------------------------------------------------------
+def _corrupt_truncate(path):
+    f = _largest_data_file(path)
+    data = open(f, "rb").read()
+    with open(f, "wb") as out:
+        out.write(data[: max(1, len(data) // 2)])
+
+
+def _corrupt_flip(path):
+    f = _largest_data_file(path)
+    data = bytearray(open(f, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(f, "wb") as out:
+        out.write(bytes(data))
+
+
+def _corrupt_no_manifest(path):
+    os.unlink(os.path.join(path, ckpt.MANIFEST_NAME))
+
+
+def _corrupt_uncommitted(path):
+    os.unlink(os.path.join(path, ckpt.COMMITTED_NAME))
+
+
+CORRUPTIONS = {"truncated_leaf": _corrupt_truncate,
+               "flipped_bytes": _corrupt_flip,
+               "missing_manifest": _corrupt_no_manifest,
+               "interrupted_rename": _corrupt_uncommitted}
+
+
+@pytest.mark.parametrize("kind", sorted(CORRUPTIONS))
+def test_verify_detects_corruption(tmp_path, kind):
+    path = str(tmp_path / "c")
+    tree = _tree(3.0)
+    ckpt.save_state(path, tree, step=3)
+    ckpt.load_state(path, tree, verify=True)          # intact: loads
+    CORRUPTIONS[kind](path)
+    before = metrics.counter("ckpt.verify_fail").value
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_state(path, tree, verify=True)
+    assert metrics.counter("ckpt.verify_fail").value == before + 1
+
+
+def test_commit_marker_records_step_metadata(tmp_path):
+    path = str(tmp_path / "c")
+    ckpt.save_state(path, _tree(7.0), step=7)
+    meta = ckpt.checkpoint_metadata(path)
+    assert meta["step"] == 7
+    assert meta["framework"] == "paddle_tpu"
+    marker = json.load(open(os.path.join(path, ckpt.COMMITTED_NAME)))
+    assert marker["step"] == 7 and marker["manifest_sha256"]
+
+
+def test_interrupted_commit_leaves_detectable_tree(tmp_path):
+    """A crash between the rename and the COMMITTED marker (fail point
+    in the commit sequence) must leave an uncommitted tree that
+    verify=True rejects; a later save over the same path heals it."""
+    path = str(tmp_path / "c")
+    resilience.arm_fail_point("ckpt.commit")
+    with pytest.raises(resilience.FailPointError):
+        ckpt.save_state(path, _tree(1.0), step=1)
+    assert os.path.isdir(path)                      # tree landed...
+    assert not os.path.exists(os.path.join(path, ckpt.COMMITTED_NAME))
+    with pytest.raises(ckpt.CheckpointCorruptError,
+                       match="interrupted commit"):
+        ckpt.verify_checkpoint(path)
+    ckpt.save_state(path, _tree(2.0), step=2)       # heal by overwrite
+    back = ckpt.load_state(path, _tree(0.0), verify=True)
+    np.testing.assert_allclose(np.asarray(back["w"]), 2.0)
+
+
+# ---------------------------------------------------------------------------
+# AsyncCheckpointer: quarantine + newest-intact fallback + GC floor
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kind", sorted(CORRUPTIONS))
+def test_restore_quarantines_and_falls_back(tmp_path, kind):
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "mgr"), max_to_keep=4)
+    for step in range(1, 4):
+        mgr.save(step, _tree(float(step)))
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [1, 2, 3]
+    CORRUPTIONS[kind](os.path.join(str(tmp_path / "mgr"), "3"))
+
+    before = metrics.counter("ckpt.quarantined").value
+    with pytest.warns(UserWarning, match="quarantined"):
+        back = mgr.restore(template=_tree(0.0))
+    np.testing.assert_allclose(np.asarray(back["w"]), 2.0)  # newest intact
+    assert metrics.counter("ckpt.quarantined").value == before + 1
+    qdir = os.path.join(str(tmp_path / "mgr"),
+                        ckpt.AsyncCheckpointer.QUARANTINE, "3")
+    assert os.path.isdir(qdir)                      # moved aside, kept
+    assert mgr.all_steps() == [1, 2]
+    mgr.close()
+
+
+def test_restore_walks_past_multiple_corrupt_steps(tmp_path):
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "mgr"), max_to_keep=5)
+    for step in range(1, 5):
+        mgr.save(step, _tree(float(step)))
+    mgr.wait_until_finished()
+    _corrupt_flip(os.path.join(str(tmp_path / "mgr"), "4"))
+    _corrupt_uncommitted(os.path.join(str(tmp_path / "mgr"), "3"))
+    with pytest.warns(UserWarning):
+        back = mgr.restore(template=_tree(0.0))
+    np.testing.assert_allclose(np.asarray(back["w"]), 2.0)
+    mgr.close()
+
+
+def test_restore_raises_when_nothing_intact(tmp_path):
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "mgr"))
+    mgr.save(1, _tree(1.0))
+    mgr.wait_until_finished()
+    _corrupt_truncate(os.path.join(str(tmp_path / "mgr"), "1"))
+    with pytest.raises(ckpt.CheckpointCorruptError, match="no intact"), \
+            pytest.warns(UserWarning):
+        mgr.restore(template=_tree(0.0))
+    mgr.close()
+
+
+def test_failed_write_never_raises_into_training(tmp_path):
+    """An injected checkpoint-write failure (chaos ckpt.write) is
+    counted and warned; the previous intact step stays restorable and
+    GC never deletes it."""
+    chaos.configure("ckpt.write:fail@2", seed=0)
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "mgr"), max_to_keep=1)
+    before = metrics.counter("ckpt.write_fail").value
+    mgr.save(1, _tree(1.0))
+    mgr.wait_until_finished()
+    with pytest.warns(UserWarning, match="previous intact"):
+        mgr.save(2, _tree(2.0))                     # injected failure
+        mgr.wait_until_finished()
+    assert metrics.counter("ckpt.write_fail").value == before + 1
+    assert isinstance(mgr.last_error, chaos.ChaosError)
+    assert mgr.all_steps() == [1]                   # GC floor: last
+    back = mgr.restore(template=_tree(0.0))         # verified step kept
+    np.testing.assert_allclose(np.asarray(back["w"]), 1.0)
+    mgr.save(3, _tree(3.0))                         # next write heals
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [3]                   # rotation resumed
+    mgr.close()
+
+
+def test_gc_rotation_keeps_newest_and_clears_torn(tmp_path):
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "mgr"), max_to_keep=2)
+    chaos.configure("ckpt.write:fail@2", seed=0)    # step 2 is torn
+    with pytest.warns(UserWarning):
+        for step in range(1, 6):
+            mgr.save(step, _tree(float(step)))
+        mgr.wait_until_finished()
+    assert mgr.all_steps() == [4, 5]
+    # the torn step-2 tree was shadowed by newer commits and GC'd
+    assert not os.path.exists(os.path.join(str(tmp_path / "mgr"), "2"))
+    back = mgr.restore(5, template=_tree(0.0))
+    np.testing.assert_allclose(np.asarray(back["w"]), 5.0)
+    mgr.close()
+
+
+def test_save_interval_steps_window(tmp_path):
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "mgr"), max_to_keep=8,
+                                 save_interval_steps=3)
+    assert mgr.save(1, _tree(1.0)) is True
+    assert mgr.save(2, _tree(2.0)) is False         # inside the window
+    assert mgr.save(3, _tree(3.0)) is False
+    assert mgr.save(4, _tree(4.0)) is True
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [1, 4]
+    mgr.close()

@@ -149,13 +149,17 @@ class _SlowDS(paddle.io.Dataset):
 
 def test_dataloader_worker_sigkill_falls_back():
     """SIGKILL the worker processes mid-epoch: the loader detects the
-    dead pool immediately (not via the long watchdog) and completes the
-    epoch in-process (reference reaps dead workers,
+    dead pool immediately (not via the long watchdog), completes the
+    epoch in-process, names the workers' exit signal in the warning,
+    and counts the deaths in metrics (reference reaps dead workers,
     dataloader_iter.py _shutdown_on_error)."""
     import multiprocessing.process as mpp
     import threading
     import warnings as W
 
+    from paddle_tpu.profiler import metrics
+
+    deaths_before = metrics.counter("io.loader.worker_death").value
     dl = paddle.io.DataLoader(_SlowDS(), batch_size=4, num_workers=2,
                               use_shared_memory=True, timeout=30.0)
     result = {}
@@ -168,13 +172,15 @@ def test_dataloader_worker_sigkill_falls_back():
 
     t = threading.Thread(target=consume, daemon=True)
     t.start()
-    # wait for worker processes to exist, then murder them
+    # wait for BOTH worker processes to exist, then murder them (a
+    # partial snapshot would leave a survivor serving batches and turn
+    # fast dead-pool detection into the slow stall path)
     deadline = time.monotonic() + 10
     victims = []
-    while time.monotonic() < deadline and not victims:
+    while time.monotonic() < deadline and len(victims) < 2:
         victims = list(mpp.active_children())
         time.sleep(0.05)
-    assert victims, "no worker processes spawned"
+    assert len(victims) == 2, "worker processes not spawned"
     for child in victims:
         try:
             os.kill(child.pid, signal.SIGKILL)
@@ -186,4 +192,10 @@ def test_dataloader_worker_sigkill_falls_back():
     batches = result["batches"]
     assert len(batches) == 8
     assert sum(int(b[0].shape[0]) for b in batches) == 32
-    assert any("falling back" in w for w in result["warnings"])
+    fallback = [w for w in result["warnings"] if "falling back" in w]
+    assert fallback
+    # the postmortem names each dead worker's signal...
+    assert any("signal 9 (SIGKILL)" in w for w in fallback), fallback
+    # ...and the event lands in the metrics registry
+    assert metrics.counter("io.loader.worker_death").value >= \
+        deaths_before + 1

@@ -1,0 +1,433 @@
+"""Resilience + chaos layer tests: retry/backoff/deadline semantics,
+fail points, chaos-spec grammar and determinism, and the zero-overhead
+contract — every chaos site costs exactly one predicate read when
+``FLAGS_chaos_spec`` is unset (PR-1 instrumentation discipline)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import metrics
+from paddle_tpu.utils import chaos, resilience
+from paddle_tpu.utils.resilience import Deadline, FailPointError, retry
+
+from conftest import free_port
+
+
+@pytest.fixture(autouse=True)
+def _chaos_teardown():
+    yield
+    chaos.reset()
+    resilience.clear_fail_points()
+
+
+# ---------------------------------------------------------------------------
+# retry / Deadline
+# ---------------------------------------------------------------------------
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    delays = []
+
+    @retry(retry_on=(ConnectionRefusedError,), max_tries=5,
+           base_delay=0.01, jitter=0.0, sleep=delays.append)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionRefusedError("not yet")
+        return "ok"
+
+    before = metrics.counter("resilience.retry").value
+    assert flaky() == "ok"
+    assert calls["n"] == 3
+    assert len(delays) == 2
+    assert delays[1] > delays[0]          # exponential backoff
+    assert metrics.counter("resilience.retry").value == before + 2
+
+
+def test_retry_gives_up_after_max_tries():
+    calls = {"n": 0}
+
+    @retry(retry_on=(OSError,), max_tries=3, base_delay=0.0,
+           sleep=lambda d: None)
+    def always_down():
+        calls["n"] += 1
+        raise ConnectionRefusedError("down")
+
+    with pytest.raises(ConnectionRefusedError):
+        always_down()
+    assert calls["n"] == 3
+
+
+def test_retry_classify_rejects_permanent_errors():
+    calls = {"n": 0}
+
+    @retry(retry_on=(OSError,), max_tries=5, base_delay=0.0,
+           classify=lambda e: isinstance(e, ConnectionRefusedError),
+           sleep=lambda d: None)
+    def permanent():
+        calls["n"] += 1
+        raise FileNotFoundError("gone for good")
+
+    with pytest.raises(FileNotFoundError):
+        permanent()
+    assert calls["n"] == 1                 # no retry on permanent
+
+
+def test_retry_respects_deadline():
+    calls = {"n": 0}
+
+    @retry(retry_on=(OSError,), max_tries=100, base_delay=0.05,
+           deadline=0.15)
+    def slow_fail():
+        calls["n"] += 1
+        raise ConnectionRefusedError("down")
+
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionRefusedError):
+        slow_fail()
+    assert time.monotonic() - t0 < 2.0     # bounded, nowhere near 100 tries
+    assert calls["n"] < 20
+
+
+def test_deadline_semantics():
+    assert Deadline(None).remaining() is None
+    assert not Deadline(None).expired()
+    assert Deadline(None).clamp(42.0) == 42.0
+    d = Deadline(0.05)
+    assert d.remaining() <= 0.05
+    assert d.clamp(1.0) <= 0.05
+    time.sleep(0.08)
+    assert d.expired()
+    assert d.remaining() == 0.0
+
+
+def test_fail_point_one_shot():
+    resilience.arm_fail_point("x.y")
+    with pytest.raises(FailPointError):
+        resilience.fail_point("x.y")
+    resilience.fail_point("x.y")           # disarmed after one shot
+    resilience.fail_point("never.armed")   # no-op
+
+
+# ---------------------------------------------------------------------------
+# chaos spec grammar
+# ---------------------------------------------------------------------------
+def test_chaos_spec_parse():
+    rules = chaos.parse_spec("ckpt.write:fail@3;store.rpc:delay=0.5@2-4;"
+                             "step.loss:nan;loader.worker:fail@p=0.25;"
+                             "fs.rename:fail@5-")
+    r = rules["ckpt.write"][0]
+    assert r.kind == "fail" and (r.lo, r.hi) == (3, 3)
+    r = rules["store.rpc"][0]
+    assert r.kind == "delay" and r.value == 0.5 and (r.lo, r.hi) == (2, 4)
+    assert rules["step.loss"][0].lo is None          # every call
+    assert rules["loader.worker"][0].prob == 0.25
+    r = rules["fs.rename"][0]
+    assert (r.lo, r.hi) == (5, None)                 # open range
+
+    for bad in ("nosite", "site:explode", "site:fail@p=2.0"):
+        with pytest.raises(ValueError):
+            chaos.parse_spec(bad)
+
+
+def test_chaos_fail_and_count_selectors():
+    chaos.configure("s:fail@2", seed=0)
+    assert chaos.hit("s") is None                    # call 1: clean
+    with pytest.raises(chaos.ChaosError):
+        chaos.hit("s")                               # call 2: injected
+    assert chaos.hit("s") is None                    # call 3: clean again
+    assert chaos.call_count("s") == 3
+    assert metrics.counter("chaos.injected.s").value >= 1
+
+
+def test_chaos_custom_exception_and_delay():
+    chaos.configure("rpc:fail@1;d:delay=0.05@1", seed=0)
+    with pytest.raises(ConnectionRefusedError):
+        chaos.hit("rpc", exc=ConnectionRefusedError)
+    t0 = time.monotonic()
+    assert chaos.hit("d") == "delay"
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_chaos_deterministic_schedule_same_seed():
+    """Same seed + same call pattern -> identical injection schedule;
+    a different seed diverges (seeded per-site RNG)."""
+    def schedule(seed):
+        chaos.configure("s:fail@p=0.5", seed=seed)
+        fired = []
+        for i in range(64):
+            try:
+                chaos.hit("s")
+            except chaos.ChaosError:
+                fired.append(i)
+        return fired
+
+    a, b, c = schedule(7), schedule(7), schedule(8)
+    assert a == b                      # deterministic replay
+    assert 0 < len(a) < 64             # actually probabilistic
+    assert a != c                      # seed matters
+
+
+def test_chaos_armed_via_set_flags():
+    paddle.set_flags({"FLAGS_chaos_spec": "s:fail@1"})
+    try:
+        assert chaos.active
+        with pytest.raises(chaos.ChaosError):
+            chaos.hit("s")
+    finally:
+        paddle.set_flags({"FLAGS_chaos_spec": ""})
+    assert not chaos.active
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead contract: with no spec armed, instrumented paths never
+# call chaos.hit — the gate is one module-predicate read (acceptance
+# criterion; mirrors test_profiler.test_zero_overhead_when_disabled)
+# ---------------------------------------------------------------------------
+def test_chaos_sites_cost_one_predicate_when_off(tmp_path, monkeypatch):
+    assert paddle.utils.flags.get_flag("FLAGS_chaos_spec") == ""
+    assert not chaos.active
+    calls = []
+    monkeypatch.setattr(chaos, "hit",
+                        lambda site, exc=None: calls.append(site))
+
+    # ckpt.write
+    from paddle_tpu.distributed import checkpoint as ckpt
+    import jax.numpy as jnp
+    ckpt.save_state(str(tmp_path / "c"), {"w": jnp.ones((2,))})
+
+    # fs.rename
+    from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+    fs = LocalFS()
+    (tmp_path / "a").write_text("x")
+    fs.mv(str(tmp_path / "a"), str(tmp_path / "b"))
+
+    # store.rpc
+    from paddle_tpu.distributed.fleet.elastic.manager import (KVServer,
+                                                              TCPStore)
+    srv = KVServer().start()
+    try:
+        TCPStore(srv.endpoint).put("/k", "v")
+    finally:
+        srv.stop()
+
+    # loader.worker
+    class DS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return np.zeros(2, np.float32)
+
+        def __len__(self):
+            return 4
+
+    list(paddle.io.DataLoader(DS(), batch_size=2))
+
+    # step.loss
+    net = paddle.nn.Linear(4, 2)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  paddle.nn.MSELoss())
+    model.train_batch([np.ones((2, 4), np.float32)],
+                      [np.zeros((2, 2), np.float32)])
+
+    assert calls == [], f"chaos.hit called with no spec armed: {calls}"
+
+
+def test_chaos_sites_fire_when_armed(tmp_path):
+    """Sanity inverse of the predicate test: an armed spec reaches the
+    real sites."""
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import checkpoint as ckpt
+    chaos.configure("ckpt.write:fail@1;loader.worker:fail@1", seed=0)
+    with pytest.raises(chaos.ChaosError):
+        ckpt.save_state(str(tmp_path / "c"), {"w": jnp.ones((2,))})
+
+    class DS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return np.zeros(2, np.float32)
+
+        def __len__(self):
+            return 4
+
+    with pytest.raises(chaos.ChaosError):
+        list(paddle.io.DataLoader(DS(), batch_size=2))
+
+
+# ---------------------------------------------------------------------------
+# TCPStore retry (satellite): KVServer restart window
+# ---------------------------------------------------------------------------
+def test_tcp_store_rides_through_server_restart():
+    from paddle_tpu.distributed.fleet.elastic.manager import (KVServer,
+                                                              TCPStore)
+    port = free_port()
+    srv = KVServer(port=port).start()
+    store = TCPStore(srv.endpoint, timeout=5.0, retries=8,
+                     retry_base_delay=0.05)
+    store.put("/x", "1")
+    srv.stop()                               # restart window opens
+
+    def relaunch():
+        time.sleep(0.4)
+        KVServer(port=port).start()
+
+    t = threading.Thread(target=relaunch, daemon=True)
+    before = metrics.counter("resilience.retry").value
+    t.start()
+    store.put("/x", "2")                     # retried through the window
+    t.join()
+    assert store.get("/x") == "2"
+    assert metrics.counter("resilience.retry").value > before
+
+
+def test_tcp_store_bounded_failure_when_server_gone():
+    from paddle_tpu.distributed.fleet.elastic.manager import TCPStore
+    store = TCPStore(f"127.0.0.1:{free_port()}", timeout=1.0, retries=3,
+                     retry_base_delay=0.01)
+    t0 = time.monotonic()
+    with pytest.raises((ConnectionRefusedError, OSError)):
+        store.get("/nope")
+    assert time.monotonic() - t0 < 5.0       # bounded, no infinite loop
+
+
+def test_chaos_store_rpc_delay_through_tcp_store():
+    from paddle_tpu.distributed.fleet.elastic.manager import (KVServer,
+                                                              TCPStore)
+    srv = KVServer().start()
+    try:
+        store = TCPStore(srv.endpoint)
+        chaos.configure("store.rpc:delay=0.1@1", seed=0)
+        t0 = time.monotonic()
+        store.put("/k", "v")
+        assert time.monotonic() - t0 >= 0.09
+        assert metrics.counter("chaos.injected.store.rpc").value >= 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fs satellites: atomic overwrite-rename + HDFS transient retry
+# ---------------------------------------------------------------------------
+def test_localfs_mv_atomic_file_overwrite(tmp_path):
+    from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+    fs = LocalFS()
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    src.write_text("new")
+    dst.write_text("old")
+    with pytest.raises(FileExistsError):
+        fs.mv(str(src), str(dst))            # overwrite=False still guards
+    fs.mv(str(src), str(dst), overwrite=True)
+    assert dst.read_text() == "new" and not src.exists()
+
+
+def test_localfs_mv_atomic_dir_overwrite_no_window(tmp_path):
+    """Directory overwrite swaps via rename-aside: even when the
+    post-swap cleanup dies, dst holds the NEW tree (no
+    delete-then-rename window where dst is missing)."""
+    from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+    fs = LocalFS()
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    src.mkdir(), dst.mkdir()
+    (src / "f").write_text("new")
+    (dst / "f").write_text("old")
+    resilience.arm_fail_point("fs.mv.post_swap")
+    with pytest.raises(FailPointError):
+        fs.mv(str(src), str(dst), overwrite=True)
+    assert (dst / "f").read_text() == "new"  # swap already landed
+    fs.mv(str(dst), str(tmp_path / "dst2"), overwrite=False)
+    assert (tmp_path / "dst2" / "f").read_text() == "new"
+
+
+def _fake_hadoop(tmp_path, script_body: str):
+    home = tmp_path / "hadoop_home"
+    (home / "bin").mkdir(parents=True)
+    exe = home / "bin" / "hadoop"
+    exe.write_text("#!/bin/sh\n" + script_body)
+    exe.chmod(0o755)
+    return str(home)
+
+
+def test_hdfs_run_retries_transient_exit_codes(tmp_path):
+    from paddle_tpu.distributed.fleet.utils.fs import HDFSClient
+    state = tmp_path / "attempts"
+    home = _fake_hadoop(tmp_path, f"""
+n=$(cat {state} 2>/dev/null || echo 0)
+n=$((n+1)); echo $n > {state}
+if [ $n -lt 3 ]; then echo "Call From x/y: Connection refused" >&2; exit 255; fi
+echo "ok"
+""")
+    client = HDFSClient(hadoop_home=home, sleep_inter=10)
+    assert "ok" in client._run("-ls", "/")
+    assert state.read_text().strip() == "3"  # 2 transient retries
+
+
+def test_hdfs_run_no_retry_on_permanent_failure(tmp_path):
+    from paddle_tpu.distributed.fleet.utils.fs import (ExecuteError,
+                                                       HDFSClient)
+    state = tmp_path / "attempts"
+    home = _fake_hadoop(tmp_path, f"""
+n=$(cat {state} 2>/dev/null || echo 0)
+n=$((n+1)); echo $n > {state}
+echo "ls: /nope: No such file or directory" >&2
+exit 1
+""")
+    client = HDFSClient(hadoop_home=home, sleep_inter=10)
+    with pytest.raises(ExecuteError):
+        client._run("-ls", "/nope")
+    assert state.read_text().strip() == "1"  # permanent: one attempt
+
+
+# ---------------------------------------------------------------------------
+# anomaly guard (hapi tie-in) driven by the step.loss chaos site
+# ---------------------------------------------------------------------------
+def _fit_model():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 2))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    model.prepare(opt, paddle.nn.MSELoss())
+    return model
+
+
+class _FitDS(paddle.io.Dataset):
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        return (rng.rand(4).astype(np.float32),
+                rng.rand(2).astype(np.float32))
+
+    def __len__(self):
+        return 8
+
+
+def test_anomaly_action_raise_on_injected_nan():
+    model = _fit_model()
+    paddle.set_flags({"FLAGS_anomaly_action": "raise"})
+    chaos.configure("step.loss:nan@2", seed=0)
+    try:
+        with pytest.raises(FloatingPointError, match="train step 2"):
+            model.fit(_FitDS(), batch_size=4, epochs=1, verbose=0,
+                      shuffle=False)
+    finally:
+        paddle.set_flags({"FLAGS_anomaly_action": ""})
+
+
+def test_anomaly_action_skip_reverts_and_continues():
+    import warnings as W
+    model = _fit_model()
+    paddle.set_flags({"FLAGS_anomaly_action": "skip"})
+    chaos.configure("step.loss:nan@1", seed=0)
+    before = metrics.counter("train.anomaly").value
+    try:
+        with W.catch_warnings(record=True) as rec:
+            W.simplefilter("always")
+            model.fit(_FitDS(), batch_size=4, epochs=1, verbose=0,
+                      shuffle=False)
+    finally:
+        paddle.set_flags({"FLAGS_anomaly_action": ""})
+    assert metrics.counter("train.anomaly").value == before + 1
+    assert any("step reverted" in str(w.message) for w in rec)
+    # training continued and produced finite params
+    for _n, p in model.network.named_parameters():
+        assert np.isfinite(np.asarray(p._data)).all()

@@ -1,0 +1,222 @@
+"""Supervised relaunch tests (TorchElastic-style): crash detection,
+hung-step watchdog, restart budget, and the acceptance gate — SIGKILL a
+worker mid-step in a ``--max_restarts`` launch and require the training
+outcome to match an uninterrupted run (same gate style as
+``test_dist_parity.py``)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+           PYTHONPATH=REPO)
+
+
+def _launch(tmp_path, script_body, extra_args, env=None, timeout=300):
+    script = tmp_path / "trainer.py"
+    script.write_text(textwrap.dedent(script_body))
+    report = tmp_path / "report.json"
+    run_env = dict(ENV, PADDLE_SUPERVISE_REPORT=str(report))
+    run_env.update(env or {})
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--supervise", *extra_args, str(script)]
+    r = subprocess.run(cmd, env=run_env, cwd=REPO, capture_output=True,
+                       text=True, timeout=timeout)
+    rep = json.load(open(report)) if report.exists() else None
+    return r, rep
+
+
+def test_supervise_relaunch_on_crash(tmp_path):
+    """A worker crash (nonzero exit) kills the gang, bumps
+    PADDLE_RESTART_GENERATION, and relaunches; launch.restarts counts."""
+    r, rep = _launch(tmp_path, """
+        import os, sys
+        gen = int(os.environ.get("PADDLE_RESTART_GENERATION", "0"))
+        if gen == 0 and os.environ["PADDLE_TRAINER_ID"] == "1":
+            sys.exit(3)
+        """, ["--nproc", "2", "--max_restarts", "2"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert rep == {"restarts": 1, "restarts_metric": 1,
+                   "kind": "done", "code": 0}
+    assert "supervised relaunch 1/2" in r.stderr
+
+
+def test_supervise_restart_budget_exhausted(tmp_path):
+    r, rep = _launch(tmp_path, """
+        import sys
+        sys.exit(5)
+        """, ["--nproc", "1", "--max_restarts", "2"])
+    assert r.returncode != 0
+    assert rep["restarts"] == 2 and rep["kind"] == "crash"
+    assert rep["code"] == 5
+
+
+def test_supervise_watchdog_kills_hung_step(tmp_path):
+    """A worker that heartbeats then stops advancing its step is a
+    HANG, not a crash — the watchdog must detect it, kill the gang, and
+    relaunch (reference: hung-collective detection; FLAGS_watchdog_timeout)."""
+    r, rep = _launch(tmp_path, """
+        import os, time
+        gen = int(os.environ.get("PADDLE_RESTART_GENERATION", "0"))
+        if gen == 0:
+            from paddle_tpu.distributed.fleet.elastic.manager import \\
+                store_from_spec
+            store = store_from_spec(os.environ["PADDLE_SUPERVISE_STORE"])
+            key = (f"/paddle/supervise/"
+                   f"{os.environ['PADDLE_SUPERVISE_JOB']}/"
+                   f"{os.environ['PADDLE_TRAINER_ID']}")
+            store.put(key, "1")
+            time.sleep(300)            # hung step: never advances
+        """, ["--nproc", "1", "--max_restarts", "1",
+              "--watchdog_timeout", "3"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert rep["restarts"] == 1 and rep["kind"] == "done"
+    assert "watchdog" in r.stderr
+
+
+def test_supervise_done_worker_does_not_trip_watchdog(tmp_path):
+    """A worker that heartbeats and then EXITS 0 stops advancing its
+    heartbeat by definition — the watchdog must not read that as a hang
+    while its gang-mates keep training."""
+    r, rep = _launch(tmp_path, """
+        import os, time
+        from paddle_tpu.distributed.fleet.elastic.manager import \\
+            store_from_spec
+        store = store_from_spec(os.environ["PADDLE_SUPERVISE_STORE"])
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        key = (f"/paddle/supervise/"
+               f"{os.environ['PADDLE_SUPERVISE_JOB']}/{rank}")
+        store.put(key, "1")
+        if rank == "1":          # keeps "training" past the watchdog
+            for step in range(2, 14):
+                time.sleep(0.5)
+                store.put(key, str(step))
+        """, ["--nproc", "2", "--max_restarts", "2",
+              "--watchdog_timeout", "3"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert rep == {"restarts": 0, "restarts_metric": 0,
+                   "kind": "done", "code": 0}
+
+
+def test_supervise_rejects_elastic_combo(tmp_path):
+    script = tmp_path / "t.py"
+    script.write_text("")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--supervise", "--elastic", str(script)],
+        env=ENV, cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0
+    assert "mutually exclusive" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# acceptance gate: gang-kill recovery parity
+# ---------------------------------------------------------------------------
+PARITY_TRAINER = """
+import json, os, signal
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.hapi.callbacks import Callback
+
+rank = os.environ["PADDLE_TRAINER_ID"]
+gen = int(os.environ.get("PADDLE_RESTART_GENERATION", "0"))
+work = os.environ["SUP_TEST_DIR"]
+
+paddle.seed(0)
+net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.Tanh(),
+                           paddle.nn.Linear(8, 1))
+model = paddle.Model(net)
+opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+model.prepare(opt, paddle.nn.MSELoss())
+
+
+class DS(paddle.io.Dataset):
+    def __getitem__(self, i):
+        import time
+        time.sleep(0.02)     # pace steps so async commits land between
+        rng = np.random.RandomState(i)
+        x = rng.rand(4).astype("float32")
+        return x, (x.sum(keepdims=True) * 0.5).astype("float32")
+
+    def __len__(self):
+        return 40       # batch 4 -> 10 global steps
+
+
+class Chronicle(Callback):
+    def on_train_batch_end(self, step, logs=None):
+        if rank == "0":
+            with open(os.path.join(work, "losses.jsonl"), "a") as f:
+                f.write(json.dumps({"step": step, "gen": gen,
+                                    "loss": float(logs["loss"])}) + "\\n")
+        if rank == "1" and gen == 0 and step == 7:
+            os.kill(os.getpid(), signal.SIGKILL)    # die MID-step-stream
+
+
+ckptr = ckpt.AsyncCheckpointer(os.path.join(work, f"ckpt_{rank}"),
+                               max_to_keep=3)
+model.fit(DS(), batch_size=4, epochs=1, verbose=0, shuffle=False,
+          checkpointer=ckptr, callbacks=[Chronicle()])
+ckptr.close()
+"""
+
+
+@pytest.mark.slow
+def test_gang_kill_recovery_parity(tmp_path):
+    """SIGKILL one worker mid-step in a --max_restarts=2 supervised
+    launch: the gang is killed and relaunched, workers resume from the
+    latest intact checkpoint, and the final loss matches an
+    uninterrupted run to 2e-4."""
+    r, rep = _launch(tmp_path, PARITY_TRAINER,
+                     ["--nproc", "2", "--max_restarts", "2"],
+                     env={"SUP_TEST_DIR": str(tmp_path)}, timeout=600)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert rep["restarts"] == 1 and rep["kind"] == "done"
+
+    rows = [json.loads(line) for line in
+            (tmp_path / "losses.jsonl").read_text().splitlines()]
+    final = {}
+    for row in rows:                     # last write wins per step
+        final[row["step"]] = row["loss"]
+    assert sorted(final) == list(range(10)), sorted(final)
+    gen1_steps = [row["step"] for row in rows if row["gen"] == 1]
+    if gen1_steps:
+        # the relaunched worker resumed from a checkpoint, not step 0
+        assert min(gen1_steps) >= 2, gen1_steps
+
+    # uninterrupted reference run (same seed/model/data, in-process)
+    import paddle_tpu as paddle
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.Tanh(),
+                               paddle.nn.Linear(8, 1))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    model.prepare(opt, paddle.nn.MSELoss())
+
+    class DS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            x = rng.rand(4).astype("float32")
+            return x, (x.sum(keepdims=True) * 0.5).astype("float32")
+
+        def __len__(self):
+            return 40
+
+    ref = []
+
+    class Rec(paddle.hapi.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            ref.append(float(logs["loss"]))
+
+    model.fit(DS(), batch_size=4, epochs=1, verbose=0, shuffle=False,
+              callbacks=[Rec()])
+    assert len(ref) == 10
+    np.testing.assert_allclose(final[9], ref[-1], rtol=2e-4, atol=1e-6)
+    # and the whole post-restart trajectory tracks the reference
+    np.testing.assert_allclose([final[s] for s in range(10)], ref,
+                               rtol=2e-4, atol=1e-6)

@@ -18,4 +18,9 @@ python -m pytest tests/test_profiler.py -q
 # caught with the op and var named.
 python tools/framework_lint.py
 python -m pytest tests/test_passes.py -q
+# Fault-tolerance chaos gate: a supervised Model.fit run under a fixed
+# chaos spec (one injected checkpoint-write failure + delayed store
+# RPCs) with a mid-run SIGKILL — must complete via verified-checkpoint
+# resume with the expected chaos.injected/launch.restarts counts.
+python tools/chaos_gate.py
 exec python -m pytest tests/ -q --runslow "$@"
