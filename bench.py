@@ -21,6 +21,9 @@ import numpy as np
 # observability snapshot (jit-cache hit rate, step p50/p95) in the JSON.
 # Off by default — tracing adds per-op host overhead to the eager paths.
 PROFILE = os.environ.get("BENCH_PROFILE", "") not in ("", "0")
+# BENCH_SERVE=1: also run the serving bench (InferenceEngine under
+# concurrent clients) and embed req/s + p50/p99 latency in the JSON.
+SERVE = os.environ.get("BENCH_SERVE", "") not in ("", "0")
 
 
 def _metrics_snapshot():
@@ -153,6 +156,11 @@ def main():
             result["metrics"] = _metrics_snapshot()
         except Exception as e:
             print(f"bench: metrics snapshot failed: {e!r}", file=sys.stderr)
+    if SERVE:
+        try:
+            result["serving"] = bench_serving(on_tpu)
+        except Exception as e:  # the headline metric must still print
+            print(f"bench: serving leg failed: {e!r}", file=sys.stderr)
     print(json.dumps(result))
 
 
@@ -215,6 +223,85 @@ def bench_resnet(on_tpu: bool):
     return {"value": round(imgs, 1), "unit": "imgs/s",
             "vs_baseline": round(imgs / (0.8 * 390.0), 3),
             "mfu": round(mfu, 3)}
+
+
+def bench_serving(on_tpu: bool):
+    """Serving throughput/latency through the real endpoint path: an
+    InferenceEngine (dynamic batching over a cloned-predictor pool,
+    paddle_tpu/serving/) hammered by concurrent client threads with
+    randomized batch sizes.  Reports req/s and p50/p99 end-to-end
+    latency plus batch-occupancy/compile accounting — the serving
+    analog of the seq/s training headline."""
+    import tempfile
+    import threading
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import serving
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.profiler import metrics as pm
+
+    paddle.seed(0)
+    if on_tpu:
+        d_in, d_hid, max_batch, clients, per_client = 256, 1024, 32, 16, 64
+    else:
+        d_in, d_hid, max_batch, clients, per_client = 32, 64, 8, 8, 25
+    net = nn.Sequential(nn.Linear(d_in, d_hid), nn.ReLU(),
+                        nn.Linear(d_hid, d_in))
+    prefix = os.path.join(tempfile.mkdtemp(prefix="bench_serve_"), "m")
+    paddle.jit.save(net, prefix, input_spec=[
+        InputSpec([-1, d_in], "float32", name="x")])
+    engine = serving.InferenceEngine(prefix, serving.EngineConfig(
+        max_batch_size=max_batch, batch_timeout_ms=2, num_workers=2,
+        max_queue=4 * clients))
+    lat = pm.histogram("serving.request.latency_ms")
+    occ = pm.histogram("serving.batch.occupancy")
+    lat.reset()
+    occ.reset()
+
+    # warmup: one request per bucket so compiles land outside the clock
+    for b in range(max_batch.bit_length()):
+        engine.infer([np.zeros((1 << b, d_in), np.float32)], timeout=300)
+    lat.reset()
+    occ.reset()
+
+    done = []
+
+    def client(tid):
+        rng = np.random.RandomState(tid)
+        n = 0
+        for _ in range(per_client):
+            x = rng.rand(int(rng.randint(1, max_batch // 2 + 1)),
+                         d_in).astype("float32")
+            try:
+                engine.infer([x], timeout=300)
+                n += 1
+            except serving.RequestRejected:
+                pass                       # shed under overload: not lost
+        done.append(n)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    engine.close()
+    served = sum(done)
+    snap = lat.snapshot()
+    occ_snap = occ.snapshot()
+    compiles = pm.get("serving.compile")
+    return {
+        "req_per_s": round(served / dt, 1),
+        "p50_ms": round(snap.get("p50") or 0.0, 3),
+        "p99_ms": round(snap.get("p99") or 0.0, 3),
+        "served": served,
+        "clients": clients,
+        "batch_occupancy_avg": round(occ_snap.get("avg") or 0.0, 2),
+        "compiles": compiles.value if compiles else 0,
+        "max_batch_size": max_batch,
+    }
 
 
 if __name__ == "__main__":

@@ -54,6 +54,7 @@ from . import metric  # noqa: F401
 from . import jit  # noqa: F401
 from . import static  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import vision  # noqa: F401
 from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401

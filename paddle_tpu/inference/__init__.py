@@ -16,6 +16,7 @@ from __future__ import annotations
 import enum
 import os
 import pickle
+import threading
 from typing import Dict, List, Optional
 
 import jax
@@ -297,7 +298,12 @@ class Predictor:
                                            for n in self._input_names}
         self._outputs: Dict[str, Tensor] = {n: Tensor(n)
                                             for n in self._output_names}
-        self._jit_holder: Dict[str, object] = {}
+        # shared by every clone: the jit wrapper, a lock serializing
+        # lazy one-time work (param materialization, name assignment),
+        # and the set of input-shape signatures seen so far (each new
+        # signature is one jit retrace+XLA compile)
+        self._jit_holder: Dict[str, object] = {"lock": threading.Lock(),
+                                               "shapes": set()}
         self._apply_precision(config)
 
     # -- precision pipeline (see Config.set_precision) -----------------
@@ -381,23 +387,32 @@ class Predictor:
             # precision-native program: the resident (reduced) params ARE
             # the program's parameter signature — nothing to cast back
             return self._params
+        if not self._dequant and self._out_dtype is None:
+            return self._params          # plain precision: lock-free
         if getattr(self, "_mat_params", None) is not None:
             return self._mat_params
-        if self._dequant:
-            from ..quantization import dequantize_weight_int8, QuantizedW
-            mat = {k: dequantize_weight_int8(v)
-                   if isinstance(v, QuantizedW) else v
-                   for k, v in self._params.items()}
-        elif self._out_dtype is not None:
-            # cast back ONLY the params we reduced — a natively-bf16
-            # param must keep its dtype or the exported signature breaks
-            mat = {k: v.astype(jnp.float32)
-                   if k in self._reduced_keys else v
-                   for k, v in self._params.items()}
-        else:
-            return self._params
-        self._mat_params = mat
-        self._params = mat  # free the reduced copy; clones share this
+        with self._jit_holder["lock"]:
+            # double-checked: a concurrent clone on the shared holder may
+            # have materialized while we waited
+            if getattr(self, "_mat_params", None) is not None:
+                return self._mat_params
+            if self._dequant:
+                from ..quantization import dequantize_weight_int8, \
+                    QuantizedW
+                mat = {k: dequantize_weight_int8(v)
+                       if isinstance(v, QuantizedW) else v
+                       for k, v in self._params.items()}
+            elif self._out_dtype is not None:
+                # cast back ONLY the params we reduced — a natively-bf16
+                # param must keep its dtype or the exported signature
+                # breaks
+                mat = {k: v.astype(jnp.float32)
+                       if k in self._reduced_keys else v
+                       for k, v in self._params.items()}
+            else:
+                return self._params
+            self._mat_params = mat
+            self._params = mat  # free the reduced copy; clones share this
         return mat
 
     def get_input_names(self) -> List[str]:
@@ -417,12 +432,19 @@ class Predictor:
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         if inputs is not None:
+            # PURE path: never touches the shared input/output handles,
+            # so any number of threads may call run(inputs=...) on one
+            # predictor (or its clones) concurrently.  Handle state is
+            # only for the reference-style copy_from_cpu/run()/
+            # copy_to_cpu protocol, which stays single-threaded.
             if len(inputs) != len(self._input_names):
                 raise ValueError(
                     f"run() got {len(inputs)} inputs but the model has "
                     f"{len(self._input_names)}: {self._input_names}")
-            for n, arr in zip(self._input_names, inputs):
-                self._inputs[n].copy_from_cpu(np.asarray(arr))
+            arrays = [jnp.asarray(np.asarray(a)) for a in inputs]
+            flat = self._run_arrays(arrays)
+            self._ensure_output_names(len(flat))
+            return [np.asarray(v) for v in flat]
         arrays = []
         for n in self._input_names:
             h = self._inputs[n]
@@ -430,21 +452,80 @@ class Predictor:
                 raise RuntimeError(f"input '{n}' not set; call "
                                    "get_input_handle(name).copy_from_cpu")
             arrays.append(h._value)
+        flat = self._run_arrays(arrays)
+        self._ensure_output_names(len(flat))
+        for n, v in zip(self._output_names, flat):
+            self._outputs[n]._value = v
+        return True
+
+    def _run_arrays(self, arrays: List) -> List:
+        self._track_retrace(arrays)
         out = self._compiled_call()(*([self._materialize_params(),
                                        self._buffers] if self._kind ==
                                       "layer" else []), *arrays)
+        return self._finalize_outputs(out)
+
+    def _finalize_outputs(self, out) -> List:
+        """Flatten the program's output pytree and apply the legacy
+        storage-precision boundary cast.  The serving engine's bucketed
+        executor shares this so served outputs can never drift from
+        ``run()``'s precision semantics."""
         flat = jax.tree_util.tree_leaves(out)
         if self._out_dtype is not None:
             flat = [v.astype(self._out_dtype)
                     if v.dtype == jnp.float32 else v for v in flat]
-        if not self._output_names:
-            self._output_names = [f"output_{i}" for i in range(len(flat))]
-            self._outputs = {n: Tensor(n) for n in self._output_names}
-        for n, v in zip(self._output_names, flat):
-            self._outputs[n]._value = v
-        if inputs is not None:
-            return [np.asarray(v) for v in flat]
-        return True
+        return flat
+
+    def _ensure_output_names(self, n: int):
+        """Unnamed artifacts materialize output names on first run;
+        names only — output handle VALUES are never written here."""
+        if self._output_names:
+            return
+        with self._jit_holder["lock"]:
+            if not self._output_names:
+                names = [f"output_{i}" for i in range(n)]
+                self._outputs = {m: Tensor(m) for m in names}
+                self._output_names = names
+
+    def _track_retrace(self, arrays: List):
+        """Each distinct input-shape signature is one jit retrace + XLA
+        compile of the exported program (the signature set is shared by
+        clones, exactly like the underlying jit cache).  Counts
+        ``inference.retrace`` and warns once past the flag threshold,
+        pointing at serving's shape bucketing."""
+        holder = self._jit_holder
+        sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        if sig in holder["shapes"]:
+            return
+        with holder["lock"]:
+            if sig in holder["shapes"]:
+                return
+            holder["shapes"].add(sig)
+            n_shapes = len(holder["shapes"])
+            from ..profiler import metrics as _metrics
+            _metrics.counter(
+                "inference.retrace",
+                "distinct input-shape signatures compiled by Predictor "
+                "(one jit retrace + XLA compile each; shared by clones)"
+            ).inc()   # under the lock: concurrent novel shapes must
+            # both land (the registry's inc is deliberately lock-free)
+        from ..utils import flags as _flags
+        try:
+            threshold = int(_flags.get_flag(
+                "FLAGS_inference_retrace_warn"))
+        except KeyError:  # pragma: no cover - flag always defined
+            threshold = 8
+        if n_shapes > threshold and not holder.get("retrace_warned"):
+            holder["retrace_warned"] = True
+            import warnings
+            warnings.warn(
+                f"Predictor has retraced+recompiled for {n_shapes} "
+                "distinct input shapes (each novel shape pays a full "
+                "XLA compile). Pad inputs to a bounded shape set, or "
+                "serve through paddle_tpu.serving.InferenceEngine — "
+                "its shape bucketing caps total compiles at the bucket "
+                "count (FLAGS_inference_retrace_warn sets this "
+                "threshold)", UserWarning, stacklevel=4)
 
     def _compiled_call(self):
         """jax.jit wrapper around the exported program, built once and
@@ -456,8 +537,13 @@ class Predictor:
         (analysis_predictor.cc:342 PrepareExecutor, reused by ZeroCopyRun)."""
         holder = self._jit_holder
         if holder.get("for") is not self._exported:
-            holder["fn"] = jax.jit(self._exported.call)
-            holder["for"] = self._exported
+            with holder["lock"]:
+                # double-checked: concurrent cold-start runs must share
+                # ONE wrapper, or each thread pays a duplicate XLA
+                # compile of the same program+shape
+                if holder.get("for") is not self._exported:
+                    holder["fn"] = jax.jit(self._exported.call)
+                    holder["for"] = self._exported
         return holder["fn"]
 
     def clone(self):

@@ -1,0 +1,40 @@
+"""``paddle_tpu.serving`` — turn a saved inference artifact into a
+servable endpoint.
+
+The synchronous ``paddle_tpu.inference.Predictor`` answers one request
+per dispatch.  This package adds the serving layer the ROADMAP's
+"heavy traffic from millions of users" goal needs (reference parity:
+Paddle's AnalysisPredictor + Clone multi-threaded serving stack, grown
+with Orca/Clipper-style dynamic batching):
+
+- :class:`InferenceEngine` (``engine.py``): bounded request queue,
+  dynamic batcher (``max_batch_size`` / ``batch_timeout_ms``), futures
+  fan-out, pool of ``Predictor.clone()`` workers sharing one set of
+  device weights;
+- shape bucketing + compiled-executable cache (``bucketing.py``):
+  total XLA compiles bounded by the bucket count, not by observed
+  shapes;
+- admission control (``admission.py``): queue-depth bound, per-request
+  deadlines, explicit overload rejection with SLO metrics;
+- HTTP frontend (``server.py``): ``/v1/infer`` (JSON or .npz),
+  ``/healthz``, Prometheus ``/metrics``.
+
+Quick start::
+
+    from paddle_tpu import serving
+    engine = serving.InferenceEngine("model", serving.EngineConfig(
+        max_batch_size=16, batch_timeout_ms=3, num_workers=2))
+    out, = engine.infer([x])              # in-process
+    serving.ServingServer(engine).start() # ... or over HTTP
+"""
+from .admission import (AdmissionController, DeadlineExceeded,
+                        EngineClosed, RequestRejected)
+from .bucketing import BucketPolicy, ExecutableCache, next_bucket, \
+    pad_batch
+from .engine import EngineConfig, InferenceEngine, validate_artifact
+from .server import ServingServer, serve
+
+__all__ = ["InferenceEngine", "EngineConfig", "ServingServer", "serve",
+           "RequestRejected", "DeadlineExceeded", "EngineClosed",
+           "AdmissionController", "BucketPolicy", "ExecutableCache",
+           "next_bucket", "pad_batch", "validate_artifact"]

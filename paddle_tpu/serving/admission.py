@@ -1,0 +1,138 @@
+"""Admission control for the serving engine: shed, don't OOM.
+
+An overloaded accelerator endpoint has exactly three honest choices:
+queue (bounded!), reject explicitly, or fall over.  This module owns
+the first two.  Every request passes :meth:`AdmissionController.admit`
+before it may enter the engine queue:
+
+- queue depth beyond ``max_queue``  -> :class:`RequestRejected`
+  (``queue_full``) — the client sees backpressure immediately instead
+  of a timeout after unbounded buffering;
+- request rows beyond ``max_batch_size`` -> :class:`RequestRejected`
+  (``too_large``) — a request the batcher could never place;
+- engine closed -> :class:`RequestRejected` (``closed``).
+
+Per-request deadlines produce :class:`DeadlineExceeded` when a request
+expires while still queued (the batcher sheds it without running) or
+when the client-side wait runs out.  All outcomes are SLO-accounted in
+the metrics registry: ``serving.request.admitted``,
+``serving.request.rejected[.reason]``, ``serving.request.shed_deadline``,
+``serving.queue_depth``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+__all__ = ["RequestRejected", "DeadlineExceeded", "EngineClosed",
+           "AdmissionController"]
+
+
+class RequestRejected(RuntimeError):
+    """Explicit overload rejection; ``reason`` is one of ``queue_full``,
+    ``too_large``, ``closed``."""
+
+    def __init__(self, msg: str, reason: str = "overload"):
+        super().__init__(msg)
+        self.reason = reason
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before a result was produced."""
+
+
+class EngineClosed(RequestRejected):
+    def __init__(self, msg: str = "engine is closed"):
+        super().__init__(msg, reason="closed")
+
+
+class AdmissionController:
+    """Bounded-queue gatekeeper with SLO counters.
+
+    ``acquire``/``release`` bracket a request's time in the pending
+    queue; the gauge tracks live depth so ``/metrics`` shows queue
+    pressure directly.
+    """
+
+    def __init__(self, max_queue: int, max_rows: Optional[int] = None,
+                 name: str = "serving"):
+        self.max_queue = int(max_queue)
+        self.max_rows = max_rows
+        self._depth = 0
+        self._lock = threading.Lock()
+        from ..profiler import metrics as _metrics
+        self._admitted = _metrics.counter(
+            f"{name}.request.admitted", "requests accepted into the "
+            "engine queue")
+        self._rejected = _metrics.counter(
+            f"{name}.request.rejected", "requests explicitly rejected "
+            "at admission (all reasons)")
+        self._shed = _metrics.counter(
+            f"{name}.request.shed_deadline", "queued requests dropped "
+            "because their deadline expired before execution")
+        self._depth_gauge = _metrics.gauge(
+            f"{name}.queue_depth", "requests currently waiting in the "
+            "engine queue")
+        self._name = name
+        self._closed = False
+
+    # -- lifecycle ----------------------------------------------------
+    def close(self):
+        self._closed = True
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    # -- admission ----------------------------------------------------
+    def _reject(self, reason: str, msg: str):
+        from ..profiler import metrics as _metrics
+        with self._lock:   # exact counts even under concurrent clients
+            self._rejected.inc()
+            _metrics.counter(
+                f"{self._name}.request.rejected.{reason}").inc()
+        if reason == "closed":
+            raise EngineClosed(msg)
+        raise RequestRejected(msg, reason=reason)
+
+    def acquire(self, rows: int = 1):
+        """Admit one request of ``rows`` samples or raise
+        :class:`RequestRejected`."""
+        if self._closed:
+            self._reject("closed", "engine is closed")
+        if self.max_rows is not None and rows > self.max_rows:
+            self._reject(
+                "too_large",
+                f"request carries {rows} rows but max_batch_size is "
+                f"{self.max_rows}; split the request (a batch the "
+                "engine could never place would wait forever)")
+        with self._lock:
+            if self._depth >= self.max_queue:
+                depth = self._depth
+            else:
+                self._depth += 1
+                self._depth_gauge.set(self._depth)
+                self._admitted.inc()
+                return
+        self._reject(
+            "queue_full",
+            f"engine queue is full ({depth}/{self.max_queue} waiting); "
+            "overload is shed explicitly — retry with backoff or scale "
+            "workers (EngineConfig.max_queue bounds this)")
+
+    def release(self):
+        """The request left the queue (picked into a batch or shed)."""
+        with self._lock:
+            self._depth = max(0, self._depth - 1)
+            self._depth_gauge.set(self._depth)
+
+    def shed_deadline(self):
+        self._shed.inc()
+
+
+def deadline_from_ms(deadline_ms: Optional[float]) -> Optional[float]:
+    """Monotonic absolute deadline from a relative millisecond budget."""
+    if deadline_ms is None:
+        return None
+    return time.monotonic() + float(deadline_ms) / 1e3

@@ -1,0 +1,176 @@
+"""Shape bucketing + compiled-executable cache for serving.
+
+Every novel input shape handed to an exported XLA program is a full
+retrace + compile (seconds); a serving fleet that forwards raw client
+shapes compiles without bound.  Bucketing rounds the batch dim (and,
+opt-in, any dynamic non-batch dim) up to the next power-of-two bucket,
+so the executable population is bounded by the bucket count no matter
+what shapes clients send: ``ceil(log2(max_batch_size)) + 1`` batch
+buckets instead of one program per observed batch size.
+
+The :class:`ExecutableCache` maps ``(bucket signature, precision)`` to
+an ahead-of-time compiled executable (``jax.jit(...).lower().compile()``)
+shared by every predictor worker; misses are compiles and are counted
+(``serving.compile``), which is what the serving gate bounds.
+
+Reference points: Orca/Clipper-style serving batchers bucket padded
+batches the same way; the reference Paddle bounds executables per
+predictor via its ZeroCopy shape contract.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["next_bucket", "bucket_shape", "pad_batch", "BucketPolicy",
+           "ExecutableCache"]
+
+
+def next_bucket(n: int, min_bucket: int = 1, cap: Optional[int] = None
+                ) -> int:
+    """Smallest power-of-two >= ``n`` (and >= ``min_bucket``), clamped
+    to ``cap`` when given.  ``n`` itself is returned when it exceeds the
+    cap — the caller decided to admit it, so it gets its own bucket."""
+    if n < 0:
+        raise ValueError(f"bucket size for negative dim {n}")
+    b = max(int(min_bucket), 1)
+    while b < n:
+        b <<= 1
+    if cap is not None and b > cap:
+        return n if n > cap else cap
+    return b
+
+
+class BucketPolicy:
+    """Which dims of each input get padded, and to what bucket.
+
+    ``dynamic_dims`` comes from the artifact's declared input avals
+    (``-1`` entries).  Dim 0 of every input is the batch dim and is
+    always bucketed (the engine concatenates requests along it); other
+    dynamic dims are bucketed only with ``pad_dynamic_dims=True``
+    because zero-padding a content dim (e.g. sequence length) changes
+    the math for models without masking — the engine documents that
+    contract rather than silently corrupting outputs.
+    """
+
+    def __init__(self, input_avals: Sequence[Tuple[Sequence[int], str]],
+                 max_batch_size: int = 8, min_batch_bucket: int = 1,
+                 pad_dynamic_dims: bool = False):
+        self.max_batch_size = int(max_batch_size)
+        self.min_batch_bucket = int(min_batch_bucket)
+        self.pad_dynamic_dims = bool(pad_dynamic_dims)
+        # per input: indices of non-batch dims declared dynamic
+        self.dynamic_dims: List[Tuple[int, ...]] = []
+        for shape, _dt in input_avals or ():
+            self.dynamic_dims.append(tuple(
+                i for i, d in enumerate(shape)
+                if i > 0 and (d is None or int(d) < 0)))
+
+    def batch_bucket(self, rows: int) -> int:
+        return next_bucket(rows, self.min_batch_bucket,
+                           cap=self.max_batch_size)
+
+    def bucket_shape(self, idx: int, shape: Sequence[int],
+                     rows_bucket: int) -> Tuple[int, ...]:
+        """Padded shape for input ``idx``: batch dim -> ``rows_bucket``,
+        dynamic dims -> pow2 buckets when enabled, rest untouched."""
+        out = list(shape)
+        out[0] = rows_bucket
+        if self.pad_dynamic_dims and idx < len(self.dynamic_dims):
+            for d in self.dynamic_dims[idx]:
+                if d < len(out):
+                    out[d] = next_bucket(out[d])
+        return tuple(out)
+
+    def max_buckets(self) -> int:
+        """Upper bound on batch buckets (compile-count bound when
+        dynamic-dim padding is off and non-batch shapes are fixed)."""
+        n, b = 1, max(self.min_batch_bucket, 1)
+        while b < self.max_batch_size:
+            b <<= 1
+            n += 1
+        return n
+
+
+def bucket_shape(shape: Sequence[int], max_batch_size: int = 8
+                 ) -> Tuple[int, ...]:
+    """Convenience: bucket dim 0 of ``shape`` (pow2, capped)."""
+    out = list(shape)
+    out[0] = next_bucket(out[0], cap=max_batch_size)
+    return tuple(out)
+
+
+def pad_batch(arr, target_shape: Sequence[int]):
+    """Zero-pad ``arr`` up to ``target_shape`` (no dim may shrink).
+    Returns ``arr`` unchanged when the shape already matches."""
+    import numpy as np
+    shape = tuple(arr.shape)
+    target = tuple(int(t) for t in target_shape)
+    if shape == target:
+        return arr
+    if len(shape) != len(target) or any(t < s for s, t in zip(shape,
+                                                              target)):
+        raise ValueError(f"cannot pad {shape} to {target}")
+    out = np.zeros(target, dtype=arr.dtype)
+    out[tuple(slice(0, s) for s in shape)] = np.asarray(arr)
+    return out
+
+
+class ExecutableCache:
+    """``(bucket signature, precision) -> compiled executable``.
+
+    ``get_or_compile`` is hit/miss accounted in the metrics registry
+    (``serving.executable_cache.hit`` / ``serving.compile``); a miss
+    runs ``compile_fn`` exactly once per key even under concurrent
+    workers (per-key in-flight latch), so total compiles stay bounded
+    by the number of distinct bucket keys.
+    """
+
+    def __init__(self, name: str = "serving"):
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple, object] = {}
+        self._inflight: Dict[Tuple, threading.Event] = {}
+        from ..profiler import metrics as _metrics
+        self._hits = _metrics.counter(
+            f"{name}.executable_cache.hit",
+            "bucketed executions served by an already-compiled "
+            "executable")
+        self._compiles = _metrics.counter(
+            f"{name}.compile",
+            "executable-cache misses == XLA compiles; bounded by the "
+            "bucket count")
+
+    def __len__(self):
+        return len(self._entries)
+
+    def keys(self):
+        with self._lock:
+            return list(self._entries)
+
+    def get_or_compile(self, key: Tuple, compile_fn: Callable[[], object]):
+        while True:
+            with self._lock:
+                if key in self._entries:
+                    self._hits.inc()
+                    return self._entries[key]
+                latch = self._inflight.get(key)
+                if latch is None:
+                    self._inflight[key] = latch = threading.Event()
+                    owner = True
+                else:
+                    owner = False
+            if not owner:
+                latch.wait()
+                continue  # re-read: owner published (or failed)
+            try:
+                exe = compile_fn()
+                with self._lock:
+                    self._entries[key] = exe
+                    # under the lock: the registry's inc is lock-free
+                    # and the serving gate asserts exact compile counts
+                    self._compiles.inc()
+                return exe
+            finally:
+                with self._lock:
+                    self._inflight.pop(key, None)
+                latch.set()

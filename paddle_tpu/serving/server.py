@@ -1,0 +1,219 @@
+"""Stdlib HTTP frontend for the InferenceEngine.
+
+No framework dependency — ``http.server.ThreadingHTTPServer`` is enough
+because the engine already owns queueing, batching, and admission; each
+HTTP handler thread just parks on its request future.  Endpoints:
+
+    POST /v1/infer    JSON  {"inputs": {name: nested-list}, ...}
+                      or an .npz body (Content-Type application/x-npz)
+                      with one array per input name
+    GET  /healthz     liveness + pool/queue snapshot (JSON)
+    GET  /metrics     Prometheus text exposition of the whole profiler
+                      metrics registry (PR 1 exporter)
+
+Status mapping: 200 ok, 400 malformed payload, 429 admission rejection
+(overload — shed, don't OOM), 503 engine closed, 504 deadline exceeded.
+"""
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .admission import DeadlineExceeded, EngineClosed, RequestRejected
+
+__all__ = ["ServingServer", "serve"]
+
+
+def _json_default(o):
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, (np.generic,)):
+        return o.item()
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the engine is attached to the server object by ServingServer
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if getattr(self.server, "verbose", False):  # pragma: no cover
+            super().log_message(fmt, *args)
+
+    # -- helpers -------------------------------------------------------
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj):
+        self._send(code, json.dumps(obj, default=_json_default)
+                   .encode(), "application/json")
+
+    # -- GET -----------------------------------------------------------
+    def do_GET(self):  # noqa: N802 - stdlib handler naming
+        engine = self.server.engine
+        if self.path == "/healthz":
+            from ..profiler import metrics as _metrics
+            depth = _metrics.get(
+                getattr(engine, "metrics_prefix", "serving")
+                + ".queue_depth")
+            self._send_json(200, {
+                "status": "ok",
+                "model_inputs": engine.input_names,
+                "workers": engine.config.num_workers,
+                "max_batch_size": engine.config.max_batch_size,
+                "queue_depth": depth.value if depth else 0,
+            })
+        elif self.path == "/metrics":
+            from ..profiler import metrics as _metrics
+            self._send(200, _metrics.prometheus_text().encode(),
+                       "text/plain; version=0.0.4")
+        else:
+            self._send_json(404, {"error": f"no route {self.path}; "
+                                  "try /v1/infer, /healthz, /metrics"})
+
+    # -- POST ----------------------------------------------------------
+    def do_POST(self):  # noqa: N802
+        if self.path not in ("/v1/infer", "/infer"):
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        engine = self.server.engine
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            cap = getattr(self.server, "max_body_bytes", 0)
+            if cap and length > cap:
+                # shed, don't OOM — the admission contract applies to
+                # payload bytes too, BEFORE buffering the body
+                self._send_json(413, {
+                    "error": f"request body {length} bytes exceeds the "
+                             f"server cap {cap}",
+                    "reason": "body_too_large"})
+                return
+            body = self.rfile.read(length)
+            ctype = (self.headers.get("Content-Type") or "").lower()
+            deadline_ms = self.headers.get("X-Deadline-Ms")
+            if "json" in ctype or not ctype:
+                payload = json.loads(body.decode() or "{}")
+                inputs = payload.get("inputs", payload)
+                if deadline_ms is None:
+                    deadline_ms = payload.get("deadline_ms")
+                inputs = self._decode_json_inputs(engine, inputs)
+                as_npz = False
+            else:  # .npz / binary payload
+                with np.load(io.BytesIO(body), allow_pickle=False) as z:
+                    inputs = {n: z[n] for n in z.files}
+                as_npz = True
+        except Exception as e:
+            self._send_json(400, {"error": f"malformed payload: {e}"})
+            return
+        try:
+            kwargs = {}
+            if deadline_ms is not None:
+                kwargs["deadline_ms"] = float(deadline_ms)
+            outs = engine.infer(inputs, **kwargs)
+        except EngineClosed as e:
+            self._send_json(503, {"error": str(e), "reason": e.reason})
+            return
+        except RequestRejected as e:
+            self._send_json(429, {"error": str(e), "reason": e.reason})
+            return
+        except DeadlineExceeded as e:
+            self._send_json(504, {"error": str(e),
+                                  "reason": "deadline"})
+            return
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        except Exception as e:  # model-side failure
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        names = [f"output_{i}" for i in range(len(outs))] \
+            if not engine._base._output_names else \
+            list(engine._base._output_names)
+        if as_npz:
+            buf = io.BytesIO()
+            np.savez(buf, **dict(zip(names, outs)))
+            self._send(200, buf.getvalue(), "application/x-npz")
+        else:
+            self._send_json(200, {"outputs": dict(zip(names, outs))})
+
+    @staticmethod
+    def _decode_json_inputs(engine, inputs):
+        if isinstance(inputs, dict):
+            return {n: np.asarray(v) for n, v in inputs.items()}
+        if isinstance(inputs, list):
+            return [np.asarray(v) for v in inputs]
+        raise ValueError("'inputs' must be a dict {name: array} or a "
+                         "positional list of arrays")
+
+
+class ServingServer:
+    """Owns a ThreadingHTTPServer bound to ``engine``.
+
+    ``start()`` serves on a daemon thread and returns; ``stop()`` shuts
+    the listener down (the engine itself is NOT closed — callers own its
+    lifecycle, so one engine can outlive server restarts)."""
+
+    def __init__(self, engine, host: str = "127.0.0.1",
+                 port: int = 0, verbose: bool = False,
+                 max_body_bytes: int = 64 << 20):
+        self.engine = engine
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.engine = engine
+        self._httpd.verbose = verbose
+        self._httpd.max_body_bytes = int(max_body_bytes)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ServingServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serving-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def serve(model, host: str = "127.0.0.1", port: int = 8975,
+          config=None, block: bool = True):
+    """One-call endpoint: build an InferenceEngine over ``model`` (path
+    prefix / inference.Config / Predictor) and serve it over HTTP.
+    ``block=False`` returns the started :class:`ServingServer`."""
+    from .engine import InferenceEngine
+    owns_engine = not isinstance(model, InferenceEngine)
+    engine = model if not owns_engine \
+        else InferenceEngine(model, config=config)
+    server = ServingServer(engine, host=host, port=port).start()
+    if not block:
+        return server
+    try:  # pragma: no cover - interactive path
+        while True:
+            import time
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        if owns_engine:   # a caller-provided engine outlives the server
+            engine.close()
+    return server

@@ -78,6 +78,7 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
         from .program import _build_runner
         runner = _build_runner(infer_prog, tuple(fetch_names), ())
         params = {n: p._data for n, p in infer_prog.parameters.items()}
+        desc_prog = infer_prog
 
         def infer(*arrays):
             fetches, _ = runner(dict(zip(feed_names, arrays)), params,
@@ -93,6 +94,27 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
             "fetch_names": fetch_names,
             "input_avals": [(list(shape), str(dt))
                             for shape, dt in shapes_dtypes]}
+    if program._build_fn is None and program.ops:
+        # op-level description of the exported (eval-cloned) program so
+        # artifact consumers can re-verify it without the model code —
+        # paddle_tpu.serving runs the static-analysis verify pass over
+        # this once at artifact load (prog-san, PR 2)
+        from .serialization import _op_table
+
+        def _dt(v):
+            try:
+                return str(np.dtype(v.dtype))
+            except TypeError:  # pragma: no cover - exotic dtype object
+                return str(v.dtype)
+        meta["program_desc"] = {
+            "ops": _op_table(desc_prog),
+            "placeholders": {n: (list(v.declared_shape), _dt(v))
+                             for n, v in desc_prog._placeholders.items()},
+            "parameters": sorted(desc_prog.parameters),
+            "constants": sorted(desc_prog.constants),
+            "state_vars": sorted(desc_prog.state_vars),
+            "fetch_names": list(fetch_names),
+        }
     with open(path_prefix + ".pdiparams", "wb") as f:
         pickle.dump(meta, f, protocol=4)
     return path_prefix

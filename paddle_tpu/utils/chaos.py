@@ -29,6 +29,8 @@ matching the PR-1 instrumentation discipline)::
     fs.rename      fleet/utils/fs.py LocalFS.mv/rename
     loader.worker  io DataLoader sample fetch
     step.loss      hapi Model train step (``nan`` poisons the loss)
+    serve.request  serving InferenceEngine admission (``fail`` rejects
+                   the request at submit, ``delay`` stalls the client)
 
 Injections are counted in the metrics registry: ``chaos.injected``
 (total) and ``chaos.injected.<site>``.
@@ -46,7 +48,7 @@ __all__ = ["active", "ChaosError", "SITES", "parse_spec", "configure",
            "refresh", "hit", "call_count", "reset"]
 
 SITES = ("ckpt.write", "store.rpc", "fs.rename", "loader.worker",
-         "step.loss")
+         "step.loss", "serve.request")
 
 # module-level fast predicate — the single read hot paths gate on
 active = False

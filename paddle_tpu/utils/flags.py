@@ -134,6 +134,17 @@ define_flag("FLAGS_watchdog_timeout", 60.0,
             "whose heartbeat step has not advanced for this many "
             "seconds is declared hung; the gang is killed and "
             "relaunched (TorchElastic-style supervised restart)")
+define_flag("FLAGS_inference_retrace_warn", 8,
+            "warn once when a Predictor (with its clones) has "
+            "jit-retraced for more than this many distinct input-shape "
+            "signatures — every novel shape pays a full XLA compile; "
+            "serving's shape bucketing bounds this "
+            "(paddle_tpu/serving/bucketing.py)")
+define_flag("FLAGS_serving_queue_depth", 128,
+            "default InferenceEngine admission bound: requests waiting "
+            "beyond this depth are rejected with RequestRejected "
+            "(shed, don't OOM); per-engine override via "
+            "EngineConfig.max_queue")
 define_flag("FLAGS_anomaly_action", "",
             "hapi Model.fit guard on nan/inf loss: '' (off, keeps the "
             "lazy-loss pipeline), 'raise' (FloatingPointError at the "

@@ -253,6 +253,163 @@ class TestPrecisionPipeline:
             assert not w, [str(x.message) for x in w]
 
 
+class TestThreadSafetyAndRetrace:
+    """ISSUE 4 satellites: run(inputs=...) is a pure path safe under
+    threads, and retraces are counted/warned."""
+
+    def test_explicit_inputs_never_touch_handles(self, artifact):
+        prefix, x, want = artifact
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(prefix))
+        handle_x = np.zeros((2, 8), np.float32)
+        pred.get_input_handle("x").copy_from_cpu(handle_x)
+        # explicit-inputs run must not clobber the staged handle value
+        # (the old implementation wrote through self._inputs)
+        np.testing.assert_allclose(pred.run([x])[0], want, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(pred.get_input_handle("x")._value), handle_x)
+        # nor the output handles: handle-protocol outputs still come
+        # from the handle-path run
+        assert pred.run() is True
+        out0 = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        want0 = pred.run([handle_x])[0]
+        np.testing.assert_array_equal(out0, want0)
+
+    def test_concurrent_runs_on_one_predictor(self, artifact):
+        import threading
+        prefix, _, _ = artifact
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(prefix))
+        xs = [np.random.RandomState(i).rand(2, 8).astype("float32")
+              for i in range(8)]
+        wants = [pred.run([x])[0] for x in xs]
+        results = [None] * 8
+        errors = []
+
+        def worker(i):
+            try:
+                for _ in range(5):
+                    results[i] = pred.run([xs[i]])[0]
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for got, want in zip(results, wants):
+            np.testing.assert_array_equal(got, want)
+
+    def test_retrace_metric_counts_distinct_shapes(self, tmp_path):
+        from paddle_tpu.profiler import metrics
+        paddle.seed(7)
+        net = SmallNet()
+        prefix = str(tmp_path / "retrace")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([-1, 8], "float32",
+                                              name="x")])
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(prefix))
+        before = metrics.counter("inference.retrace").value
+        for bs in (1, 2, 3, 2, 1, 5):     # 4 distinct, 2 repeats
+            pred.run([np.zeros((bs, 8), np.float32)])
+        assert metrics.counter("inference.retrace").value - before == 4
+        # clones share the signature set: no double counting
+        pred.clone().run([np.zeros((3, 8), np.float32)])
+        assert metrics.counter("inference.retrace").value - before == 4
+
+    def test_retrace_warns_once_past_threshold(self, tmp_path):
+        import warnings
+        paddle.seed(8)
+        net = SmallNet()
+        prefix = str(tmp_path / "warn")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([-1, 8], "float32",
+                                              name="x")])
+        pred = paddle.inference.create_predictor(
+            paddle.inference.Config(prefix))
+        paddle.set_flags({"FLAGS_inference_retrace_warn": 2})
+        try:
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                for bs in range(1, 6):
+                    pred.run([np.zeros((bs, 8), np.float32)])
+            hits = [x for x in w if "retraced" in str(x.message)]
+            assert len(hits) == 1          # warn ONCE, not per shape
+            assert "serving.InferenceEngine" in str(hits[0].message)
+        finally:
+            paddle.set_flags({"FLAGS_inference_retrace_warn": 8})
+
+
+class TestCloneWeightSharing:
+    """ISSUE 4 satellite: clones must share ONE materialized param dict
+    (identity, not equality) and one _jit_holder under every precision."""
+
+    def _pred(self, prefix, precision):
+        cfg = paddle.inference.Config(prefix)
+        cfg.set_precision(precision)
+        return paddle.inference.create_predictor(cfg)
+
+    @pytest.mark.parametrize("precision", [
+        paddle.inference.PrecisionType.Float32,
+        paddle.inference.PrecisionType.Half,
+        paddle.inference.PrecisionType.Bfloat16,
+        paddle.inference.PrecisionType.Int8,
+    ])
+    def test_clones_share_params_and_jit(self, artifact, precision):
+        prefix, x, _ = artifact
+        pred = self._pred(prefix, precision)
+        clones = [pred.clone() for _ in range(3)]
+        nested = clones[0].clone()          # clone-of-clone shares too
+        for c in clones + [nested]:
+            assert c._params is pred._params
+            assert c._buffers is pred._buffers
+            assert c._jit_holder is pred._jit_holder
+        # still identical AFTER running (run must not re-materialize a
+        # private copy anywhere)
+        outs = [np.asarray(c.run([x])[0], np.float32)
+                for c in [pred] + clones + [nested]]
+        for c in clones + [nested]:
+            assert c._params is pred._params
+            assert c._materialize_params() is pred._materialize_params()
+        for o in outs[1:]:
+            np.testing.assert_array_equal(outs[0], o)
+
+    def test_legacy_storage_path_shares_materialized_dict(
+            self, artifact, tmp_path):
+        """The pre-r5 fallback (storage-reduced, f32 program) is where a
+        private per-clone copy would silently double HBM — the clone
+        must share the SOURCE's materialized dict."""
+        import pickle
+        import shutil
+        import warnings
+        prefix, x, _ = artifact
+        legacy = str(tmp_path / "legacy")
+        shutil.copy(prefix + ".pdmodel", legacy + ".pdmodel")
+        with open(prefix + ".pdiparams", "rb") as f:
+            meta = pickle.load(f)
+        meta.pop("programs", None)
+        meta.pop("int8_keys", None)
+        with open(legacy + ".pdiparams", "wb") as f:
+            pickle.dump(meta, f, protocol=4)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pred = self._pred(legacy,
+                              paddle.inference.PrecisionType.Bfloat16)
+        c1, c2 = pred.clone(), pred.clone()
+        assert c1._materialize_params() is c2._materialize_params()
+        assert c1._materialize_params() is pred._materialize_params()
+        assert c1._jit_holder is pred._jit_holder
+        np.testing.assert_array_equal(
+            np.asarray(c1.run([x])[0], np.float32),
+            np.asarray(c2.run([x])[0], np.float32))
+
+
 class TestPrecisionExecutesReduced:
     """Round-5 (verdict item 4): set_precision changes the EXECUTED
     program, not just storage — asserted on the StableHLO the Predictor

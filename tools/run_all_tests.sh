@@ -23,4 +23,10 @@ python -m pytest tests/test_passes.py -q
 # RPCs) with a mid-run SIGKILL — must complete via verified-checkpoint
 # resume with the expected chaos.injected/launch.restarts counts.
 python tools/chaos_gate.py
+# Serving gate: the InferenceEngine under concurrent synthetic clients
+# with a fixed serve.request chaos spec — zero lost requests (bit-exact
+# vs unbatched Predictor.run), exactly one injected failure, exact
+# queue_full shed count at the admission bound, and total XLA compiles
+# bounded by the shape-bucket count.
+python tools/serving_gate.py
 exec python -m pytest tests/ -q --runslow "$@"

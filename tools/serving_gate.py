@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""CI serving gate: the InferenceEngine under concurrent synthetic
+clients with a FIXED chaos spec must lose nothing, shed exactly what
+admission control says it shed, and compile no more executables than
+the bucket count allows.
+
+Three phases:
+
+1. soak — 4 client threads x 8 requests of randomized batch sizes under
+   ``serve.request:fail@7`` (the 7th admission, globally, is injected to
+   fail): every request must either succeed bit-exactly vs a reference
+   ``Predictor.run`` or be the single injected ChaosError; zero lost.
+2. overload — queue paused, ``max_queue`` requests parked, the next R
+   submits must each be rejected with ``queue_full`` (exact shed
+   count), then the parked requests must all complete after resume.
+3. accounting — total XLA compiles (``serving.compile``) <= the bucket
+   count; completed + failed + rejected + shed tallies exactly match
+   what was submitted.
+
+Wired into tools/run_all_tests.sh next to the chaos gate.
+"""
+import os
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+CHAOS_SPEC = "serve.request:fail@7"
+CLIENTS, PER_CLIENT = 4, 8
+OVERLOAD_EXTRA = 3
+
+
+def val(name):
+    from paddle_tpu.profiler import metrics
+    m = metrics.get(name)
+    return m.value if m is not None else 0
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import serving
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.utils import chaos
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    prefix = os.path.join(tempfile.mkdtemp(prefix="serving_gate_"), "m")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([-1, 8], "float32", name="x")])
+    reference = paddle.inference.create_predictor(
+        paddle.inference.Config(prefix))
+
+    engine = serving.InferenceEngine(prefix, serving.EngineConfig(
+        max_batch_size=8, batch_timeout_ms=5, num_workers=2,
+        max_queue=16))
+
+    # -- phase 1: chaos soak ------------------------------------------
+    paddle.set_flags({"FLAGS_chaos_spec": CHAOS_SPEC})
+    ok, injected, lost = [], [], []
+
+    def client(tid):
+        rng = np.random.RandomState(100 + tid)
+        for _ in range(PER_CLIENT):
+            # rows 2..8: batched rows are bit-identical to unbatched
+            # runs for M >= 2 (XLA's M=1 gemv specialization is the one
+            # batch-size-dependent path; rows=1 ulp semantics are
+            # covered in tests/test_serving.py)
+            x = rng.rand(int(rng.randint(2, 9)), 8).astype("float32")
+            try:
+                out, = engine.infer([x], timeout=120)
+            except chaos.ChaosError:
+                injected.append(tid)
+                continue
+            except Exception as e:  # anything else is a lost request
+                lost.append(repr(e))
+                continue
+            if not np.array_equal(out, reference.run([x])[0]):
+                lost.append(f"output mismatch (client {tid})")
+            else:
+                ok.append(tid)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    paddle.set_flags({"FLAGS_chaos_spec": ""})
+
+    total = CLIENTS * PER_CLIENT
+    assert not lost, f"lost/wrong requests: {lost}"
+    assert len(injected) == 1, \
+        f"expected exactly 1 injected failure, got {len(injected)}"
+    assert len(ok) == total - 1, (len(ok), total)
+    assert val("chaos.injected.serve.request") == 1
+
+    # -- phase 2: deterministic overload ------------------------------
+    rej0 = val("serving.request.rejected.queue_full")
+    engine.pause()
+    x = np.zeros((1, 8), np.float32)
+    parked = [engine.submit([x]) for _ in range(engine.config.max_queue)]
+    shed = 0
+    for _ in range(OVERLOAD_EXTRA):
+        try:
+            engine.submit([x])
+        except serving.RequestRejected as e:
+            assert e.reason == "queue_full", e.reason
+            shed += 1
+    assert shed == OVERLOAD_EXTRA, shed
+    assert val("serving.request.rejected.queue_full") - rej0 \
+        == OVERLOAD_EXTRA
+    engine.resume()
+    for f in parked:                      # parked work survives overload
+        assert f.result(timeout=120)[0].shape == (1, 4)
+
+    # -- phase 3: accounting ------------------------------------------
+    compiles = val("serving.compile")
+    bucket_bound = engine._policy.max_buckets()
+    assert compiles <= bucket_bound, \
+        f"{compiles} compiles > bucket bound {bucket_bound}"
+    completed = val("serving.request.completed")
+    assert completed == (total - 1) + engine.config.max_queue, completed
+    engine.close()
+    occ = engine.stats()["serving.batch.occupancy"]
+    print(f"serving gate OK: {completed} served bit-exact, "
+          f"1 chaos-injected, {shed} shed at full queue, "
+          f"{compiles} compiles <= {bucket_bound} buckets, "
+          f"mean batch occupancy {occ['avg']:.2f}")
+
+
+if __name__ == "__main__":
+    main()
