@@ -48,6 +48,14 @@ def _metrics_snapshot():
     return out
 
 
+def _compile_cache_info():
+    """Persistent-XLA-cache accounting for the BENCH JSON: entry counts
+    let a relaunch prove it skipped recompiles (new_entries == 0)."""
+    from paddle_tpu.utils import compile_cache as cc
+    d = cc.cache_dir()
+    return {"dir": d, "entries": cc.entry_count(d)} if d else None
+
+
 def _probe_backend(timeout_s: float = 240.0) -> bool:
     """True if the default (TPU/axon) backend initializes in a fresh
     subprocess within timeout_s.  The axon tunnel can hang indefinitely
@@ -111,25 +119,45 @@ def main():
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
     labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
 
-    # warmup/compile
+    # warmup/compile — timed: this is the cold-start cost a persistent
+    # compilation cache (FLAGS_compile_cache_dir) amortizes across
+    # relaunches
+    cache_before = _compile_cache_info()
+    t_cold = time.perf_counter()
     loss, params, opt_state = step(params, opt_state, ids, labels)
     float(loss)
     jax.block_until_ready(params)
+    cold_start_s = time.perf_counter() - t_cold
+    cache_warm = _compile_cache_info()
 
     # best-of-N repetitions: the tunneled chip is shared, so single-window
-    # timings vary ~2x with interference; the max is the machine's rate
+    # timings vary ~2x with interference; the max is the machine's rate.
+    # Batches arrive through the io DevicePrefetcher (the Model.fit input
+    # stage) so the measured data_wait is the pipeline's real handoff
+    # cost; the arrays are device-resident, so the device_put is free and
+    # the leg stays comparable with earlier rounds.
+    from paddle_tpu.io import DevicePrefetcher
     reps = 5 if on_tpu else 1
     best_dt = None
+    best_wait = 0.0
     for _ in range(reps):
+        feed = DevicePrefetcher(iter([(ids, labels)] * steps), depth=2)
+        it = iter(feed)
+        wait_s = 0.0
         t0 = time.perf_counter()
         for _ in range(steps):
-            loss, params, opt_state = step(params, opt_state, ids, labels)
+            tw = time.perf_counter()
+            bx, by = next(it)
+            wait_s += time.perf_counter() - tw
+            loss, params, opt_state = step(params, opt_state, bx, by)
         # force full materialization: through the remote tunnel,
         # block_until_ready alone can return before the device finishes
         float(loss)
         jax.block_until_ready(params)
         dt = time.perf_counter() - t0
-        best_dt = dt if best_dt is None else min(best_dt, dt)
+        feed.close()
+        if best_dt is None or dt < best_dt:
+            best_dt, best_wait = dt, wait_s
 
     seq_per_sec = B * steps / best_dt
     target = 0.8 * 107.0  # see module docstring
@@ -146,7 +174,22 @@ def main():
         "unit": "seq/s",
         "vs_baseline": round(seq_per_sec / target, 3),
         "mfu": round(mfu, 3),
+        # async-pipeline attribution: cold start (trace+compile+step 1)
+        # vs steady-state step, and the fraction of the timed window the
+        # consumer spent waiting on the input pipeline
+        "cold_start_s": round(cold_start_s, 3),
+        "steady_step_s": round(best_dt / steps, 4),
+        "data_wait_frac": round(best_wait / best_dt, 4),
     }
+    if cache_before is not None:
+        result["compile_cache"] = {
+            "dir": cache_before["dir"],
+            "entries_before": cache_before["entries"],
+            "cold_start_compiles": cache_warm["entries"]
+            - cache_before["entries"],
+            "steady_state_compiles": _compile_cache_info()["entries"]
+            - cache_warm["entries"],
+        }
     try:
         result["extra"] = {"resnet50": bench_resnet(on_tpu)}
     except Exception as e:  # the headline metric must still print
@@ -190,29 +233,65 @@ def bench_resnet(on_tpu: bool):
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(B, 3, hw, hw), jnp.float32)
     y = jnp.asarray(rng.randint(0, nclass, (B, 1)), jnp.int64)
+    t_cold = time.perf_counter()
     model.train_batch([x], [y])          # compile
     p0 = next(iter(net.parameters()))
     jax.block_until_ready(p0._data)
     float(jnp.sum(p0._data.astype(jnp.float32)))
+    cold_start_s = time.perf_counter() - t_cold
+
+    # timed region runs with the host tracer live: _train_batch_jit then
+    # records the per-step 'device' (dispatch/backpressure) phase, which
+    # together with the manual data-wait split attributes the wall time
+    # instead of asserting where it went.  Tracer cost on the compiled
+    # path is a handful of clock reads per STEP, not per op.
+    from paddle_tpu.io import DevicePrefetcher
+    from paddle_tpu.profiler import metrics as pm
+    from paddle_tpu.profiler import tracer as ptracer
+    dev_ns = pm.counter("train.step.device_ns")
+    was_tracing = ptracer.active
+    ptracer.enable()
     reps = 4 if on_tpu else 1
     best = None
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        logs = None
-        for _ in range(steps):
-            # loss comes back lazy (hapi _LazyScalar), so consecutive
-            # steps pipeline on-device; force full materialization of
-            # the final step's params + loss before stopping the clock
-            ts = time.perf_counter() if PROFILE else 0
-            logs = model.train_batch([x], [y])
-            if PROFILE:
-                from paddle_tpu.profiler import metrics as pm
-                pm.histogram("bench.step_latency_ms").observe(
-                    (time.perf_counter() - ts) * 1e3)
-        float(logs["loss"])
-        jax.block_until_ready(p0._data)
-        float(jnp.sum(p0._data.astype(jnp.float32)))
-        best = min(best or 9e9, time.perf_counter() - t0)
+    best_wait = 0.0
+    best_dev_ns = 0
+    try:
+        for _ in range(reps):
+            feed = DevicePrefetcher(iter([(x, y)] * steps), depth=2)
+            it = iter(feed)
+            wait_s = 0.0
+            dev0 = dev_ns.value
+            logs = None
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                # loss comes back lazy (hapi _LazyScalar), so
+                # consecutive steps pipeline on-device; force full
+                # materialization of the final step's params + loss
+                # before stopping the clock
+                tw = time.perf_counter()
+                bx, by = next(it)
+                wait_s += time.perf_counter() - tw
+                ts = time.perf_counter() if PROFILE else 0
+                logs = model.train_batch([bx], [by])
+                if PROFILE:
+                    pm.histogram("bench.step_latency_ms").observe(
+                        (time.perf_counter() - ts) * 1e3)
+            # the tail drain is queued device work materializing — it
+            # belongs to the device phase, not the host
+            t_sync = time.perf_counter()
+            float(logs["loss"])
+            jax.block_until_ready(p0._data)
+            float(jnp.sum(p0._data.astype(jnp.float32)))
+            t_end = time.perf_counter()
+            dt = t_end - t0
+            feed.close()
+            if best is None or dt < best:
+                best, best_wait = dt, wait_s
+                best_dev_ns = dev_ns.value - dev0 + \
+                    int((t_end - t_sync) * 1e9)
+    finally:
+        if not was_tracing:
+            ptracer.disable()
     imgs = B * steps / best
     # ResNet50 fwd ~4.1 GFLOP/img at 224^2; fwd+bwd ~3x (no remat on
     # the conv path), against one v5e chip's 197 bf16 TFLOP/s peak —
@@ -220,9 +299,18 @@ def bench_resnet(on_tpu: bool):
     # channel counts early in the net under-fill the MXU; profiled
     # conv-path table in BASELINE.md)
     mfu = imgs * 3 * 4.1e9 / 197e12
+    wait_frac = best_wait / best
+    dev_frac = min(1.0, best_dev_ns / 1e9 / best)
     return {"value": round(imgs, 1), "unit": "imgs/s",
             "vs_baseline": round(imgs / (0.8 * 390.0), 3),
-            "mfu": round(mfu, 3)}
+            "mfu": round(mfu, 3),
+            "cold_start_s": round(cold_start_s, 3),
+            "steady_step_s": round(best / steps, 4),
+            "data_wait_frac": round(wait_frac, 4),
+            # dispatch/backpressure vs everything-else-on-host split for
+            # the best rep — the "where did the step go" attribution
+            "device_frac": round(dev_frac, 4),
+            "host_frac": round(max(0.0, 1.0 - wait_frac - dev_frac), 4)}
 
 
 def bench_serving(on_tpu: bool):

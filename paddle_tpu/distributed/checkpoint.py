@@ -450,12 +450,20 @@ class AsyncCheckpointer:
                                                COMMITTED_NAME))]
 
     # -- write path --------------------------------------------------------
+    def want_save(self, step: int) -> bool:
+        """True when :meth:`save` at ``step`` would actually write
+        (outside the save-interval window).  ``Model.fit`` checks this
+        before building the state tree, so interval steps cost nothing
+        and never touch the device."""
+        step = int(step)
+        return self._last_requested is None or \
+            step - self._last_requested >= self._interval
+
     def save(self, step: int, tree: Dict[str, Any]) -> bool:
         """Queue an async save of ``tree`` at ``step``.  Returns False
         (and writes nothing) inside the save-interval window."""
         step = int(step)
-        if self._last_requested is not None and \
-                step - self._last_requested < self._interval:
+        if not self.want_save(step):   # ONE copy of the window logic
             return False
         self._last_requested = step
         # prune completed futures so a million-step run doesn't hold a

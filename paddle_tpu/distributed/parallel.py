@@ -29,8 +29,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..nn.layer_base import Layer
 from ..core.tensor import Tensor
 
-__all__ = ["DataParallel", "shard_batch", "param_shardings",
-            "apply_param_shardings", "scale_loss"]
+__all__ = ["DataParallel", "shard_batch", "input_sharding_fn",
+           "param_shardings", "apply_param_shardings", "scale_loss"]
 
 
 def _default_dp_mesh(axis: str = "dp") -> Mesh:
@@ -52,6 +52,35 @@ def shard_batch(arrays, mesh: Mesh, axis: str = "dp"):
         else:
             out.append(jax.device_put(arr, spec))
     return out
+
+
+def input_sharding_fn(mesh: Mesh, axis: str = "dp"):
+    """Per-leaf sharding chooser for the io DevicePrefetcher: the same
+    rules as :func:`shard_batch` (dim0 split over ``axis`` when
+    divisible, replicated otherwise), as a callable the prefetch thread
+    applies inside its ``device_put``.  Batches then land on the mesh
+    pre-sharded — no host gather and no re-placement inside the train
+    step (``shard_batch`` becomes a no-op on already-committed arrays).
+
+    Returns None when the mesh is not fully addressable from this
+    process (multi-host): per-process shards can't be globally placed
+    with a plain ``device_put``; those pipelines keep host batches and
+    shard in-step."""
+    if axis not in mesh.axis_names:
+        return None
+    if any(d.process_index != jax.process_index() for d in
+           mesh.devices.flat):
+        return None
+    split = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    n = mesh.shape[axis]
+
+    def leaf_sharding(arr):
+        if getattr(arr, "ndim", 0) == 0 or arr.shape[0] % n != 0:
+            return repl
+        return split
+
+    return leaf_sharding
 
 
 def param_shardings(layer: Layer, mesh: Mesh) -> Dict[str, NamedSharding]:

@@ -122,6 +122,10 @@ class ProgBarLogger(Callback):
         return " - ".join(parts)
 
     def on_train_batch_end(self, step, logs=None):
+        # logs['loss'] is a LAZY device scalar in the async fit loop —
+        # it must only be coerced (via _fmt) on log_freq boundaries, so
+        # the steady-state loop blocks on the device at most once per
+        # window (tools/pipeline_gate.py + test_async_pipeline pin this)
         self.step = step
         self._ips_samples += ((logs or {}).get("batch_size")
                               or self.params.get("batch_size") or 0)
@@ -235,28 +239,54 @@ class LRSchedulerCallback(Callback):
 
 class VisualDL(Callback):
     """Scalar logging callback.  VisualDL itself isn't in this image;
-    writes a plain jsonl the dashboard (or any reader) can tail."""
+    writes a plain jsonl the dashboard (or any reader) can tail.
 
-    def __init__(self, log_dir="vdl_log"):
+    Per-step values are buffered as-is and only coerced to float at
+    flush points (every ``flush_every`` steps, epoch end, train end) —
+    ``logs['loss']`` is a lazy device scalar in the async fit loop, and
+    coercing it every step would reintroduce the per-step host sync
+    this pipeline removes.  A crash mid-window loses at most
+    ``flush_every`` steps of scalars; shrink it (or 1 for the old
+    write-per-step behavior) when post-mortem completeness matters more
+    than pipeline depth."""
+
+    def __init__(self, log_dir="vdl_log", flush_every=64):
         super().__init__()
         self.log_dir = log_dir
+        self.flush_every = max(1, int(flush_every))
         self._f = None
+        self._buf = []
 
     def on_train_begin(self, logs=None):
         os.makedirs(self.log_dir, exist_ok=True)
         self._f = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
 
-    def on_train_batch_end(self, step, logs=None):
+    def _flush(self):
         import json
+        if not self._f:
+            self._buf.clear()
+            return
+        for step, rec in self._buf:
+            out = {"step": step}
+            for k, v in rec:
+                out[k] = float(v)   # lazy scalars materialize here
+            self._f.write(json.dumps(out) + "\n")
+        self._buf.clear()
+
+    def on_train_batch_end(self, step, logs=None):
         if self._f and logs:
-            rec = {"step": step}
-            for k, v in logs.items():
-                if k != "batch_size" and isinstance(v, numbers.Number):
-                    rec[k] = float(v)
-            self._f.write(json.dumps(rec) + "\n")
+            self._buf.append((step, [(k, v) for k, v in logs.items()
+                                     if k != "batch_size" and
+                                     isinstance(v, numbers.Number)]))
+            if len(self._buf) >= self.flush_every:
+                self._flush()
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._flush()
 
     def on_train_end(self, logs=None):
         if self._f:
+            self._flush()
             self._f.close()
 
 

@@ -26,6 +26,7 @@ from ..core import autograd
 from ..core.random import default_generator, rng_scope
 from ..core.tensor import Tensor, to_tensor
 from ..metric import Metric
+from ..profiler import metrics as _metrics
 from ..profiler import tracer as _obs
 from ..utils import chaos as _chaos
 from .callbacks import config_callbacks
@@ -69,6 +70,13 @@ class _LazyScalar(numbers.Real):
 
     def __float__(self):
         if self._val is None:
+            # each materialization is one host<->device round trip that
+            # drains the async pipeline; counted so CI can assert the
+            # steady-state loop blocks at most once per log_freq window
+            _metrics.counter(
+                "train.loss_fetch",
+                "lazy-loss device scalars materialized on the host "
+                "(each one is a pipeline sync point)").inc()
             try:
                 self._val = float(self._arr)
             except Exception as e:
@@ -311,6 +319,11 @@ class Model:
         else:
             split_chain = False
         lr = self._lr_device()
+        # step-phase attribution: the dispatch call is where device
+        # backpressure surfaces in a sync-free loop (XLA bounds the
+        # in-flight queue), so its duration is the per-step "device"
+        # phase; fit subtracts it from the body time to get "host"
+        _d0 = _obs.now_ns() if _obs.active else 0
         try:
             loss, outs, new_buffers, new_params, new_state, new_ctr = \
                 step(params, buffers, opt._fn_state, key_base, rng_ctr,
@@ -318,6 +331,8 @@ class Model:
         except Exception:
             net.load_functional_state(params, buffers)  # drop leaked tracers
             raise
+        if _d0:
+            self._last_dispatch_ns = _obs.on_step_phase("device", _d0)
         if not split_chain:
             # mirror the in-jit counter bump on the host generator so
             # get_rng_state()/eager draws stay consistent, and keep the
@@ -404,6 +419,8 @@ class Model:
         return [np.asarray(o) for o in outs]
 
     def _update_metrics(self, out_arrays, labels):
+        if not self._metrics:
+            return {}
         results = {}
         for metric in self._metrics:
             computed = metric.compute(
@@ -557,10 +574,60 @@ class Model:
     # ------------------------------------------------------------------
     # loop-level API
     # ------------------------------------------------------------------
+    def _epoch_input(self, loader, depth):
+        """(iterator, prefetcher-or-None) for one epoch over ``loader``:
+        the io DevicePrefetcher stage (background collate +
+        ``device_put``, ``depth`` batches resident on device) unless
+        disabled or the loader runs its own.  For DataParallel/hybrid
+        networks the prefetch ``device_put`` uses the step's input
+        sharding, so multi-chip feeds land pre-sharded; on meshes with
+        no local placement (multi-host) prefetch is bypassed entirely —
+        including a loader-owned stage — because batches must stay
+        host-side for the in-step global sharding."""
+        from ..io import DataLoader, DevicePrefetcher
+        from ..utils import flags as _flags
+        if depth is None:
+            depth = _flags.get_flag("FLAGS_prefetch_to_device")
+        depth = int(depth or 0)
+        sharding = None
+        dp_net = self._use_jit and hasattr(self.network, "shard_inputs") \
+            and getattr(self.network, "mesh", None) is not None
+        if dp_net:
+            from ..distributed.parallel import input_sharding_fn
+            sharding = input_sharding_fn(
+                self.network.mesh, getattr(self.network, "_dp_axis", "dp"))
+            if sharding is None:
+                # no local placement exists: force host batches even if
+                # the loader has its own device-prefetch stage
+                if getattr(loader, "prefetch_to_device", 0) > 0 and \
+                        hasattr(loader, "_iter_batches"):
+                    return loader._iter_batches(), None
+                return iter(loader), None
+        if getattr(loader, "prefetch_to_device", 0) > 0:
+            # the loader's own stage runs in its __iter__; (re)hand it
+            # this fit's input sharding — a loader reused across
+            # models/meshes must not keep a stale closure
+            loader._input_sharding = sharding
+            return iter(loader), None
+        if depth <= 0:
+            return iter(loader), None
+        if isinstance(loader, DataLoader):
+            pf = DevicePrefetcher.for_loader(loader, depth=depth,
+                                             sharding=sharding)
+        else:
+            try:
+                pf = DevicePrefetcher(iter(loader), depth=depth,
+                                      sharding=sharding)
+            except TypeError:
+                return iter(loader), None
+        self._last_prefetcher = pf
+        return iter(pf), pf
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None, checkpointer=None):
+            accumulate_grad_batches=1, num_iters=None, checkpointer=None,
+            prefetch_to_device=None):
         from ..io import DataLoader, Dataset
         self._save_dir = save_dir
         if isinstance(train_data, Dataset):
@@ -602,54 +669,97 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(train_loader):
-                if step_count < start_step:
-                    # resumed run: this batch's update is already inside
-                    # the restored state — replay the data order without
-                    # re-training (shuffle must be deterministic/off for
-                    # exact continuation, as in the reference resume)
+            # async input pipeline: a fresh one-shot prefetch stage per
+            # epoch; falls through to the plain loader when disabled
+            it, pf = self._epoch_input(train_loader, prefetch_to_device)
+            step = -1
+            try:
+                while True:
+                    # step-phase breakdown (host tracer on): data_wait
+                    # is the time this loop blocked on the input
+                    # pipeline; with prefetch warm it is ~queue-pop
+                    trace = _obs.active
+                    _tw0 = _obs.now_ns() if trace else 0
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    if trace:
+                        _obs.on_step_phase("data_wait", _tw0)
+                    step += 1
+                    if step_count < start_step:
+                        # resumed run: this batch's update is already
+                        # inside the restored state — replay the data
+                        # order without re-training (shuffle must be
+                        # deterministic/off for exact continuation, as
+                        # in the reference resume)
+                        step_count += 1
+                        continue
+                    cbks.on_train_batch_begin(step)
+                    ins, lbls = self._split_batch(batch)
+                    # profiler v2 hot-path hook: with the host tracer
+                    # off this whole block is one predicate read per
+                    # step
+                    _t0 = _obs.now_ns() if trace else 0
+                    self._last_dispatch_ns = 0
+                    if anomaly:
+                        # pre-step copies (the jit step donates its
+                        # inputs); this is the guard's per-step cost
+                        snap = self._state_refs()
+                    if accumulate_grad_batches > 1:
+                        # grad accumulation rides the eager tape:
+                        # backward accumulates into .grad, step fires on
+                        # the boundary
+                        update = (step + 1) % accumulate_grad_batches == 0
+                        self.network.train()
+                        logs = self._train_batch_eager(ins, lbls,
+                                                       update=update)
+                    else:
+                        logs = self.train_batch(ins, lbls)
+                    if _t0:
+                        _obs.on_hapi_step(_t0, num_samples=_batch_len(ins),
+                                          mode="train")
                     step_count += 1
-                    continue
-                cbks.on_train_batch_begin(step)
-                ins, lbls = self._split_batch(batch)
-                # profiler v2 hot-path hook: with the host tracer off
-                # this whole block is one predicate read per step
-                _t0 = _obs.now_ns() if _obs.active else 0
-                if anomaly:
-                    # pre-step copies (the jit step donates its inputs);
-                    # this is the guard's per-step cost
-                    snap = self._state_refs()
-                if accumulate_grad_batches > 1:
-                    # grad accumulation rides the eager tape: backward
-                    # accumulates into .grad, step fires on the boundary
-                    update = (step + 1) % accumulate_grad_batches == 0
-                    self.network.train()
-                    logs = self._train_batch_eager(ins, lbls, update=update)
+                    if anomaly and "loss" in logs:
+                        # guard mode materialises the loss at the
+                        # producing step (its documented synchronous
+                        # trade against the lazy-loss pipeline)
+                        v = float(logs["loss"])
+                        if not np.isfinite(v):
+                            self._handle_anomaly(anomaly, v, step_count,
+                                                 snap, checkpointer)
+                            logs["loss"] = v
+                    if heartbeat is not None:
+                        # int step only — never touches the device
+                        heartbeat(step_count)
+                    if checkpointer is not None and (
+                            not hasattr(checkpointer, "want_save")
+                            or checkpointer.want_save(step_count)):
+                        # tree build + host snapshot only on steps the
+                        # checkpointer will actually write; interval
+                        # steps stay sync-free
+                        checkpointer.save(step_count,
+                                          self._ckpt_tree(step_count))
+                    # reference hapi: callbacks see the ACTUAL batch
+                    # size so ips stays honest on the final partial
+                    # batch
+                    logs["batch_size"] = _batch_len(ins)
+                    cbks.on_train_batch_end(step, logs)
+                    if _t0:
+                        _obs.on_step_host(
+                            _obs.now_ns() - _t0 - self._last_dispatch_ns)
+                    if num_iters is not None and step_count >= num_iters:
+                        break
+            finally:
+                if pf is not None:
+                    pf.close()
                 else:
-                    logs = self.train_batch(ins, lbls)
-                if _t0:
-                    _obs.on_hapi_step(_t0, num_samples=_batch_len(ins),
-                                      mode="train")
-                step_count += 1
-                if anomaly and "loss" in logs:
-                    # guard mode materialises the loss at the producing
-                    # step (trades away the lazy-loss pipeline)
-                    v = float(logs["loss"])
-                    if not np.isfinite(v):
-                        self._handle_anomaly(anomaly, v, step_count,
-                                             snap, checkpointer)
-                        logs["loss"] = v
-                if heartbeat is not None:
-                    heartbeat(step_count)
-                if checkpointer is not None:
-                    checkpointer.save(step_count,
-                                      self._ckpt_tree(step_count))
-                # reference hapi: callbacks see the ACTUAL batch size so
-                # ips stays honest on the final partial batch
-                logs["batch_size"] = _batch_len(ins)
-                cbks.on_train_batch_end(step, logs)
-                if num_iters is not None and step_count >= num_iters:
-                    break
+                    # a loader-owned stage must also stop promptly on an
+                    # exception — a stored traceback pins the suspended
+                    # generator and would keep its producer alive
+                    lpf = getattr(train_loader, "_last_prefetcher", None)
+                    if lpf is not None:
+                        lpf.close()
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and epoch % eval_freq == 0:
                 self.evaluate(eval_loader, batch_size=batch_size,

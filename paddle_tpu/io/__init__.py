@@ -20,12 +20,13 @@ from ..core.tensor import Tensor, to_tensor
 from ..profiler import metrics as _metrics
 from ..profiler import tracer as _tracer
 from ..utils import chaos as _chaos
+from .prefetch import DevicePrefetcher
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "default_collate_fn", "get_worker_info"]
+           "DevicePrefetcher", "default_collate_fn", "get_worker_info"]
 
 
 class Dataset:
@@ -256,7 +257,12 @@ def default_collate_fn(batch):
         return Tensor(jnp.stack([b._data for b in batch]))
     if isinstance(sample, np.ndarray):
         return to_tensor(np.stack(batch))
-    if isinstance(sample, (int, float)):
+    if isinstance(sample, float):
+        # collate straight into the canonical dtype: np.asarray(batch)
+        # would build a float64 array that to_tensor then converts to
+        # float32 — two full copies for one batch of scalars
+        return to_tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, int) and not isinstance(sample, bool):
         return to_tensor(np.asarray(batch))
     if isinstance(sample, (str, bytes)):
         return list(batch)
@@ -266,6 +272,98 @@ def default_collate_fn(batch):
         return type(sample)(default_collate_fn(list(items))
                             for items in zip(*batch))
     return to_tensor(np.asarray(batch))
+
+
+class _SlotCollate:
+    """``default_collate_fn`` semantics with a reused host staging
+    buffer per (loader slot, leaf): samples are written once into the
+    staging buffer (``np.stack(..., out=...)`` / direct scalar fill) and
+    once into the device buffer — one host copy total, no per-batch
+    allocation churn.  The old path was two copies for every converted
+    batch (``np.asarray`` → ``to_tensor``'s dtype-converting
+    ``jnp.asarray``).
+
+    Slots are keyed by producing thread (each DataLoader worker thread /
+    the prefetch thread / the caller), so concurrent workers never share
+    a buffer.  The device copy is forced (``jnp.array(copy=True)``)
+    whenever no dtype conversion would occur — on the CPU backend
+    ``jnp.asarray`` can alias host memory zero-copy, and an aliased
+    staging buffer must never be recycled under a live batch."""
+
+    _MAX_SLOTS = 64   # thread ids recycle; bound stale-slot growth
+
+    def __init__(self):
+        self._bufs = {}
+        # fork-worker mode: return the staged np buffer itself instead
+        # of a device Tensor — a forked child must NEVER touch jax (an
+        # XLA compile against inherited locks is the classic fork
+        # deadlock), and the worker packs/serializes each batch before
+        # the buffer is reused, so handing out the view is safe
+        self.host_arrays = False
+
+    def _staging(self, path, shape, dtype):
+        key = (threading.get_ident(), path)
+        buf = self._bufs.get(key)
+        if buf is None or buf.shape != shape or buf.dtype != dtype:
+            if len(self._bufs) >= self._MAX_SLOTS and key not in self._bufs:
+                self._bufs.clear()
+            buf = np.empty(shape, dtype)
+            self._bufs[key] = buf
+        return buf
+
+    def _from_staging(self, buf):
+        if self.host_arrays:
+            return buf
+        import jax.numpy as jnp
+        from ..core.dtype import dtype_to_jnp
+        # same canonicalization to_tensor applies to 64-bit numpy, but
+        # ALWAYS copy=True: under jax_enable_x64 the "conversion" is an
+        # identity and jnp.asarray would alias the reusable buffer
+        dt = dtype_to_jnp(str(buf.dtype)) \
+            if buf.dtype in (np.float64, np.int64) else None
+        return Tensor(jnp.array(buf, dtype=dt, copy=True))
+
+    def __call__(self, batch):
+        return self._collate(batch, ())
+
+    def _collate(self, batch, path):
+        sample = batch[0]
+        if isinstance(sample, np.ndarray):
+            if any(b.dtype != sample.dtype or b.shape != sample.shape
+                   for b in batch):
+                # mixed dtypes promote / ragged raises — np.stack's
+                # rules, not a silent cast into the staging buffer
+                if self.host_arrays:
+                    return np.stack(batch)
+                return default_collate_fn(batch)
+            buf = self._staging(path, (len(batch),) + sample.shape,
+                                sample.dtype)
+            np.stack(batch, out=buf)
+            return self._from_staging(buf)
+        if isinstance(sample, float):
+            buf = self._staging(path, (len(batch),), np.float32)
+            buf[:] = batch
+            return self._from_staging(buf)
+        if isinstance(sample, dict):
+            return {k: self._collate([b[k] for b in batch], path + (k,))
+                    for k in sample}
+        if isinstance(sample, (tuple, list)):
+            return type(sample)(
+                self._collate(list(items), path + (i,))
+                for i, items in enumerate(zip(*batch)))
+        if self.host_arrays:
+            # forked child: every remaining leaf finishes on the host
+            # too (np.asarray on a Tensor is a buffer->host read, never
+            # a compile); the parent's _unpack re-wraps with to_tensor,
+            # which keeps the int64-canonicalization semantics
+            if isinstance(sample, Tensor):
+                return np.stack([np.asarray(b._data) for b in batch])
+            if isinstance(sample, (str, bytes)):
+                return list(batch)
+            return np.asarray(batch)
+        # Tensors (already device arrays), ints (int64 truncation
+        # semantics + warning live in to_tensor), strings, misc
+        return default_collate_fn(batch)
 
 
 class DataLoader:
@@ -281,10 +379,17 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, use_shared_memory=True,
                  prefetch_factor=2, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, prefetch_to_device=0):
         self.dataset = dataset
-        self.collate_fn = collate_fn or default_collate_fn
+        # default collate goes through the slot-buffered variant: same
+        # results, one host copy per leaf instead of two
+        self.collate_fn = collate_fn or _SlotCollate()
         self.num_workers = num_workers
+        # device-prefetch stage (io/prefetch.py): N batches kept
+        # resident on device by a background collate+device_put thread
+        self.prefetch_to_device = int(prefetch_to_device or 0)
+        self._input_sharding = None   # set by Model.fit for DP meshes
+        self._last_prefetcher = None
         self.use_shared_memory = use_shared_memory
         self.timeout = timeout
         self.prefetch_factor = max(2, prefetch_factor)
@@ -324,6 +429,15 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def __iter__(self):
+        if self.prefetch_to_device > 0:
+            # device-prefetch mode: the prefetcher records its own
+            # consumer-wait spans; one fresh (one-shot) stage per epoch
+            pf = DevicePrefetcher.for_loader(
+                self, depth=self.prefetch_to_device,
+                sharding=self._input_sharding)
+            self._last_prefetcher = pf
+            yield from pf
+            return
         # observability wrapper: when the host tracer is live, each
         # batch handoff records a consumer-wait span + wait-time
         # histogram (queue starvation is the classic input-bound
@@ -457,6 +571,36 @@ class DataLoader:
         def worker_loop(wid):
             _worker_info.info = _WorkerInfo(wid, self.num_workers,
                                             self.dataset)
+            if isinstance(self.collate_fn, _SlotCollate):
+                # this is the child's post-fork copy: collate to bare
+                # np arrays so the child never enters jax (fork +
+                # inherited XLA locks = deadlock); the parent re-wraps
+                # on decode
+                self.collate_fn.host_arrays = True
+            # a terminate() can land between segment creation and the
+            # result_q put — the one window where the segment's name is
+            # known to nobody else.  Unlink it on the way out, or it
+            # leaks in /dev/shm until reboot (an early-stopping consumer
+            # — fit(num_iters=...) over the prefetch stage — tears
+            # workers down mid-batch routinely).
+            import signal as _sig
+            inflight = {"seg": None}
+
+            def _term(_signum, _frame):
+                s = inflight["seg"]
+                if s is not None:
+                    try:
+                        s.close()
+                        s.unlink()
+                    except Exception:
+                        pass
+                import os as __os
+                __os._exit(0)
+
+            try:
+                _sig.signal(_sig.SIGTERM, _term)
+            except (ValueError, OSError):
+                pass   # non-main thread (thread-path reuse): no window
             if self.worker_init_fn is not None:
                 self.worker_init_fn(wid)
             while True:
@@ -471,6 +615,7 @@ class DataLoader:
                         total = max(1, sum(a.nbytes for a in arrays))
                         seg = shared_memory.SharedMemory(create=True,
                                                          size=total)
+                        inflight["seg"] = seg
                         metas, off = [], 0
                         for a in arrays:
                             seg.buf[off:off + a.nbytes] = a.tobytes()
@@ -479,6 +624,8 @@ class DataLoader:
                             off += a.nbytes
                         result_q.put((i, ("shm", seg.name, metas,
                                           pickle.dumps(structure)), None))
+                        # delivered: the parent owns the unlink now
+                        inflight["seg"] = None
                         # the parent unlinks; stop this process's
                         # resource tracker from double-freeing it
                         try:
@@ -583,7 +730,14 @@ class DataLoader:
                 if err is not None:
                     raise RuntimeError(
                         f"DataLoader worker failed on batch {i}:\n{err}")
-                yield decode(payload)
+                try:
+                    out = decode(payload)
+                except FileNotFoundError:
+                    # a terminated worker's SIGTERM cleanup can unlink a
+                    # segment whose name had just been delivered; the
+                    # batch itself is deterministic — refetch in-process
+                    out = self._fetch(batches[i])
+                yield out
         finally:
             for pr in procs:
                 pr.terminate()

@@ -1,0 +1,284 @@
+"""Device prefetch stage: keep the next N batches resident on device.
+
+The tf.data / PyTorch-DDP lesson (Murray et al.; torch
+``DataLoader(pin_memory=True)`` + compute/transfer overlap): an
+accelerator step should never wait for the host to collate or transfer
+its inputs.  This module adds that stage to the io pipeline:
+
+- a background thread pulls host batches (running collate there when it
+  owns the fetch), moves every array leaf onto device with
+  ``jax.device_put``, and parks the results in a bounded queue —
+  ``depth`` batches stay resident on device (double-buffered at the
+  default depth of 2);
+- the consumer (``Model.fit``'s train loop, or any ``for batch in``)
+  pops device-ready batches, so in steady state the only wait is queue
+  handoff (~µs), not collate + H2D transfer;
+- **sharding-aware**: pass ``sharding`` (a ``jax.sharding.Sharding`` or
+  a per-leaf callable) and ``device_put`` lands each batch already laid
+  out for the step — multi-chip data-parallel feeds arrive pre-sharded,
+  with no host gather and no re-placement inside the step;
+- **no lost batches**: in indexed mode (map-style dataset, the
+  ``Model.fit`` default) a failed fetch — a chaos-killed loader worker,
+  a flaky remote filesystem — is retried synchronously up to
+  ``retries`` times (counted ``io.prefetch.refetch``), so a transient
+  worker death never drops a batch or tears down the epoch.
+
+Ordering is exactly the unprefetched loader's: the batch plan is
+snapshotted from the sampler once per epoch (the same single draw the
+plain iterator performs), so a fixed seed gives bit-identical training
+with the pipeline on or off — ``tools/pipeline_gate.py`` pins this down
+in CI.
+
+Instrumentation follows the PR-1 discipline: with the host tracer off,
+the consumer path costs one predicate read per batch; ``stats`` (gets /
+nonempty_gets / max_depth / refetch) are plain int adds and always on,
+because the CI gate asserts on them with tracing disabled.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import warnings
+from typing import Any, Callable, Iterable, Optional, Union
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..profiler import metrics as _metrics
+from ..profiler import tracer as _tracer
+from ..utils import chaos as _chaos
+
+__all__ = ["DevicePrefetcher"]
+
+_ShardingLike = Union[Any, Callable[[Any], Any], None]
+
+
+class DevicePrefetcher:
+    """One-epoch async device feed over ``source`` (see module doc).
+
+    ``source`` is either any iterable of batches (iterator mode) or a
+    map-style ``DataLoader`` handed to :meth:`for_loader` (indexed mode,
+    which adds per-batch refetch).  A prefetcher is one-shot: iterate it
+    once; build a fresh one per epoch (``DataLoader(prefetch_to_device=
+    N)`` does this in its ``__iter__``).
+    """
+
+    def __init__(self, source: Iterable, depth: int = 2,
+                 sharding: _ShardingLike = None, retries: int = 3,
+                 name: str = "io.prefetch"):
+        self._source = source
+        self._plan = None          # indexed mode: list of index batches
+        self._loader = None
+        self.depth = max(1, int(depth))
+        self._sharding = sharding
+        self._retries = max(0, int(retries))
+        self.name = name
+        self._q: _queue.Queue = _queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        self._warned_refetch = False
+        self._host_collate = None   # sharded indexed mode (for_loader)
+        self._wrap_np = False
+        # always-on pipeline accounting (the CI gate reads these):
+        # gets/nonempty_gets say whether the queue kept ahead of the
+        # consumer; refetch counts recovered worker deaths (lost == 0
+        # as long as iteration completes)
+        self.stats = {"gets": 0, "nonempty_gets": 0, "max_depth": 0,
+                      "refetch": 0, "produced": 0}
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def for_loader(cls, loader, depth: int = 2,
+                   sharding: _ShardingLike = None, retries: int = 3):
+        """Prefetcher for a ``DataLoader``.  Map-style loaders without
+        process/thread workers run collate on the prefetch thread and
+        get per-batch refetch (indexed mode); worker-backed and
+        iterable loaders are wrapped as-is (their own machinery keeps
+        producing; this stage adds the device transfer + residency)."""
+        pf = cls(loader, depth=depth, sharding=sharding, retries=retries)
+        if getattr(loader, "batch_sampler", None) is not None and \
+                getattr(loader, "num_workers", 0) == 0:
+            # one sampler draw, exactly like the plain iterator's single
+            # pass — fixed seed => identical batch order either way
+            pf._plan = list(loader.batch_sampler)
+            pf._loader = loader
+            cf = loader.collate_fn
+            if sharding is not None and hasattr(cf, "host_arrays"):
+                # sharded feed through the default collate: stage to a
+                # host buffer and let _place do the ONE device_put with
+                # the step sharding — collating to a device Tensor
+                # first would pay a second (default-device -> mesh)
+                # re-placement per batch
+                host_cf = type(cf)()
+                host_cf.host_arrays = True
+                pf._host_collate = host_cf
+                pf._wrap_np = True   # mirror the collate's Tensor leaves
+        elif hasattr(loader, "_iter_batches"):
+            # worker-backed/iterable loader: feed off the raw batch
+            # iterator, NOT iter(loader) (which would re-enter the
+            # loader's own prefetch mode)
+            pf._source = loader._iter_batches()
+        return pf
+
+    # -- producer side -------------------------------------------------
+    def _place(self, arr):
+        s = self._sharding
+        if callable(s):
+            s = s(arr)
+        if s is None:
+            return jax.device_put(arr)
+        return jax.device_put(arr, s)
+
+    def _to_device(self, obj):
+        if isinstance(obj, Tensor):
+            return Tensor(self._place(obj._data))
+        if isinstance(obj, np.ndarray):
+            placed = self._place(obj)
+            return Tensor(placed) if self._wrap_np else placed
+        if isinstance(obj, jax.Array):
+            return self._place(obj)
+        if isinstance(obj, tuple):
+            return tuple(self._to_device(o) for o in obj)
+        if isinstance(obj, list):
+            return [self._to_device(o) for o in obj]
+        if isinstance(obj, dict):
+            return {k: self._to_device(v) for k, v in obj.items()}
+        return obj
+
+    def _fetch_batch(self, indices):
+        if self._host_collate is not None:
+            # host-mode default collate (np staging buffers); the chaos
+            # site fires here exactly as in DataLoader._fetch
+            if _chaos.active:
+                _chaos.hit("loader.worker")
+            ds = self._loader.dataset
+            return self._host_collate([ds[j] for j in indices])
+        return self._loader._fetch(indices)
+
+    def _fetch_with_retry(self, i: int, indices):
+        last = None
+        for attempt in range(self._retries + 1):
+            try:
+                return self._fetch_batch(indices)
+            except BaseException as e:
+                last = e
+                if attempt == self._retries:
+                    break
+                self.stats["refetch"] += 1
+                _metrics.counter(
+                    "io.prefetch.refetch",
+                    "prefetch-stage batch fetches retried after a "
+                    "loader worker death (recovered, not lost)").inc()
+                if not self._warned_refetch:
+                    self._warned_refetch = True
+                    warnings.warn(
+                        f"DevicePrefetcher: fetch of batch {i} died "
+                        f"({type(last).__name__}: {last}); refetching "
+                        f"in place (no batch is lost)")
+        raise RuntimeError(
+            f"DevicePrefetcher: batch {i} still failing after "
+            f"{self._retries} refetches") from last
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _produce(self):
+        try:
+            if self._plan is not None:
+                for i, indices in enumerate(self._plan):
+                    if self._stop.is_set():
+                        return
+                    batch = self._to_device(
+                        self._fetch_with_retry(i, indices))
+                    self.stats["produced"] += 1
+                    if not self._put(("b", batch)):
+                        return
+            else:
+                for batch in self._source:
+                    if self._stop.is_set():
+                        return
+                    batch = self._to_device(batch)
+                    self.stats["produced"] += 1
+                    if not self._put(("b", batch)):
+                        return
+        except BaseException as e:   # surface at the consumer, in order
+            self._put(("e", e))
+            return
+        self._put(("end", None))
+
+    # -- consumer side -------------------------------------------------
+    def _start(self):
+        self._started = True
+        self._thread = threading.Thread(
+            target=self._produce, name="paddle-prefetch", daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        if self._started:
+            raise RuntimeError(
+                "DevicePrefetcher is one-shot; build a fresh one per "
+                "epoch (DataLoader(prefetch_to_device=N) does)")
+        self._start()
+        try:
+            while True:
+                trace = _tracer.active
+                t0 = _tracer.now_ns() if trace else 0
+                try:
+                    item = self._q.get_nowait()
+                    nonempty = True
+                except _queue.Empty:
+                    item = self._q.get()
+                    nonempty = False
+                kind, payload = item
+                if kind == "end":
+                    return
+                if kind == "e":
+                    raise payload
+                self.stats["gets"] += 1
+                if nonempty:
+                    self.stats["nonempty_gets"] += 1
+                depth = self._q.qsize()
+                if depth > self.stats["max_depth"]:
+                    self.stats["max_depth"] = depth
+                if trace:
+                    _tracer.on_data_wait(t0, depth=depth)
+                    _metrics.gauge(
+                        "io.prefetch.queue_depth",
+                        "device-resident batches waiting in the "
+                        "prefetch queue").set(depth)
+                yield payload
+        finally:
+            self.close()
+
+    def close(self):
+        """Stop the producer and drop queued batches (idempotent)."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._thread = None
+        # in iterator mode the upstream may be a generator driving its
+        # own worker machinery (fork processes, shm segments) — close it
+        # so an early exit runs its finally blocks instead of orphaning
+        # workers; best-effort (it can still be executing on a stuck
+        # producer thread)
+        src_close = getattr(self._source, "close", None)
+        if src_close is not None:
+            try:
+                src_close()
+            except Exception:
+                pass
+        self._source = None

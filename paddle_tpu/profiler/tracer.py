@@ -142,6 +142,33 @@ def on_queue_depth(name: str, depth: int):
     _metrics.gauge(name + ".queue_depth").set(depth)
 
 
+def on_step_phase(phase: str, start_ns: int, end_ns: Optional[int] = None,
+                  mode: str = "train") -> int:
+    """One phase of a hapi train-loop step: ``data_wait`` (blocked on
+    the input pipeline for the next batch), ``device`` (inside the
+    jitted-step dispatch call — in a steady sync-free loop the device
+    backpressure surfaces here), ``host`` (everything else: state
+    plumbing, callbacks, bookkeeping).  Histograms + total-ns counters
+    let the bench compute data_wait_frac / host_frac / device_frac and
+    attribute a utilization win instead of asserting it.  Returns the
+    span duration in ns."""
+    if end_ns is None:
+        end_ns = time.perf_counter_ns()
+    record(f"step::{phase}", start_ns, end_ns, cat="hapi")
+    dt = end_ns - start_ns
+    _metrics.histogram(f"{mode}.step.{phase}_ms").observe(dt / 1e6)
+    _metrics.counter(f"{mode}.step.{phase}_ns").inc(dt)
+    return dt
+
+
+def on_step_host(dt_ns: int, mode: str = "train"):
+    """Host-side remainder of one loop step (body minus the dispatch
+    'device' phase).  Not a contiguous span — metrics only; the full
+    body span is already recorded by :func:`on_hapi_step`."""
+    _metrics.histogram(f"{mode}.step.host_ms").observe(dt_ns / 1e6)
+    _metrics.counter(f"{mode}.step.host_ns").inc(dt_ns)
+
+
 def on_hapi_step(start_ns: int, num_samples: int = 0, mode: str = "train"):
     """One hapi Model loop step (latency is host wall time; with the
     lazy-loss pipeline this is enqueue latency, not device step time)."""

@@ -2,4 +2,10 @@ from . import flags  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
 from . import resilience  # noqa: F401
 from . import chaos  # noqa: F401
+from . import compile_cache  # noqa: F401
 from . import cpp_extension  # noqa: F401
+
+# backend init: arm the persistent XLA compilation cache when
+# FLAGS_compile_cache_dir is set (env or earlier define); supervised
+# relaunches then skip recompiles entirely
+compile_cache.configure()

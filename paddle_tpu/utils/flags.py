@@ -151,6 +151,19 @@ define_flag("FLAGS_anomaly_action", "",
             "producing step), 'skip' (revert this step's update and "
             "continue), 'rollback' (restore the newest intact "
             "checkpoint when fit(checkpointer=...) is set, else skip)")
+define_flag("FLAGS_compile_cache_dir", "",
+            "persistent XLA compilation cache directory (jax "
+            "compilation cache): relaunches and supervised restarts "
+            "(launch --supervise) reuse compiled executables instead "
+            "of re-tracing + re-compiling every program; empty "
+            "disables.  Wired at backend init "
+            "(utils/compile_cache.py) and re-wired on set_flags")
+define_flag("FLAGS_prefetch_to_device", 2,
+            "default device-prefetch depth used by Model.fit's train "
+            "loop (batches kept resident on device by the io "
+            "DevicePrefetcher background thread; double-buffered at "
+            "2).  0 disables the async input pipeline; per-loader "
+            "override via DataLoader(prefetch_to_device=N)")
 
 # flags may arrive via env at import time — seed the dispatch fast path
 _refresh_debug_cache()

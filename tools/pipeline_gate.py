@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""CI async-pipeline gate: the device-prefetched ``Model.fit`` must be
+**bit-exact** vs the unprefetched loop on a fixed-seed 20-step run, with
+the prefetch queue observed non-empty in steady state and ZERO lost
+batches when a ``loader.worker`` chaos kill takes out a fetch mid-epoch.
+
+Three runs of the same fixed-seed model/data (shuffle off, 20 steps):
+
+1. synchronous  — ``prefetch_to_device=0`` (reference trajectory)
+2. prefetched   — ``DataLoader(prefetch_to_device=2)`` (the Model.fit
+   default path), asserted bit-identical per-step losses AND a queue
+   that actually ran ahead (nonempty_gets > 0, produced == steps)
+3. chaos        — same as 2 under ``loader.worker:fail@7``: the
+   prefetch stage must refetch the killed batch (refetch == injected
+   == 1) and still deliver every batch bit-exactly
+
+Also asserts the sync-free contract the lazy-loss pipeline documents:
+the steady-state loop materializes the loss on the host at most once
+per ``log_freq`` window (train.loss_fetch counter).
+
+Wired into tools/run_all_tests.sh.
+"""
+import os
+import sys
+import warnings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.hapi.callbacks import Callback  # noqa: E402
+from paddle_tpu.profiler import metrics  # noqa: E402
+from paddle_tpu.utils import chaos  # noqa: E402
+
+STEPS = 20
+BATCH = 4
+
+
+class DS(paddle.io.Dataset):
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        x = rng.rand(8).astype("float32")
+        return x, (x.sum(keepdims=True) * 0.25).astype("float32")
+
+    def __len__(self):
+        return STEPS * BATCH
+
+
+class CaptureLoss(Callback):
+    def __init__(self):
+        super().__init__()
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        # keep the LAZY scalar; materialize after fit so the capture
+        # itself doesn't add per-step syncs
+        self.losses.append(logs["loss"])
+
+
+def run(prefetch_depth, log_freq=5):
+    paddle.seed(1234)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.Tanh(),
+                               paddle.nn.Linear(16, 1))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    model.prepare(opt, paddle.nn.MSELoss())
+    loader = paddle.io.DataLoader(DS(), batch_size=BATCH, shuffle=False,
+                                  prefetch_to_device=prefetch_depth)
+    cap = CaptureLoss()
+    fetch0 = metrics.counter("train.loss_fetch").value
+    # prefetch_to_device=0 must go to fit as well: otherwise the
+    # FLAGS_prefetch_to_device default wraps the loader at the fit
+    # level and the "synchronous" reference leg silently prefetches
+    model.fit(loader, epochs=1, verbose=2, log_freq=log_freq,
+              callbacks=[cap], prefetch_to_device=prefetch_depth)
+    fetches_in_fit = metrics.counter("train.loss_fetch").value - fetch0
+    losses = np.asarray([float(l) for l in cap.losses], np.float64)
+    return losses, loader._last_prefetcher, fetches_in_fit
+
+
+def main():
+    fails = []
+
+    def check(ok, msg):
+        print(("PASS " if ok else "FAIL ") + msg)
+        if not ok:
+            fails.append(msg)
+
+    # 1) reference: synchronous loop
+    ref, pf, ref_fetches = run(0)
+    check(pf is None and len(ref) == STEPS,
+          f"synchronous run: {len(ref)} steps, no prefetch stage")
+    # verbose=2/log_freq=5 prints at steps 0,5,10,15 + epoch end: the
+    # loop may block at most once per window (+1 for the epoch line)
+    budget = STEPS // 5 + 2
+    check(0 < ref_fetches <= budget,
+          f"sync-free contract: {ref_fetches} loss fetches in fit for "
+          f"{STEPS} steps @ log_freq=5 (budget {budget})")
+
+    # 2) prefetched, bit-exact + queue ran ahead
+    got, pf, pf_fetches = run(2)
+    check(pf is not None and np.array_equal(ref, got),
+          "prefetched fit bit-exact vs synchronous loop "
+          f"(max |d|={np.max(np.abs(ref - got)) if len(ref) == len(got) else 'len mismatch'})")
+    check(pf is not None and pf.stats["produced"] == STEPS
+          and pf.stats["gets"] == STEPS,
+          f"zero lost batches: produced={pf.stats['produced']} "
+          f"consumed={pf.stats['gets']} of {STEPS}")
+    check(pf is not None and pf.stats["nonempty_gets"] > 0,
+          f"prefetch queue non-empty in steady state "
+          f"(nonempty_gets={pf.stats['nonempty_gets']}/{STEPS}, "
+          f"max_depth={pf.stats['max_depth']})")
+    check(0 < pf_fetches <= budget,
+          f"sync-free contract (prefetched): {pf_fetches} loss fetches "
+          f"(budget {budget})")
+
+    # 3) loader.worker chaos kill: recovered, nothing lost, bit-exact
+    refetch0 = metrics.counter("io.prefetch.refetch").value
+    chaos.configure("loader.worker:fail@7", seed=0)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            got_c, pf_c, _ = run(2)
+    finally:
+        chaos.reset()
+    injected = metrics.counter("chaos.injected.loader.worker").value
+    refetches = metrics.counter("io.prefetch.refetch").value - refetch0
+    check(injected == 1, f"chaos injected exactly one worker kill "
+          f"(got {injected})")
+    check(pf_c is not None and refetches == 1
+          and pf_c.stats["refetch"] == 1,
+          f"killed fetch refetched in place (refetch={refetches})")
+    check(pf_c is not None and pf_c.stats["produced"] == STEPS
+          and len(got_c) == STEPS,
+          f"zero lost batches under chaos kill (produced="
+          f"{pf_c.stats['produced'] if pf_c else None}, "
+          f"steps={len(got_c)})")
+    check(np.array_equal(ref, got_c),
+          "chaos run still bit-exact vs synchronous loop")
+
+    if fails:
+        print(f"pipeline gate: {len(fails)} check(s) failed",
+              file=sys.stderr)
+        return 1
+    print("pipeline gate: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,12 @@ python -m pytest tests/test_passes.py -q
 # RPCs) with a mid-run SIGKILL — must complete via verified-checkpoint
 # resume with the expected chaos.injected/launch.restarts counts.
 python tools/chaos_gate.py
+# Async-pipeline gate: device-prefetched Model.fit must be bit-exact vs
+# the synchronous loop on a fixed-seed 20-step run, the prefetch queue
+# must actually run ahead, a loader.worker chaos kill must be recovered
+# with zero lost batches, and the steady-state loop must block on the
+# lazy loss at most once per log_freq window.
+python tools/pipeline_gate.py
 # Serving gate: the InferenceEngine under concurrent synthetic clients
 # with a fixed serve.request chaos spec — zero lost requests (bit-exact
 # vs unbatched Predictor.run), exactly one injected failure, exact
