@@ -204,6 +204,10 @@ def main():
             result["serving"] = bench_serving(on_tpu)
         except Exception as e:  # the headline metric must still print
             print(f"bench: serving leg failed: {e!r}", file=sys.stderr)
+        try:
+            result["serving_decode"] = bench_decode(on_tpu)
+        except Exception as e:
+            print(f"bench: decode leg failed: {e!r}", file=sys.stderr)
     print(json.dumps(result))
 
 
@@ -389,6 +393,99 @@ def bench_serving(on_tpu: bool):
         "batch_occupancy_avg": round(occ_snap.get("avg") or 0.0, 2),
         "compiles": compiles.value if compiles else 0,
         "max_batch_size": max_batch,
+    }
+
+
+def bench_decode(on_tpu: bool):
+    """Autoregressive serving leg: the continuous-batching
+    GenerationEngine (slot scheduler + fixed-capacity KV-cache,
+    paddle_tpu/serving + paddle_tpu/generation) under concurrent
+    streaming clients with staggered arrivals.  Reports tokens/s,
+    time-to-first-token, p50/p99 inter-token latency, and decode batch
+    occupancy — the four numbers an LLM chat endpoint is actually
+    judged on — next to the one-shot serving numbers."""
+    import threading
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPT, GPTConfig
+    from paddle_tpu.profiler import metrics as pm
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=30528, hidden_size=768,
+                        num_layers=12, num_heads=12, max_seq_len=512)
+        slots, clients, per_client, max_new = 8, 16, 4, 64
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=4,
+                        num_heads=4, max_seq_len=128, ffn_mult=2)
+        slots, clients, per_client, max_new = 4, 6, 3, 16
+    net = GPT(cfg)
+    engine = serving.GenerationEngine(
+        net, serving.GenerationEngineConfig(
+            max_slots=slots, max_new_tokens=max_new,
+            max_queue=4 * clients))
+    # warmup: one request per prompt bucket so compiles land outside
+    # the clock (same discipline as the one-shot serving leg)
+    for pb in serving.seq_buckets(engine.max_length,
+                                  engine.config.prompt_bucket_min):
+        if pb >= engine.max_length:
+            break
+        engine.generate(np.ones((min(pb, engine.max_length - max_new
+                                     - 1),), np.int32),
+                        max_new_tokens=2, timeout=600)
+    for h in ("ttft_ms", "inter_token_ms", "decode.occupancy",
+              "prefill", "decode"):
+        m = pm.get(f"serving.{h}")
+        if m is not None:
+            m.reset()
+
+    done_tokens = []
+
+    def client(tid):
+        rng = np.random.RandomState(200 + tid)
+        n = 0
+        for r in range(per_client):
+            time.sleep(0.002 * tid)        # staggered arrivals
+            plen = int(rng.randint(4, min(33, engine.max_length
+                                          - max_new - 1)))
+            prompt = rng.randint(1, cfg.vocab_size,
+                                 (plen,)).astype(np.int32)
+            try:
+                out = engine.generate(
+                    prompt, do_sample=True, temperature=0.8,
+                    top_p=0.95, seed=tid * 100 + r, timeout=600)
+                n += len(out)
+            except serving.RequestRejected:
+                pass                       # shed under overload
+        done_tokens.append(n)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    engine.close()
+    generated = sum(done_tokens)
+    ttft = pm.get("serving.ttft_ms").snapshot()
+    itl = pm.get("serving.inter_token_ms").snapshot()
+    occ = pm.get("serving.decode.occupancy").snapshot()
+    compiles = pm.get("serving.compile")
+    return {
+        "tokens_per_s": round(generated / dt, 1),
+        "ttft_p50_ms": round(ttft.get("p50") or 0.0, 3),
+        "ttft_p99_ms": round(ttft.get("p99") or 0.0, 3),
+        "inter_token_p50_ms": round(itl.get("p50") or 0.0, 3),
+        "inter_token_p99_ms": round(itl.get("p99") or 0.0, 3),
+        "decode_occupancy_avg": round(occ.get("avg") or 0.0, 2),
+        "decode_occupancy_max": occ.get("max"),
+        "tokens_generated": generated,
+        "tokens_per_request": max_new,
+        "slots": slots,
+        "clients": clients,
+        "compiles": compiles.value if compiles else 0,
     }
 
 

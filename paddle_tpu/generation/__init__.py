@@ -1,0 +1,30 @@
+"""``paddle_tpu.generation`` — autoregressive decoding subsystem.
+
+Turns the repo's decoder LMs into token-by-token generators that
+compile a **bounded** number of XLA executables no matter how many
+tokens or requests flow through them:
+
+- fixed-capacity KV-cache (``kv_cache.py``): pre-allocated
+  ``(B, capacity, H, D)`` buffers updated via ``dynamic_update_slice``
+  at explicit per-row length indices — decode shapes never change, so
+  the jitted step compiles once per bucket (the legacy growing-concat
+  ``MultiHeadAttention.Cache`` retraced every token);
+- seeded, fully-dynamic sampling (``sampling.py``): greedy /
+  temperature / top-k / top-p as per-row ARRAYS inside one executable,
+  per-row threaded PRNG keys so streams are reproducible and
+  independent of batch composition;
+- :class:`GenerationSession` (``session.py``): AOT prefill/decode
+  steps through the PR 4 ``ExecutableCache``, plus the high-level
+  ``generate()`` loop (eos / max-length stopping, streaming callback).
+
+``models.GPT.generate`` is the one-call entry point; the continuous-
+batching serving path is ``serving.GenerationEngine``.
+"""
+from .kv_cache import (KVCache, attention_mask, init_caches,
+                       init_layer_cache, legacy_view, write, write_kv)
+from .sampling import sample, sample_row
+from .session import GenerationSession
+
+__all__ = ["KVCache", "GenerationSession", "init_caches",
+           "init_layer_cache", "write", "write_kv", "attention_mask",
+           "legacy_view", "sample", "sample_row"]

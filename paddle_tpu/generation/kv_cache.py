@@ -1,0 +1,118 @@
+"""Fixed-capacity KV-cache for autoregressive decode.
+
+The legacy ``MultiHeadAttention.Cache`` grows by ``concat`` — every
+decode step produces a NEW key/value shape, so a jitted decode step
+retraces (and XLA recompiles) on every token.  This module holds the
+cache the other way around: **pre-allocated** ``(B, capacity, H, D)``
+buffers that every step updates in place via
+``jax.lax.dynamic_update_slice`` at an explicit per-row length index.
+The shapes never change, so the jitted decode step compiles **once**
+per (batch-bucket, capacity) and every subsequent token is a pure
+execute.
+
+Layout matches the framework's attention convention ``(B, S, H, D)``
+(batch, sequence, heads, head_dim); ``capacity`` takes the sequence
+slot.  Rows may sit at different lengths (continuous batching admits
+and retires rows independently), which is why the write index is a
+``(B,)`` vector, not a scalar.
+
+All functions here operate on raw ``jax.numpy`` arrays (they run inside
+jitted steps); the layer-level wrappers in
+``nn/layer/transformer.py`` (``MultiHeadAttention.FixedCache``) and
+``models/gpt.py`` convert from/to framework Tensors.  The cache is an
+inference-time structure: updates go through ``lax`` directly and do
+not record autograd.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["KVCache", "init_layer_cache", "init_caches", "write_kv",
+           "write", "attention_mask", "legacy_view"]
+
+
+class KVCache(NamedTuple):
+    """One attention layer's cache: ``k``/``v`` of shape
+    ``(B, capacity, num_heads, head_dim)``.  A NamedTuple so the whole
+    per-model cache (a tuple of these) is a jax pytree that flows
+    straight through ``jit`` / AOT-compiled executables."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return int(self.k.shape[1])
+
+    @property
+    def batch(self) -> int:
+        return int(self.k.shape[0])
+
+
+def init_layer_cache(batch: int, capacity: int, num_heads: int,
+                     head_dim: int, dtype=jnp.float32) -> KVCache:
+    """Zero-filled fixed-capacity cache for one attention layer."""
+    shape = (int(batch), int(capacity), int(num_heads), int(head_dim))
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def init_caches(num_layers: int, batch: int, capacity: int,
+                num_heads: int, head_dim: int,
+                dtype=jnp.float32) -> Tuple[KVCache, ...]:
+    """Per-layer tuple of zero caches (the model-level cache pytree)."""
+    return tuple(init_layer_cache(batch, capacity, num_heads, head_dim,
+                                  dtype)
+                 for _ in range(int(num_layers)))
+
+
+def write_kv(buf: jnp.ndarray, new: jnp.ndarray,
+             starts: jnp.ndarray) -> jnp.ndarray:
+    """Write ``new`` ``(B, S, H, D)`` into ``buf`` ``(B, C, H, D)`` at
+    per-row sequence offsets ``starts`` ``(B,)`` via a vmapped
+    ``dynamic_update_slice`` — the fixed-shape update that lets the
+    decode step compile once.  Out-of-range starts clamp (jax
+    semantics); callers bound lengths against capacity."""
+    new = new.astype(buf.dtype)
+
+    def one(b, n, s):
+        return jax.lax.dynamic_update_slice(
+            b, n, (s.astype(jnp.int32), jnp.int32(0), jnp.int32(0)))
+    return jax.vmap(one)(buf, new, starts)
+
+
+def write(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
+          starts: jnp.ndarray) -> KVCache:
+    """Functional cache update: returns the cache with ``k_new`` /
+    ``v_new`` written at ``starts`` (shapes unchanged)."""
+    return KVCache(write_kv(cache.k, k_new, starts),
+                   write_kv(cache.v, v_new, starts))
+
+
+def attention_mask(starts: jnp.ndarray, q_len: int, capacity: int,
+                   dtype=jnp.float32) -> jnp.ndarray:
+    """Additive attention mask ``(B, 1, q_len, capacity)`` for a query
+    block written at per-row offsets ``starts``: query token ``t`` of
+    row ``i`` (absolute position ``starts[i] + t``) may attend cache
+    slots ``j <= starts[i] + t``.  This is causal masking expressed
+    against the fixed capacity axis — slots past a row's live length
+    (stale or zero-initialized) are excluded, so right-padded prompts
+    and retired-slot garbage never leak into the math."""
+    jpos = jnp.arange(capacity, dtype=jnp.int32)[None, None, :]
+    qpos = (starts.astype(jnp.int32)[:, None, None]
+            + jnp.arange(q_len, dtype=jnp.int32)[None, :, None])
+    allow = jpos <= qpos                       # (B, q_len, capacity)
+    big_neg = jnp.asarray(jnp.finfo(dtype).min, dtype)
+    return jnp.where(allow, jnp.asarray(0, dtype), big_neg)[:, None]
+
+
+def legacy_view(cache: KVCache, length: int
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compat shim: the first ``length`` slots as the growing-concat
+    arrays the legacy ``MultiHeadAttention.Cache`` carries.  ``length``
+    must be a python int (host-side view; inside jit the fixed buffers
+    are the whole point)."""
+    n = int(length)
+    return cache.k[:, :n], cache.v[:, :n]

@@ -1,0 +1,89 @@
+"""Seeded, fully-dynamic token sampling for the jitted decode step.
+
+Design constraints (they shape everything here):
+
+1. **One compile.**  Temperature / top-k / top-p arrive as ``(B,)``
+   arrays, not python numbers, so every sampling configuration — and
+   any per-row mix of configurations inside one continuously-batched
+   decode step — runs through the SAME compiled executable.  Greedy is
+   ``temperature <= 0`` (an array predicate), not a separate traced
+   branch.
+
+2. **Batchmate independence.**  Each row samples with its own PRNG key
+   and sees only its own logits.  A row's token stream is therefore
+   bit-identical whether it runs solo, in any slot of a continuous
+   batch, or shuffled to a different batch position — the contract the
+   serving gate pins (same one PR 4 documents for one-shot requests).
+
+3. **Determinism.**  Keys are threaded explicitly
+   (``fold_in(request_key, token_position)`` per sampled token); no
+   global generator state is consumed, so a fixed seed reproduces the
+   stream across runs and processes.
+
+The selection itself is Gumbel-max over the top-k/top-p-masked scaled
+logits: ``argmax(logits/T + g)`` with ``g ~ Gumbel(0,1)`` draws exactly
+from the renormalized masked softmax without materializing a
+renormalization, and keeps the whole routine argmax-shaped (cheap on
+TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample", "sample_row"]
+
+
+def sample_row(logits: jnp.ndarray, key: jnp.ndarray,
+               temperature: jnp.ndarray, top_k: jnp.ndarray,
+               top_p: jnp.ndarray) -> jnp.ndarray:
+    """Sample one token id from one row's ``(V,)`` logits.
+
+    ``temperature <= 0``  -> greedy argmax (key unused).
+    ``top_k <= 0``        -> no top-k cut; else keep the k highest.
+    ``top_p`` outside (0, 1) -> no nucleus cut; else keep the smallest
+    prefix of the probability-sorted vocab whose cumulative mass
+    reaches ``top_p`` (the argmax token is always kept).
+    """
+    V = logits.shape[-1]
+    f32 = jnp.float32
+    logits = logits.astype(f32)
+    greedy = temperature <= 0
+    t = jnp.where(greedy, f32(1.0),
+                  jnp.maximum(temperature.astype(f32), f32(1e-6)))
+    scaled = logits / t
+
+    order = jnp.argsort(-scaled)               # descending
+    sorted_desc = scaled[order]
+
+    # top-k: keep scores >= the k-th highest (k<=0 means "all")
+    k_eff = jnp.where(top_k <= 0, V, jnp.clip(top_k, 1, V))
+    kth = sorted_desc[jnp.clip(k_eff - 1, 0, V - 1)]
+    keep_k = scaled >= kth
+
+    # top-p over the sorted softmax: token is kept while the cumulative
+    # mass BEFORE it is still under p (so the argmax always survives)
+    p_eff = jnp.where((top_p <= 0) | (top_p >= 1), f32(1.0),
+                      top_p.astype(f32))
+    probs_sorted = jax.nn.softmax(sorted_desc)
+    cum_before = jnp.cumsum(probs_sorted) - probs_sorted
+    keep_sorted = cum_before < p_eff
+    keep_sorted = keep_sorted.at[0].set(True)
+    keep_p = jnp.zeros((V,), bool).at[order].set(keep_sorted)
+
+    masked = jnp.where(keep_k & keep_p, scaled, f32(-jnp.inf))
+    g = jax.random.gumbel(key, (V,), f32)
+    sampled = jnp.argmax(masked + g)
+    return jnp.where(greedy, jnp.argmax(logits),
+                     sampled).astype(jnp.int32)
+
+
+def sample(logits: jnp.ndarray, keys: jnp.ndarray,
+           temperature: jnp.ndarray, top_k: jnp.ndarray,
+           top_p: jnp.ndarray) -> jnp.ndarray:
+    """Batched :func:`sample_row`: ``logits (B, V)``, per-row ``keys``
+    ``(B, 2) uint32``, per-row knobs ``(B,)`` -> token ids ``(B,)
+    int32``.  Pure vmap over rows — no cross-row interaction, which is
+    what makes token streams independent of batch composition."""
+    return jax.vmap(sample_row)(logits, keys, temperature.astype(
+        jnp.float32), top_k.astype(jnp.int32), top_p.astype(jnp.float32))

@@ -1,0 +1,381 @@
+"""GenerationSession: AOT-compiled prefill/decode over a decoder LM.
+
+The session splits autoregressive generation the way production engines
+do (Orca/vLLM shape; SNIPPETS' jit/AOT patterns ground the fixed-shape
+step design):
+
+- **prefill** — one fixed-shape ``(B, prompt_bucket)`` forward over the
+  (right-padded) prompts that fills the fixed-capacity KV-cache and
+  samples the first token per row;
+- **decode** — a fixed-shape ``(B, 1)`` step that writes one token's
+  k/v at each row's position, attends over the capacity axis, and
+  samples the next token.
+
+Both steps are pure functions of ``(params, buffers, caches, arrays)``
+compiled **ahead of time** via ``jax.jit(...).lower().compile()`` and
+held in the PR 4 :class:`~paddle_tpu.serving.bucketing.ExecutableCache`
+— total XLA compiles are bounded by the bucket count (one decode
+executable per batch capacity, one prefill executable per prompt-length
+bucket), never by token or request count.  ``<name>.compile`` /
+``<name>.executable_cache.hit`` account every miss/hit.
+
+Every step additionally takes an ``update_mask`` (prefill) so a
+continuous-batching scheduler can admit new rows into a live batch
+without touching its neighbours' cache — and, because rows never
+interact, a row's sampled stream is bit-identical between a solo
+:meth:`generate` call and any slot of a continuously-batched engine run
+that uses the same batch capacity.
+
+Metrics (registry, PR 1): ``<name>.prefill`` / ``<name>.decode``
+latency histograms, ``<name>.tokens_out``; spans land in the host
+tracer when tracing is on.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .sampling import sample as _sample
+
+__all__ = ["GenerationSession"]
+
+# Serializes AOT traces across ALL sessions in the process: compiling a
+# step temporarily binds tracers into the LIVE layer's tensors and
+# toggles eval mode, so two concurrent compile_fns over the same model
+# (the ExecutableCache latch is only per-key) would corrupt each
+# other's save/trace/restore window.  Compiles are rare (once per
+# bucket), so one coarse lock costs nothing steady-state.
+import threading as _threading
+_TRACE_LOCK = _threading.Lock()
+
+
+def _as_key_rows(seed, seeds, rows: int) -> np.ndarray:
+    """Per-row PRNG keys ``(rows, 2) uint32``.  A row's key comes from
+    its OWN seed (``seeds[i]`` when given, else the shared ``seed``) —
+    never from its batch position, so placement in a batch cannot
+    change a row's stream."""
+    if seeds is not None:
+        seeds = np.asarray(seeds).reshape(-1)
+        if len(seeds) < rows:                   # pad rows: inert keys
+            seeds = np.concatenate(
+                [seeds, np.zeros(rows - len(seeds), seeds.dtype)])
+        return np.stack([np.asarray(jax.random.PRNGKey(int(s)))
+                         for s in seeds[:rows]]).astype(np.uint32)
+    one = np.asarray(jax.random.PRNGKey(int(seed))).astype(np.uint32)
+    return np.broadcast_to(one, (rows, 2)).copy()
+
+
+class GenerationSession:
+    """Reusable fixed-shape generation state machine over ``model``.
+
+    ``model`` is a decoder LM exposing the cache-aware forward contract
+    ``forward(ids, caches=..., positions=...) -> (logits, new_caches)``
+    plus ``gen_caches(batch, capacity)`` (``models.GPT`` implements
+    both).  The session owns no weights — params/buffers are read from
+    the live layer at call time, so a session built once keeps serving
+    after further training steps.
+
+    Parameters
+    ----------
+    batch_capacity:
+        Fixed row count of every compiled step (rounded up to a pow2
+        bucket).  A continuous-batching engine sets this to its slot
+        count; ``generate()`` pads smaller requests up to it.
+    max_length:
+        KV-cache capacity (prompt + generated tokens), bounded by the
+        model's ``max_seq_len``.
+    name:
+        Metrics prefix (``generation`` standalone; a serving engine
+        passes its own so compiles/latency land under ``serving.*``).
+    executable_cache:
+        Share one :class:`ExecutableCache` across sessions/engines;
+        default builds a private one under ``name``.
+    """
+
+    def __init__(self, model, batch_capacity: int = 1,
+                 max_length: Optional[int] = None,
+                 prompt_bucket_min: int = 8,
+                 name: str = "generation",
+                 executable_cache=None):
+        from ..serving.bucketing import ExecutableCache, next_bucket
+        self.model = model
+        cfg = model.cfg
+        self.batch_capacity = next_bucket(max(int(batch_capacity), 1))
+        self.max_length = int(max_length or cfg.max_seq_len)
+        if self.max_length > cfg.max_seq_len:
+            raise ValueError(
+                f"max_length {self.max_length} exceeds the model's "
+                f"max_seq_len {cfg.max_seq_len} (no position embedding "
+                "past it)")
+        self.prompt_bucket_min = max(1, int(prompt_bucket_min))
+        self.name = name
+        self._cache = executable_cache if executable_cache is not None \
+            else ExecutableCache(name=name)
+        self._prefill_fn = None
+        self._decode_fn = None
+        from ..profiler import metrics as _metrics
+        self._m_prefill = _metrics.histogram(
+            f"{name}.prefill", "prefill step latency ms (fill the "
+            "KV-cache + first token)")
+        self._m_decode = _metrics.histogram(
+            f"{name}.decode", "decode step latency ms (one token for "
+            "the whole batch)")
+        self._m_tokens = _metrics.counter(
+            f"{name}.tokens_out", "tokens sampled by generation steps")
+
+    # -- cache construction -------------------------------------------
+    def init_caches(self):
+        """Zero fixed-capacity caches shaped for this session."""
+        return self.model.gen_caches(self.batch_capacity,
+                                     self.max_length)
+
+    def prompt_bucket(self, prompt_len: int) -> int:
+        """Pow2 prompt-length bucket (bounded by cache capacity)."""
+        from ..serving.bucketing import next_bucket
+        b = next_bucket(max(int(prompt_len), 1),
+                        min_bucket=min(self.prompt_bucket_min,
+                                       self.max_length))
+        return min(b, self.max_length)
+
+    # -- functional steps ---------------------------------------------
+    def _make_prefill(self) -> Callable:
+        net = self.model
+
+        def step(params, buffers, old_caches, ids, prompt_lens,
+                 update_mask, keys, temps, tks, tps):
+            from ..core import autograd
+            from ..core.tensor import Tensor
+            with autograd.no_grad():
+                net.load_functional_state(params, buffers)
+                fresh = jax.tree_util.tree_map(jnp.zeros_like,
+                                               old_caches)
+                starts = jnp.zeros((ids.shape[0],), jnp.int32)
+                logits, new_caches = net.forward(
+                    Tensor(ids), caches=fresh, positions=starts)
+            logits = logits._data
+            idx = jnp.clip(prompt_lens - 1, 0, ids.shape[1] - 1)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1)[:, 0]   # (B, V)
+            # the sampled token will sit at position prompt_len: fold
+            # the row key at that position (decode folds the same way,
+            # so one (key, position) pair -> one sampled token, always)
+            step_keys = jax.vmap(jax.random.fold_in)(keys, prompt_lens)
+            tok = _sample(last, step_keys, temps, tks, tps)
+            m = update_mask
+            merged = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(
+                    m.reshape((-1,) + (1,) * (n.ndim - 1)), n, o),
+                new_caches, old_caches)
+            return tok, merged
+        return step
+
+    def _make_decode(self) -> Callable:
+        net = self.model
+
+        def step(params, buffers, caches, tokens, positions, keys,
+                 temps, tks, tps):
+            from ..core import autograd
+            from ..core.tensor import Tensor
+            with autograd.no_grad():
+                net.load_functional_state(params, buffers)
+                logits, new_caches = net.forward(
+                    Tensor(tokens[:, None]), caches=caches,
+                    positions=positions)
+            last = logits._data[:, 0]                       # (B, V)
+            step_keys = jax.vmap(jax.random.fold_in)(keys, positions + 1)
+            tok = _sample(last, step_keys, temps, tks, tps)
+            return tok, new_caches
+        return step
+
+    def _compiled(self, kind: str, step: Callable, args: tuple):
+        """AOT-compile ``step`` for the exact arg avals, once per
+        bucket key, through the shared ExecutableCache (its per-key
+        in-flight latch keeps concurrent engines/threads to ONE
+        compile).  The trace binds tracers into the live layer's
+        tensors; concrete state is restored before returning so the
+        eager model stays usable."""
+        key = (kind, self.batch_capacity, self.max_length,
+               tuple(jnp.shape(a) for a in args[2:] if a is not None
+                     and not isinstance(a, (tuple, list, dict))))
+        net = self.model
+
+        def compile_fn():
+            with _TRACE_LOCK:   # one trace at a time over the live net
+                was_training = net.training
+                params0, buffers0 = net.functional_state()
+                try:
+                    net.eval()             # generation is eval-mode
+                    avals = jax.tree_util.tree_map(
+                        lambda a: jax.ShapeDtypeStruct(
+                            jnp.shape(a), jnp.asarray(a).dtype), args)
+                    return jax.jit(step).lower(*avals).compile()
+                finally:
+                    net.load_functional_state(params0, buffers0)
+                    if was_training:
+                        net.train()
+        return self._cache.get_or_compile(key, compile_fn)
+
+    def _state_snapshot(self):
+        """params/buffers of the live model, taken under the trace
+        lock: while another thread's compile_fn has tracers loaded into
+        the layer, an unguarded snapshot would capture them and feed
+        tracers into a compiled executable."""
+        with _TRACE_LOCK:
+            return self.model.functional_state()
+
+    # -- step drivers (the engine calls these; generate() below too) --
+    def prefill(self, caches, ids, prompt_lens, update_mask, keys,
+                temps, tks, tps):
+        """Run the compiled prefill step; returns ``(tokens (B,),
+        caches)`` with only ``update_mask`` rows' cache touched."""
+        if self._prefill_fn is None:
+            self._prefill_fn = self._make_prefill()
+        params, buffers = self._state_snapshot()
+        args = (params, buffers, caches, jnp.asarray(ids, jnp.int32),
+                jnp.asarray(prompt_lens, jnp.int32),
+                jnp.asarray(update_mask, bool),
+                jnp.asarray(keys, jnp.uint32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(tks, jnp.int32),
+                jnp.asarray(tps, jnp.float32))
+        exe = self._compiled(f"prefill:{ids.shape[1]}",
+                             self._prefill_fn, args)
+        t0 = time.perf_counter_ns()
+        tok, caches = exe(*args)
+        tok_h = np.asarray(tok)            # sync point = honest timing
+        self._observe(self._m_prefill, "prefill", t0)
+        self._m_tokens.inc(int(np.asarray(update_mask).sum()))
+        return tok_h, caches
+
+    def decode(self, caches, tokens, positions, keys, temps, tks, tps,
+               live_rows: Optional[int] = None):
+        """Run the compiled decode step; returns ``(tokens (B,),
+        caches)``.  One compile for the session lifetime — asserted by
+        the regression tests via ``<name>.compile``."""
+        if self._decode_fn is None:
+            self._decode_fn = self._make_decode()
+        params, buffers = self._state_snapshot()
+        args = (params, buffers, caches,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(keys, jnp.uint32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(tks, jnp.int32),
+                jnp.asarray(tps, jnp.float32))
+        exe = self._compiled("decode", self._decode_fn, args)
+        t0 = time.perf_counter_ns()
+        tok, caches = exe(*args)
+        tok_h = np.asarray(tok)
+        self._observe(self._m_decode, "decode", t0)
+        self._m_tokens.inc(int(live_rows if live_rows is not None
+                               else len(tok_h)))
+        return tok_h, caches
+
+    def _observe(self, hist, phase: str, t0_ns: int):
+        t1 = time.perf_counter_ns()
+        hist.observe((t1 - t0_ns) / 1e6)
+        from ..profiler import tracer as _tracer
+        if _tracer.active:
+            _tracer.on_serving_phase(f"{self.name}.{phase}", t0_ns, t1)
+
+    # -- high-level generate ------------------------------------------
+    def generate(self, ids, prompt_lens=None, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 seeds=None, eos_token_id: Optional[int] = None,
+                 stream_callback=None) -> List[np.ndarray]:
+        """Generate token continuations for a batch of prompts.
+
+        ``ids``: int array ``(P,)`` or ``(B, P)`` (or a list of 1-D
+        ragged prompts).  Returns a list of ``B`` 1-D int32 arrays of
+        generated tokens (prompt excluded; the eos token, when hit, is
+        included as the final element).  Greedy unless ``do_sample``;
+        seeded sampling is bit-reproducible and batch-position
+        independent (see ``sampling.py``).  ``stream_callback(row,
+        token)`` fires per sampled token in order.
+        """
+        ids_list, lens = self._normalize_prompts(ids, prompt_lens)
+        B_real = len(ids_list)
+        B = self.batch_capacity
+        if B_real > B:
+            raise ValueError(
+                f"{B_real} prompts exceed the session batch capacity "
+                f"{B}; raise batch_capacity or split the call")
+        max_p = max(lens)
+        if max_p >= self.max_length:
+            raise ValueError(
+                f"prompt length {max_p} leaves no room in the "
+                f"{self.max_length}-slot cache")
+        Pb = self.prompt_bucket(max_p)
+        batch = np.zeros((B, Pb), np.int32)
+        plens = np.ones((B,), np.int32)
+        for i, (row, n) in enumerate(zip(ids_list, lens)):
+            batch[i, :n] = row
+            plens[i] = n
+        keys = _as_key_rows(seed, seeds, B)
+        temps = np.full((B,), float(temperature) if do_sample else 0.0,
+                        np.float32)
+        tks = np.full((B,), int(top_k), np.int32)
+        tps = np.full((B,), float(top_p), np.float32)
+        mask = np.zeros((B,), bool)
+        mask[:B_real] = True
+
+        caches = self.init_caches()
+        tok, caches = self.prefill(caches, batch, plens, mask, keys,
+                                   temps, tks, tps)
+        out: List[List[int]] = [[] for _ in range(B_real)]
+        done = [False] * B_real
+        positions = plens.copy()            # where the sampled token sits
+        max_new = max(int(max_new_tokens), 1)
+
+        def absorb(tok_h):
+            for i in range(B_real):
+                if done[i]:
+                    continue
+                t = int(tok_h[i])
+                out[i].append(t)
+                if stream_callback is not None:
+                    stream_callback(i, t)
+                if eos_token_id is not None and t == int(eos_token_id):
+                    done[i] = True
+                elif len(out[i]) >= max_new:
+                    done[i] = True
+                elif positions[i] + 1 >= self.max_length:
+                    done[i] = True          # cache full: hard stop
+        absorb(tok)
+        while not all(done):
+            tok, caches = self.decode(
+                caches, tok, positions, keys, temps, tks, tps,
+                live_rows=sum(1 for d in done if not d))
+            positions = positions + 1
+            absorb(tok)
+        return [np.asarray(o, np.int32) for o in out]
+
+    @staticmethod
+    def _normalize_prompts(ids, prompt_lens):
+        if isinstance(ids, (list, tuple)) and ids and \
+                not np.isscalar(ids[0]):
+            rows = [np.asarray(r).reshape(-1).astype(np.int32)
+                    for r in ids]
+            return rows, [len(r) for r in rows]
+        arr = np.asarray(getattr(ids, "_data", ids))
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if arr.ndim != 2:
+            raise ValueError(f"prompts must be (P,) or (B, P); got "
+                             f"{arr.shape}")
+        arr = arr.astype(np.int32)
+        if prompt_lens is None:
+            lens = [arr.shape[1]] * arr.shape[0]
+        else:
+            lens = [int(n) for n in np.asarray(prompt_lens).reshape(-1)]
+            if len(lens) != arr.shape[0]:
+                raise ValueError("prompt_lens rows != prompt rows")
+        if min(lens) < 1:
+            raise ValueError("empty prompt (length 0)")
+        return [arr[i, :lens[i]] for i in range(arr.shape[0])], lens

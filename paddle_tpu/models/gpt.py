@@ -11,6 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -19,6 +20,10 @@ from ..nn.layer_base import Layer
 from .. import nn
 
 __all__ = ["GPTConfig", "GPT", "GPTBlock"]
+
+# guards generate()'s per-model session-cache creation (see GPT.generate)
+import threading as _threading
+_GEN_SESSION_LOCK = _threading.Lock()
 
 
 @dataclass
@@ -50,7 +55,7 @@ class GPTAttention(Layer):
             self.out = nn.Linear(D, D)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, positions=None):
         from ..ops.manipulation import reshape, split, squeeze
         from ..ops.nn_misc import scaled_dot_product_attention
         B, T, D = x.shape
@@ -58,11 +63,28 @@ class GPTAttention(Layer):
         qkv = self.qkv(x)
         qkv = reshape(qkv, [B, T, 3, h, hd])
         q, k, v = [squeeze(t, axis=2) for t in split(qkv, 3, axis=2)]
-        # paddle layout (B, S, H, D); pallas flash kernel on TPU
-        ctx = scaled_dot_product_attention(q, k, v, is_causal=True,
-                                           training=self.training)
+        if cache is None:
+            # paddle layout (B, S, H, D); pallas flash kernel on TPU
+            ctx = scaled_dot_product_attention(q, k, v, is_causal=True,
+                                               training=self.training)
+            out = self.out(reshape(ctx, [B, T, D]))
+            return self.dropout(out)
+        # fixed-capacity decode path (generation subsystem): write this
+        # block's k/v at per-row ``positions`` via dynamic_update_slice,
+        # attend over the whole capacity axis under an explicit length
+        # mask — shapes never change, so the jitted step compiles once
+        from ..core.tensor import Tensor
+        from .. import generation as _gen
+        starts = positions._data if isinstance(positions, Tensor) \
+            else jnp.asarray(positions, jnp.int32)
+        new_cache = _gen.write(cache, k._data, v._data, starts)
+        mask = _gen.attention_mask(starts, T, new_cache.capacity,
+                                   dtype=q._data.dtype)
+        ctx = scaled_dot_product_attention(
+            q, Tensor(new_cache.k), Tensor(new_cache.v),
+            attn_mask=Tensor(mask), training=self.training)
         out = self.out(reshape(ctx, [B, T, D]))
-        return self.dropout(out)
+        return self.dropout(out), new_cache
 
 
 class GPTBlock(Layer):
@@ -85,10 +107,15 @@ class GPTBlock(Layer):
             self.down = nn.Linear(cfg.ffn_mult * D, D)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
-        x = x + self.attn(self.ln1(x))
+    def forward(self, x, cache=None, positions=None):
+        if cache is None:
+            x = x + self.attn(self.ln1(x))
+        else:
+            a, cache = self.attn(self.ln1(x), cache=cache,
+                                 positions=positions)
+            x = x + a
         x = x + self.dropout(self.down(F.gelu(self.up(self.ln2(x)))))
-        return x
+        return x if cache is None else (x, cache)
 
 
 class GPT(Layer):
@@ -115,11 +142,88 @@ class GPT(Layer):
                                     for _ in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
 
-    def forward(self, ids):
-        import jax.numpy as jnp
+    def forward(self, ids, caches=None, positions=None):
         T = ids.shape[1]
-        pos = Tensor(jnp.arange(T, dtype=jnp.int32)[None, :])
-        x = self.wte(ids) + self.wpe(pos)
-        for blk in self.blocks:
-            x = blk(x)
-        return self.head(self.ln_f(x))
+        if caches is None:
+            pos = Tensor(jnp.arange(T, dtype=jnp.int32)[None, :])
+            x = self.wte(ids) + self.wpe(pos)
+            for blk in self.blocks:
+                x = blk(x)
+            return self.head(self.ln_f(x))
+        # incremental path: ``caches`` is a per-block tuple of
+        # fixed-capacity generation.KVCache, ``positions`` (B,) the
+        # per-row write offset (a prompt prefill passes zeros; a decode
+        # step passes each row's current length).  Returns
+        # (logits, new_caches) — same shapes in as out, so the whole
+        # call AOT-compiles once per bucket (GenerationSession owns
+        # that; see paddle_tpu/generation/session.py).
+        starts = positions._data if isinstance(positions, Tensor) \
+            else jnp.asarray(positions, jnp.int32)
+        idx = starts[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        idx = jnp.clip(idx, 0, self.cfg.max_seq_len - 1)
+        x = self.wte(ids) + self.wpe(Tensor(idx))
+        new_caches = []
+        for blk, c in zip(self.blocks, caches):
+            x, nc = blk(x, cache=c, positions=starts)
+            new_caches.append(nc)
+        return self.head(self.ln_f(x)), tuple(new_caches)
+
+    def gen_caches(self, batch: int, capacity: int = None):
+        """Zero fixed-capacity KV-caches for incremental decoding —
+        one :class:`~paddle_tpu.generation.KVCache` per block, each
+        ``(batch, capacity, num_heads, head_dim)``.  ``capacity``
+        defaults to (and is bounded by) ``cfg.max_seq_len``."""
+        from .. import generation as _gen
+        cap = int(capacity or self.cfg.max_seq_len)
+        if cap > self.cfg.max_seq_len:
+            raise ValueError(f"capacity {cap} exceeds max_seq_len "
+                             f"{self.cfg.max_seq_len}")
+        return _gen.init_caches(self.cfg.num_layers, batch, cap,
+                                self.cfg.num_heads,
+                                self.cfg.hidden_size
+                                // self.cfg.num_heads)
+
+    def generate(self, ids, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 seeds=None, eos_token_id=None, max_length=None,
+                 batch_capacity=None, stream_callback=None):
+        """Autoregressive continuation of ``ids`` (``(P,)``/``(B, P)``
+        int array or ragged list of prompts) -> list of 1-D int32
+        arrays of generated tokens per row (eos, when hit, included).
+
+        Greedy by default; ``do_sample=True`` enables seeded
+        temperature / top-k / top-p sampling with per-request threaded
+        PRNG keys — a fixed ``seed`` (or per-row ``seeds``) reproduces
+        streams bit-identically across runs and batch positions.
+
+        The work is split into an AOT-compiled prefill and a
+        fixed-shape decode step over a pre-allocated KV-cache
+        (:class:`~paddle_tpu.generation.GenerationSession`): compiles
+        are bounded by the shape-bucket count, never by token count.
+        Sessions are cached on the model per (batch-bucket, cache
+        capacity), so repeated calls — including after further training
+        steps, since weights are read at call time — reuse the same
+        executables.
+        """
+        from ..generation import GenerationSession
+        from ..serving.bucketing import next_bucket
+        rows, _ = GenerationSession._normalize_prompts(ids, None)
+        cap_b = int(batch_capacity or next_bucket(max(len(rows), 1)))
+        max_len = int(max_length or self.cfg.max_seq_len)
+        skey = (cap_b, max_len)
+        with _GEN_SESSION_LOCK:
+            # serialized check-then-insert: concurrent first calls must
+            # share ONE session (private ExecutableCache => duplicate
+            # XLA compiles otherwise)
+            sessions = getattr(self, "_gen_sessions", None)
+            if sessions is None:
+                sessions = self._gen_sessions = {}
+            if skey not in sessions:
+                sessions[skey] = GenerationSession(
+                    self, batch_capacity=cap_b, max_length=max_len)
+        return sessions[skey].generate(
+            rows, max_new_tokens=max_new_tokens, do_sample=do_sample,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            seed=seed, seeds=seeds, eos_token_id=eos_token_id,
+            stream_callback=stream_callback)

@@ -38,6 +38,16 @@ def _convert_attention_mask(attn_mask, dtype):
 class MultiHeadAttention(Layer):
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+    #: Fixed-capacity decode cache (generation subsystem): pre-allocated
+    #: ``(B, max_length, H, D)`` k/v buffers plus per-row ``lengths``.
+    #: Unlike the growing-concat :attr:`Cache` (a new shape — and a jit
+    #: retrace/XLA recompile — every decode step), shapes never change:
+    #: each step writes at the explicit length index via
+    #: ``dynamic_update_slice`` and masks slots past the live length,
+    #: so a jitted decode step compiles exactly once.  Inference-only
+    #: (updates bypass autograd); the legacy Cache keeps its numerics.
+    FixedCache = collections.namedtuple("FixedCache",
+                                        ["k", "v", "lengths"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
                  vdim=None, need_weights=False, weight_attr=None,
@@ -62,12 +72,29 @@ class MultiHeadAttention(Layer):
         return ops.manipulation.reshape(x, [b, s, self.num_heads,
                                             self.head_dim])
 
-    def gen_cache(self, key, value=None, type=None):
+    def gen_cache(self, key, value=None, type=None, max_length=None):
+        """Legacy API unchanged: default/``Cache`` returns the growing
+        concat cache, ``StaticCache`` the projected memory.  New:
+        ``type=MultiHeadAttention.FixedCache`` (requires ``max_length``)
+        returns a pre-allocated fixed-capacity cache whose decode step
+        compiles once — see :attr:`FixedCache`."""
         if type == MultiHeadAttention.StaticCache:
             k = self._shape(self.k_proj(key))
             v = self._shape(self.v_proj(value if value is not None else key))
             return self.StaticCache(k, v)
         b = key.shape[0]
+        if type == MultiHeadAttention.FixedCache:
+            if max_length is None:
+                raise ValueError(
+                    "FixedCache is pre-allocated: pass max_length "
+                    "(prompt + max new tokens)")
+            import jax.numpy as jnp
+            k = ops.creation.zeros([b, int(max_length), self.num_heads,
+                                    self.head_dim])
+            v = ops.creation.zeros([b, int(max_length), self.num_heads,
+                                    self.head_dim])
+            return self.FixedCache(k, v,
+                                   Tensor(jnp.zeros((b,), jnp.int32)))
         k = ops.creation.zeros([b, 0, self.num_heads, self.head_dim])
         v = ops.creation.zeros([b, 0, self.num_heads, self.head_dim])
         return self.Cache(k, v)
@@ -76,6 +103,8 @@ class MultiHeadAttention(Layer):
         key = query if key is None else key
         value = key if value is None else value
         q = self._shape(self.q_proj(query))
+        if isinstance(cache, self.FixedCache):
+            return self._forward_fixed(q, key, value, attn_mask, cache)
         if isinstance(cache, self.StaticCache):
             k, v = cache.k, cache.v
         else:
@@ -99,6 +128,44 @@ class MultiHeadAttention(Layer):
         if cache is not None and not isinstance(cache, self.StaticCache):
             outs.append(cache)
         return out if len(outs) == 1 else tuple(outs)
+
+    def _forward_fixed(self, q, key, value, attn_mask, cache):
+        """Fixed-capacity incremental attention: write this call's k/v
+        at each row's ``lengths`` offset (``dynamic_update_slice``),
+        attend over the full capacity under a causal length mask.
+        Shapes in == shapes out, so a jitted decode loop compiles once.
+        An extra additive ``attn_mask`` (``(B?, H?, Sq, capacity)``
+        broadcastable) composes with the length mask."""
+        import jax.numpy as jnp
+        from ... import generation as _gen
+        k_new = self._shape(self.k_proj(key))
+        v_new = self._shape(self.v_proj(value))
+        starts = cache.lengths._data if isinstance(cache.lengths, Tensor) \
+            else jnp.asarray(cache.lengths, jnp.int32)
+        kbuf = _gen.write_kv(cache.k._data if isinstance(cache.k, Tensor)
+                             else cache.k, k_new._data, starts)
+        vbuf = _gen.write_kv(cache.v._data if isinstance(cache.v, Tensor)
+                             else cache.v, v_new._data, starts)
+        T = q.shape[1]
+        mask = _gen.attention_mask(starts, T, kbuf.shape[1],
+                                   dtype=q._data.dtype)
+        user = _convert_attention_mask(attn_mask, q.dtype)
+        if user is not None:
+            mask = mask + user._data
+        out = ops.nn_misc.scaled_dot_product_attention(
+            q, Tensor(kbuf), Tensor(vbuf), attn_mask=Tensor(mask),
+            dropout_p=self.dropout, training=self.training)
+        b = out.shape[0]
+        out = ops.manipulation.reshape(out, [b, T, self.embed_dim])
+        out = self.out_proj(out)
+        new_cache = self.FixedCache(
+            Tensor(kbuf), Tensor(vbuf),
+            Tensor(starts + jnp.int32(T)))
+        outs = [out]
+        if self.need_weights:
+            outs.append(None)
+        outs.append(new_cache)
+        return tuple(outs)
 
 
 class TransformerEncoderLayer(Layer):

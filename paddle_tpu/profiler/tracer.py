@@ -169,6 +169,22 @@ def on_step_host(dt_ns: int, mode: str = "train"):
     _metrics.counter(f"{mode}.step.host_ns").inc(dt_ns)
 
 
+def on_serving_phase(name: str, start_ns: int,
+                     end_ns: Optional[int] = None) -> int:
+    """One serving-side generation phase span — ``<prefix>.prefill``
+    (prompt ingestion filling the KV-cache) or ``<prefix>.decode`` (one
+    token across the in-flight batch).  The chrome-trace view then
+    shows the prefill stalls a continuous batcher injects between
+    decode steps, which is the thing to stare at when time-to-first-
+    token and inter-token latency fight each other.  Latency histograms
+    for the same phases live in the metrics registry (the session owns
+    those; this is the tracer span only).  Returns the span ns."""
+    if end_ns is None:
+        end_ns = time.perf_counter_ns()
+    record(f"serve::{name}", start_ns, end_ns, cat="serving")
+    return end_ns - start_ns
+
+
 def on_hapi_step(start_ns: int, num_samples: int = 0, mode: str = "train"):
     """One hapi Model loop step (latency is host wall time; with the
     lazy-loss pipeline this is enqueue latency, not device step time)."""

@@ -17,7 +17,14 @@ with Orca/Clipper-style dynamic batching):
 - admission control (``admission.py``): queue-depth bound, per-request
   deadlines, explicit overload rejection with SLO metrics;
 - HTTP frontend (``server.py``): ``/v1/infer`` (JSON or .npz),
-  ``/healthz``, Prometheus ``/metrics``.
+  ``/v1/generate`` (JSON; SSE token streaming with ``stream=true``),
+  ``/healthz``, Prometheus ``/metrics``;
+- :class:`GenerationEngine` (``engine.py``): continuous (in-flight)
+  batching for autoregressive decode — a slot-based scheduler admits
+  queued prompts into the running batch at token boundaries over a
+  fixed-capacity KV-cache (``paddle_tpu.generation``), retires
+  finished rows without draining the batch, streams tokens per
+  request, and extends admission to token budgets.
 
 Quick start::
 
@@ -26,15 +33,24 @@ Quick start::
         max_batch_size=16, batch_timeout_ms=3, num_workers=2))
     out, = engine.infer([x])              # in-process
     serving.ServingServer(engine).start() # ... or over HTTP
+
+    gen = serving.GenerationEngine(gpt, serving.GenerationEngineConfig(
+        max_slots=8, max_new_tokens=128))
+    for tok in gen.submit(prompt_ids, do_sample=True, seed=7):
+        ...                               # tokens as they decode
 """
 from .admission import (AdmissionController, DeadlineExceeded,
                         EngineClosed, RequestRejected)
 from .bucketing import BucketPolicy, ExecutableCache, next_bucket, \
-    pad_batch
-from .engine import EngineConfig, InferenceEngine, validate_artifact
+    pad_batch, seq_buckets
+from .engine import (EngineConfig, GenerationEngine,
+                     GenerationEngineConfig, GenerationStream,
+                     InferenceEngine, validate_artifact)
 from .server import ServingServer, serve
 
 __all__ = ["InferenceEngine", "EngineConfig", "ServingServer", "serve",
-           "RequestRejected", "DeadlineExceeded", "EngineClosed",
-           "AdmissionController", "BucketPolicy", "ExecutableCache",
-           "next_bucket", "pad_batch", "validate_artifact"]
+           "GenerationEngine", "GenerationEngineConfig",
+           "GenerationStream", "RequestRejected", "DeadlineExceeded",
+           "EngineClosed", "AdmissionController", "BucketPolicy",
+           "ExecutableCache", "next_bucket", "pad_batch",
+           "seq_buckets", "validate_artifact"]

@@ -56,9 +56,16 @@ class AdmissionController:
     """
 
     def __init__(self, max_queue: int, max_rows: Optional[int] = None,
-                 name: str = "serving"):
+                 name: str = "serving",
+                 max_tokens: Optional[int] = None):
         self.max_queue = int(max_queue)
         self.max_rows = max_rows
+        # token budget (generation engines): the sum of every admitted
+        # request's reserved tokens (prompt + max_new) may not exceed
+        # this — cache slots and decode time are provisioned in tokens,
+        # not requests, so admission must be too
+        self.max_tokens = int(max_tokens) if max_tokens else None
+        self._tokens = 0
         self._depth = 0
         self._lock = threading.Lock()
         from ..profiler import metrics as _metrics
@@ -74,6 +81,9 @@ class AdmissionController:
         self._depth_gauge = _metrics.gauge(
             f"{name}.queue_depth", "requests currently waiting in the "
             "engine queue")
+        self._tokens_gauge = _metrics.gauge(
+            f"{name}.tokens_in_flight", "reserved tokens (prompt + max "
+            "new) of admitted-but-unfinished generation requests")
         self._name = name
         self._closed = False
 
@@ -96,8 +106,9 @@ class AdmissionController:
             raise EngineClosed(msg)
         raise RequestRejected(msg, reason=reason)
 
-    def acquire(self, rows: int = 1):
-        """Admit one request of ``rows`` samples or raise
+    def acquire(self, rows: int = 1, tokens: int = 0):
+        """Admit one request of ``rows`` samples (reserving ``tokens``
+        against the token budget, when one is configured) or raise
         :class:`RequestRejected`."""
         if self._closed:
             self._reject("closed", "engine is closed")
@@ -107,25 +118,54 @@ class AdmissionController:
                 f"request carries {rows} rows but max_batch_size is "
                 f"{self.max_rows}; split the request (a batch the "
                 "engine could never place would wait forever)")
+        if self.max_tokens is not None and tokens > self.max_tokens:
+            self._reject(
+                "too_large",
+                f"request reserves {tokens} tokens but the engine's "
+                f"whole token budget is {self.max_tokens}; shorten the "
+                "prompt or max_new_tokens")
+        reason = None
         with self._lock:
             if self._depth >= self.max_queue:
-                depth = self._depth
+                reason, depth = "queue_full", self._depth
+            elif self.max_tokens is not None and \
+                    self._tokens + tokens > self.max_tokens:
+                reason, held = "token_budget", self._tokens
             else:
                 self._depth += 1
                 self._depth_gauge.set(self._depth)
+                self._tokens += tokens
+                self._tokens_gauge.set(self._tokens)
                 self._admitted.inc()
                 return
+        if reason == "queue_full":
+            self._reject(
+                "queue_full",
+                f"engine queue is full ({depth}/{self.max_queue} "
+                "waiting); overload is shed explicitly — retry with "
+                "backoff or scale workers (EngineConfig.max_queue "
+                "bounds this)")
         self._reject(
-            "queue_full",
-            f"engine queue is full ({depth}/{self.max_queue} waiting); "
-            "overload is shed explicitly — retry with backoff or scale "
-            "workers (EngineConfig.max_queue bounds this)")
+            "token_budget",
+            f"token budget exhausted ({held}+{tokens} over "
+            f"{self.max_tokens} reserved tokens in flight); retry when "
+            "running generations finish (max_tokens_in_flight bounds "
+            "this)")
 
     def release(self):
         """The request left the queue (picked into a batch or shed)."""
         with self._lock:
             self._depth = max(0, self._depth - 1)
             self._depth_gauge.set(self._depth)
+
+    def release_tokens(self, tokens: int):
+        """A generation request retired (finished / shed / failed):
+        return its reserved tokens to the budget."""
+        if not tokens:
+            return
+        with self._lock:
+            self._tokens = max(0, self._tokens - int(tokens))
+            self._tokens_gauge.set(self._tokens)
 
     def shed_deadline(self):
         self._shed.inc()

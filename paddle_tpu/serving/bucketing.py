@@ -22,8 +22,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["next_bucket", "bucket_shape", "pad_batch", "BucketPolicy",
-           "ExecutableCache"]
+__all__ = ["next_bucket", "bucket_shape", "pad_batch", "seq_buckets",
+           "BucketPolicy", "ExecutableCache"]
 
 
 def next_bucket(n: int, min_bucket: int = 1, cap: Optional[int] = None
@@ -90,6 +90,22 @@ class BucketPolicy:
             b <<= 1
             n += 1
         return n
+
+
+def seq_buckets(max_length: int, min_bucket: int = 8) -> List[int]:
+    """All pow2 sequence-length buckets in ``[min_bucket, max_length]``
+    (the last clamps to ``max_length``).  This is the compile-count
+    bound for generation prefill: one prefill executable per entry (+1
+    decode executable per batch capacity) no matter how many prompts
+    of how many lengths arrive — what ``tools/decode_gate.py`` and the
+    bench assert against."""
+    out, b = [], max(1, int(min_bucket))
+    cap = max(1, int(max_length))
+    while b < cap:
+        out.append(b)
+        b <<= 1
+    out.append(cap)
+    return out
 
 
 def bucket_shape(shape: Sequence[int], max_batch_size: int = 8

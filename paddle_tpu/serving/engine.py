@@ -40,7 +40,8 @@ from .admission import (AdmissionController, DeadlineExceeded,
 from .bucketing import BucketPolicy, ExecutableCache
 
 __all__ = ["EngineConfig", "InferenceEngine", "RequestRejected",
-           "DeadlineExceeded", "EngineClosed"]
+           "DeadlineExceeded", "EngineClosed", "GenerationEngineConfig",
+           "GenerationEngine", "GenerationStream"]
 
 
 class EngineConfig:
@@ -567,3 +568,471 @@ class InferenceEngine:
         exe = self._cache.get_or_compile(key, compile_fn)
         out = exe(*leading, *arrays)
         return predictor._finalize_outputs(out)
+
+
+# ---------------------------------------------------------------------------
+# continuous (in-flight) batching for autoregressive generation
+# ---------------------------------------------------------------------------
+
+class GenerationEngineConfig:
+    """Knobs for :class:`GenerationEngine`.
+
+    max_slots            rows of the running decode batch (the slot
+                         count); every compiled step has exactly this
+                         batch shape, so empty slots cost compute but
+                         never a recompile
+    max_length           KV-cache capacity per slot (prompt + generated
+                         tokens); defaults to the model's max_seq_len
+    max_new_tokens       per-request default generation budget
+    max_queue            admission bound on waiting requests (default:
+                         FLAGS_serving_queue_depth)
+    max_tokens_in_flight token-budget admission bound: the sum of every
+                         admitted request's (prompt_len +
+                         max_new_tokens) reservation; default
+                         max_slots * max_length (i.e. "what the cache
+                         can physically hold")
+    deadline_ms          default per-request deadline (sheds while
+                         queued, like the batch engine); None = none
+    prompt_bucket_min    smallest prompt-length bucket (prefill
+                         executables are one-per-bucket)
+    name                 metrics prefix (default "serving" — gives the
+                         ``serving.prefill`` / ``serving.decode`` /
+                         ``serving.compile`` names the gates assert on)
+    """
+
+    def __init__(self, max_slots: int = 4,
+                 max_length: Optional[int] = None,
+                 max_new_tokens: int = 64,
+                 max_queue: Optional[int] = None,
+                 max_tokens_in_flight: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 prompt_bucket_min: int = 8,
+                 name: str = "serving"):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.max_slots = int(max_slots)
+        self.max_length = max_length
+        self.max_new_tokens = int(max_new_tokens)
+        if max_queue is None:
+            from ..utils import flags as _flags
+            max_queue = int(_flags.get_flag("FLAGS_serving_queue_depth"))
+        self.max_queue = int(max_queue)
+        self.max_tokens_in_flight = max_tokens_in_flight
+        self.deadline_ms = deadline_ms
+        self.prompt_bucket_min = int(prompt_bucket_min)
+        self.name = str(name)
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "temperature", "top_k", "top_p",
+                 "seed", "eos", "deadline", "budget", "future", "queue",
+                 "tokens", "t_submit", "t_first", "t_last", "cancelled")
+
+    def __init__(self, prompt, max_new, temperature, top_k, top_p,
+                 seed, eos, deadline, budget):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = seed
+        self.eos = eos
+        self.deadline = deadline
+        self.budget = budget
+        self.future: Future = Future()
+        self.queue: "_queue.Queue" = _queue.Queue()
+        self.tokens: List[int] = []
+        self.t_submit = time.monotonic()
+        self.t_first = None
+        self.t_last = None
+        self.cancelled = False
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) > self.deadline
+
+
+class GenerationStream:
+    """Handle for one in-flight generation request.
+
+    Iterate it to consume tokens as the engine emits them (ends when
+    the request finishes; raises the request's error if it failed), or
+    call :meth:`result` to block for the full generated sequence.
+    ``cancel()`` asks the scheduler to retire the request at the next
+    token boundary — the future then resolves to the partial tokens.
+    """
+
+    def __init__(self, req: _GenRequest):
+        self._req = req
+
+    def __iter__(self):
+        while True:
+            item = self._req.queue.get()
+            if item is None:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Generated token ids (prompt excluded; eos, when hit,
+        included as the last element)."""
+        try:
+            return self._req.future.result(timeout=timeout)
+        except (TimeoutError, _FutureTimeout):
+            if self._req.future.done():
+                return self._req.future.result()
+            raise DeadlineExceeded(
+                f"no result within {timeout}s (generation may still "
+                "be running; iterate the stream for partial tokens)")
+
+    def cancel(self):
+        self._req.cancelled = True
+
+    @property
+    def done(self) -> bool:
+        return self._req.future.done()
+
+
+class GenerationEngine:
+    """Continuous (in-flight) batching over an autoregressive model.
+
+    The PR 4 :class:`InferenceEngine` coalesces independent one-shot
+    requests; LLM chat traffic is iterative — each request is a decode
+    LOOP whose length nobody knows up front.  Batching whole requests
+    would make every short request wait for the longest batchmate.
+    This engine batches at **token boundaries** instead (Orca-style):
+
+    - a fixed bank of ``max_slots`` decode slots runs one fused
+      fixed-shape decode step per token for every occupied slot;
+    - queued requests are admitted into free slots BETWEEN decode
+      steps: their prompts are prefilled (grouped per prompt-length
+      bucket) directly into the shared fixed-capacity KV-cache without
+      touching running neighbours (``update_mask`` merge);
+    - finished rows (eos / token budget / cache full) retire
+      immediately and their slot is re-admitted next boundary — the
+      batch never drains to refill;
+    - tokens stream out per request as they are sampled
+      (:class:`GenerationStream`; the HTTP layer exposes SSE).
+
+    Because rows never interact (see ``generation/sampling.py``) and
+    every step runs at the same ``(max_slots, ...)`` shapes as a
+    solo :meth:`GenerationSession.generate` call over the same session,
+    each streamed sequence is **bit-identical** to the sequential
+    ``generate()`` reference — chaos soak in ``tools/decode_gate.py``
+    pins exactly that.
+
+    Admission extends PR 4's queue-depth bound with a **token budget**
+    (``max_tokens_in_flight``): requests reserve prompt + max_new
+    tokens at submit and return them at retirement, so overload sheds
+    in the unit the hardware is actually provisioned in.
+
+    Metrics (PR 1 registry, ``<name>.`` prefix): ``prefill``/``decode``
+    step histograms, ``ttft_ms``, ``inter_token_ms``,
+    ``decode.occupancy``, ``tokens_out``, ``compile`` + the admission
+    SLO counters.
+    """
+
+    def __init__(self, model, config: Optional[GenerationEngineConfig]
+                 = None):
+        from ..generation import GenerationSession
+        self.config = config or GenerationEngineConfig()
+        cfg = self.config
+        self.model = model
+        max_len = int(cfg.max_length or model.cfg.max_seq_len)
+        self.session = GenerationSession(
+            model, batch_capacity=cfg.max_slots, max_length=max_len,
+            prompt_bucket_min=cfg.prompt_bucket_min, name=cfg.name)
+        self.max_length = self.session.max_length
+        S = self.slots = self.session.batch_capacity
+        self.metrics_prefix = cfg.name
+        budget = cfg.max_tokens_in_flight
+        if budget is None:
+            budget = S * self.max_length
+        self._admission = AdmissionController(
+            cfg.max_queue, max_rows=None, name=cfg.name,
+            max_tokens=int(budget))
+
+        from ..profiler import metrics as _metrics
+        p = cfg.name
+        self._m_ttft = _metrics.histogram(
+            f"{p}.ttft_ms", "time to first token (submit -> first "
+            "sampled token)")
+        self._m_itl = _metrics.histogram(
+            f"{p}.inter_token_ms", "gap between consecutive streamed "
+            "tokens of one request")
+        self._m_occ = _metrics.histogram(
+            f"{p}.decode.occupancy", "occupied slots per decode step")
+        self._m_done = _metrics.counter(
+            f"{p}.request.completed", "requests answered successfully")
+        self._m_failed = _metrics.counter(
+            f"{p}.request.failed", "requests completed exceptionally")
+        self._m_cancelled = _metrics.counter(
+            f"{p}.request.cancelled", "requests retired by client "
+            "cancel (future resolves to the partial tokens; not "
+            "counted as completed — SLO dashboards must not mistake "
+            "disconnects for answers)")
+        _metrics.gauge(f"{p}.slots", "decode slots").set(S)
+
+        # slot bank (host-side control state; caches live on device)
+        self._caches = self.session.init_caches()
+        self._slot_req: List[Optional[_GenRequest]] = [None] * S
+        self._positions = np.zeros((S,), np.int32)
+        self._last_tok = np.zeros((S,), np.int32)
+        self._keys = np.zeros((S, 2), np.uint32)
+        self._temps = np.zeros((S,), np.float32)
+        self._tks = np.zeros((S,), np.int32)
+        self._tps = np.ones((S,), np.float32)
+
+        self._pending: deque = deque()
+        self._cond = threading.Condition()
+        self._mlock = threading.Lock()
+        self._stop = False
+        self._paused = False
+        self._closed = False
+        self._scheduler = threading.Thread(
+            target=self._loop, name="generation-scheduler", daemon=True)
+        self._scheduler.start()
+
+    # -- client surface ------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               do_sample: bool = False, temperature: float = 1.0,
+               top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+               eos_token_id: Optional[int] = None,
+               deadline_ms: Optional[float] = "default"
+               ) -> GenerationStream:
+        """Enqueue one prompt; returns a :class:`GenerationStream`.
+        Raises :class:`RequestRejected` at admission (``queue_full`` /
+        ``token_budget`` / ``too_large`` / ``closed``); the
+        ``serve.request`` chaos site can fail or delay here."""
+        prompt = np.asarray(getattr(prompt, "_data", prompt))
+        prompt = prompt.reshape(-1).astype(np.int32)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.config.max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size >= self.max_length:
+            # route through the controller so the per-reason counter and
+            # its lock discipline apply (the gates assert exact counts)
+            self._admission._reject(
+                "too_large",
+                f"prompt of {prompt.size} tokens leaves no room in the "
+                f"{self.max_length}-slot KV-cache")
+        from ..utils import chaos as _chaos
+        if _chaos.active:
+            _chaos.hit("serve.request")
+        budget = int(prompt.size) + max_new
+        self._admission.acquire(tokens=budget)
+        if deadline_ms == "default":
+            deadline_ms = self.config.deadline_ms
+        req = _GenRequest(
+            prompt, max_new,
+            float(temperature) if do_sample else 0.0, int(top_k),
+            float(top_p), int(seed), eos_token_id,
+            deadline_from_ms(deadline_ms), budget)
+        with self._cond:
+            if self._closed:
+                self._admission.release()
+                self._admission.release_tokens(budget)
+                raise EngineClosed()
+            self._pending.append(req)
+            self._cond.notify()
+        return GenerationStream(req)
+
+    def generate(self, prompt, timeout: Optional[float] = None,
+                 **kw) -> np.ndarray:
+        """Blocking submit: the full generated sequence."""
+        return self.submit(prompt, **kw).result(timeout=timeout)
+
+    # -- operations ----------------------------------------------------
+    def pause(self):
+        """Stop admitting queued requests into slots (running slots
+        keep decoding); admission keeps filling up to the bounds, then
+        sheds — the deterministic-overload test hook."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self):
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def stats(self) -> Dict[str, object]:
+        from ..profiler import metrics as _metrics
+        snap = _metrics.snapshot()
+        return {k: v for k, v in snap.items()
+                if k.startswith(self.metrics_prefix + ".")}
+
+    def close(self, timeout: Optional[float] = 60.0):
+        """Reject new work, let queued + running requests finish, stop
+        the scheduler."""
+        self._admission.close()
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._stop = True
+            self._paused = False
+            self._cond.notify_all()
+        self._scheduler.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- scheduler -----------------------------------------------------
+    def _occupied(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is not None]
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while (not self._stop and not self._pending
+                       and not self._occupied()) or \
+                        (self._paused and not self._occupied()
+                         and not self._stop):
+                    self._cond.wait()
+                if self._stop and not self._pending \
+                        and not self._occupied():
+                    break
+            try:
+                self._admit()
+                occ = self._occupied()
+                if not occ:
+                    continue
+                tok, self._caches = self.session.decode(
+                    self._caches, self._last_tok, self._positions,
+                    self._keys, self._temps, self._tks, self._tps,
+                    live_rows=len(occ))
+                with self._mlock:
+                    self._m_occ.observe(len(occ))
+                self._positions = self._positions + 1
+                # copy: np.asarray over a device buffer is read-only,
+                # and _admit writes per-slot entries in place
+                self._last_tok = np.array(tok, np.int32)
+                for s in occ:
+                    self._emit(s, int(tok[s]))
+            except BaseException as e:  # noqa: BLE001 — fail everything in flight
+                self._fail_all(e)
+
+    def _admit(self):
+        """Token-boundary admission: move queued requests into free
+        slots, grouped per prompt-length bucket, one masked prefill per
+        group; running neighbours' cache rows are untouched."""
+        took: List[Tuple[int, _GenRequest]] = []
+        with self._cond:
+            if self._paused:
+                return
+            free = [i for i, r in enumerate(self._slot_req)
+                    if r is None]
+            while self._pending and free:
+                req = self._pending.popleft()
+                self._admission.release()
+                if req.expired():
+                    self._shed(req)
+                    continue
+                if req.cancelled:
+                    self._retire(req, slot=None)
+                    continue
+                took.append((free.pop(0), req))
+        if not took:
+            return
+        groups: Dict[int, List[Tuple[int, _GenRequest]]] = {}
+        for slot, req in took:
+            pb = self.session.prompt_bucket(len(req.prompt))
+            groups.setdefault(pb, []).append((slot, req))
+        for pb, members in sorted(groups.items()):
+            S = self.slots
+            ids = np.zeros((S, pb), np.int32)
+            plens = np.ones((S,), np.int32)
+            mask = np.zeros((S,), bool)
+            for slot, req in members:
+                n = len(req.prompt)
+                ids[slot, :n] = req.prompt
+                plens[slot] = n
+                mask[slot] = True
+                self._slot_req[slot] = req
+                self._keys[slot] = np.asarray(
+                    jax_random_key(req.seed), np.uint32)
+                self._temps[slot] = req.temperature
+                self._tks[slot] = req.top_k
+                self._tps[slot] = req.top_p
+            tok, self._caches = self.session.prefill(
+                self._caches, ids, plens, mask, self._keys,
+                self._temps, self._tks, self._tps)
+            for slot, req in members:
+                self._positions[slot] = plens[slot]
+                self._last_tok[slot] = tok[slot]
+                self._emit(slot, int(tok[slot]))
+
+    def _emit(self, slot: int, tok: int):
+        req = self._slot_req[slot]
+        if req is None:
+            return
+        now = time.monotonic()
+        with self._mlock:
+            if req.t_first is None:
+                req.t_first = now
+                self._m_ttft.observe((now - req.t_submit) * 1e3)
+            else:
+                self._m_itl.observe((now - req.t_last) * 1e3)
+        req.t_last = now
+        req.tokens.append(tok)
+        req.queue.put(tok)
+        hit_eos = req.eos is not None and tok == int(req.eos)
+        out_of_room = self._positions[slot] + 1 >= self.max_length
+        if hit_eos or req.cancelled or out_of_room \
+                or len(req.tokens) >= req.max_new:
+            self._retire(req, slot)
+
+    def _retire(self, req: _GenRequest, slot: Optional[int]):
+        if slot is not None:
+            self._slot_req[slot] = None
+        self._admission.release_tokens(req.budget)
+        if not req.future.done():
+            req.future.set_result(np.asarray(req.tokens, np.int32))
+            with self._mlock:
+                if req.cancelled:
+                    self._m_cancelled.inc()
+                else:
+                    self._m_done.inc()
+        req.queue.put(None)
+
+    def _shed(self, req: _GenRequest):
+        with self._mlock:
+            self._admission.shed_deadline()
+        self._admission.release_tokens(req.budget)
+        exc = DeadlineExceeded(
+            "request deadline expired while queued (engine overloaded "
+            "relative to the deadline)")
+        if not req.future.done():
+            req.future.set_exception(exc)
+        req.queue.put(exc)
+
+    def _fail_all(self, exc: BaseException):
+        with self._cond:
+            pending = list(self._pending)
+            self._pending.clear()
+        victims = pending + [r for r in self._slot_req if r is not None]
+        self._slot_req = [None] * self.slots
+        for req in victims:
+            self._admission.release_tokens(req.budget)
+            if not req.future.done():
+                req.future.set_exception(exc)
+                with self._mlock:
+                    self._m_failed.inc()
+            req.queue.put(exc)
+        for _ in pending:
+            self._admission.release()
+
+
+def jax_random_key(seed: int):
+    """Per-request base PRNG key — derived from the request's OWN seed
+    so its sampled stream is independent of slot placement and
+    batchmates (the decode-gate parity contract)."""
+    import jax
+    return np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)

@@ -1,12 +1,27 @@
-"""Stdlib HTTP frontend for the InferenceEngine.
+"""Stdlib HTTP frontend for the serving engines.
 
 No framework dependency — ``http.server.ThreadingHTTPServer`` is enough
-because the engine already owns queueing, batching, and admission; each
-HTTP handler thread just parks on its request future.  Endpoints:
+because the engines already own queueing, batching, and admission; each
+HTTP handler thread just parks on its request future (or relays a token
+stream).  Endpoints:
 
     POST /v1/infer    JSON  {"inputs": {name: nested-list}, ...}
                       or an .npz body (Content-Type application/x-npz)
                       with one array per input name
+    POST /v1/generate JSON  {"prompt_ids": [...], "max_new_tokens": n,
+                      "do_sample": bool, "temperature": t, "top_k": k,
+                      "top_p": p, "seed": s, "eos_token_id": id,
+                      "stream": bool} against a GenerationEngine.
+                      ``stream=false`` answers one JSON object
+                      {"tokens": [...]} when generation finishes;
+                      ``stream=true`` answers ``text/event-stream``
+                      over chunked transfer — one ``data:`` event per
+                      sampled token as the continuous batcher emits it
+                      ({"token": id, "index": n}), then a final
+                      {"done": true, "tokens": [...]} event.  Errors
+                      after streaming began arrive as a terminal
+                      {"error": ...} event (the status line already
+                      went out).
     GET  /healthz     liveness + pool/queue snapshot (JSON)
     GET  /metrics     Prometheus text exposition of the whole profiler
                       metrics registry (PR 1 exporter)
@@ -59,19 +74,24 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- GET -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 - stdlib handler naming
-        engine = self.server.engine
+        engine = self.server.engine or self.server.generation_engine
         if self.path == "/healthz":
             from ..profiler import metrics as _metrics
             depth = _metrics.get(
                 getattr(engine, "metrics_prefix", "serving")
                 + ".queue_depth")
-            self._send_json(200, {
-                "status": "ok",
-                "model_inputs": engine.input_names,
-                "workers": engine.config.num_workers,
-                "max_batch_size": engine.config.max_batch_size,
-                "queue_depth": depth.value if depth else 0,
-            })
+            body = {"status": "ok",
+                    "queue_depth": depth.value if depth else 0}
+            if self.server.engine is not None:
+                e = self.server.engine
+                body.update(model_inputs=e.input_names,
+                            workers=e.config.num_workers,
+                            max_batch_size=e.config.max_batch_size)
+            g = self.server.generation_engine
+            if g is not None:
+                body.update(decode_slots=g.slots,
+                            max_length=g.max_length)
+            self._send_json(200, body)
         elif self.path == "/metrics":
             from ..profiler import metrics as _metrics
             self._send(200, _metrics.prometheus_text().encode(),
@@ -82,22 +102,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- POST ----------------------------------------------------------
     def do_POST(self):  # noqa: N802
+        if self.path in ("/v1/generate", "/generate"):
+            self._do_generate()
+            return
         if self.path not in ("/v1/infer", "/infer"):
             self._send_json(404, {"error": f"no route {self.path}"})
             return
         engine = self.server.engine
+        if engine is None:
+            self._send_json(404, {"error": "no inference engine bound; "
+                                  "this server only serves /v1/generate"})
+            return
         try:
-            length = int(self.headers.get("Content-Length") or 0)
-            cap = getattr(self.server, "max_body_bytes", 0)
-            if cap and length > cap:
-                # shed, don't OOM — the admission contract applies to
-                # payload bytes too, BEFORE buffering the body
-                self._send_json(413, {
-                    "error": f"request body {length} bytes exceeds the "
-                             f"server cap {cap}",
-                    "reason": "body_too_large"})
+            body = self._read_body()
+            if body is None:
                 return
-            body = self.rfile.read(length)
             ctype = (self.headers.get("Content-Type") or "").lower()
             deadline_ms = self.headers.get("X-Deadline-Ms")
             if "json" in ctype or not ctype:
@@ -145,6 +164,117 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(200, {"outputs": dict(zip(names, outs))})
 
+    def _read_body(self):
+        """Read the request body under the server byte cap.  Returns
+        the bytes, or None after answering 413 — shed, don't OOM: the
+        admission contract applies to payload bytes too, BEFORE
+        buffering (shared by /v1/infer and /v1/generate)."""
+        length = int(self.headers.get("Content-Length") or 0)
+        cap = getattr(self.server, "max_body_bytes", 0)
+        if cap and length > cap:
+            self._send_json(413, {
+                "error": f"request body {length} bytes exceeds the "
+                         f"server cap {cap}",
+                "reason": "body_too_large"})
+            return None
+        return self.rfile.read(length)
+
+    # -- generation (streaming) ----------------------------------------
+    def _do_generate(self):
+        gen = self.server.generation_engine
+        if gen is None:
+            self._send_json(404, {"error": "no generation engine bound "
+                                  "(serve a model via GenerationEngine)"})
+            return
+        try:
+            body = self._read_body()
+            if body is None:
+                return
+            payload = json.loads(body.decode() or "{}")
+            prompt = payload.get("prompt_ids", payload.get("prompt"))
+            if prompt is None:
+                raise ValueError("missing 'prompt_ids'")
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+            kw = {}
+            for k in ("max_new_tokens", "top_k", "seed",
+                      "eos_token_id"):
+                if payload.get(k) is not None:
+                    kw[k] = int(payload[k])
+            for k in ("temperature", "top_p", "deadline_ms"):
+                if payload.get(k) is not None:
+                    kw[k] = float(payload[k])
+            if self.headers.get("X-Deadline-Ms") is not None:
+                kw["deadline_ms"] = float(self.headers["X-Deadline-Ms"])
+            kw["do_sample"] = bool(payload.get("do_sample", False))
+            stream = bool(payload.get("stream", False))
+        except Exception as e:
+            self._send_json(400, {"error": f"malformed payload: {e}"})
+            return
+        try:
+            handle = gen.submit(prompt, **kw)
+        except EngineClosed as e:
+            self._send_json(503, {"error": str(e), "reason": e.reason})
+            return
+        except RequestRejected as e:
+            self._send_json(429, {"error": str(e), "reason": e.reason})
+            return
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        except Exception as e:   # chaos injection / model-side failure
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        if not stream:
+            try:
+                toks = handle.result()
+            except DeadlineExceeded as e:
+                self._send_json(504, {"error": str(e),
+                                      "reason": "deadline"})
+                return
+            except Exception as e:
+                self._send_json(500,
+                                {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send_json(200, {"tokens": toks.tolist()})
+            return
+        # SSE over chunked transfer: the status goes out before the
+        # request finishes, so late errors become a terminal event
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            i = 0
+            for tok in handle:
+                self._chunk(f"data: {json.dumps({'token': int(tok), 'index': i})}\n\n")
+                i += 1
+            self._chunk("data: " + json.dumps(
+                {"done": True,
+                 "tokens": handle.result().tolist()}) + "\n\n")
+        except OSError:
+            # client went away mid-stream: free the decode slot and the
+            # token-budget reservation at the next token boundary
+            # instead of generating the rest for nobody
+            handle.cancel()
+            return
+        except Exception as e:
+            try:
+                self._chunk("data: " + json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}) + "\n\n")
+            except OSError:
+                handle.cancel()
+                return
+        try:
+            self.wfile.write(b"0\r\n\r\n")   # chunked terminator
+        except OSError:
+            pass
+
+    def _chunk(self, text: str):
+        data = text.encode()
+        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self.wfile.flush()
+
     @staticmethod
     def _decode_json_inputs(engine, inputs):
         if isinstance(inputs, dict):
@@ -162,12 +292,21 @@ class ServingServer:
     the listener down (the engine itself is NOT closed — callers own its
     lifecycle, so one engine can outlive server restarts)."""
 
-    def __init__(self, engine, host: str = "127.0.0.1",
+    def __init__(self, engine=None, host: str = "127.0.0.1",
                  port: int = 0, verbose: bool = False,
-                 max_body_bytes: int = 64 << 20):
+                 max_body_bytes: int = 64 << 20,
+                 generation_engine=None):
+        from .engine import GenerationEngine
+        if generation_engine is None and isinstance(engine,
+                                                    GenerationEngine):
+            engine, generation_engine = None, engine
+        if engine is None and generation_engine is None:
+            raise ValueError("bind at least one engine")
         self.engine = engine
+        self.generation_engine = generation_engine
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.engine = engine
+        self._httpd.generation_engine = generation_engine
         self._httpd.verbose = verbose
         self._httpd.max_body_bytes = int(max_body_bytes)
         self._httpd.daemon_threads = True
@@ -199,8 +338,9 @@ def serve(model, host: str = "127.0.0.1", port: int = 8975,
     """One-call endpoint: build an InferenceEngine over ``model`` (path
     prefix / inference.Config / Predictor) and serve it over HTTP.
     ``block=False`` returns the started :class:`ServingServer`."""
-    from .engine import InferenceEngine
-    owns_engine = not isinstance(model, InferenceEngine)
+    from .engine import GenerationEngine, InferenceEngine
+    owns_engine = not isinstance(model, (InferenceEngine,
+                                         GenerationEngine))
     engine = model if not owns_engine \
         else InferenceEngine(model, config=config)
     server = ServingServer(engine, host=host, port=port).start()

@@ -145,6 +145,39 @@ class FasterTokenizer(Layer):
             out.extend(self._wordpiece(w))
         return out
 
+    # -- decode (the streaming-serving contract) ---------------------------
+    def convert_ids_to_tokens(self, ids) -> List[str]:
+        """Inverse vocab lookup (unknown ids -> the unk token)."""
+        if getattr(self, "_inv_vocab", None) is None or \
+                len(self._inv_vocab) != len(self.vocab):
+            self._inv_vocab = {i: t for t, i in self.vocab.items()}
+        inv = self._inv_vocab
+        return [inv.get(int(i), self.unk_token) for i in np.asarray(
+            getattr(ids, "_data", ids)).reshape(-1)]
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        """Token ids -> text: wordpieces merge at their ``##``
+        continuation prefix, words join with single spaces.  This is
+        the contract the token-streaming serving path leans on:
+        ``decode(encode(text))`` round-trips any text that is already
+        clean, lower-case, whitespace-delimited, in-vocab wordpiece
+        material (tests/test_tokenizer.py pins it) — tokenization is
+        lossy beyond that (case folding, accent stripping, whitespace
+        collapse) by design.  ``skip_special_tokens`` drops
+        [CLS]/[SEP]/[PAD]/[UNK]-style framing."""
+        specials = {self.cls_token, self.sep_token, self.pad_token}
+        if skip_special_tokens:
+            specials.add(self.unk_token)
+        pieces = []
+        for tok in self.convert_ids_to_tokens(ids):
+            if skip_special_tokens and tok in specials:
+                continue
+            if tok.startswith("##") and pieces:
+                pieces[-1] += tok[2:]
+            else:
+                pieces.append(tok)
+        return " ".join(pieces)
+
     def forward(self, text, text_pair=None, max_seq_len=0,
                 pad_to_max_seq_len=False):
         """Returns (input_ids [B, L], token_type_ids [B, L]) int64

@@ -1,0 +1,258 @@
+"""Continuous-batching GenerationEngine + streaming HTTP serving.
+
+Acceptance surface:
+
+- every continuously-batched, streamed sequence is BIT-IDENTICAL to a
+  sequential ``GenerationSession.generate`` reference over the same
+  session (slot placement, batchmates, and admission timing must not
+  leak into the math);
+- admission extends to token budgets (``token_budget`` rejection) on
+  top of the PR 4 queue-depth bound;
+- total XLA compiles stay bounded by the bucket count (one decode + one
+  prefill per prompt-length bucket) across arbitrary traffic;
+- the SSE endpoint streams the same tokens the engine emits.
+"""
+import json
+import http.client
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.models import GPT, GPTConfig
+from paddle_tpu.profiler import metrics
+from paddle_tpu.serving.bucketing import seq_buckets
+
+CFG = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                num_heads=2, max_seq_len=64, ffn_mult=2)
+
+
+def val(name):
+    m = metrics.get(name)
+    return m.value if m is not None else 0
+
+
+@pytest.fixture(scope="module")
+def net():
+    paddle.seed(0)
+    return GPT(CFG)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.RandomState(1)
+    return [rng.randint(1, CFG.vocab_size, (n,)).astype(np.int32)
+            for n in (3, 5, 7, 4, 6, 9)]
+
+
+def make_engine(net, name, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_new_tokens", 8)
+    return serving.GenerationEngine(
+        net, serving.GenerationEngineConfig(name=name, **kw))
+
+
+def test_single_request_matches_sequential_reference(net, prompts):
+    with make_engine(net, "gse_single") as eng:
+        got = eng.generate(prompts[0], max_new_tokens=6, timeout=120)
+        ref = eng.session.generate([prompts[0]], max_new_tokens=6)[0]
+        assert np.array_equal(got, ref)
+
+
+def test_continuous_batching_bit_identical_staggered(net, prompts):
+    """Staggered concurrent clients with per-request seeds/sampling:
+    every result equals its solo sequential reference over the SAME
+    session — the continuous batcher may not change a single bit."""
+    with make_engine(net, "gse_stagger") as eng:
+        streams = {}
+
+        def client(i):
+            time.sleep(0.004 * i)
+            streams[i] = eng.submit(
+                prompts[i], max_new_tokens=6, do_sample=True,
+                temperature=0.8, top_k=12, top_p=0.95, seed=100 + i)
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        results = {i: s.result(timeout=120)
+                   for i, s in streams.items()}
+        for i, p in enumerate(prompts):
+            ref = eng.session.generate(
+                [p], max_new_tokens=6, do_sample=True, temperature=0.8,
+                top_k=12, top_p=0.95, seed=100 + i)[0]
+            assert np.array_equal(results[i], ref), i
+        # the batch actually ran multi-occupancy at some point
+        occ = metrics.get("gse_stagger.decode.occupancy")
+        assert occ is not None and occ._max >= 2
+
+
+def test_stream_yields_tokens_then_result_matches(net, prompts):
+    with make_engine(net, "gse_stream") as eng:
+        s = eng.submit(prompts[1], max_new_tokens=5, seed=3)
+        toks = list(s)
+        assert len(toks) == 5
+        assert np.array_equal(np.asarray(toks, np.int32), s.result())
+
+
+def test_compiles_bounded_by_bucket_count(net, prompts):
+    """Mixed prompt lengths: compiles <= one decode + one prefill per
+    pow2 prompt bucket, regardless of request count."""
+    name = "gse_buckets"
+    c0 = val(f"{name}.compile")
+    with make_engine(net, name, max_length=64) as eng:
+        for rep in range(2):
+            for p in prompts:
+                eng.generate(p, max_new_tokens=3, timeout=120)
+        bound = len(seq_buckets(64, eng.config.prompt_bucket_min)) + 1
+        compiles = val(f"{name}.compile") - c0
+        assert compiles <= bound, (compiles, bound)
+        # 12 requests through at most `bound` executables
+        assert val(f"{name}.request.completed") == 2 * len(prompts)
+
+
+def test_token_budget_admission(net, prompts):
+    with make_engine(net, "gse_budget", max_slots=2,
+                     max_tokens_in_flight=20) as eng:
+        eng.pause()
+        a = eng.submit(prompts[0], max_new_tokens=10)    # 3+10 = 13
+        with pytest.raises(serving.RequestRejected) as ei:
+            eng.submit(prompts[1], max_new_tokens=10)    # 5+10 over
+        assert ei.value.reason == "token_budget"
+        # a single request over the whole budget is too_large
+        with pytest.raises(serving.RequestRejected) as ei2:
+            eng.submit(prompts[2], max_new_tokens=50)
+        assert ei2.value.reason == "too_large"
+        eng.resume()
+        a.result(timeout=120)
+        # budget returned at retirement: now admits again
+        eng.generate(prompts[1], max_new_tokens=10, timeout=120)
+
+
+def test_queue_depth_admission(net, prompts):
+    with make_engine(net, "gse_queue", max_queue=2) as eng:
+        eng.pause()
+        parked = [eng.submit(prompts[0], max_new_tokens=2)
+                  for _ in range(2)]
+        with pytest.raises(serving.RequestRejected) as ei:
+            eng.submit(prompts[0], max_new_tokens=2)
+        assert ei.value.reason == "queue_full"
+        eng.resume()
+        for s in parked:
+            s.result(timeout=120)
+
+
+def test_deadline_sheds_while_queued(net, prompts):
+    with make_engine(net, "gse_deadline") as eng:
+        eng.pause()
+        s = eng.submit(prompts[0], max_new_tokens=4, deadline_ms=20)
+        time.sleep(0.1)
+        eng.resume()
+        with pytest.raises(serving.DeadlineExceeded):
+            s.result(timeout=120)
+        assert val("gse_deadline.request.shed_deadline") >= 1
+
+
+def test_prompt_overflow_rejected(net):
+    with make_engine(net, "gse_long", max_length=16) as eng:
+        with pytest.raises(serving.RequestRejected) as ei:
+            eng.submit(np.ones(16, np.int32))
+        assert ei.value.reason == "too_large"
+
+
+def test_close_rejects_new_finishes_running(net, prompts):
+    eng = make_engine(net, "gse_close")
+    s = eng.submit(prompts[0], max_new_tokens=4)
+    eng.close()
+    assert len(s.result(timeout=120)) == 4
+    with pytest.raises(serving.RequestRejected):
+        eng.submit(prompts[0])
+
+
+def test_cancel_retires_with_partial_tokens(net, prompts):
+    with make_engine(net, "gse_cancel") as eng:
+        s = eng.submit(prompts[0], max_new_tokens=64)
+        it = iter(s)
+        first = next(it)
+        s.cancel()
+        out = s.result(timeout=120)
+        assert out[0] == first and len(out) < 64
+
+
+def test_ttft_and_inter_token_metrics(net, prompts):
+    name = "gse_metrics"
+    with make_engine(net, name) as eng:
+        eng.generate(prompts[0], max_new_tokens=5, timeout=120)
+    assert metrics.get(f"{name}.ttft_ms").count == 1
+    assert metrics.get(f"{name}.inter_token_ms").count == 4
+    assert metrics.get(f"{name}.prefill").count == 1
+    assert metrics.get(f"{name}.decode").count >= 4
+    assert val(f"{name}.tokens_out") >= 5
+
+
+# -- HTTP layer ---------------------------------------------------------
+
+def test_http_generate_json_and_sse(net, prompts):
+    with make_engine(net, "gse_http") as eng:
+        with serving.ServingServer(eng) as srv:
+            conn = http.client.HTTPConnection(srv.host, srv.port,
+                                              timeout=120)
+            body = {"prompt_ids": prompts[0].tolist(),
+                    "max_new_tokens": 5, "seed": 1}
+            conn.request("POST", "/v1/generate", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 200
+            toks = json.loads(r.read())["tokens"]
+            ref = eng.session.generate([prompts[0]], max_new_tokens=5,
+                                       seed=1)[0]
+            assert toks == ref.tolist()
+
+            body.update(stream=True, do_sample=True, temperature=0.8,
+                        seed=42)
+            conn.request("POST", "/v1/generate", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 200
+            assert "text/event-stream" in r.getheader("Content-Type")
+            events = [json.loads(ln[6:]) for ln in
+                      r.read().decode().split("\n")
+                      if ln.startswith("data: ")]
+            streamed = [e["token"] for e in events if "token" in e]
+            final = [e for e in events if e.get("done")][0]
+            ref2 = eng.session.generate(
+                [prompts[0]], max_new_tokens=5, do_sample=True,
+                temperature=0.8, seed=42)[0]
+            assert streamed == final["tokens"] == ref2.tolist()
+
+            # healthz reflects the generation engine
+            conn.request("GET", "/healthz")
+            h = json.loads(conn.getresponse().read())
+            assert h["decode_slots"] == eng.slots
+
+            # malformed payload
+            conn.request("POST", "/v1/generate", "{}",
+                         {"Content-Type": "application/json"})
+            assert conn.getresponse().status == 400
+
+
+def test_http_generate_rejection_maps_to_429(net, prompts):
+    with make_engine(net, "gse_http429", max_queue=1) as eng:
+        eng.pause()
+        parked = eng.submit(prompts[0], max_new_tokens=2)
+        with serving.ServingServer(eng) as srv:
+            conn = http.client.HTTPConnection(srv.host, srv.port,
+                                              timeout=120)
+            conn.request("POST", "/v1/generate", json.dumps(
+                {"prompt_ids": prompts[0].tolist()}),
+                {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 429
+            assert json.loads(r.read())["reason"] == "queue_full"
+        eng.resume()
+        parked.result(timeout=120)

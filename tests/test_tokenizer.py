@@ -1,0 +1,67 @@
+"""FasterTokenizer encode→decode round-trip — the contract the
+token-streaming serving path leans on: whatever the tokenizer can emit
+as clean lower-case wordpiece text must decode back to itself, so a
+stream of generated ids renders to stable text."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+VOCAB = {t: i for i, t in enumerate(
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]",
+     "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over",
+     "lazy", "dog", "token", "##izer", "stream", "##ing", "serve",
+     "##d", "a", "b", "c", "##a", "##b", "##c"])}
+
+
+@pytest.fixture()
+def tok():
+    return paddle.text.FasterTokenizer(VOCAB)
+
+
+def test_encode_decode_round_trip(tok):
+    """decode(encode(text)) == text for clean in-vocab material —
+    including wordpiece splits that must re-merge at their '##'
+    continuations."""
+    for text in ("the quick brown fox",
+                 "jumped over the lazy dog",
+                 "tokenizer streaming served",
+                 "abc ab a"):
+        ids, _ = tok(text)
+        ids = np.asarray(ids._data)[0]
+        assert tok.decode(ids) == text, text
+
+
+def test_decode_skips_framing_and_padding(tok):
+    ids, _ = tok(["the fox"], max_seq_len=8, pad_to_max_seq_len=True)
+    row = np.asarray(ids._data)[0]
+    assert row[0] == VOCAB["[CLS]"] and VOCAB["[PAD]"] in row
+    assert tok.decode(row) == "the fox"
+    # keeping specials is opt-out
+    kept = tok.decode(row, skip_special_tokens=False)
+    assert kept.startswith("[CLS]") and "[PAD]" in kept
+
+
+def test_decode_unknown_ids_map_to_unk(tok):
+    assert tok.decode([4, 9999], skip_special_tokens=False) \
+        .endswith("[UNK]")
+    # and are dropped under skip_special_tokens (stream never renders
+    # garbage for out-of-vocab ids)
+    assert tok.decode([4, 9999]) == "the"
+
+
+def test_convert_ids_to_tokens_inverse_of_vocab(tok):
+    ids = [VOCAB["stream"], VOCAB["##ing"]]
+    assert tok.convert_ids_to_tokens(ids) == ["stream", "##ing"]
+
+
+def test_round_trip_through_generated_stream(tok):
+    """The serving shape: ids arrive one at a time; incremental decode
+    of the accumulated stream converges to the full decode."""
+    text = "the quick fox jumped"
+    ids, _ = tok(text)
+    ids = [int(i) for i in np.asarray(ids._data)[0]]
+    acc = []
+    for i in ids:
+        acc.append(i)
+    assert tok.decode(acc) == text

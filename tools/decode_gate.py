@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""CI decode gate: the continuous-batching GenerationEngine under
+concurrent clients with a FIXED chaos spec must lose nothing, stream
+bit-exact sequences, and compile no more executables than the bucket
+count allows.
+
+Three phases:
+
+1. soak — 3 client threads x 4 staggered generation requests (mixed
+   prompt lengths, mixed greedy/sampled configs, per-request seeds)
+   under ``serve.request:fail@7`` (the 7th admission, globally, is
+   injected to fail): every request must either stream to completion
+   or be the single injected ChaosError; zero lost.
+2. parity — every streamed sequence (iterator tokens AND final result)
+   must be IDENTICAL to a sequential ``GenerationSession.generate``
+   reference over the same session: continuous batching, slot
+   placement, and admission timing may not change a single token.
+3. accounting — total XLA compiles (``serving.compile``) <= one decode
+   executable + one prefill executable per pow2 prompt bucket;
+   completed + injected tallies exactly match what was submitted;
+   the decode batch actually ran multi-occupancy.
+
+Wired into tools/run_all_tests.sh next to the serving gate.
+"""
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+CHAOS_SPEC = "serve.request:fail@7"
+CLIENTS, PER_CLIENT = 3, 4
+MAX_NEW = 5
+
+
+def val(name):
+    from paddle_tpu.profiler import metrics
+    m = metrics.get(name)
+    return m.value if m is not None else 0
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPT, GPTConfig
+    from paddle_tpu.serving.bucketing import seq_buckets
+    from paddle_tpu.utils import chaos
+
+    paddle.seed(0)
+    net = GPT(GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64, ffn_mult=2))
+    engine = serving.GenerationEngine(
+        net, serving.GenerationEngineConfig(
+            max_slots=4, max_length=64, max_new_tokens=MAX_NEW))
+
+    rng = np.random.RandomState(7)
+    jobs = []
+    for c in range(CLIENTS):
+        for r in range(PER_CLIENT):
+            n = int(rng.randint(3, 11))
+            jobs.append(dict(
+                prompt=rng.randint(1, 97, (n,)).astype(np.int32),
+                kw=dict(max_new_tokens=MAX_NEW,
+                        do_sample=bool((c + r) % 2),
+                        temperature=0.8, top_k=12, top_p=0.95,
+                        seed=1000 + 10 * c + r)))
+
+    # -- phase 1: chaos soak ------------------------------------------
+    paddle.set_flags({"FLAGS_chaos_spec": CHAOS_SPEC})
+    ok, injected, lost = [], [], []
+
+    def client(tid):
+        for r in range(PER_CLIENT):
+            time.sleep(0.002 * (tid + r))     # staggered arrivals
+            job = jobs[tid * PER_CLIENT + r]
+            try:
+                stream = engine.submit(job["prompt"], **job["kw"])
+            except chaos.ChaosError:
+                injected.append((tid, r))
+                continue
+            except Exception as e:            # anything else is lost
+                lost.append(repr(e))
+                continue
+            try:
+                toks = list(stream)           # the STREAMED sequence
+                final = stream.result(timeout=300)
+            except Exception as e:
+                lost.append(repr(e))
+                continue
+            if toks != final.tolist():
+                lost.append(f"stream/result mismatch ({tid},{r})")
+            else:
+                job["got"] = final
+                ok.append((tid, r))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    paddle.set_flags({"FLAGS_chaos_spec": ""})
+
+    total = CLIENTS * PER_CLIENT
+    assert not lost, f"lost/wrong requests: {lost}"
+    assert len(injected) == 1, \
+        f"expected exactly 1 injected failure, got {len(injected)}"
+    assert len(ok) == total - 1, (len(ok), total)
+    assert val("chaos.injected.serve.request") == 1
+
+    # -- phase 2: streamed == sequential reference --------------------
+    for job in jobs:
+        if "got" not in job:
+            continue
+        ref = engine.session.generate([job["prompt"]], **job["kw"])[0]
+        assert np.array_equal(job["got"], ref), \
+            (job["got"], ref, "continuous batching changed tokens")
+
+    # -- phase 3: accounting ------------------------------------------
+    bound = 1 + len(seq_buckets(64, engine.config.prompt_bucket_min))
+    compiles = val("serving.compile")
+    assert compiles <= bound, \
+        f"{compiles} compiles for {total} requests (bound {bound})"
+    assert val("serving.request.completed") == len(ok)
+    occ = None
+    from paddle_tpu.profiler import metrics as _metrics
+    occ = _metrics.get("serving.decode.occupancy")
+    assert occ is not None and occ._max >= 2, \
+        "decode batch never ran multi-occupancy — not continuous"
+    engine.close()
+    print(f"decode gate OK: {len(ok)}/{total} streamed bit-exact, "
+          f"1 injected chaos failure, {compiles} compiles "
+          f"(bound {bound}), peak occupancy {occ._max:.0f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -35,4 +35,11 @@ python tools/pipeline_gate.py
 # queue_full shed count at the admission bound, and total XLA compiles
 # bounded by the shape-bucket count.
 python tools/serving_gate.py
+# Decode gate: the continuous-batching GenerationEngine under
+# concurrent staggered clients with a fixed serve.request chaos spec —
+# zero lost requests, every streamed sequence bit-identical to the
+# sequential generate() reference, exactly one injected failure, and
+# total XLA compiles bounded by the prompt-bucket count (+1 decode
+# executable) — the per-token-retrace failure mode stays pinned shut.
+python tools/decode_gate.py
 exec python -m pytest tests/ -q --runslow "$@"
