@@ -34,6 +34,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["add_sharding_axis", "shard_tree", "zero_state_shardings"]
 
 
+def _supported_memory_kind(mesh: Mesh, kind: Optional[str]
+                           ) -> Optional[str]:
+    """``kind`` if the mesh's devices can address it, else None.  TPU
+    devices expose ``pinned_host`` for offload; the CPU backend only
+    has ``unpinned_host`` (it IS host memory), where offload is a
+    placement no-op rather than an error."""
+    if not kind:
+        return None
+    try:
+        dev = next(iter(mesh.devices.flat))
+        if any(m.kind == kind for m in dev.addressable_memories()):
+            return kind
+    except Exception:       # noqa: BLE001 — older jax: trust the caller
+        return kind
+    return None
+
+
 def add_sharding_axis(ns: NamedSharding, shape, axis: str = "sharding",
                       memory_kind: Optional[str] = None) -> NamedSharding:
     """Extend a param's NamedSharding with ``axis`` on the first
@@ -41,6 +58,7 @@ def add_sharding_axis(ns: NamedSharding, shape, axis: str = "sharding",
     (the reference shards flattened params by rank; here we keep array
     structure and pick a dimension)."""
     mesh = ns.mesh
+    memory_kind = _supported_memory_kind(mesh, memory_kind)
     n = mesh.shape.get(axis, 1)
     spec = list(ns.spec) + [None] * (len(shape) - len(ns.spec))
     if any(axis == p or (isinstance(p, tuple) and axis in p)

@@ -213,7 +213,12 @@ class GenerationSession:
                     avals = jax.tree_util.tree_map(
                         lambda a: jax.ShapeDtypeStruct(
                             jnp.shape(a), jnp.asarray(a).dtype), args)
-                    return jax.jit(step).lower(*avals).compile()
+                    # AOT artifact store: a relaunched engine loads the
+                    # serialized executable instead of paying the XLA
+                    # compile (keyed on the lowered module's content)
+                    from ..utils.artifact_store import aot_compile
+                    return aot_compile(jax.jit(step).lower(*avals),
+                                       label=f"{self.name}.{kind}")
                 finally:
                     net.load_functional_state(params0, buffers0)
                     if was_training:

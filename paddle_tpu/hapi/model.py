@@ -298,10 +298,7 @@ class Model:
             # job in the reference) during compilation.
             arrays = self.network.shard_inputs(arrays)
         sig = ("train", tuple((a.shape, str(a.dtype)) for a in arrays))
-        if sig not in self._jit_cache:
-            self._jit_cache[sig] = self._build_jit_train_step(
-                len(inputs), len(labels))
-        step = self._jit_cache[sig]
+        step = self._jit_cache.get(sig)
         net, opt = self.network, self._optimizer
         params, buffers = net.functional_state()
         if not hasattr(opt, "_fn_state") or opt._fn_state is None:
@@ -319,6 +316,28 @@ class Model:
         else:
             split_chain = False
         lr = self._lr_device()
+        if step is None:
+            step = self._build_jit_train_step(len(inputs), len(labels))
+            from ..utils import artifact_store as _aot
+            if _aot.active() is not None and \
+                    not hasattr(self.network, "shard_inputs"):
+                # single-device only: AOT executables are sharding-
+                # strict, and the DP wrapper's param shardings evolve
+                # between the first and later steps
+                # AOT path through the artifact store: a relaunched
+                # trainer (PR 3 supervisor) deserializes the persisted
+                # executable instead of paying the XLA compile.  Any
+                # lowering/serialization hiccup falls back to the plain
+                # jit step — identical numerics either way.
+                try:
+                    step = _aot.aot_compile(
+                        step.lower(params, buffers, opt._fn_state,
+                                   key_base, rng_ctr, *([lr] + arrays)),
+                        label="hapi.train_step")
+                except Exception:   # noqa: BLE001 — jit fallback
+                    step = self._build_jit_train_step(len(inputs),
+                                                      len(labels))
+            self._jit_cache[sig] = step
         # step-phase attribution: the dispatch call is where device
         # backpressure surfaces in a sync-free loop (XLA bounds the
         # in-flight queue), so its duration is the per-step "device"

@@ -34,6 +34,23 @@ __all__ = ["init_gpt_params", "gpt_param_shardings",
            "build_spmd_train_step"]
 
 
+def _barrier_with_grad():
+    """``lax.optimization_barrier`` if this jax can differentiate
+    through it, else identity.  The barrier is a pure perf hint
+    (materialize per-layer weight slices so XLA doesn't pick the
+    half-rate batch-in-sublanes emitter — see trunk()); on jax builds
+    without its autodiff rule the train step must still build."""
+    try:
+        jax.eval_shape(jax.grad(lambda x: lax.optimization_barrier(x)),
+                       jax.ShapeDtypeStruct((), jnp.float32))
+        return lax.optimization_barrier
+    except Exception:       # noqa: BLE001 — NotImplementedError et al.
+        return lambda x: x
+
+
+_opt_barrier = _barrier_with_grad()
+
+
 def _glorot(key, shape):
     fan_in, fan_out = shape[-2], shape[-1]
     std = np.sqrt(2.0 / (fan_in + fan_out))
@@ -228,7 +245,7 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
             # batch-in-sublanes emitter (profiled r5: the down-proj+LN
             # fusion ran 3.43 ms vs 1.81 with materialized weights —
             # the copies themselves are ~0.1 ms/layer)
-            p_i = lax.optimization_barrier(p_i)
+            p_i = _opt_barrier(p_i)
             x = maybe_remat(block_fn)(p_i, x)
         return _layernorm(x, params["ln_f_g"], params["ln_f_b"])
 

@@ -61,6 +61,12 @@ class EngineConfig:
                       from fragmenting the executable population)
     validate_artifact run the static-analysis verify pass over the
                       artifact's embedded program desc at load (PR 2)
+    warmup            pre-populate every batch-bucket executable at
+                      construction (from the AOT artifact store when
+                      FLAGS_compile_cache_dir is armed — then a fresh
+                      engine costs deserialization, not compiles), so
+                      first-request latency equals steady state;
+                      warmed-bucket count lands in /healthz
     name              metrics prefix (default "serving"); give each
                       engine a distinct name when one process serves
                       several models, or their counters/gauges mix
@@ -74,6 +80,7 @@ class EngineConfig:
                  pad_dynamic_dims: bool = False,
                  min_batch_bucket: int = 1,
                  validate_artifact: bool = True,
+                 warmup: bool = False,
                  name: str = "serving"):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
@@ -90,6 +97,7 @@ class EngineConfig:
         self.pad_dynamic_dims = bool(pad_dynamic_dims)
         self.min_batch_bucket = int(min_batch_bucket)
         self.validate_artifact = bool(validate_artifact)
+        self.warmup = bool(warmup)
         self.name = str(name)
 
 
@@ -236,6 +244,10 @@ class InferenceEngine:
         _metrics.gauge(f"{prefix}.workers", "predictor clones in the "
                        "pool").set(self.config.num_workers)
 
+        self.warmed_buckets = 0
+        if self.config.warmup:
+            self._warmup()
+
         self._pending: deque = deque()
         self._cond = threading.Condition()
         # serializes metric updates issued from concurrent workers: the
@@ -260,6 +272,67 @@ class InferenceEngine:
                                  name=f"serving-worker-{i}", daemon=True)
             t.start()
             self._workers.append(t)
+
+    # -- warmup --------------------------------------------------------
+    def _warmup(self):
+        """Compile (or, with the AOT artifact store armed, deserialize)
+        every batch-bucket executable before the first request, so
+        first-request latency equals steady state.  Skipped per input
+        with dynamic non-batch dims (the bucket set is unbounded there
+        unless pad_dynamic_dims bounds it — and then only the batch
+        buckets are enumerable anyway)."""
+        avals = self._base._meta.get("input_avals") or []
+        if len(avals) != len(self.input_names):
+            import warnings
+            warnings.warn(
+                "EngineConfig.warmup: artifact metadata has no usable "
+                "input_avals (legacy/storage-reduced artifact?) — "
+                "warmup skipped; buckets will compile on first use",
+                UserWarning, stacklevel=3)
+            return
+        shapes = []
+        for shape, dt in avals:
+            tail = [int(d) if d is not None else -1 for d in shape[1:]]
+            if any(d < 0 for d in tail):
+                import warnings
+                warnings.warn(
+                    f"EngineConfig.warmup: input has dynamic non-batch "
+                    f"dims {list(shape)}; bucket set is unbounded — "
+                    "warmup skipped for this engine", UserWarning,
+                    stacklevel=3)
+                return
+            shapes.append((tail, str(dt)))
+        # derive the bucket set from the SAME policy the batcher uses —
+        # a second copy of the bucketing rule would silently warm the
+        # wrong keys if the rule ever changed
+        buckets, rows = [], 1
+        while True:
+            b = self._policy.batch_bucket(rows)
+            buckets.append(b)
+            if b >= self.config.max_batch_size:
+                break
+            rows = b + 1
+        errors = []
+        for rows in buckets:
+            padded = [np.zeros((rows,) + tuple(tail), dt)
+                      for tail, dt in shapes]
+            try:
+                self._run_bucketed(self._base, padded)
+            except Exception as e:  # noqa: BLE001 — best-effort, but loud
+                errors.append((rows, e))
+        if errors:
+            import warnings
+            warnings.warn(
+                f"engine warmup failed for {len(errors)} bucket(s) "
+                f"(first: rows={errors[0][0]}: {errors[0][1]!r}); "
+                "those buckets will compile on first use",
+                RuntimeWarning, stacklevel=3)
+        self.warmed_buckets = len(self._cache)
+        from ..profiler import metrics as _metrics
+        _metrics.gauge(
+            f"{self.metrics_prefix}.warmed_buckets",
+            "bucket executables pre-populated at engine construction"
+        ).set(self.warmed_buckets)
 
     # -- client surface ------------------------------------------------
     def submit(self, inputs, deadline_ms: Optional[float] = "default"
@@ -559,7 +632,14 @@ class InferenceEngine:
                 lead_avals = [jax.tree_util.tree_map(
                     lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
                     for t in leading]
-                return jit_fn.lower(*lead_avals, *avals).compile()
+                # AOT artifact store: an engine relaunch loads the
+                # persisted executable instead of re-compiling the
+                # bucket (utils/artifact_store.py; armed with
+                # FLAGS_compile_cache_dir)
+                from ..utils.artifact_store import aot_compile
+                return aot_compile(
+                    jit_fn.lower(*lead_avals, *avals),
+                    label=f"{self.config.name}.bucket")
             except Exception:
                 # AOT lowering unsupported for this export: fall back to
                 # the shared jit wrapper (its shape-keyed cache makes the
@@ -595,6 +675,12 @@ class GenerationEngineConfig:
                          queued, like the batch engine); None = none
     prompt_bucket_min    smallest prompt-length bucket (prefill
                          executables are one-per-bucket)
+    warmup               pre-populate the decode executable and every
+                         prompt-bucket prefill executable at
+                         construction (from the AOT artifact store when
+                         FLAGS_compile_cache_dir is armed), so
+                         time-to-first-token equals steady state from
+                         request one; warmed count lands in /healthz
     name                 metrics prefix (default "serving" — gives the
                          ``serving.prefill`` / ``serving.decode`` /
                          ``serving.compile`` names the gates assert on)
@@ -607,6 +693,7 @@ class GenerationEngineConfig:
                  max_tokens_in_flight: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  prompt_bucket_min: int = 8,
+                 warmup: bool = False,
                  name: str = "serving"):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
@@ -620,6 +707,7 @@ class GenerationEngineConfig:
         self.max_tokens_in_flight = max_tokens_in_flight
         self.deadline_ms = deadline_ms
         self.prompt_bucket_min = int(prompt_bucket_min)
+        self.warmup = bool(warmup)
         self.name = str(name)
 
 
@@ -774,6 +862,13 @@ class GenerationEngine:
             "disconnects for answers)")
         _metrics.gauge(f"{p}.slots", "decode slots").set(S)
 
+        # warmup BEFORE the slot bank exists: the warmup cache is a
+        # local that frees on return, so peak device memory stays at
+        # one KV cache either way
+        self.warmed_buckets = 0
+        if cfg.warmup:
+            self._warmup()
+
         # slot bank (host-side control state; caches live on device)
         self._caches = self.session.init_caches()
         self._slot_req: List[Optional[_GenRequest]] = [None] * S
@@ -793,6 +888,54 @@ class GenerationEngine:
         self._scheduler = threading.Thread(
             target=self._loop, name="generation-scheduler", daemon=True)
         self._scheduler.start()
+
+    def _warmup(self):
+        """One masked-out prefill per prompt bucket plus one decode
+        step over throwaway caches: populates the session's executable
+        cache (through the AOT artifact store when armed) without
+        touching any real slot state.  All-False update masks keep the
+        warmup mathematically inert; ``live_rows=0`` keeps it out of
+        the token metrics."""
+        from .bucketing import seq_buckets
+        S = self.slots
+        keys = np.zeros((S, 2), np.uint32)
+        temps = np.zeros((S,), np.float32)
+        tks = np.zeros((S,), np.int32)
+        tps = np.ones((S,), np.float32)
+        errors = []
+        caches = self.session.init_caches()
+        for pb in seq_buckets(self.max_length,
+                              self.config.prompt_bucket_min):
+            try:
+                _tok, caches = self.session.prefill(
+                    caches, np.zeros((S, pb), np.int32),
+                    np.ones((S,), np.int32), np.zeros((S,), bool),
+                    keys, temps, tks, tps)
+            except Exception as e:  # noqa: BLE001 — best-effort, but loud
+                errors.append((f"prefill:{pb}", e))
+        try:
+            self.session.decode(
+                caches, np.zeros((S,), np.int32),
+                np.zeros((S,), np.int32), keys, temps, tks, tps,
+                live_rows=0)
+        except Exception as e:      # noqa: BLE001
+            errors.append(("decode", e))
+        if errors:
+            import warnings
+            warnings.warn(
+                f"GenerationEngine warmup failed for {len(errors)} "
+                f"step(s) (first: {errors[0][0]}: {errors[0][1]!r}); "
+                "those buckets will compile on first use",
+                RuntimeWarning, stacklevel=3)
+        self.warmed_buckets = len(self.session._cache)
+        from ..profiler import metrics as _metrics
+        # decode_-prefixed: a dual-engine server with both configs at
+        # the default name='serving' must not have the batch engine's
+        # warmed_buckets gauge overwritten (mirrors the /healthz key)
+        _metrics.gauge(
+            f"{self.metrics_prefix}.decode_warmed_buckets",
+            "prefill/decode executables pre-populated at engine "
+            "construction").set(self.warmed_buckets)
 
     # -- client surface ------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,

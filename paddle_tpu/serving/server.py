@@ -86,11 +86,17 @@ class _Handler(BaseHTTPRequestHandler):
                 e = self.server.engine
                 body.update(model_inputs=e.input_names,
                             workers=e.config.num_workers,
-                            max_batch_size=e.config.max_batch_size)
+                            max_batch_size=e.config.max_batch_size,
+                            warmed_buckets=getattr(e, "warmed_buckets",
+                                                   0))
             g = self.server.generation_engine
             if g is not None:
+                # distinct key: with both engines bound, the decode
+                # warmup count must not clobber the batch engine's
                 body.update(decode_slots=g.slots,
-                            max_length=g.max_length)
+                            max_length=g.max_length,
+                            decode_warmed_buckets=getattr(
+                                g, "warmed_buckets", 0))
             self._send_json(200, body)
         elif self.path == "/metrics":
             from ..profiler import metrics as _metrics

@@ -9,6 +9,9 @@ Built-in passes (all registered in ``PassRegistry``):
 - ``shape_inference``      re-propagate avals with real feed shapes
 - ``liveness_report``      report ops that feed neither fetch nor state
 - ``dead_op_eliminate``    strip those ops (transform pass)
+- ``constant_fold``        evaluate const-only subgraphs at pass time
+- ``cse``                  merge identical pure ops (transform pass)
+- ``fusion_group``         collapse elementwise chains into one region
 - ``spmd_collective_lint`` Megatron placement / collective ordering
 
 Entry points: ``run_passes(program, names, ctx)`` for composition,
@@ -27,6 +30,8 @@ from .verifier import VerifyPass
 from .shape_inference import ShapeInferencePass
 from .liveness import (LivenessReportPass, DeadOpEliminationPass,
                        find_dead_ops)
+from .optimize import (ConstantFoldPass, CsePass, FusionGroupPass,
+                       OPT_PASS_PIPELINE, ELEMENTWISE_OPS)
 from .spmd_lint import (SpmdCollectiveLintPass, lint_hlo_collectives,
                         lint_spmd_train_step, HloCollective)
 
@@ -34,7 +39,9 @@ __all__ = ["Diagnostic", "Pass", "PassContext", "PassRegistry",
            "PassResult", "ProgramVerificationError", "register_pass",
            "get_pass", "run_passes", "DefUseGraph", "VerifyPass",
            "ShapeInferencePass", "LivenessReportPass",
-           "DeadOpEliminationPass", "SpmdCollectiveLintPass",
+           "DeadOpEliminationPass", "ConstantFoldPass", "CsePass",
+           "FusionGroupPass", "OPT_PASS_PIPELINE", "ELEMENTWISE_OPS",
+           "SpmdCollectiveLintPass",
            "find_dead_ops", "lint_hlo_collectives",
            "lint_spmd_train_step", "HloCollective", "analyze",
            "AnalysisReport", "ERROR", "WARNING", "INFO"]

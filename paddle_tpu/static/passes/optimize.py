@@ -1,0 +1,416 @@
+"""Optimizing transform passes: constant folding, CSE, fusion grouping.
+
+The PR 2 pass framework verifies and DCEs but never makes anything
+faster.  These three passes shrink the op list a ``CompiledProgram``
+hands to the Executor's jitted replay — less Python work per trace,
+fewer vjp closures, smaller HLO to compile — while staying **bit-exact**
+by construction:
+
+- ``constant_fold`` evaluates ops whose every input is a program
+  constant *at pass time* with the exact same jax impl the runner would
+  have called, and bakes the results in as new constants.  Elementwise
+  and matmul/reduction ops execute as single standalone XLA ops either
+  way (fusion never changes an individual op's rounding), so the folded
+  value is the value the unoptimized program computes.
+- ``cse`` merges ops that are provably the same computation: same type,
+  same (canonicalized) inputs, same static attrs, same underlying impl
+  function.  Downstream readers are renamed onto the surviving output.
+- ``fusion_group`` collapses contiguous connected chains of elementwise
+  ops into one composite op whose impl replays the members in order —
+  one dispatched region instead of N, with escaped intermediate names
+  preserved as fused outputs.
+
+All three refuse anything that could change semantics: ops a grad op
+replays (the vjp closure is captured per forward op idx), ops writing
+parameters/state, shape-probed ops (their impls execute with side
+effects), rng-consuming op types, and fetched outputs (cse/fold keep
+the fetch name reachable).  Eliminated/folded/fused counts land in the
+PR 1 metrics registry (``static.pass.const_folded`` /
+``static.pass.cse_merged`` / ``static.pass.ops_fused`` /
+``static.pass.fusion_groups``).
+
+Run from ``CompiledProgram`` behind ``FLAGS_program_opt`` (default on,
+per-pass opt-out via ``FLAGS_program_opt_skip``), version-keyed cached
+exactly like DCE.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..program import OpDesc, Program
+from .pass_base import Pass, PassContext, PassResult, register_pass
+
+__all__ = ["ConstantFoldPass", "CsePass", "FusionGroupPass",
+           "OPT_PASS_PIPELINE", "ELEMENTWISE_OPS"]
+
+# default transform pipeline CompiledProgram runs under FLAGS_program_opt
+# (after dead_op_eliminate; order matters: folding exposes CSE
+# opportunities, CSE shortens chains before they are fused)
+OPT_PASS_PIPELINE = ("constant_fold", "cse", "fusion_group")
+
+# op types whose impls consume rng / host state: never fold, merge, or
+# re-execute them at pass time
+_STATEFUL_OPS = frozenset({
+    "dropout", "alpha_dropout", "gumbel_softmax", "uniform", "gaussian",
+    "rand", "randn", "randint", "randperm", "bernoulli", "multinomial",
+    "exponential", "poisson", "shuffle", "while", "cond", "print",
+})
+
+# fusable op types: elementwise math plus pure shape/epilogue ops.  The
+# fused impl replays each member's exact impl in program order, so
+# membership only requires purity (no rng, no state, no host effects) —
+# each member still lowers to the same HLO instruction(s) it would have
+# alone, which is what keeps fusion bit-exact
+ELEMENTWISE_OPS = frozenset({
+    # elementwise math / activations
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "pow", "maximum", "minimum", "scale", "neg", "abs", "square",
+    "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sin", "cos", "tan", "erf", "floor", "ceil", "round", "sign",
+    "clip", "cast", "relu", "relu6", "leaky_relu", "elu", "celu",
+    "selu", "gelu", "sigmoid", "tanh", "softplus", "softsign",
+    "hardtanh", "hardsigmoid", "hardswish", "silu", "swish", "mish",
+    "hardshrink", "softshrink", "tanhshrink", "logsigmoid", "assign",
+    "fill_constant",
+    # pure shape/epilogue ops (attention head plumbing, serving heads)
+    "reshape", "squeeze", "unsqueeze", "flatten", "transpose", "split",
+    "softmax", "log_softmax",
+})
+
+# don't bake constants bigger than this into the Program (they live on
+# host for the program's lifetime); folding is a size/time trade
+_FOLD_MAX_BYTES = 16 << 20
+
+
+def _clone_skeleton(program: Program) -> Program:
+    """Empty Program sharing mutable containers with the source, the
+    way liveness._strip does — parameter/state writes must keep hitting
+    the same live objects."""
+    p = Program()
+    p._placeholders = dict(program._placeholders)
+    p.parameters = program.parameters          # shared: same live objects
+    p.constants = dict(program.constants)
+    p.state_vars = program.state_vars
+    p._vars = dict(program._vars)
+    p._lr_provider = program._lr_provider
+    p._build_fn = program._build_fn
+    p.param_specs = dict(program.param_specs)
+    p.random_seed = program.random_seed
+    return p
+
+
+def _rebuild(program: Program, drop: Set[int],
+             rename: Optional[Dict[str, str]] = None,
+             replace: Optional[Dict[int, OpDesc]] = None) -> Program:
+    """New Program without ``drop`` ops, with input names remapped via
+    ``rename`` and ops substituted via ``replace`` (keyed by original
+    idx); grad ``fwd_idx`` links remapped like liveness._strip."""
+    rename = rename or {}
+    replace = replace or {}
+    p = _clone_skeleton(program)
+    remap: Dict[int, int] = {}
+    for op in program.ops:
+        if op.idx in drop:
+            continue
+        src = replace.get(op.idx, op)
+        clone = OpDesc(src.type, src.kind, src.impl,
+                       [rename.get(n, n) for n in src.input_names],
+                       src.output_names, src.attrs, src.fwd_idx,
+                       src.grad_input_mask, src.eval_impl)
+        p._append(clone)
+        remap[op.idx] = clone.idx
+    for op in p.ops:
+        if op.fwd_idx is not None:
+            op.fwd_idx = remap.get(op.fwd_idx)
+    return p
+
+
+def _vjp_pinned(program: Program) -> Set[int]:
+    """Forward op idxs some grad op replays: their vjp closures are
+    captured per op, so these ops must survive any transform."""
+    return {op.fwd_idx for op in program.ops
+            if op.kind == "grad" and op.fwd_idx is not None}
+
+
+def _multi_def(program: Program) -> Set[str]:
+    """Names written by more than one op (WAW programs are verifier
+    territory; transforms must not reorder them)."""
+    seen: Set[str] = set()
+    multi: Set[str] = set()
+    for op in program.ops:
+        for n in op.output_names:
+            (multi if n in seen else seen).add(n)
+    return multi
+
+
+def _impl_key(op: OpDesc):
+    """Identity of the computation behind ``op.impl``, or None when it
+    can't be established.  capture_op closes kwargs with
+    functools.partial; only kwargs of static types land in ``attrs``,
+    so a partial carrying keys absent from attrs holds non-static
+    payload (arrays) we can't compare cheaply — skip those."""
+    impl = op.impl
+    if isinstance(impl, functools.partial):
+        if set(impl.keywords) - set(op.attrs):
+            return None
+        if impl.args:
+            return None
+        return impl.func
+    return impl
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def _attr_key(attrs: dict):
+    try:
+        return tuple(sorted((k, _freeze(v)) for k, v in attrs.items()))
+    except TypeError:
+        return None
+
+
+@register_pass("constant_fold")
+class ConstantFoldPass(Pass):
+    """Evaluate const-only subgraphs at pass time (bit-exact)."""
+
+    is_transform = True
+
+    def run(self, program, context: PassContext, result: PassResult):
+        import jax.numpy as jnp
+        pinned = _vjp_pinned(program)
+        multi = _multi_def(program)
+        mutable = set(program.parameters) | set(program.state_vars)
+        const_vals = dict(program.constants)
+        new_consts: Dict[str, object] = {}
+        folded: List[int] = []
+        for op in program.ops:
+            if op.kind != "compute" or op.idx in pinned:
+                continue
+            if op.type in _STATEFUL_OPS or \
+                    op.attrs.get("__shape_probed__"):
+                continue
+            if not op.input_names:
+                continue     # source-less ops may be implicit rng/state
+            if any(n in mutable or n in multi for n in op.output_names):
+                continue
+            if not all(n in const_vals for n in op.input_names):
+                continue
+            try:
+                outs = op.impl(*[const_vals[n] for n in op.input_names])
+            except Exception as e:      # noqa: BLE001 — leave it unfolded
+                result.warning(
+                    "const-fold-eval",
+                    f"constant inputs but impl raised at fold time: {e!r}",
+                    op_idx=op.idx, op_type=op.type)
+                continue
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            if len(outs) != len(op.output_names):
+                continue
+            arrays = [jnp.asarray(o) for o in outs]
+            if sum(a.size * a.dtype.itemsize for a in arrays) \
+                    > _FOLD_MAX_BYTES:
+                continue
+            for n, a in zip(op.output_names, arrays):
+                const_vals[n] = a
+                new_consts[n] = a
+            folded.append(op.idx)
+        if not folded:
+            result.program = program
+            return
+        p = _rebuild(program, set(folded))
+        p.constants.update(new_consts)
+        result.program = p
+        from ...profiler import metrics as _metrics
+        _metrics.counter(
+            "static.pass.const_folded",
+            "ops evaluated at pass time by constant_fold (their outputs "
+            "became program constants)").inc(len(folded))
+        result.info(
+            "const-fold-summary",
+            f"folded {len(folded)} const-only op(s) of "
+            f"{len(program.ops)} "
+            f"({[program.ops[i].type for i in folded]})")
+
+
+@register_pass("cse")
+class CsePass(Pass):
+    """Merge identical pure ops over the def/use structure."""
+
+    is_transform = True
+
+    def run(self, program, context: PassContext, result: PassResult):
+        pinned = _vjp_pinned(program)
+        multi = _multi_def(program)
+        mutable = set(program.parameters) | set(program.state_vars)
+        fetches = set(context.fetch_names)
+        seen: Dict[tuple, OpDesc] = {}
+        rename: Dict[str, str] = {}
+        removed: List[int] = []
+        for op in program.ops:
+            if op.kind != "compute" or op.idx in pinned:
+                continue
+            if op.type in _STATEFUL_OPS or \
+                    op.attrs.get("__shape_probed__"):
+                continue
+            if any(n in mutable or n in multi or n in fetches
+                   for n in op.output_names):
+                continue
+            impl_key = _impl_key(op)
+            attr_key = _attr_key(op.attrs)
+            if impl_key is None or attr_key is None:
+                continue
+            key = (op.type, impl_key, attr_key,
+                   tuple(rename.get(n, n) for n in op.input_names))
+            prev = seen.get(key)
+            if prev is not None and \
+                    len(prev.output_names) == len(op.output_names):
+                for old, new in zip(op.output_names, prev.output_names):
+                    rename[old] = new
+                removed.append(op.idx)
+                continue
+            seen[key] = op
+        if not removed:
+            result.program = program
+            return
+        result.program = _rebuild(program, set(removed), rename=rename)
+        from ...profiler import metrics as _metrics
+        _metrics.counter(
+            "static.pass.cse_merged",
+            "duplicate ops merged by common-subexpression "
+            "elimination").inc(len(removed))
+        result.info(
+            "cse-summary",
+            f"merged {len(removed)} duplicate op(s) of "
+            f"{len(program.ops)} "
+            f"({[program.ops[i].type for i in removed]})")
+
+
+def _make_fused_impl(members: Tuple[Tuple[object, Tuple[str, ...],
+                                          Tuple[str, ...]], ...],
+                     ext_in: Tuple[str, ...],
+                     out_names: Tuple[str, ...]):
+    """Composite impl replaying ``members`` in order over a local env.
+    Same impls, same order, same single-op HLO each — bit-exact with
+    the unfused replay."""
+    def fused(*args):
+        env = dict(zip(ext_in, args))
+        for impl, ins, outs in members:
+            r = impl(*[env[n] for n in ins])
+            r = r if isinstance(r, tuple) else (r,)
+            for n, v in zip(outs, r):
+                env[n] = v
+        res = tuple(env[n] for n in out_names)
+        return res if len(res) > 1 else res[0]
+    return fused
+
+
+@register_pass("fusion_group")
+class FusionGroupPass(Pass):
+    """Tag contiguous connected elementwise chains as one fused op."""
+
+    is_transform = True
+
+    def run(self, program, context: PassContext, result: PassResult):
+        pinned = _vjp_pinned(program)
+        multi = _multi_def(program)
+        mutable = set(program.parameters) | set(program.state_vars)
+
+        def eligible(op: OpDesc) -> bool:
+            return (op.kind == "compute" and op.idx not in pinned
+                    and op.type in ELEMENTWISE_OPS
+                    and not op.attrs.get("__shape_probed__")
+                    and op.eval_impl is None
+                    and bool(op.input_names)
+                    and not any(n in mutable or n in multi
+                                for n in op.output_names))
+
+        # maximal contiguous runs (ops are program-ordered), split into
+        # *connected* chains: each member after the first consumes
+        # something a prior member made
+        chains: List[List[OpDesc]] = []
+        chain: List[OpDesc] = []
+        defined: Set[str] = set()
+
+        def close():
+            nonlocal chain, defined
+            if len(chain) >= 2:
+                chains.append(chain)
+            chain, defined = [], set()
+
+        for op in program.ops:
+            if not eligible(op):
+                close()
+                continue
+            if chain and not any(n in defined for n in op.input_names):
+                close()
+            chain.append(op)
+            defined.update(op.output_names)
+        close()
+
+        if not chains:
+            result.program = program
+            return
+
+        # which names escape each chain (consumed outside it or fetched)
+        consumers: Dict[str, List[int]] = {}
+        for op in program.ops:
+            for n in op.input_names:
+                consumers.setdefault(n, []).append(op.idx)
+        fetches = set(context.fetch_names)
+
+        drop: Set[int] = set()
+        replace: Dict[int, OpDesc] = {}
+        total = 0
+        for chain in chains:
+            idxs = {op.idx for op in chain}
+            produced: Set[str] = set()
+            ext_in: List[str] = []
+            out_names: List[str] = []
+            for op in chain:
+                for n in op.input_names:
+                    if n not in produced and n not in ext_in:
+                        ext_in.append(n)
+                produced.update(op.output_names)
+            for op in chain:
+                for n in op.output_names:
+                    escapes = n in fetches or any(
+                        c not in idxs for c in consumers.get(n, ()))
+                    if escapes and n not in out_names:
+                        out_names.append(n)
+            if not out_names:      # fully dead chain: DCE's job, not ours
+                continue
+            members = tuple((op.impl, tuple(op.input_names),
+                             tuple(op.output_names)) for op in chain)
+            fused = OpDesc(
+                "fused_" + "_".join(op.type for op in chain),
+                "compute",
+                _make_fused_impl(members, tuple(ext_in),
+                                 tuple(out_names)),
+                ext_in, out_names,
+                {"__fused__": True,
+                 "__fused_ops__": [op.type for op in chain]})
+            replace[chain[0].idx] = fused
+            drop.update(idxs - {chain[0].idx})
+            total += len(chain)
+        if not replace:
+            result.program = program
+            return
+        result.program = _rebuild(program, drop, replace=replace)
+        from ...profiler import metrics as _metrics
+        _metrics.counter(
+            "static.pass.fusion_groups",
+            "elementwise chains collapsed into composite ops").inc(
+            len(replace))
+        _metrics.counter(
+            "static.pass.ops_fused",
+            "member ops absorbed into fusion groups").inc(total)
+        result.info(
+            "fusion-summary",
+            f"fused {total} op(s) into {len(replace)} group(s): "
+            f"{[op.attrs['__fused_ops__'] for op in replace.values()]}")

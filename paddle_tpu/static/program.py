@@ -696,37 +696,56 @@ class CompiledProgram:
         return self
 
     def _optimized_program(self, fetch_names: Tuple[str, ...]):
-        """Dead-op-eliminated view of the program for these fetches
+        """Pass-optimized view of the program for these fetches
         (reference: build_strategy-driven ir passes in compiler.py).
-        Gated by FLAGS_program_dce; bit-exact by construction — only ops
-        reaching neither a fetch nor a parameter/state write are cut."""
+        FLAGS_program_dce gates dead-op elimination; FLAGS_program_opt
+        additionally runs the optimizing pipeline (constant_fold, cse,
+        fusion_group — FLAGS_program_opt_skip opts out per pass).  All
+        bit-exact by construction; memoized on (program version, fetch
+        signature, active pass list) like DCE alone was."""
         from ..utils import flags as _flags
-        if not _flags.get_flag("FLAGS_program_dce"):
+        names = []
+        if _flags.get_flag("FLAGS_program_dce"):
+            names.append("dead_op_eliminate")
+        if _flags.get_flag("FLAGS_program_opt"):
+            from .passes import OPT_PASS_PIPELINE
+            skip = {s.strip() for s in str(_flags.get_flag(
+                "FLAGS_program_opt_skip")).split(",") if s.strip()}
+            names.extend(n for n in OPT_PASS_PIPELINE if n not in skip)
+        if not names:
             return self.program
-        return _dce_cached(self.program, fetch_names, self._dce_cache)
+        return _passes_cached(self.program, fetch_names, tuple(names),
+                              self._dce_cache)
 
     def __getattr__(self, item):
         return getattr(self.program, item)
 
 
-def _dce_cached(program: Program, fetch_names: Tuple[str, ...],
-                cache: Dict) -> Program:
-    """Dead-op-eliminated program for these fetches, memoized on
-    (program version, fetch signature).  Entries for stale versions can
-    never hit again (the version only moves forward), so they are
-    evicted on miss — the cache holds only the live version's fetch
+def _passes_cached(program: Program, fetch_names: Tuple[str, ...],
+                   pass_names: Tuple[str, ...], cache: Dict) -> Program:
+    """Transform-pass pipeline output for these fetches, memoized on
+    (program version, fetch signature, pass list).  Entries for stale
+    versions can never hit again (the version only moves forward), so
+    they are evicted on miss — the cache holds only the live version's
     signatures instead of growing per mutation+run cycle."""
-    key = (program._version, fetch_names)
+    key = (program._version, fetch_names, pass_names)
     prog = cache.get(key)
     if prog is None:
         for stale in [k for k in cache if k[0] != program._version]:
             del cache[stale]
         from . import passes as _passes
-        res = _passes.DeadOpEliminationPass().apply(
-            program, _passes.PassContext(fetch_names=fetch_names))
-        prog = res.program if res.program is not None else program
+        prog, _ = _passes.run_passes(
+            program, pass_names,
+            _passes.PassContext(fetch_names=fetch_names))
         cache[key] = prog
     return prog
+
+
+def _dce_cached(program: Program, fetch_names: Tuple[str, ...],
+                cache: Dict) -> Program:
+    """Dead-op elimination alone (the plain-Executor use_prune path)."""
+    return _passes_cached(program, fetch_names, ("dead_op_eliminate",),
+                          cache)
 
 
 def _build_runner(program: Program, fetch_names: Tuple[str, ...],
@@ -913,8 +932,6 @@ class Executor:
                           f" (cache size {len(self._cache)})",
                           file=_sys.stderr)
             fn = _build_runner(program, fetch_names, written)
-            if use_program_cache:
-                self._cache[key] = fn
 
         # scope isolation (reference framework/scope.h:62 + executor.py
         # scope arg): with an explicit scope, parameter/state values are
@@ -987,6 +1004,26 @@ class Executor:
         lr = jnp.asarray(
             program._lr_provider() if program._lr_provider else 0.0,
             jnp.float32)
+        if use_program_cache and key not in self._cache \
+                and dp_mesh is None:
+            # AOT artifact store (utils/artifact_store.py): a relaunch
+            # running the same program/feed signature deserializes the
+            # persisted executable instead of paying the XLA compile.
+            # Single-device only — AOT executables are sharding-strict,
+            # and the dp path's input shardings evolve across steps.
+            # Cached runs only: with use_program_cache=False every call
+            # rebuilds fn, and re-lowering + hashing + deserializing
+            # per call would cost more than the jit path it replaces.
+            from ..utils import artifact_store as _aot
+            if _aot.active() is not None:
+                try:
+                    fn = _aot.aot_compile(
+                        fn.lower(feed_arrays, mutables, lr),
+                        label="static.executor")
+                except Exception:   # noqa: BLE001 — keep the jit fn
+                    pass
+        if use_program_cache:
+            self._cache[key] = fn
         fetches, new_mut = fn(feed_arrays, mutables, lr)
 
         for n, arr in new_mut.items():

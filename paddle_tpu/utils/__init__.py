@@ -3,9 +3,11 @@ from .flags import get_flags, set_flags  # noqa: F401
 from . import resilience  # noqa: F401
 from . import chaos  # noqa: F401
 from . import compile_cache  # noqa: F401
+from . import artifact_store  # noqa: F401
 from . import cpp_extension  # noqa: F401
 
 # backend init: arm the persistent XLA compilation cache when
 # FLAGS_compile_cache_dir is set (env or earlier define); supervised
-# relaunches then skip recompiles entirely
+# relaunches then skip recompiles entirely.  The AOT artifact store
+# (artifact_store.py) arms off the same flag at its own import.
 compile_cache.configure()

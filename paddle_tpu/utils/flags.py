@@ -117,6 +117,23 @@ define_flag("FLAGS_program_dce", True,
             "parameter/state update are stripped before compile "
             "(bit-exact; saves trace+XLA-compile time per feed "
             "signature)")
+define_flag("FLAGS_program_opt", True,
+            "run the optimizing ir passes (constant_fold, cse, "
+            "fusion_group — static/passes/optimize.py) when running a "
+            "CompiledProgram: const-only subgraphs evaluate at pass "
+            "time, duplicate pure ops merge, and contiguous "
+            "elementwise chains dispatch as one fused region "
+            "(bit-exact by construction; version-keyed cached like "
+            "FLAGS_program_dce)")
+define_flag("FLAGS_program_opt_skip", "",
+            "comma-separated optimizing pass names to skip while "
+            "FLAGS_program_opt stays on, e.g. 'constant_fold,cse' "
+            "leaves only fusion_group active")
+define_flag("FLAGS_aot_store_max_mb", 2048,
+            "size cap (MiB) of the content-addressed AOT artifact "
+            "store (<FLAGS_compile_cache_dir>/artifacts); "
+            "least-recently-used executables are evicted past it, "
+            "0 disables the cap (utils/artifact_store.py)")
 define_flag("FLAGS_host_tracer_capacity", 1 << 20,
             "max host spans held by the profiler ring buffer; oldest "
             "spans drop beyond this (reference host_trace_level buffer)")

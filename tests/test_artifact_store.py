@@ -1,0 +1,392 @@
+"""AOT artifact store: roundtrip, corruption matrix, GC, and wiring.
+
+The corruption matrix is the load-bearing part: a truncated, bit-
+flipped, magic-less, or version-mismatched entry must MISS CLEANLY —
+counted, deleted, recompiled — never crash and never serve wrong code.
+Wiring tests pin the integration points (static Executor, hapi train
+step, serving warmup, generation session) against a store injected via
+the module-level state, so they exercise exactly the paths
+FLAGS_compile_cache_dir arms without touching jax's process-global
+persistent-cache config.
+"""
+import glob
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import artifact_store as aot
+from paddle_tpu.profiler import metrics
+
+
+def _stats():
+    return aot.stats()
+
+
+def _delta(before, after):
+    return {k: after[k] - before[k] for k in after}
+
+
+def _lower(mul=2.0, n=8):
+    def f(x):
+        return x * mul + 1.0
+    return jax.jit(f).lower(jax.ShapeDtypeStruct((n,), jnp.float32))
+
+
+def _blob_paths(store):
+    return sorted(glob.glob(os.path.join(store.root, "objects", "*",
+                                         "*.bin")))
+
+
+@pytest.fixture
+def store(tmp_path):
+    return aot.ArtifactStore(str(tmp_path / "artifacts"), name="test")
+
+
+@pytest.fixture
+def global_store(tmp_path, monkeypatch):
+    """Arm the module-level store (what aot_compile consults) without
+    going through FLAGS_compile_cache_dir — jax's persistent-cache
+    config is process-global and must not chase a pytest tmp dir."""
+    s = aot.ArtifactStore(str(tmp_path / "artifacts"))
+    monkeypatch.setitem(aot._state, "store", s)
+    monkeypatch.setitem(aot._state, "root", s.root)
+    return s
+
+
+class TestStoreRoundtrip:
+    def test_miss_store_hit(self, store):
+        b0 = _stats()
+        low = _lower()
+        exe1 = store.load_or_compile(low, label="t")
+        d = _delta(b0, _stats())
+        assert d["miss"] == 1 and d["store"] == 1 and d["hit"] == 0
+        assert len(store) == 1
+        exe2 = store.load_or_compile(_lower(), label="t")
+        d = _delta(b0, _stats())
+        assert d["hit"] == 1 and d["miss"] == 1
+        x = np.arange(8, dtype=np.float32)
+        assert np.array_equal(np.asarray(exe1(x)), np.asarray(exe2(x)))
+
+    def test_distinct_programs_distinct_entries(self, store):
+        store.load_or_compile(_lower(mul=2.0))
+        store.load_or_compile(_lower(mul=3.0))
+        store.load_or_compile(_lower(mul=2.0, n=16))
+        assert len(store) == 3
+
+    def test_second_store_instance_hits_same_dir(self, store):
+        """Fresh instance over the same root = the relaunch case."""
+        store.load_or_compile(_lower())
+        b0 = _stats()
+        s2 = aot.ArtifactStore(store.root)
+        exe = s2.load_or_compile(_lower())
+        d = _delta(b0, _stats())
+        assert d["hit"] == 1 and d["miss"] == 0
+        assert np.allclose(np.asarray(exe(np.ones(8, np.float32))), 3.0)
+
+    def test_extra_key_separates_entries(self, store):
+        store.load_or_compile(_lower(), extra=("a",))
+        b0 = _stats()
+        store.load_or_compile(_lower(), extra=("b",))
+        assert _delta(b0, _stats())["miss"] == 1
+
+    def test_aot_compile_without_store_just_compiles(self, monkeypatch):
+        monkeypatch.setitem(aot._state, "store", None)
+        monkeypatch.setitem(aot._state, "root", None)
+        b0 = _stats()
+        exe = aot.aot_compile(_lower())
+        assert np.allclose(np.asarray(exe(np.zeros(8, np.float32))), 1.0)
+        assert _delta(b0, _stats()) == {k: 0 for k in b0}
+
+
+class TestCorruptionMatrix:
+    """Every defect class: clean miss + recompile, never crash."""
+
+    def _one_entry(self, store):
+        store.load_or_compile(_lower())
+        paths = _blob_paths(store)
+        assert len(paths) == 1
+        return paths[0]
+
+    def _assert_clean_miss(self, store, b0):
+        exe = store.load_or_compile(_lower())
+        d = _delta(b0, _stats())
+        assert d["corrupt"] == 1 and d["miss"] == 1 and d["hit"] == 0
+        # the defective entry was quarantine-deleted and re-stored
+        assert d["store"] == 1 and len(store) == 1
+        out = np.asarray(exe(np.arange(8, dtype=np.float32)))
+        assert np.array_equal(out, np.arange(8) * 2.0 + 1.0)
+
+    def test_truncated_entry(self, store):
+        p = self._one_entry(store)
+        blob = open(p, "rb").read()
+        open(p, "wb").write(blob[:len(blob) // 2])
+        self._assert_clean_miss(store, _stats())
+
+    def test_flipped_byte(self, store):
+        p = self._one_entry(store)
+        blob = bytearray(open(p, "rb").read())
+        blob[-10] ^= 0xFF
+        open(p, "wb").write(bytes(blob))
+        self._assert_clean_miss(store, _stats())
+
+    def test_bad_magic(self, store):
+        p = self._one_entry(store)
+        blob = open(p, "rb").read()
+        open(p, "wb").write(b"NOTANAOT" + blob)
+        self._assert_clean_miss(store, _stats())
+
+    def test_version_mismatch(self, store):
+        """Header rewritten to claim another jax/jaxlib: the payload
+        hash still matches, the version check must reject anyway (an
+        upgraded runtime must never load a stale executable)."""
+        p = self._one_entry(store)
+        blob = open(p, "rb").read()
+        nl = blob.index(b"\n", len(aot._MAGIC))
+        header = json.loads(blob[len(aot._MAGIC):nl].decode())
+        header["jax"] = "0.0.0-stale"
+        open(p, "wb").write(
+            aot._MAGIC + json.dumps(header, sort_keys=True).encode()
+            + b"\n" + blob[nl + 1:])
+        self._assert_clean_miss(store, _stats())
+
+    def test_empty_file(self, store):
+        p = self._one_entry(store)
+        open(p, "wb").close()
+        self._assert_clean_miss(store, _stats())
+
+    def test_garbage_pickle_payload(self, store):
+        """Valid magic+header over a hash-consistent garbage payload:
+        deserialization itself must fail closed."""
+        p = self._one_entry(store)
+        payload = b"\x80\x04garbage-not-an-executable"
+        import hashlib
+        jax_v, jaxlib_v, _p, backend = aot._versions()
+        header = json.dumps({
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "size": len(payload), "jax": jax_v, "jaxlib": jaxlib_v,
+            "backend": backend, "label": "", "fingerprint": "x",
+        }, sort_keys=True).encode()
+        open(p, "wb").write(aot._MAGIC + header + b"\n" + payload)
+        self._assert_clean_miss(store, _stats())
+
+    def test_missing_index_is_not_fatal(self, store):
+        """Blobs are self-verifying; the index is only GC metadata."""
+        store.load_or_compile(_lower())
+        os.unlink(store._index_path)
+        b0 = _stats()
+        store.load_or_compile(_lower())
+        assert _delta(b0, _stats())["hit"] == 1
+
+    def test_corrupt_index_is_not_fatal(self, store):
+        store.load_or_compile(_lower())
+        with open(store._index_path, "w") as f:
+            f.write("{not json")
+        b0 = _stats()
+        store.load_or_compile(_lower())
+        assert _delta(b0, _stats())["hit"] == 1
+
+
+class TestGC:
+    def test_lru_eviction_under_size_cap(self, tmp_path):
+        s = aot.ArtifactStore(str(tmp_path / "a"), name="gc")
+        s.load_or_compile(_lower(mul=1.0))
+        s.load_or_compile(_lower(mul=2.0))
+        size = sum(os.path.getsize(p) for p in _blob_paths(s))
+        # re-touch entry 1 so entry 2 is the LRU victim
+        b0 = _stats()
+        s.load_or_compile(_lower(mul=1.0))
+        assert _delta(b0, _stats())["hit"] == 1
+        s.max_bytes = size  # the third entry must push something out
+        b0 = _stats()
+        s.load_or_compile(_lower(mul=3.0))
+        d = _delta(b0, _stats())
+        assert d["evicted"] >= 1 and len(s) <= 2
+        # the most-recently-used entry survived
+        b0 = _stats()
+        s.load_or_compile(_lower(mul=1.0))
+        assert _delta(b0, _stats())["hit"] == 1
+
+    def test_orphan_blobs_count_against_cap(self, tmp_path):
+        """A blob written without an index entry (crash between blob
+        write and index write) must still be seen — and evicted — by
+        the size-cap GC."""
+        s = aot.ArtifactStore(str(tmp_path / "c"), name="orph")
+        s.load_or_compile(_lower(mul=1.0))
+        size = os.path.getsize(_blob_paths(s)[0])
+        orphan = os.path.join(s.root, "objects", "zz",
+                              "f" * 64 + ".bin")
+        os.makedirs(os.path.dirname(orphan), exist_ok=True)
+        with open(orphan, "wb") as f:
+            f.write(b"\0" * size)
+        os.utime(orphan, (1, 1))        # oldest: the LRU victim
+        s.max_bytes = 2 * size          # entry + orphan are at the cap
+        b0 = _stats()
+        s.load_or_compile(_lower(mul=2.0))   # pushes past the cap
+        assert _delta(b0, _stats())["evicted"] >= 1
+        assert not os.path.exists(orphan)
+
+    def test_cap_zero_never_evicts(self, tmp_path):
+        s = aot.ArtifactStore(str(tmp_path / "b"), max_bytes=0)
+        for m in (1.0, 2.0, 3.0, 4.0):
+            s.load_or_compile(_lower(mul=m))
+        assert len(s) == 4
+
+
+class TestWiring:
+    """The integration points FLAGS_compile_cache_dir arms."""
+
+    def test_static_executor_roundtrip(self, global_store):
+        from paddle_tpu import static
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data("x", [None, 6], "float32")
+                pred = static.nn.fc(x, 3)
+            xb = np.random.RandomState(0).rand(2, 6).astype("float32")
+            b0 = _stats()
+            ref, = static.Executor().run(main, feed={"x": xb},
+                                         fetch_list=[pred])
+            d = _delta(b0, _stats())
+            assert d["miss"] == 1 and d["store"] == 1
+            b0 = _stats()
+            out, = static.Executor().run(main, feed={"x": xb},
+                                         fetch_list=[pred])
+            assert _delta(b0, _stats())["hit"] == 1
+            assert np.array_equal(ref, out)
+        finally:
+            paddle.disable_static()
+
+    def test_hapi_train_step_roundtrip(self, global_store):
+        import paddle_tpu.nn as nn
+
+        def train(seed):
+            paddle.seed(seed)
+            net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                                nn.Linear(8, 1))
+            model = paddle.Model(net)
+            model.prepare(paddle.optimizer.SGD(
+                learning_rate=0.1, parameters=net.parameters()),
+                paddle.nn.MSELoss())
+            rng = np.random.RandomState(0)
+            x = rng.rand(4, 4).astype("float32")
+            y = rng.rand(4, 1).astype("float32")
+            losses = [float(model.train_batch([x], [y])["loss"])
+                      for _ in range(3)]
+            return losses
+
+        b0 = _stats()
+        ref = train(0)
+        d = _delta(b0, _stats())
+        assert d["miss"] >= 1 and d["store"] == d["miss"]
+        b0 = _stats()
+        out = train(0)          # same arch+seed: fingerprint identical
+        d = _delta(b0, _stats())
+        assert d["hit"] >= 1 and d["miss"] == 0
+        assert ref == out       # deserialized step is bit-exact
+
+    def test_generation_session_roundtrip(self, global_store):
+        from paddle_tpu.generation import GenerationSession
+        from paddle_tpu.models import GPT, GPTConfig
+        paddle.seed(3)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=2, max_seq_len=32, ffn_mult=2)
+        net = GPT(cfg)
+        prompt = np.arange(1, 6, dtype=np.int32)
+
+        b0 = _stats()
+        s1 = GenerationSession(net, batch_capacity=1, max_length=32,
+                               name="aot_t1")
+        ref = s1.generate(prompt, max_new_tokens=6, do_sample=True,
+                          seed=9)
+        d = _delta(b0, _stats())
+        assert d["miss"] == 2 and d["store"] == 2  # prefill + decode
+        b0 = _stats()
+        s2 = GenerationSession(net, batch_capacity=1, max_length=32,
+                               name="aot_t2")
+        out = s2.generate(prompt, max_new_tokens=6, do_sample=True,
+                          seed=9)
+        d = _delta(b0, _stats())
+        assert d["hit"] == 2 and d["miss"] == 0
+        assert np.array_equal(ref[0], out[0])
+
+
+class TestWarmup:
+    def _save_artifact(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit import InputSpec
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                            nn.Linear(8, 4))
+        prefix = str(tmp_path / "m")
+        paddle.jit.save(net, prefix, input_spec=[
+            InputSpec([-1, 4], "float32", name="x")])
+        return prefix
+
+    def test_engine_warmup_populates_all_buckets(self, tmp_path):
+        from paddle_tpu import serving
+        prefix = self._save_artifact(tmp_path)
+        engine = serving.InferenceEngine(
+            prefix, serving.EngineConfig(max_batch_size=8, warmup=True,
+                                         num_workers=1,
+                                         name="warmtest"))
+        try:
+            assert engine.warmed_buckets == 4    # 1, 2, 4, 8
+            compiles = metrics.counter("warmtest.compile").value
+            out = engine.infer([np.ones((3, 4), np.float32)],
+                               timeout=60)
+            assert out[0].shape == (3, 4)
+            # first request = steady state: no fresh compile
+            assert metrics.counter("warmtest.compile").value == compiles
+            assert metrics.gauge("warmtest.warmed_buckets").value == 4
+        finally:
+            engine.close()
+
+    def test_warmup_from_store_costs_no_compiles(self, tmp_path,
+                                                 global_store):
+        from paddle_tpu import serving
+        prefix = self._save_artifact(tmp_path)
+        cfg = dict(max_batch_size=4, warmup=True, num_workers=1)
+        e1 = serving.InferenceEngine(
+            prefix, serving.EngineConfig(name="warmaot1", **cfg))
+        e1.close()
+        b0 = _stats()
+        e2 = serving.InferenceEngine(
+            prefix, serving.EngineConfig(name="warmaot2", **cfg))
+        e2.close()
+        d = _delta(b0, _stats())
+        assert d["hit"] == e2.warmed_buckets > 0 and d["miss"] == 0
+
+    def test_generation_engine_warmup_and_healthz(self, tmp_path):
+        from paddle_tpu import serving
+        from paddle_tpu.models import GPT, GPTConfig
+        paddle.seed(1)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=2, max_seq_len=32, ffn_mult=2)
+        engine = serving.GenerationEngine(
+            GPT(cfg), serving.GenerationEngineConfig(
+                max_slots=2, max_new_tokens=4, warmup=True,
+                name="warmgen"))
+        try:
+            # seq_buckets(32, 8) prefills (8, 16, 32) + 1 decode
+            assert engine.warmed_buckets == 4
+            compiles = metrics.counter("warmgen.compile").value
+            toks = engine.generate(np.ones(5, np.int32), timeout=120)
+            assert len(toks) > 0
+            assert metrics.counter("warmgen.compile").value == compiles
+            from paddle_tpu.serving.server import ServingServer
+            with ServingServer(engine) as srv:
+                body = json.loads(urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}/healthz",
+                    timeout=10).read())
+            assert body["decode_warmed_buckets"] == 4
+            assert body["decode_slots"] == 2
+        finally:
+            engine.close()

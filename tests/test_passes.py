@@ -36,7 +36,8 @@ def _static_mode():
 @pytest.fixture
 def _flags_guard():
     saved = {k: flags_mod.get_flag(k)
-             for k in ("FLAGS_check_program", "FLAGS_program_dce")}
+             for k in ("FLAGS_check_program", "FLAGS_program_dce",
+                       "FLAGS_program_opt", "FLAGS_program_opt_skip")}
     yield
     flags_mod.set_flags(saved)
 
@@ -293,6 +294,219 @@ class TestExecutorValidation:
                     validate=True)
 
 
+class TestOptimizingPasses:
+    """constant_fold / cse / fusion_group: golden programs through
+    CompiledProgram with FLAGS_program_opt, asserted bit-exact against
+    the unoptimized execution (the DCE harness pattern above)."""
+
+    def _run(self, prog, fetch, feed, optimize, skip=""):
+        exe = static.Executor()
+        saved = flags_mod.get_flags(["FLAGS_program_opt",
+                                     "FLAGS_program_opt_skip"])
+        flags_mod.set_flags({"FLAGS_program_opt": optimize,
+                             "FLAGS_program_opt_skip": skip})
+        try:
+            comp = static.CompiledProgram(prog)
+            outs = exe.run(comp, feed=feed, fetch_list=fetch,
+                           use_program_cache=False)
+            names = tuple(f if isinstance(f, str) else f.name
+                          for f in fetch)
+            return outs, comp._optimized_program(names)
+        finally:
+            flags_mod.set_flags(saved)
+
+    def _epilogue_program(self):
+        """fc trunk + naive serving epilogue: a const-only subgraph
+        (1/T), a recomputed scale (cse bait), and an elementwise tail
+        (fusion bait)."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+            logits = static.nn.fc(h, 4)
+            t = paddle.to_tensor(np.float32(0.5))
+            inv = paddle.multiply(paddle.add(t, t), t)  # const chain
+            a = paddle.multiply(logits, inv)
+            b = paddle.multiply(logits, inv)            # duplicate
+            out = paddle.exp(paddle.tanh(paddle.add(a, b)))
+        return main, out
+
+    def test_constant_fold_bit_exact_and_counted(self):
+        before = metrics.counter("static.pass.const_folded").value
+        main, out = self._epilogue_program()
+        xb = np.random.RandomState(0).rand(5, 8).astype("float32")
+        ref, _ = self._run(main, [out], {"x": xb}, optimize=False)
+        opt, prog = self._run(main, [out], {"x": xb}, optimize=True)
+        assert np.array_equal(ref[0], opt[0])
+        # the const chain (add, multiply) evaluated at pass time
+        assert metrics.counter("static.pass.const_folded").value \
+            - before >= 2
+        assert not any(op.type == "add" and
+                       set(op.input_names) <= set(prog.constants)
+                       for op in prog.ops)
+
+    def test_folded_value_still_fetchable(self):
+        """A folded op's output becomes a program constant, and a fetch
+        of that very name must still resolve (env seeds from consts)."""
+        main = static.Program()
+        with static.program_guard(main):
+            static.data("x", [None, 4], "float32")
+            t = paddle.to_tensor(np.float32(3.0))
+            v = paddle.multiply(paddle.add(t, t), t)   # 18.0, const-only
+        outs, prog = self._run(main, [v], {"x": np.zeros((1, 4),
+                                                         np.float32)},
+                               optimize=True)
+        assert v.name in prog.constants
+        assert float(outs[0]) == 18.0
+
+    def test_cse_merges_duplicates_bit_exact(self):
+        before = metrics.counter("static.pass.cse_merged").value
+        main, out = self._epilogue_program()
+        xb = np.random.RandomState(1).rand(3, 8).astype("float32")
+        ref, _ = self._run(main, [out], {"x": xb}, optimize=False)
+        opt, prog = self._run(main, [out], {"x": xb}, optimize=True,
+                              skip="fusion_group")
+        assert np.array_equal(ref[0], opt[0])
+        assert metrics.counter("static.pass.cse_merged").value \
+            - before == 1
+        mults = [op for op in prog.ops if op.type == "multiply"]
+        assert len(mults) == 1      # b collapsed onto a
+
+    def test_cse_never_merges_fetched_outputs(self):
+        """Both duplicate outputs fetched: the fetch names must both
+        survive, so the duplicate is NOT merged."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            a = paddle.tanh(x)
+            b = paddle.tanh(x)
+        xb = np.random.RandomState(2).rand(2, 4).astype("float32")
+        outs, prog = self._run(main, [a, b], {"x": xb}, optimize=True)
+        assert np.array_equal(outs[0], outs[1])
+        assert sum(1 for op in prog.ops if op.type == "tanh") == 2
+
+    def test_fusion_groups_chains_bit_exact(self):
+        before = metrics.counter("static.pass.ops_fused").value
+        main, out = self._epilogue_program()
+        xb = np.random.RandomState(3).rand(4, 8).astype("float32")
+        ref, _ = self._run(main, [out], {"x": xb}, optimize=False)
+        opt, prog = self._run(main, [out], {"x": xb}, optimize=True)
+        assert np.array_equal(ref[0], opt[0])
+        fused = [op for op in prog.ops
+                 if op.attrs.get("__fused__")]
+        assert fused, f"no fused op in {[op.type for op in prog.ops]}"
+        assert metrics.counter("static.pass.ops_fused").value \
+            - before >= 3   # add+tanh+exp at least
+        # fusion preserves the (renamed-onto-a) chain semantics
+        assert all("__fused_ops__" in op.attrs for op in fused)
+
+    def test_fusion_preserves_escaped_intermediates(self):
+        """A mid-chain output consumed outside the chain (here:
+        fetched) must survive as a fused-op output."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            mid = paddle.tanh(paddle.exp(x))
+            out = paddle.sqrt(paddle.abs(mid))
+        xb = np.random.RandomState(4).rand(2, 4).astype("float32")
+        ref, _ = self._run(main, [mid, out], {"x": xb}, optimize=False)
+        opt, prog = self._run(main, [mid, out], {"x": xb},
+                              optimize=True)
+        assert np.array_equal(ref[0], opt[0])
+        assert np.array_equal(ref[1], opt[1])
+        fused = [op for op in prog.ops if op.attrs.get("__fused__")]
+        assert fused and mid.name in fused[0].output_names
+
+    def test_grad_pinned_ops_never_touched(self):
+        """Every forward op a grad op replays must survive all three
+        passes — training programs stay byte-identical in behavior."""
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            label = static.data("label", [None, 1], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+            pred = static.nn.fc(h, 1)
+            loss = paddle.mean(paddle.square(pred - label))
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        rng = np.random.RandomState(5)
+        feed = {"x": rng.rand(4, 8).astype("float32"),
+                "label": rng.rand(4, 1).astype("float32")}
+        _, prog = self._run(main, [loss], feed, optimize=True)
+        pinned = {op.fwd_idx for op in main.ops if op.kind == "grad"}
+        kept_types = [op.type for op in prog.ops]
+        for idx in pinned:
+            assert main.ops[idx].type in kept_types
+        assert not any(op.attrs.get("__fused__") for op in prog.ops)
+
+    def test_train_parity_three_steps(self):
+        """Full fwd+bwd+update loop, FLAGS_program_opt on vs off:
+        losses and updated parameters bit-identical at every step."""
+        def build():
+            paddle.seed(42)
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 8], "float32")
+                label = static.data("label", [None, 1], "float32")
+                h = static.nn.fc(x, 16, activation="relu")
+                pred = static.nn.fc(h, 1)
+                loss = paddle.mean(paddle.square(pred - label))
+                paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            return main, loss
+        rng = np.random.RandomState(6)
+        xb = rng.rand(4, 8).astype("float32")
+        yb = rng.rand(4, 1).astype("float32")
+        exe = static.Executor()
+        saved = flags_mod.get_flags(["FLAGS_program_opt"])
+        try:
+            flags_mod.set_flags({"FLAGS_program_opt": False})
+            m1, l1 = build()
+            ref = [exe.run(static.CompiledProgram(m1),
+                           feed={"x": xb, "label": yb},
+                           fetch_list=[l1])[0] for _ in range(3)]
+            flags_mod.set_flags({"FLAGS_program_opt": True})
+            m2, l2 = build()
+            opt = [exe.run(static.CompiledProgram(m2),
+                           feed={"x": xb, "label": yb},
+                           fetch_list=[l2])[0] for _ in range(3)]
+        finally:
+            flags_mod.set_flags(saved)
+        for a, b in zip(ref, opt):
+            assert np.array_equal(a, b)
+        for pa, pb in zip(m1.parameters.values(),
+                          m2.parameters.values()):
+            assert np.array_equal(np.asarray(pa._data),
+                                  np.asarray(pb._data))
+
+    def test_skip_flag_disables_individual_pass(self):
+        main, out = self._epilogue_program()
+        feed = {"x": np.ones((2, 8), np.float32)}
+        _, all_on = self._run(main, [out], feed, optimize=True)
+        _, no_fuse = self._run(main, [out], feed, optimize=True,
+                               skip="fusion_group")
+        assert any(op.attrs.get("__fused__") for op in all_on.ops)
+        assert not any(op.attrs.get("__fused__") for op in no_fuse.ops)
+        _, none_on = self._run(main, [out], feed, optimize=True,
+                               skip="constant_fold,cse,fusion_group")
+        # only DCE remains; the const chain survives as ops
+        assert any(op.type == "add" and
+                   set(op.input_names) <= set(main.constants)
+                   for op in none_on.ops)
+
+    def test_stateful_ops_never_folded_or_fused(self):
+        """dropout consumes rng: it must survive every transform even
+        when its inputs are constants."""
+        main = static.Program()
+        with static.program_guard(main):
+            static.data("x", [None, 4], "float32")
+            c = paddle.to_tensor(np.ones((4, 4), np.float32))
+            d = paddle.nn.functional.dropout(paddle.add(c, c), p=0.5)
+            out = paddle.tanh(d)
+        _, prog = self._run(
+            main, [out], {"x": np.zeros((1, 4), np.float32)},
+            optimize=True)
+        assert any(op.type.startswith("dropout") for op in prog.ops)
+
+
 class TestDeadOpElimination:
     def test_liveness_finds_dead_branch(self):
         main, pred = _forward_program(extra_dead=True)
@@ -308,7 +522,10 @@ class TestDeadOpElimination:
         assert d.op_type in ("matmul", "add") and d.var is not None
 
     def test_dce_bit_exact_and_strips(self, _flags_guard):
-        flags_mod.set_flags({"FLAGS_program_dce": True})
+        # DCE-only assertion: keep the optimizing pipeline out of the
+        # op-count arithmetic (TestOptimizingPasses covers it)
+        flags_mod.set_flags({"FLAGS_program_dce": True,
+                             "FLAGS_program_opt": False})
         main, pred = _forward_program(extra_dead=True)
         xb = np.random.RandomState(0).rand(6, 8).astype("float32")
         exe = static.Executor()

@@ -35,6 +35,13 @@ python tools/pipeline_gate.py
 # queue_full shed count at the admission bound, and total XLA compiles
 # bounded by the shape-bucket count.
 python tools/serving_gate.py
+# Compile-amortization gate: the same fit + serving-engine load +
+# generation session runs twice in two processes sharing one
+# FLAGS_compile_cache_dir — the second run must perform ZERO fresh XLA
+# compiles (every AOT site hits the content-addressed artifact store,
+# jax's persistent cache gains no entries) and be bit-exact with the
+# first across all three legs.
+python tools/cache_gate.py
 # Decode gate: the continuous-batching GenerationEngine under
 # concurrent staggered clients with a fixed serve.request chaos spec —
 # zero lost requests, every streamed sequence bit-identical to the
