@@ -28,7 +28,6 @@ import hashlib
 import json
 import os
 import shutil
-import threading
 import time
 import warnings
 from typing import Any, Dict, Optional
@@ -39,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from ..utils import chaos as _chaos
+from ..utils import concurrency as _conc
 from ..utils import resilience as _resilience
 from ..profiler import metrics as _metrics
 
@@ -51,7 +51,7 @@ MANIFEST_NAME = "_paddle_manifest.json"
 COMMITTED_NAME = "_PADDLE_COMMITTED"
 
 _pending = []
-_plock = threading.Lock()
+_plock = _conc.Lock(name="ckpt.pending", lazy=True)
 
 
 class CheckpointCorruptError(RuntimeError):

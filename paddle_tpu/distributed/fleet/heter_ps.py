@@ -184,8 +184,12 @@ class HeterEmbeddingTable:
         return {"host": self.host.copy()}
 
     def load_state_dict(self, sd):
-        self.host[...] = sd["host"]
         with self._lock:
+            # host write under the table lock: apply_grads mutates
+            # self.host under it, and a restore racing a training push
+            # must not interleave row updates with the bulk overwrite
+            # (found by conc_lint LK03)
+            self.host[...] = sd["host"]
             # refresh any cached copies from the restored host tier
             live = [(int(r), s) for r, s in self._slot_of.items()]
             for rid, slot in live:

@@ -180,6 +180,24 @@ class PodLauncher:
             except subprocess.TimeoutExpired:
                 p.kill()
 
+    def dump_stacks(self, settle: float = 0.5):
+        """Ask every live worker for a thread dump (SIGUSR1 -> the
+        handler installed by ``Model.fit`` under supervision /
+        ``concurrency.install_signal_dump``) before the gang is
+        killed, so a watchdog-stalled worker's log ends with all
+        thread stacks + held sanitizer locks instead of going dark."""
+        signalled = False
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGUSR1)
+                    signalled = True
+                except (OSError, AttributeError,
+                        ValueError):   # gone / no SIGUSR1 (windows)
+                    pass
+        if signalled:
+            time.sleep(settle)   # let handlers flush before SIGTERM
+
     def _close_logs(self):
         for f in self.log_files:
             f.close()
@@ -238,6 +256,7 @@ class PodLauncher:
                                       f"{now - t:.1f}s (watchdog "
                                       f"{watchdog}s) — killing the "
                                       f"gang", file=sys.stderr)
+                                self.dump_stacks()
                                 self.stop()
                                 return "stall", k
                 time.sleep(poll)

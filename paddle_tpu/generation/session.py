@@ -49,9 +49,13 @@ __all__ = ["GenerationSession"]
 # toggles eval mode, so two concurrent compile_fns over the same model
 # (the ExecutableCache latch is only per-key) would corrupt each
 # other's save/trace/restore window.  Compiles are rare (once per
-# bucket), so one coarse lock costs nothing steady-state.
-import threading as _threading
-_TRACE_LOCK = _threading.Lock()
+# bucket), so one coarse lock costs nothing steady-state.  Sanitizer
+# factory (utils/concurrency.py): under FLAGS_lock_san the XLA
+# compile held under this lock is a known, baselined LK02 — the
+# serialization IS the point; the runtime graph still orders it
+# against every other named lock.
+from ..utils import concurrency as _conc
+_TRACE_LOCK = _conc.Lock(name="generation.trace", lazy=True)
 
 
 def _as_key_rows(seed, seeds, rows: int) -> np.ndarray:

@@ -514,6 +514,13 @@ class Model:
         spec = os.environ.get("PADDLE_SUPERVISE_STORE")
         if not spec:
             return None
+        # supervised workers also install the SIGUSR1 thread-dump
+        # handler: before the watchdog kills a stalled gang it signals
+        # each worker, so the wedged one's log ends with every thread's
+        # stack and currently-held sanitizer locks (diagnosable
+        # artifact instead of a silent SIGKILL)
+        from ..utils import concurrency as _conc
+        _conc.install_signal_dump()
         from ..distributed.fleet.elastic.manager import store_from_spec
         from ..distributed.launch import SUPERVISE_PREFIX
         store = store_from_spec(spec)

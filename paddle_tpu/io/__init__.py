@@ -20,6 +20,7 @@ from ..core.tensor import Tensor, to_tensor
 from ..profiler import metrics as _metrics
 from ..profiler import tracer as _tracer
 from ..utils import chaos as _chaos
+from ..utils import concurrency as _conc
 from .prefetch import DevicePrefetcher
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
@@ -766,7 +767,7 @@ class DataLoader:
 
         batches = list(self.batch_sampler)
         cursor = {"i": 0}
-        lock = threading.Lock()
+        lock = _conc.Lock(name="io.loader.cursor")
         q = native.BlockingQueue(
             capacity=self.prefetch_factor * self.num_workers)
         done = {"workers": 0}
@@ -832,9 +833,9 @@ class DataLoader:
     def _prefetch_iter(self):
         batches = list(self.batch_sampler)
         cursor = {"i": 0}
-        lock = threading.Lock()
+        lock = _conc.Lock(name="io.loader.cursor")
         results: dict = {}
-        cond = threading.Condition()
+        cond = _conc.Condition(name="io.loader.results")
         limit = self.prefetch_factor * self.num_workers
 
         class _WorkerError:

@@ -48,6 +48,7 @@ from ..core.tensor import Tensor
 from ..profiler import metrics as _metrics
 from ..profiler import tracer as _tracer
 from ..utils import chaos as _chaos
+from ..utils import concurrency as _conc
 
 __all__ = ["DevicePrefetcher"]
 
@@ -217,9 +218,10 @@ class DevicePrefetcher:
     # -- consumer side -------------------------------------------------
     def _start(self):
         self._started = True
-        self._thread = threading.Thread(
-            target=self._produce, name="paddle-prefetch", daemon=True)
-        self._thread.start()
+        # spawn registers the creation site with the sanitizer thread
+        # registry, so leak reports and SIGUSR1 dumps name this stage
+        self._thread = _conc.spawn(
+            self._produce, name="paddle-prefetch")
 
     def __iter__(self):
         if self._started:

@@ -14,8 +14,9 @@ from __future__ import annotations
 
 import json
 import re
-import threading
 from typing import Dict, Optional
+
+from ..utils import concurrency as _conc
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "counter", "gauge",
            "histogram", "get", "snapshot", "prometheus_text", "reset",
@@ -150,7 +151,13 @@ class Registry:
     """Name -> metric, get-or-create; one process-wide default below."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # RLock, deliberately: under FLAGS_lock_san the sanitizer
+        # records its own wait/hold observations through this registry,
+        # so the instrumentation path can re-enter get-or-create while
+        # the outer create still holds the lock
+        # lazy: the default Registry is built at import, before any
+        # set_flags could arm the sanitizer
+        self._lock = _conc.RLock(name="profiler.registry", lazy=True)
         self._metrics: Dict[str, object] = {}
 
     def _get_or_create(self, cls, name, doc, **kw):

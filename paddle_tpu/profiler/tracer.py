@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..utils import concurrency as _conc
 from ..utils import flags as _flags
 from . import metrics as _metrics
 
@@ -40,7 +41,7 @@ __all__ = ["active", "enable", "disable", "is_enabled", "clear", "events",
 # module-level fast predicate — the single check hot paths gate on
 active = False
 
-_lock = threading.Lock()
+_lock = _conc.Lock(name="profiler.tracer", lazy=True)
 _events: collections.deque = collections.deque(maxlen=1 << 20)
 
 # event tuple layout: (name, start_ns, end_ns, tid, cat, args)

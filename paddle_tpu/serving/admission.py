@@ -21,9 +21,10 @@ the metrics registry: ``serving.request.admitted``,
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Optional
+
+from ..utils import concurrency as _conc
 
 __all__ = ["RequestRejected", "DeadlineExceeded", "EngineClosed",
            "AdmissionController"]
@@ -67,7 +68,7 @@ class AdmissionController:
         self.max_tokens = int(max_tokens) if max_tokens else None
         self._tokens = 0
         self._depth = 0
-        self._lock = threading.Lock()
+        self._lock = _conc.Lock(name=f"{name}.admission")
         from ..profiler import metrics as _metrics
         self._admitted = _metrics.counter(
             f"{name}.request.admitted", "requests accepted into the "

@@ -22,6 +22,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..utils import concurrency as _conc
+
 __all__ = ["next_bucket", "bucket_shape", "pad_batch", "seq_buckets",
            "BucketPolicy", "ExecutableCache"]
 
@@ -143,7 +145,7 @@ class ExecutableCache:
     """
 
     def __init__(self, name: str = "serving"):
-        self._lock = threading.Lock()
+        self._lock = _conc.Lock(name=f"{name}.executable_cache")
         self._entries: Dict[Tuple, object] = {}
         self._inflight: Dict[Tuple, threading.Event] = {}
         from ..profiler import metrics as _metrics

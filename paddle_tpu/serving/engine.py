@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ..utils import concurrency as _conc
 from .admission import (AdmissionController, DeadlineExceeded,
                         EngineClosed, RequestRejected, deadline_from_ms)
 from .bucketing import BucketPolicy, ExecutableCache
@@ -249,12 +250,17 @@ class InferenceEngine:
             self._warmup()
 
         self._pending: deque = deque()
-        self._cond = threading.Condition()
+        # sanitizer factories (utils/concurrency.py): plain threading
+        # primitives when FLAGS_lock_san=0, instrumented (order graph +
+        # contention histograms) when on
+        self._cond = _conc.Condition(name=f"{self.config.name}"
+                                     ".engine.cond")
         # serializes metric updates issued from concurrent workers: the
         # registry's Counter.inc is deliberately lock-free (PR-1 hot
         # path), but the serving gate asserts EXACT counts, so the
         # engine's own increments must not lose races
-        self._mlock = threading.Lock()
+        self._mlock = _conc.Lock(name=f"{self.config.name}"
+                                 ".engine.metrics")
         self._batch_q: "_queue.Queue" = _queue.Queue(
             maxsize=max(2, 2 * self.config.num_workers))
         self._stop = False
@@ -263,15 +269,12 @@ class InferenceEngine:
         self._workers: List[threading.Thread] = []
         self._predictors = [model.clone()
                             for _ in range(self.config.num_workers)]
-        self._batcher = threading.Thread(target=self._batcher_loop,
-                                         name="serving-batcher",
-                                         daemon=True)
-        self._batcher.start()
+        self._batcher = _conc.spawn(self._batcher_loop,
+                                    name="serving-batcher")
         for i, p in enumerate(self._predictors):
-            t = threading.Thread(target=self._worker_loop, args=(p,),
-                                 name=f"serving-worker-{i}", daemon=True)
-            t.start()
-            self._workers.append(t)
+            self._workers.append(_conc.spawn(
+                self._worker_loop, args=(p,),
+                name=f"serving-worker-{i}"))
 
     # -- warmup --------------------------------------------------------
     def _warmup(self):
@@ -880,14 +883,14 @@ class GenerationEngine:
         self._tps = np.ones((S,), np.float32)
 
         self._pending: deque = deque()
-        self._cond = threading.Condition()
-        self._mlock = threading.Lock()
+        self._cond = _conc.Condition(name=f"{cfg.name}"
+                                     ".genengine.cond")
+        self._mlock = _conc.Lock(name=f"{cfg.name}.genengine.metrics")
         self._stop = False
         self._paused = False
         self._closed = False
-        self._scheduler = threading.Thread(
-            target=self._loop, name="generation-scheduler", daemon=True)
-        self._scheduler.start()
+        self._scheduler = _conc.spawn(
+            self._loop, name="generation-scheduler")
 
     def _warmup(self):
         """One masked-out prefill per prompt bucket plus one decode

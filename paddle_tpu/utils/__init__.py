@@ -1,6 +1,16 @@
 from . import flags  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
+from . import concurrency  # noqa: F401
 from . import resilience  # noqa: F401
+
+# supervised workers (launch --supervise exports PADDLE_SUPERVISE_STORE
+# into the gang's env) get the SIGUSR1 thread-dump handler at IMPORT:
+# the watchdog signals the gang before killing it, and SIGUSR1's
+# default disposition would otherwise terminate — dumpless — any
+# worker that wedged before Model.fit installed the handler itself
+import os as _os
+if _os.environ.get("PADDLE_SUPERVISE_STORE"):
+    concurrency.install_signal_dump()
 from . import chaos  # noqa: F401
 from . import compile_cache  # noqa: F401
 from . import artifact_store  # noqa: F401

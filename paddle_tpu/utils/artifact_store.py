@@ -45,10 +45,10 @@ import hashlib
 import json
 import os
 import pickle
-import threading
 import time
 from typing import Optional, Tuple
 
+from . import concurrency as _conc
 from . import flags as _flags
 
 __all__ = ["ArtifactStore", "active", "configure", "aot_compile",
@@ -115,7 +115,9 @@ class ArtifactStore:
             mb = int(_flags.get_flag("FLAGS_aot_store_max_mb"))
             max_bytes = mb << 20 if mb > 0 else 0
         self.max_bytes = int(max_bytes)
-        self._lock = threading.Lock()
+        # lazy: the global store is constructed at import when
+        # FLAGS_compile_cache_dir arrives via env
+        self._lock = _conc.Lock(name="aot_store.index", lazy=True)
         os.makedirs(os.path.join(self.root, "objects"), exist_ok=True)
 
     # -- paths / index -------------------------------------------------

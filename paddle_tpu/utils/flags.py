@@ -175,6 +175,23 @@ define_flag("FLAGS_compile_cache_dir", "",
             "of re-tracing + re-compiling every program; empty "
             "disables.  Wired at backend init "
             "(utils/compile_cache.py) and re-wired on set_flags")
+define_flag("FLAGS_lock_san", 0,
+            "runtime lock sanitizer level for the framework's named "
+            "locks (utils/concurrency.py): 0 = off (factories return "
+            "plain threading primitives; zero per-acquire cost), 1 = "
+            "instrument — per-thread held-lock stacks, a process-global "
+            "acquisition-order graph that WARNS when an acquire closes "
+            "an ordering cycle (potential deadlock), per-site "
+            "lock.wait_ms/lock.hold_ms histograms, long-hold warnings "
+            "— 2 = same but cycle formation RAISES LockOrderError at "
+            "the offending acquire (CI gates).  Read once at lock "
+            "construction, so set it via env or before building "
+            "engines/loaders/checkpointers")
+define_flag("FLAGS_lock_hold_warn_ms", 200.0,
+            "with FLAGS_lock_san >= 1: warn (and count "
+            "lock.long_hold) when any sanitizer lock is held longer "
+            "than this many milliseconds — long critical sections "
+            "serialize every waiter under load; 0 disables the check")
 define_flag("FLAGS_prefetch_to_device", 2,
             "default device-prefetch depth used by Model.fit's train "
             "loop (batches kept resident on device by the io "

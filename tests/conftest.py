@@ -71,3 +71,53 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+# ---------------------------------------------------------------------------
+# thread-leak canary (conc-san): every test module must clean up its
+# non-daemon threads.  A leaked non-daemon thread wedges interpreter
+# shutdown (the exact close()-hang bug class the concurrency sanitizer
+# exists for), and the leaking module is usually NOT the one that
+# times out in CI — so name the culprit at the moment of the leak.
+# Creation sites come from the sanitizer thread registry.  Disable
+# with PADDLE_THREAD_CANARY=0 when bisecting.
+# ---------------------------------------------------------------------------
+import threading  # noqa: E402
+
+from paddle_tpu.utils import concurrency as _conc  # noqa: E402
+
+_conc.install_thread_registry()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _thread_leak_canary(request):
+    if os.environ.get("PADDLE_THREAD_CANARY", "1") == "0":
+        yield
+        return
+    before = set(threading.enumerate())
+    yield
+    # grace: servers/executors shut down asynchronously — give their
+    # threads a moment to finish before calling them leaked
+    deadline = 2.0
+    step = 0.05
+    import time
+    leaked = []
+    while deadline > 0:
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive() and not t.daemon]
+        if not leaked:
+            break
+        time.sleep(step)
+        deadline -= step
+    if leaked:
+        names = []
+        for t in leaked:
+            site = _conc.thread_site(t)
+            names.append(f"'{t.name}'"
+                         + (f" (started at {site})" if site else ""))
+        pytest.fail(
+            f"{request.node.name} leaked {len(leaked)} non-daemon "
+            f"thread(s): {', '.join(names)} — join them (or mark them "
+            "daemon) on the module's teardown path; a leaked "
+            "non-daemon thread blocks interpreter shutdown",
+            pytrace=False)

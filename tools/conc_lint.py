@@ -1,0 +1,816 @@
+#!/usr/bin/env python
+"""conc-lint: static lock-order & blocking-under-lock analysis (CI gate).
+
+``tools/framework_lint.py`` sanitizes the framework source for TPU
+dispatch defects (HS01/MD01/VJ01/FL01); this module does the same for
+the defect class that actually dominated PR 4-7 review: concurrency.
+It resolves ``self._lock``-style lock attributes per class (and
+module-global locks), walks every function with a held-lock scope
+stack, follows method calls within a class (and module-level calls
+within a module), and reports:
+
+- ``LK01 lock-order-cycle``: the global lock acquisition-order graph
+  (built from nested ``with``/``acquire`` scopes, including through
+  intra-class method calls) contains a cycle — two threads
+  interleaving those paths can deadlock.  Also covers the degenerate
+  self-cycle: a non-reentrant ``Lock`` re-acquired on a path that
+  already holds it.
+- ``LK02 blocking-under-lock``: a call that can block indefinitely
+  executes while a lock is held — ``queue.get/put`` and
+  ``Future.result``/``.join()``/``.wait()`` without a timeout,
+  ``subprocess``/socket ops, and XLA dispatch (``jit`` /
+  ``lower(...)`` / ``.compile()`` / ``device_put``) — the exact shape
+  of the PR 4/6/7 review findings (batcher wedged, duplicate cold
+  compiles, trace-window corruption, close() hangs).
+- ``LK03 unguarded-guarded-attr``: an attribute written under a lock
+  somewhere in its class is written bare (no lock held) in another
+  method — either the lock is pointless or the bare write races.
+- ``TH01 unjoined-non-daemon-thread``: ``threading.Thread`` created
+  with ``daemon`` unset/False and no reachable ``join()`` — leaks a
+  thread that can wedge interpreter shutdown.
+
+Scope contract (what the static side does NOT see): calls across
+module boundaries and through function-valued arguments are not
+followed — the runtime sanitizer (``paddle_tpu/utils/concurrency.py``,
+``FLAGS_lock_san``) owns those orderings.  Together they bracket the
+bug class from both sides.
+
+Usage::
+
+    python tools/conc_lint.py [paths...] [--baseline FILE]
+    python tools/conc_lint.py --write-baseline   # re-seed (review!)
+
+Exit status is nonzero iff a finding is NOT in the baseline
+(``tools/conc_lint_baseline.txt``).  Baseline keys are line-stable
+(``path|code|scope|detail|occurrence``) so unrelated edits don't
+invalidate them, and entries may carry a trailing ``# justification``
+comment — CI policy requires one per baselined finding.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from framework_lint import (Finding, _assign_occurrences,  # noqa: E402
+                            _call_name)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "conc_lint_baseline.txt")
+
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+_REENTRANT_KINDS = {"RLock", "Condition"}  # threading.Condition defaults
+#                                            to an RLock internally
+
+# receivers whose .wait() is lock-coupled: waiting on the held lock's
+# own condition RELEASES it (not a blocking hold)
+_SUBPROCESS_TAILS = {"run", "check_call", "check_output", "call",
+                     "communicate"}
+_SOCKET_TAILS = {"recv", "recv_into", "accept", "connect", "sendall",
+                 "makefile"}
+_DISPATCH_TAILS = {"device_put", "block_until_ready", "jit"}
+
+
+class _LockDef:
+    __slots__ = ("node", "kind", "line")
+
+    def __init__(self, node: str, kind: str, line: int):
+        self.node = node      # graph node id, e.g. "engine.InferenceEngine._mlock"
+        self.kind = kind      # Lock | RLock | Condition
+        self.line = line
+
+    @property
+    def reentrant(self) -> bool:
+        return self.kind in _REENTRANT_KINDS
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "path", "line", "via")
+
+    def __init__(self, src, dst, path, line, via):
+        self.src = src
+        self.dst = dst
+        self.path = path
+        self.line = line
+        self.via = via
+
+
+def _lock_ctor_kind(call: ast.AST) -> Optional[str]:
+    """'Lock' | 'RLock' | 'Condition' when ``call`` constructs one
+    (``threading.X()``, ``concurrency.X(...)``, bare ``X()`` from a
+    ``from threading import Lock`` style import), else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    tail = _call_name(call.func).split(".")[-1]
+    return _LOCK_CTORS.get(tail)
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """'self._lock' / 'NAME' / 'a.b.c' as a dotted string, or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _FileAnalysis:
+    """One source file's lock definitions, ordering edges, per-function
+    summaries, and directly-emitted findings."""
+
+    def __init__(self, path: str, modbase: str):
+        self.path = path
+        self.modbase = modbase
+        # "self._x" (per class) / global name -> _LockDef
+        self.class_locks: Dict[str, Dict[str, _LockDef]] = {}
+        self.global_locks: Dict[str, _LockDef] = {}
+        self.edges: List[_Edge] = []
+        self.findings: List[Finding] = []
+        # propagation tables: qualname -> direct lock nodes / callees
+        self.fn_acquires: Dict[str, Set[str]] = {}
+        self.fn_calls: Dict[str, Set[str]] = {}
+        # deferred call-site edges: (held nodes, callee qual, line, scope)
+        self.call_sites: List[Tuple[List[str], str, int, str]] = []
+        self.lock_kinds: Dict[str, str] = {}
+
+    # -- lock resolution ----------------------------------------------
+    def resolve(self, expr: ast.AST, cls: Optional[str]
+                ) -> Optional[_LockDef]:
+        chain = _attr_chain(expr)
+        if chain is None:
+            return None
+        if chain.startswith("self.") and cls is not None:
+            return self.class_locks.get(cls, {}).get(chain[5:])
+        if "." not in chain:
+            return self.global_locks.get(chain)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# pass 1: collect lock definitions
+# ---------------------------------------------------------------------------
+def _collect_locks(tree: ast.Module, fa: _FileAnalysis):
+    def node_id(cls: Optional[str], attr: str) -> str:
+        base = f"{fa.modbase}.{cls}.{attr}" if cls else \
+            f"{fa.modbase}.{attr}"
+        return base
+
+    def scan_assign(stmt, cls: Optional[str]):
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            return
+        kind = _lock_ctor_kind(value)
+        if kind is None:
+            return
+        for tgt in targets:
+            chain = _attr_chain(tgt)
+            if chain is None:
+                continue
+            if chain.startswith("self.") and cls is not None:
+                attr = chain[5:]
+                d = _LockDef(node_id(cls, attr), kind, stmt.lineno)
+                fa.class_locks.setdefault(cls, {})[attr] = d
+                fa.lock_kinds[d.node] = kind
+            elif "." not in chain and cls is None:
+                d = _LockDef(node_id(None, chain), kind, stmt.lineno)
+                fa.global_locks[chain] = d
+                fa.lock_kinds[d.node] = kind
+
+    # module-level walk that skips def/class bodies: a global lock
+    # assigned inside a top-level try/except or platform `if` is still
+    # a module global and must resolve (or every rule goes blind on it)
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.ClassDef):
+            # every `self.X = Lock()` anywhere in the class (methods,
+            # nested closures) defines a class lock attribute
+            for sub in ast.walk(node):
+                scan_assign(sub, node.name)
+            continue
+        scan_assign(node, None)
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# pass 2: per-function scope walk
+# ---------------------------------------------------------------------------
+class _FnWalker:
+    """Walk one function body with a held-lock stack, emitting
+    acquisitions, call sites, blocking calls, and attribute writes."""
+
+    def __init__(self, fa: _FileAnalysis, qual: str, cls: Optional[str],
+                 fn: ast.AST, writes_out: Dict[str, List[Tuple]]):
+        self.fa = fa
+        self.qual = qual
+        self.cls = cls
+        self.fn = fn
+        self.writes = writes_out   # attr -> [(guarded, qual, line)]
+        fa.fn_acquires.setdefault(qual, set())
+        fa.fn_calls.setdefault(qual, set())
+
+    # -- emission ------------------------------------------------------
+    def _acquired(self, held: List[_LockDef], lock: _LockDef, line: int):
+        fa = self.fa
+        fa.fn_acquires[self.qual].add(lock.node)
+        for h in held:
+            fa.edges.append(_Edge(h.node, lock.node, fa.path, line,
+                                  f"in {self.qual}"))
+
+    def _blocking(self, held: List[_LockDef], kind: str, line: int):
+        lock = held[-1]
+        self.fa.findings.append(Finding(
+            self.fa.path, line, "LK02", self.qual,
+            f"{lock.node}:{kind}",
+            f"blocking call ({kind}) while holding '{lock.node}' — an "
+            "unbounded wait under a lock turns one slow/wedged peer "
+            "into a pile-up of every thread that needs the lock "
+            "(add a timeout, or move the call outside the critical "
+            "section)"))
+
+    # -- helpers -------------------------------------------------------
+    def _check_call(self, call: ast.Call, held: List[_LockDef]):
+        """LK02 candidates + call-site recording, for one Call node."""
+        fa = self.fa
+        name = _call_name(call.func)
+        tail = name.split(".")[-1]
+        line = call.lineno
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        nonblocking = any(
+            kw.arg in ("block", "blocking") and
+            isinstance(kw.value, ast.Constant) and kw.value.value is False
+            for kw in call.keywords)
+        npos = len(call.args)
+
+        # record intra-class / intra-module call sites for propagation
+        # (held or not: LK03's lock-context pass needs the bare ones)
+        if isinstance(call.func, ast.Attribute) and \
+                isinstance(call.func.value, ast.Name) and \
+                call.func.value.id == "self" and self.cls is not None:
+            callee = f"{self.cls}.{call.func.attr}"
+            fa.fn_calls[self.qual].add(callee)
+            fa.call_sites.append(
+                ([h.node for h in held], callee, line, self.qual))
+        elif isinstance(call.func, ast.Name):
+            callee = call.func.id
+            fa.fn_calls[self.qual].add(callee)
+            fa.call_sites.append(
+                ([h.node for h in held], callee, line, self.qual))
+
+        if not held:
+            return
+        recv = call.func.value if isinstance(call.func, ast.Attribute) \
+            else None
+        if tail in ("wait", "wait_for") and recv is not None:
+            # positional timeouts count, but a literal None does not:
+            # wait(t) / wait_for(pred, t) are bounded, wait(None) /
+            # wait_for(pred, None) are unbounded
+            def _timed_pos(idx):
+                return npos > idx and not (
+                    isinstance(call.args[idx], ast.Constant) and
+                    call.args[idx].value is None)
+            timed = has_timeout or \
+                (tail == "wait" and _timed_pos(0)) or \
+                (tail == "wait_for" and _timed_pos(1))
+            if not timed:
+                # cond.wait() releases ONLY the cond's own lock; every
+                # OTHER held lock stays held for the whole park.
+                # Exempt the wait only for the receiver's own lock.
+                d = self.fa.resolve(recv, self.cls)
+                others = held if d is None else \
+                    [h for h in held if h.node != d.node]
+                if others:
+                    self._blocking(others, "wait", line)
+            return
+        if tail == "get" and recv is not None and npos == 0 and \
+                not has_timeout and not nonblocking:
+            self._blocking(held, "queue.get", line)
+        elif tail == "put" and recv is not None and npos == 1 and \
+                not has_timeout and not nonblocking:
+            self._blocking(held, "queue.put", line)
+        elif tail == "result" and recv is not None and npos == 0 and \
+                not has_timeout:
+            self._blocking(held, "Future.result", line)
+        elif tail == "join" and recv is not None and npos == 0 and \
+                not has_timeout:
+            self._blocking(held, "join", line)
+        elif tail in _SUBPROCESS_TAILS and not has_timeout and (
+                "subprocess" in name or tail == "communicate"):
+            self._blocking(held, f"subprocess.{tail}", line)
+        elif tail in _SOCKET_TAILS and recv is not None and \
+                not has_timeout and tail not in ("connect",):
+            self._blocking(held, f"socket.{tail}", line)
+        elif tail == "connect" and recv is not None and npos == 1 and \
+                not has_timeout:
+            self._blocking(held, "socket.connect", line)
+        elif tail in _DISPATCH_TAILS:
+            self._blocking(held, f"dispatch.{tail}", line)
+        elif tail == "compile" and recv is not None and npos == 0 and \
+                not call.keywords:
+            self._blocking(held, "dispatch.compile", line)
+        elif tail == "lower" and recv is not None and \
+                (npos > 0 or call.keywords):
+            self._blocking(held, "dispatch.lower", line)
+
+    def _scan_expr(self, node: ast.AST, held: List[_LockDef]):
+        """Scan an expression tree (no statements inside) for calls and
+        attribute writes."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub, held)
+
+    def _note_writes(self, stmt: ast.stmt, held: List[_LockDef]):
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AugAssign):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]   # bare `self.x: T` declares, not writes
+        for tgt in targets:
+            for t in ast.walk(tgt):
+                chain = _attr_chain(t) if isinstance(t, ast.Attribute) \
+                    else None
+                if chain and chain.startswith("self.") and \
+                        "." not in chain[5:]:
+                    attr = chain[5:]
+                    if self.cls is not None and attr not in \
+                            self.fa.class_locks.get(self.cls, {}):
+                        self.writes.setdefault(attr, []).append(
+                            (bool(held), self.qual, stmt.lineno))
+
+    # -- statement walk ------------------------------------------------
+    def walk(self):
+        self._walk_body(self.fn.body, [])
+
+    def _walk_body(self, body: List[ast.stmt], held: List[_LockDef]):
+        # .acquire()/.release() pairs extend the held set for the REST
+        # of this body (a conservative but simple model of manual
+        # acquire; `with` is the structured path below)
+        local_held = list(held)
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue   # separate analysis unit
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                entered = list(local_held)
+                for item in stmt.items:
+                    self._scan_expr(item.context_expr, entered)
+                    lock = self.fa.resolve(item.context_expr, self.cls)
+                    if lock is not None:
+                        self._acquired(entered, lock, stmt.lineno)
+                        entered = entered + [lock]
+                self._walk_body(stmt.body, entered)
+                continue
+            # manual acquire/release at statement level
+            expr = stmt.value if isinstance(stmt, ast.Expr) else None
+            if isinstance(expr, ast.Call) and \
+                    isinstance(expr.func, ast.Attribute):
+                lock = self.fa.resolve(expr.func.value, self.cls)
+                if lock is not None and expr.func.attr == "acquire":
+                    self._acquired(local_held, lock, stmt.lineno)
+                    local_held = local_held + [lock]
+                    continue
+                if lock is not None and expr.func.attr == "release":
+                    local_held = [h for h in local_held
+                                  if h.node != lock.node]
+                    continue
+            self._note_writes(stmt, local_held)
+            # compound statements: recurse into their bodies, scan the
+            # header expressions with the current held set
+            sub_bodies = []
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list) and sub and \
+                        isinstance(sub[0], ast.stmt):
+                    sub_bodies.append(sub)
+            handlers = getattr(stmt, "handlers", None) or []
+            for h in handlers:
+                sub_bodies.append(h.body)
+            if sub_bodies:
+                for field, value in ast.iter_fields(stmt):
+                    if field in ("body", "orelse", "finalbody",
+                                 "handlers"):
+                        continue
+                    if isinstance(value, ast.AST):
+                        self._scan_expr(value, local_held)
+                for sub in sub_bodies:
+                    self._walk_body(sub, local_held)
+            else:
+                self._scan_expr(stmt, local_held)
+
+
+# ---------------------------------------------------------------------------
+# pass 3: TH01 threads
+# ---------------------------------------------------------------------------
+def _is_thread_join(node: ast.Call) -> bool:
+    """A ``.join(...)`` call that can plausibly be a Thread join: the
+    receiver is a bare name or a self-attribute — NOT a dotted module
+    path (``os.path.join``) and NOT a string literal (``", ".join``),
+    which would otherwise grant any path-touching function a free pass
+    on the leak rule."""
+    if not (isinstance(node.func, ast.Attribute) and
+            node.func.attr == "join"):
+        return False
+    chain = _attr_chain(node.func.value)
+    return chain is not None and (
+        "." not in chain or
+        (chain.startswith("self.") and chain.count(".") == 1))
+
+
+def _lint_threads(tree: ast.Module, fa: _FileAnalysis):
+    # receivers of any plausible thread-join call in the module
+    join_receivers: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_thread_join(node):
+            chain = _attr_chain(node.func.value)
+            join_receivers.add(chain.split(".")[-1])
+
+    # functions containing each Thread() call, to scope heuristics
+    def enclosing_functions(tree):
+        out = {}
+        stack = []
+
+        def visit(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.append(node)
+                for c in ast.iter_child_nodes(node):
+                    visit(c)
+                stack.pop()
+                return
+            out[id(node)] = stack[-1] if stack else None
+            for c in ast.iter_child_nodes(node):
+                visit(c)
+        visit(tree)
+        return out
+
+    owners = enclosing_functions(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or \
+                _call_name(node.func).split(".")[-1] != "Thread":
+            continue
+        name = _call_name(node.func)
+        if name not in ("Thread", "threading.Thread"):
+            continue
+        daemon_kw = next((kw for kw in node.keywords
+                          if kw.arg == "daemon"), None)
+        if daemon_kw is not None and not (
+                isinstance(daemon_kw.value, ast.Constant) and
+                daemon_kw.value.value is False):
+            continue   # daemon=True or dynamic: not a leak shape
+        owner = owners.get(id(node))
+        scope = owner.name if owner is not None else "<module>"
+        # joined heuristics: (a) any .join( in the enclosing function,
+        # (b) a `<var>.daemon = True` assignment in the function
+        joined = False
+        if owner is not None:
+            for sub in ast.walk(owner):
+                if isinstance(sub, ast.Call) and _is_thread_join(sub):
+                    joined = True
+                elif isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        chain = _attr_chain(tgt)
+                        if chain and chain.endswith(".daemon") and \
+                                isinstance(sub.value, ast.Constant) and \
+                                sub.value.value is True:
+                            joined = True
+        else:
+            joined = bool(join_receivers)
+        if not joined:
+            tgt_kw = next((kw for kw in node.keywords
+                           if kw.arg == "target"), None)
+            tgt = _call_name(tgt_kw.value) if tgt_kw is not None and \
+                isinstance(tgt_kw.value, (ast.Name, ast.Attribute)) \
+                else "?"
+            fa.findings.append(Finding(
+                fa.path, node.lineno, "TH01", scope, f"target:{tgt}",
+                "threading.Thread created non-daemon with no reachable "
+                "join() — it outlives its owner and can wedge "
+                "interpreter shutdown (pass daemon=True, or join it on "
+                "the close/exit path)"))
+
+
+# ---------------------------------------------------------------------------
+# propagation + cycle detection
+# ---------------------------------------------------------------------------
+def _propagate_calls(fa: _FileAnalysis):
+    """Transitive acquires through intra-class/module calls: while
+    holding H, calling a method that (transitively) acquires M adds
+    the edge H -> M at the call site."""
+    # fixpoint of all_acquires = direct U callees'
+    all_acq = {q: set(a) for q, a in fa.fn_acquires.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, callees in fa.fn_calls.items():
+            acc = all_acq.setdefault(q, set())
+            for c in callees:
+                extra = all_acq.get(c)
+                if extra and not extra <= acc:
+                    acc |= extra
+                    changed = True
+    for held, callee, line, scope in fa.call_sites:
+        for node in sorted(all_acq.get(callee, ())):
+            for h in held:
+                fa.edges.append(_Edge(h, node, fa.path, line,
+                                      f"via call to {callee} in {scope}"))
+
+
+def _lock_context(fa: _FileAnalysis) -> Set[str]:
+    """Private methods (``Class._name``, not dunder) whose every
+    intra-file call site executes with a lock held — directly, or from
+    another lock-context method.  Writes inside them are effectively
+    guarded, so LK03 must not call them bare (the ``_push_locked``-
+    style 'caller holds the lock' helper convention)."""
+    sites: Dict[str, List[Tuple[bool, str]]] = {}
+    for held, callee, _line, scope in fa.call_sites:
+        tail = callee.rsplit(".", 1)[-1]
+        if tail.startswith("_") and not tail.startswith("__"):
+            sites.setdefault(callee, []).append((bool(held), scope))
+    ctx: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for callee, evs in sites.items():
+            if callee in ctx:
+                continue
+            if evs and all(held or caller in ctx
+                           for held, caller in evs):
+                ctx.add(callee)
+                changed = True
+    return ctx
+
+
+def _cycle_findings(edges: List[_Edge], kinds: Dict[str, str]
+                    ) -> List[Finding]:
+    """LK01 findings: self-loops on non-reentrant locks + every
+    strongly-connected component of >= 2 lock nodes."""
+    findings: List[Finding] = []
+    graph: Dict[str, Dict[str, _Edge]] = {}
+    for e in edges:
+        if e.src == e.dst:
+            if kinds.get(e.src) == "Lock":
+                findings.append(Finding(
+                    e.path, e.line, "LK01", "<graph>",
+                    f"self:{e.src}",
+                    f"non-reentrant lock '{e.src}' is (re)acquired on "
+                    f"a path that already holds it ({e.via}) — "
+                    "guaranteed self-deadlock the first time this path "
+                    "executes"))
+            continue
+        graph.setdefault(e.src, {}).setdefault(e.dst, e)
+        graph.setdefault(e.dst, {})
+
+    # Tarjan SCC
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, iter(graph.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(graph.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    for scc in sccs:
+        if len(scc) < 2:
+            continue
+        members = sorted(scc)
+        # anchor the finding at the smallest-line participating edge
+        anchor = min(
+            (graph[s][d] for s in scc for d in graph.get(s, {})
+             if d in scc and s != d),
+            key=lambda e: (e.path, e.line))
+        chain = " -> ".join(members + [members[0]])
+        findings.append(Finding(
+            anchor.path, anchor.line, "LK01", "<graph>", chain,
+            f"lock-order cycle {chain}: this process acquires these "
+            "locks in inconsistent orders "
+            f"(one edge: {anchor.src} -> {anchor.dst}, {anchor.via}) — "
+            "two threads interleaving the paths can deadlock; pick one "
+            "global order or drop to a single lock"))
+    return findings
+
+
+def _lk03_findings(fa: _FileAnalysis,
+                   writes: Dict[str, Dict[str, List[Tuple]]]
+                   ) -> List[Finding]:
+    findings = []
+    for cls, attrs in writes.items():
+        for attr, evs in attrs.items():
+            guarded = [e for e in evs if e[0]]
+            bare = [e for e in evs
+                    if not e[0] and not e[1].endswith("__init__")]
+            if guarded and bare:
+                for _g, qual, line in bare:
+                    findings.append(Finding(
+                        fa.path, line, "LK03", qual,
+                        f"{cls}.{attr}",
+                        f"'self.{attr}' is written under a lock in "
+                        f"{guarded[0][1]} (line {guarded[0][2]}) but "
+                        "written bare here — either the lock is "
+                        "unnecessary or this write races with the "
+                        "guarded one"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+def analyze_source(src: str, path: str) -> _FileAnalysis:
+    tree = ast.parse(src)
+    # node ids are keyed on the FULL module path (dotted): basenames
+    # collide across packages (three different `__init__.py` locks in
+    # this tree alone), and merged nodes would fabricate LK01 cycles
+    # between unrelated locks
+    modbase = os.path.splitext(path)[0].replace(os.sep, ".")
+    if "/" in modbase:
+        modbase = modbase.replace("/", ".")
+    fa = _FileAnalysis(path, modbase)
+    _collect_locks(tree, fa)
+
+    # enumerate analysis units: every function def, with class context
+    units: List[Tuple[str, Optional[str], ast.AST]] = []
+
+    def collect_units(node, cls: Optional[str], prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                collect_units(child, child.name, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                units.append((qual, cls, child))
+                collect_units(child, cls, qual)
+            else:
+                collect_units(child, cls, prefix)
+
+    collect_units(tree, None, "")
+
+    writes_by_class: Dict[str, Dict[str, List[Tuple]]] = {}
+    for qual, cls, fn in units:
+        sink = writes_by_class.setdefault(cls, {}) if cls else {}
+        _FnWalker(fa, qual, cls, fn, sink).walk()
+    _propagate_calls(fa)
+    ctx = _lock_context(fa)
+    if ctx:   # bare writes inside caller-holds-the-lock helpers are
+        #       guarded in every real execution
+        for attrs in writes_by_class.values():
+            for attr, evs in attrs.items():
+                attrs[attr] = [(g or q in ctx, q, ln)
+                               for g, q, ln in evs]
+    fa.findings.extend(_lk03_findings(
+        fa, {c: w for c, w in writes_by_class.items() if c}))
+    _lint_threads(tree, fa)
+    return fa
+
+
+def lint_source(src: str, path: str) -> List[Finding]:
+    """Single-file convenience: local findings + cycles within the
+    file's own graph (the CLI aggregates graphs across files)."""
+    fa = analyze_source(src, path)
+    findings = fa.findings + _cycle_findings(fa.edges, fa.lock_kinds)
+    return _assign_occurrences(findings)
+
+
+def lint_paths(paths: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    edges: List[_Edge] = []
+    kinds: Dict[str, str] = {}
+    for root in paths:
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        for f in sorted(files):
+            rel = os.path.relpath(f, REPO)
+            try:
+                with open(f, encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError as e:
+                print(f"conc_lint: cannot read {rel}: {e}",
+                      file=sys.stderr)
+                continue
+            try:
+                fa = analyze_source(src, rel)
+            except SyntaxError as e:
+                findings.append(Finding(rel, e.lineno or 0, "SYN", "?",
+                                        "syntax", f"syntax error: {e}"))
+                continue
+            findings.extend(fa.findings)
+            edges.extend(fa.edges)
+            kinds.update(fa.lock_kinds)
+    findings.extend(_cycle_findings(edges, kinds))
+    return _assign_occurrences(findings)
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Baseline keys; a trailing ``# justification`` per line is
+    stripped (and required by review policy).  Keys never contain
+    ``#``, so the comment starts at the first `` #`` regardless of
+    how many spaces precede it."""
+    if not os.path.exists(path):
+        return set()
+    out = set()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            out.add(line.split(" #", 1)[0].strip())
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO, "paddle_tpu")])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-seed the suppression list from current "
+                         "findings (review each, add a justification)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding (exit 1 if any)")
+    args = ap.parse_args(argv)
+
+    findings = lint_paths(args.paths)
+    if args.write_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write("# conc_lint baseline — reviewed findings "
+                    "suppressed in CI.\n"
+                    "# Every entry MUST carry a trailing "
+                    "'  # justification'.\n"
+                    "# Regenerate (after review!) with: "
+                    "python tools/conc_lint.py --write-baseline\n")
+            for k in sorted({fi.key() for fi in findings}):
+                f.write(k + "  # TODO: justify or fix\n")
+        print(f"conc_lint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(args.baseline, REPO)}")
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    new = [f for f in findings if f.key() not in baseline]
+    for f in findings:
+        tag = "" if f.key() in baseline else "  <-- NEW"
+        print(f"{f!r}{tag}")
+    print(f"conc_lint: {len(findings)} finding(s), "
+          f"{len(findings) - len(new)} baseline-suppressed, "
+          f"{len(new)} new")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,10 +13,15 @@ python -m pytest tests/test_profiler.py -q
 # Static-analysis gates: (1) the framework AST linter must stay clean
 # against its baseline (tools/framework_lint_baseline.txt — new
 # findings fail, pre-existing ones are suppressed explicitly); (2) the
-# verifier-on-golden-programs check — test_passes.py mutates the golden
-# programs from test_static_graph.py and asserts every defect class is
-# caught with the op and var named.
+# concurrency linter (conc-san static side): lock-order cycles,
+# blocking-under-lock, bare writes to guarded attributes, and unjoined
+# non-daemon threads — same baseline discipline, every suppressed
+# finding carries a justification; (3) the verifier-on-golden-programs
+# check — test_passes.py mutates the golden programs from
+# test_static_graph.py and asserts every defect class is caught with
+# the op and var named.
 python tools/framework_lint.py
+python tools/conc_lint.py
 python -m pytest tests/test_passes.py -q
 # Fault-tolerance chaos gate: a supervised Model.fit run under a fixed
 # chaos spec (one injected checkpoint-write failure + delayed store
@@ -49,4 +54,14 @@ python tools/cache_gate.py
 # total XLA compiles bounded by the prompt-bucket count (+1 decode
 # executable) — the per-token-retrace failure mode stays pinned shut.
 python tools/decode_gate.py
+# Concurrency-sanitizer gate (conc-san runtime side): the serving,
+# decode, and pipeline soaks re-run with FLAGS_lock_san=1 (plus a
+# threaded-DataLoader + async-checkpoint loader soak that engages the
+# io/ckpt locks the pipeline contract can't) — the instrumented locks
+# must record zero acquisition-order cycles and zero holds over
+# threshold across real concurrent traffic, every leg must clear its
+# engagement floor, and every gate's own bit-exactness/chaos/
+# compile-bound assertions must still hold with the sanitizer in the
+# lock path.
+python tools/conc_gate.py
 exec python -m pytest tests/ -q --runslow "$@"
