@@ -21,6 +21,17 @@ tree and rejects torn or corrupt checkpoints with
 :class:`CheckpointCorruptError`; :class:`AsyncCheckpointer.restore`
 quarantines corrupt steps and falls back to the newest intact one, and
 its GC never deletes the last verified step.
+
+Elastic-resume layer (manifest **v2**): the manifest additionally
+records the save-time world size, mesh shape, and a per-leaf sharding
+layout (pytree path, shape, dtype, PartitionSpec).  That makes a tree
+saved at world N restorable at world M without the caller knowing the
+source topology: ``load_state(path, reshard_mesh=mesh)`` rebuilds the
+tree skeleton from the recorded layout and re-places every leaf onto
+the new mesh — replicated state broadcasts, DP/ZeRO-sharded state
+re-partitions along the same axis names (dims the new world no longer
+divides degrade to replicated).  v1 manifests still load through every
+non-reshard path; only the automatic reshard needs v2.
 """
 from __future__ import annotations
 
@@ -44,11 +55,13 @@ from ..profiler import metrics as _metrics
 
 __all__ = ["save_state", "load_state", "save_layer", "load_layer",
            "AsyncCheckpointer", "wait_all", "verify_checkpoint",
-           "checkpoint_metadata", "CheckpointCorruptError",
-           "MANIFEST_NAME", "COMMITTED_NAME"]
+           "checkpoint_metadata", "derive_rank_seed",
+           "CheckpointCorruptError", "MANIFEST_NAME", "COMMITTED_NAME",
+           "MANIFEST_FORMAT"]
 
 MANIFEST_NAME = "_paddle_manifest.json"
 COMMITTED_NAME = "_PADDLE_COMMITTED"
+MANIFEST_FORMAT = 2   # v2: world_size / mesh_shape / per-leaf layout
 
 _pending = []
 _plock = _conc.Lock(name="ckpt.pending", lazy=True)
@@ -105,7 +118,90 @@ def _walk_files(root: str):
             yield os.path.relpath(full, root), full
 
 
-def _write_manifest(root: str, step: Optional[int]) -> str:
+def _current_world() -> int:
+    """The data-parallel world this process believes it is part of:
+    the launcher's PADDLE_TRAINERS_NUM when set, else jax's process
+    count (1 for a solo run)."""
+    try:
+        return int(os.environ["PADDLE_TRAINERS_NUM"])
+    except (KeyError, ValueError):
+        return jax.process_count()
+
+
+def derive_rank_seed(base_seed: int, rank: int) -> int:
+    """Deterministic per-rank RNG seed for a cross-world resume.
+
+    Rank 0 keeps the checkpointed seed (a shrink-to-one resume replays
+    the base stream); every other rank folds its NEW rank id in,
+    crc32-keyed so the derivation is identical across processes and
+    interpreter salts.  The old per-rank streams can't be reused
+    verbatim: after a world change the rank-to-host mapping rotates,
+    and two survivors restoring trees saved by different old ranks must
+    not end up cloning one stream."""
+    rank = int(rank)
+    if rank == 0:
+        return int(base_seed)
+    import zlib
+    fold = zlib.crc32(f"paddle_tpu.rank.{rank}".encode()) * 0x9E3779B1
+    return (int(base_seed) ^ fold) & ((1 << 63) - 1)
+
+
+def _tree_layout(tree) -> Dict[str, Any]:
+    """Manifest-v2 metadata for ``tree``: save-time world size, mesh
+    shape, and one layout entry per leaf (pytree path as a JSON list of
+    dict keys / sequence indices, shape, dtype, PartitionSpec or None
+    for replicated/host leaves).  ``load_state(reshard_mesh=...)``
+    rebuilds the restore skeleton from exactly this record."""
+    import jax.tree_util as jtu
+    entries = []
+    mesh_shape = None
+    mesh_devices = 0
+    for path, leaf in jtu.tree_flatten_with_path(tree)[0]:
+        keys: Optional[list] = []
+        for p in path:
+            if isinstance(p, jtu.DictKey):
+                keys.append(str(p.key))
+            elif isinstance(p, jtu.SequenceKey):
+                keys.append(int(p.idx))
+            else:   # attr/flattened-custom nodes: not rebuildable
+                keys = None
+                break
+        spec = None
+        sh = getattr(leaf, "sharding", None)
+        if sh is not None and hasattr(sh, "spec") and \
+                getattr(sh, "mesh", None) is not None:
+            raw = tuple(sh.spec)
+            if any(e is not None for e in raw):
+                spec = [list(e) if isinstance(e, (tuple, list)) else e
+                        for e in raw]
+                mesh_shape = {str(k): int(v)
+                              for k, v in dict(sh.mesh.shape).items()}
+                mesh_devices = max(mesh_devices, int(sh.mesh.devices.size))
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            # plain Python scalars (int/float step counters) have no
+            # array protocol but orbax still stores them — record the
+            # numpy view so the reshard path can rebuild them
+            try:
+                arr = np.asarray(leaf)
+                shape, dtype = arr.shape, arr.dtype
+            except Exception:
+                shape, dtype = (), None
+        entries.append({
+            "path": keys,
+            "key": jtu.keystr(path),
+            "shape": [int(s) for s in shape],
+            "dtype": str(dtype) if dtype is not None else None,
+            "spec": spec,
+        })
+    world = mesh_devices if mesh_devices else _current_world()
+    return {"world_size": int(world), "mesh_shape": mesh_shape,
+            "layout": entries}
+
+
+def _write_manifest(root: str, step: Optional[int],
+                    extra: Optional[Dict[str, Any]] = None) -> str:
     """Hash every data file under ``root`` and write the manifest.
     Returns the manifest's own sha256 (recorded in the commit marker)."""
     files = {}
@@ -114,12 +210,14 @@ def _write_manifest(root: str, step: Optional[int]) -> str:
                       "sha256": _hash_file(full)}
         _fsync_file(full)  # data durable before the manifest claims it
     manifest = {
-        "format": 1,
+        "format": MANIFEST_FORMAT,
         "framework": "paddle_tpu",
         "step": None if step is None else int(step),
         "created": time.time(),
         "files": files,
     }
+    if extra:
+        manifest.update(extra)
     mpath = os.path.join(root, MANIFEST_NAME)
     blob = json.dumps(manifest, indent=1, sort_keys=True).encode()
     with open(mpath, "wb") as f:
@@ -130,7 +228,7 @@ def _write_manifest(root: str, step: Optional[int]) -> str:
 
 
 def _commit(tmp: str, final: str, *, step: Optional[int],
-            overwrite: bool):
+            overwrite: bool, extra: Optional[Dict[str, Any]] = None):
     """tmp dir -> fsync -> rename -> COMMITTED marker (the atomic-commit
     sequence; a crash at any point leaves either the old checkpoint, an
     intact tree stranded at ``final + '.old'``, or a detectably-
@@ -138,7 +236,7 @@ def _commit(tmp: str, final: str, *, step: Optional[int],
     processes race the commit of one shared tree (multi-host writers on
     a shared filesystem), the first rename wins and the losers return
     once they see the winner's marker."""
-    manifest_sha = _write_manifest(tmp, step)
+    manifest_sha = _write_manifest(tmp, step, extra)
     _fsync_dir(tmp)
     aside = None
     if os.path.exists(final):
@@ -234,7 +332,8 @@ def checkpoint_metadata(path: str) -> Optional[Dict[str, Any]]:
     except (OSError, json.JSONDecodeError):
         return None
     return {k: manifest.get(k)
-            for k in ("step", "framework", "format", "created")}
+            for k in ("step", "framework", "format", "created",
+                      "world_size", "mesh_shape")}
 
 
 # ---------------------------------------------------------------------------
@@ -276,11 +375,14 @@ def save_state(path: str, tree: Dict[str, Any], *, overwrite: bool = True,
         raise FileExistsError(path)
     _flush_pending(path)   # a prior async save to this path must land
     tmp = _tmp_path(path)  # first — the commit tmp tree is shared
+    # manifest-v2 metadata is read off the ORIGINAL arrays (their
+    # shardings are gone once orbax has written host bytes)
+    extra = _tree_layout(tree)
     if use_async:
         ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         ckptr.save(tmp, args=ocp.args.StandardSave(tree), force=True)
         with _plock:
-            _pending.append((ckptr, tmp, path, step, overwrite))
+            _pending.append((ckptr, tmp, path, step, overwrite, extra))
         return ckptr
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(tmp, tree, force=True)
@@ -288,14 +390,14 @@ def save_state(path: str, tree: Dict[str, Any], *, overwrite: bool = True,
     # "sync" save really means the checkpoint is on disk
     ckptr.wait_until_finished()
     ckptr.close()
-    _commit(tmp, path, step=step, overwrite=overwrite)
+    _commit(tmp, path, step=step, overwrite=overwrite, extra=extra)
     return None
 
 
 def _finalize(entry):
-    ckptr, tmp, path, step, overwrite = entry
+    ckptr, tmp, path, step, overwrite, extra = entry
     ckptr.wait_until_finished()
-    _commit(tmp, path, step=step, overwrite=overwrite)
+    _commit(tmp, path, step=step, overwrite=overwrite, extra=extra)
 
 
 def _flush_pending(path: str):
@@ -326,18 +428,106 @@ def wait_all():
         raise first_err
 
 
+def _read_manifest(path: str) -> Dict[str, Any]:
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path}: unreadable manifest ({e})") from None
+
+
+def _insert_path(root, path, value):
+    """Place ``value`` into the nested dict/list skeleton at ``path``
+    (str entries are dict keys, int entries are list indices)."""
+    node = root
+    for i, key in enumerate(path):
+        last = i == len(path) - 1
+        child_is_seq = not last and isinstance(path[i + 1], int)
+        if isinstance(key, int):
+            while len(node) <= key:
+                node.append(None)
+            if last:
+                node[key] = value
+            else:
+                if node[key] is None:
+                    node[key] = [] if child_is_seq else {}
+                node = node[key]
+        else:
+            if last:
+                node[key] = value
+            else:
+                node = node.setdefault(key, [] if child_is_seq else {})
+
+
+def _load_resharded(path: str, reshard_mesh, *, verify: bool):
+    """The manifest-v2 reshard path: rebuild the saved tree's skeleton
+    from the recorded per-leaf layout as sharding-annotated
+    ShapeDtypeStructs on ``reshard_mesh`` and restore onto it.
+    Replicated leaves broadcast to the new mesh; leaves recorded with a
+    PartitionSpec re-partition along the same axis names (axes the new
+    mesh lacks, or that no longer divide the dim, degrade to
+    replicated)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from .parallel import clean_partition_spec
+    ocp = _ocp()
+    path = os.path.abspath(path)
+    if verify:
+        verify_checkpoint(path)
+    manifest = _read_manifest(path)
+    layout = manifest.get("layout")
+    if int(manifest.get("format") or 1) < 2 or not layout:
+        raise ValueError(
+            f"checkpoint {path} carries a v{manifest.get('format', 1)} "
+            f"manifest with no per-leaf sharding layout — it predates "
+            f"manifest v2, so automatic resharding has no source record; "
+            f"pass an explicit template (+ shardings) to load_state "
+            f"instead")
+    bad = [e.get("key") for e in layout
+           if e.get("path") is None or not e.get("dtype")]
+    if bad:
+        raise ValueError(
+            f"checkpoint {path}: layout entries {bad} are not "
+            f"rebuildable (non-dict/list pytree path or unknown leaf "
+            f"dtype); pass an explicit template (+ shardings) to "
+            f"load_state instead")
+    root: Any = [] if isinstance(layout[0]["path"][0], int) else {}
+    for e in layout:
+        spec = e.get("spec")
+        pspec = clean_partition_spec(
+            [tuple(s) if isinstance(s, list) else s for s in spec],
+            reshard_mesh, shape=e["shape"]) if spec else P()
+        sds = jax.ShapeDtypeStruct(
+            tuple(e["shape"]), np.dtype(e["dtype"]),
+            sharding=NamedSharding(reshard_mesh, pspec))
+        _insert_path(root, e["path"], sds)
+    return ocp.StandardCheckpointer().restore(path, root)
+
+
 def load_state(path: str, template: Optional[Dict[str, Any]] = None,
                shardings: Optional[Dict[str, Any]] = None, *,
-               verify: bool = False):
+               verify: bool = False, reshard_mesh=None):
     """Restore a pytree.  `template` (a matching pytree of arrays or
     ShapeDtypeStructs) drives dtype/shape; `shardings` (same structure of
     NamedSharding) re-places shards onto the target mesh — pass the
     current mesh's shardings to restore a checkpoint written on a
     different topology (elastic resume).
 
+    ``reshard_mesh`` is the template-free version of that: the tree
+    skeleton AND source layout come from the manifest-v2 record written
+    at save time, and every leaf is re-placed onto the given mesh —
+    replicated state broadcasts, sharded state re-partitions.  Requires
+    a v2 manifest (raises ValueError on v1 trees, which predate the
+    layout record).
+
     With ``verify=True`` the tree is checked against its checksum
     manifest first and torn/corrupt checkpoints raise
     :class:`CheckpointCorruptError` instead of loading garbage."""
+    if reshard_mesh is not None:
+        if shardings is not None:
+            raise ValueError("pass either shardings= or reshard_mesh=, "
+                             "not both")
+        return _load_resharded(path, reshard_mesh, verify=verify)
     ocp = _ocp()
     path = os.path.abspath(path)
     if verify:
@@ -432,6 +622,9 @@ class AsyncCheckpointer:
         self._futures = []
         self._last_requested: Optional[int] = None
         self.last_error: Optional[BaseException] = None
+        # manifest metadata (step / world_size / mesh_shape) of the tree
+        # the most recent restore() actually loaded
+        self.last_restored_meta: Optional[Dict[str, Any]] = None
 
     # -- paths -------------------------------------------------------------
     def _step_dir(self, step: int) -> str:
@@ -559,6 +752,39 @@ class AsyncCheckpointer:
         warnings.warn(f"checkpoint step {step} failed verification "
                       f"({err}); quarantined under {qroot}")
 
+    def _surface_meta(self, step: int, *, template, shardings):
+        """Record + announce the manifest metadata of the step about to
+        be restored (``last_restored_meta``), and refuse a blind restore
+        of a tree that NEEDS resharding: a v2 manifest that records a
+        different world size (or an actually-sharded layout) cannot be
+        restored faithfully without a template/shardings — failing here
+        with the source topology named beats handing back arrays whose
+        placement silently no longer matches the job."""
+        meta = checkpoint_metadata(self._step_dir(step)) or {}
+        meta.setdefault("step", step)
+        self.last_restored_meta = meta
+        fmt = int(meta.get("format") or 1)
+        world = meta.get("world_size")
+        mesh = meta.get("mesh_shape")
+        warnings.warn(
+            f"checkpoint restore: step {meta.get('step')} from "
+            f"{self.directory} (manifest v{fmt}"
+            + (f", saved at world {world}" if world is not None else "")
+            + (f", mesh {mesh}" if mesh else "") + ")")
+        if template is not None or shardings is not None or fmt < 2:
+            return
+        cur = _current_world()
+        if mesh or (world is not None and int(world) != cur):
+            raise ValueError(
+                f"checkpoint step {meta.get('step')} under "
+                f"{self.directory} was saved at world {world}"
+                + (f" on mesh {mesh}" if mesh else "")
+                + f" but this process runs at world {cur}: the tree "
+                f"needs resharding, which a template-less restore "
+                f"can't express — pass template=/shardings=, or use "
+                f"checkpoint.load_state(path, reshard_mesh=...) for "
+                f"the automatic manifest-v2 reshard path")
+
     def restore(self, step: Optional[int] = None,
                 template: Optional[Dict[str, Any]] = None,
                 shardings: Optional[Dict[str, Any]] = None, *,
@@ -566,8 +792,13 @@ class AsyncCheckpointer:
         """Restore ``step`` (or, when None, the newest step that passes
         verification — corrupt/torn steps are quarantined and skipped).
         Raises :class:`CheckpointCorruptError` when nothing intact
-        remains."""
+        remains.  The restored step's manifest metadata (step, world
+        size, mesh shape) is logged and kept on
+        ``self.last_restored_meta`` so a resumed run states what it
+        restored and from which world."""
         if step is not None:
+            self._surface_meta(int(step), template=template,
+                               shardings=shardings)
             return load_state(self._step_dir(step), template, shardings,
                               verify=verify)
         candidates = sorted(self._step_dirs(), reverse=True)
@@ -578,6 +809,7 @@ class AsyncCheckpointer:
                 except CheckpointCorruptError as e:
                     self._quarantine(s, e)
                     continue
+            self._surface_meta(s, template=template, shardings=shardings)
             return load_state(self._step_dir(s), template, shardings,
                               verify=False)
         raise CheckpointCorruptError(

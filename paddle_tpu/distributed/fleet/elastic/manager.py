@@ -294,7 +294,24 @@ class TCPStore(Store):
 
     def _call_once(self, req: dict):
         if _chaos.active:
-            _chaos.hit("store.rpc", exc=ConnectionRefusedError)
+            # store.partition: a deterministic window (fail@n-m) where
+            # every control-plane RPC dies as if the network dropped —
+            # distinct site so partitions compose with per-call
+            # store.rpc schedules.  BOTH sites count every RPC even
+            # when the other fires (a raise must not stall the
+            # sibling's call counter, or combined schedules would land
+            # on different RPCs than the spec says); the raised errors
+            # are in the retry class, so bounded windows are ridden
+            # out like real blips.
+            err = None
+            for site, exc in (("store.rpc", ConnectionRefusedError),
+                              ("store.partition", ConnectionResetError)):
+                try:
+                    _chaos.hit(site, exc=exc)
+                except Exception as e:  # noqa: BLE001 — raised below
+                    err = err if err is not None else e
+            if err is not None:
+                raise err
         data = json.dumps(req).encode() + b"\n"
         if len(data) > _KV_MAX_LINE:
             raise ValueError(f"KV request of {len(data)} bytes exceeds "

@@ -13,8 +13,8 @@ the CPU-simulation path, where each process gets an
 (reference TestDistBase's localhost multi-process cluster).
 
 Supervisor mode (``--supervise``, TorchElastic-style): the launcher
-heartbeats workers through the elastic ``Store`` (workers put TTL'd
-step counters under ``/paddle/supervise/<job>/<rank>`` — hapi
+heartbeats workers through the elastic ``Store`` (workers put step
+payloads under ``/paddle/supervise/<job>/g<generation>/<rank>`` — hapi
 ``Model.fit`` does this automatically when ``PADDLE_SUPERVISE_STORE``
 is set), detects both crashes (nonzero exit) and hung steps (no
 heartbeat advance within ``FLAGS_watchdog_timeout``), kills the gang,
@@ -22,6 +22,23 @@ bumps ``PADDLE_RESTART_GENERATION``, and relaunches up to
 ``--max_restarts`` times.  Workers are expected to resume from the
 newest intact checkpoint (``AsyncCheckpointer.restore``), so a restart
 costs re-execution since the last commit, not the whole run.
+
+Elastic supervise (``--supervise --np MIN:MAX``): the degraded-but-
+running mode.  When a failure looks like a *lost host* — death by
+signal, a watchdog stall, or (under ``--evict_stragglers``) a rank
+whose per-step wall time exceeds ``FLAGS_straggler_factor`` x the gang
+median for ``FLAGS_straggler_patience`` consecutive heartbeat samples
+— the supervisor runs a store-based rendezvous round (generation-
+prefixed TTL lease keys, so stale ranks from prior generations can't
+join), drops the lost host's slot onto a rendezvous denylist, and
+relaunches with whatever world size survives within ``[MIN, MAX]``.
+Shrink-relaunches do NOT consume the ``--max_restarts`` budget:
+degradation is not failure.  A plain software crash (nonzero exit
+code) keeps the full world and spends the budget as before.  Workers
+learn the new world through the standard ``PADDLE_TRAINERS_NUM`` /
+``PADDLE_TRAINER_ID`` env contract; cross-world checkpoint resume is
+``distributed.checkpoint``'s manifest-v2 reshard path + ``Model.fit``'s
+sample-exact replay-offset recompute.
 """
 from __future__ import annotations
 
@@ -30,14 +47,112 @@ import json
 import os
 import shlex
 import signal
+import statistics
 import subprocess
 import sys
 import time
+from collections import deque
 
-# single source of truth for the relaunch protocol
-from .fleet.elastic.manager import ELASTIC_EXIT_CODE  # noqa: E402
+# single source of truth for the relaunch protocol + np parsing
+from .fleet.elastic.manager import ELASTIC_EXIT_CODE, _parse_np  # noqa: E402
 
 SUPERVISE_PREFIX = "/paddle/supervise/"
+RDZV_PREFIX = "/paddle/rendezvous/"
+
+
+def heartbeat_key(job: str, generation, rank) -> str:
+    """The generation-prefixed supervise heartbeat key.  Scoping the key
+    to the restart generation means a slow-dying worker from generation
+    N keeps writing under ``g<N>/`` — invisible to the generation-N+1
+    watchdog, which lists only its own prefix (and the supervisor also
+    deletes prior-generation keys at each relaunch)."""
+    return f"{SUPERVISE_PREFIX}{job}/g{generation}/{rank}"
+
+
+def _parse_beat(value):
+    """Decode one heartbeat payload: JSON ``{"step": s, "dt": secs}``
+    (v2, ``dt`` = mean per-step wall time since the previous beat) or a
+    bare step token (v1 / hand-rolled scripts).  Returns
+    ``(step_token, dt_or_None)``."""
+    if isinstance(value, str) and value[:1] == "{":
+        try:
+            d = json.loads(value)
+            if isinstance(d, dict) and "step" in d:
+                dt = d.get("dt")
+                return d["step"], (float(dt) if dt is not None else None)
+        except (ValueError, TypeError):
+            pass
+    return value, None
+
+
+class StragglerTracker:
+    """Rolling per-rank step-time medians from heartbeat payloads.
+
+    Each fresh sample (a beat whose step advanced, carrying a ``dt``)
+    updates that rank's rolling median (window of 8).  The gang median
+    is the median of the *other* ranks' medians — excluding the
+    candidate keeps a 2-rank gang meaningful (with it included, a
+    2-rank median can never exceed 2x itself).  A rank whose median
+    exceeds ``factor`` x the gang median accrues one strike per fresh
+    sample, resets on a healthy sample, and is flagged once per
+    generation when strikes reach ``patience`` — counted as
+    ``launch.straggler`` and recorded for the supervise report.
+    Detection is pure bookkeeping; the eviction policy stays in the
+    supervisor loop."""
+
+    WINDOW = 8
+    MIN_SAMPLES = 2
+
+    def __init__(self, factor: float, patience: int, generation: int = 0):
+        self.factor = float(factor)
+        self.patience = max(1, int(patience))
+        self.generation = int(generation)
+        self.reports = []
+        self._times = {}
+        self._strikes = {}
+        self._samples = {}
+        self._flagged = set()
+
+    def observe(self, rank: str, dt: float):
+        """One fresh per-step wall-time sample for ``rank``.  Returns
+        the straggler report dict when this exact sample crosses the
+        patience threshold, else None."""
+        q = self._times.setdefault(rank, deque(maxlen=self.WINDOW))
+        q.append(float(dt))
+        self._samples[rank] = self._samples.get(rank, 0) + 1
+        if rank in self._flagged or len(q) < self.MIN_SAMPLES:
+            return None
+        meds = {r: statistics.median(t) for r, t in self._times.items()
+                if len(t) >= self.MIN_SAMPLES}
+        others = [m for r, m in meds.items() if r != rank]
+        if not others:
+            return None
+        gang = statistics.median(others)
+        mine = meds[rank]
+        if not (gang > 0 and mine > self.factor * gang):
+            self._strikes[rank] = 0
+            return None
+        self._strikes[rank] = self._strikes.get(rank, 0) + 1
+        if self._strikes[rank] < self.patience:
+            return None
+        self._flagged.add(rank)
+        report = {"generation": self.generation, "rank": str(rank),
+                  "median_s": round(mine, 6),
+                  "gang_median_s": round(gang, 6),
+                  "strikes": self._strikes[rank],
+                  "samples": self._samples[rank]}
+        self.reports.append(report)
+        from ..profiler import metrics as _metrics
+        _metrics.counter(
+            "launch.straggler",
+            "ranks whose rolling per-step median exceeded "
+            "FLAGS_straggler_factor x the gang median for "
+            "FLAGS_straggler_patience consecutive samples").inc()
+        print(f"launch: rank {rank} is a straggler — median step "
+              f"{mine:.3f}s vs gang {gang:.3f}s "
+              f"(factor {self.factor}, {report['strikes']} strikes over "
+              f"{report['samples']} samples)", file=sys.stderr)
+        return report
 
 
 def _parse_args(argv=None):
@@ -59,16 +174,35 @@ def _parse_args(argv=None):
                    help=f"relaunch the pod when a proc exits with code "
                         f"{ELASTIC_EXIT_CODE}")
     p.add_argument("--np", type=str, default=None,
-                   help="MIN:MAX elastic world bounds — each (re)launch "
-                        "sizes the pod to the live member count in the "
-                        "elastic store (PADDLE_ELASTIC_STORE_ROOT), like "
-                        "the reference's etcd-driven scale in/out")
+                   help="MIN:MAX elastic world bounds.  With --elastic: "
+                        "each (re)launch sizes the pod to the live "
+                        "member count in the elastic store "
+                        "(PADDLE_ELASTIC_STORE_ROOT), like the "
+                        "reference's etcd-driven scale in/out.  With "
+                        "--supervise: enables elastic supervise — a "
+                        "lost host (signal death / watchdog stall / "
+                        "evicted straggler) shrinks the relaunched "
+                        "world within these bounds instead of burning "
+                        "a restart on a gang that can't re-form")
     p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("--supervise", action="store_true",
                    help="babysit the gang: relaunch on ANY worker crash "
                         "or hung-step stall (watchdog over store "
                         "heartbeats), bumping PADDLE_RESTART_GENERATION "
-                        "each attempt, up to --max_restarts")
+                        "each attempt, up to --max_restarts; add "
+                        "--np MIN:MAX to relaunch elastically at the "
+                        "surviving world size (shrinks don't consume "
+                        "the restart budget)")
+    p.add_argument("--evict_stragglers", action="store_true",
+                   help="with --supervise --np MIN:MAX: when a rank's "
+                        "rolling median step time exceeds "
+                        "FLAGS_straggler_factor x the gang median for "
+                        "FLAGS_straggler_patience consecutive "
+                        "heartbeat samples, treat it as a stall — kill "
+                        "the gang and re-form WITHOUT that host via a "
+                        "rendezvous denylist entry (without this flag "
+                        "stragglers are only reported: launch.straggler "
+                        "metric + supervise report JSON)")
     p.add_argument("--watchdog_timeout", type=float, default=None,
                    help="seconds without heartbeat-step progress before "
                         "a worker counts as hung (default: "
@@ -77,13 +211,23 @@ def _parse_args(argv=None):
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
-    if args.supervise and args.elastic:
-        # the supervisor already relaunches on every failure; silently
-        # counting elastic-resize exits against its restart budget (and
-        # never resizing) would corrupt both protocols
-        p.error("--supervise and --elastic are mutually exclusive: "
-                "use --supervise for crash/hang recovery at fixed "
-                "world size, --elastic for membership-driven resizing")
+    if args.np:
+        try:
+            lo, hi = _parse_np(args.np)
+        except ValueError:
+            p.error(f"bad --np {args.np!r}: expected N or MIN:MAX")
+        if lo < 1 or hi < lo:
+            p.error(f"bad --np {args.np!r}: need 1 <= MIN <= MAX")
+    if args.supervise and args.elastic and not args.np:
+        # the historical exclusion, lifted into the unified mode: the
+        # supervisor CAN resize, but only with explicit world bounds
+        p.error("--supervise --elastic needs --np MIN:MAX: elastic "
+                "supervise relaunches at the surviving world size "
+                "within those bounds")
+    if args.evict_stragglers and not (args.supervise and args.np):
+        p.error("--evict_stragglers requires --supervise --np MIN:MAX "
+                "(eviction re-forms the gang one host smaller, which "
+                "needs elastic world bounds)")
     return args
 
 
@@ -204,61 +348,90 @@ class PodLauncher:
         self.log_files = []
 
     def supervise(self, store, job: str, watchdog: float,
-                  poll: float = 0.2):
-        """Babysit the gang: returns ("done", 0) when every worker exits
-        cleanly, ("crash", code) on the first nonzero exit, or
-        ("stall", rank_key) when a worker that has heartbeated stops
-        advancing its step for ``watchdog`` seconds.  Crash/stall kills
-        the whole gang (partial pods can't make progress — reference
-        launch.py terminate_local_procs).
+                  poll: float = 0.2, *, generation: int = 0,
+                  straggler=None, evict_stragglers: bool = False):
+        """Babysit the gang.  Returns ``(kind, detail, victim_rank)``:
+
+        - ``("done", 0, None)`` — every worker exited cleanly;
+        - ``("crash", rc, rank)`` — first nonzero exit (``rc < 0`` is
+          death by signal, which elastic supervise reads as host loss);
+        - ``("stall", key, rank)`` — a heartbeating worker stopped
+          advancing its step for ``watchdog`` seconds;
+        - ``("straggler", key, rank)`` — only with
+          ``evict_stragglers``: the ``straggler`` tracker flagged the
+          rank, so the gang is killed for an eviction re-form.
+
+        Crash/stall/eviction kills the whole gang (partial pods can't
+        make progress — reference launch.py terminate_local_procs).
+        Only heartbeat keys under THIS generation's prefix are read, so
+        a slow-dying worker from a prior generation can't feed this
+        watchdog.
 
         Stall detection is opt-in by construction: a worker that never
         writes a heartbeat (a script not using Model.fit) is only
         covered by crash detection — the watchdog can't distinguish
         "doesn't heartbeat" from "hung before the first beat", and
         killing every non-heartbeating script would be worse."""
-        last = {}  # heartbeat key -> (value, t_last_changed)
+        last = {}  # heartbeat key -> (step_token, t_last_changed)
         beat_t = 0.0
         a = self.args
         try:
             while True:
                 rcs = [p.poll() for p in self.procs]
-                bad = next((rc for rc in rcs if rc not in (None, 0)),
-                           None)
+                bad = next(((rc, i) for i, rc in enumerate(rcs)
+                            if rc not in (None, 0)), None)
                 if bad is not None:
                     self.stop()
-                    return "crash", bad
+                    return "crash", bad[0], a.host_rank * a.nproc + bad[1]
                 if all(rc == 0 for rc in rcs):
-                    return "done", 0
+                    return "done", 0, None
                 # a cleanly-exited worker's heartbeat stops advancing by
                 # definition — it must never trip the stall watchdog
                 done_ranks = {str(a.host_rank * a.nproc + local)
                               for local, rc in enumerate(rcs) if rc == 0}
                 now = time.monotonic()
-                if watchdog and store is not None and \
-                        now - beat_t >= poll:
+                if store is not None and now - beat_t >= poll and \
+                        (watchdog or straggler is not None):
                     beat_t = now
                     try:
                         beats = store.list_prefix(
-                            f"{SUPERVISE_PREFIX}{job}/")
+                            f"{SUPERVISE_PREFIX}{job}/g{generation}/")
                     except Exception:
                         beats = None   # store blip: skip this round
                     if beats is not None:
                         for k, v in beats.items():
-                            if last.get(k, (object(),))[0] != v:
-                                last[k] = (v, now)
-                        for k, (v, t) in last.items():
-                            if k.rsplit("/", 1)[-1] in done_ranks:
+                            step, dt = _parse_beat(v)
+                            prev = last.get(k)
+                            if prev is not None and prev[0] == step:
                                 continue
-                            if now - t > watchdog:
-                                print(f"launch: worker heartbeat {k} "
-                                      f"stuck at {v!r} for "
-                                      f"{now - t:.1f}s (watchdog "
-                                      f"{watchdog}s) — killing the "
-                                      f"gang", file=sys.stderr)
+                            last[k] = (step, now)
+                            rank = k.rsplit("/", 1)[-1]
+                            if straggler is None or dt is None or \
+                                    rank in done_ranks:
+                                continue
+                            rep = straggler.observe(rank, dt)
+                            if rep is not None and evict_stragglers:
+                                print(f"launch: evicting straggler "
+                                      f"rank {rank} — killing the gang "
+                                      f"to re-form without it",
+                                      file=sys.stderr)
                                 self.dump_stacks()
                                 self.stop()
-                                return "stall", k
+                                return "straggler", k, rank
+                        if watchdog:
+                            for k, (v, t) in last.items():
+                                rank = k.rsplit("/", 1)[-1]
+                                if rank in done_ranks:
+                                    continue
+                                if now - t > watchdog:
+                                    print(f"launch: worker heartbeat "
+                                          f"{k} stuck at {v!r} for "
+                                          f"{now - t:.1f}s (watchdog "
+                                          f"{watchdog}s) — killing the "
+                                          f"gang", file=sys.stderr)
+                                    self.dump_stacks()
+                                    self.stop()
+                                    return "stall", k, rank
                 time.sleep(poll)
         finally:
             self._close_logs()
@@ -336,10 +509,77 @@ def launch(argv=None):
         return code
 
 
+def _rendezvous_round(store, job: str, generation: int, slots,
+                      hi: int, ttl: float = 60.0):
+    """One store-based rendezvous round forming ``generation``'s gang:
+    read the denylist (``/paddle/rendezvous/<job>/deny/<slot>`` —
+    written when a host is evicted), grant every surviving slot up to
+    ``hi``, and claim a generation-prefixed TTL lease per granted slot
+    (``.../g<gen>/<slot>``).  The generation prefix is the fencing
+    token: a stale rank from a prior generation holds a lease under a
+    different prefix (which its TTL also expires), so it can never
+    count toward — or join — the new gang.  Store outages degrade to
+    the supervisor's local membership view: a rendezvous round never
+    blocks a relaunch.  Counted as ``launch.rendezvous_rounds``."""
+    from ..profiler import metrics as _metrics
+    _metrics.counter(
+        "launch.rendezvous_rounds",
+        "elastic-supervise rendezvous rounds (one per gang "
+        "formation)").inc()
+    deny = set()
+    try:
+        deny = {k.rsplit("/", 1)[-1] for k in
+                store.list_prefix(f"{RDZV_PREFIX}{job}/deny/")}
+    except Exception as e:
+        print(f"launch: rendezvous denylist unreadable ({e!r}); "
+              f"using the local membership view", file=sys.stderr)
+    granted = [s for s in slots if s not in deny][:max(1, int(hi))]
+    pfx = f"{RDZV_PREFIX}{job}/g{generation}/"
+    for s in granted:
+        try:
+            store.put(f"{pfx}{s}", "lease", ttl=ttl)
+        except Exception:
+            pass   # lease is the observable record, not the decision
+    return granted
+
+
+def _deny_slot(store, job: str, slot: str):
+    """Record an evicted host slot on the rendezvous denylist so no
+    later round re-admits it."""
+    try:
+        store.put(f"{RDZV_PREFIX}{job}/deny/{slot}", "denied")
+    except Exception as e:
+        print(f"launch: could not record denylist entry for {slot} "
+              f"({e!r}); supervisor-local eviction still holds",
+              file=sys.stderr)
+
+
+def _purge_stale_generations(store, job: str, generation: int):
+    """Delete heartbeat keys from generations before ``generation``.
+    Ignore-by-prefix in ``supervise`` is the correctness mechanism (a
+    slow-dying worker can rewrite its old key after this purge); the
+    delete is hygiene so the store doesn't accrete one key set per
+    restart."""
+    pfx = f"{SUPERVISE_PREFIX}{job}/"
+    keep = f"{pfx}g{generation}/"
+    try:
+        for k in store.list_prefix(pfx):
+            if not k.startswith(keep):
+                store.delete(k)
+    except Exception:
+        pass
+
+
 def _supervised_loop(args, tail, pod_ref):
     """Supervisor mode: spawn, babysit, and relaunch the gang until it
     completes or the restart budget is spent.  Each attempt runs with
-    PADDLE_RESTART_GENERATION set so workers know they are a resume."""
+    PADDLE_RESTART_GENERATION set so workers know they are a resume.
+
+    With ``--np MIN:MAX`` (elastic supervise) a lost host — death by
+    signal, watchdog stall, or evicted straggler — shrinks the next
+    generation's world within the bounds instead of consuming the
+    restart budget; a plain software crash (nonzero exit code) keeps
+    the world and spends the budget, as before."""
     from .fleet.elastic.manager import KVServer, store_from_spec
     from ..profiler import metrics as _metrics
     from ..utils import flags as _flags
@@ -347,6 +587,10 @@ def _supervised_loop(args, tail, pod_ref):
     watchdog = args.watchdog_timeout
     if watchdog is None:
         watchdog = _flags.get_flag("FLAGS_watchdog_timeout")
+    elastic = bool(args.np)
+    lo, hi = _parse_np(args.np) if elastic else (args.nproc, args.nproc)
+    if elastic:
+        args.nproc = max(lo, min(hi, args.nproc))
     job = os.environ.get("PADDLE_SUPERVISE_JOB",
                          f"job-{os.getpid()}")
     spec = os.environ.get("PADDLE_ELASTIC_STORE_ROOT")
@@ -358,28 +602,98 @@ def _supervised_loop(args, tail, pod_ref):
         spec = f"tcp://{server.endpoint}"
     store = store_from_spec(spec)
     interval = os.environ.get("PADDLE_HEARTBEAT_INTERVAL", "1.0")
-    restarts = 0
+    factor = _flags.get_flag("FLAGS_straggler_factor")
+    patience = _flags.get_flag("FLAGS_straggler_patience")
+    restarts = 0        # budget-consuming (same-world) restarts
+    shrinks = 0         # world-shrinking relaunches: NOT failures
+    generation = 0
+    rdzv_rounds = 0
+    # stable host-slot labels: rank numbering is contiguous per
+    # generation, but eviction identity must survive renumbering.
+    # Host-qualified so a multi-host job's shared deny prefix can't
+    # make host A's eviction of its slot 1 denylist every other
+    # host's slot 1 as well.
+    slots = [f"h{args.host_rank}-s{i}" for i in range(args.nproc)]
+    world_history = []
+    stragglers = []
     counter = _metrics.counter(
-        "launch.restarts", "supervised gang relaunches (crash or "
-        "watchdog stall)")
+        "launch.restarts", "supervised gang relaunches (crash, "
+        "watchdog stall, straggler eviction, or elastic shrink)")
     outcome = {"kind": "done", "code": 0}
     try:
         while True:
+            if elastic:
+                slots = _rendezvous_round(store, job, generation, slots,
+                                          hi)
+                rdzv_rounds += 1
+                if len(slots) < lo:
+                    print(f"launch: rendezvous formed only "
+                          f"{len(slots)} member(s), below the --np "
+                          f"floor {lo}; giving up", file=sys.stderr)
+                    outcome = {"kind": "underworld", "code": 1}
+                    return 1
+            args.nproc = len(slots) if elastic else args.nproc
+            world_history.append(args.nproc)
+            tracker = None
+            if factor and factor > 0:
+                tracker = StragglerTracker(factor, patience,
+                                           generation=generation)
             pod = PodLauncher(args, tail, extra_env={
                 "PADDLE_SUPERVISE_STORE": spec,
                 "PADDLE_SUPERVISE_JOB": job,
                 "PADDLE_HEARTBEAT_INTERVAL": str(interval),
-                "PADDLE_RESTART_GENERATION": str(restarts),
+                "PADDLE_RESTART_GENERATION": str(generation),
             })
             pod_ref["pod"] = pod
             pod.launch()
-            kind, detail = pod.supervise(store, job, watchdog)
+            kind, detail, victim = pod.supervise(
+                store, job, watchdog, generation=generation,
+                straggler=tracker,
+                evict_stragglers=args.evict_stragglers)
+            if tracker is not None:
+                stragglers.extend(tracker.reports)
             if kind == "done":
                 outcome = {"kind": "done", "code": 0}
                 return 0
+            # host-loss attribution: a signal death, a stall, or an
+            # evicted straggler means the HOST is gone/useless; a plain
+            # nonzero exit is a software crash on a healthy host
+            lost_host = kind in ("stall", "straggler") or \
+                (kind == "crash" and isinstance(detail, int) and
+                 detail < 0)
+            # map the victim's GLOBAL rank onto a slot THIS supervisor
+            # owns (rank = host_rank * nproc + local slot index); an
+            # unmappable victim (a remote host's rank in a multi-host
+            # pod, where only that host's supervisor can drop the
+            # slot) must fall through to the budgeted restart path —
+            # shrinking by a slot we don't own would loop forever
+            # without ever degrading the world
+            victim_slot = None
+            if elastic and lost_host and victim is not None:
+                try:
+                    vi = int(victim) - args.host_rank * args.nproc
+                except (TypeError, ValueError):
+                    vi = -1
+                if 0 <= vi < len(slots):
+                    victim_slot = slots[vi]
+            if victim_slot is not None and len(slots) - 1 >= lo:
+                _deny_slot(store, job, victim_slot)
+                slots = [s for s in slots if s != victim_slot]
+                shrinks += 1
+                generation += 1
+                counter.inc()
+                _purge_stale_generations(store, job, generation)
+                print(f"launch: worker {kind} ({detail}) read as host "
+                      f"loss — degrading to world {len(slots)} "
+                      f"(bounds {lo}:{hi}, slot {victim_slot} "
+                      f"denylisted; shrink-restarts don't consume "
+                      f"--max_restarts)", file=sys.stderr)
+                continue
             if restarts < args.max_restarts:
                 restarts += 1
+                generation += 1
                 counter.inc()
+                _purge_stale_generations(store, job, generation)
                 print(f"launch: worker {kind} ({detail}); supervised "
                       f"relaunch {restarts}/{args.max_restarts} "
                       f"(workers resume from the newest intact "
@@ -397,6 +711,13 @@ def _supervised_loop(args, tail, pod_ref):
             with open(report, "w") as f:
                 json.dump({"restarts": restarts,
                            "restarts_metric": counter.value,
+                           "shrinks": shrinks,
+                           "world": world_history[-1] if world_history
+                           else args.nproc,
+                           "world_history": world_history,
+                           "generation": generation,
+                           "rendezvous_rounds": rdzv_rounds,
+                           "stragglers": stragglers,
                            **outcome}, f)
         if server is not None:
             server.stop()

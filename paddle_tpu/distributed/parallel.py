@@ -30,12 +30,59 @@ from ..nn.layer_base import Layer
 from ..core.tensor import Tensor
 
 __all__ = ["DataParallel", "shard_batch", "input_sharding_fn",
-           "param_shardings", "apply_param_shardings", "scale_loss"]
+           "param_shardings", "apply_param_shardings", "scale_loss",
+           "mesh_for_world", "clean_partition_spec"]
 
 
 def _default_dp_mesh(axis: str = "dp") -> Mesh:
     devs = jax.devices()
     return Mesh(np.asarray(devs), (axis,))
+
+
+def mesh_for_world(world: int, axis: str = "dp", devices=None) -> Mesh:
+    """A 1-D mesh over the first ``world`` visible devices — the
+    target-mesh constructor for cross-world checkpoint resharding: a
+    tree saved at world N restores onto ``mesh_for_world(M)`` via
+    ``checkpoint.load_state(..., reshard_mesh=...)`` after an elastic
+    shrink or grow."""
+    devs = list(devices if devices is not None else jax.devices())
+    world = int(world)
+    if world < 1 or world > len(devs):
+        raise ValueError(f"world {world} out of range: {len(devs)} "
+                         f"devices visible")
+    return Mesh(np.asarray(devs[:world]), (axis,))
+
+
+def clean_partition_spec(spec, mesh: Mesh, shape=None) -> P:
+    """A PartitionSpec with entries the mesh can't honor dropped to
+    replicated: axis names the mesh doesn't have (e.g. an mp spec on a
+    pure-dp mesh), and — when ``shape`` is given — axes whose size no
+    longer divides the dim (a world change can leave a DP-sharded dim
+    indivisible; degrading that dim to replicated beats failing the
+    restore)."""
+    entries = tuple(spec) if not isinstance(spec, (list, tuple)) else spec
+    cleaned = []
+    for i, entry in enumerate(entries):
+        keep = entry
+        if entry is None:
+            cleaned.append(None)
+            continue
+        if isinstance(entry, (list, tuple)):
+            if not all(e in mesh.axis_names for e in entry):
+                keep = None
+            else:
+                keep = tuple(entry)
+        elif entry not in mesh.axis_names:
+            keep = None
+        if keep is not None and shape is not None and i < len(shape):
+            axes = keep if isinstance(keep, tuple) else (keep,)
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a]
+            if size and int(shape[i]) % size != 0:
+                keep = None
+        cleaned.append(keep)
+    return P(*cleaned)
 
 
 def shard_batch(arrays, mesh: Mesh, axis: str = "dp"):
@@ -91,17 +138,7 @@ def param_shardings(layer: Layer, mesh: Mesh) -> Dict[str, NamedSharding]:
     out = {}
     for name, p in layer.named_parameters():
         spec = p.placements if p.placements is not None else P()
-        # drop axes the mesh doesn't have (e.g. mp spec on a pure-dp mesh)
-        cleaned = []
-        for entry in (spec if isinstance(spec, tuple) else tuple(spec)):
-            if entry is None or entry in mesh.axis_names:
-                cleaned.append(entry)
-            elif (isinstance(entry, (list, tuple))
-                  and all(e in mesh.axis_names for e in entry)):
-                cleaned.append(tuple(entry))
-            else:
-                cleaned.append(None)
-        out[name] = NamedSharding(mesh, P(*cleaned))
+        out[name] = NamedSharding(mesh, clean_partition_spec(spec, mesh))
     return out
 
 

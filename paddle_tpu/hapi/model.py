@@ -464,7 +464,12 @@ class Model:
     # ------------------------------------------------------------------
     def _ckpt_tree(self, step_count: int):
         """(params, buffers, opt, rng, step) as one checkpointable tree —
-        everything a relaunched worker needs to continue bit-exactly."""
+        everything a relaunched worker needs to continue bit-exactly.
+        The meta leaf also records the data-parallel world and the
+        exact global sample count consumed, so a relaunch at a
+        DIFFERENT world size (elastic shrink/grow) can recompute its
+        replay offset by samples instead of by now-meaningless step
+        indices."""
         params, buffers = self.network.functional_state()
         opt = self._optimizer
         if getattr(opt, "_fn_state", None) is None:
@@ -474,15 +479,36 @@ class Model:
                 "opt": opt._fn_state,
                 "meta": {"step": np.int64(step_count),
                          "rng_seed": np.uint64(gen._seed),
-                         "rng_counter": np.uint64(gen._counter)}}
+                         "rng_counter": np.uint64(gen._counter),
+                         "world": np.int64(
+                             getattr(self, "_fit_data_world", 1)),
+                         "samples": np.int64(
+                             getattr(self, "_fit_samples_seen", 0)),
+                         # epoch-scoped counters: cross-world replay
+                         # must not compare sample totals ACROSS epochs
+                         # (DistributedBatchSampler ceil-pads each
+                         # epoch to a world-dependent total)
+                         "epoch": np.int64(
+                             getattr(self, "_fit_epoch", 0)),
+                         "samples_epoch": np.int64(
+                             getattr(self, "_fit_samples_epoch", 0))}}
 
-    def _fit_resume(self, checkpointer) -> Optional[int]:
+    def _fit_resume(self, checkpointer, data_world: Optional[int] = None):
         """Restore the newest intact checkpoint (corrupt steps are
-        quarantined by the checkpointer); returns the global step to
-        resume from, or None when nothing intact exists (cold start —
-        the live state is left untouched)."""
-        from ..distributed.checkpoint import CheckpointCorruptError
+        quarantined by the checkpointer); returns a dict of
+        ``{"step", "world", "samples"}`` describing the restored state,
+        or None when nothing intact exists (cold start — the live state
+        is left untouched).  ``world``/``samples`` are None for trees
+        written before manifest v2 (which still load via the legacy
+        meta template)."""
+        from ..distributed.checkpoint import (CheckpointCorruptError,
+                                              derive_rank_seed)
+        if data_world is None:
+            data_world = getattr(self, "_fit_data_world", 1)
         template = self._ckpt_tree(0)
+        legacy = dict(template)
+        legacy["meta"] = {k: template["meta"][k]
+                          for k in ("step", "rng_seed", "rng_counter")}
         try:
             restored = checkpointer.restore(template=template)
         except CheckpointCorruptError:
@@ -491,29 +517,77 @@ class Model:
                     "fit: no intact checkpoint survived verification; "
                     "starting from scratch")
             return None
+        except Exception:
+            # the manifest format decides whether this is a pre-v2 tree
+            # (whose meta lacks the new keys, so the full template
+            # mismatches the stored structure) or a v2 tree that failed
+            # for a real reason — only the former gets the legacy-shape
+            # retry (MIGRATION: v1 trees still load, sans cross-world
+            # replay recompute); masking a genuine v2 failure behind a
+            # legacy retry would bury the actual error
+            seen = getattr(checkpointer, "last_restored_meta", None) or {}
+            if int(seen.get("format") or 1) >= 2:
+                raise
+            try:
+                restored = checkpointer.restore(template=legacy)
+            except CheckpointCorruptError:
+                if checkpointer.all_steps():
+                    warnings.warn(
+                        "fit: no intact checkpoint survived "
+                        "verification; starting from scratch")
+                return None
         self.network.load_functional_state(restored["params"],
                                            restored["buffers"])
         self._optimizer._fn_state = restored["opt"]
         meta = restored["meta"]
+        old_world = int(meta["world"]) if "world" in meta else None
+        samples = int(meta["samples"]) if "samples" in meta else None
+        epoch = int(meta["epoch"]) if "epoch" in meta else 0
+        samples_epoch = int(meta["samples_epoch"]) \
+            if "samples_epoch" in meta else samples
         gen = default_generator
-        gen._seed = int(meta["rng_seed"])
+        if old_world is not None and old_world != data_world:
+            # cross-world resume: the rank<->host mapping has rotated,
+            # so each survivor re-derives its stream deterministically
+            # from its NEW rank instead of inheriting whichever old
+            # rank's stream happens to be in the restored tree
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            gen._seed = derive_rank_seed(int(meta["rng_seed"]), rank)
+        else:
+            gen._seed = int(meta["rng_seed"])
         gen._counter = int(meta["rng_counter"])
         gen._key = None
         self._rng_dev_cache = None     # device counter resyncs next step
         step = int(meta["step"])
         warnings.warn(f"fit: resumed from checkpoint at step {step} "
                       f"(generation "
-                      f"{os.environ.get('PADDLE_RESTART_GENERATION', '0')})")
-        return step
+                      f"{os.environ.get('PADDLE_RESTART_GENERATION', '0')}"
+                      + (f", saved at data-parallel world {old_world}"
+                         if old_world is not None else "") + ")")
+        # the DIRECTORY label the checkpointer restored from: may sit
+        # above meta["step"] when an earlier elastic resume offset the
+        # numbering (see _fit_save_offset in fit)
+        seen = getattr(checkpointer, "last_restored_meta", None) or {}
+        label = seen.get("step")
+        label = step if label is None else int(label)
+        return {"step": step, "world": old_world, "samples": samples,
+                "epoch": epoch, "samples_epoch": samples_epoch,
+                "label": label}
 
     def _make_heartbeat(self):
         """Supervised-launch heartbeat: when the launcher exported
-        PADDLE_SUPERVISE_STORE, put this rank's step counter under the
-        supervise prefix so the watchdog can tell progress from a hang.
-        Returns None (zero per-step cost) when unsupervised."""
+        PADDLE_SUPERVISE_STORE, put this rank's step payload under the
+        generation-prefixed supervise key so the watchdog can tell
+        progress from a hang — and, since the payload carries the mean
+        per-step wall time between beats, so the supervisor's straggler
+        detector can median step times across the gang.  The generation
+        prefix keeps a slow-dying worker from a prior generation from
+        feeding the current generation's watchdog.  Returns None (zero
+        per-step cost) when unsupervised."""
         spec = os.environ.get("PADDLE_SUPERVISE_STORE")
         if not spec:
             return None
+        import json as _json
         # supervised workers also install the SIGUSR1 thread-dump
         # handler: before the watchdog kills a stalled gang it signals
         # each worker, so the wedged one's log ends with every thread's
@@ -522,22 +596,32 @@ class Model:
         from ..utils import concurrency as _conc
         _conc.install_signal_dump()
         from ..distributed.fleet.elastic.manager import store_from_spec
-        from ..distributed.launch import SUPERVISE_PREFIX
+        from ..distributed.launch import heartbeat_key
         store = store_from_spec(spec)
-        key = (f"{SUPERVISE_PREFIX}"
-               f"{os.environ.get('PADDLE_SUPERVISE_JOB', 'default')}/"
-               f"{os.environ.get('PADDLE_TRAINER_ID', '0')}")
+        key = heartbeat_key(
+            os.environ.get("PADDLE_SUPERVISE_JOB", "default"),
+            os.environ.get("PADDLE_RESTART_GENERATION", "0"),
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
         interval = float(os.environ.get("PADDLE_HEARTBEAT_INTERVAL",
                                         "1.0"))
-        state = {"t": 0.0}
+        state = {"t": 0.0, "step": None}
 
         def beat(step):
             now = time.monotonic()
             if now - state["t"] < interval:
                 return
-            state["t"] = now
+            payload = {"step": step}
+            prev_t, prev_step = state["t"], state["step"]
+            if prev_t and isinstance(step, int) and \
+                    isinstance(prev_step, int) and step > prev_step:
+                # mean per-step wall time since the last beat — the
+                # straggler detector's input (int steps only: eval
+                # beats keep the watchdog fed but carry no timing)
+                payload["dt"] = round((now - prev_t) /
+                                      (step - prev_step), 6)
+            state["t"], state["step"] = now, step
             try:
-                store.put(key, str(step))
+                store.put(key, _json.dumps(payload))
             except Exception:
                 pass   # store blip: the TTL/watchdog slack absorbs it
 
@@ -580,7 +664,7 @@ class Model:
             if restored is not None:
                 warnings.warn(f"anomalous loss {value} at step "
                               f"{step_count}: rolled back to checkpoint "
-                              f"step {restored}")
+                              f"step {restored['step']}")
                 return
             warnings.warn("FLAGS_anomaly_action=rollback: no intact "
                           "checkpoint yet, reverting this step instead")
@@ -684,14 +768,63 @@ class Model:
         from ..utils import flags as _flags
         anomaly = _flags.get_flag("FLAGS_anomaly_action")
         heartbeat = self._heartbeat = self._make_heartbeat()
+        # data-parallel world of the DATA pipeline: >1 only when the
+        # loader actually shards the index space across ranks
+        # (DistributedBatchSampler) — replicated-data gangs train every
+        # sample on every rank, so their replay offsets are world-free
+        from ..io import DistributedBatchSampler
+        data_world = 1
+        bs = getattr(train_loader, "batch_sampler", None)
+        if isinstance(bs, DistributedBatchSampler):
+            data_world = int(bs.nranks)
+        self._fit_data_world = data_world
+        self._fit_samples_seen = 0
         start_step = 0
+        resume_samples = None
+        resume_epoch = 0
+        # checkpoint directory labels must stay monotonic across
+        # elastic resumes: a GROW renumbers step_count DOWNWARD on the
+        # new grid, and saving step 101 next to a stale old-world step
+        # 200 would make every later restore pick the pre-grow tree.
+        # The offset keeps labels strictly increasing while the tree's
+        # meta keeps the true new-grid step count for replay math.
+        self._fit_save_offset = 0
         if checkpointer is not None and self._optimizer is not None:
-            start_step = self._fit_resume(checkpointer) or 0
+            info = self._fit_resume(checkpointer, data_world)
+            if info is not None:
+                if info["world"] is not None and \
+                        info["world"] != data_world and \
+                        info["samples_epoch"] is not None:
+                    self._fit_save_offset = info["label"]
+                    # elastic world change: step indices from the old
+                    # world are meaningless here — replay completed
+                    # epochs wholesale (their padded sample totals are
+                    # world-dependent, so the counts don't transfer)
+                    # and skip WITHIN the saved epoch by global sample
+                    # count, so nothing is double-trained or silently
+                    # dropped
+                    resume_samples = info["samples_epoch"]
+                    resume_epoch = info["epoch"]
+                    warnings.warn(
+                        f"fit: resharded resume — checkpoint was taken "
+                        f"at data-parallel world {info['world']}, this "
+                        f"run is world {data_world}; replaying "
+                        f"{resume_epoch} completed epoch(s) plus "
+                        f"{resume_samples} already-trained global "
+                        f"samples instead of old-world step indices")
+                else:
+                    start_step = info["step"]
+                    # same-world: continue an existing label offset
+                    # (label == step means no offset ever applied)
+                    self._fit_save_offset = max(
+                        0, info["label"] - info["step"])
 
         cbks.on_train_begin()
         step_count = 0
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
+            self._fit_epoch = epoch
+            self._fit_samples_epoch = 0
             for m in self._metrics:
                 m.reset()
             logs = {}
@@ -713,13 +846,52 @@ class Model:
                     if trace:
                         _obs.on_step_phase("data_wait", _tw0)
                     step += 1
-                    if step_count < start_step:
+                    if resume_samples is not None:
+                        # cross-world resume: replay the data order,
+                        # counting GLOBAL samples (this rank's batch x
+                        # data world) until the checkpoint's consumed-
+                        # sample mark — the step grid of the old world
+                        # doesn't exist here.  Completed old-world
+                        # epochs replay WHOLESALE — their ceil-padded
+                        # sample totals are world-dependent, so the
+                        # counts don't transfer across epochs.
+                        if epoch > resume_epoch:
+                            # the saved epoch is exhausted on the new
+                            # grid (its old padded total can exceed the
+                            # new one); training resumes here
+                            resume_samples = None
+                        else:
+                            bl = _batch_len(self._split_batch(batch)[0]) \
+                                * data_world
+                            if epoch < resume_epoch or \
+                                    self._fit_samples_epoch + bl <= \
+                                    resume_samples:
+                                self._fit_samples_seen += bl
+                                self._fit_samples_epoch += bl
+                                step_count += 1
+                                continue
+                            if self._fit_samples_epoch < resume_samples:
+                                warnings.warn(
+                                    f"fit: resharded-resume boundary "
+                                    f"falls inside a batch — re-training "
+                                    f"{resume_samples - self._fit_samples_epoch}"
+                                    f" of {resume_samples} replayed "
+                                    f"samples (the old step boundary is "
+                                    f"not representable on the new "
+                                    f"world's batch grid)")
+                            resume_samples = None
+                    if resume_samples is None and \
+                            step_count < start_step:
                         # resumed run: this batch's update is already
                         # inside the restored state — replay the data
                         # order without re-training (shuffle must be
                         # deterministic/off for exact continuation, as
                         # in the reference resume)
                         step_count += 1
+                        bl = _batch_len(
+                            self._split_batch(batch)[0]) * data_world
+                        self._fit_samples_seen += bl
+                        self._fit_samples_epoch += bl
                         continue
                     cbks.on_train_batch_begin(step)
                     ins, lbls = self._split_batch(batch)
@@ -746,6 +918,8 @@ class Model:
                         _obs.on_hapi_step(_t0, num_samples=_batch_len(ins),
                                           mode="train")
                     step_count += 1
+                    self._fit_samples_seen += _batch_len(ins) * data_world
+                    self._fit_samples_epoch += _batch_len(ins) * data_world
                     if anomaly and "loss" in logs:
                         # guard mode materialises the loss at the
                         # producing step (its documented synchronous
@@ -755,16 +929,26 @@ class Model:
                             self._handle_anomaly(anomaly, v, step_count,
                                                  snap, checkpointer)
                             logs["loss"] = v
+                    if _chaos.active:
+                        # host.slow: deterministic per-rank slowdown of
+                        # the step loop (a 'delay' action stretches
+                        # this step's wall time, which the next beat's
+                        # dt payload then reports — the straggler-
+                        # detection test bed)
+                        _chaos.hit("host.slow")
                     if heartbeat is not None:
-                        # int step only — never touches the device
+                        # step + per-step wall time — never the device
                         heartbeat(step_count)
+                    save_label = step_count + self._fit_save_offset
                     if checkpointer is not None and (
                             not hasattr(checkpointer, "want_save")
-                            or checkpointer.want_save(step_count)):
+                            or checkpointer.want_save(save_label)):
                         # tree build + host snapshot only on steps the
                         # checkpointer will actually write; interval
-                        # steps stay sync-free
-                        checkpointer.save(step_count,
+                        # steps stay sync-free.  The directory label
+                        # carries the elastic offset; the tree's meta
+                        # records the true new-grid step count
+                        checkpointer.save(save_label,
                                           self._ckpt_tree(step_count))
                     # reference hapi: callbacks see the ACTUAL batch
                     # size so ips stays honest on the final partial

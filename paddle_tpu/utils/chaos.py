@@ -24,13 +24,20 @@ checkpoint write and delays store RPCs 2-4 by 500 ms.
 Registered sites (each costs ONE predicate read when no spec is set,
 matching the PR-1 instrumentation discipline)::
 
-    ckpt.write     distributed/checkpoint.py commit path
-    store.rpc      fleet/elastic/manager.py TCPStore._call
-    fs.rename      fleet/utils/fs.py LocalFS.mv/rename
-    loader.worker  io DataLoader sample fetch
-    step.loss      hapi Model train step (``nan`` poisons the loss)
-    serve.request  serving InferenceEngine admission (``fail`` rejects
-                   the request at submit, ``delay`` stalls the client)
+    ckpt.write       distributed/checkpoint.py commit path
+    store.rpc        fleet/elastic/manager.py TCPStore._call
+    store.partition  same RPC path, as a *network partition*: a
+                     ``fail@n-m`` window makes every store RPC fail
+                     (ConnectionResetError) until the window closes;
+                     rides the TCPStore retry path like a real blip
+    fs.rename        fleet/utils/fs.py LocalFS.mv/rename
+    loader.worker    io DataLoader sample fetch
+    step.loss        hapi Model train step (``nan`` poisons the loss)
+    host.slow        hapi Model.fit step loop (``delay`` stretches the
+                     selected rank's per-step wall time — the straggler-
+                     detection test bed)
+    serve.request    serving InferenceEngine admission (``fail`` rejects
+                     the request at submit, ``delay`` stalls the client)
 
 Injections are counted in the metrics registry: ``chaos.injected``
 (total) and ``chaos.injected.<site>``.
@@ -47,8 +54,8 @@ from . import flags as _flags
 __all__ = ["active", "ChaosError", "SITES", "parse_spec", "configure",
            "refresh", "hit", "call_count", "reset"]
 
-SITES = ("ckpt.write", "store.rpc", "fs.rename", "loader.worker",
-         "step.loss", "serve.request")
+SITES = ("ckpt.write", "store.rpc", "store.partition", "fs.rename",
+         "loader.worker", "step.loss", "host.slow", "serve.request")
 
 # module-level fast predicate — the single read hot paths gate on
 active = False

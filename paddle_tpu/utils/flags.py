@@ -140,9 +140,10 @@ define_flag("FLAGS_host_tracer_capacity", 1 << 20,
 define_flag("FLAGS_chaos_spec", "",
             "deterministic fault-injection spec, e.g. "
             "'ckpt.write:fail@3;store.rpc:delay=0.5@2-4' — named sites "
-            "(ckpt.write, store.rpc, fs.rename, loader.worker, "
-            "step.loss) fail/stall/poison on a seeded schedule; empty "
-            "means every site costs one predicate read (utils/chaos.py)")
+            "(ckpt.write, store.rpc, store.partition, fs.rename, "
+            "loader.worker, step.loss, host.slow, serve.request) "
+            "fail/stall/poison on a seeded schedule; empty means every "
+            "site costs one predicate read (utils/chaos.py)")
 define_flag("FLAGS_chaos_seed", 0,
             "seed for probabilistic chaos selectors (p=...); same seed "
             "+ same call pattern = same injection schedule")
@@ -192,6 +193,18 @@ define_flag("FLAGS_lock_hold_warn_ms", 200.0,
             "lock.long_hold) when any sanitizer lock is held longer "
             "than this many milliseconds — long critical sections "
             "serialize every waiter under load; 0 disables the check")
+define_flag("FLAGS_straggler_factor", 3.0,
+            "supervisor straggler detection (distributed.launch "
+            "--supervise): a rank whose rolling median per-step wall "
+            "time (reported in heartbeat payloads) exceeds this factor "
+            "x the gang median (median of the OTHER ranks' medians) "
+            "accrues one strike per fresh heartbeat sample; 0 disables "
+            "detection entirely")
+define_flag("FLAGS_straggler_patience", 3,
+            "consecutive straggler strikes before a rank is reported "
+            "(launch.straggler metric + supervise report JSON) and — "
+            "under launch --evict_stragglers — the gang is re-formed "
+            "without that host via a rendezvous denylist entry")
 define_flag("FLAGS_prefetch_to_device", 2,
             "default device-prefetch depth used by Model.fit's train "
             "loop (batches kept resident on device by the io "

@@ -1,0 +1,436 @@
+"""Cross-world checkpoint resharding (manifest v2) tests: the N→M
+matrix over replicated params + DP-sharded optimizer state, v1-manifest
+backward compatibility, AsyncCheckpointer restore metadata + the
+refuse-blind-reshard contract, deterministic per-rank RNG re-derivation,
+and sampler-resume parity (no duplicated / dropped samples) across an
+elastic shrink."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.parallel import (clean_partition_spec,
+                                             mesh_for_world)
+
+WORLDS = (1, 2, 4)
+
+
+def _make_tree(mesh):
+    """Replicated 'params' + DP-sharded (dim0 over 'dp') 'opt' moment
+    state, shapes divisible by every world in the matrix."""
+    rep = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P("dp"))
+    return {
+        "params": {"w": jax.device_put(
+            jnp.arange(32.0).reshape(8, 4), rep)},
+        "opt": {"m": jax.device_put(jnp.arange(8.0) * 0.5, dp),
+                "v": jax.device_put(
+                    jnp.arange(32.0).reshape(8, 4) * 0.25, dp)},
+        "meta": {"step": np.asarray(3, np.int32)},
+    }
+
+
+@pytest.mark.parametrize("n", WORLDS)
+@pytest.mark.parametrize("m", WORLDS)
+def test_reshard_matrix_bit_parity(tmp_path, n, m):
+    """save_state at world N, load_state(reshard_mesh=world M): every
+    leaf bit-identical to the never-interrupted reference, replicated
+    state broadcast, DP-sharded state re-partitioned onto the new dp
+    axis."""
+    src = mesh_for_world(n)
+    tree = _make_tree(src)
+    ref = jax.tree.map(lambda a: np.array(a), tree)
+    path = str(tmp_path / f"w{n}")
+    ckpt.save_state(path, tree, step=3)
+
+    dst = mesh_for_world(m)
+    back = ckpt.load_state(path, reshard_mesh=dst, verify=True)
+    flat_ref = jax.tree_util.tree_flatten_with_path(ref)[0]
+    flat_back = jax.tree_util.tree_flatten_with_path(back)[0]
+    assert [k for k, _ in flat_ref] == [k for k, _ in flat_back]
+    for (key, want), (_, got) in zip(flat_ref, flat_back):
+        np.testing.assert_array_equal(np.asarray(got), want, err_msg=str(key))
+    # placement contract on the new mesh
+    assert back["params"]["w"].sharding.spec == P()
+    assert back["opt"]["m"].sharding.spec == P("dp")
+    assert len(back["opt"]["m"].sharding.mesh.devices.flat) == m
+
+
+def test_reshard_indivisible_dim_degrades_to_replicated(tmp_path):
+    """A sharded dim the new world no longer divides restores
+    REPLICATED (with identical bytes) instead of failing the resume."""
+    src = mesh_for_world(2)
+    tree = {"s": jax.device_put(jnp.arange(10.0),
+                                NamedSharding(src, P("dp")))}
+    path = str(tmp_path / "indiv")
+    ckpt.save_state(path, tree)
+    back = ckpt.load_state(path, reshard_mesh=mesh_for_world(4))
+    np.testing.assert_array_equal(np.asarray(back["s"]), np.arange(10.0))
+    assert back["s"].sharding.spec == P(None)
+
+
+def test_manifest_v2_records_world_and_layout(tmp_path):
+    src = mesh_for_world(4)
+    path = str(tmp_path / "m")
+    ckpt.save_state(path, _make_tree(src), step=3)
+    man = json.load(open(os.path.join(path, ckpt.MANIFEST_NAME)))
+    assert man["format"] == ckpt.MANIFEST_FORMAT == 2
+    assert man["world_size"] == 4
+    assert man["mesh_shape"] == {"dp": 4}
+    by_path = {tuple(e["path"]): e for e in man["layout"]}
+    assert by_path[("opt", "m")]["spec"] == ["dp"]
+    assert by_path[("opt", "m")]["shape"] == [8]
+    assert by_path[("params", "w")]["spec"] is None
+    assert by_path[("meta", "step")]["dtype"] == "int32"
+    meta = ckpt.checkpoint_metadata(path)
+    assert meta["world_size"] == 4 and meta["mesh_shape"] == {"dp": 4}
+
+
+def _downgrade_to_v1(path):
+    """Rewrite a committed tree's manifest to the v1 shape (no layout /
+    world metadata) and re-pin the commit marker's manifest hash — i.e.
+    a genuine pre-v2 checkpoint."""
+    import hashlib
+    mpath = os.path.join(path, ckpt.MANIFEST_NAME)
+    man = json.load(open(mpath))
+    for k in ("layout", "world_size", "mesh_shape"):
+        man.pop(k, None)
+    man["format"] = 1
+    blob = json.dumps(man, indent=1, sort_keys=True).encode()
+    with open(mpath, "wb") as f:
+        f.write(blob)
+    cpath = os.path.join(path, ckpt.COMMITTED_NAME)
+    marker = json.load(open(cpath))
+    marker["manifest_sha256"] = hashlib.sha256(blob).hexdigest()
+    with open(cpath, "w") as f:
+        json.dump(marker, f)
+
+
+def test_v1_manifest_still_loads_but_cannot_reshard(tmp_path):
+    tree = {"w": jnp.arange(12.0).reshape(3, 4)}
+    path = str(tmp_path / "v1")
+    ckpt.save_state(path, tree, step=1)
+    _downgrade_to_v1(path)
+    # every non-reshard path still works, verification included
+    back = ckpt.load_state(path, tree, verify=True)
+    np.testing.assert_array_equal(np.asarray(back["w"]),
+                                  np.arange(12.0).reshape(3, 4))
+    meta = ckpt.checkpoint_metadata(path)
+    assert meta["format"] == 1 and meta["world_size"] is None
+    # the automatic reshard path refuses with the reason named
+    with pytest.raises(ValueError, match="predates\\s+manifest v2"):
+        ckpt.load_state(path, reshard_mesh=mesh_for_world(2))
+
+
+def test_async_checkpointer_surfaces_restore_metadata(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "mgr"), max_to_keep=2)
+    tree = {"w": jnp.ones((4,)), "meta": {"step": np.asarray(5)}}
+    assert mgr.save(5, tree)
+    mgr.wait_until_finished()
+    with pytest.warns(UserWarning, match="saved at world 2"):
+        back = mgr.restore(template=jax.tree.map(np.asarray, tree))
+    np.testing.assert_array_equal(np.asarray(back["w"]), 1.0)
+    meta = mgr.last_restored_meta
+    assert meta["step"] == 5 and meta["world_size"] == 2
+    assert meta["format"] == 2
+    mgr.close()
+
+
+def test_async_checkpointer_refuses_blind_cross_world_restore(
+        tmp_path, monkeypatch):
+    """Satellite: a template-less restore of a tree that needs
+    resharding (manifest world != this process's world) must refuse
+    with the source topology named, not hand back silently-misplaced
+    arrays."""
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "mgr"), max_to_keep=2)
+    tree = {"w": jnp.ones((4,))}
+    mgr.save(1, tree)
+    mgr.wait_until_finished()
+    # same world: a blind restore is fine
+    back = mgr.restore()
+    np.testing.assert_array_equal(np.asarray(back["w"]), 1.0)
+    # shrunken world: blind restore refused, template path still works
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+    with pytest.raises(ValueError, match="needs resharding"):
+        mgr.restore()
+    back = mgr.restore(template={"w": np.zeros((4,), np.float32)})
+    np.testing.assert_array_equal(np.asarray(back["w"]), 1.0)
+    mgr.close()
+
+
+def test_derive_rank_seed_deterministic_and_distinct():
+    base = 1234567
+    assert ckpt.derive_rank_seed(base, 0) == base   # shrink-to-one
+    seeds = [ckpt.derive_rank_seed(base, r) for r in range(8)]
+    assert len(set(seeds)) == 8                     # per-rank streams
+    assert seeds == [ckpt.derive_rank_seed(base, r) for r in range(8)]
+    assert all(0 <= s < (1 << 63) for s in seeds)
+    assert ckpt.derive_rank_seed(base + 1, 3) != seeds[3]
+
+
+def test_clean_partition_spec_drops_unhonorable_axes():
+    mesh = mesh_for_world(2)
+    assert clean_partition_spec(P("dp"), mesh) == P("dp")
+    assert clean_partition_spec(P("mp", "dp"), mesh) == P(None, "dp")
+    assert clean_partition_spec(P("dp"), mesh, shape=[7]) == P(None)
+    assert clean_partition_spec(P("dp"), mesh, shape=[8]) == P("dp")
+    assert clean_partition_spec([["dp"], None], mesh,
+                                shape=[4, 3]) == P(("dp",), None)
+
+
+# ---------------------------------------------------------------------------
+# sampler-resume parity across a shrink: no duplicated, no dropped index
+# ---------------------------------------------------------------------------
+def _trained_indices(n_samples, batch, world, start_batch, n_batches):
+    """Global index set trained by batches [start_batch, start_batch +
+    n_batches) of every rank at the given world."""
+    out = []
+    for rank in range(world):
+        s = paddle.io.DistributedBatchSampler(
+            list(range(n_samples)), batch_size=batch,
+            num_replicas=world, rank=rank, shuffle=False)
+        batches = list(s)
+        out.extend(i for b in batches[start_batch:start_batch + n_batches]
+                   for i in b)
+    return sorted(out)
+
+
+def test_sampler_resume_parity_across_shrink():
+    """World 4 trains 3 global steps (24 samples), then the job resumes
+    at world 2 skipping by GLOBAL SAMPLE COUNT: the union of trained
+    indices is exactly the dataset — nothing double-trained, nothing
+    dropped."""
+    n, batch = 48, 2
+    trained_before = _trained_indices(n, batch, world=4,
+                                      start_batch=0, n_batches=3)
+    assert len(trained_before) == 24
+    samples_seen = 3 * batch * 4
+    # the fit recompute: skip whole new-world batches until the sample mark
+    skip = samples_seen // (batch * 2)
+    assert skip * batch * 2 == samples_seen      # divisible: exact
+    per_rank_batches = n // (batch * 2)
+    trained_after = _trained_indices(n, batch, world=2, start_batch=skip,
+                                     n_batches=per_rank_batches - skip)
+    combined = sorted(trained_before + trained_after)
+    assert combined == list(range(n)), "duplicated or dropped samples"
+
+
+class _IdxDS(paddle.io.Dataset):
+    """Targets are a fixed linear function of the index so any data-
+    order mistake shows up in the loss trajectory."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        x = rng.rand(4).astype(np.float32)
+        return x, (x.sum(keepdims=True) * 0.5).astype(np.float32)
+
+    def __len__(self):
+        return self.n
+
+
+def _dist_loader(n, batch, world, rank):
+    ds = _IdxDS(n)
+    sampler = paddle.io.DistributedBatchSampler(
+        ds, batch_size=batch, num_replicas=world, rank=rank,
+        shuffle=False)
+    return paddle.io.DataLoader(ds, batch_sampler=sampler)
+
+
+def _fresh_model():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.Tanh(),
+                               paddle.nn.Linear(8, 1))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    model.prepare(opt, paddle.nn.MSELoss())
+    return model
+
+
+def test_fit_cross_world_resume_recomputes_offset(tmp_path, monkeypatch):
+    """End to end through Model.fit: train 3 steps at data-parallel
+    world 4, resume the same checkpoint directory at world 2 — the
+    replay offset is recomputed by samples (6 new-world batches
+    skipped, 6 trained), the meta records the new world, and the total
+    consumed-sample count lands exactly on the dataset size."""
+    import warnings as W
+    n, batch = 48, 2
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    model = _fresh_model()
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=3)
+    model.fit(_dist_loader(n, batch, 4, 0), epochs=1, verbose=0,
+              num_iters=3, checkpointer=mgr, prefetch_to_device=0)
+    mgr.close()
+    assert mgr.latest_step() == 3
+    assert model._fit_samples_seen == 3 * batch * 4
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    model2 = _fresh_model()
+    trained = []
+
+    class Rec(paddle.hapi.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            trained.append(step)
+
+    mgr2 = ckpt.AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=3)
+    with W.catch_warnings(record=True) as rec:
+        W.simplefilter("always")
+        model2.fit(_dist_loader(n, batch, 2, 0), epochs=1, verbose=0,
+                   checkpointer=mgr2, callbacks=[Rec()],
+                   prefetch_to_device=0)
+    mgr2.close()
+    assert any("resharded resume" in str(w.message) for w in rec)
+    # 12 per-rank batches at world 2; the first 6 replay 24 global
+    # samples, the remaining 6 train
+    assert len(trained) == 6, trained
+    assert model2._fit_samples_seen == n
+    # the new checkpoints carry the NEW world
+    meta = mgr2.restore(
+        template=model2._ckpt_tree(0))["meta"]
+    assert int(meta["world"]) == 2
+    assert int(meta["samples"]) == n
+
+
+def test_reshard_tree_with_python_scalar_leaf(tmp_path):
+    """Plain Python scalars (no array protocol) get their numpy view
+    recorded in the layout, so the template-free reshard path restores
+    them instead of crashing on an unknown dtype."""
+    path = str(tmp_path / "scalar")
+    ckpt.save_state(path, {"w": jnp.arange(4.0), "epoch": 3})
+    man = json.load(open(os.path.join(path, ckpt.MANIFEST_NAME)))
+    by_path = {tuple(e["path"]): e for e in man["layout"]}
+    assert by_path[("epoch",)]["dtype"] == "int64"
+    back = ckpt.load_state(path, reshard_mesh=mesh_for_world(2))
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.arange(4.0))
+    assert int(np.asarray(back["epoch"])) == 3
+
+
+def test_fit_cross_world_resume_multi_epoch_padding(tmp_path,
+                                                    monkeypatch):
+    """Completed old-world epochs replay WHOLESALE on a cross-world
+    resume: DistributedBatchSampler ceil-pads each epoch to a
+    world-dependent total (10 samples -> 12 padded at world 4, 10 at
+    world 2), so comparing sample counts across epochs would drift by
+    the padding difference per epoch.  Save mid-epoch-1 at world 4,
+    resume at world 2: epoch 0 is skipped wholesale, epoch 1 skips by
+    samples, and exactly the remaining batch trains."""
+    import warnings as W
+    n, batch = 10, 1
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    model = _fresh_model()
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=3)
+    # 3 padded batches/rank/epoch at world 4: 5 steps = epoch 0 (3) +
+    # 2 steps into epoch 1 (8 of its 12 padded global samples)
+    model.fit(_dist_loader(n, batch, 4, 0), epochs=2, verbose=0,
+              num_iters=5, checkpointer=mgr, prefetch_to_device=0)
+    mgr.close()
+    assert model._fit_epoch == 1 and model._fit_samples_epoch == 8
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    model2 = _fresh_model()
+    trained = []
+
+    class Rec(paddle.hapi.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            trained.append((self.model._fit_epoch, step))
+
+    Rec.model = None
+    rec = Rec()
+    rec.model = model2
+    mgr2 = ckpt.AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=3)
+    with W.catch_warnings(record=True) as warns:
+        W.simplefilter("always")
+        model2.fit(_dist_loader(n, batch, 2, 0), epochs=2, verbose=0,
+                   checkpointer=mgr2, callbacks=[rec],
+                   prefetch_to_device=0)
+    mgr2.close()
+    assert any("replaying 1 completed epoch" in str(w.message)
+               for w in warns)
+    # world 2: 5 batches/rank/epoch; epoch 0 replays wholesale, epoch 1
+    # skips 4 batches (8 global samples) and trains ONLY the last one
+    assert trained == [(1, 4)], trained
+
+
+def test_fit_grow_resume_keeps_checkpoint_labels_monotonic(
+        tmp_path, monkeypatch):
+    """A GROW renumbers step_count downward on the new grid (fewer,
+    bigger steps) — new checkpoints must still outrank the stale
+    old-world tree, or every later restore would pick the pre-grow
+    state.  Directory labels carry an elastic offset; the tree's meta
+    keeps the true new-grid step count."""
+    n, batch = 48, 2
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    model = _fresh_model()
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=8)
+    model.fit(_dist_loader(n, batch, 2, 0), epochs=1, verbose=0,
+              num_iters=4, checkpointer=mgr, prefetch_to_device=0)
+    mgr.close()
+    assert mgr.latest_step() == 4          # old-world labels 1..4
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    model2 = _fresh_model()
+    trained = []
+
+    class Rec(paddle.hapi.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            trained.append(step)
+
+    mgr2 = ckpt.AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=8)
+    model2.fit(_dist_loader(n, batch, 4, 0), epochs=1, verbose=0,
+               checkpointer=mgr2, callbacks=[Rec()],
+               prefetch_to_device=0)
+    mgr2.close()
+    # 16 old-world samples replay as 2 new-world batches; 4 train
+    assert trained == [2, 3, 4, 5], trained
+    # post-grow labels sit ABOVE the stale old-world step 4
+    assert mgr2.latest_step() == 4 + 6, mgr2.all_steps()
+    # and a fresh same-world resume restores the POST-grow tree
+    mgr3 = ckpt.AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=8)
+    meta = mgr3.restore(template=model2._ckpt_tree(0))["meta"]
+    mgr3.close()
+    assert int(meta["world"]) == 4 and int(meta["samples"]) == n
+    assert int(meta["step"]) == 6          # true new-grid step count
+
+
+def test_fit_cross_world_resume_rederives_rank_seed(tmp_path,
+                                                    monkeypatch):
+    """A nonzero NEW rank re-derives its RNG stream deterministically
+    from the checkpointed base seed on a cross-world resume (same-world
+    resume keeps the exact stream)."""
+    from paddle_tpu.core.random import default_generator
+    n, batch = 16, 2
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    model = _fresh_model()
+    mgr = ckpt.AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+    model.fit(_dist_loader(n, batch, 4, 0), epochs=1, verbose=0,
+              num_iters=1, checkpointer=mgr, prefetch_to_device=0)
+    mgr.close()
+    saved_seed = default_generator._seed
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    model2 = _fresh_model()
+    mgr2 = ckpt.AsyncCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+    model2.fit(_dist_loader(n, batch, 2, 1), epochs=1, verbose=0,
+               num_iters=2, checkpointer=mgr2, prefetch_to_device=0)
+    mgr2.close()
+    expect = ckpt.derive_rank_seed(saved_seed, 1)
+    # the resumed generator started from the derived per-rank seed
+    assert default_generator._seed == expect
+    paddle.seed(0)   # leave the global generator clean for other tests

@@ -232,6 +232,17 @@ def test_chaos_sites_cost_one_predicate_when_off(tmp_path, monkeypatch):
     model.train_batch([np.ones((2, 4), np.float32)],
                       [np.zeros((2, 2), np.float32)])
 
+    # host.slow (lives in the fit step loop, not train_batch)
+    class FitDS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return (np.ones(4, np.float32), np.zeros(2, np.float32))
+
+        def __len__(self):
+            return 4
+
+    model.fit(FitDS(), batch_size=2, epochs=1, verbose=0, shuffle=False,
+              prefetch_to_device=0)
+
     assert calls == [], f"chaos.hit called with no spec armed: {calls}"
 
 
@@ -253,6 +264,145 @@ def test_chaos_sites_fire_when_armed(tmp_path):
 
     with pytest.raises(chaos.ChaosError):
         list(paddle.io.DataLoader(DS(), batch_size=2))
+
+
+# ---------------------------------------------------------------------------
+# new sites: host.slow (step-loop slowdown) + store.partition (RPC
+# outage window) — armed behavior, zero-overhead is covered above, and
+# seeded schedules must replay across processes
+# ---------------------------------------------------------------------------
+def _tiny_fit_model():
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  paddle.nn.MSELoss())
+    return model
+
+
+class _TinyDS(paddle.io.Dataset):
+    def __getitem__(self, i):
+        return (np.ones(4, np.float32), np.zeros(2, np.float32))
+
+    def __len__(self):
+        return 8
+
+
+def test_chaos_host_slow_delays_selected_fit_steps():
+    """host.slow with a delay action stretches exactly the selected
+    steps of the fit loop — the per-step wall time the heartbeat
+    payload reports, i.e. a deterministic straggler."""
+    model = _tiny_fit_model()
+    chaos.configure("host.slow:delay=0.15@2-3", seed=0)
+    t0 = time.monotonic()
+    model.fit(_TinyDS(), batch_size=2, epochs=1, verbose=0,
+              shuffle=False, prefetch_to_device=0)
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 0.29, elapsed          # two delayed steps
+    assert chaos.call_count("host.slow") == 4  # one visit per step
+    assert metrics.counter("chaos.injected.host.slow").value >= 2
+
+
+def test_chaos_store_partition_window_ridden_by_retry():
+    """store.partition opens a deterministic RPC-failure window; the
+    TCPStore retry path rides a bounded window out exactly like a real
+    network blip (the raised ConnectionResetError is in its retry
+    class)."""
+    from paddle_tpu.distributed.fleet.elastic.manager import (KVServer,
+                                                              TCPStore)
+    srv = KVServer().start()
+    try:
+        store = TCPStore(srv.endpoint, retries=5, retry_base_delay=0.01)
+        chaos.configure("store.partition:fail@1-2", seed=0)
+        before = metrics.counter("resilience.retry").value
+        store.put("/part", "v")              # calls 1-2 fail, 3 lands
+        assert store.get("/part") == "v"
+        assert metrics.counter(
+            "chaos.injected.store.partition").value >= 2
+        assert metrics.counter("resilience.retry").value >= before + 2
+    finally:
+        srv.stop()
+
+
+def test_chaos_store_sites_count_in_lockstep_when_combined():
+    """store.rpc and store.partition both count EVERY RPC even when the
+    other site fires first — combined schedules land exactly on the
+    RPCs the spec names."""
+    from paddle_tpu.distributed.fleet.elastic.manager import (KVServer,
+                                                              TCPStore)
+    srv = KVServer().start()
+    try:
+        store = TCPStore(srv.endpoint, retries=5, retry_base_delay=0.01)
+        chaos.configure("store.rpc:fail@1;store.partition:fail@3",
+                        seed=0)
+        store.put("/k", "v")          # visits 1 (rpc@1 fires) + 2
+        assert store.get("/k") == "v"  # visits 3 (partition@3) + 4
+        assert chaos.call_count("store.rpc") == \
+            chaos.call_count("store.partition") == 4
+        assert metrics.counter("chaos.injected.store.rpc").value >= 1
+        assert metrics.counter(
+            "chaos.injected.store.partition").value >= 1
+    finally:
+        srv.stop()
+
+
+def test_chaos_store_partition_outage_surfaces_when_window_too_wide():
+    """A partition wider than the retry budget surfaces as the
+    connection error a real dead network would produce."""
+    from paddle_tpu.distributed.fleet.elastic.manager import (KVServer,
+                                                              TCPStore)
+    srv = KVServer().start()
+    try:
+        store = TCPStore(srv.endpoint, retries=3, retry_base_delay=0.01)
+        chaos.configure("store.partition:fail@1-", seed=0)
+        with pytest.raises(ConnectionResetError):
+            store.put("/part", "v")
+    finally:
+        srv.stop()
+
+
+_REPLAY_SNIPPET = """
+import os
+from paddle_tpu.utils import chaos
+fired = []
+for i in range(64):
+    try:
+        chaos.hit("host.slow")
+    except chaos.ChaosError:
+        fired.append(("h", i))
+    try:
+        chaos.hit("store.partition")
+    except chaos.ChaosError:
+        fired.append(("p", i))
+print(fired)
+"""
+
+
+def test_chaos_new_sites_seeded_cross_process_replay(tmp_path):
+    """Seeded probabilistic schedules for the new sites replay
+    bit-identically across PROCESSES (crc32-keyed per-site RNG — the
+    in-process determinism test can't catch interpreter hash salting)."""
+    import subprocess
+    import sys
+    script = tmp_path / "replay.py"
+    script.write_text(_REPLAY_SNIPPET)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(seed):
+        env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu",
+                   FLAGS_chaos_spec=("host.slow:fail@p=0.4;"
+                                     "store.partition:fail@p=0.3"),
+                   FLAGS_chaos_seed=str(seed))
+        r = subprocess.run([sys.executable, str(script)], env=env,
+                           capture_output=True, text=True, timeout=120,
+                           cwd=repo)
+        assert r.returncode == 0, r.stderr
+        return r.stdout
+
+    a, b, c = run(11), run(11), run(12)
+    assert a == b                        # same seed: same schedule
+    assert a != c                        # seed matters
+    assert "('h'," in a and "('p'," in a  # both sites actually fired
 
 
 # ---------------------------------------------------------------------------

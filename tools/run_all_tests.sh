@@ -28,6 +28,16 @@ python -m pytest tests/test_passes.py -q
 # RPCs) with a mid-run SIGKILL — must complete via verified-checkpoint
 # resume with the expected chaos.injected/launch.restarts counts.
 python tools/chaos_gate.py
+# Elastic degrade-and-continue gate: (1) 4 supervised workers under
+# --np 2:4, SIGKILL one mid-step — the gang must re-form at world 3 via
+# a rendezvous round (exact restart/rendezvous counts, zero restart
+# budget spent), resume from the newest intact checkpoint, reshard a
+# DP-sharded tree 4->3 bit-exactly inside the degraded gang, and match
+# an uninterrupted run's loss trajectory; (2) a host.slow chaos delay
+# on one rank must trip the straggler detector at exactly
+# FLAGS_straggler_patience strikes and — under --evict_stragglers —
+# re-form the gang without that host.
+python tools/elastic_gate.py
 # Async-pipeline gate: device-prefetched Model.fit must be bit-exact vs
 # the synchronous loop on a fixed-seed 20-step run, the prefetch queue
 # must actually run ahead, a loader.worker chaos kill must be recovered
