@@ -24,6 +24,9 @@ PROFILE = os.environ.get("BENCH_PROFILE", "") not in ("", "0")
 # BENCH_SERVE=1: also run the serving bench (InferenceEngine under
 # concurrent clients) and embed req/s + p50/p99 latency in the JSON.
 SERVE = os.environ.get("BENCH_SERVE", "") not in ("", "0")
+# BENCH_INT8=1: serving leg comparing the int8 artifact path against
+# fp32 — latency + top-1 agreement through the same InferenceEngine.
+INT8 = os.environ.get("BENCH_INT8", "") not in ("", "0")
 
 
 def _metrics_snapshot():
@@ -215,6 +218,11 @@ def main():
             result["metrics"] = _metrics_snapshot()
         except Exception as e:
             print(f"bench: metrics snapshot failed: {e!r}", file=sys.stderr)
+    if INT8:
+        try:
+            result["serving_int8"] = bench_int8(on_tpu)
+        except Exception as e:
+            print(f"bench: int8 leg failed: {e!r}", file=sys.stderr)
     if SERVE:
         try:
             result["serving"] = bench_serving(on_tpu)
@@ -331,16 +339,200 @@ def bench_resnet(on_tpu: bool):
     mfu = imgs * 3 * 4.1e9 / 197e12
     wait_frac = best_wait / best
     dev_frac = min(1.0, best_dev_ns / 1e9 / best)
-    return {"value": round(imgs, 1), "unit": "imgs/s",
-            "vs_baseline": round(imgs / (0.8 * 390.0), 3),
-            "mfu": round(mfu, 3),
-            "cold_start_s": round(cold_start_s, 3),
-            "steady_step_s": round(best / steps, 4),
-            "data_wait_frac": round(wait_frac, 4),
-            # dispatch/backpressure vs everything-else-on-host split for
-            # the best rep — the "where did the step go" attribution
-            "device_frac": round(dev_frac, 4),
-            "host_frac": round(max(0.0, 1.0 - wait_frac - dev_frac), 4)}
+    out = {"value": round(imgs, 1), "unit": "imgs/s",
+           "vs_baseline": round(imgs / (0.8 * 390.0), 3),
+           "mfu": round(mfu, 3),
+           "cold_start_s": round(cold_start_s, 3),
+           "steady_step_s": round(best / steps, 4),
+           "data_wait_frac": round(wait_frac, 4),
+           # dispatch/backpressure vs everything-else-on-host split for
+           # the best rep — the "where did the step go" attribution
+           "device_frac": round(dev_frac, 4),
+           "host_frac": round(max(0.0, 1.0 - wait_frac - dev_frac), 4)}
+    try:
+        # per-phase share of the step (conv/norm/elementwise/optimizer)
+        # off the PR 1 tracer op table — same summary path as
+        # tools/profile_resnet.py.  MFU-by-phase: phase share x leg MFU.
+        shares = _resnet_phase_shares(model, opt, x, y, p0)
+        out["phase_shares"] = {k: round(v["time_frac"], 4)
+                               for k, v in shares.items()}
+        out["phase_mfu"] = {k: round(v["time_frac"] * out["mfu"], 4)
+                            for k, v in shares.items()}
+    except Exception as e:
+        print(f"bench: resnet phase breakdown failed: {e!r}",
+              file=sys.stderr)
+    try:
+        out["fused"] = _resnet_fused_ablation(on_tpu)
+    except Exception as e:
+        print(f"bench: resnet fused ablation failed: {e!r}",
+              file=sys.stderr)
+    return out
+
+
+def _resnet_phase_shares(model, opt, x, y, p0):
+    """conv/norm/elementwise/optimizer time shares from the tracer op
+    table — the shared ``tracer.eager_phase_profile`` recipe, the same
+    one ``tools/profile_resnet.py`` prints, so the two can never
+    disagree on methodology."""
+    from paddle_tpu.profiler import tracer
+    _, shares, _ = tracer.eager_phase_profile(model, opt, x, y, p0)
+    return shares
+
+
+def _resnet_fused_ablation(on_tpu: bool):
+    """Measured before/after for the kernel work: the SAME fixed-seed
+    fit leg with FLAGS_fused_conv + FLAGS_fused_optimizer both off vs
+    both on (cold start incl. trace+compile, steady step, and the eager
+    optimizer step where the fused update actually lives)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.utils import flags as fl
+
+    if on_tpu:
+        B, hw, steps, nclass, depth = 128, 224, 6, 1000, 50
+    else:
+        B, hw, steps, nclass, depth = 8, 32, 4, 10, 18
+
+    def leg(fused):
+        paddle.seed(0)
+        fl.set_flags({"FLAGS_fused_conv": fused,
+                      "FLAGS_fused_optimizer": fused})
+        net = getattr(paddle.vision.models, f"resnet{depth}")(
+            num_classes=nclass)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9,
+            parameters=net.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        rng = np.random.RandomState(0)
+        xb = jnp.asarray(rng.rand(B, 3, hw, hw), jnp.float32)
+        yb = jnp.asarray(rng.randint(0, nclass, (B, 1)), jnp.int32)
+        p0 = next(iter(net.parameters()))
+        t0 = time.perf_counter()
+        logs = model.train_batch([xb], [yb])
+        float(logs["loss"])
+        jax.block_until_ready(p0._data)
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            logs = model.train_batch([xb], [yb])
+        float(logs["loss"])
+        jax.block_until_ready(p0._data)
+        steady = (time.perf_counter() - t0) / steps
+        # eager optimizer step: where the fused update replaces the
+        # per-leaf dispatch loop
+        model._train_batch_eager([xb], [yb], update=False)
+        g0 = next(p for p in net.parameters() if p.grad is not None)
+        jax.block_until_ready(g0.grad._data)
+        opt.step()             # group-jit compile outside the clock
+        jax.block_until_ready(p0._data)
+        model._train_batch_eager([xb], [yb], update=False)
+        t0 = time.perf_counter()
+        opt.step()
+        jax.block_until_ready(p0._data)
+        opt_ms = (time.perf_counter() - t0) * 1e3
+        opt.clear_grad()
+        return cold, steady, opt_ms
+
+    flags_was = fl.get_flags(["FLAGS_fused_conv",
+                              "FLAGS_fused_optimizer"])
+    # interleaved best-of-N: the shared-CPU/tunneled-chip noise between
+    # two sequential single runs is larger than the effect being
+    # measured, and leg order must not bias the comparison
+    best = {False: None, True: None}
+    try:
+        for _ in range(3 if not on_tpu else 2):
+            for fused in (False, True):
+                r = leg(fused)
+                if best[fused] is None:
+                    best[fused] = list(r)
+                else:
+                    best[fused] = [min(a, b)
+                                   for a, b in zip(best[fused], r)]
+    finally:
+        fl.set_flags(flags_was)
+    cold_off, steady_off, opt_off = best[False]
+    cold_on, steady_on, opt_on = best[True]
+    return {
+        "config": f"resnet{depth} b{B} {hw}x{hw}",
+        "cold_start_s": {"off": round(cold_off, 3),
+                         "on": round(cold_on, 3)},
+        "steady_step_s": {"off": round(steady_off, 4),
+                          "on": round(steady_on, 4)},
+        "eager_opt_step_ms": {"off": round(opt_off, 2),
+                              "on": round(opt_on, 2)},
+        "steady_speedup": round(steady_off / steady_on, 3),
+        "cold_speedup": round(cold_off / cold_on, 3),
+        "opt_step_speedup": round(opt_off / opt_on, 2),
+    }
+
+
+def bench_int8(on_tpu: bool):
+    """Int8 serving leg: the SAME resnet artifact served through two
+    InferenceEngines — fp32 vs the int8 program variant (per-output-
+    channel weight scales, axis-aware) — reporting latency and top-1
+    agreement.  Both run the full engine path (bucketing +
+    ExecutableCache), so the numbers are endpoint numbers."""
+    import tempfile
+    import warnings
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, serving
+    from paddle_tpu.jit import InputSpec
+
+    paddle.seed(0)
+    if on_tpu:
+        B, hw, nclass, depth, reqs = 64, 224, 1000, 50, 24
+    else:
+        B, hw, nclass, depth, reqs = 8, 32, 10, 18, 8
+    net = getattr(paddle.vision.models, f"resnet{depth}")(
+        num_classes=nclass)
+    net.eval()
+    prefix = os.path.join(tempfile.mkdtemp(prefix="bench_int8_"), "m")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        paddle.jit.save(net, prefix, input_spec=[
+            InputSpec([B, 3, hw, hw], "float32", name="x")])
+
+    rng = np.random.RandomState(0)
+    batches = [rng.rand(B, 3, hw, hw).astype("float32")
+               for _ in range(reqs)]
+
+    def serve(precision, name):
+        cfg = inference.Config(prefix)
+        if precision is not None:
+            cfg.set_precision(precision)
+        eng = serving.InferenceEngine(cfg, serving.EngineConfig(
+            max_batch_size=B, min_batch_bucket=B, num_workers=1,
+            name=name))
+        eng.infer([batches[0]], timeout=600)      # compile off-clock
+        outs, lats = [], []
+        for xb in batches:
+            t0 = time.perf_counter()
+            outs.append(eng.infer([xb], timeout=600)[0])
+            lats.append((time.perf_counter() - t0) * 1e3)
+        eng.close()
+        lats.sort()
+        return outs, lats[len(lats) // 2]
+
+    ref, p50_fp32 = serve(None, "bench_fp32")
+    q, p50_int8 = serve(inference.PrecisionType.Int8, "bench_int8")
+    top1 = [np.argmax(r, axis=1) for r in ref]
+    top1_q = [np.argmax(o, axis=1) for o in q]
+    agree = float(np.mean([np.mean(a == b)
+                           for a, b in zip(top1, top1_q)]))
+    rel = float(max(np.abs(np.asarray(b, np.float32)
+                           - np.asarray(a, np.float32)).max()
+                    / (np.abs(np.asarray(a, np.float32)).max() or 1.0)
+                    for a, b in zip(ref, q)))
+    return {
+        "config": f"resnet{depth} b{B} {hw}x{hw}, {reqs} requests",
+        "p50_ms": {"fp32": round(p50_fp32, 2),
+                   "int8": round(p50_int8, 2)},
+        "speedup": round(p50_fp32 / p50_int8, 3),
+        "top1_agreement": round(agree, 4),
+        "max_rel_err": round(rel, 5),
+    }
 
 
 def bench_program_opt():

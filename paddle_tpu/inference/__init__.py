@@ -342,9 +342,15 @@ class Predictor:
             else:  # Int8: params packed as (int8 rows, per-channel scales)
                 from ..quantization import quantize_weight_int8
                 keys = set(self._meta.get("int8_keys", ()))
+                # per-key quantization axis recorded at save time (conv
+                # kernels scale per output channel); artifacts saved
+                # before r10 lack the map and keep the last-axis layout
+                # their program was traced with
+                axes = self._meta.get("int8_axes") or {}
                 self._params = {
                     k: ((lambda qw: (qw.q, qw.scales))(
-                        quantize_weight_int8(v)) if k in keys else v)
+                        quantize_weight_int8(v, axis=axes.get(
+                            k, v.ndim - 1))) if k in keys else v)
                     for k, v in self._params.items()}
             return
         # legacy (pre-r5) artifact: single f32 program — fall back to
@@ -366,11 +372,16 @@ class Predictor:
                 for k, v in self._params.items()}
             self._out_dtype = tgt
         elif prec == PrecisionType.Int8:
-            from ..quantization import quantize_weight_int8
+            from ..quantization import (default_int8_axis,
+                                        quantize_weight_int8)
             q = {}
             for k, v in self._params.items():
                 if v.dtype == jnp.float32 and v.ndim >= 2 and v.size > 16:
-                    q[k] = quantize_weight_int8(v)
+                    # weight-only storage path: QuantizedW carries its
+                    # own axis, so per-output-channel conv scales
+                    # round-trip through _materialize_params
+                    q[k] = quantize_weight_int8(
+                        v, axis=default_int8_axis(v.ndim))
                 else:
                     q[k] = v
             self._params = q

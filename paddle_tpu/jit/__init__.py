@@ -306,9 +306,15 @@ def save(layer, path, input_spec=None, **configs):
         # matmul/conv weights only (ndim >= 2): a 1-D bias "quantized"
         # with per-channel (== per-element) scales would be BIGGER than
         # its f32 original
+        from ..quantization import default_int8_axis
         int8_keys = sorted(k for k, v in params.items()
                            if v.dtype == jnp.float32 and v.ndim >= 2
                            and v.size > 16)
+        # per-key quantization axis: conv kernels (rank>=3) scale per
+        # OUTPUT channel (axis 0), matmul weights per column — recorded
+        # in the meta so every loader dequantizes on the right axis
+        int8_axes = {k: default_int8_axis(params[k].ndim)
+                     for k in int8_keys}
 
         def infer_int8(qparams, buffers, *arrays):
             dq = {}
@@ -316,7 +322,7 @@ def save(layer, path, input_spec=None, **configs):
                 if k in set(int8_keys):
                     q, scales = v
                     shape = [1] * q.ndim
-                    shape[q.ndim - 1] = -1
+                    shape[int8_axes[k]] = -1
                     dq[k] = q.astype(jnp.bfloat16) * \
                         scales.astype(jnp.bfloat16).reshape(shape)
                 else:
@@ -336,8 +342,8 @@ def save(layer, path, input_spec=None, **configs):
         for k, a in p_avals.items():
             if k in int8_keys:
                 q_avals[k] = (jax.ShapeDtypeStruct(a.shape, jnp.int8),
-                              jax.ShapeDtypeStruct((a.shape[-1],),
-                                                   jnp.float32))
+                              jax.ShapeDtypeStruct(
+                                  (a.shape[int8_axes[k]],), jnp.float32))
             else:
                 q_avals[k] = a
         try:
@@ -345,6 +351,7 @@ def save(layer, path, input_spec=None, **configs):
                 jax.jit(infer_int8), shapes_dtypes, q_avals, b_avals)
             meta["programs"]["Int8"] = exp_q.serialize()
             meta["int8_keys"] = int8_keys
+            meta["int8_axes"] = int8_axes
         except Exception as e:  # pragma: no cover
             meta.setdefault("precision_export_errors", {})["Int8"] = str(e)
         finally:

@@ -15,6 +15,7 @@ from .layer.container import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
+from .layer.fused_conv import FusedConvBNReLU  # noqa: F401
 
 from . import clip  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

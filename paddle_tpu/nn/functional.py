@@ -50,6 +50,118 @@ def tanh_(x, name=None):
     return _tanh_(x)
 
 
+def fused_conv_bn(x, conv, bn=None, act="relu", pre_norm=False):
+    """Run a conv/batch-norm/activation block as ONE fused dispatch.
+
+    ``conv`` is an ``nn.Conv2D``-style layer (1d/3d work too) and ``bn``
+    an optional ``nn.BatchNorm*`` layer; ``act`` is ``"relu"``-style or
+    ``None``.  ``pre_norm=True`` runs the DenseNet ordering
+    (norm → act → conv) instead of conv → norm → act.
+
+    Honors ``FLAGS_fused_conv``: when off — or in static-capture mode
+    (the program-level ``fusion_group`` pass owns that fusion), under
+    an active AMP autocast (the eager cast lists are per-op), or for
+    configs the fused kernels don't cover — it falls back to the exact
+    eager composition, which is also the bit-parity reference the
+    tests pin the fused path against.
+    """
+    from ..core.tensor import to_tensor as _tt
+    from ..ops import fused_conv as _fc
+    from ..ops import activation as _act_ops
+    from ..utils import flags as _flags
+    from ..amp import _amp_state
+
+    def _eager():
+        if pre_norm:
+            out = x
+            if bn is not None:
+                out = bn(_tt(out))
+            if act:
+                out = getattr(_act_ops, act)(out)
+            return conv(out)
+        out = conv(_tt(x))
+        if bn is not None:
+            out = bn(out)
+        if act:
+            out = getattr(_act_ops, act)(out)
+        return out
+
+    from .layer.conv import _ConvNd
+    fusable = (
+        _flags.get_flag("FLAGS_fused_conv")
+        and isinstance(conv, _ConvNd)   # quantized/custom convs: eager
+        and act in _fc._ACTS
+        and not getattr(conv, "_transposed", False)
+        and getattr(conv, "_padding_mode", "zeros") == "zeros"
+        # registered hooks are an observable contract (PTQ calibration
+        # records conv inputs via pre-hooks) — they only fire through
+        # Layer.__call__, so hooked convs take the eager composition
+        and not conv._forward_pre_hooks
+        and not conv._forward_post_hooks
+        and _amp_state() is None)
+    if not fusable:
+        return _eager()
+    if bn is not None:
+        from .layer.norm import (BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                                 _BatchNormBase)
+        # exact types only: subclasses (SyncBatchNorm, the legacy act-
+        # carrying BatchNorm) override forward with semantics the fused
+        # kernel must not silently replace.  BatchNorm1D's forward is
+        # the generic batch_norm modulo the NCL/NLC format alias, which
+        # the nd-generic fused kernels derive from the conv layout.
+        if type(bn) not in (BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                            _BatchNormBase) or \
+                bn.weight is None or bn.bias is None or \
+                bn._use_global_stats or \
+                bn._forward_pre_hooks or bn._forward_post_hooks:
+            return _eager()
+    from ..static.mode import in_dynamic_mode
+    if not in_dynamic_mode():
+        # static capture: emit the plain conv/batch_norm/act ops — the
+        # extended fusion_group pass fuses them at the program level
+        # (and conv_bn_fold folds the eval form when enabled)
+        return _eager()
+
+    x = _tt(x)
+    if not all(isinstance(s, int) for s in
+               tuple(x._data.shape) + tuple(conv.weight._data.shape)):
+        # symbolic dims (jax.export dynamic-batch tracing): the fused
+        # factories key on concrete shapes — the eager composition
+        # exports cleanly with symbolic batch
+        return _eager()
+    kw = dict(stride=conv._stride, padding=conv._padding,
+              dilation=conv._dilation, groups=conv._groups,
+              data_format=conv._data_format)
+    if bn is None:
+        if pre_norm:
+            return _eager()
+        return _fc.fused_conv_act(x, conv.weight, conv.bias, act=act, **kw)
+    training = bn.training
+    if pre_norm:
+        out = _fc.fused_bn_act_conv(
+            x, conv.weight, bn.weight, bn.bias, bn._mean, bn._variance,
+            bias=conv.bias, epsilon=bn._epsilon, act=act,
+            training=training, **kw)
+        if not training:
+            return out
+        y, mu, var = out
+    elif training:
+        y, mu, var = _fc.fused_conv_bn_act(
+            x, conv.weight, bn.weight, bn.bias, bias=conv.bias,
+            epsilon=bn._epsilon, act=act, **kw)
+    else:
+        return _fc.fused_conv_bn_act_infer(
+            x, conv.weight, bn.weight, bn.bias, bn._mean, bn._variance,
+            bias=conv.bias, epsilon=bn._epsilon, act=act, **kw)
+    # running-stat update: same in-place rebind as ops.norm_ops.batch_norm
+    # (capture-safe under the jitted train step — buffers are read out
+    # after tracing)
+    mom = bn._momentum
+    bn._mean._data = mom * bn._mean._data + (1.0 - mom) * mu._data
+    bn._variance._data = mom * bn._variance._data + (1.0 - mom) * var._data
+    return y
+
+
 def gather_tree(ids, parents):
     """Backtrace beam-search ids along parent pointers (reference
     gather_tree_op): ids/parents [T, B, beam] -> full sequences."""

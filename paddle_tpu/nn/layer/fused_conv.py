@@ -1,0 +1,49 @@
+"""Fused conv+BN+activation layer (reference
+``incubate/nn/FusedConv2D``-style fusion surface, TPU-first).
+
+``FusedConvBNReLU`` owns a ``Conv2D`` and a ``BatchNorm2D`` as ordinary
+sublayers (state_dict-compatible with the unfused pair) and runs them
+through ``nn.functional.fused_conv_bn`` — one dispatched kernel in
+training (custom-vjp backward recomputing the cheap epilogue) and the
+folded-constant form in inference, with ``FLAGS_fused_conv=0`` as the
+bit-parity escape hatch back to the eager composition.
+"""
+from __future__ import annotations
+
+from ..layer_base import Layer
+from .conv import Conv2D
+from .norm import BatchNorm2D
+
+__all__ = ["FusedConvBNReLU"]
+
+
+class FusedConvBNReLU(Layer):
+    """``act(bn(conv(x)))`` as one fused op.
+
+    Constructor mirrors ``Conv2D`` (plus BN's ``momentum``/``epsilon``
+    and ``act``); the conv is bias-free by default because BN's shift
+    subsumes it.  Sublayers are named ``conv`` and ``bn``, so a
+    state_dict produced by an unfused ``conv``/``bn`` pair under the
+    same attribute names loads unchanged.
+    """
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, act="relu",
+                 momentum=0.9, epsilon=1e-05, weight_attr=None,
+                 bias_attr=False, data_format="NCHW"):
+        super().__init__()
+        self.conv = Conv2D(in_channels, out_channels, kernel_size,
+                           stride=stride, padding=padding,
+                           dilation=dilation, groups=groups,
+                           weight_attr=weight_attr, bias_attr=bias_attr,
+                           data_format=data_format)
+        self.bn = BatchNorm2D(out_channels, momentum=momentum,
+                              epsilon=epsilon, data_format=data_format)
+        self._act = act
+
+    def forward(self, x):
+        from .. import functional as F
+        return F.fused_conv_bn(x, self.conv, self.bn, act=self._act)
+
+    def extra_repr(self):
+        return f"act={self._act}"

@@ -1,0 +1,352 @@
+"""Fused conv + batch-norm + activation block kernels.
+
+Bench r05 put the ResNet leg at 0.11 MFU against the GPT leg's 0.562 —
+the conv stack pays per-op dispatch/trace overhead three times per
+block (conv, batch_norm, relu) and the autodiff of the unfused chain
+saves the normalized activations AND the relu mask per block.  This
+module dispatches the whole block as ONE op:
+
+- **training** (``fused_conv_bn_act``): conv → batch-stats normalize →
+  scale/shift → activation in a single jitted call.  The op carries a
+  ``jax.custom_vjp`` whose backward *recomputes the cheap epilogue*
+  (x̂, pre-activation mask) from the saved conv output instead of
+  saving those intermediates — residuals are (x, w, conv_out, γ, β,
+  μ, σ²) where plain autodiff would additionally pin x̂ and the mask
+  (two conv-output-sized tensors per block).  Conv input/weight grads
+  come from ``jax.vjp`` of the conv primitive inside the backward; XLA
+  dead-code-eliminates the unused primal recompute (conv is linear),
+  so no double conv executes.
+- **inference** (``fused_conv_bn_act_infer``): the BN constants fold
+  into the conv weights at materialization — ``conv(x, w·s) + (β−μ·s)``
+  with ``s = γ·rsqrt(σ²+ε)`` — one conv + bias instead of conv +
+  normalize.  Tolerance-level parity with the unfused math (the fold
+  reassociates the per-channel multiply), which tests pin explicitly.
+
+The forward math of the training op replays the exact elementwise
+sequence of the eager conv/batch_norm/relu composition (same ops, same
+order), so the fused forward is **bit-exact** with ``FLAGS_fused_conv=0``.
+
+Reference parity: ``operators/fused/conv_fusion_op.cu`` (cudnn
+conv+bias+act fusion) and ``operators/fused/fused_bn_activation_op.*``;
+on TPU the fusion is an XLA-region boundary rather than a cudnn call.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import to_tensor
+from .conv import _conv_dn, _norm_padding, _tuplen
+
+__all__ = ["fused_conv_bn_act", "fused_conv_bn_act_infer",
+           "fused_conv_act", "fused_bn_act_conv"]
+
+_ACTS = {
+    None: lambda x: x,
+    "": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "silu": jax.nn.silu,
+}
+
+
+def _conv_closure(x_shape, w_shape, nd, stride, padding, dilation, groups,
+                  channel_last):
+    """The exact conv the eager ``ops.conv._conv`` path runs, closed
+    over static geometry (shapes included: ``conv_dimension_numbers``
+    wants them, and the closure is rebuilt per shape signature by the
+    cached factory anyway)."""
+    stride = _tuplen(stride, nd)
+    dilation = _tuplen(dilation, nd)
+    kernel = w_shape[2:]
+    pad = _norm_padding(padding, nd, stride, kernel, dilation)
+    dn = jax.lax.conv_dimension_numbers(x_shape, w_shape,
+                                        _conv_dn(nd, channel_last))
+
+    def convfn(a, w):
+        return jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+    return convfn
+
+
+def _bcast_shape(ndim, channel_axis, channels):
+    shape = [1] * ndim
+    shape[channel_axis] = channels
+    return shape
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fused_train(x_shape, w_shape, has_bias, nd, stride, padding,
+                      dilation, groups, channel_last, eps, act):
+    """custom_vjp'd ``(x, w[, b], gamma, beta) -> (y, mu, var)`` for the
+    training (batch-stats) mode.  lru_cache keeps the returned callable
+    identity stable per static config so the eager jit/vjp cache in
+    core.dispatch can key on it."""
+    convfn = _conv_closure(x_shape, w_shape, nd, stride, padding, dilation,
+                           groups, channel_last)
+    out_ndim = len(x_shape)
+    ch_axis = out_ndim - 1 if channel_last else 1
+    channels = w_shape[0]
+    bshape = tuple(_bcast_shape(out_ndim, ch_axis, channels))
+    axes = tuple(i for i in range(out_ndim) if i != ch_axis)
+    actfn = _ACTS[act]
+
+    def _conv_bias(x, w, rest):
+        c = convfn(x, w)
+        if has_bias:
+            c = c + rest[0].reshape(bshape)
+        return c
+
+    def fused(x, w, *rest):
+        # identical elementwise sequence to the eager composition
+        # (ops/norm_ops.batch_norm impl) — forward bit-parity holds by
+        # construction
+        c = _conv_bias(x, w, rest)
+        gamma, beta = rest[-2], rest[-1]
+        mu = jnp.mean(c, axis=axes)
+        var = jnp.var(c, axis=axes)
+        out = (c - mu.reshape(bshape)) * jax.lax.rsqrt(
+            var.reshape(bshape) + eps)
+        out = out * gamma.reshape(bshape)
+        out = out + beta.reshape(bshape)
+        return actfn(out), mu, var
+
+    f = jax.custom_vjp(fused)
+
+    def fwd(x, w, *rest):
+        c = _conv_bias(x, w, rest)
+        gamma, beta = rest[-2], rest[-1]
+        mu = jnp.mean(c, axis=axes)
+        var = jnp.var(c, axis=axes)
+        inv = jax.lax.rsqrt(var + eps)
+        xhat = (c - mu.reshape(bshape)) * inv.reshape(bshape)
+        pre = xhat * gamma.reshape(bshape) + beta.reshape(bshape)
+        y = actfn(pre)
+        # residuals: conv_out-sized tensors saved are c and (for relu)
+        # y — which ALIASES the op output, so it costs no extra memory;
+        # x̂ and the activation mask recompute in bwd.  Plain autodiff
+        # would pin x̂ AND the mask as separate buffers per block.
+        keep_y = y if act == "relu" else None
+        return (y, mu, var), (x, w, rest, c, mu, inv, keep_y)
+
+    def bwd(res, cots):
+        gy, gmu, gvar = cots
+        x, w, rest, c, mu, inv, y = res
+        gamma = rest[-2]
+        beta = rest[-1]
+        xhat = (c - mu.reshape(bshape)) * inv.reshape(bshape)
+        if act in ("relu",):
+            # relu mask from the saved output: y > 0 <=> pre > 0
+            go = jnp.where(y > 0, gy, jnp.zeros_like(gy))
+        elif act in (None, ""):
+            go = gy
+        else:
+            # general activation: vjp of the pointwise fn at the
+            # recomputed pre-activation
+            pre = xhat * gamma.reshape(bshape) + beta.reshape(bshape)
+            _, act_vjp = jax.vjp(actfn, pre)
+            (go,) = act_vjp(gy)
+        dgamma = jnp.sum(go * xhat, axis=axes)
+        dbeta = jnp.sum(go, axis=axes)
+        dxhat = go * gamma.reshape(bshape)
+        m = 1
+        for i in axes:
+            m *= c.shape[i]
+        s1 = jnp.sum(dxhat, axis=axes, keepdims=True)
+        s2 = jnp.sum(dxhat * xhat, axis=axes, keepdims=True)
+        dc = (inv.reshape(bshape) / m) * (m * dxhat - s1 - xhat * s2)
+        # cotangents flowing into the returned batch stats (running-
+        # stat updates are stop_gradient downstream, but correctness
+        # must not depend on that)
+        dc = dc + gmu.reshape(bshape) / m
+        dc = dc + gvar.reshape(bshape) * 2.0 * (c - mu.reshape(bshape)) / m
+        _, conv_vjp = jax.vjp(lambda a, ww: convfn(a, ww), x, w)
+        dx, dw = conv_vjp(dc)
+        if has_bias:
+            db = jnp.sum(dc, axis=axes)
+            return dx, dw, db, dgamma, dbeta
+        return dx, dw, dgamma, dbeta
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fused_infer(x_shape, w_shape, has_bias, nd, stride, padding,
+                      dilation, groups, channel_last, eps, act):
+    """Folded-constant inference form: BN constants fold into the conv
+    weights — ``conv(x, w·s) + shift``.  Plain autodiff (eval-mode
+    grads are rare; the chain is short)."""
+    convfn = _conv_closure(x_shape, w_shape, nd, stride, padding, dilation,
+                           groups, channel_last)
+    out_ndim = len(x_shape)
+    ch_axis = out_ndim - 1 if channel_last else 1
+    channels = w_shape[0]
+    bshape = tuple(_bcast_shape(out_ndim, ch_axis, channels))
+    wscale_shape = tuple([-1] + [1] * (len(w_shape) - 1))
+    actfn = _ACTS[act]
+
+    def fused(x, w, *rest):
+        gamma, beta, mu, var = rest[-4:]
+        scale = gamma * jax.lax.rsqrt(var + eps)
+        wf = w * scale.reshape(wscale_shape)
+        shift = beta - mu * scale
+        if has_bias:
+            shift = shift + rest[0] * scale
+        y = convfn(x, wf) + shift.reshape(bshape)
+        return actfn(y)
+    return fused
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fused_conv_act(x_shape, w_shape, has_bias, nd, stride, padding,
+                         dilation, groups, channel_last, act):
+    """conv(+bias)+activation in one dispatch (no norm — e.g. the
+    GoogLeNet branches)."""
+    convfn = _conv_closure(x_shape, w_shape, nd, stride, padding, dilation,
+                           groups, channel_last)
+    out_ndim = len(x_shape)
+    ch_axis = out_ndim - 1 if channel_last else 1
+    bshape = tuple(_bcast_shape(out_ndim, ch_axis, w_shape[0]))
+    actfn = _ACTS[act]
+
+    def fused(x, w, *rest):
+        c = convfn(x, w)
+        if has_bias:
+            c = c + rest[0].reshape(bshape)
+        return actfn(c)
+    return fused
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fused_pre(x_shape, w_shape, has_bias, nd, stride, padding,
+                    dilation, groups, channel_last, eps, act, training):
+    """Pre-activation form (DenseNet): norm → act → conv in one
+    dispatch.  Training returns (y, mu, var) over the INPUT's batch
+    stats; eval uses the running stats.  Single XLA region, plain
+    autodiff (the input x is a live tensor either way, so there is no
+    conv-sized intermediate worth a custom saving policy)."""
+    convfn = _conv_closure(x_shape, w_shape, nd, stride, padding, dilation,
+                           groups, channel_last)
+    in_ndim = len(x_shape)
+    ch_axis = in_ndim - 1 if channel_last else 1
+    channels = x_shape[ch_axis]
+    bshape = tuple(_bcast_shape(in_ndim, ch_axis, channels))
+    axes = tuple(i for i in range(in_ndim) if i != ch_axis)
+    out_ch_axis = in_ndim - 1 if channel_last else 1
+    out_bshape = tuple(_bcast_shape(in_ndim, out_ch_axis, w_shape[0]))
+    actfn = _ACTS[act]
+
+    def fused(x, w, *rest):
+        gamma, beta = rest[-4], rest[-3]
+        rm, rv = rest[-2], rest[-1]
+        if training:
+            mu = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+        else:
+            mu, var = rm, rv
+        out = (x - mu.reshape(bshape)) * jax.lax.rsqrt(
+            var.reshape(bshape) + eps)
+        out = out * gamma.reshape(bshape)
+        out = out + beta.reshape(bshape)
+        c = convfn(actfn(out), w)
+        if has_bias:
+            c = c + rest[0].reshape(out_bshape)
+        if training:
+            return c, mu, var
+        return c
+    return fused
+
+
+def _static_key(stride, padding, dilation, nd):
+    """Hashable, nd-normalized (stride, padding, dilation) for the
+    lru_cache'd factories."""
+    if isinstance(padding, (list, tuple)):
+        padding = tuple(int(p) for p in padding)
+    elif not isinstance(padding, str):
+        padding = int(padding)
+    return _tuplen(stride, nd), padding, _tuplen(dilation, nd)
+
+
+def _prep(x, weight, bias, data_format):
+    x = to_tensor(x)
+    weight = to_tensor(weight)
+    bias = to_tensor(bias) if bias is not None else None
+    nd = weight.ndim - 2
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    return x, weight, bias, nd, channel_last
+
+
+def fused_conv_bn_act(x, weight, bn_weight, bn_bias, bias=None, stride=1,
+                      padding=0, dilation=1, groups=1, data_format="NCHW",
+                      epsilon=1e-05, act="relu", name=None):
+    """Training-mode fused block.  Returns ``(y, batch_mean, batch_var)``
+    Tensors — the caller owns the running-stat update (mirrors the
+    eager ``batch_norm`` contract)."""
+    x, weight, bias, nd, channel_last = _prep(x, weight, bias, data_format)
+    stride_k, pad_k, dil_k = _static_key(stride, padding, dilation, nd)
+    fn = _make_fused_train(tuple(x.shape), tuple(weight.shape),
+                           bias is not None, nd, stride_k, pad_k, dil_k,
+                           int(groups), channel_last, float(epsilon),
+                           act)
+    tensors = [x, weight] + ([bias] if bias is not None else []) + \
+        [to_tensor(bn_weight), to_tensor(bn_bias)]
+    return dispatch("fused_conv_bn_" + (act or "linear"), fn, tensors, {})
+
+
+def fused_conv_bn_act_infer(x, weight, bn_weight, bn_bias, running_mean,
+                            running_var, bias=None, stride=1, padding=0,
+                            dilation=1, groups=1, data_format="NCHW",
+                            epsilon=1e-05, act="relu", name=None):
+    """Inference-mode fused block: folded-constant form (one conv +
+    bias).  Tolerance-parity with the unfused math."""
+    x, weight, bias, nd, channel_last = _prep(x, weight, bias, data_format)
+    stride_k, pad_k, dil_k = _static_key(stride, padding, dilation, nd)
+    fn = _make_fused_infer(tuple(x.shape), tuple(weight.shape),
+                           bias is not None, nd, stride_k, pad_k, dil_k,
+                           int(groups), channel_last, float(epsilon),
+                           act)
+    tensors = [x, weight] + ([bias] if bias is not None else []) + \
+        [to_tensor(bn_weight), to_tensor(bn_bias),
+         to_tensor(running_mean), to_tensor(running_var)]
+    return dispatch("fused_conv_bn_" + (act or "linear") + "_infer", fn,
+                    tensors, {})
+
+
+def fused_conv_act(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                   groups=1, data_format="NCHW", act="relu", name=None):
+    """conv(+bias)+activation in one dispatch."""
+    x, weight, bias, nd, channel_last = _prep(x, weight, bias, data_format)
+    stride_k, pad_k, dil_k = _static_key(stride, padding, dilation, nd)
+    fn = _make_fused_conv_act(tuple(x.shape), tuple(weight.shape),
+                              bias is not None, nd, stride_k, pad_k,
+                              dil_k, int(groups), channel_last, act)
+    tensors = [x, weight] + ([bias] if bias is not None else [])
+    return dispatch("fused_conv_" + (act or "linear"), fn, tensors, {})
+
+
+def fused_bn_act_conv(x, weight, bn_weight, bn_bias, running_mean,
+                      running_var, bias=None, stride=1, padding=0,
+                      dilation=1, groups=1, data_format="NCHW",
+                      epsilon=1e-05, act="relu", training=False,
+                      name=None):
+    """Pre-activation fused block (norm → act → conv).  Training mode
+    returns ``(y, batch_mean, batch_var)``; eval returns ``y``."""
+    x, weight, bias, nd, channel_last = _prep(x, weight, bias, data_format)
+    stride_k, pad_k, dil_k = _static_key(stride, padding, dilation, nd)
+    fn = _make_fused_pre(tuple(x.shape), tuple(weight.shape),
+                         bias is not None, nd, stride_k, pad_k, dil_k,
+                         int(groups), channel_last, float(epsilon), act,
+                         bool(training))
+    tensors = [x, weight] + ([bias] if bias is not None else []) + \
+        [to_tensor(bn_weight), to_tensor(bn_bias),
+         to_tensor(running_mean), to_tensor(running_var)]
+    return dispatch("fused_bn_" + (act or "linear") + "_conv", fn,
+                    tensors, {})

@@ -26,9 +26,20 @@ from ..nn.layer_base import Layer
 from .. import nn
 
 __all__ = ["quantize_weights", "PostTrainingQuantization",
-           "QuantizedLinear", "fake_quantize_abs_max", "QAT",
-           "QuantizedW", "quantize_weight_int8",
-           "dequantize_weight_int8"]
+           "QuantizedLinear", "QuantizedConv2D", "fake_quantize_abs_max",
+           "QAT", "QuantizedW", "quantize_weight_int8",
+           "dequantize_weight_int8", "default_int8_axis"]
+
+
+def default_int8_axis(ndim: int) -> int:
+    """Per-channel quantization axis for a weight of rank ``ndim``:
+    conv kernels (rank >= 3, OIHW/OIW layout) quantize per OUTPUT
+    channel — axis 0 — matmul weights (in, out) per column — the last
+    axis.  Quantizing a conv kernel along its last spatial axis (the
+    pre-r10 behavior) shares one scale across all output channels of a
+    kernel column and costs real top-1; the serving artifacts record
+    the axis per key (``int8_axes``) so loaders never guess."""
+    return 0 if ndim >= 3 else ndim - 1
 
 
 class QuantizedW:
@@ -112,17 +123,82 @@ class QuantizedLinear(Layer):
         return f"{self.weight_q.shape}, {mode}"
 
 
+class QuantizedConv2D(Layer):
+    """Int8 conv: per-output-channel weight scales (axis 0 of the OIHW
+    kernel).  With a calibrated input scale the convolution runs fully
+    in int8 (int32 accumulation — the MXU's 2x-throughput int8 path);
+    without one it falls back to weight-only (dequantize W, fp conv).
+    """
+
+    def __init__(self, weight_int8, w_scales, bias=None, stride=1,
+                 padding=0, dilation=1, groups=1, data_format="NCHW",
+                 in_scale: Optional[float] = None, name=None):
+        super().__init__()
+        self.weight_q = jnp.asarray(weight_int8)        # (O, I/g, kh, kw)
+        self.w_scales = jnp.asarray(w_scales, jnp.float32)    # (O,)
+        self.bias = None if bias is None else jnp.asarray(bias)
+        self.in_scale = None if in_scale is None else float(in_scale)
+        self._cfg = dict(stride=stride, padding=padding,
+                         dilation=dilation, groups=groups,
+                         data_format=data_format)
+
+    def forward(self, x):
+        from ..ops import conv as conv_ops
+        x = to_tensor(x)
+        a = x._data
+        ch_axis = a.ndim - 1 if self._cfg["data_format"] in (
+            "NHWC", "NWC", "NDHWC") else 1
+        sshape = [1] * a.ndim
+        sshape[ch_axis] = -1
+        if self.in_scale is not None:
+            q = jnp.clip(jnp.round(a / self.in_scale), -127, 127) \
+                .astype(jnp.int8)
+            nd = self.weight_q.ndim - 2
+            dn = jax.lax.conv_dimension_numbers(
+                q.shape, self.weight_q.shape,
+                conv_ops._conv_dn(nd, ch_axis != 1))
+            stride = conv_ops._tuplen(self._cfg["stride"], nd)
+            dil = conv_ops._tuplen(self._cfg["dilation"], nd)
+            pad = conv_ops._norm_padding(
+                self._cfg["padding"], nd, stride,
+                self.weight_q.shape[2:], dil)
+            acc = jax.lax.conv_general_dilated(
+                q, self.weight_q, window_strides=stride, padding=pad,
+                rhs_dilation=dil, dimension_numbers=dn,
+                feature_group_count=self._cfg["groups"],
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * \
+                (self.in_scale * self.w_scales).reshape(sshape)
+        else:   # weight-only: dequant folds into the fp conv
+            w = self.weight_q.astype(jnp.float32) * \
+                self.w_scales.reshape((-1,) + (1,) *
+                                      (self.weight_q.ndim - 1))
+            return conv_ops.conv2d(x, Tensor(w),
+                                   None if self.bias is None
+                                   else Tensor(self.bias), **self._cfg)
+        if self.bias is not None:
+            out = out + self.bias.reshape(sshape)
+        return Tensor(out, stop_gradient=True)
+
+    def extra_repr(self):
+        mode = "static-int8" if self.in_scale is not None else \
+            "weight-only"
+        return f"{self.weight_q.shape}, {mode}"
+
+
 def quantize_weights(model: Layer) -> Layer:
-    """Weight-only int8: swap every nn.Linear for a QuantizedLinear with
-    per-output-channel scales (reference mkldnn int8 weight path).
-    Returns the model (mutated in place, eval-mode inference)."""
+    """Weight-only int8: swap every nn.Linear / nn.Conv2D for its
+    quantized counterpart with per-output-channel scales (reference
+    mkldnn int8 weight path).  Returns the model (mutated in place,
+    eval-mode inference)."""
     for name, sub in list(model.named_sublayers()):
-        _replace_linears(sub)
-    _replace_linears(model)
+        _replace_quantizable(sub)
+    _replace_quantizable(model)
     return model
 
 
-def _replace_linears(layer: Layer, in_scales: Optional[Dict] = None):
+def _replace_quantizable(layer: Layer, in_scales: Optional[Dict] = None):
+    from ..nn.layer.conv import Conv2D
     for attr, sub in list(layer._sub_layers.items()):
         if isinstance(sub, nn.Linear):
             w = np.asarray(sub.weight._data)             # (in, out)
@@ -132,18 +208,38 @@ def _replace_linears(layer: Layer, in_scales: Optional[Dict] = None):
                 else np.asarray(sub.bias._data)
             in_scale = None if in_scales is None else \
                 in_scales.get(id(sub))
-            from ..nn.layer_base import Layer as _L
-            _L._struct_version += 1
-            layer._sub_layers[attr] = QuantizedLinear(
-                q, scales, b, in_scale=in_scale)
+            # setattr, not a bare _sub_layers write: Layer.__setattr__
+            # mirrors sublayers into __dict__ for fast attribute access,
+            # and attribute-style models (self.fc = Linear(...)) would
+            # keep dispatching to the stale fp32 layer otherwise
+            setattr(layer, attr, QuantizedLinear(
+                q, scales, b, in_scale=in_scale))
+        elif isinstance(sub, Conv2D) and not sub._transposed:
+            w = np.asarray(sub.weight._data)             # (O, I/g, kh, kw)
+            scales = _per_channel_scales(w, axis=0)
+            q = _quantize(w, scales, axis=0)
+            b = None if getattr(sub, "bias", None) is None \
+                else np.asarray(sub.bias._data)
+            in_scale = None if in_scales is None else \
+                in_scales.get(id(sub))
+            setattr(layer, attr, QuantizedConv2D(
+                q, scales, b, stride=sub._stride, padding=sub._padding,
+                dilation=sub._dilation, groups=sub._groups,
+                data_format=sub._data_format, in_scale=in_scale))
         else:
-            _replace_linears(sub, in_scales)
+            _replace_quantizable(sub, in_scales)
+
+
+# historical name kept for external callers
+_replace_linears = _replace_quantizable
 
 
 class PostTrainingQuantization:
     """Static int8 PTQ (reference mkldnn_quantizer.cc /
-    PostTrainingQuantization): run calibration batches, record per-layer
-    input abs-max, then convert Linears to fully-int8 QuantizedLinears.
+    PostTrainingQuantization): run calibration batches from a sample
+    loader, record per-layer input abs-max, then convert Linears AND
+    Conv2Ds to their fully-int8 counterparts (per-output-channel weight
+    scales, per-tensor calibrated activation scales).
     """
 
     def __init__(self, model: Layer, algo: str = "abs_max"):
@@ -177,15 +273,17 @@ class PostTrainingQuantization:
 
     @staticmethod
     def _linears(layer) -> List:
+        from ..nn.layer.conv import Conv2D
         out = []
         for _, sub in layer.named_sublayers():
-            if isinstance(sub, nn.Linear):
+            if isinstance(sub, nn.Linear) or (
+                    isinstance(sub, Conv2D) and not sub._transposed):
                 out.append(sub)
         return out
 
     def convert(self) -> Layer:
         in_scales = {lid: r / 127.0 for lid, r in self._ranges.items()}
-        _replace_linears(self.model, in_scales)
+        _replace_quantizable(self.model, in_scales)
         return self.model
 
 

@@ -42,7 +42,8 @@ from ..program import OpDesc, Program
 from .pass_base import Pass, PassContext, PassResult, register_pass
 
 __all__ = ["ConstantFoldPass", "CsePass", "FusionGroupPass",
-           "OPT_PASS_PIPELINE", "ELEMENTWISE_OPS"]
+           "ConvBnFoldPass", "OPT_PASS_PIPELINE", "ELEMENTWISE_OPS",
+           "CONV_CHAIN_OPS"]
 
 # default transform pipeline CompiledProgram runs under FLAGS_program_opt
 # (after dead_op_eliminate; order matters: folding exposes CSE
@@ -77,6 +78,15 @@ ELEMENTWISE_OPS = frozenset({
     "reshape", "squeeze", "unsqueeze", "flatten", "transpose", "split",
     "softmax", "log_softmax",
 })
+
+# pure non-elementwise ops admitted into fusion chains for the conv leg:
+# conv itself plus batch_norm (the stats-UPDATE op writes parameters and
+# is excluded by the mutable-output rule; the forward batch_norm op is
+# pure).  Replay-in-order keeps them bit-exact exactly like the
+# elementwise members; batch_norm members additionally carry their
+# eval-mode lowering into the fused op (see _make_fused_impl) so
+# clone(for_test=True) of an optimized program keeps its semantics.
+CONV_CHAIN_OPS = frozenset({"conv1d", "conv2d", "conv3d", "batch_norm"})
 
 # don't bake constants bigger than this into the Program (they live on
 # host for the program's lifetime); folding is a size/time trade
@@ -294,20 +304,33 @@ class CsePass(Pass):
 def _make_fused_impl(members: Tuple[Tuple[object, Tuple[str, ...],
                                           Tuple[str, ...]], ...],
                      ext_in: Tuple[str, ...],
-                     out_names: Tuple[str, ...]):
+                     out_names: Tuple[str, ...],
+                     use_eval: bool = False):
     """Composite impl replaying ``members`` in order over a local env.
     Same impls, same order, same single-op HLO each — bit-exact with
-    the unfused replay."""
+    the unfused replay.  ``use_eval=True`` replays each member's
+    eval-mode lowering (falling back to its main impl), producing the
+    fused op's own ``eval_impl``."""
     def fused(*args):
         env = dict(zip(ext_in, args))
-        for impl, ins, outs in members:
-            r = impl(*[env[n] for n in ins])
+        for impl, eval_impl, ins, outs in members:
+            fn = eval_impl if (use_eval and eval_impl is not None) \
+                else impl
+            r = fn(*[env[n] for n in ins])
             r = r if isinstance(r, tuple) else (r,)
             for n, v in zip(outs, r):
                 env[n] = v
         res = tuple(env[n] for n in out_names)
         return res if len(res) > 1 else res[0]
     return fused
+
+
+def _fused_name(types):
+    """Bounded op-type name for a fusion group (conv chains in an eval
+    resnet can span dozens of members)."""
+    if len(types) <= 4:
+        return "fused_" + "_".join(types)
+    return "fused_" + "_".join(types[:3]) + f"_x{len(types)}"
 
 
 @register_pass("fusion_group")
@@ -322,10 +345,17 @@ class FusionGroupPass(Pass):
         mutable = set(program.parameters) | set(program.state_vars)
 
         def eligible(op: OpDesc) -> bool:
+            # elementwise members plus the conv leg (conv itself and
+            # pure batch_norm forwards); ops carrying an eval-mode
+            # lowering are admitted because the fused op re-derives its
+            # OWN eval_impl from the members' (clone(for_test) keeps
+            # working on optimized programs)
             return (op.kind == "compute" and op.idx not in pinned
-                    and op.type in ELEMENTWISE_OPS
+                    and (op.type in ELEMENTWISE_OPS
+                         or op.type in CONV_CHAIN_OPS)
                     and not op.attrs.get("__shape_probed__")
-                    and op.eval_impl is None
+                    and (op.eval_impl is None
+                         or op.type in CONV_CHAIN_OPS)
                     and bool(op.input_names)
                     and not any(n in mutable or n in multi
                                 for n in op.output_names))
@@ -385,16 +415,23 @@ class FusionGroupPass(Pass):
                         out_names.append(n)
             if not out_names:      # fully dead chain: DCE's job, not ours
                 continue
-            members = tuple((op.impl, tuple(op.input_names),
+            members = tuple((op.impl, op.eval_impl,
+                             tuple(op.input_names),
                              tuple(op.output_names)) for op in chain)
+            fused_eval = None
+            if any(op.eval_impl is not None for op in chain):
+                fused_eval = _make_fused_impl(members, tuple(ext_in),
+                                              tuple(out_names),
+                                              use_eval=True)
             fused = OpDesc(
-                "fused_" + "_".join(op.type for op in chain),
+                _fused_name([op.type for op in chain]),
                 "compute",
                 _make_fused_impl(members, tuple(ext_in),
                                  tuple(out_names)),
                 ext_in, out_names,
                 {"__fused__": True,
-                 "__fused_ops__": [op.type for op in chain]})
+                 "__fused_ops__": [op.type for op in chain]},
+                eval_impl=fused_eval)
             replace[chain[0].idx] = fused
             drop.update(idxs - {chain[0].idx})
             total += len(chain)
@@ -414,3 +451,128 @@ class FusionGroupPass(Pass):
             "fusion-summary",
             f"fused {total} op(s) into {len(replace)} group(s): "
             f"{[op.attrs['__fused_ops__'] for op in replace.values()]}")
+
+
+@register_pass("conv_bn_fold")
+class ConvBnFoldPass(Pass):
+    """Folded-constant inference form for eval-mode conv→batch_norm
+    (→relu) pairs: the BN affine collapses into the conv weights —
+    ``conv(x, w·s) + t`` — one conv + bias instead of conv + normalize.
+
+    NOT bit-exact (the fold reassociates the per-channel multiply), so
+    this pass is excluded from the default ``FLAGS_program_opt``
+    pipeline and runs only under ``FLAGS_conv_bn_fold`` — the serving
+    opt-in.  The per-channel (s, t) are extracted by PROBING the bn
+    op's own impl (``bn(1)−bn(0)`` and ``bn(0)``: eval batch_norm is
+    affine per channel), so the exact epsilon/weight/bias semantics of
+    the captured op are reproduced without closure introspection; with
+    constant stats XLA folds the probe at compile time.
+
+    Eligibility: the conv is bias-free (2 inputs), nothing else reads
+    the conv output, the bn op is in eval form — its impl IS its
+    eval lowering (a ``clone(for_test=True)`` program), or no
+    ``batch_norm_stats`` op consumes the conv output (a program
+    captured under ``model.eval()``).
+    """
+
+    is_transform = True
+
+    def run(self, program, context: PassContext, result: PassResult):
+        import jax
+        import jax.numpy as jnp
+        pinned = _vjp_pinned(program)
+        multi = _multi_def(program)
+        mutable = set(program.parameters) | set(program.state_vars)
+        fetches = set(context.fetch_names)
+        consumers: Dict[str, List[int]] = {}
+        for op in program.ops:
+            for n in op.input_names:
+                consumers.setdefault(n, []).append(op.idx)
+
+        stats_inputs = {n for op in program.ops
+                        if op.type == "batch_norm_stats"
+                        for n in op.input_names}
+
+        drop: Set[int] = set()
+        replace: Dict[int, OpDesc] = {}
+        folded = 0
+        ops = [op for op in program.ops if op.kind == "compute"]
+        for i, conv in enumerate(ops[:-1]):
+            if conv.type not in ("conv1d", "conv2d", "conv3d"):
+                continue
+            if conv.idx in pinned or conv.idx in drop:
+                continue
+            if len(conv.input_names) != 2:      # conv bias: t would
+                continue                        # double-apply the scale
+            bn = ops[i + 1]
+            if bn.type != "batch_norm" or bn.idx in pinned:
+                continue
+            cout = conv.output_names[0]
+            if bn.input_names[0] != cout or cout in fetches:
+                continue
+            if any(n in mutable or n in multi for n in
+                   conv.output_names + bn.output_names):
+                continue
+            # every consumer of the conv output must be this bn (or the
+            # stats op we refuse below)
+            if set(consumers.get(cout, ())) - {bn.idx}:
+                continue
+            eval_form = bn.impl is bn.eval_impl or (
+                bn.eval_impl is not None and cout not in stats_inputs
+                and not any(n in stats_inputs for n in bn.output_names))
+            if not eval_form and cout in stats_inputs:
+                continue
+            bn_fn = bn.eval_impl if bn.eval_impl is not None else bn.impl
+            conv_fn = conv.impl
+            # optional trailing relu joins the folded op
+            act = None
+            bnout = bn.output_names[0]
+            if i + 2 < len(ops):
+                nxt = ops[i + 2]
+                if nxt.type == "relu" and nxt.idx not in pinned and \
+                        nxt.input_names == [bnout] and \
+                        bnout not in fetches and \
+                        set(consumers.get(bnout, ())) == {nxt.idx} and \
+                        not any(n in mutable or n in multi
+                                for n in nxt.output_names):
+                    act = nxt
+
+            def folded_impl(x, w, *bn_rest, _conv=conv_fn, _bn=bn_fn,
+                            _act=(act.impl if act is not None else None)):
+                probe = jnp.zeros((1,) * x.ndim, x.dtype)
+                t = _bn(probe, *bn_rest)
+                s = _bn(jnp.ones((1,) * x.ndim, x.dtype), *bn_rest) - t
+                wf = w * s.reshape((-1,) + (1,) * (w.ndim - 1))
+                y = _conv(x, wf) + t
+                if _act is not None:
+                    y = _act(y)
+                return y
+
+            out_op = act if act is not None else bn
+            in_names = list(conv.input_names) + list(bn.input_names[1:])
+            new_op = OpDesc(
+                "fused_conv_bn_folded" + ("_relu" if act is not None
+                                          else ""),
+                "compute", folded_impl, in_names,
+                list(out_op.output_names),
+                {"__fused__": True, "__folded__": True,
+                 "__fused_ops__": [conv.type, "batch_norm"]
+                 + (["relu"] if act is not None else [])})
+            replace[conv.idx] = new_op
+            drop.add(bn.idx)
+            if act is not None:
+                drop.add(act.idx)
+            folded += 1
+        if not replace:
+            result.program = program
+            return
+        result.program = _rebuild(program, drop, replace=replace)
+        from ...profiler import metrics as _metrics
+        _metrics.counter(
+            "static.pass.conv_bn_folded",
+            "conv+batch_norm(+relu) chains rewritten to the "
+            "folded-constant inference form").inc(folded)
+        result.info(
+            "conv-bn-fold-summary",
+            f"folded {folded} conv+bn pair(s) into folded-constant "
+            "inference convs")

@@ -711,7 +711,14 @@ class CompiledProgram:
             from .passes import OPT_PASS_PIPELINE
             skip = {s.strip() for s in str(_flags.get_flag(
                 "FLAGS_program_opt_skip")).split(",") if s.strip()}
-            names.extend(n for n in OPT_PASS_PIPELINE if n not in skip)
+            pipeline = list(OPT_PASS_PIPELINE)
+            if _flags.get_flag("FLAGS_conv_bn_fold"):
+                # folded-constant inference conv (NOT bit-exact — the
+                # serving opt-in); must run before fusion_group or the
+                # conv/bn pairs are already inside fused composites
+                pipeline.insert(pipeline.index("fusion_group"),
+                                "conv_bn_fold")
+            names.extend(n for n in pipeline if n not in skip)
         if not names:
             return self.program
         return _passes_cached(self.program, fetch_names, tuple(names),

@@ -205,6 +205,33 @@ define_flag("FLAGS_straggler_patience", 3,
             "(launch.straggler metric + supervise report JSON) and — "
             "under launch --evict_stragglers — the gang is re-formed "
             "without that host via a rendezvous denylist entry")
+define_flag("FLAGS_fused_conv", True,
+            "dispatch conv+batch_norm+activation blocks as ONE fused op "
+            "(ops/fused_conv.py): training mode runs conv -> fold BN "
+            "scale/shift -> activation in a single jitted call whose "
+            "custom_vjp backward recomputes the cheap epilogue instead "
+            "of saving normalized/mask intermediates; inference mode "
+            "folds the BN constants into the conv weights.  Adopted by "
+            "the vision conv models behind nn.functional.fused_conv_bn; "
+            "0 falls back to the eager conv/bn/act composition "
+            "(bit-parity-pinned by tests/test_fused_conv.py)")
+define_flag("FLAGS_fused_optimizer", True,
+            "apply Momentum/Adam/AdamW updates as one fused kernel per "
+            "stacked same-shape parameter group instead of one dispatch "
+            "per leaf (optimizer/fused_update.py): parameters sharing "
+            "(shape, dtype, decay config) stack into a (G, ...) array "
+            "and update under jax.vmap — per-element math identical to "
+            "the per-leaf loop (bit-parity-pinned), dispatched-op count "
+            "drops from O(params) to O(groups).  0 restores the "
+            "per-leaf reference path")
+define_flag("FLAGS_conv_bn_fold", False,
+            "static-program pass: rewrite eval-form conv->batch_norm"
+            "(->relu) chains into the folded-constant inference form "
+            "(BN scale/shift folded into the conv weights — one conv + "
+            "bias instead of conv + normalize).  Changes rounding "
+            "(tolerance-level, not bit-exact), so it is OFF by default "
+            "and excluded from the FLAGS_program_opt bit-exact "
+            "pipeline; serving programs opt in for the latency win")
 define_flag("FLAGS_prefetch_to_device", 2,
             "default device-prefetch depth used by Model.fit's train "
             "loop (batches kept resident on device by the io "

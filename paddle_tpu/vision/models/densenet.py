@@ -2,11 +2,14 @@
 
 Dense blocks concatenate every prior feature map; on TPU the concats are
 pure layout ops XLA folds into the following 1x1 conv's MXU matmul.
+The pre-activation bn->relu->conv blocks dispatch as one fused op via
+``nn.functional.fused_conv_bn(pre_norm=True)`` behind ``FLAGS_fused_conv``.
 """
 from __future__ import annotations
 
 from ... import ops as P
 from ... import nn
+from ...nn import functional as F
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201", "densenet264"]
@@ -33,8 +36,10 @@ class _DenseLayer(nn.Layer):
         self.relu = nn.ReLU()
 
     def forward(self, x):
-        out = self.conv1(self.relu(self.bn1(x)))
-        out = self.conv2(self.relu(self.bn2(out)))
+        out = F.fused_conv_bn(x, self.conv1, self.bn1, act="relu",
+                              pre_norm=True)
+        out = F.fused_conv_bn(out, self.conv2, self.bn2, act="relu",
+                              pre_norm=True)
         if self.dropout is not None:
             out = self.dropout(out)
         return P.concat([x, out], axis=1)
@@ -62,7 +67,8 @@ class _Transition(nn.Layer):
         self.relu = nn.ReLU()
 
     def forward(self, x):
-        return self.pool(self.conv(self.relu(self.bn(x))))
+        return self.pool(F.fused_conv_bn(x, self.conv, self.bn,
+                                         act="relu", pre_norm=True))
 
 
 class DenseNet(nn.Layer):
@@ -99,7 +105,8 @@ class DenseNet(nn.Layer):
             self.classifier = nn.Linear(chans, num_classes)
 
     def forward(self, x):
-        x = self.pool0(self.relu(self.bn0(self.conv0(x))))
+        x = self.pool0(F.fused_conv_bn(x, self.conv0, self.bn0,
+                                       act="relu"))
         for b in self.blocks:
             x = b(x)
         x = self.relu(self.bn_last(x))

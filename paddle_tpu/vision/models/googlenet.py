@@ -3,6 +3,37 @@ from __future__ import annotations
 
 from ... import nn
 from ... import ops
+from ...nn import functional as F
+
+
+def _hooked(layer):
+    return layer._forward_pre_hooks or layer._forward_post_hooks
+
+
+def _fused_seq(x, seq):
+    """Run a Sequential through the fused dispatch, deriving the layout
+    from its live members instead of hard-coded indices: each Conv2D
+    immediately followed by ReLU collapses into one fused conv+relu;
+    everything else (pools, PTQ-swapped quantized convs, ...) runs
+    as-is in order.  Registered forward hooks stay an observable
+    contract: a hooked container runs as a plain Sequential, and a
+    hooked ReLU member keeps its pair on the module path (hooked convs
+    already force the eager fallback inside ``fused_conv_bn``)."""
+    if _hooked(seq):
+        return seq(x)
+    subs = list(seq._sub_layers.values())
+    i = 0
+    while i < len(subs):
+        m = subs[i]
+        if isinstance(m, nn.Conv2D) and i + 1 < len(subs) and \
+                isinstance(subs[i + 1], nn.ReLU) and \
+                not _hooked(subs[i + 1]):
+            x = F.fused_conv_bn(x, m, None, act="relu")
+            i += 2
+        else:
+            x = m(x)
+            i += 1
+    return x
 
 __all__ = ["GoogLeNet", "googlenet"]
 
@@ -19,8 +50,8 @@ class _Inception(nn.Layer):
                                 nn.Conv2D(in_c, c4, 1), nn.ReLU())
 
     def forward(self, x):
-        return ops.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
-                          axis=1)
+        return ops.concat([_fused_seq(x, b) for b in
+                           (self.b1, self.b2, self.b3, self.b4)], axis=1)
 
 
 class GoogLeNet(nn.Layer):
@@ -48,7 +79,7 @@ class GoogLeNet(nn.Layer):
         self.fc = nn.Linear(1024, num_classes)
 
     def forward(self, x):
-        x = self.stem(x)
+        x = _fused_seq(x, self.stem)
         x = self.pool3(self.i3b(self.i3a(x)))
         x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
         x = self.i5b(self.i5a(x))

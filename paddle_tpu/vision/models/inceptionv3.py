@@ -7,6 +7,7 @@ from __future__ import annotations
 
 from ... import ops as P
 from ... import nn
+from ...nn import functional as F
 
 __all__ = ["InceptionV3", "inception_v3"]
 
@@ -20,7 +21,7 @@ class _ConvBN(nn.Layer):
         self.relu = nn.ReLU()
 
     def forward(self, x):
-        return self.relu(self.bn(self.conv(x)))
+        return F.fused_conv_bn(x, self.conv, self.bn, act="relu")
 
 
 class _InceptionA(nn.Layer):

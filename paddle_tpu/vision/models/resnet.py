@@ -1,14 +1,35 @@
 """ResNet family (reference python/paddle/vision/models/resnet.py).
 
 The BASELINE north-star model: ResNet50 imgs/sec/chip.  Convs stay NCHW
-(XLA lays out for the MXU internally); BN fuses with conv via XLA.
+(XLA lays out for the MXU internally).  Every conv/bn/relu block runs
+through ``nn.functional.fused_conv_bn`` — one fused dispatch per block
+behind ``FLAGS_fused_conv`` (custom-vjp training kernel, folded-constant
+inference form), falling back to the eager composition when the flag is
+off or the mode (static capture, AMP) owns fusion elsewhere.
 """
 from __future__ import annotations
 
 from ... import nn
+from ...nn import functional as F
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnet152", "wide_resnet50_2", "wide_resnet101_2"]
+
+
+def _downsample(ds, x):
+    """Identity-branch dispatch: fuse the canonical Sequential(conv, bn)
+    the factories build; any user-supplied downsample — or one carrying
+    forward hooks on the container — stays an arbitrary callable module
+    (the pre-r10 contract; hooks on the conv/bn members already force
+    the eager fallback inside ``fused_conv_bn``)."""
+    from ...nn.layer.norm import _BatchNormBase
+    subs = list(ds._sub_layers.values()) if isinstance(ds, nn.Sequential) \
+        else []
+    if len(subs) == 2 and isinstance(subs[0], nn.Conv2D) and \
+            isinstance(subs[1], _BatchNormBase) and \
+            not (ds._forward_pre_hooks or ds._forward_post_hooks):
+        return F.fused_conv_bn(x, subs[0], subs[1], act=None)
+    return ds(x)
 
 
 class BasicBlock(nn.Layer):
@@ -29,10 +50,10 @@ class BasicBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.bn2(self.conv2(out))
+        out = F.fused_conv_bn(x, self.conv1, self.bn1, act="relu")
+        out = F.fused_conv_bn(out, self.conv2, self.bn2, act=None)
         if self.downsample is not None:
-            identity = self.downsample(x)
+            identity = _downsample(self.downsample, x)
         return self.relu(out + identity)
 
 
@@ -58,11 +79,11 @@ class BottleneckBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.relu(self.bn2(self.conv2(out)))
-        out = self.bn3(self.conv3(out))
+        out = F.fused_conv_bn(x, self.conv1, self.bn1, act="relu")
+        out = F.fused_conv_bn(out, self.conv2, self.bn2, act="relu")
+        out = F.fused_conv_bn(out, self.conv3, self.bn3, act=None)
         if self.downsample is not None:
-            identity = self.downsample(x)
+            identity = _downsample(self.downsample, x)
         return self.relu(out + identity)
 
 
@@ -113,7 +134,7 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        x = F.fused_conv_bn(x, self.conv1, self.bn1, act="relu")
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -1,0 +1,354 @@
+"""Fused conv+BN+activation parity matrix (paddle_tpu/ops/fused_conv.py).
+
+Contract under test (see ops/fused_conv.py):
+- training-mode fused forward is BIT-EXACT with the eager
+  conv/batch_norm/act composition (same elementwise sequence);
+- the custom-vjp backward (recompute-epilogue) matches autodiff of the
+  unfused chain at float32 tolerance, including over a 3-step training
+  loop;
+- inference mode folds BN constants into the conv weights
+  (tolerance-level parity — the fold reassociates the multiply);
+- ``FLAGS_fused_conv=0`` restores the eager composition exactly;
+- the vision model factories produce the same numbers fused/unfused.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.utils import flags as fl
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    was = fl.get_flags(["FLAGS_fused_conv", "FLAGS_fused_optimizer"])
+    yield
+    fl.set_flags(was)
+
+
+def _block(groups=1, dilation=1, bias=False, channels=(3, 8)):
+    paddle.seed(0)
+    cin, cout = channels
+    conv = nn.Conv2D(cin, cout, 3, padding=dilation, dilation=dilation,
+                     groups=groups, bias_attr=None if bias else False)
+    bn = nn.BatchNorm2D(cout)
+    return conv, bn
+
+
+def _x(shape=(2, 3, 8, 8), seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).rand(*shape).astype("float32"))
+
+
+def _reset_bn(bn, rm, rv):
+    bn._mean._data = jnp.asarray(rm)
+    bn._variance._data = jnp.asarray(rv)
+
+
+@pytest.mark.parametrize("act", ["relu", None])
+@pytest.mark.parametrize("groups,dilation,bias",
+                         [(1, 1, False), (2, 1, False), (1, 2, False),
+                          (1, 1, True)])
+def test_train_forward_bit_exact(act, groups, dilation, bias):
+    cin = 4 if groups == 2 else 3
+    conv, bn = _block(groups=groups, dilation=dilation, bias=bias,
+                      channels=(cin, 8))
+    conv.train(), bn.train()
+    x = _x((2, cin, 8, 8))
+    rm = np.asarray(bn._mean.numpy())
+    rv = np.asarray(bn._variance.numpy())
+
+    fl.set_flags({"FLAGS_fused_conv": False})
+    ref = F.fused_conv_bn(x, conv, bn, act=act).numpy()
+    rm_ref, rv_ref = bn._mean.numpy().copy(), bn._variance.numpy().copy()
+
+    _reset_bn(bn, rm, rv)
+    fl.set_flags({"FLAGS_fused_conv": True})
+    out = F.fused_conv_bn(x, conv, bn, act=act).numpy()
+
+    np.testing.assert_array_equal(out, ref)
+    # running-stat updates bit-match the eager batch_norm contract
+    np.testing.assert_array_equal(bn._mean.numpy(), rm_ref)
+    np.testing.assert_array_equal(bn._variance.numpy(), rv_ref)
+
+
+def test_backward_matches_autodiff():
+    conv, bn = _block()
+    conv.train(), bn.train()
+    rm = np.asarray(bn._mean.numpy())
+    rv = np.asarray(bn._variance.numpy())
+
+    def grads(fused):
+        fl.set_flags({"FLAGS_fused_conv": fused})
+        _reset_bn(bn, rm, rv)
+        for p in (conv.weight, bn.weight, bn.bias):
+            p.clear_gradient()
+        xt = _x()
+        xt.stop_gradient = False
+        loss = paddle.sum(F.fused_conv_bn(xt, conv, bn, act="relu") ** 2)
+        loss.backward()
+        return [xt.grad.numpy(), conv.weight.grad.numpy(),
+                bn.weight.grad.numpy(), bn.bias.grad.numpy()]
+
+    got = grads(True)
+    ref = grads(False)
+    for g, r, name in zip(got, ref, ("x", "w", "gamma", "beta")):
+        np.testing.assert_allclose(g, r, rtol=2e-4, atol=2e-5,
+                                   err_msg=f"grad {name}")
+
+
+@pytest.mark.slow
+def test_three_step_training_parity():
+    """Slow tier: tools/kernel_gate.py runs the 10-step variant of this
+    check in every CI sweep; tier-1 keeps the per-op parity tests."""
+    def run(fused):
+        paddle.seed(11)
+        fl.set_flags({"FLAGS_fused_conv": fused,
+                      "FLAGS_fused_optimizer": False})
+        net = paddle.vision.models.resnet18(num_classes=10)
+        model = paddle.Model(net)
+        # small lr: the comparison must measure the backward's float32
+        # tolerance, not chaotic trajectory divergence on a tiny batch
+        opt = paddle.optimizer.Momentum(0.001, 0.9,
+                                        parameters=net.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        rng = np.random.RandomState(11)
+        x = np.asarray(rng.rand(4, 3, 32, 32), np.float32)
+        y = np.asarray(rng.randint(0, 10, (4, 1)), np.int32)
+        losses = [float(model.train_batch([x], [y])["loss"])
+                  for _ in range(3)]
+        params = {n: np.asarray(p.numpy())
+                  for n, p in net.named_parameters()}
+        return losses, params
+
+    l_on, p_on = run(True)
+    l_off, p_off = run(False)
+    assert abs(l_on[0] - l_off[0]) <= 1e-6     # step 1: fwd bit-exact
+    np.testing.assert_allclose(l_on, l_off, rtol=1e-2)
+    # early-layer grads see the backward's float reassociation amplified
+    # through the whole depth — parity is rtol+atol, not per-element
+    # relative alone (near-zero params have huge relative noise)
+    for n in p_off:
+        np.testing.assert_allclose(p_on[n], p_off[n], rtol=2e-2,
+                                   atol=1e-3, err_msg=n)
+
+
+def test_inference_folded_parity():
+    conv, bn = _block()
+    # give the running stats non-trivial values
+    bn._mean._data = jnp.asarray(
+        np.random.RandomState(1).randn(8).astype("float32") * 0.1)
+    bn._variance._data = jnp.asarray(
+        1.0 + np.random.RandomState(2).rand(8).astype("float32"))
+    conv.eval(), bn.eval()
+    x = _x()
+    fl.set_flags({"FLAGS_fused_conv": False})
+    ref = F.fused_conv_bn(x, conv, bn, act="relu").numpy()
+    fl.set_flags({"FLAGS_fused_conv": True})
+    out = F.fused_conv_bn(x, conv, bn, act="relu").numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_act_no_bn():
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 8, 3, padding=1)       # with bias (GoogLeNet)
+    x = _x()
+    fl.set_flags({"FLAGS_fused_conv": False})
+    ref = F.fused_conv_bn(x, conv, None, act="relu").numpy()
+    fl.set_flags({"FLAGS_fused_conv": True})
+    out = F.fused_conv_bn(x, conv, None, act="relu").numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.parametrize("training", [True, False])
+def test_pre_norm_densenet_order(training):
+    paddle.seed(0)
+    conv = nn.Conv2D(8, 4, 3, padding=1, bias_attr=False)
+    bn = nn.BatchNorm2D(8)          # pre-activation: norms the INPUT
+    conv.train() if training else conv.eval()
+    bn.train() if training else bn.eval()
+    x = _x((2, 8, 6, 6))
+    rm = np.asarray(bn._mean.numpy())
+    rv = np.asarray(bn._variance.numpy())
+    fl.set_flags({"FLAGS_fused_conv": False})
+    ref = F.fused_conv_bn(x, conv, bn, act="relu", pre_norm=True).numpy()
+    rm_ref = bn._mean.numpy().copy()
+    _reset_bn(bn, rm, rv)
+    fl.set_flags({"FLAGS_fused_conv": True})
+    out = F.fused_conv_bn(x, conv, bn, act="relu", pre_norm=True).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(bn._mean.numpy(), rm_ref, rtol=1e-6)
+
+
+def test_fused_layer_state_dict_roundtrip():
+    paddle.seed(0)
+    layer = nn.FusedConvBNReLU(3, 8, 3, padding=1)
+    layer.train()
+    x = _x()
+    out = layer(x).numpy()
+    # state dict names mirror an unfused conv/bn pair
+    sd = layer.state_dict()
+    assert any(k.startswith("conv.") for k in sd)
+    assert any(k.startswith("bn.") for k in sd)
+    paddle.seed(1)
+    other = nn.FusedConvBNReLU(3, 8, 3, padding=1)
+    other.set_state_dict(sd)
+    other.train()
+    np.testing.assert_array_equal(other(x).numpy(), out)
+
+
+def test_sync_batchnorm_not_silently_fused():
+    """Subclassed norms (SyncBatchNorm) keep their own forward."""
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 8, 3, padding=1, bias_attr=False)
+    bn = nn.SyncBatchNorm(8)
+    x = _x()
+    fl.set_flags({"FLAGS_fused_conv": True})
+    out = F.fused_conv_bn(x, conv, bn, act="relu").numpy()
+    fl.set_flags({"FLAGS_fused_conv": False})
+    # reset stats drift from the first call
+    bn._mean._data = jnp.zeros_like(bn._mean._data)
+    bn._variance._data = jnp.ones_like(bn._variance._data)
+    ref = F.fused_conv_bn(x, conv, bn, act="relu").numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+@pytest.mark.parametrize("factory,shape", [
+    ("resnet18", (1, 3, 32, 32)),
+    pytest.param("densenet121", (1, 3, 32, 32),
+                 marks=pytest.mark.slow),
+    pytest.param("googlenet", (1, 3, 64, 64),
+                 marks=pytest.mark.slow),
+])
+def test_model_factory_parity(factory, shape):
+    paddle.seed(0)
+    net = getattr(paddle.vision.models, factory)(num_classes=10)
+    x = _x(shape)
+    net.eval()
+    fl.set_flags({"FLAGS_fused_conv": False})
+    ref = net(x).numpy()
+    fl.set_flags({"FLAGS_fused_conv": True})
+    out = net(x).numpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_inceptionv3_factory_parity():
+    paddle.seed(0)
+    net = paddle.vision.models.inception_v3(num_classes=10)
+    x = _x((1, 3, 75, 75))
+    net.eval()
+    fl.set_flags({"FLAGS_fused_conv": False})
+    ref = net(x).numpy()
+    fl.set_flags({"FLAGS_fused_conv": True})
+    out = net(x).numpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=1e-4)
+
+
+def test_static_capture_falls_back_to_composition():
+    """Program capture must see the 3-op composition (the program-level
+    fusion_group pass owns fusion there)."""
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.jit.dy2static.program_translator import \
+        ProgramTranslator
+
+    paddle.seed(0)
+    net = paddle.vision.models.resnet18(num_classes=4)
+    net.eval()
+    fl.set_flags({"FLAGS_fused_conv": True})
+    prog, _, _ = ProgramTranslator().get_program(
+        net.forward, [InputSpec([1, 3, 32, 32], "float32", name="x")])
+    types = {op.type for op in prog.ops}
+    assert "conv2d" in types and "batch_norm" in types
+    assert not any(t.startswith("fused_conv_bn") for t in types)
+
+
+def test_conv1d_bn1d_fused_parity():
+    """1d blocks fuse too (BatchNorm1D is whitelisted): train forward
+    bit-exact vs the eager composition, and the block dispatches as ONE
+    fused op, not three."""
+    from paddle_tpu.profiler import tracer
+
+    paddle.seed(0)
+    conv = nn.Conv1D(3, 8, 3, padding=1, bias_attr=False)
+    bn = nn.BatchNorm1D(8)
+    conv.train(), bn.train()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 3, 16).astype("float32"))
+    rm = np.asarray(bn._mean.numpy())
+    rv = np.asarray(bn._variance.numpy())
+
+    fl.set_flags({"FLAGS_fused_conv": False})
+    ref = F.fused_conv_bn(x, conv, bn, act="relu").numpy()
+    rm_ref = bn._mean.numpy().copy()
+
+    _reset_bn(bn, rm, rv)
+    fl.set_flags({"FLAGS_fused_conv": True})
+    F.fused_conv_bn(x, conv, bn, act="relu")      # warm the factory
+    _reset_bn(bn, rm, rv)
+    tracer.enable()
+    tracer.clear()
+    out = F.fused_conv_bn(x, conv, bn, act="relu").numpy()
+    ops = set(tracer.op_table())
+    tracer.disable()
+    tracer.clear()
+
+    np.testing.assert_array_equal(out, ref)
+    np.testing.assert_array_equal(bn._mean.numpy(), rm_ref)
+    assert ops == {"fused_conv_bn_relu"}, ops
+
+
+def test_hooked_conv_falls_back_to_eager():
+    """Registered forward hooks are an observable contract (PTQ
+    calibration records conv inputs via pre-hooks) — they only fire
+    through Layer.__call__, so a hooked conv must take the eager
+    composition even with FLAGS_fused_conv=1."""
+    conv, bn = _block()
+    x = _x()
+    seen = []
+
+    def hook(layer, inputs):
+        seen.append(float(np.abs(inputs[0].numpy()).max()))
+
+    h = conv.register_forward_pre_hook(hook)
+    try:
+        fl.set_flags({"FLAGS_fused_conv": True})
+        out = F.fused_conv_bn(x, conv, bn, act="relu").numpy()
+    finally:
+        h.remove()
+    assert seen, "pre-hook did not fire under FLAGS_fused_conv=1"
+    # with the hook removed the fused path resumes, numerics unchanged
+    bn._mean._data = jnp.zeros_like(bn._mean._data)
+    bn._variance._data = jnp.ones_like(bn._variance._data)
+    fused = F.fused_conv_bn(x, conv, bn, act="relu").numpy()
+    np.testing.assert_array_equal(fused, out)
+
+
+def test_custom_downsample_callable_contract():
+    """BasicBlock/BottleneckBlock accept an arbitrary callable module as
+    ``downsample`` (pre-r10 contract) — only the canonical
+    Sequential(conv, bn) is routed through the fused dispatch."""
+    from paddle_tpu.vision.models.resnet import BasicBlock
+
+    paddle.seed(0)
+    blk = BasicBlock(8, 8, stride=2,
+                     downsample=nn.Conv2D(8, 8, 1, stride=2))
+    blk.eval()
+    out = blk(_x((2, 8, 8, 8)))
+    assert tuple(out.shape) == (2, 8, 4, 4)
+
+    # three-member Sequential (ResNet-D style) must run ALL members
+    ds = nn.Sequential(nn.AvgPool2D(2, 2), nn.Conv2D(8, 8, 1),
+                       nn.BatchNorm2D(8))
+    blk2 = BasicBlock(8, 8, stride=2, downsample=ds)
+    blk2.eval()
+    ref = ds(_x((2, 8, 8, 8))).numpy()
+    # fused main path is tolerance-level vs the eager composition in
+    # eval mode (folded constants)
+    np.testing.assert_allclose(
+        np.maximum(ref + blk2.bn2(blk2.conv2(blk2.relu(
+            blk2.bn1(blk2.conv1(_x((2, 8, 8, 8))))))).numpy(), 0),
+        blk2(_x((2, 8, 8, 8))).numpy(), rtol=1e-4, atol=1e-5)

@@ -13,6 +13,7 @@ import os
 import subprocess
 import sys
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -880,3 +881,114 @@ class TestFrameworkLint:
             capture_output=True, text=True, cwd=REPO)
         assert proc.returncode == 1
         assert "MD01" in proc.stdout and "NEW" in proc.stdout
+
+
+class TestConvChainFusion:
+    """r10 fusion_group extension (conv/batch_norm chains) and the
+    conv_bn_fold folded-constant inference pass."""
+
+    def _run(self, prog, fetch, feed, **flag_kv):
+        exe = static.Executor()
+        names = ["FLAGS_program_opt", "FLAGS_program_opt_skip",
+                 "FLAGS_conv_bn_fold"]
+        saved = flags_mod.get_flags(names)
+        flags_mod.set_flags({"FLAGS_program_opt": True,
+                             "FLAGS_program_opt_skip": "",
+                             "FLAGS_conv_bn_fold": False,
+                             **flag_kv})
+        try:
+            comp = static.CompiledProgram(prog)
+            outs = exe.run(comp, feed=feed, fetch_list=fetch,
+                           use_program_cache=False)
+            fetch_names = tuple(f if isinstance(f, str) else f.name
+                                for f in fetch)
+            return outs, comp._optimized_program(fetch_names)
+        finally:
+            flags_mod.set_flags(saved)
+
+    def _conv_block_program(self, train=False):
+        """Captured conv -> batch_norm -> relu (eval form by default)."""
+        import paddle_tpu.nn as pnn
+        paddle.seed(0)
+        conv = pnn.Conv2D(3, 4, 3, padding=1, bias_attr=False)
+        bn = pnn.BatchNorm2D(4)
+        bn._mean._data = jnp.asarray(
+            np.random.RandomState(1).randn(4).astype("float32") * 0.1)
+        bn._variance._data = jnp.asarray(
+            1.0 + np.random.RandomState(2).rand(4).astype("float32"))
+        conv.train() if train else conv.eval()
+        bn.train() if train else bn.eval()
+        saved = flags_mod.get_flags(["FLAGS_fused_conv"])
+        flags_mod.set_flags({"FLAGS_fused_conv": False})
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                paddle.enable_static()
+                try:
+                    x = static.data("x", [2, 3, 8, 8], "float32")
+                    import paddle_tpu.nn.functional as F
+                    out = F.relu(bn(conv(x)))
+                finally:
+                    paddle.disable_static()
+        finally:
+            flags_mod.set_flags(saved)
+        return main, out
+
+    def test_conv_bn_relu_chain_fuses_bit_exact(self):
+        main, out = self._conv_block_program()
+        assert {op.type for op in main.ops} >= {"conv2d", "batch_norm",
+                                                "relu"}
+        xb = np.random.RandomState(0).rand(2, 3, 8, 8).astype("float32")
+        saved = flags_mod.get_flags(["FLAGS_program_opt"])
+        flags_mod.set_flags({"FLAGS_program_opt": False})
+        try:
+            ref = static.Executor().run(
+                static.CompiledProgram(main), feed={"x": xb},
+                fetch_list=[out], use_program_cache=False)
+        finally:
+            flags_mod.set_flags(saved)
+        opt, prog = self._run(main, [out], {"x": xb})
+        assert np.array_equal(ref[0], opt[0])
+        fused = [op for op in prog.ops
+                 if op.attrs.get("__fused__")]
+        assert fused, "conv chain did not fuse"
+        members = sum((op.attrs["__fused_ops__"] for op in fused), [])
+        assert "conv2d" in members and "batch_norm" in members \
+            and "relu" in members
+
+    def test_fused_conv_chain_keeps_eval_lowering(self):
+        """A fused op whose members carry eval_impl re-derives its own
+        eval_impl, so clone(for_test=True) of an optimized program
+        keeps eval semantics."""
+        main, out = self._conv_block_program(train=True)
+        xb = np.random.RandomState(0).rand(2, 3, 8, 8).astype("float32")
+        _, prog = self._run(main, [out], {"x": xb})
+        fused = [op for op in prog.ops if op.attrs.get("__fused__")
+                 and "batch_norm" in op.attrs.get("__fused_ops__", ())]
+        assert fused and all(op.eval_impl is not None for op in fused)
+
+    def test_conv_bn_fold_tolerance_and_counted(self):
+        before = metrics.counter("static.pass.conv_bn_folded").value
+        main, out = self._conv_block_program()
+        xb = np.random.RandomState(0).rand(2, 3, 8, 8).astype("float32")
+        ref, _ = self._run(main, [out], {"x": xb})
+        folded, prog = self._run(main, [out], {"x": xb},
+                                 FLAGS_conv_bn_fold=True)
+        assert any(op.type.startswith("fused_conv_bn_folded")
+                   for op in prog.ops)
+        assert not any(op.type == "batch_norm" for op in prog.ops)
+        assert metrics.counter("static.pass.conv_bn_folded").value \
+            - before >= 1
+        np.testing.assert_allclose(folded[0], ref[0], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_conv_bn_fold_refuses_train_form(self):
+        """A train-mode batch_norm (stats op consumes the conv output)
+        must NOT be folded to the inference form."""
+        main, out = self._conv_block_program(train=True)
+        assert any(op.type == "batch_norm_stats" for op in main.ops)
+        xb = np.random.RandomState(0).rand(2, 3, 8, 8).astype("float32")
+        _, prog = self._run(main, [out], {"x": xb},
+                            FLAGS_conv_bn_fold=True)
+        assert not any(op.type.startswith("fused_conv_bn_folded")
+                       for op in prog.ops)

@@ -87,3 +87,176 @@ def test_qat_trains():
         opt.clear_grad()
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5
+
+
+# ---------------------------------------------------------------------------
+# conv-aware int8 (r10): per-output-channel conv scales, calibrated
+# static int8 convs, axis-aware serving artifacts
+# ---------------------------------------------------------------------------
+import os
+import tempfile
+import warnings
+
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (QuantizedConv2D, default_int8_axis,
+                                     quantize_weight_int8,
+                                     dequantize_weight_int8)
+
+
+def _conv_net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                         nn.Conv2D(8, 4, 1))
+
+
+XIMG = np.random.RandomState(0).rand(2, 3, 8, 8).astype("float32")
+
+
+def test_default_int8_axis():
+    assert default_int8_axis(4) == 0      # conv OIHW: per out channel
+    assert default_int8_axis(3) == 0      # conv1d OIW
+    assert default_int8_axis(2) == 1      # matmul (in, out): per column
+
+
+def test_weight_roundtrip_conv_axis():
+    w = np.random.RandomState(1).randn(8, 3, 3, 3).astype("float32")
+    # scale one output channel up 100x: per-channel (axis 0) scales
+    # must absorb it without wrecking the others
+    w[3] *= 100.0
+    qw = quantize_weight_int8(w, axis=0)
+    assert qw.scales.shape == (8,)
+    deq = np.asarray(dequantize_weight_int8(qw))
+    rel = np.abs(deq - w).max(axis=(1, 2, 3)) / np.abs(w).max(axis=(1, 2, 3))
+    assert rel.max() < 0.01
+
+
+def test_weight_only_int8_conv():
+    net = _conv_net()
+    ref = net(paddle.to_tensor(XIMG)).numpy()
+    q = _conv_net()
+    q.set_state_dict(net.state_dict())
+    quantize_weights(q)
+    out = q(paddle.to_tensor(XIMG)).numpy()
+    assert isinstance(q._sub_layers["0"], QuantizedConv2D)
+    assert q._sub_layers["0"].in_scale is None
+    rel = np.abs(out - ref).max() / np.abs(ref).max()
+    assert rel < 0.03
+
+
+def test_static_ptq_int8_conv_calibrated():
+    net = _conv_net()
+    ref = net(paddle.to_tensor(XIMG)).numpy()
+    q = _conv_net()
+    q.set_state_dict(net.state_dict())
+    # calibration over a sample loader (several batches)
+    loader = [(paddle.to_tensor(XIMG),),
+              (paddle.to_tensor(XIMG * 0.5),)]
+    PostTrainingQuantization(q).calibrate(loader).convert()
+    lin = q._sub_layers["0"]
+    assert isinstance(lin, QuantizedConv2D)
+    assert lin.in_scale is not None          # calibrated activation
+    assert lin.weight_q.dtype == np.int8
+    assert lin.w_scales.shape == (8,)        # per OUT channel
+    out = q(paddle.to_tensor(XIMG)).numpy()
+    rel = np.abs(out - ref).max() / np.abs(ref).max()
+    assert rel < 0.08
+
+
+def test_int8_artifact_axis_meta_and_parity():
+    """jit.save records the per-key quantization axis; the Int8 program
+    variant dequantizes conv kernels per OUTPUT channel."""
+    import pickle
+    from paddle_tpu import inference
+    from paddle_tpu.jit import InputSpec
+
+    paddle.seed(0)
+    net = _conv_net()
+    net.eval()
+    prefix = os.path.join(tempfile.mkdtemp(prefix="q8ax_"), "m")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        paddle.jit.save(net, prefix, input_spec=[
+            InputSpec([2, 3, 8, 8], "float32", name="x")])
+    with open(prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    assert "Int8" in meta.get("programs", {})
+    axes = meta["int8_axes"]
+    conv_keys = [k for k in meta["int8_keys"]
+                 if len(meta["params"][k].shape) == 4]
+    assert conv_keys and all(axes[k] == 0 for k in conv_keys)
+
+    ref = inference.Predictor(
+        inference.Config(prefix)).run(inputs=[XIMG])[0]
+    cfg = inference.Config(prefix)
+    cfg.set_precision(inference.PrecisionType.Int8)
+    out = inference.Predictor(cfg).run(inputs=[XIMG])[0]
+    rel = np.abs(np.asarray(out, np.float32) - ref).max() \
+        / np.abs(ref).max()
+    assert rel < 0.05
+
+
+def test_int8_quantize_then_serve_roundtrip():
+    """quantize -> artifact -> InferenceEngine (bucketing +
+    ExecutableCache) -> bit-stable service with top-1 agreement."""
+    from paddle_tpu import inference, serving
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.profiler import metrics as pm
+
+    paddle.seed(0)
+    net = paddle.vision.models.resnet18(num_classes=10)
+    net.eval()
+    prefix = os.path.join(tempfile.mkdtemp(prefix="q8serve_"), "m")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        paddle.jit.save(net, prefix, input_spec=[
+            InputSpec([4, 3, 32, 32], "float32", name="x")])
+    rng = np.random.RandomState(0)
+    xs = [rng.rand(4, 3, 32, 32).astype("float32") for _ in range(3)]
+    ref = [inference.Predictor(inference.Config(prefix))
+           .run(inputs=[x])[0] for x in xs]
+
+    cfg = inference.Config(prefix)
+    cfg.set_precision(inference.PrecisionType.Int8)
+    eng = serving.InferenceEngine(cfg, serving.EngineConfig(
+        max_batch_size=4, min_batch_bucket=4, num_workers=1,
+        name="q8serve"))
+    outs = [eng.infer([x], timeout=600)[0] for x in xs]
+    again = [eng.infer([x], timeout=600)[0] for x in xs]
+    compiles = pm.counter("q8serve.compile").value
+    eng.close()
+    for a, b in zip(outs, again):            # served results stable
+        np.testing.assert_array_equal(a, b)
+    agree = np.mean([np.mean(np.argmax(a, 1) == np.argmax(b, 1))
+                     for a, b in zip(ref, outs)])
+    assert agree >= 0.9
+    assert 0 < compiles <= 1                 # one bucket, one compile
+
+
+def test_ptq_calibrates_through_fused_conv_blocks():
+    """Regression: calibrate() observes conv inputs via forward
+    pre-hooks, which only fire through Conv2D.__call__ — the fused conv
+    dispatch (FLAGS_fused_conv=1, default) bypasses it, so hooked convs
+    must fall back to the eager composition during calibration or the
+    ranges stay silently empty and convert() degrades to weight-only."""
+    from paddle_tpu.utils import flags as fl
+
+    fl.set_flags({"FLAGS_fused_conv": True})
+    paddle.seed(0)
+    net = nn.Sequential(nn.FusedConvBNReLU(3, 8, 3, padding=1),
+                        nn.Conv2D(8, 4, 1))
+    net.eval()
+    ref = net(paddle.to_tensor(XIMG)).numpy()
+
+    ptq = PostTrainingQuantization(net).calibrate(
+        [(paddle.to_tensor(XIMG),)])
+    inner = net._sub_layers["0"].conv
+    assert id(inner) in ptq._ranges, \
+        "conv inside the fused block was not observed during calibration"
+    ptq.convert()
+    q_inner = net._sub_layers["0"].conv
+    assert isinstance(q_inner, QuantizedConv2D)
+    assert q_inner.in_scale is not None       # calibrated, not weight-only
+
+    out = net(paddle.to_tensor(XIMG)).numpy()
+    rel = np.abs(out - ref).max() / max(np.abs(ref).max(), 1e-6)
+    assert rel < 0.1

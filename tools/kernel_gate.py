@@ -1,0 +1,204 @@
+"""CI gate for the r10 kernel work (fused conv blocks, fused optimizer,
+int8 serving artifacts).
+
+Legs:
+1. **Fit loss parity** — fixed-seed 10-step ResNet18 fit with
+   FLAGS_fused_conv + FLAGS_fused_optimizer ON vs OFF: step-1 loss must
+   match to float32 noise (the fused forward is bit-exact), the whole
+   trajectory within tolerance (the custom-vjp backward reassociates
+   the BN reduction chain), and both runs must end finite.
+2. **Dispatch/executable bound** — one conv+bn+relu block dispatches as
+   ONE op (one eager-jit executable), not three, with the flag on; the
+   escape hatch restores the 3-op composition.
+3. **Fused-optimizer parity** — Momentum/Adam/AdamW (incl. weight decay
+   + an LR schedule) eager-trained fused vs per-leaf: params allclose
+   at 1e-6, and the fused path is bit-deterministic (two fused runs
+   produce identical param sha256s).
+4. **Int8 artifact serve** — a resnet18 jit.save artifact loads at
+   PrecisionType.Int8 through the InferenceEngine (bucketing +
+   ExecutableCache) and serves with top-1 agreement vs fp32 and
+   bounded compiles.
+
+Exit code 0 = gate passed.
+"""
+import hashlib
+import os
+import sys
+import tempfile
+import warnings
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+FAILURES = []
+
+
+def check(name, ok, detail=""):
+    status = "ok" if ok else "FAIL"
+    print(f"[kernel_gate] {name}: {status} {detail}")
+    if not ok:
+        FAILURES.append(name)
+
+
+def leg_fit_parity():
+    import paddle_tpu as paddle
+    from paddle_tpu.utils import flags as fl
+
+    def run(fused):
+        paddle.seed(7)
+        fl.set_flags({"FLAGS_fused_conv": fused,
+                      "FLAGS_fused_optimizer": fused})
+        net = paddle.vision.models.resnet18(num_classes=10)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.Momentum(
+            learning_rate=0.01, momentum=0.9,
+            parameters=net.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        rng = np.random.RandomState(7)
+        x = np.asarray(rng.rand(4, 3, 32, 32), np.float32)
+        y = np.asarray(rng.randint(0, 10, (4, 1)), np.int32)
+        return [float(model.train_batch([x], [y])["loss"])
+                for _ in range(10)]
+
+    on = run(True)
+    off = run(False)
+    check("fit.finite", all(np.isfinite(on)) and all(np.isfinite(off)),
+          f"on[-1]={on[-1]:.5f} off[-1]={off[-1]:.5f}")
+    check("fit.step1_parity", abs(on[0] - off[0]) <= 1e-5,
+          f"{on[0]:.7f} vs {off[0]:.7f}")
+    rel = max(abs(a - b) / max(abs(b), 1e-3) for a, b in zip(on, off))
+    check("fit.trajectory_parity", rel < 0.05,
+          f"max rel step diff {rel:.4f} (tol 0.05)")
+
+
+def leg_dispatch_bound():
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import tracer
+    from paddle_tpu.utils import flags as fl
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 8, 3, padding=1, bias_attr=False)
+    bn = nn.BatchNorm2D(8)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 3, 16, 16).astype("float32"))
+
+    def ops_for(fused):
+        fl.set_flags({"FLAGS_fused_conv": fused})
+        F.fused_conv_bn(x, conv, bn, act="relu")   # warm factory/caches
+        tracer.enable()
+        tracer.clear()
+        F.fused_conv_bn(x, conv, bn, act="relu")
+        table = tracer.op_table()
+        tracer.disable()
+        tracer.clear()
+        return set(table)
+
+    fused_ops = ops_for(True)
+    eager_ops = ops_for(False)
+    fl.set_flags({"FLAGS_fused_conv": True})
+    check("block.one_dispatch",
+          fused_ops == {"fused_conv_bn_relu"},
+          f"fused block dispatched {sorted(fused_ops)}")
+    check("block.escape_hatch",
+          eager_ops == {"conv2d", "batch_norm", "relu"},
+          f"FLAGS_fused_conv=0 dispatched {sorted(eager_ops)}")
+
+
+def leg_optimizer_parity():
+    import paddle_tpu as paddle
+    from paddle_tpu.utils import flags as fl
+    import paddle_tpu.nn as nn
+
+    def train(opt_name, fused, kw):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                            nn.Linear(16, 16), nn.ReLU(),
+                            nn.Linear(16, 4))
+        sched = paddle.optimizer.lr.StepDecay(0.05, step_size=2,
+                                              gamma=0.5)
+        opt = getattr(paddle.optimizer, opt_name)(
+            learning_rate=sched, parameters=net.parameters(), **kw)
+        fl.set_flags({"FLAGS_fused_optimizer": fused})
+        rng = np.random.RandomState(3)
+        xb = paddle.to_tensor(rng.rand(16, 8).astype("float32"))
+        yb = paddle.to_tensor(rng.rand(16, 4).astype("float32"))
+        for _ in range(5):
+            loss = paddle.mean((net(xb) - yb) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            sched.step()
+        arrs = [np.asarray(p.numpy()) for p in net.parameters()]
+        sha = hashlib.sha256(
+            b"".join(a.tobytes() for a in arrs)).hexdigest()
+        return arrs, sha
+
+    for name, kw in (("Momentum", dict(momentum=0.9,
+                                       weight_decay=0.01)),
+                     ("Adam", dict(weight_decay=0.02)),
+                     ("AdamW", dict(weight_decay=0.01))):
+        fused1, sha1 = train(name, True, kw)
+        fused2, sha2 = train(name, True, kw)
+        ref, _ = train(name, False, kw)
+        md = max(np.abs(a - b).max() for a, b in zip(fused1, ref))
+        check(f"opt.{name}.parity", md < 1e-6,
+              f"max param diff vs per-leaf {md:.2e}")
+        check(f"opt.{name}.sha_deterministic", sha1 == sha2,
+              sha1[:12])
+    fl.set_flags({"FLAGS_fused_optimizer": True})
+
+
+def leg_int8_serve():
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, serving
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.profiler import metrics as pm
+
+    paddle.seed(0)
+    net = paddle.vision.models.resnet18(num_classes=10)
+    net.eval()
+    prefix = os.path.join(tempfile.mkdtemp(prefix="kernel_gate_"), "m")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        paddle.jit.save(net, prefix, input_spec=[
+            InputSpec([4, 3, 32, 32], "float32", name="x")])
+
+    rng = np.random.RandomState(0)
+    batches = [rng.rand(4, 3, 32, 32).astype("float32")
+               for _ in range(4)]
+    ref = [inference.Predictor(inference.Config(prefix))
+           .run(inputs=[b])[0] for b in batches]
+
+    cfg = inference.Config(prefix)
+    cfg.set_precision(inference.PrecisionType.Int8)
+    eng = serving.InferenceEngine(cfg, serving.EngineConfig(
+        max_batch_size=4, min_batch_bucket=4, num_workers=1,
+        name="kernel_gate_int8"))
+    outs = [eng.infer([b], timeout=600)[0] for b in batches]
+    compiles = pm.counter("kernel_gate_int8.compile").value
+    eng.close()
+    agree = float(np.mean([np.mean(np.argmax(a, 1) == np.argmax(b, 1))
+                           for a, b in zip(ref, outs)]))
+    check("int8.top1_agreement", agree >= 0.9, f"{agree:.3f}")
+    check("int8.compile_bound", 0 < compiles <= 1,
+          f"{compiles} compiles for one bucket")
+
+
+def main():
+    leg_fit_parity()
+    leg_dispatch_bound()
+    leg_optimizer_parity()
+    leg_int8_serve()
+    if FAILURES:
+        print(f"[kernel_gate] FAILED: {FAILURES}")
+        return 1
+    print("[kernel_gate] all legs passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

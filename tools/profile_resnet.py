@@ -1,58 +1,122 @@
-"""Per-op device profile of the ResNet50 Model.train_batch step (the
-bench.py resnet leg), via xprof hlo_stats — same harness as
-tools/profile_bert.py."""
+"""Op-level profile of the ResNet train step (the bench.py resnet leg).
+
+Default mode drives the PR 1 host tracer through an instrumented EAGER
+train step and prints the per-op time table plus the conv/norm/
+elementwise/optimizer phase shares — the same ``tracer.op_table()`` /
+``tracer.phase_shares()`` path bench.py's MFU breakdown reads, so the
+two can never disagree on methodology.  ``--xprof`` keeps the original
+device-side capture (jax.profiler trace + hlo_stats via
+tools/profile_bert.py) for runs on real hardware.
+"""
 import argparse
 import os
 import sys
+import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def capture(batch: int, steps: int, logdir: str):
-    import time
+def build(depth: int, batch: int, hw: int, nclass: int, amp):
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    factory = getattr(paddle.vision.models, f"resnet{depth}")
+    net = factory(num_classes=nclass)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=net.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(), amp_configs=amp)
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.rand(batch, 3, hw, hw), np.float32)
+    y = np.asarray(rng.randint(0, nclass, (batch, 1)), np.int32)
+    return net, model, opt, x, y
+
+
+def op_profile(args):
+    """Host-tracer path: eager steps so every op goes through
+    core.dispatch and lands in the tracer's op table."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import tracer
+
+    net, model, opt, x, y = build(args.depth, args.batch, args.hw,
+                                  args.nclass, None)
+    p0 = next(iter(net.parameters()))
+    # shared recipe with bench.py's phase_shares leg (warm eager caches
+    # outside the window, instrumented eager steps, optimizer wall time
+    # as its own bucket) — one implementation, no methodology drift
+    table, shares, wall = tracer.eager_phase_profile(
+        model, opt, x, y, p0, steps=args.steps)
+
+    rows = sorted(table.items(), key=lambda kv: -kv[1]["total_ns"])
+    print(f"\n== op table ({args.steps} eager steps, "
+          f"{wall:.2f}s wall, resnet{args.depth} b{args.batch} "
+          f"{args.hw}x{args.hw}) ==")
+    print(f"{'op':<34}{'phase':<12}{'calls':>7}{'total ms':>10}"
+          f"{'avg us':>9}")
+    for op, s in rows[:args.top]:
+        print(f"{op:<34}{s['phase']:<12}{s['calls']:>7}"
+              f"{s['total_ns'] / 1e6:>10.2f}"
+              f"{s['avg_ns'] / 1e3:>9.1f}")
+    print("\n== phase shares ==")
+    for phase, s in shares.items():
+        # synthetic buckets (optimizer wall time) carry calls=None —
+        # they run as fused jit calls, not per-op dispatches
+        calls = "—" if s["calls"] is None else f"{s['calls']} dispatches"
+        print(f"{phase:<14}{s['time_frac'] * 100:>6.1f}%  "
+              f"({s['total_ns'] / 1e6:.1f} ms, {calls})")
+    return shares
+
+
+def xprof_capture(args):
+    """Original device-side capture (TPU runs): jax.profiler trace +
+    hlo_stats summary via tools/profile_bert.py."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
 
-    paddle.seed(0)
-    net = paddle.vision.models.resnet50(num_classes=1000)
-    model = paddle.Model(net)
-    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
-                                    parameters=net.parameters())
-    model.prepare(opt, paddle.nn.CrossEntropyLoss(), amp_configs="O2")
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(batch, 3, 224, 224), jnp.float32)
-    y = jnp.asarray(rng.randint(0, 1000, (batch, 1)), jnp.int32)
-    model.train_batch([x], [y])
+    net, model, opt, x, y = build(args.depth, args.batch, args.hw,
+                                  args.nclass, "O2")
+    xj = jnp.asarray(x)
+    yj = jnp.asarray(y, jnp.int32)
+    model.train_batch([xj], [yj])
     p0 = next(iter(net.parameters()))
     jax.block_until_ready(p0._data)
-    with jax.profiler.trace(logdir):
+    os.makedirs(args.logdir, exist_ok=True)
+    with jax.profiler.trace(args.logdir):
         t0 = time.perf_counter()
-        for _ in range(steps):
-            model.train_batch([x], [y])
+        for _ in range(args.steps):
+            model.train_batch([xj], [yj])
         jax.block_until_ready(p0._data)
         dt = time.perf_counter() - t0
-    print(f"[capture] {steps} steps in {dt:.3f}s -> "
-          f"{batch * steps / dt:.1f} imgs/s", file=sys.stderr)
+    print(f"[capture] {args.steps} steps in {dt:.3f}s -> "
+          f"{args.batch * args.steps / dt:.1f} imgs/s", file=sys.stderr)
+    from profile_bert import print_table, summarize
+    data = summarize(args.logdir)
+    if data:
+        print_table(data, args.top)
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--depth", type=int, default=50,
+                    choices=(18, 34, 50, 101, 152))
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--hw", type=int, default=64)
+    ap.add_argument("--nclass", type=int, default=1000)
     ap.add_argument("--steps", type=int, default=4)
-    ap.add_argument("--logdir", default="/tmp/resnet_profile")
     ap.add_argument("--top", type=int, default=30)
-    ap.add_argument("--reuse", action="store_true")
+    ap.add_argument("--xprof", action="store_true",
+                    help="device-side capture via jax.profiler + "
+                    "hlo_stats (TPU runs) instead of the host tracer")
+    ap.add_argument("--logdir", default="/tmp/resnet_profile")
     args = ap.parse_args()
-    if not args.reuse:
-        os.makedirs(args.logdir, exist_ok=True)
-        capture(args.batch, args.steps, args.logdir)
-    from profile_bert import summarize, print_table
-    data = summarize(args.logdir)
-    if data:
-        print_table(data, args.top)
+    if args.xprof:
+        xprof_capture(args)
+    else:
+        op_profile(args)
 
 
 if __name__ == "__main__":

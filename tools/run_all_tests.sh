@@ -64,6 +64,15 @@ python tools/cache_gate.py
 # total XLA compiles bounded by the prompt-bucket count (+1 decode
 # executable) — the per-token-retrace failure mode stays pinned shut.
 python tools/decode_gate.py
+# Kernel gate (r10 conv-leg MFU work): fixed-seed 10-step ResNet18 fit
+# fused vs unfused must stay loss-parity within tolerance (step 1 to
+# float32 noise), a conv+bn+relu block must dispatch as ONE op with the
+# flag on and exactly the 3-op composition with it off, the fused
+# optimizer must match the per-leaf reference at 1e-6 with
+# bit-deterministic param sha256s, and an int8 resnet artifact must
+# load and serve through the InferenceEngine with top-1 agreement and
+# compiles bounded by the bucket count.
+python tools/kernel_gate.py
 # Concurrency-sanitizer gate (conc-san runtime side): the serving,
 # decode, and pipeline soaks re-run with FLAGS_lock_san=1 (plus a
 # threaded-DataLoader + async-checkpoint loader soak that engages the
