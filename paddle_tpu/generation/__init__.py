@@ -17,14 +17,39 @@ tokens or requests flow through them:
   steps through the PR 4 ``ExecutableCache``, plus the high-level
   ``generate()`` loop (eos / max-length stopping, streaming callback).
 
+The serving-memory subsystem (PR 11) layers on top:
+
+- paged KV block pool (``paged_kv.py``): per-layer
+  ``(num_blocks, block_size, H, D)`` arenas + per-request block
+  tables (data, not shape), refcounted alloc/free with copy-on-write,
+  gather-based attention inside the same AOT executables, optional
+  int8 block storage;
+- content-addressed prefix cache (``prefix_cache.py``): sha256-keyed
+  immutable block chains so shared system prompts prefill once;
+- speculative decoding (``speculative.py``): n-gram prompt-lookup
+  drafter + one batched verify step, greedy/sampled-equivalent.
+
 ``models.GPT.generate`` is the one-call entry point; the continuous-
 batching serving path is ``serving.GenerationEngine``.
 """
 from .kv_cache import (KVCache, attention_mask, init_caches,
-                       init_layer_cache, legacy_view, write, write_kv)
+                       init_layer_cache, kv_view, legacy_view, write,
+                       write_kv)
+from .paged_kv import (BlockPool, BlockPoolExhausted, KVArena,
+                       KVArenaQ, PagedGenerationSession, PagedKV,
+                       blocks_for_tokens, init_arenas, paged_view,
+                       write_paged)
+from .prefix_cache import PrefixCache
 from .sampling import sample, sample_row
 from .session import GenerationSession
+from .speculative import (accept_span, draft_row, fill_verify_row,
+                          propose_drafts)
 
 __all__ = ["KVCache", "GenerationSession", "init_caches",
            "init_layer_cache", "write", "write_kv", "attention_mask",
-           "legacy_view", "sample", "sample_row"]
+           "legacy_view", "kv_view", "sample", "sample_row",
+           "KVArena", "KVArenaQ", "PagedKV", "BlockPool",
+           "BlockPoolExhausted", "PagedGenerationSession",
+           "init_arenas", "write_paged", "paged_view",
+           "blocks_for_tokens", "PrefixCache", "propose_drafts",
+           "accept_span", "draft_row", "fill_verify_row"]

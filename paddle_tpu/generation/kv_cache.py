@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["KVCache", "init_layer_cache", "init_caches", "write_kv",
-           "write", "attention_mask", "legacy_view"]
+           "write", "kv_view", "attention_mask", "legacy_view"]
 
 
 class KVCache(NamedTuple):
@@ -83,12 +83,29 @@ def write_kv(buf: jnp.ndarray, new: jnp.ndarray,
     return jax.vmap(one)(buf, new, starts)
 
 
-def write(cache: KVCache, k_new: jnp.ndarray, v_new: jnp.ndarray,
-          starts: jnp.ndarray) -> KVCache:
+def write(cache, k_new: jnp.ndarray, v_new: jnp.ndarray,
+          starts: jnp.ndarray):
     """Functional cache update: returns the cache with ``k_new`` /
-    ``v_new`` written at ``starts`` (shapes unchanged)."""
+    ``v_new`` written at ``starts`` (shapes unchanged).  Dispatches on
+    the cache structure — a paged cache (``paged_kv.PagedKV``) routes
+    to the block-table scatter, so the model's attention layers stay
+    cache-layout agnostic."""
+    if not isinstance(cache, KVCache):
+        from .paged_kv import write_paged
+        return write_paged(cache, k_new, v_new, starts)
     return KVCache(write_kv(cache.k, k_new, starts),
                    write_kv(cache.v, v_new, starts))
+
+
+def kv_view(cache) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense ``(B, capacity, H, D)`` k/v views for attention: the raw
+    buffers of a contiguous :class:`KVCache`, or the block-table
+    gather (dequantized when int8) of a paged cache — the layout
+    seam the attention layers read through."""
+    if isinstance(cache, KVCache):
+        return cache.k, cache.v
+    from .paged_kv import paged_view
+    return paged_view(cache)
 
 
 def attention_mask(starts: jnp.ndarray, q_len: int, capacity: int,

@@ -293,6 +293,36 @@ class GenerationSession:
             _tracer.on_serving_phase(f"{self.name}.{phase}", t0_ns, t1)
 
     # -- high-level generate ------------------------------------------
+    def _prep_batch(self, ids, prompt_lens, do_sample, temperature,
+                    top_k, top_p, seed, seeds):
+        """Shared ``generate()`` request prep: ragged prompts
+        right-padded into a ``(batch_capacity, prompt_bucket)`` window
+        plus per-row keys and sampling-parameter arrays — one
+        implementation for the contiguous path and the paged twin
+        (``paged_kv.PagedGenerationSession``)."""
+        ids_list, lens = self._normalize_prompts(ids, prompt_lens)
+        B_real = len(ids_list)
+        B = self.batch_capacity
+        if B_real > B:
+            raise ValueError(
+                f"{B_real} prompts exceed the session batch capacity "
+                f"{B}; raise batch_capacity or split the call")
+        max_p = max(lens)
+        if max_p >= self.max_length:
+            raise ValueError(
+                f"prompt length {max_p} leaves no room in the "
+                f"{self.max_length}-slot cache")
+        Pb = self.prompt_bucket(max_p)
+        batch = np.zeros((B, Pb), np.int32)
+        for i, (row, n) in enumerate(zip(ids_list, lens)):
+            batch[i, :n] = row
+        keys = _as_key_rows(seed, seeds, B)
+        temps = np.full((B,), float(temperature) if do_sample else 0.0,
+                        np.float32)
+        tks = np.full((B,), int(top_k), np.int32)
+        tps = np.full((B,), float(top_p), np.float32)
+        return ids_list, lens, batch, keys, temps, tks, tps
+
     def generate(self, ids, prompt_lens=None, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
@@ -308,29 +338,13 @@ class GenerationSession:
         independent (see ``sampling.py``).  ``stream_callback(row,
         token)`` fires per sampled token in order.
         """
-        ids_list, lens = self._normalize_prompts(ids, prompt_lens)
+        ids_list, lens, batch, keys, temps, tks, tps = \
+            self._prep_batch(ids, prompt_lens, do_sample, temperature,
+                             top_k, top_p, seed, seeds)
         B_real = len(ids_list)
         B = self.batch_capacity
-        if B_real > B:
-            raise ValueError(
-                f"{B_real} prompts exceed the session batch capacity "
-                f"{B}; raise batch_capacity or split the call")
-        max_p = max(lens)
-        if max_p >= self.max_length:
-            raise ValueError(
-                f"prompt length {max_p} leaves no room in the "
-                f"{self.max_length}-slot cache")
-        Pb = self.prompt_bucket(max_p)
-        batch = np.zeros((B, Pb), np.int32)
         plens = np.ones((B,), np.int32)
-        for i, (row, n) in enumerate(zip(ids_list, lens)):
-            batch[i, :n] = row
-            plens[i] = n
-        keys = _as_key_rows(seed, seeds, B)
-        temps = np.full((B,), float(temperature) if do_sample else 0.0,
-                        np.float32)
-        tks = np.full((B,), int(top_k), np.int32)
-        tps = np.full((B,), float(top_p), np.float32)
+        plens[:B_real] = lens
         mask = np.zeros((B,), bool)
         mask[:B_real] = True
 

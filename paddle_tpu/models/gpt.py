@@ -70,18 +70,22 @@ class GPTAttention(Layer):
             out = self.out(reshape(ctx, [B, T, D]))
             return self.dropout(out)
         # fixed-capacity decode path (generation subsystem): write this
-        # block's k/v at per-row ``positions`` via dynamic_update_slice,
-        # attend over the whole capacity axis under an explicit length
-        # mask — shapes never change, so the jitted step compiles once
+        # block's k/v at per-row ``positions``, attend over the whole
+        # capacity axis under an explicit length mask — shapes never
+        # change, so the jitted step compiles once.  ``write``/
+        # ``kv_view`` dispatch on the cache structure: contiguous
+        # (B, capacity, H, D) buffers or the paged block-table arenas
+        # look identical from here.
         from ..core.tensor import Tensor
         from .. import generation as _gen
         starts = positions._data if isinstance(positions, Tensor) \
             else jnp.asarray(positions, jnp.int32)
         new_cache = _gen.write(cache, k._data, v._data, starts)
-        mask = _gen.attention_mask(starts, T, new_cache.capacity,
+        kv_k, kv_v = _gen.kv_view(new_cache)
+        mask = _gen.attention_mask(starts, T, kv_k.shape[1],
                                    dtype=q._data.dtype)
         ctx = scaled_dot_product_attention(
-            q, Tensor(new_cache.k), Tensor(new_cache.v),
+            q, Tensor(kv_k), Tensor(kv_v),
             attn_mask=Tensor(mask), training=self.training)
         out = self.out(reshape(ctx, [B, T, D]))
         return self.dropout(out), new_cache
@@ -182,6 +186,20 @@ class GPT(Layer):
                                 self.cfg.num_heads,
                                 self.cfg.hidden_size
                                 // self.cfg.num_heads)
+
+    def gen_arenas(self, num_blocks: int, block_size: int,
+                   quantized: bool = False):
+        """Zero paged KV arenas for the block-pool decode path — one
+        :class:`~paddle_tpu.generation.KVArena` (or int8 ``KVArenaQ``)
+        per LAYER, each ``(num_blocks, block_size, num_heads,
+        head_dim)``.  Per-request block tables, not arena shape, decide
+        who owns which block (``generation/paged_kv.py``)."""
+        from .. import generation as _gen
+        return _gen.init_arenas(self.cfg.num_layers, num_blocks,
+                                block_size, self.cfg.num_heads,
+                                self.cfg.hidden_size
+                                // self.cfg.num_heads,
+                                quantized=quantized)
 
     def generate(self, ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,

@@ -28,7 +28,28 @@ from .. import nn
 __all__ = ["quantize_weights", "PostTrainingQuantization",
            "QuantizedLinear", "QuantizedConv2D", "fake_quantize_abs_max",
            "QAT", "QuantizedW", "quantize_weight_int8",
-           "dequantize_weight_int8", "default_int8_axis"]
+           "dequantize_weight_int8", "default_int8_axis",
+           "quantize_int8_jnp", "dequantize_int8_jnp"]
+
+
+def quantize_int8_jnp(x, axis: int = -1):
+    """In-kernel symmetric int8 quantization: per-slice abs-max scales
+    along ``axis`` (kept out of the returned shape), traceable inside a
+    jitted step — the dynamic-value twin of the host-side per-channel
+    weight helpers above.  The paged KV-cache quantizes each written
+    token's k/v per head this way (``generation/paged_kv.py``).
+    Returns ``(q int8, scales f32)``."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
+                   keepdims=True)
+    scales = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / scales), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(scales, axis=axis)
+
+
+def dequantize_int8_jnp(q, scales, axis: int = -1):
+    """Inverse of :func:`quantize_int8_jnp`: broadcast the scales back
+    along ``axis`` (dequant-in-kernel for int8 KV attention)."""
+    return q.astype(jnp.float32) * jnp.expand_dims(scales, axis)
 
 
 def default_int8_axis(ndim: int) -> int:

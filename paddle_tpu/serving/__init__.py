@@ -45,12 +45,14 @@ from .bucketing import BucketPolicy, ExecutableCache, next_bucket, \
     pad_batch, seq_buckets
 from .engine import (EngineConfig, GenerationEngine,
                      GenerationEngineConfig, GenerationStream,
-                     InferenceEngine, validate_artifact)
+                     InferenceEngine, PagedGenerationEngine,
+                     validate_artifact)
 from .server import ServingServer, serve
 
 __all__ = ["InferenceEngine", "EngineConfig", "ServingServer", "serve",
            "GenerationEngine", "GenerationEngineConfig",
-           "GenerationStream", "RequestRejected", "DeadlineExceeded",
+           "GenerationStream", "PagedGenerationEngine",
+           "RequestRejected", "DeadlineExceeded",
            "EngineClosed", "AdmissionController", "BucketPolicy",
            "ExecutableCache", "next_bucket", "pad_batch",
            "seq_buckets", "validate_artifact"]

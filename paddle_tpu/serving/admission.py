@@ -32,7 +32,11 @@ __all__ = ["RequestRejected", "DeadlineExceeded", "EngineClosed",
 
 class RequestRejected(RuntimeError):
     """Explicit overload rejection; ``reason`` is one of ``queue_full``,
-    ``too_large``, ``closed``."""
+    ``too_large``, ``token_budget``, ``kv_blocks``, ``closed``.
+    ``kv_blocks`` means the paged KV block pool could not supply the
+    request's blocks (possibly injected via the ``kv.block_alloc``
+    chaos site) — the engine shed it rather than corrupt a live
+    batch."""
 
     def __init__(self, msg: str, reason: str = "overload"):
         super().__init__(msg)
@@ -79,6 +83,10 @@ class AdmissionController:
         self._shed = _metrics.counter(
             f"{name}.request.shed_deadline", "queued requests dropped "
             "because their deadline expired before execution")
+        self._shed_kv = _metrics.counter(
+            f"{name}.request.shed_kv_blocks", "admitted requests shed "
+            "because the paged KV block pool could not supply blocks "
+            "(incl. exhaustion injected via kv.block_alloc)")
         self._depth_gauge = _metrics.gauge(
             f"{name}.queue_depth", "requests currently waiting in the "
             "engine queue")
@@ -170,6 +178,12 @@ class AdmissionController:
 
     def shed_deadline(self):
         self._shed.inc()
+
+    def shed_kv_blocks(self):
+        """A paged engine shed an admitted request on pool exhaustion
+        (typed ``RequestRejected(reason="kv_blocks")`` to the client —
+        the gate asserts this count exactly)."""
+        self._shed_kv.inc()
 
 
 def deadline_from_ms(deadline_ms: Optional[float]) -> Optional[float]:

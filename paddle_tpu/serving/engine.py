@@ -42,7 +42,8 @@ from .bucketing import BucketPolicy, ExecutableCache
 
 __all__ = ["EngineConfig", "InferenceEngine", "RequestRejected",
            "DeadlineExceeded", "EngineClosed", "GenerationEngineConfig",
-           "GenerationEngine", "GenerationStream"]
+           "GenerationEngine", "GenerationStream",
+           "PagedGenerationEngine"]
 
 
 class EngineConfig:
@@ -687,6 +688,28 @@ class GenerationEngineConfig:
     name                 metrics prefix (default "serving" — gives the
                          ``serving.prefill`` / ``serving.decode`` /
                          ``serving.compile`` names the gates assert on)
+
+    Paged-KV knobs (read by :class:`PagedGenerationEngine` only; the
+    contiguous engine ignores them):
+
+    block_size           KV block width in tokens; must divide
+                         max_length (bit-parity vs contiguous needs the
+                         gathered view capacity == contiguous capacity)
+    num_blocks           the arena's block-pool size — THE serving HBM
+                         budget.  Default max_slots * (max_length /
+                         block_size), i.e. the contiguous engine's
+                         worst-case footprint; provision it for the
+                         expected live tokens instead and the same HBM
+                         carries a multiple of the streams
+    kv_cache_dtype       'float32' | 'int8' block storage; default
+                         reads FLAGS_kv_cache_dtype at construction
+    prefix_cache_blocks  content-addressed prefix-cache capacity in
+                         blocks (0 disables); default reads
+                         FLAGS_prefix_cache_blocks
+    speculative_k        draft tokens per decode step from the n-gram
+                         prompt-lookup drafter (0 disables); default
+                         reads FLAGS_speculative_k
+    spec_ngram           trailing n-gram width the drafter matches
     """
 
     def __init__(self, max_slots: int = 4,
@@ -697,14 +720,20 @@ class GenerationEngineConfig:
                  deadline_ms: Optional[float] = None,
                  prompt_bucket_min: int = 8,
                  warmup: bool = False,
-                 name: str = "serving"):
+                 name: str = "serving",
+                 block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 kv_cache_dtype: Optional[str] = None,
+                 prefix_cache_blocks: Optional[int] = None,
+                 speculative_k: Optional[int] = None,
+                 spec_ngram: int = 2):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         self.max_slots = int(max_slots)
         self.max_length = max_length
         self.max_new_tokens = int(max_new_tokens)
+        from ..utils import flags as _flags
         if max_queue is None:
-            from ..utils import flags as _flags
             max_queue = int(_flags.get_flag("FLAGS_serving_queue_depth"))
         self.max_queue = int(max_queue)
         self.max_tokens_in_flight = max_tokens_in_flight
@@ -712,12 +741,26 @@ class GenerationEngineConfig:
         self.prompt_bucket_min = int(prompt_bucket_min)
         self.warmup = bool(warmup)
         self.name = str(name)
+        self.block_size = int(block_size)
+        self.num_blocks = num_blocks
+        if kv_cache_dtype is None:
+            kv_cache_dtype = str(_flags.get_flag("FLAGS_kv_cache_dtype"))
+        self.kv_cache_dtype = kv_cache_dtype
+        if prefix_cache_blocks is None:
+            prefix_cache_blocks = int(
+                _flags.get_flag("FLAGS_prefix_cache_blocks"))
+        self.prefix_cache_blocks = int(prefix_cache_blocks)
+        if speculative_k is None:
+            speculative_k = int(_flags.get_flag("FLAGS_speculative_k"))
+        self.speculative_k = int(speculative_k)
+        self.spec_ngram = int(spec_ngram)
 
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "top_p",
                  "seed", "eos", "deadline", "budget", "future", "queue",
-                 "tokens", "t_submit", "t_first", "t_last", "cancelled")
+                 "tokens", "t_submit", "t_first", "t_last", "cancelled",
+                 "blocks", "cached_len")
 
     def __init__(self, prompt, max_new, temperature, top_k, top_p,
                  seed, eos, deadline, budget):
@@ -737,6 +780,8 @@ class _GenRequest:
         self.t_first = None
         self.t_last = None
         self.cancelled = False
+        self.blocks: List[int] = []    # paged mode: held KV block ids
+        self.cached_len = 0            # paged mode: prefix-cache cover
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -826,23 +871,15 @@ class GenerationEngine:
 
     def __init__(self, model, config: Optional[GenerationEngineConfig]
                  = None):
-        from ..generation import GenerationSession
         self.config = config or GenerationEngineConfig()
         cfg = self.config
         self.model = model
         max_len = int(cfg.max_length or model.cfg.max_seq_len)
-        self.session = GenerationSession(
-            model, batch_capacity=cfg.max_slots, max_length=max_len,
-            prompt_bucket_min=cfg.prompt_bucket_min, name=cfg.name)
+        self.session = self._make_session(model, cfg, max_len)
         self.max_length = self.session.max_length
         S = self.slots = self.session.batch_capacity
         self.metrics_prefix = cfg.name
-        budget = cfg.max_tokens_in_flight
-        if budget is None:
-            budget = S * self.max_length
-        self._admission = AdmissionController(
-            cfg.max_queue, max_rows=None, name=cfg.name,
-            max_tokens=int(budget))
+        self._admission = self._make_admission(cfg)
 
         from ..profiler import metrics as _metrics
         p = cfg.name
@@ -873,14 +910,7 @@ class GenerationEngine:
             self._warmup()
 
         # slot bank (host-side control state; caches live on device)
-        self._caches = self.session.init_caches()
-        self._slot_req: List[Optional[_GenRequest]] = [None] * S
-        self._positions = np.zeros((S,), np.int32)
-        self._last_tok = np.zeros((S,), np.int32)
-        self._keys = np.zeros((S, 2), np.uint32)
-        self._temps = np.zeros((S,), np.float32)
-        self._tks = np.zeros((S,), np.int32)
-        self._tps = np.ones((S,), np.float32)
+        self._init_slot_state()
 
         self._pending: deque = deque()
         self._cond = _conc.Condition(name=f"{cfg.name}"
@@ -891,6 +921,45 @@ class GenerationEngine:
         self._closed = False
         self._scheduler = _conc.spawn(
             self._loop, name="generation-scheduler")
+
+    # -- construction hooks (PagedGenerationEngine overrides these) ----
+    def _make_session(self, model, cfg: GenerationEngineConfig,
+                      max_len: int):
+        from ..generation import GenerationSession
+        return GenerationSession(
+            model, batch_capacity=cfg.max_slots, max_length=max_len,
+            prompt_bucket_min=cfg.prompt_bucket_min, name=cfg.name)
+
+    def _make_admission(self, cfg: GenerationEngineConfig
+                        ) -> AdmissionController:
+        budget = cfg.max_tokens_in_flight
+        if budget is None:
+            budget = self.slots * self.max_length
+        return AdmissionController(
+            cfg.max_queue, max_rows=None, name=cfg.name,
+            max_tokens=int(budget))
+
+    def _init_slot_arrays(self):
+        S = self.slots
+        self._slot_req: List[Optional[_GenRequest]] = [None] * S
+        self._positions = np.zeros((S,), np.int32)
+        self._last_tok = np.zeros((S,), np.int32)
+        self._keys = np.zeros((S, 2), np.uint32)
+        self._temps = np.zeros((S,), np.float32)
+        self._tks = np.zeros((S,), np.int32)
+        self._tps = np.ones((S,), np.float32)
+
+    def _init_slot_state(self):
+        self._caches = self.session.init_caches()
+        self._init_slot_arrays()
+
+    def _token_reservation(self, prompt, max_new: int) -> int:
+        """Tokens to reserve against the admission budget at submit —
+        the contiguous engine's worst case (prompt + max_new; a slot
+        physically holds that many cache rows whether used or not).
+        The paged engine returns 0: its admission signal is live
+        block-pool occupancy, not a worst-case reservation."""
+        return int(prompt.size) + int(max_new)
 
     def _warmup(self):
         """One masked-out prefill per prompt bucket plus one decode
@@ -923,13 +992,18 @@ class GenerationEngine:
                 live_rows=0)
         except Exception as e:      # noqa: BLE001
             errors.append(("decode", e))
+        self._finish_warmup(errors)
+
+    def _finish_warmup(self, errors):
+        """Shared warmup tail (both engine flavors): loud best-effort
+        failure report + the ``decode_warmed_buckets`` gauge."""
         if errors:
             import warnings
             warnings.warn(
-                f"GenerationEngine warmup failed for {len(errors)} "
-                f"step(s) (first: {errors[0][0]}: {errors[0][1]!r}); "
-                "those buckets will compile on first use",
-                RuntimeWarning, stacklevel=3)
+                f"{type(self).__name__} warmup failed for "
+                f"{len(errors)} step(s) (first: {errors[0][0]}: "
+                f"{errors[0][1]!r}); those buckets will compile on "
+                "first use", RuntimeWarning, stacklevel=4)
         self.warmed_buckets = len(self.session._cache)
         from ..profiler import metrics as _metrics
         # decode_-prefixed: a dual-engine server with both configs at
@@ -969,7 +1043,7 @@ class GenerationEngine:
         from ..utils import chaos as _chaos
         if _chaos.active:
             _chaos.hit("serve.request")
-        budget = int(prompt.size) + max_new
+        budget = self._token_reservation(prompt, max_new)
         self._admission.acquire(tokens=budget)
         if deadline_ms == "default":
             deadline_ms = self.config.deadline_ms
@@ -1050,20 +1124,26 @@ class GenerationEngine:
                 occ = self._occupied()
                 if not occ:
                     continue
-                tok, self._caches = self.session.decode(
-                    self._caches, self._last_tok, self._positions,
-                    self._keys, self._temps, self._tks, self._tps,
-                    live_rows=len(occ))
-                with self._mlock:
-                    self._m_occ.observe(len(occ))
-                self._positions = self._positions + 1
-                # copy: np.asarray over a device buffer is read-only,
-                # and _admit writes per-slot entries in place
-                self._last_tok = np.array(tok, np.int32)
-                for s in occ:
-                    self._emit(s, int(tok[s]))
+                self._decode_round(occ)
             except BaseException as e:  # noqa: BLE001 — fail everything in flight
                 self._fail_all(e)
+
+    def _decode_round(self, occ: List[int]):
+        """One token boundary: a fused decode step for every occupied
+        slot (the paged engine overrides this with block-table decode
+        and, when armed, speculative verify)."""
+        tok, self._caches = self.session.decode(
+            self._caches, self._last_tok, self._positions,
+            self._keys, self._temps, self._tks, self._tps,
+            live_rows=len(occ))
+        with self._mlock:
+            self._m_occ.observe(len(occ))
+        self._positions = self._positions + 1
+        # copy: np.asarray over a device buffer is read-only,
+        # and _admit writes per-slot entries in place
+        self._last_tok = np.array(tok, np.int32)
+        for s in occ:
+            self._emit(s, int(tok[s]))
 
     def _admit(self):
         """Token-boundary admission: move queued requests into free
@@ -1135,10 +1215,18 @@ class GenerationEngine:
                 or len(req.tokens) >= req.max_new:
             self._retire(req, slot)
 
+    def _release_resources(self, req: _GenRequest):
+        """THE accounting seam: every way a request leaves the engine
+        (finish, cancel, deadline shed, kv-block shed, engine failure)
+        returns its reservations through this one method — token budget
+        here, plus KV block references in the paged override.  One
+        place to audit means no path can leak."""
+        self._admission.release_tokens(req.budget)
+
     def _retire(self, req: _GenRequest, slot: Optional[int]):
         if slot is not None:
             self._slot_req[slot] = None
-        self._admission.release_tokens(req.budget)
+        self._release_resources(req)
         if not req.future.done():
             req.future.set_result(np.asarray(req.tokens, np.int32))
             with self._mlock:
@@ -1151,7 +1239,7 @@ class GenerationEngine:
     def _shed(self, req: _GenRequest):
         with self._mlock:
             self._admission.shed_deadline()
-        self._admission.release_tokens(req.budget)
+        self._release_resources(req)
         exc = DeadlineExceeded(
             "request deadline expired while queued (engine overloaded "
             "relative to the deadline)")
@@ -1166,7 +1254,7 @@ class GenerationEngine:
         victims = pending + [r for r in self._slot_req if r is not None]
         self._slot_req = [None] * self.slots
         for req in victims:
-            self._admission.release_tokens(req.budget)
+            self._release_resources(req)
             if not req.future.done():
                 req.future.set_exception(exc)
                 with self._mlock:
@@ -1182,3 +1270,420 @@ def jax_random_key(seed: int):
     batchmates (the decode-gate parity contract)."""
     import jax
     return np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# paged-KV serving memory: block-pool continuous batching
+# ---------------------------------------------------------------------------
+
+class PagedGenerationEngine(GenerationEngine):
+    """:class:`GenerationEngine` over the paged KV-cache subsystem
+    (``paddle_tpu/generation/paged_kv.py``): same continuous-batching
+    scheduler, same client surface, but KV memory is a shared
+    refcounted block pool instead of one worst-case ``(max_length, H,
+    D)`` buffer per slot.
+
+    What changes operationally:
+
+    - **admission** switches from the worst-case token budget to live
+      block-pool occupancy: ``submit`` reserves nothing (the
+      ``<name>.kv.blocks_in_flight`` gauge replaces
+      ``tokens_in_flight`` as the admission signal), blocks are
+      allocated lazily as each request actually grows, and a pool that
+      cannot supply a block sheds the request with a typed
+      ``RequestRejected(reason="kv_blocks")`` — never a corrupted
+      batch (the ``kv.block_alloc`` chaos site injects exactly this);
+    - **prefix cache**: prompts sharing a prefix with any earlier
+      prompt (sha256 content-addressed, ``prefix_cache_blocks`` cap)
+      skip straight to a chunked prefill of the uncached suffix —
+      shared system prompts prefill once; partially shared blocks are
+      copied-on-write before a request appends into them;
+    - **int8 KV** (``kv_cache_dtype='int8'``): blocks stored int8 with
+      per-token-per-head scales, dequantized inside the attention
+      executable — ~3.6x less HBM per block (k+v int8 plus two f32
+      per-token-per-head scale planes; 4096 -> 1152 bytes/token on
+      the bench config), tolerance-level numerics;
+    - **speculative decoding** (``speculative_k > 0``): the n-gram
+      prompt-lookup drafter proposes up to k tokens per boundary and
+      ONE batched verify executable commits the longest agreeing
+      prefix — streams stay bit-identical to non-speculative decode
+      (greedy and sampled; the drafter only changes how many forwards
+      produce them).  ``<name>.spec.proposed`` / ``.accepted``
+      counters and the ``.accept_rate`` gauge account it.
+
+    Executable population stays bounded exactly like the contiguous
+    engine: block tables and pool state are step *data*, never part of
+    a compile key — one chunk executable per pow2 suffix bucket, one
+    width-1 decode, one verify width, one block-copy helper.
+
+    With ``block_size`` dividing ``max_length``, paged greedy decode
+    is bit-exact against the contiguous PR 6 references
+    (``tools/paged_gate.py`` pins it under chaos).
+    """
+
+    # -- construction hooks -------------------------------------------
+    def _make_session(self, model, cfg: GenerationEngineConfig,
+                      max_len: int):
+        from ..generation import PagedGenerationSession
+        return PagedGenerationSession(
+            model, batch_capacity=cfg.max_slots, max_length=max_len,
+            block_size=cfg.block_size, num_blocks=cfg.num_blocks,
+            kv_dtype=cfg.kv_cache_dtype,
+            prompt_bucket_min=cfg.prompt_bucket_min, name=cfg.name)
+
+    def _make_admission(self, cfg: GenerationEngineConfig
+                        ) -> AdmissionController:
+        # no token budget: paged admission is queue depth at submit
+        # plus live block-pool occupancy at allocation time
+        return AdmissionController(
+            cfg.max_queue, max_rows=None, name=cfg.name,
+            max_tokens=None)
+
+    def _token_reservation(self, prompt, max_new: int) -> int:
+        return 0
+
+    def _init_slot_state(self):
+        from ..generation import BlockPool, PrefixCache
+        cfg = self.config
+        ses = self.session
+        self._init_slot_arrays()
+        self._arenas = ses.init_arenas()
+        self._table = np.full((self.slots, ses.blocks_per_slot), -1,
+                              np.int32)
+        self.pool = BlockPool(ses.num_blocks, ses.block_size,
+                              name=cfg.name)
+        self.pool.block_bytes = ses.arena_bytes_per_block()
+        self.prefix_cache = PrefixCache(
+            self.pool, cfg.prefix_cache_blocks, name=cfg.name)
+        self.speculative_k = max(int(cfg.speculative_k), 0)
+        from ..profiler import metrics as _metrics
+        p = cfg.name
+        self._m_spec_proposed = _metrics.counter(
+            f"{p}.spec.proposed", "draft tokens proposed by the "
+            "prompt-lookup drafter")
+        self._m_spec_accepted = _metrics.counter(
+            f"{p}.spec.accepted", "draft tokens the verify step "
+            "accepted (each one a forward pass saved)")
+        self._g_spec_rate = _metrics.gauge(
+            f"{p}.spec.accept_rate", "accepted/proposed draft ratio "
+            "(engine lifetime)")
+
+    def _warmup(self):
+        """Every chunk-width executable (one per pow2 suffix bucket +
+        the width-1 decode + the verify width when speculative is
+        armed) compiled over throwaway arenas with all-zero feeds —
+        every write is dropped by the table, so warmup is
+        mathematically inert and peak memory stays one arena set."""
+        from .bucketing import seq_buckets
+        ses = self.session
+        S = self.slots
+        keys = np.zeros((S, 2), np.uint32)
+        temps = np.zeros((S,), np.float32)
+        tks = np.zeros((S,), np.int32)
+        tps = np.ones((S,), np.float32)
+        zeros = np.zeros((S,), np.int32)
+        arenas = ses.init_arenas()
+        table = np.full((S, ses.blocks_per_slot), -1, np.int32)
+        errors = []
+        for pb in seq_buckets(self.max_length,
+                              self.config.prompt_bucket_min):
+            try:
+                _tok, arenas = ses.prefill(
+                    arenas, table, np.zeros((S, pb), np.int32), zeros,
+                    zeros, keys, temps, tks, tps, live_rows=0)
+            except Exception as e:  # noqa: BLE001 — best-effort, but loud
+                errors.append((f"pchunk:{pb}", e))
+        try:
+            ses.decode(arenas, table, zeros, zeros, keys, temps, tks,
+                       tps, live_rows=0)
+        except Exception as e:      # noqa: BLE001
+            errors.append(("pchunk:1", e))
+        if self.config.speculative_k > 0:
+            W = int(self.config.speculative_k) + 1
+            try:
+                ses.verify(arenas, table, np.zeros((S, W), np.int32),
+                           zeros, zeros, keys, temps, tks, tps,
+                           live_rows=0)
+            except Exception as e:  # noqa: BLE001
+                errors.append((f"pverify:{W}", e))
+        self._finish_warmup(errors)
+
+    # -- block accounting ---------------------------------------------
+    def _release_resources(self, req: _GenRequest):
+        """The accounting seam, paged edition: token budget (a no-op —
+        paged submit reserves none) AND every KV block reference the
+        request holds, in one place."""
+        super()._release_resources(req)
+        if req.blocks:
+            self.pool.decref(req.blocks)
+            req.blocks = []
+
+    def _prepare_slot(self, slot: int, req: _GenRequest):
+        """Prefix-cache lookup + block allocation + copy-on-write for
+        one admitted request; fills the slot's table row.  Returns the
+        request's COW ``(src, dst)`` block pair (or ``None``) instead
+        of dispatching the copy — the caller batches all pairs of one
+        admission round into a single ``copy_blocks`` call, so shared
+        partial-tail prefixes cost one launch per ``batch_capacity``
+        copies, not one per request.  Raises
+        :class:`BlockPoolExhausted` with every transferred reference
+        returned (the caller sheds typed)."""
+        from ..generation import BlockPoolExhausted, blocks_for_tokens
+        ses = self.session
+        bs = ses.block_size
+        plen = int(req.prompt.size)
+        chain, cached_len = self.prefix_cache.lookup(req.prompt)
+        # always re-feed >= 1 token: the chunk executable samples the
+        # token AFTER each row's window, so a fully-cached prompt still
+        # feeds its last token (writing bit-identical k/v into a COW
+        # copy of the tail block)
+        cached = min(cached_len, plen - 1)
+        fb = cached // bs               # first block this row writes
+        total = blocks_for_tokens(plen, bs)
+        try:
+            fresh = self.pool.alloc(total - fb)
+        except BlockPoolExhausted:
+            if chain:
+                self.pool.decref(chain)
+            raise
+        row = chain[:fb] + fresh
+        cow = None
+        if fb < len(chain):
+            # the write window starts inside a shared cached block:
+            # copy it into this row's first fresh block, return the
+            # shared holds we no longer use
+            cow = (chain[fb], fresh[0])
+            self.pool.decref(chain[fb:])
+        req.blocks = row
+        req.cached_len = cached
+        self._table[slot, :] = -1
+        self._table[slot, :len(row)] = row
+        self._slot_req[slot] = req
+        self._keys[slot] = np.asarray(jax_random_key(req.seed),
+                                      np.uint32)
+        self._temps[slot] = req.temperature
+        self._tks[slot] = req.top_k
+        self._tps[slot] = req.top_p
+        return cow
+
+    def _ensure_blocks(self, slot: int, req: _GenRequest,
+                       upto_pos: int):
+        """Grow the slot's table to cover writes through absolute
+        position ``upto_pos`` (lazy decode-time growth — the admission
+        win over worst-case reservation).  Raises
+        :class:`BlockPoolExhausted`."""
+        from ..generation import blocks_for_tokens
+        need = blocks_for_tokens(int(upto_pos) + 1,
+                                 self.session.block_size)
+        have = len(req.blocks)
+        if need <= have:
+            return
+        fresh = self.pool.alloc(need - have)
+        req.blocks.extend(fresh)
+        self._table[slot, have:have + len(fresh)] = fresh
+
+    def _shed_kv(self, req: _GenRequest, slot: Optional[int], cause):
+        """Pool exhaustion (organic or ``kv.block_alloc``-injected):
+        shed the request with the typed error — the live batch never
+        sees a partial allocation."""
+        with self._mlock:
+            self._admission.shed_kv_blocks()
+        if slot is not None:
+            self._slot_req[slot] = None
+            self._table[slot, :] = -1
+        self._release_resources(req)
+        exc = RequestRejected(
+            f"paged KV block pool exhausted ({cause}); request shed — "
+            "retry when running generations free blocks, or provision "
+            "more num_blocks", reason="kv_blocks")
+        if not req.future.done():
+            req.future.set_exception(exc)
+        req.queue.put(exc)
+
+    def _retire(self, req: _GenRequest, slot: Optional[int]):
+        if slot is not None:
+            self._table[slot, :] = -1
+        super()._retire(req, slot)
+
+    def _fail_all(self, exc: BaseException):
+        super()._fail_all(exc)
+        self._table[:, :] = -1
+
+    def close(self, timeout: Optional[float] = 60.0):
+        super().close(timeout=timeout)
+        # drop the cache's holds so the pool drains to all-free once
+        # every live request is done (the leak canary in the tests)
+        self.prefix_cache.clear()
+
+    # -- scheduler overrides ------------------------------------------
+    def _admit(self):
+        """Token-boundary admission, paged edition: prefix-cache
+        lookup + block allocation per request, then ONE chunked
+        prefill per suffix-length bucket feeding each row's uncached
+        suffix at its true offset."""
+        from ..generation import BlockPoolExhausted, blocks_for_tokens
+        took: List[Tuple[int, _GenRequest]] = []
+        with self._cond:
+            if self._paused:
+                return
+            free = [i for i, r in enumerate(self._slot_req)
+                    if r is None]
+            while self._pending and free:
+                req = self._pending.popleft()
+                self._admission.release()
+                if req.expired():
+                    self._shed(req)
+                    continue
+                if req.cancelled:
+                    self._retire(req, slot=None)
+                    continue
+                took.append((free.pop(0), req))
+        if not took:
+            return
+        placed: List[Tuple[int, _GenRequest]] = []
+        cows: List[Tuple[int, int]] = []
+        for slot, req in took:
+            try:
+                cow = self._prepare_slot(slot, req)
+            except BlockPoolExhausted as e:
+                self._shed_kv(req, None, e)
+                continue
+            if cow is not None:
+                cows.append(cow)
+            placed.append((slot, req))
+        if not placed:
+            return
+        if cows:
+            # one batched copy-on-write launch for the whole round
+            self._arenas = self.session.copy_blocks(
+                self._arenas, [s for s, _ in cows],
+                [d for _, d in cows])
+        groups: Dict[int, List[Tuple[int, _GenRequest]]] = {}
+        for slot, req in placed:
+            flen = len(req.prompt) - req.cached_len
+            groups.setdefault(self.session.prompt_bucket(flen),
+                              []).append((slot, req))
+        for pb, members in sorted(groups.items()):
+            S = self.slots
+            ids = np.zeros((S, pb), np.int32)
+            starts = np.zeros((S,), np.int32)
+            feed = np.zeros((S,), np.int32)
+            for slot, req in members:
+                suffix = req.prompt[req.cached_len:]
+                ids[slot, :len(suffix)] = suffix
+                starts[slot] = req.cached_len
+                feed[slot] = len(suffix)
+            tok, self._arenas = self.session.prefill(
+                self._arenas, self._table, ids, starts, feed,
+                self._keys, self._temps, self._tks, self._tps,
+                live_rows=len(members))
+            for slot, req in members:
+                # offer the now-filled prompt blocks to the prefix
+                # cache BEFORE emit (emit may retire the request,
+                # releasing its holds)
+                n = len(req.prompt)
+                self.prefix_cache.insert(
+                    req.prompt,
+                    req.blocks[:blocks_for_tokens(
+                        n, self.session.block_size)])
+                self._positions[slot] = n
+                self._last_tok[slot] = tok[slot]
+                self._emit(slot, int(tok[slot]))
+
+    def _decode_round(self, occ: List[int]):
+        from ..generation import BlockPoolExhausted, draft_row
+        k = self.speculative_k
+        if k > 0:
+            drafts: Dict[int, List[int]] = {}
+            for s in occ:
+                req = self._slot_req[s]
+                ctx = np.concatenate(
+                    [req.prompt, np.asarray(req.tokens, np.int32)])
+                room = self.max_length - int(self._positions[s])
+                drafts[s] = draft_row(ctx, k, room,
+                                      ngram=self.config.spec_ngram)
+            if any(drafts.values()):
+                self._verify_round(occ, drafts, k)
+                return
+        # plain paged decode: each live row writes one token at its
+        # position — grow its table lazily first
+        victims = []
+        for s in occ:
+            req = self._slot_req[s]
+            try:
+                self._ensure_blocks(s, req, int(self._positions[s]))
+            except BlockPoolExhausted as e:
+                victims.append((s, req, e))
+        for s, req, e in victims:
+            self._shed_kv(req, s, e)
+        occ = self._occupied()
+        if not occ:
+            return
+        tok, self._arenas = self.session.decode(
+            self._arenas, self._table, self._last_tok,
+            self._positions, self._keys, self._temps, self._tks,
+            self._tps, live_rows=len(occ))
+        with self._mlock:
+            self._m_occ.observe(len(occ))
+        self._positions = self._positions + 1
+        self._last_tok = np.array(tok, np.int32)
+        for s in occ:
+            self._emit(s, int(tok[s]))
+
+    def _verify_round(self, occ: List[int],
+                      drafts: Dict[int, List[int]], k: int):
+        """Speculative boundary: one batched verify at width k+1;
+        each row commits the longest prefix of its drafts the model's
+        own sampler agrees with, plus the correction token — the
+        committed stream is exactly what sequential decode would have
+        produced."""
+        from ..generation import (BlockPoolExhausted, accept_span,
+                                  fill_verify_row)
+        W = k + 1
+        S = self.slots
+        ids = np.zeros((S, W), np.int32)
+        feed = np.zeros((S,), np.int32)
+        victims, live = [], []
+        for s in occ:
+            req = self._slot_req[s]
+            d = drafts.get(s) or []
+            fill_verify_row(ids, feed, s, int(self._last_tok[s]), d)
+            try:
+                self._ensure_blocks(s, req,
+                                    int(self._positions[s]) + len(d))
+            except BlockPoolExhausted as e:
+                feed[s] = 0              # shed row stays inert
+                victims.append((s, req, e))
+                continue
+            live.append(s)
+        for s, req, e in victims:
+            self._shed_kv(req, s, e)
+        if not live:
+            return
+        toks, self._arenas = self.session.verify(
+            self._arenas, self._table, ids, self._positions, feed,
+            self._keys, self._temps, self._tks, self._tps,
+            live_rows=len(live))
+        with self._mlock:
+            self._m_occ.observe(len(live))
+        proposed = sum(len(drafts.get(s) or []) for s in live)
+        accepted = 0
+        for s in live:
+            span = accept_span(drafts.get(s) or [], toks[s])
+            for j, t in enumerate(span):
+                self._positions[s] += 1
+                self._last_tok[s] = int(t)
+                self._emit(s, int(t))
+                # count only drafts that actually committed (span[-1]
+                # is the correction/bonus token, not a draft; a row
+                # retiring mid-span discards the rest)
+                if j < len(span) - 1:
+                    accepted += 1
+                if self._slot_req[s] is None:
+                    break               # retired mid-span (eos/budget)
+        with self._mlock:
+            self._m_spec_proposed.inc(proposed)
+            self._m_spec_accepted.inc(accepted)
+            total = self._m_spec_proposed.value
+            self._g_spec_rate.set(
+                (self._m_spec_accepted.value / total) if total else 0.0)

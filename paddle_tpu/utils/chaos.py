@@ -38,6 +38,10 @@ matching the PR-1 instrumentation discipline)::
                      detection test bed)
     serve.request    serving InferenceEngine admission (``fail`` rejects
                      the request at submit, ``delay`` stalls the client)
+    kv.block_alloc   generation paged-KV BlockPool allocation (``fail``
+                     injects pool exhaustion — the engine must shed the
+                     request with RequestRejected(reason="kv_blocks"),
+                     never corrupt a live batch)
 
 Injections are counted in the metrics registry: ``chaos.injected``
 (total) and ``chaos.injected.<site>``.
@@ -55,7 +59,8 @@ __all__ = ["active", "ChaosError", "SITES", "parse_spec", "configure",
            "refresh", "hit", "call_count", "reset"]
 
 SITES = ("ckpt.write", "store.rpc", "store.partition", "fs.rename",
-         "loader.worker", "step.loss", "host.slow", "serve.request")
+         "loader.worker", "step.loss", "host.slow", "serve.request",
+         "kv.block_alloc")
 
 # module-level fast predicate — the single read hot paths gate on
 active = False

@@ -232,6 +232,28 @@ define_flag("FLAGS_conv_bn_fold", False,
             "(tolerance-level, not bit-exact), so it is OFF by default "
             "and excluded from the FLAGS_program_opt bit-exact "
             "pipeline; serving programs opt in for the latency win")
+define_flag("FLAGS_kv_cache_dtype", "float32",
+            "storage dtype of the paged KV-cache arenas "
+            "(generation/paged_kv.py): 'float32' (exact) or 'int8' "
+            "(per-token-per-head scales, dequantized inside the "
+            "attention executable — ~3.6x less HBM per block at a pinned "
+            "top-1/bitstream-tolerance gate).  Read by "
+            "GenerationEngineConfig at construction")
+define_flag("FLAGS_prefix_cache_blocks", 0,
+            "capacity (in KV blocks) of the content-addressed prefix "
+            "cache (generation/prefix_cache.py): sha256-keyed chains "
+            "of filled, refcounted, immutable blocks so shared system "
+            "prompts prefill once and hit forever; LRU-evicted past "
+            "this cap.  0 disables the cache (engines can still opt "
+            "in via GenerationEngineConfig.prefix_cache_blocks)")
+define_flag("FLAGS_speculative_k", 0,
+            "draft tokens proposed per decode step by the n-gram "
+            "prompt-lookup drafter (generation/speculative.py); one "
+            "batched verify executable accepts the longest agreeing "
+            "prefix, so accepted spans multiply tokens/s per stream "
+            "with a greedy-equivalence guarantee.  0 disables "
+            "speculative decoding (engines can opt in via "
+            "GenerationEngineConfig.speculative_k)")
 define_flag("FLAGS_prefetch_to_device", 2,
             "default device-prefetch depth used by Model.fit's train "
             "loop (batches kept resident on device by the io "

@@ -1,0 +1,478 @@
+"""Paged KV-cache serving memory subsystem (PR 11).
+
+Acceptance surface:
+
+- **block lifecycle** — BlockPool refcounting is exact: every way a
+  request leaves the engine (finish, eos, cancel, chaos shed, engine
+  close) returns its blocks; after a full workload + close the pool is
+  back to all-free (the leak canary);
+- **copy-on-write** — a partially filled shared block is copied before
+  a sharer appends into it, and the donor's bytes are unchanged;
+- **prefix cache** — content-addressed determinism (same prompt ->
+  same sha256 chain -> hit), LRU eviction under the block cap,
+  concurrent first-fill races cache exactly one copy;
+- **bit-parity** — paged greedy/sampled decode equals the contiguous
+  PR 6 reference token for token (block_size divides max_length, so
+  the gathered view capacity equals the contiguous capacity);
+- **int8 KV** — quantized arenas round-trip within tolerance and the
+  generated streams stay top-1-stable on the tiny reference model;
+- **speculative decoding** — the n-gram drafter + verify step commits
+  exactly the sequential sampler's stream (greedy AND sampled).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.generation import (BlockPool, BlockPoolExhausted,
+                                   PagedGenerationSession, PrefixCache,
+                                   accept_span, blocks_for_tokens,
+                                   propose_drafts)
+from paddle_tpu.models import GPT, GPTConfig
+from paddle_tpu.profiler import metrics
+
+CFG = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                num_heads=2, max_seq_len=64, ffn_mult=2)
+BS = 16                                  # block_size; divides 64
+
+
+def val(name):
+    m = metrics.get(name)
+    return m.value if m is not None else 0
+
+
+@pytest.fixture(scope="module")
+def net():
+    paddle.seed(0)
+    return GPT(CFG)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.RandomState(11)
+    return [rng.randint(1, CFG.vocab_size, (n,)).astype(np.int32)
+            for n in (5, 9, 13, 7, 21, 4)]
+
+
+def paged_engine(net, name, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_length", 64)
+    kw.setdefault("max_new_tokens", 8)
+    kw.setdefault("block_size", BS)
+    return serving.PagedGenerationEngine(
+        net, serving.GenerationEngineConfig(name=name, **kw))
+
+
+# -- BlockPool ---------------------------------------------------------
+
+def test_pool_alloc_free_refcount():
+    pool = BlockPool(8, BS, name="tp_pool")
+    a = pool.alloc(3)
+    assert len(a) == 3 and pool.available == 5
+    pool.incref(a)                        # second holder
+    assert pool.decref(a) == 0            # first release frees nothing
+    assert pool.available == 5
+    assert pool.decref(a) == 3            # last holder frees all
+    assert pool.available == 8
+
+
+def test_pool_all_or_nothing_and_typed_exhaustion():
+    pool = BlockPool(4, BS, name="tp_pool2")
+    pool.alloc(3)
+    with pytest.raises(BlockPoolExhausted):
+        pool.alloc(2)                     # only 1 free: nothing granted
+    assert pool.available == 1            # no partial grant leaked
+
+
+def test_pool_refcount_misuse_raises():
+    pool = BlockPool(2, BS, name="tp_pool3")
+    (b,) = pool.alloc(1)
+    pool.decref([b])
+    with pytest.raises(ValueError):
+        pool.decref([b])                  # double free
+    with pytest.raises(ValueError):
+        pool.incref([b])                  # resurrecting a free block
+
+
+def test_blocks_for_tokens():
+    assert blocks_for_tokens(1, 16) == 1
+    assert blocks_for_tokens(16, 16) == 1
+    assert blocks_for_tokens(17, 16) == 2
+
+
+# -- PrefixCache -------------------------------------------------------
+
+def test_prefix_cache_hit_is_deterministic():
+    pool = BlockPool(16, 4, name="tp_pc1")
+    cache = PrefixCache(pool, capacity_blocks=8, name="tp_pc1")
+    toks = np.arange(1, 11, dtype=np.int32)          # 10 tokens, bs=4
+    blocks = pool.alloc(blocks_for_tokens(10, 4))    # 3 blocks
+    cache.insert(toks, blocks)
+    got, covered = cache.lookup(toks)
+    assert covered == 10 and got == blocks           # full cover + tail
+    # the lookup transferred refs: release them, then the request's own
+    pool.decref(got)
+    # different content, same length -> miss
+    other = toks + 1
+    got2, covered2 = cache.lookup(other)
+    assert covered2 == 0 and got2 == []
+
+
+def test_prefix_cache_partial_cover_block_boundary():
+    pool = BlockPool(16, 4, name="tp_pc2")
+    cache = PrefixCache(pool, capacity_blocks=8, name="tp_pc2")
+    donor = np.arange(1, 9, dtype=np.int32)          # 8 = 2 full blocks
+    blocks = pool.alloc(2)
+    cache.insert(donor, blocks)
+    # a longer prompt sharing the first 8 tokens covers 2 blocks
+    longer = np.concatenate([donor, np.int32([90, 91, 92])])
+    got, covered = cache.lookup(longer)
+    assert covered == 8 and got == blocks
+    pool.decref(got)
+
+
+def test_prefix_cache_lru_eviction_under_cap():
+    pool = BlockPool(16, 4, name="tp_pc3")
+    cache = PrefixCache(pool, capacity_blocks=2, name="tp_pc3")
+    used0 = pool.used
+    for base in (0, 20, 40):              # 3 single-block inserts, cap 2
+        toks = np.arange(base + 1, base + 5, dtype=np.int32)
+        blocks = pool.alloc(1)
+        cache.insert(toks, blocks)
+        pool.decref(blocks)               # request retires immediately
+    assert len(cache) == 2                # oldest entry evicted
+    got, covered = cache.lookup(np.arange(1, 5, dtype=np.int32))
+    assert covered == 0                   # the base=0 entry is gone
+    got, covered = cache.lookup(np.arange(41, 45, dtype=np.int32))
+    assert covered == 4                   # newest still cached
+    pool.decref(got)
+    cache.clear()
+    assert pool.used == used0             # cache held the only refs
+
+
+def test_prefix_cache_concurrent_first_fill_caches_once():
+    """Two racing inserts of the same prompt: exactly one copy is
+    cached; the loser's blocks stay private (its own refs intact)."""
+    pool = BlockPool(16, 4, name="tp_pc4")
+    cache = PrefixCache(pool, capacity_blocks=8, name="tp_pc4")
+    toks = np.arange(1, 9, dtype=np.int32)
+    mine = [pool.alloc(2) for _ in range(2)]
+    barrier = threading.Barrier(2)
+
+    def racer(i):
+        barrier.wait()
+        cache.insert(toks, mine[i])
+    ths = [threading.Thread(target=racer, args=(i,)) for i in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    got, covered = cache.lookup(toks)
+    assert covered == 8 and len(got) == 2
+    winner = set(got)
+    # exactly one insert won; its blocks are refcounted 1 (request) + 1
+    # (cache) + 1 (this lookup); the loser's blocks stay at 1
+    assert winner == set(mine[0]) or winner == set(mine[1])
+    loser = mine[1] if winner == set(mine[0]) else mine[0]
+    for b in loser:
+        assert pool.refcount(b) == 1
+    for b in winner:
+        assert pool.refcount(b) == 3
+    pool.decref(got)
+
+
+def test_prefix_cache_disabled_at_zero_cap():
+    pool = BlockPool(8, 4, name="tp_pc5")
+    cache = PrefixCache(pool, capacity_blocks=0, name="tp_pc5")
+    toks = np.arange(1, 9, dtype=np.int32)
+    blocks = pool.alloc(2)
+    cache.insert(toks, blocks)            # no-op
+    got, covered = cache.lookup(toks)
+    assert covered == 0 and got == [] and len(cache) == 0
+    pool.decref(blocks)
+    assert pool.available == 8
+
+
+# -- paged session: parity + write validity ----------------------------
+
+def test_paged_generate_bit_equal_contiguous_greedy(net, prompts):
+    ref_ses = net  # contiguous reference via the plain session
+    from paddle_tpu.generation import GenerationSession
+    ses = GenerationSession(net, batch_capacity=4, max_length=64,
+                            name="tp_ref")
+    pses = PagedGenerationSession(net, batch_capacity=4, max_length=64,
+                                  block_size=BS, name="tp_paged")
+    batch = prompts[:4]
+    ref = ses.generate(batch, max_new_tokens=8)
+    got = pses.generate(batch, max_new_tokens=8)
+    for r, g in zip(ref, got):
+        assert np.array_equal(r, g)
+
+
+def test_paged_generate_bit_equal_contiguous_sampled(net, prompts):
+    from paddle_tpu.generation import GenerationSession
+    ses = GenerationSession(net, batch_capacity=4, max_length=64,
+                            name="tp_refs")
+    pses = PagedGenerationSession(net, batch_capacity=4, max_length=64,
+                                  block_size=BS, name="tp_pageds")
+    kw = dict(max_new_tokens=8, do_sample=True, temperature=0.8,
+              top_k=12, top_p=0.95, seeds=[7, 8, 9, 10])
+    ref = ses.generate(prompts[:4], **kw)
+    got = pses.generate(prompts[:4], **kw)
+    for r, g in zip(ref, got):
+        assert np.array_equal(r, g)
+
+
+def test_int8_kv_roundtrip_tolerance():
+    from paddle_tpu.quantization import (dequantize_int8_jnp,
+                                         quantize_int8_jnp)
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 5, 4, 8).astype(np.float32)
+    q, s = quantize_int8_jnp(x, axis=-1)
+    back = np.asarray(dequantize_int8_jnp(q, s, axis=-1))
+    assert np.asarray(q).dtype == np.int8
+    # symmetric abs-max int8: worst-case error is half a step
+    step = np.abs(x).max(axis=-1, keepdims=True) / 127.0
+    assert np.all(np.abs(back - x) <= 0.5 * step + 1e-7)
+
+
+def test_int8_kv_generate_top1_stable(net, prompts):
+    """int8 arenas are tolerance-level, not bit-exact — but on the
+    reference model the greedy stream must stay top-1 identical (the
+    pinned gate the flag documents)."""
+    from paddle_tpu.generation import GenerationSession
+    ses = GenerationSession(net, batch_capacity=4, max_length=64,
+                            name="tp_refq")
+    pses = PagedGenerationSession(net, batch_capacity=4, max_length=64,
+                                  block_size=BS, kv_dtype="int8",
+                                  name="tp_pagedq")
+    ref = ses.generate(prompts[:4], max_new_tokens=8)
+    got = pses.generate(prompts[:4], max_new_tokens=8)
+    same = sum(int(np.array_equal(r, g)) for r, g in zip(ref, got))
+    assert same == len(ref), (same, len(ref))
+
+
+def test_write_drop_marker_not_wraparound(net):
+    """A write mapped to an unallocated table entry must be DROPPED —
+    a -1 index would wrap python-style and corrupt the LAST block."""
+    import jax.numpy as jnp
+    from paddle_tpu.generation import PagedKV, init_arenas, write_paged
+    arenas = init_arenas(1, 4, 4, CFG.num_heads,
+                         CFG.hidden_size // CFG.num_heads)
+    poison = jnp.full(arenas[0].k.shape, 7.0)
+    arena = type(arenas[0])(poison, poison)
+    table = jnp.full((1, 2), -1, jnp.int32)   # nothing allocated
+    cache = PagedKV(arena, table, jnp.asarray([8], jnp.int32))
+    H, D = CFG.num_heads, CFG.hidden_size // CFG.num_heads
+    newk = jnp.ones((1, 2, H, D))
+    out = write_paged(cache, newk, newk, jnp.asarray([0], jnp.int32))
+    assert np.array_equal(np.asarray(out.arena.k),
+                          np.asarray(poison))  # dropped, nothing wrote
+
+
+# -- speculative primitives --------------------------------------------
+
+def test_propose_drafts_prompt_lookup():
+    ctx = [1, 2, 3, 9, 1, 2]              # trailing (1,2) seen earlier
+    assert propose_drafts(ctx, 3, ngram=2) == [3, 9, 1]
+    assert propose_drafts([1, 2, 3], 0) == []
+    assert propose_drafts([5, 6, 7], 3, ngram=2) == []   # no repeat
+
+
+def test_accept_span_longest_prefix_plus_bonus():
+    assert accept_span([4, 5, 6], [4, 5, 9, 8]) == [4, 5, 9]
+    assert accept_span([4, 5], [7, 5, 6]) == [7]          # miss at 0
+    assert accept_span([], [3]) == [3]                    # plain decode
+
+
+def test_speculative_stream_bit_equal(net, prompts):
+    """speculative_k > 0 must not change a single token — greedy AND
+    sampled (the acceptance rule only commits what the sequential
+    sampler would have produced)."""
+    pses = PagedGenerationSession(net, batch_capacity=4, max_length=64,
+                                  block_size=BS, name="tp_spec")
+    # repetition-heavy prompts so drafts actually get accepted
+    rep = [np.tile(np.int32([5, 6, 7]), 6),
+           np.tile(np.int32([9, 4]), 8)]
+    for kw in (dict(), dict(do_sample=True, temperature=0.9,
+                            top_k=12, top_p=0.95, seeds=[3, 4])):
+        ref = pses.generate(rep, max_new_tokens=10, **kw)
+        got = pses.generate(rep, max_new_tokens=10, speculative_k=3,
+                            **kw)
+        for r, g in zip(ref, got):
+            assert np.array_equal(r, g)
+
+
+# -- engine lifecycle: leaks, chaos shed, admission --------------------
+
+def test_engine_pool_all_free_after_mixed_retirement(net, prompts):
+    """finish + eos + cancel + close: the pool must drain to all-free
+    (prefix cache cleared at close) — the leak canary."""
+    with paged_engine(net, "tp_leak", prefix_cache_blocks=4) as eng:
+        eng.generate(prompts[0], max_new_tokens=6, timeout=120)
+        eng.generate(prompts[1], max_new_tokens=4, timeout=120,
+                     eos_token_id=int(
+                         eng.generate(prompts[1], max_new_tokens=1,
+                                      timeout=120)[0]))
+        s = eng.submit(prompts[2], max_new_tokens=8)
+        next(iter(s))                     # first token streamed
+        s.cancel()
+        s.result(timeout=120)
+    assert eng.pool.available == eng.pool.num_blocks
+    assert len(eng.prefix_cache) == 0
+
+
+def test_engine_paged_matches_contiguous_engine(net, prompts):
+    with serving.GenerationEngine(
+            net, serving.GenerationEngineConfig(
+                max_slots=4, max_length=64, max_new_tokens=8,
+                name="tp_c_eng")) as ceng:
+        refs = [ceng.generate(p, max_new_tokens=8, timeout=120)
+                for p in prompts]
+    with paged_engine(net, "tp_p_eng") as peng:
+        for p, r in zip(prompts, refs):
+            got = peng.generate(p, max_new_tokens=8, timeout=120)
+            assert np.array_equal(got, r)
+    assert peng.pool.available == peng.pool.num_blocks
+
+
+def test_engine_prefix_cache_hits_skip_prefill(net, prompts):
+    sys_prompt = np.tile(np.int32([11, 12, 13, 14]), 5)   # 20 tokens
+    with paged_engine(net, "tp_hits", prefix_cache_blocks=8) as eng:
+        mk = lambda tail: np.concatenate(   # noqa: E731
+            [sys_prompt, np.int32(tail)])
+        first = eng.generate(mk([21, 22]), max_new_tokens=4,
+                             timeout=120)
+        assert val("tp_hits.prefix_cache.hit") == 0
+        eng.generate(mk([31, 32]), max_new_tokens=4, timeout=120)
+        assert val("tp_hits.prefix_cache.hit") == 1
+        assert val("tp_hits.prefix_cache.hit_tokens") >= BS
+        # determinism: the hitting request still equals a cold run
+        again = eng.generate(mk([21, 22]), max_new_tokens=4,
+                             timeout=120)
+        assert np.array_equal(first, again)
+    assert eng.pool.available == eng.pool.num_blocks
+
+
+def test_engine_chaos_shed_typed_and_leak_free(net, prompts):
+    """kv.block_alloc injection: the victim gets a typed
+    RequestRejected(reason='kv_blocks'), neighbours stream bit-exact,
+    nothing leaks."""
+    with paged_engine(net, "tp_chaos") as eng:
+        ref = eng.generate(prompts[0], max_new_tokens=6, timeout=120)
+        paddle.set_flags(
+            {"FLAGS_chaos_spec": "kv.block_alloc:fail@1"})
+        try:
+            with pytest.raises(serving.RequestRejected) as ei:
+                eng.generate(prompts[1], max_new_tokens=6, timeout=120)
+            assert ei.value.reason == "kv_blocks"
+        finally:
+            paddle.set_flags({"FLAGS_chaos_spec": ""})
+        # engine unharmed: same request now succeeds and matches
+        got = eng.generate(prompts[0], max_new_tokens=6, timeout=120)
+        assert np.array_equal(got, ref)
+        assert val("tp_chaos.request.shed_kv_blocks") == 1
+    assert eng.pool.available == eng.pool.num_blocks
+
+
+def test_engine_organic_exhaustion_sheds_not_corrupts(net, prompts):
+    """A pool too small for a second stream sheds the newcomer while
+    the running stream finishes unharmed."""
+    with paged_engine(net, "tp_tiny", max_slots=2,
+                      num_blocks=3) as eng:     # 3 of 8 worst-case
+        long_p = np.tile(np.int32([3, 4, 5]), 9)     # 27 toks = 2 blks
+        s1 = eng.submit(long_p, max_new_tokens=20)   # grows into blk 3
+        shed = 0
+        for _ in range(4):
+            try:
+                eng.generate(long_p + 1, max_new_tokens=20,
+                             timeout=120)
+            except serving.RequestRejected as e:
+                assert e.reason == "kv_blocks"
+                shed += 1
+        out = s1.result(timeout=120)
+        assert len(out) > 0
+        assert shed >= 1
+    assert eng.pool.available == eng.pool.num_blocks
+
+
+def test_engine_speculative_matches_reference(net):
+    rep = np.tile(np.int32([5, 6, 7]), 6)
+    with paged_engine(net, "tp_seng0") as base:
+        ref = base.generate(rep, max_new_tokens=10, timeout=120)
+    with paged_engine(net, "tp_seng", speculative_k=3) as eng:
+        got = eng.generate(rep, max_new_tokens=10, timeout=120)
+        assert np.array_equal(got, ref)
+        assert val("tp_seng.spec.proposed") > 0
+    assert eng.pool.available == eng.pool.num_blocks
+
+
+def test_engine_speculative_accepts_with_oracle_drafter(
+        net, monkeypatch):
+    """Drive the verify/commit machinery at a pinned accept rate: an
+    oracle drafter that proposes the true greedy continuation (from a
+    non-speculative reference) must get every draft accepted — each
+    boundary commits k+1 tokens and the stream stays bit-exact.  (The
+    n-gram drafter can't accept organically on this random-weight
+    model: its greedy stream never repeats within max_new.)"""
+    import paddle_tpu.generation as _gen
+    rep = np.tile(np.int32([5, 6, 7]), 6)
+    with paged_engine(net, "tp_oracle0") as base:
+        ref = base.generate(rep, max_new_tokens=12, timeout=120)
+    truth = ref.tolist()
+
+    def oracle(context, k, ngram=2):
+        ctx = np.asarray(context).reshape(-1)
+        done = int(ctx.size) - rep.size     # tokens generated so far
+        return truth[done:done + int(k)]
+    # patch the speculative module itself: draft_row (the shared
+    # clamp helper both drivers call) resolves propose_drafts from
+    # its own module globals, not the package re-export
+    monkeypatch.setattr(_gen.speculative, "propose_drafts", oracle)
+    with paged_engine(net, "tp_oracle", speculative_k=3) as eng:
+        got = eng.generate(rep, max_new_tokens=12, timeout=120)
+        assert np.array_equal(got, ref)
+        assert val("tp_oracle.spec.proposed") > 0
+        assert val("tp_oracle.spec.accepted") == \
+            val("tp_oracle.spec.proposed")  # oracle: all accepted
+        # k+1 tokens per boundary -> fewer verify rounds than tokens
+        m = metrics.get("tp_oracle.decode")
+        assert m is not None and m._count < len(ref)
+    assert eng.pool.available == eng.pool.num_blocks
+
+
+def test_engine_concurrent_streams_leak_free(net, prompts):
+    """Staggered concurrent traffic over a provisioned-for-live-tokens
+    pool (smaller than worst case): everything completes or sheds
+    typed; pool drains to all-free after close."""
+    results, shed = {}, []
+    with paged_engine(net, "tp_conc", max_slots=4,
+                      num_blocks=12,           # 12 < 4*4 worst case
+                      prefix_cache_blocks=0) as eng:
+        def client(i):
+            time.sleep(0.003 * i)
+            try:
+                results[i] = eng.generate(
+                    prompts[i % len(prompts)], max_new_tokens=6,
+                    timeout=120)
+            except serving.RequestRejected as e:
+                assert e.reason == "kv_blocks"
+                shed.append(i)
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(8)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert len(results) + len(shed) == 8
+        from paddle_tpu.generation import GenerationSession
+        ref_ses = GenerationSession(net, batch_capacity=4,
+                                    max_length=64, name="tp_conc_ref")
+        for i, out in results.items():
+            ref = ref_ses.generate(
+                [prompts[i % len(prompts)]], max_new_tokens=6)[0]
+            assert np.array_equal(out, ref)
+    assert eng.pool.available == eng.pool.num_blocks

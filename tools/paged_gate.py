@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""CI paged gate: the PagedGenerationEngine (block-pool KV memory,
+PR 11) under staggered concurrent streams with a FIXED
+``kv.block_alloc`` chaos spec must lose nothing, stream bit-exact
+sequences against the PR 6 contiguous references, shed EXACTLY the
+injected count with the typed reason, hit the prefix cache a pinned
+number of times on a repeated-system-prompt workload, and compile no
+more executables than the bucket bound allows.
+
+Four phases:
+
+1. chaos soak — 3 client threads x 4 staggered generation requests
+   (mixed prompt lengths, mixed greedy/sampled configs, per-request
+   seeds) under ``kv.block_alloc:fail@7`` (the 7th block allocation,
+   globally, is injected to exhaust): every request must either stream
+   to completion or be THE single typed
+   ``RequestRejected(reason="kv_blocks")`` shed; zero lost; the pool
+   drains to all-free after close (no leaked refcounts).
+2. parity — every completed stream (iterator tokens AND final result)
+   must be IDENTICAL to a sequential CONTIGUOUS
+   ``GenerationSession.generate`` reference: paging, block tables,
+   lazy growth, admission timing, and the shed neighbour may not
+   change a single token.
+3. prefix cache — N requests sharing one system-prompt prefix with
+   distinct tails: exactly N-1 hits, >= (N-1) * block_size prompt
+   tokens served from cache, and the hitting streams still equal their
+   cold references.
+4. accounting — total XLA compiles <= one chunk executable per pow2
+   prompt bucket + one width-1 decode + one block-copy helper (block
+   tables are DATA: they never enter a compile key); shed counter ==
+   injected counter == 1.
+
+Wired into tools/run_all_tests.sh next to the decode gate.
+"""
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+CHAOS_SPEC = "kv.block_alloc:fail@7"
+CLIENTS, PER_CLIENT = 3, 4
+MAX_NEW = 5
+BS = 16
+
+
+def val(name):
+    from paddle_tpu.profiler import metrics
+    m = metrics.get(name)
+    return m.value if m is not None else 0
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.generation import GenerationSession
+    from paddle_tpu.models import GPT, GPTConfig
+    from paddle_tpu.serving.bucketing import seq_buckets
+
+    paddle.seed(0)
+    net = GPT(GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64, ffn_mult=2))
+    engine = serving.PagedGenerationEngine(
+        net, serving.GenerationEngineConfig(
+            max_slots=4, max_length=64, max_new_tokens=MAX_NEW,
+            block_size=BS, name="pg_soak"))
+
+    rng = np.random.RandomState(7)
+    jobs = []
+    for c in range(CLIENTS):
+        for r in range(PER_CLIENT):
+            n = int(rng.randint(3, 11))
+            jobs.append(dict(
+                prompt=rng.randint(1, 97, (n,)).astype(np.int32),
+                kw=dict(max_new_tokens=MAX_NEW,
+                        do_sample=bool((c + r) % 2),
+                        temperature=0.8, top_k=12, top_p=0.95,
+                        seed=1000 + 10 * c + r)))
+
+    # -- phase 1: kv.block_alloc chaos soak ---------------------------
+    paddle.set_flags({"FLAGS_chaos_spec": CHAOS_SPEC})
+    ok, shed, lost = [], [], []
+
+    def client(tid):
+        for r in range(PER_CLIENT):
+            time.sleep(0.002 * (tid + r))     # staggered arrivals
+            job = jobs[tid * PER_CLIENT + r]
+            try:
+                stream = engine.submit(job["prompt"], **job["kw"])
+                toks = list(stream)           # the STREAMED sequence
+                final = stream.result(timeout=300)
+            except serving.RequestRejected as e:
+                if e.reason == "kv_blocks":   # the typed injected shed
+                    shed.append((tid, r))
+                else:
+                    lost.append(f"untyped rejection ({tid},{r}): {e}")
+                continue
+            except Exception as e:            # anything else is lost
+                lost.append(repr(e))
+                continue
+            if toks != final.tolist():
+                lost.append(f"stream/result mismatch ({tid},{r})")
+            else:
+                job["got"] = final
+                ok.append((tid, r))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    paddle.set_flags({"FLAGS_chaos_spec": ""})
+
+    total = CLIENTS * PER_CLIENT
+    assert not lost, f"lost/wrong requests: {lost}"
+    assert len(shed) == 1, \
+        f"expected exactly 1 kv_blocks shed, got {len(shed)}"
+    assert len(ok) == total - 1, (len(ok), total)
+    assert val("chaos.injected.kv.block_alloc") == 1
+    assert val("pg_soak.request.shed_kv_blocks") == 1
+    assert val("pg_soak.kv.alloc_exhausted") == 1
+    engine.close()
+    assert engine.pool.available == engine.pool.num_blocks, \
+        "leaked KV blocks after soak + close"
+
+    # -- phase 2: paged streams == contiguous references --------------
+    ref_ses = GenerationSession(net, batch_capacity=4, max_length=64,
+                                name="pg_ref")
+    for job in jobs:
+        if "got" not in job:
+            continue
+        ref = ref_ses.generate([job["prompt"]], **job["kw"])[0]
+        assert np.array_equal(job["got"], ref), \
+            (job["got"], ref, "paging changed tokens")
+
+    # -- phase 3: pinned prefix-cache hits ----------------------------
+    sys_prompt = np.tile(np.int32([11, 12, 13, 14, 15]), 5)  # 25 toks
+    N = 4
+    peng = serving.PagedGenerationEngine(
+        net, serving.GenerationEngineConfig(
+            max_slots=4, max_length=64, max_new_tokens=MAX_NEW,
+            block_size=BS, prefix_cache_blocks=8, name="pg_prefix"))
+    outs = []
+    for i in range(N):
+        tail = np.int32([40 + i, 50 + i])
+        outs.append(peng.generate(
+            np.concatenate([sys_prompt, tail]),
+            max_new_tokens=MAX_NEW, timeout=300))
+    hits = val("pg_prefix.prefix_cache.hit")
+    hit_tokens = val("pg_prefix.prefix_cache.hit_tokens")
+    assert hits == N - 1, \
+        f"expected exactly {N - 1} prefix-cache hits, got {hits}"
+    assert hit_tokens >= (N - 1) * BS, (hit_tokens, (N - 1) * BS)
+    for i, got in enumerate(outs):        # hitters equal cold refs
+        tail = np.int32([40 + i, 50 + i])
+        ref = ref_ses.generate(
+            [np.concatenate([sys_prompt, tail])],
+            max_new_tokens=MAX_NEW)[0]
+        assert np.array_equal(got, ref), \
+            "prefix-cache hit changed tokens"
+    peng.close()
+    assert peng.pool.available == peng.pool.num_blocks, \
+        "leaked KV blocks after prefix workload + close"
+
+    # -- phase 4: compile accounting ----------------------------------
+    # per engine: one chunk executable per pow2 suffix bucket + the
+    # width-1 decode + one block-copy (COW) helper; block tables and
+    # pool state are data, never key material
+    bound = len(seq_buckets(64, engine.config.prompt_bucket_min)) + 2
+    for name in ("pg_soak", "pg_prefix"):
+        compiles = val(f"{name}.compile")
+        assert 0 < compiles <= bound, \
+            f"{name}: {compiles} compiles (bound {bound})"
+    assert val("pg_soak.request.completed") == len(ok)
+    assert val("pg_prefix.request.completed") == N
+    print(f"paged gate OK: {len(ok)}/{total} streamed bit-exact vs "
+          f"contiguous refs, 1 typed kv_blocks shed (injected), "
+          f"{val('pg_prefix.prefix_cache.hit')} pinned prefix hits "
+          f"({hit_tokens} tokens served from cache), compiles "
+          f"{val('pg_soak.compile')}/{val('pg_prefix.compile')} "
+          f"(bound {bound}), pools drained to all-free")
+
+
+if __name__ == "__main__":
+    main()

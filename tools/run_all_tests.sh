@@ -64,6 +64,14 @@ python tools/cache_gate.py
 # total XLA compiles bounded by the prompt-bucket count (+1 decode
 # executable) — the per-token-retrace failure mode stays pinned shut.
 python tools/decode_gate.py
+# Paged gate (PR 11 serving-memory subsystem): the PagedGenerationEngine
+# under staggered concurrent streams with a fixed kv.block_alloc chaos
+# spec — zero lost requests, paged greedy/sampled streams bit-exact vs
+# the contiguous references, exactly one typed kv_blocks shed, a pinned
+# prefix-cache hit count on a repeated-system-prompt workload, pools
+# drained to all-free (no leaked block refcounts), and compiles still
+# bounded by the prompt buckets (block tables are data, never shape).
+python tools/paged_gate.py
 # Kernel gate (r10 conv-leg MFU work): fixed-seed 10-step ResNet18 fit
 # fused vs unfused must stay loss-parity within tolerance (step 1 to
 # float32 noise), a conv+bn+relu block must dispatch as ONE op with the
