@@ -271,6 +271,10 @@ def _commit(tmp: str, final: str, *, step: Optional[int],
         f.flush()
         os.fsync(f.fileno())
     _fsync_dir(final)
+    from ..profiler import flight as _flight
+    if _flight.active:
+        _flight.note("ckpt", "commit", step=marker["step"],
+                     path=os.path.basename(final))
 
 
 def verify_checkpoint(path: str) -> Dict[str, Any]:
@@ -692,6 +696,10 @@ class AsyncCheckpointer:
             _metrics.counter("ckpt.write_fail",
                              "async checkpoint writes that failed "
                              "before commit").inc()
+            from ..profiler import flight as _flight
+            if _flight.active:
+                _flight.note("ckpt", "write_fail", step=step,
+                             error=f"{type(e).__name__}: {e}")
             warnings.warn(f"checkpoint save for step {step} failed "
                           f"({e!r}); the previous intact step remains "
                           f"restorable")

@@ -50,6 +50,7 @@ import signal
 import statistics
 import subprocess
 import sys
+import tempfile
 import time
 from collections import deque
 
@@ -381,6 +382,11 @@ class PodLauncher:
                 bad = next(((rc, i) for i, rc in enumerate(rcs)
                             if rc not in (None, 0)), None)
                 if bad is not None:
+                    # signal the survivors before killing the gang so
+                    # each one's log ends with its thread stacks AND
+                    # flight-recorder tail (the dead rank can't dump —
+                    # its gangmates' history is the evidence left)
+                    self.dump_stacks()
                     self.stop()
                     return "crash", bad[0], a.host_rank * a.nproc + bad[1]
                 if all(rc == 0 for rc in rcs):
@@ -521,11 +527,15 @@ def _rendezvous_round(store, job: str, generation: int, slots,
     count toward — or join — the new gang.  Store outages degrade to
     the supervisor's local membership view: a rendezvous round never
     blocks a relaunch.  Counted as ``launch.rendezvous_rounds``."""
+    from ..profiler import flight as _flight
     from ..profiler import metrics as _metrics
     _metrics.counter(
         "launch.rendezvous_rounds",
         "elastic-supervise rendezvous rounds (one per gang "
         "formation)").inc()
+    if _flight.active:
+        _flight.note("launch", "rendezvous", generation=generation,
+                     slots=len(slots))
     deny = set()
     try:
         deny = {k.rsplit("/", 1)[-1] for k in
@@ -555,19 +565,21 @@ def _deny_slot(store, job: str, slot: str):
 
 
 def _purge_stale_generations(store, job: str, generation: int):
-    """Delete heartbeat keys from generations before ``generation``.
-    Ignore-by-prefix in ``supervise`` is the correctness mechanism (a
-    slow-dying worker can rewrite its old key after this purge); the
-    delete is hygiene so the store doesn't accrete one key set per
-    restart."""
-    pfx = f"{SUPERVISE_PREFIX}{job}/"
-    keep = f"{pfx}g{generation}/"
-    try:
-        for k in store.list_prefix(pfx):
-            if not k.startswith(keep):
-                store.delete(k)
-    except Exception:
-        pass
+    """Delete heartbeat AND fleet-metrics keys from generations before
+    ``generation``.  Ignore-by-prefix in ``supervise`` is the
+    correctness mechanism (a slow-dying worker can rewrite its old key
+    after this purge); the delete is hygiene so the store doesn't
+    accrete one key set per restart."""
+    from .fleet_metrics import METRICS_PREFIX
+    for root in (SUPERVISE_PREFIX, METRICS_PREFIX):
+        pfx = f"{root}{job}/"
+        keep = f"{pfx}g{generation}/"
+        try:
+            for k in store.list_prefix(pfx):
+                if not k.startswith(keep):
+                    store.delete(k)
+        except Exception:
+            pass
 
 
 def _supervised_loop(args, tail, pod_ref):
@@ -601,6 +613,35 @@ def _supervised_loop(args, tail, pod_ref):
         server = KVServer().start()
         spec = f"tcp://{server.endpoint}"
     store = store_from_spec(spec)
+    # flight-recorder dump directory: every worker's SIGUSR1/crash
+    # dumps (and the supervisor's own) land here, then fold into the
+    # supervise report — the post-mortem starts pre-assembled
+    flight_dir = os.environ.get("PADDLE_FLIGHT_DIR")
+    if not flight_dir:
+        flight_dir = args.log_dir or tempfile.mkdtemp(
+            prefix="paddle_flight_")
+        os.environ["PADDLE_FLIGHT_DIR"] = flight_dir
+    os.makedirs(flight_dir, exist_ok=True)
+    # a reused --log_dir may hold a PREVIOUS run's flight dumps; only
+    # dumps written after this instant belong in this run's report
+    flight_t0 = time.time()
+    # aggregated fleet /metrics endpoint (opt-in by port): every
+    # rank's registry snapshot, rank-labeled + min/max/sum rollups
+    gen_ref = {"g": 0}
+    metrics_server = None
+    mport = os.environ.get("PADDLE_FLEET_METRICS_PORT")
+    if mport is not None:
+        from .fleet_metrics import FleetMetricsServer
+        try:
+            metrics_server = FleetMetricsServer(
+                spec, job, lambda: gen_ref["g"],
+                port=int(mport)).start()
+            print(f"launch: fleet metrics at http://"
+                  f"{metrics_server.host}:{metrics_server.port}"
+                  f"/metrics", file=sys.stderr)
+        except Exception as e:
+            print(f"launch: fleet metrics server failed ({e!r}); "
+                  f"continuing without aggregation", file=sys.stderr)
     interval = os.environ.get("PADDLE_HEARTBEAT_INTERVAL", "1.0")
     factor = _flags.get_flag("FLAGS_straggler_factor")
     patience = _flags.get_flag("FLAGS_straggler_patience")
@@ -622,6 +663,7 @@ def _supervised_loop(args, tail, pod_ref):
     outcome = {"kind": "done", "code": 0}
     try:
         while True:
+            gen_ref["g"] = generation
             if elastic:
                 slots = _rendezvous_round(store, job, generation, slots,
                                           hi)
@@ -706,6 +748,11 @@ def _supervised_loop(args, tail, pod_ref):
             outcome = {"kind": kind, "code": code}
             return code if code else 1
     finally:
+        # the supervisor's own flight ring (rendezvous rounds,
+        # per-generation formation history) joins the workers' dumps
+        from ..profiler import flight as _flight
+        _flight.dump(os.path.join(flight_dir, "flight.supervisor.json"),
+                     reason="supervise-exit")
         report = os.environ.get("PADDLE_SUPERVISE_REPORT")
         if report:
             with open(report, "w") as f:
@@ -718,9 +765,48 @@ def _supervised_loop(args, tail, pod_ref):
                            "generation": generation,
                            "rendezvous_rounds": rdzv_rounds,
                            "stragglers": stragglers,
+                           "flight_dir": flight_dir,
+                           "flight_dumps": _collect_flight_dumps(
+                               flight_dir, min_mtime=flight_t0),
                            **outcome}, f)
+        if metrics_server is not None:
+            metrics_server.stop()
         if server is not None:
             server.stop()
+
+
+def _collect_flight_dumps(flight_dir: str, tail: int = 10,
+                          min_mtime: float = 0.0):
+    """Fold this run's flight dumps under ``flight_dir`` into the
+    supervise report: per dump, the event counts and the last ``tail``
+    events — enough for a first read of *what the gang was doing*
+    without opening each file.  ``min_mtime`` fences out stale dumps a
+    previous run left in a reused log directory."""
+    out = {}
+    try:
+        names = sorted(os.listdir(flight_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("flight.") and name.endswith(".json")):
+            continue
+        path = os.path.join(flight_dir, name)
+        try:
+            if os.path.getmtime(path) < min_mtime:
+                continue
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        evs = doc.get("events") or []
+        out[name] = {"reason": doc.get("reason"),
+                     "rank": doc.get("rank"),
+                     "generation": doc.get("generation"),
+                     "events": len(evs),
+                     "counts": doc.get("counts") or {},
+                     "tail": [f"{e.get('cat')}.{e.get('event')}"
+                              for e in evs[-tail:]]}
+    return out
 
 
 if __name__ == "__main__":

@@ -255,16 +255,23 @@ class BlockPool:
         n = int(n)
         if n == 0:
             return []
+        from ..profiler import flight as _flight
         from ..utils import chaos as _chaos
         if _chaos.active:
             try:
                 _chaos.hit("kv.block_alloc", exc=BlockPoolExhausted)
             except BlockPoolExhausted:
                 self._c_exhausted.inc()
+                if _flight.active:
+                    _flight.note("kv", "exhausted", need=n,
+                                 injected=True)
                 raise
         with self._lock:
             if len(self._free) < n:
                 self._c_exhausted.inc()
+                if _flight.active:
+                    _flight.note("kv", "exhausted", need=n,
+                                 free=len(self._free))
                 raise BlockPoolExhausted(
                     f"need {n} KV blocks but only {len(self._free)} of "
                     f"{self.num_blocks} are free (shed, don't corrupt)")

@@ -595,15 +595,21 @@ class Model:
         # artifact instead of a silent SIGKILL)
         from ..utils import concurrency as _conc
         _conc.install_signal_dump()
+        from ..distributed import fleet_metrics as _fleet
         from ..distributed.fleet.elastic.manager import store_from_spec
         from ..distributed.launch import heartbeat_key
+        from ..profiler import flight as _flight
         store = store_from_spec(spec)
-        key = heartbeat_key(
-            os.environ.get("PADDLE_SUPERVISE_JOB", "default"),
-            os.environ.get("PADDLE_RESTART_GENERATION", "0"),
-            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        job = os.environ.get("PADDLE_SUPERVISE_JOB", "default")
+        gen = os.environ.get("PADDLE_RESTART_GENERATION", "0")
+        rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        key = heartbeat_key(job, gen, rank)
         interval = float(os.environ.get("PADDLE_HEARTBEAT_INTERVAL",
                                         "1.0"))
+        if _flight.active:
+            _flight.note("launch", "fit_start", generation=gen,
+                         rank=rank,
+                         world=os.environ.get("PADDLE_TRAINERS_NUM"))
         state = {"t": 0.0, "step": None}
 
         def beat(step):
@@ -624,6 +630,13 @@ class Model:
                 store.put(key, _json.dumps(payload))
             except Exception:
                 pass   # store blip: the TTL/watchdog slack absorbs it
+            try:
+                # fleet metrics ride the heartbeat cadence: one registry
+                # snapshot per beat under a generation-prefixed key the
+                # supervisor aggregates into its /metrics endpoint
+                _fleet.publish(store, job, gen, rank, step=step)
+            except Exception:
+                pass   # same store-blip tolerance as the beat itself
 
         return beat
 
@@ -651,10 +664,14 @@ class Model:
         this step's update; 'rollback' restores the newest intact
         checkpoint (data is not rewound — training continues with the
         next batch either way)."""
+        from ..profiler import flight as _flight
         from ..profiler import metrics as _metrics
         _metrics.counter("train.anomaly",
                          "nan/inf losses caught by the fit anomaly "
                          "guard").inc()
+        if _flight.active:
+            _flight.note("train", "anomaly", value=str(value),
+                         step=step_count, action=action)
         if action == "raise":
             raise FloatingPointError(
                 f"loss is {value} at train step {step_count} "

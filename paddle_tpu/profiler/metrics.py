@@ -12,9 +12,10 @@ calling in, so a disabled profiler costs a single predicate per op.
 """
 from __future__ import annotations
 
+import bisect
 import json
 import re
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..utils import concurrency as _conc
 
@@ -77,19 +78,32 @@ class Gauge:
         return self._v
 
 
+# default Prometheus bucket bounds: a 1-2.5-5 ladder wide enough for
+# the registry's mixed units (most histograms are milliseconds; the
+# occupancy/fill ratios land in the low buckets).  Cumulative counts
+# over these feed the `_bucket{le=...}` exposition series.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
 class Histogram:
     """count/sum/min/max plus percentile estimates over a bounded
     reservoir of the most recent observations (so a long-running
     trainer's p50/p95 track current behavior, not the whole epoch
-    history)."""
+    history), and exact per-bucket counts over the full history for
+    Prometheus ``_bucket{le=...}`` exposition."""
 
     __slots__ = ("name", "doc", "_count", "_sum", "_min", "_max",
-                 "_ring", "_cap", "_i")
+                 "_ring", "_cap", "_i", "_bounds", "_bcounts")
 
-    def __init__(self, name: str, doc: str = "", reservoir: int = 4096):
+    def __init__(self, name: str, doc: str = "", reservoir: int = 4096,
+                 buckets: Optional[Tuple[float, ...]] = None):
         self.name = name
         self.doc = doc
         self._cap = reservoir
+        self._bounds = tuple(sorted(buckets)) if buckets \
+            else DEFAULT_BUCKETS
         self.reset()
 
     def reset(self):
@@ -99,6 +113,7 @@ class Histogram:
         self._max = None
         self._ring = []
         self._i = 0
+        self._bcounts = [0] * len(self._bounds)
 
     def observe(self, v: float):
         self._count += 1
@@ -107,6 +122,12 @@ class Histogram:
             self._min = v
         if self._max is None or v > self._max:
             self._max = v
+        # le semantics: the observation counts in the first bucket
+        # whose bound is >= v (observations past the top bound land
+        # only in +Inf, i.e. _count)
+        i = bisect.bisect_left(self._bounds, v)
+        if i < len(self._bcounts):
+            self._bcounts[i] += 1
         if len(self._ring) < self._cap:
             self._ring.append(v)
         else:
@@ -120,6 +141,17 @@ class Histogram:
     @property
     def sum(self) -> float:
         return self._sum
+
+    def bucket_counts(self) -> List[Tuple[str, int]]:
+        """Cumulative ``[(le_label, count)]`` ending with ``+Inf`` ==
+        total count — the Prometheus histogram contract."""
+        out: List[Tuple[str, int]] = []
+        cum = 0
+        for bound, n in zip(self._bounds, self._bcounts):
+            cum += n
+            out.append((format(bound, "g"), cum))
+        out.append(("+Inf", self._count))
+        return out
 
     def percentile(self, p: float) -> Optional[float]:
         if not self._ring:
@@ -182,9 +214,11 @@ class Registry:
         return self._get_or_create(Gauge, name, doc)
 
     def histogram(self, name: str, doc: str = "",
-                  reservoir: int = 4096) -> Histogram:
+                  reservoir: int = 4096,
+                  buckets: Optional[Tuple[float, ...]] = None
+                  ) -> Histogram:
         return self._get_or_create(Histogram, name, doc,
-                                   reservoir=reservoir)
+                                   reservoir=reservoir, buckets=buckets)
 
     def get(self, name: str):
         return self._metrics.get(name)
@@ -194,8 +228,14 @@ class Registry:
                 for name, m in sorted(self._metrics.items())}
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition: counters/gauges as-is, histograms
-        as summary-typed quantiles + _sum/_count."""
+        """Prometheus text exposition (format version 0.0.4):
+        counters/gauges as-is; histograms as histogram-typed
+        ``_bucket{le=...}`` cumulative series + ``_sum``/``_count``.
+        Bare ``{quantile=...}`` samples are NOT legal inside a
+        histogram-typed family (conformant parsers drop the whole
+        family), so the reservoir estimates stay out of the exposition
+        — dashboards get quantiles via ``histogram_quantile()`` over
+        the buckets, or exactly via :meth:`snapshot`."""
         lines = []
         for name, m in sorted(self._metrics.items()):
             pname = _PROM_BAD.sub("_", name)
@@ -208,12 +248,9 @@ class Registry:
                 lines.append(f"# TYPE {pname} gauge")
                 lines.append(f"{pname} {m.value}")
             elif isinstance(m, Histogram):
-                lines.append(f"# TYPE {pname} summary")
-                for q in (50, 95, 99):
-                    v = m.percentile(q)
-                    if v is not None:
-                        lines.append(
-                            f'{pname}{{quantile="0.{q}"}} {v}')
+                lines.append(f"# TYPE {pname} histogram")
+                for le, cum in m.bucket_counts():
+                    lines.append(f'{pname}_bucket{{le="{le}"}} {cum}')
                 lines.append(f"{pname}_sum {m.sum}")
                 lines.append(f"{pname}_count {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")
@@ -239,8 +276,10 @@ def gauge(name: str, doc: str = "") -> Gauge:
     return _DEFAULT.gauge(name, doc)
 
 
-def histogram(name: str, doc: str = "", reservoir: int = 4096) -> Histogram:
-    return _DEFAULT.histogram(name, doc, reservoir=reservoir)
+def histogram(name: str, doc: str = "", reservoir: int = 4096,
+              buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
+    return _DEFAULT.histogram(name, doc, reservoir=reservoir,
+                              buckets=buckets)
 
 
 def get(name: str):

@@ -106,11 +106,15 @@ class AdmissionController:
 
     # -- admission ----------------------------------------------------
     def _reject(self, reason: str, msg: str):
+        from ..profiler import flight as _flight
         from ..profiler import metrics as _metrics
         with self._lock:   # exact counts even under concurrent clients
             self._rejected.inc()
             _metrics.counter(
                 f"{self._name}.request.rejected.{reason}").inc()
+        if _flight.active:
+            _flight.note("admission", "reject", engine=self._name,
+                         reason=reason)
         if reason == "closed":
             raise EngineClosed(msg)
         raise RequestRejected(msg, reason=reason)
@@ -146,6 +150,10 @@ class AdmissionController:
                 self._tokens += tokens
                 self._tokens_gauge.set(self._tokens)
                 self._admitted.inc()
+                from ..profiler import flight as _flight
+                if _flight.active:
+                    _flight.note("admission", "admit",
+                                 engine=self._name, depth=self._depth)
                 return
         if reason == "queue_full":
             self._reject(
@@ -178,12 +186,20 @@ class AdmissionController:
 
     def shed_deadline(self):
         self._shed.inc()
+        from ..profiler import flight as _flight
+        if _flight.active:
+            _flight.note("admission", "shed_deadline",
+                         engine=self._name)
 
     def shed_kv_blocks(self):
         """A paged engine shed an admitted request on pool exhaustion
         (typed ``RequestRejected(reason="kv_blocks")`` to the client —
         the gate asserts this count exactly)."""
         self._shed_kv.inc()
+        from ..profiler import flight as _flight
+        if _flight.active:
+            _flight.note("admission", "shed_kv_blocks",
+                         engine=self._name)
 
 
 def deadline_from_ms(deadline_ms: Optional[float]) -> Optional[float]:

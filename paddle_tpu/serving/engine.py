@@ -35,6 +35,9 @@ from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ..profiler import flight as _flight
+from ..profiler import rtrace as _rtrace
+from ..profiler import tracer as _tracer
 from ..utils import concurrency as _conc
 from .admission import (AdmissionController, DeadlineExceeded,
                         EngineClosed, RequestRejected, deadline_from_ms)
@@ -105,15 +108,19 @@ class EngineConfig:
 
 class _Request:
     __slots__ = ("arrays", "rows", "sig", "future", "deadline",
-                 "t_submit")
+                 "t_submit", "ctx", "t_submit_ns")
 
-    def __init__(self, arrays, rows, sig, deadline):
+    def __init__(self, arrays, rows, sig, deadline, ctx=None):
         self.arrays = arrays
         self.rows = rows
         self.sig = sig
         self.future: Future = Future()
         self.deadline = deadline
         self.t_submit = time.monotonic()
+        # request-trace context (profiler/rtrace.py) riding the request
+        # across the batcher/worker thread hops; None when untraced
+        self.ctx = ctx
+        self.t_submit_ns = _tracer.now_ns() if ctx is not None else 0
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -339,36 +346,60 @@ class InferenceEngine:
         ).set(self.warmed_buckets)
 
     # -- client surface ------------------------------------------------
-    def submit(self, inputs, deadline_ms: Optional[float] = "default"
-               ) -> Future:
+    def submit(self, inputs, deadline_ms: Optional[float] = "default",
+               trace_ctx=None) -> Future:
         """Enqueue one request; returns a Future resolving to the list
         of output arrays (np.ndarray, one per model output, sliced to
         this request's rows).  Raises RequestRejected/EngineClosed at
         admission; chaos site ``serve.request`` can fail or delay here.
+        ``trace_ctx`` (an rtrace TraceContext, usually built by the
+        HTTP layer from the ``traceparent`` header) makes the request's
+        admission/queue/execute hops emit request-scoped spans.
         """
         arrays = self._normalize(inputs)
         rows = int(arrays[0].shape[0])
-        from ..utils import chaos as _chaos
-        if _chaos.active:
-            _chaos.hit("serve.request")
-        self._admission.acquire(rows)
+        traced = trace_ctx is not None and _rtrace.active
+        t_adm = _tracer.now_ns() if traced else 0
+        try:
+            from ..utils import chaos as _chaos
+            if _chaos.active:
+                _chaos.hit("serve.request")
+            self._admission.acquire(rows)
+        except RequestRejected as e:
+            if traced:
+                trace_ctx.record("admission", t_adm, outcome=e.reason,
+                                 terminated=True)
+            raise
+        except Exception as e:
+            if traced:
+                trace_ctx.record("admission", t_adm,
+                                 outcome=type(e).__name__,
+                                 terminated=True)
+            raise
+        if traced:
+            trace_ctx.record("admission", t_adm, outcome="admitted")
         if deadline_ms == "default":
             deadline_ms = self.config.deadline_ms
         req = _Request(arrays, rows, self._signature(arrays),
-                       deadline_from_ms(deadline_ms))
+                       deadline_from_ms(deadline_ms), ctx=trace_ctx)
         with self._cond:
             if self._closed:
                 self._admission.release()
+                if traced:
+                    trace_ctx.record("queue_wait", req.t_submit_ns,
+                                     outcome="closed", terminated=True)
                 raise EngineClosed()
             self._pending.append(req)
             self._cond.notify()
         return req.future
 
     def infer(self, inputs, deadline_ms: Optional[float] = "default",
-              timeout: Optional[float] = None) -> List[np.ndarray]:
+              timeout: Optional[float] = None,
+              trace_ctx=None) -> List[np.ndarray]:
         """Blocking submit; ``timeout`` (seconds) bounds the wait
         independently of the request deadline."""
-        fut = self.submit(inputs, deadline_ms=deadline_ms)
+        fut = self.submit(inputs, deadline_ms=deadline_ms,
+                          trace_ctx=trace_ctx)
         try:
             return fut.result(timeout=timeout)
         except (TimeoutError, _FutureTimeout):
@@ -482,6 +513,9 @@ class InferenceEngine:
     def _shed(self, req: _Request):
         with self._mlock:                # batcher AND workers shed
             self._admission.shed_deadline()
+        if req.ctx is not None and _rtrace.active:
+            req.ctx.record("queue_wait", req.t_submit_ns,
+                           outcome="shed_deadline", terminated=True)
         self._complete(req.future, exc=DeadlineExceeded(
             "request deadline expired while queued (engine overloaded "
             "relative to the deadline)"))
@@ -601,8 +635,23 @@ class InferenceEngine:
             self._m_pad_waste.observe((bucket - rows) / bucket)
             self._m_batches.inc()
 
+        traced = [r for r in live if r.ctx is not None] \
+            if _rtrace.active else []
+        t0 = _tracer.now_ns() if traced else 0
+        for r in traced:
+            r.ctx.record("queue_wait", r.t_submit_ns, t0)
         outs = self._run_bucketed(predictor, padded)
         outs = [np.asarray(o) for o in outs]
+        if traced:
+            # fan-in causality: ONE span for the fused batch, each
+            # member's own 'execute' span pointing back at it
+            t1 = _tracer.now_ns()
+            bspan = _rtrace.batch_span(
+                "batch::execute", t0, t1, [r.ctx for r in traced],
+                rows=rows, bucket=bucket)
+            for r in traced:
+                r.ctx.record("execute", t0, t1, batch_span=bspan,
+                             rows=r.rows)
         off = 0
         done_t = time.monotonic()
         for r in live:
@@ -760,10 +809,11 @@ class _GenRequest:
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "top_p",
                  "seed", "eos", "deadline", "budget", "future", "queue",
                  "tokens", "t_submit", "t_first", "t_last", "cancelled",
-                 "blocks", "cached_len")
+                 "blocks", "cached_len", "ctx", "t_submit_ns",
+                 "finish_reason")
 
     def __init__(self, prompt, max_new, temperature, top_k, top_p,
-                 seed, eos, deadline, budget):
+                 seed, eos, deadline, budget, ctx=None):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
@@ -782,6 +832,15 @@ class _GenRequest:
         self.cancelled = False
         self.blocks: List[int] = []    # paged mode: held KV block ids
         self.cached_len = 0            # paged mode: prefix-cache cover
+        # request-trace context (profiler/rtrace.py) carried across the
+        # submit -> scheduler -> stream thread hops; None when untraced
+        self.ctx = ctx
+        self.t_submit_ns = _tracer.now_ns() if ctx is not None else 0
+        self.finish_reason: Optional[str] = None
+
+    @property
+    def request_id(self) -> Optional[str]:
+        return self.ctx.request_id if self.ctx is not None else None
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -1019,12 +1078,16 @@ class GenerationEngine:
                do_sample: bool = False, temperature: float = 1.0,
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                eos_token_id: Optional[int] = None,
-               deadline_ms: Optional[float] = "default"
-               ) -> GenerationStream:
+               deadline_ms: Optional[float] = "default",
+               trace_ctx=None) -> GenerationStream:
         """Enqueue one prompt; returns a :class:`GenerationStream`.
         Raises :class:`RequestRejected` at admission (``queue_full`` /
         ``token_budget`` / ``too_large`` / ``closed``); the
-        ``serve.request`` chaos site can fail or delay here."""
+        ``serve.request`` chaos site can fail or delay here.
+        ``trace_ctx`` (an rtrace TraceContext, usually built by the
+        HTTP layer from ``traceparent``/``X-Request-Id``) makes every
+        hop of this request — admission verdict, queue wait, prefill,
+        each decode boundary — emit request-scoped spans."""
         prompt = np.asarray(getattr(prompt, "_data", prompt))
         prompt = prompt.reshape(-1).astype(np.int32)
         if prompt.size < 1:
@@ -1033,29 +1096,51 @@ class GenerationEngine:
                       else self.config.max_new_tokens)
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if prompt.size >= self.max_length:
-            # route through the controller so the per-reason counter and
-            # its lock discipline apply (the gates assert exact counts)
-            self._admission._reject(
-                "too_large",
-                f"prompt of {prompt.size} tokens leaves no room in the "
-                f"{self.max_length}-slot KV-cache")
-        from ..utils import chaos as _chaos
-        if _chaos.active:
-            _chaos.hit("serve.request")
+        traced = trace_ctx is not None and _rtrace.active
+        t_adm = _tracer.now_ns() if traced else 0
         budget = self._token_reservation(prompt, max_new)
-        self._admission.acquire(tokens=budget)
+        try:
+            if prompt.size >= self.max_length:
+                # route through the controller so the per-reason counter
+                # and its lock discipline apply (the gates assert exact
+                # counts)
+                self._admission._reject(
+                    "too_large",
+                    f"prompt of {prompt.size} tokens leaves no room in "
+                    f"the {self.max_length}-slot KV-cache")
+            from ..utils import chaos as _chaos
+            if _chaos.active:
+                _chaos.hit("serve.request")
+            self._admission.acquire(tokens=budget)
+        except RequestRejected as e:
+            if traced:
+                # a rejected request still leaves a terminated span
+                # carrying the verdict — post-mortems start from WHY
+                trace_ctx.record("admission", t_adm, outcome=e.reason,
+                                 terminated=True)
+            raise
+        except Exception as e:
+            if traced:
+                trace_ctx.record("admission", t_adm,
+                                 outcome=type(e).__name__,
+                                 terminated=True)
+            raise
+        if traced:
+            trace_ctx.record("admission", t_adm, outcome="admitted")
         if deadline_ms == "default":
             deadline_ms = self.config.deadline_ms
         req = _GenRequest(
             prompt, max_new,
             float(temperature) if do_sample else 0.0, int(top_k),
             float(top_p), int(seed), eos_token_id,
-            deadline_from_ms(deadline_ms), budget)
+            deadline_from_ms(deadline_ms), budget, ctx=trace_ctx)
         with self._cond:
             if self._closed:
                 self._admission.release()
                 self._admission.release_tokens(budget)
+                if traced:
+                    trace_ctx.record("queue_wait", req.t_submit_ns,
+                                     outcome="closed", terminated=True)
                 raise EngineClosed()
             self._pending.append(req)
             self._cond.notify()
@@ -1128,14 +1213,38 @@ class GenerationEngine:
             except BaseException as e:  # noqa: BLE001 — fail everything in flight
                 self._fail_all(e)
 
+    def _trace_boundary(self, name: str, t0: int, t1: int,
+                        slots: List[int], **fields):
+        """Request-trace accounting for one fused engine step: ONE
+        ``batch::<name>`` span linked to every traced member's root
+        (fan-in causality) plus a per-member ``<name>`` child span
+        pointing back at it.  Call sites gate on ``_rtrace.active``."""
+        ctxs, reqs = [], []
+        for s in slots:
+            r = self._slot_req[s]
+            if r is not None and r.ctx is not None:
+                ctxs.append(r.ctx)
+                reqs.append((s, r))
+        if not ctxs:
+            return
+        bspan = _rtrace.batch_span(f"batch::{name}", t0, t1, ctxs,
+                                   **fields)
+        for s, r in reqs:
+            r.ctx.record(name, t0, t1, batch_span=bspan, slot=s,
+                         position=int(self._positions[s]))
+
     def _decode_round(self, occ: List[int]):
         """One token boundary: a fused decode step for every occupied
         slot (the paged engine overrides this with block-table decode
         and, when armed, speculative verify)."""
+        t0 = _tracer.now_ns() if _rtrace.active else 0
         tok, self._caches = self.session.decode(
             self._caches, self._last_tok, self._positions,
             self._keys, self._temps, self._tks, self._tps,
             live_rows=len(occ))
+        if t0:
+            self._trace_boundary("decode", t0, _tracer.now_ns(), occ,
+                                 occupancy=len(occ))
         with self._mlock:
             self._m_occ.observe(len(occ))
         self._positions = self._positions + 1
@@ -1187,13 +1296,30 @@ class GenerationEngine:
                 self._temps[slot] = req.temperature
                 self._tks[slot] = req.top_k
                 self._tps[slot] = req.top_p
+                self._note_slot_admit(slot, req)
+            t0 = _tracer.now_ns() if _rtrace.active else 0
             tok, self._caches = self.session.prefill(
                 self._caches, ids, plens, mask, self._keys,
                 self._temps, self._tks, self._tps)
+            if t0:
+                self._trace_boundary(
+                    "prefill", t0, _tracer.now_ns(),
+                    [s for s, _r in members], bucket=pb)
             for slot, req in members:
                 self._positions[slot] = plens[slot]
                 self._last_tok[slot] = tok[slot]
                 self._emit(slot, int(tok[slot]))
+
+    def _note_slot_admit(self, slot: int, req: _GenRequest):
+        """Queue-wait span closes + flight slot-admit event for one
+        request entering a decode slot."""
+        if req.ctx is not None and _rtrace.active:
+            req.ctx.record("queue_wait", req.t_submit_ns, slot=slot)
+        if _flight.active:
+            _flight.note("serve", "slot_admit",
+                         engine=self.metrics_prefix, slot=slot,
+                         request=req.request_id,
+                         prompt=int(req.prompt.size))
 
     def _emit(self, slot: int, tok: int):
         req = self._slot_req[slot]
@@ -1211,8 +1337,13 @@ class GenerationEngine:
         req.queue.put(tok)
         hit_eos = req.eos is not None and tok == int(req.eos)
         out_of_room = self._positions[slot] + 1 >= self.max_length
-        if hit_eos or req.cancelled or out_of_room \
-                or len(req.tokens) >= req.max_new:
+        budget_done = len(req.tokens) >= req.max_new
+        if hit_eos or req.cancelled or out_of_room or budget_done:
+            req.finish_reason = (
+                "cancelled" if req.cancelled else
+                "eos" if hit_eos else
+                "cache_full" if out_of_room and not budget_done else
+                "max_new_tokens")
             self._retire(req, slot)
 
     def _release_resources(self, req: _GenRequest):
@@ -1227,6 +1358,13 @@ class GenerationEngine:
         if slot is not None:
             self._slot_req[slot] = None
         self._release_resources(req)
+        reason = req.finish_reason or \
+            ("cancelled" if req.cancelled else "done")
+        if _flight.active:
+            _flight.note("serve", "slot_retire",
+                         engine=self.metrics_prefix, slot=slot,
+                         request=req.request_id, reason=reason,
+                         tokens=len(req.tokens))
         if not req.future.done():
             req.future.set_result(np.asarray(req.tokens, np.int32))
             with self._mlock:
@@ -1240,6 +1378,9 @@ class GenerationEngine:
         with self._mlock:
             self._admission.shed_deadline()
         self._release_resources(req)
+        if req.ctx is not None and _rtrace.active:
+            req.ctx.record("queue_wait", req.t_submit_ns,
+                           outcome="shed_deadline", terminated=True)
         exc = DeadlineExceeded(
             "request deadline expired while queued (engine overloaded "
             "relative to the deadline)")
@@ -1253,8 +1394,21 @@ class GenerationEngine:
             self._pending.clear()
         victims = pending + [r for r in self._slot_req if r is not None]
         self._slot_req = [None] * self.slots
+        if _flight.active:
+            _flight.note("serve", "engine_failure",
+                         engine=self.metrics_prefix,
+                         error=f"{type(exc).__name__}: {exc}",
+                         victims=len(victims))
+            # post-mortem artifact: the last N things this engine did,
+            # written next to the gang's other dumps when
+            # PADDLE_FLIGHT_DIR is configured
+            _flight.dump(reason="engine-failure")
         for req in victims:
             self._release_resources(req)
+            if req.ctx is not None and _rtrace.active:
+                req.ctx.record("failed", req.t_submit_ns,
+                               outcome=type(exc).__name__,
+                               terminated=True)
             if not req.future.done():
                 req.future.set_exception(exc)
                 with self._mlock:
@@ -1491,6 +1645,13 @@ class PagedGenerationEngine(GenerationEngine):
         if slot is not None:
             self._slot_req[slot] = None
             self._table[slot, :] = -1
+        if _flight.active:
+            _flight.note("serve", "kv_shed",
+                         engine=self.metrics_prefix, slot=slot,
+                         request=req.request_id, cause=str(cause))
+        if req.ctx is not None and _rtrace.active:
+            req.ctx.record("shed", req.t_submit_ns,
+                           outcome="kv_blocks", terminated=True)
         self._release_resources(req)
         exc = RequestRejected(
             f"paged KV block pool exhausted ({cause}); request shed — "
@@ -1550,6 +1711,7 @@ class PagedGenerationEngine(GenerationEngine):
                 continue
             if cow is not None:
                 cows.append(cow)
+            self._note_slot_admit(slot, req)
             placed.append((slot, req))
         if not placed:
             return
@@ -1573,10 +1735,15 @@ class PagedGenerationEngine(GenerationEngine):
                 ids[slot, :len(suffix)] = suffix
                 starts[slot] = req.cached_len
                 feed[slot] = len(suffix)
+            t0 = _tracer.now_ns() if _rtrace.active else 0
             tok, self._arenas = self.session.prefill(
                 self._arenas, self._table, ids, starts, feed,
                 self._keys, self._temps, self._tks, self._tps,
                 live_rows=len(members))
+            if t0:
+                self._trace_boundary(
+                    "prefill", t0, _tracer.now_ns(),
+                    [s for s, _r in members], bucket=pb)
             for slot, req in members:
                 # offer the now-filled prompt blocks to the prefix
                 # cache BEFORE emit (emit may retire the request,
@@ -1619,10 +1786,14 @@ class PagedGenerationEngine(GenerationEngine):
         occ = self._occupied()
         if not occ:
             return
+        t0 = _tracer.now_ns() if _rtrace.active else 0
         tok, self._arenas = self.session.decode(
             self._arenas, self._table, self._last_tok,
             self._positions, self._keys, self._temps, self._tks,
             self._tps, live_rows=len(occ))
+        if t0:
+            self._trace_boundary("decode", t0, _tracer.now_ns(), occ,
+                                 occupancy=len(occ))
         with self._mlock:
             self._m_occ.observe(len(occ))
         self._positions = self._positions + 1
@@ -1660,10 +1831,16 @@ class PagedGenerationEngine(GenerationEngine):
             self._shed_kv(req, s, e)
         if not live:
             return
+        t0 = _tracer.now_ns() if _rtrace.active else 0
         toks, self._arenas = self.session.verify(
             self._arenas, self._table, ids, self._positions, feed,
             self._keys, self._temps, self._tks, self._tps,
             live_rows=len(live))
+        if t0:
+            # speculative boundary: the verify step IS this round's
+            # decode work — one fused span, every live row linked
+            self._trace_boundary("decode", t0, _tracer.now_ns(), live,
+                                 occupancy=len(live), verify_width=W)
         with self._mlock:
             self._m_occ.observe(len(live))
         proposed = sum(len(drafts.get(s) or []) for s in live)

@@ -34,11 +34,14 @@ from __future__ import annotations
 import io
 import json
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from ..profiler import rtrace as _rtrace
+from ..profiler import tracer as _tracer
 from .admission import DeadlineExceeded, EngineClosed, RequestRejected
 
 __all__ = ["ServingServer", "serve"]
@@ -60,20 +63,77 @@ class _Handler(BaseHTTPRequestHandler):
         if getattr(self.server, "verbose", False):  # pragma: no cover
             super().log_message(fmt, *args)
 
+    # -- request observability -----------------------------------------
+    def _begin_request(self):
+        """Per-request identity: honor ``X-Request-Id`` (generate one
+        when absent) and build the rtrace TraceContext from the W3C
+        ``traceparent`` header.  Both are echoed on every response —
+        including SSE terminal events and error payloads — so a client
+        can always join its logs to the server's trace."""
+        rid = self.headers.get("X-Request-Id") or uuid.uuid4().hex
+        self._request_id = rid
+        self._obs_headers = {"X-Request-Id": rid}
+        tp = self.headers.get("traceparent")
+        self._ctx = _rtrace.TraceContext.from_headers(tp,
+                                                      request_id=rid)
+        self._traced = _rtrace.active
+        if self._traced:
+            self._obs_headers["traceparent"] = self._ctx.traceparent()
+            self._t_ingress = _tracer.now_ns()
+        elif tp:
+            # tracing off: echo the caller's context untouched so the
+            # distributed trace is not silently broken mid-chain
+            self._obs_headers["traceparent"] = tp
+        self._t_first_write = None
+        self._last_status = None
+
+    def _end_request(self):
+        """Close the request's server-side spans: ``egress`` (first
+        response byte -> done) and the ``ingress`` root (header parse
+        -> done, parented to the client's traceparent span)."""
+        if not getattr(self, "_traced", False):
+            return
+        t1 = _tracer.now_ns()
+        path = self.path
+        self._ctx.record("egress", self._t_first_write or t1, t1,
+                         status=self._last_status)
+        self._ctx.record("ingress", self._t_ingress, t1, parent=None,
+                         span_id=self._ctx.root, path=path,
+                         status=self._last_status)
+
     # -- helpers -------------------------------------------------------
     def _send(self, code: int, body: bytes, ctype: str):
+        if self._t_first_write is None:
+            self._t_first_write = _tracer.now_ns() \
+                if getattr(self, "_traced", False) else 0
+        self._last_status = code
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in getattr(self, "_obs_headers", {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
     def _send_json(self, code: int, obj):
+        if code >= 400 and isinstance(obj, dict) \
+                and "request_id" not in obj \
+                and getattr(self, "_request_id", None):
+            # error payloads carry the id in-band too: a client that
+            # only logs bodies can still quote it at the operator
+            obj = dict(obj, request_id=self._request_id)
         self._send(code, json.dumps(obj, default=_json_default)
                    .encode(), "application/json")
 
     # -- GET -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 - stdlib handler naming
+        self._begin_request()
+        try:
+            self._do_get()
+        finally:
+            self._end_request()
+
+    def _do_get(self):
         engine = self.server.engine or self.server.generation_engine
         if self.path == "/healthz":
             from ..profiler import metrics as _metrics
@@ -97,6 +157,26 @@ class _Handler(BaseHTTPRequestHandler):
                             max_length=g.max_length,
                             decode_warmed_buckets=getattr(
                                 g, "warmed_buckets", 0))
+                pool = getattr(g, "pool", None)
+                if pool is not None:
+                    # paged engine: block-pool occupancy + prefix-cache
+                    # effectiveness are THE capacity signals a router /
+                    # autoscaler dispatches on
+                    p = g.metrics_prefix
+
+                    def _val(name):
+                        m = _metrics.get(f"{p}.{name}")
+                        return m.value if m is not None else 0
+                    hits = _val("prefix_cache.hit")
+                    misses = _val("prefix_cache.miss")
+                    body.update(
+                        kv_blocks_total=pool.num_blocks,
+                        kv_blocks_in_flight=pool.used,
+                        kv_blocks_free=pool.available,
+                        kv_block_size=pool.block_size,
+                        prefix_cache_hit_rate=round(
+                            hits / (hits + misses), 6)
+                        if (hits + misses) else 0.0)
             self._send_json(200, body)
         elif self.path == "/metrics":
             from ..profiler import metrics as _metrics
@@ -108,6 +188,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- POST ----------------------------------------------------------
     def do_POST(self):  # noqa: N802
+        self._begin_request()
+        try:
+            self._do_post()
+        finally:
+            self._end_request()
+
+    def _do_post(self):
         if self.path in ("/v1/generate", "/generate"):
             self._do_generate()
             return
@@ -140,7 +227,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"malformed payload: {e}"})
             return
         try:
-            kwargs = {}
+            kwargs = {"trace_ctx": self._ctx}
             if deadline_ms is not None:
                 kwargs["deadline_ms"] = float(deadline_ms)
             outs = engine.infer(inputs, **kwargs)
@@ -217,7 +304,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"malformed payload: {e}"})
             return
         try:
-            handle = gen.submit(prompt, **kw)
+            handle = gen.submit(prompt, trace_ctx=self._ctx, **kw)
         except EngineClosed as e:
             self._send_json(503, {"error": str(e), "reason": e.reason})
             return
@@ -245,18 +332,27 @@ class _Handler(BaseHTTPRequestHandler):
             return
         # SSE over chunked transfer: the status goes out before the
         # request finishes, so late errors become a terminal event
+        if self._t_first_write is None:
+            self._t_first_write = _tracer.now_ns() if self._traced \
+                else 0
+        self._last_status = 200
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Transfer-Encoding", "chunked")
+        for k, v in self._obs_headers.items():
+            self.send_header(k, v)
         self.end_headers()
+        rid = self._request_id
         try:
             i = 0
             for tok in handle:
                 self._chunk(f"data: {json.dumps({'token': int(tok), 'index': i})}\n\n")
                 i += 1
+            # terminal event carries the request id: SSE consumers
+            # often never see response headers through proxies/polyfills
             self._chunk("data: " + json.dumps(
-                {"done": True,
+                {"done": True, "request_id": rid,
                  "tokens": handle.result().tolist()}) + "\n\n")
         except OSError:
             # client went away mid-stream: free the decode slot and the
@@ -267,7 +363,8 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:
             try:
                 self._chunk("data: " + json.dumps(
-                    {"error": f"{type(e).__name__}: {e}"}) + "\n\n")
+                    {"error": f"{type(e).__name__}: {e}",
+                     "request_id": rid}) + "\n\n")
             except OSError:
                 handle.cancel()
                 return

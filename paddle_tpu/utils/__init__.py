@@ -11,6 +11,11 @@ from . import resilience  # noqa: F401
 import os as _os
 if _os.environ.get("PADDLE_SUPERVISE_STORE"):
     concurrency.install_signal_dump()
+    # the flight recorder's crash excepthook installs on its import
+    # (profiler/flight.py checks the same env) — import it NOW so a
+    # worker that dies before any subsystem touches the recorder still
+    # leaves its event history next to the thread dump
+    from ..profiler import flight as _flight  # noqa: F401
 from . import chaos  # noqa: F401
 from . import compile_cache  # noqa: F401
 from . import artifact_store  # noqa: F401

@@ -226,6 +226,11 @@ def hit(site: str, exc=None) -> Optional[str]:
     _metrics.counter("chaos.injected",
                      "total chaos-layer fault injections").inc()
     _metrics.counter(f"chaos.injected.{site}").inc()
+    from ..profiler import flight as _flight
+    if _flight.active:
+        # injected faults are exactly what a post-mortem needs to see
+        # in sequence with the admission/slot/ckpt events around them
+        _flight.note("chaos", site, kind=fired.kind, call=n)
     if fired.kind == "fail":
         cls = exc or ChaosError
         raise cls(f"chaos: injected failure at {site} (call {n})")

@@ -231,6 +231,15 @@ def _note_edges(held: list, lock: "_SanLock", site: str):
         _observe_counter("lock.order_cycle",
                          "lock acquisition-order cycles observed by "
                          "the runtime sanitizer (potential deadlocks)")
+        try:
+            # flight recorder (lock-free deque append — safe from the
+            # sanitizer's own callback context)
+            from ..profiler import flight as _flight
+            if _flight.active:
+                _flight.note("locksan", "order_cycle",
+                             cycle=" -> ".join(cycle), site=site)
+        except Exception:       # noqa: BLE001 — sanitizer must not break code
+            pass
         if level() >= 2:
             raise_msg = msg
         else:
@@ -771,6 +780,14 @@ def install_signal_dump(signum=None) -> bool:
 
     def _handler(_sig, frame):
         dump_threads(sys.stderr)
+        try:
+            # flight recorder: after WHERE every thread is, WHAT the
+            # process last did (tail to the log + JSON dump when
+            # PADDLE_FLIGHT_DIR is configured)
+            from ..profiler import flight as _flight
+            _flight.dump_on_signal(sys.stderr)
+        except Exception:       # noqa: BLE001 — a dump must never throw
+            pass
 
     try:
         _signal.signal(signum, _handler)

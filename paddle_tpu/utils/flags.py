@@ -254,6 +254,29 @@ define_flag("FLAGS_speculative_k", 0,
             "with a greedy-equivalence guarantee.  0 disables "
             "speculative decoding (engines can opt in via "
             "GenerationEngineConfig.speculative_k)")
+define_flag("FLAGS_request_trace", False,
+            "per-request distributed tracing (profiler/rtrace.py): "
+            "serving requests carry a TraceContext (128-bit trace_id, "
+            "W3C traceparent parsed from and echoed on HTTP requests) "
+            "and the engines record ingress->admission->queue->prefill->"
+            "decode->egress spans into the chrome-trace ring, with one "
+            "batch-step span linked to every member request (fan-in "
+            "causality).  Off (the default) costs one predicate read "
+            "per hop; tools/trace_summary.py --request <id> renders "
+            "the per-request waterfall")
+define_flag("FLAGS_flight_recorder", True,
+            "always-on flight recorder (profiler/flight.py): a "
+            "lock-free bounded ring of structured events (admission "
+            "verdicts, slot admit/retire, kv sheds, chaos injections, "
+            "checkpoint commits, rendezvous rounds, lock-san cycles, "
+            "anomaly trips) dumped as JSON on crash/watchdog/SIGUSR1/"
+            "engine failure so every post-mortem ends with the last N "
+            "things the process actually did.  0 disables: every site "
+            "then costs one predicate read")
+define_flag("FLAGS_flight_recorder_capacity", 2048,
+            "events held by the flight-recorder ring; the oldest drop "
+            "beyond this, so the recorder can stay armed for the whole "
+            "life of a serving process")
 define_flag("FLAGS_prefetch_to_device", 2,
             "default device-prefetch depth used by Model.fit's train "
             "loop (batches kept resident on device by the io "

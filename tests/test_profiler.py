@@ -234,7 +234,12 @@ def test_metrics_prometheus_and_json(tmp_path):
     assert "# TYPE req_total counter" in text
     assert "req_total 7" in text
     assert "queue_depth 2" in text       # '.' sanitized to '_'
-    assert 'lat_ms{quantile="0.50"} 5.0' in text
+    assert "# TYPE lat_ms histogram" in text
+    assert 'lat_ms_bucket{le="5"} 1' in text
+    assert 'lat_ms_bucket{le="+Inf"} 1' in text
+    # bare {quantile=...} samples are illegal inside a histogram-typed
+    # family — conformant parsers would drop the whole family
+    assert "quantile" not in text
     assert "lat_ms_count 1" in text
     out = tmp_path / "metrics.json"
     metrics.dump_json(str(out))

@@ -91,4 +91,14 @@ python tools/kernel_gate.py
 # compile-bound assertions must still hold with the sanitizer in the
 # lock path.
 python tools/conc_gate.py
+# Observability gate (request tracing / fleet rollup / flight recorder):
+# a traced HTTP generation request must echo its traceparent trace_id
+# and export a complete ingress->admission->queue->prefill->decode->
+# egress span chain, bit-stable across two fresh processes, with
+# zero-cost pinned when tracing is off; a supervised 2-rank fit must
+# serve BOTH ranks' labeled series from the supervisor's aggregated
+# /metrics, merge per-rank chrome traces into one lane per rank, and a
+# SIGKILLed rank must leave flight-recorder dumps (survivor + supervisor)
+# whose tails carry the chaos/rendezvous events at exact counts.
+python tools/obs_gate.py
 exec python -m pytest tests/ -q --runslow "$@"

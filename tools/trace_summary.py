@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""trace_summary — chrome-trace JSON -> top-N ops table.
+"""trace_summary — chrome-trace JSON -> top-N ops table / request waterfall.
 
 Reads a trace written by ``paddle_tpu.profiler.export_chrome_tracing``
 (or any chrome://tracing file with 'X' complete events) and prints the
@@ -8,6 +8,14 @@ call count, total/avg/max duration and share of the traced wall time.
 
     python tools/trace_summary.py trace.json
     python tools/trace_summary.py trace.json -n 20 --sort avg --cat dispatch
+    python tools/trace_summary.py trace.json --request <trace-or-request-id>
+
+``--request`` selects the per-request spans recorded by the rtrace
+layer (``cat="rtrace"``, matched on ``args.trace_id`` or
+``args.request_id``) and renders them as a waterfall: offset from the
+request's first span, duration, name, and the outcome/link fields —
+the single-request story (ingress -> admission -> queue -> prefill ->
+decode... -> egress) that the aggregate table averages away.
 
 Pure stdlib so it runs anywhere the trace file lands (CI artifact
 viewers, dev laptops without the framework installed).
@@ -58,6 +66,61 @@ def format_table(stats, sort="total", top=None):
     return "\n".join(lines)
 
 
+def request_spans(events, ident):
+    """rtrace spans matching ``ident`` (a full trace_id, an
+    ``X-Request-Id``, or an unambiguous prefix of either), start-sorted.
+    Batch-step spans that *link* the request are folded in too — the
+    fused engine work the request shared with its batchmates."""
+    spans, batch = [], []
+    for e in events:
+        if e.get("ph") != "X" or e.get("cat") != "rtrace":
+            continue
+        a = e.get("args") or {}
+        tid, rid = a.get("trace_id", ""), a.get("request_id", "")
+        if tid == ident or rid == ident or \
+                (len(ident) >= 8 and (tid.startswith(ident)
+                                      or rid.startswith(ident))):
+            spans.append(e)
+        elif a.get("links"):
+            batch.append(e)
+    # batch spans live on the process trace, so match them through the
+    # trace_ids of the directly-matched spans — this way a request-id
+    # (or prefix) lookup folds them in just like a trace_id lookup
+    roots = {(e.get("args") or {}).get("trace_id") for e in spans}
+    for e in batch:
+        if any(ln.get("trace_id") in roots
+               for ln in (e.get("args") or {}).get("links") or ()):
+            spans.append(e)
+    spans.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return spans
+
+
+def format_waterfall(spans, ident):
+    if not spans:
+        return f"(no rtrace spans match {ident!r} — was " \
+               "FLAGS_request_trace on when the trace was recorded?)"
+    t0 = min(float(e.get("ts", 0.0)) for e in spans)
+    meta_keys = ("outcome", "terminated", "status", "slot", "bucket",
+                 "occupancy", "members", "rows", "path")
+    head = (f"{'offset_ms':>10} {'dur_ms':>9}  span")
+    lines = [f"request {ident}", head, "-" * 64]
+    for e in spans:
+        a = e.get("args") or {}
+        extra = " ".join(f"{k}={a[k]}" for k in meta_keys if k in a)
+        parent = "" if a.get("parent_id") or not a.get("links") \
+            else " [batch]"
+        lines.append(
+            f"{(float(e.get('ts', 0.0)) - t0) / 1e3:>10.3f} "
+            f"{float(e.get('dur', 0.0)) / 1e3:>9.3f}  "
+            f"{e.get('name', '?')}{parent}"
+            + (f"  ({extra})" if extra else ""))
+    ids = {a for a in ((e.get("args") or {}).get("request_id")
+                       for e in spans) if a}
+    if ids:
+        lines.append(f"request ids: {', '.join(sorted(ids))}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", help="chrome-trace JSON file")
@@ -67,11 +130,18 @@ def main(argv=None):
                     default="total")
     ap.add_argument("--cat", default=None,
                     help="restrict to one category (dispatch, collective, "
-                         "dataloader, hapi, ...)")
+                         "dataloader, hapi, rtrace, ...)")
+    ap.add_argument("--request", default=None, metavar="ID",
+                    help="print the span waterfall of one request "
+                         "(trace_id / X-Request-Id, or a prefix)")
     args = ap.parse_args(argv)
     with open(args.trace) as f:
         doc = json.load(f)
     events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    if args.request:
+        print(format_waterfall(request_spans(events, args.request),
+                               args.request))
+        return 0
     print(format_table(aggregate(events, cat=args.cat),
                        sort=args.sort, top=args.top))
     return 0
