@@ -59,6 +59,18 @@ from .fleet.elastic.manager import ELASTIC_EXIT_CODE, _parse_np  # noqa: E402
 
 SUPERVISE_PREFIX = "/paddle/supervise/"
 RDZV_PREFIX = "/paddle/rendezvous/"
+SERVING_PREFIX = "/paddle/serving/"
+
+
+def serving_key(job: str, generation, replica) -> str:
+    """The generation-prefixed serving-registry lease key.  The same
+    fencing pattern as :func:`heartbeat_key`: an engine replica claims
+    ``/paddle/serving/<job>/g<generation>/<replica>`` as a TTL lease
+    (``serving/fleet.py ReplicaRegistry``) and republishes its health/
+    occupancy payload on a heartbeat cadence; a stale replica from a
+    prior generation holds a lease under a different prefix, so a
+    router scoped to the live generation can never dispatch to it."""
+    return f"{SERVING_PREFIX}{job}/g{generation}/{replica}"
 
 
 def heartbeat_key(job: str, generation, rank) -> str:
@@ -565,13 +577,13 @@ def _deny_slot(store, job: str, slot: str):
 
 
 def _purge_stale_generations(store, job: str, generation: int):
-    """Delete heartbeat AND fleet-metrics keys from generations before
-    ``generation``.  Ignore-by-prefix in ``supervise`` is the
+    """Delete heartbeat, fleet-metrics AND serving-registry keys from
+    generations before ``generation``.  Ignore-by-prefix in ``supervise`` is the
     correctness mechanism (a slow-dying worker can rewrite its old key
     after this purge); the delete is hygiene so the store doesn't
     accrete one key set per restart."""
     from .fleet_metrics import METRICS_PREFIX
-    for root in (SUPERVISE_PREFIX, METRICS_PREFIX):
+    for root in (SUPERVISE_PREFIX, METRICS_PREFIX, SERVING_PREFIX):
         pfx = f"{root}{job}/"
         keep = f"{pfx}g{generation}/"
         try:

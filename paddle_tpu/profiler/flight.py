@@ -15,6 +15,11 @@ events fed by the subsystems that make operational decisions —
     launch      supervise generations, rendezvous rounds
     locksan     runtime lock-order cycles
     train       anomaly-guard trips
+    replica     serving-fleet membership: join / leave (lease expiry
+                or deregister) / deny / readmit (probe verdicts)
+    swap        weight hot-swaps: canary / promote / rollback / abort
+                (router), apply / quarantine (replica watcher)
+    fleet       replica-registry lease publish failures
 
 — and dumps it as JSON on crash (``sys.excepthook``), on SIGUSR1 (the
 supervisor signals every worker before killing a stalled gang —

@@ -24,7 +24,13 @@ with Orca/Clipper-style dynamic batching):
   queued prompts into the running batch at token boundaries over a
   fixed-capacity KV-cache (``paddle_tpu.generation``), retires
   finished rows without draining the batch, streams tokens per
-  request, and extends admission to token budgets.
+  request, and extends admission to token budgets;
+- the serving fleet (``fleet.py``): :class:`FleetReplica` (engine +
+  HTTP server + TTL-lease registry heartbeat + manifest-v2 weight
+  watcher), :class:`FleetRouter` (least-loaded dispatch, transport
+  failover, probe-driven denylist, canary-then-promote weight
+  hot-swaps with rollback) — the multi-host scale-out and
+  zero-downtime-rollout tier.
 
 Quick start::
 
@@ -47,6 +53,8 @@ from .engine import (EngineConfig, GenerationEngine,
                      GenerationEngineConfig, GenerationStream,
                      InferenceEngine, PagedGenerationEngine,
                      validate_artifact)
+from .fleet import (FleetReplica, FleetRouter, ReplicaRegistry,
+                    WeightWatcher)
 from .server import ServingServer, serve
 
 __all__ = ["InferenceEngine", "EngineConfig", "ServingServer", "serve",
@@ -55,4 +63,5 @@ __all__ = ["InferenceEngine", "EngineConfig", "ServingServer", "serve",
            "RequestRejected", "DeadlineExceeded",
            "EngineClosed", "AdmissionController", "BucketPolicy",
            "ExecutableCache", "next_bucket", "pad_batch",
-           "seq_buckets", "validate_artifact"]
+           "seq_buckets", "validate_artifact", "FleetReplica",
+           "FleetRouter", "ReplicaRegistry", "WeightWatcher"]

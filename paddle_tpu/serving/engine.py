@@ -71,7 +71,13 @@ class EngineConfig:
                       FLAGS_compile_cache_dir is armed — then a fresh
                       engine costs deserialization, not compiles), so
                       first-request latency equals steady state;
-                      warmed-bucket count lands in /healthz
+                      warmed-bucket count lands in /healthz.  Pass
+                      ``"async"`` to warm on a background thread: the
+                      engine serves immediately (cold requests compile)
+                      but reports ``ready=False`` until warmup lands —
+                      a fleet router treats not-ready replicas as
+                      undispatchable, so traffic never lands in the
+                      cold-compile window
     name              metrics prefix (default "serving"); give each
                       engine a distinct name when one process serves
                       several models, or their counters/gauges mix
@@ -102,7 +108,7 @@ class EngineConfig:
         self.pad_dynamic_dims = bool(pad_dynamic_dims)
         self.min_batch_bucket = int(min_batch_bucket)
         self.validate_artifact = bool(validate_artifact)
-        self.warmup = bool(warmup)
+        self.warmup = warmup if warmup == "async" else bool(warmup)
         self.name = str(name)
 
 
@@ -253,9 +259,16 @@ class InferenceEngine:
         _metrics.gauge(f"{prefix}.workers", "predictor clones in the "
                        "pool").set(self.config.num_workers)
 
+        # readiness: alive != dispatchable.  False while warmup is still
+        # compiling buckets — /healthz reports it and the fleet router
+        # treats not-ready replicas as undispatchable
+        self.ready = False
         self.warmed_buckets = 0
-        if self.config.warmup:
+        if self.config.warmup and self.config.warmup != "async":
             self._warmup()
+            self.ready = True
+        elif not self.config.warmup:
+            self.ready = True        # nothing to wait for
 
         self._pending: deque = deque()
         # sanitizer factories (utils/concurrency.py): plain threading
@@ -274,6 +287,12 @@ class InferenceEngine:
         self._stop = False
         self._paused = False
         self._closed = False
+        # quiesce bookkeeping for weight hot-swap: batches queued or
+        # executing (incremented by the batcher BEFORE the queue put so
+        # there is no counted-nowhere window) + whether the batcher is
+        # mid-assembly of a batch
+        self._inflight = 0
+        self._batcher_busy = False
         self._workers: List[threading.Thread] = []
         self._predictors = [model.clone()
                             for _ in range(self.config.num_workers)]
@@ -283,6 +302,17 @@ class InferenceEngine:
             self._workers.append(_conc.spawn(
                 self._worker_loop, args=(p,),
                 name=f"serving-worker-{i}"))
+        if self.config.warmup == "async":
+            _conc.spawn(self._warmup_async, name="serving-warmup")
+
+    def _warmup_async(self):
+        try:
+            self._warmup()
+        finally:
+            # an engine closed mid-warmup must stay not-ready: flipping
+            # it back would make routers dispatch into EngineClosed
+            if not self._closed:
+                self.ready = True
 
     # -- warmup --------------------------------------------------------
     def _warmup(self):
@@ -432,8 +462,90 @@ class InferenceEngine:
                 if k.startswith((self.metrics_prefix + ".",
                                  "inference."))}
 
+    @property
+    def occupancy(self) -> int:
+        """Requests queued or executing — the live-load signal a fleet
+        router's least-loaded dispatch reads from the registry."""
+        return self._admission.depth + self._inflight
+
+    def swap_weights(self, params, buffers=None, *,
+                     timeout: float = 30.0):
+        """Zero-downtime weight hot-swap: replace the pool's shared
+        weight set between batches.
+
+        Every ``Predictor.clone()`` in the pool shares ONE weight dict
+        through the ``_jit_holder`` contract (identity, not copies), so
+        the swap is an in-place update of that dict: quiesce the
+        batcher + workers (no batch may sit between its weight read and
+        its execute), write the new arrays, resume.  Batches never mix
+        weight sets — each executes entirely on the old or entirely on
+        the new tree — and queued requests simply wait out the
+        (millisecond) quiesce window, so nothing is dropped.
+        Executables are untouched: weights are call *arguments*, and
+        the tree is validated shape/dtype-exact, so there is no
+        recompile and no retrace."""
+        base = self._base
+        if base._kind != "layer":
+            raise RuntimeError(
+                "swap_weights needs a layer-kind artifact (program-kind "
+                "artifacts carry no serving-side weight set)")
+        live = base._materialize_params()
+        if live is not base._params:
+            raise RuntimeError(
+                "swap_weights supports plain-precision artifacts only: "
+                "this engine serves a reduced/quantized weight set — "
+                "re-quantize offline and roll the artifact instead")
+        import jax.numpy as jnp
+        new_p = {k: jnp.asarray(getattr(v, "_data", v))
+                 for k, v in params.items()}
+        _check_swap_tree(live, new_p, "params")
+        new_b = None
+        if buffers is not None:
+            new_b = {k: jnp.asarray(getattr(v, "_data", v))
+                     for k, v in buffers.items()}
+            _check_swap_tree(base._buffers, new_b, "buffers")
+        deadline = time.monotonic() + (timeout or 30.0)
+        with self._cond:
+            prior_paused = self._paused
+            self._paused = True
+        try:
+            self._quiesce(deadline)
+            with base._jit_holder["lock"]:
+                live.update(new_p)
+                if new_b is not None:
+                    base._buffers.update(new_b)
+        finally:
+            with self._cond:
+                self._paused = prior_paused
+                self._cond.notify_all()
+        from ..profiler import metrics as _metrics
+        with self._mlock:
+            _metrics.counter(
+                f"{self.metrics_prefix}.weight_swaps",
+                "zero-downtime weight hot-swaps applied").inc()
+        if _flight.active:
+            _flight.note("serve", "weights_swap",
+                         engine=self.metrics_prefix)
+
+    def _quiesce(self, deadline: float):
+        """Wait until no batch is queued or executing (the batcher is
+        already paused by the caller).  ``_inflight`` covers a batch
+        from before its queue put through the end of its execute, and
+        ``_batcher_busy`` covers the assembly window, so predicate
+        true == no request is anywhere between weight read and
+        result."""
+        with self._cond:
+            while self._inflight != 0 or self._batcher_busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        "swap_weights could not quiesce the engine: "
+                        "in-flight batches did not drain in time")
+                self._cond.wait(timeout=remaining)
+
     def close(self, timeout: Optional[float] = 30.0):
         """Reject new work, drain queued requests, stop the pool."""
+        self.ready = False
         self._admission.close()
         with self._cond:
             if self._closed:
@@ -534,16 +646,18 @@ class InferenceEngine:
                 if self._paused and not self._stop:
                     continue
                 first = self._pending.popleft()
+                self._batcher_busy = True
             self._admission.release()
             if first.expired():
                 self._shed(first)
+                self._batcher_idle()
                 continue
             batch = [first]
             rows = first.rows
             if timeout_s <= 0:
                 # batch-less mode (documented solo-exact numerics for
                 # single-row requests): never coalesce, dispatch as-is
-                self._batch_q.put(batch)
+                self._dispatch_batch(batch)
                 continue
             t_close = time.monotonic() + timeout_s
             while rows < self.config.max_batch_size:
@@ -579,7 +693,22 @@ class InferenceEngine:
                     # and re-trigger the scan (worst case one timeout
                     # window of extra latency, never a busy spin)
                     self._cond.wait(timeout=remaining)
-            self._batch_q.put(batch)
+            self._dispatch_batch(batch)
+
+    def _dispatch_batch(self, batch):
+        """Hand one assembled batch to the worker pool.  ``_inflight``
+        is incremented BEFORE the queue put, so the quiesce predicate
+        (``_quiesce``) can never observe an empty queue while a batch
+        is between the batcher and a worker."""
+        with self._cond:
+            self._inflight += 1
+        self._batch_q.put(batch)
+        self._batcher_idle()
+
+    def _batcher_idle(self):
+        with self._cond:
+            self._batcher_busy = False
+            self._cond.notify_all()
 
     def _worker_loop(self, predictor):
         while True:
@@ -597,6 +726,10 @@ class InferenceEngine:
                                 self._m_failed.inc()
                         except Exception:  # cancelled concurrently
                             pass
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
 
     def _execute_batch(self, predictor, batch: List[_Request]):
         now = time.monotonic()
@@ -703,6 +836,32 @@ class InferenceEngine:
         return predictor._finalize_outputs(out)
 
 
+def _check_swap_tree(live: Dict[str, object], new: Dict[str, object],
+                     what: str):
+    """A hot-swap may never half-apply: the incoming tree must match
+    the live one key-for-key in shape and dtype.  Weights are
+    executable *arguments* — a mismatched swap would mean silent
+    retraces (new compiles mid-traffic) or shape errors inside a live
+    batch, so it is rejected wholesale before anything is touched."""
+    missing = sorted(set(live) - set(new))
+    extra = sorted(set(new) - set(live))
+    if missing or extra:
+        raise ValueError(
+            f"swap_weights {what} tree mismatch: missing {missing[:3]}"
+            f"{'...' if len(missing) > 3 else ''}, unexpected "
+            f"{extra[:3]}{'...' if len(extra) > 3 else ''} — swap "
+            "trees must match the served model exactly")
+    for k, v in new.items():
+        cur = live[k]
+        if tuple(v.shape) != tuple(cur.shape) or \
+                str(v.dtype) != str(cur.dtype):
+            raise ValueError(
+                f"swap_weights {what}[{k!r}]: incoming "
+                f"{tuple(v.shape)}/{v.dtype} vs served "
+                f"{tuple(cur.shape)}/{cur.dtype} — a shape/dtype "
+                "change is a new artifact, not a hot-swap")
+
+
 # ---------------------------------------------------------------------------
 # continuous (in-flight) batching for autoregressive generation
 # ---------------------------------------------------------------------------
@@ -733,7 +892,10 @@ class GenerationEngineConfig:
                          construction (from the AOT artifact store when
                          FLAGS_compile_cache_dir is armed), so
                          time-to-first-token equals steady state from
-                         request one; warmed count lands in /healthz
+                         request one; warmed count lands in /healthz.
+                         ``"async"`` warms on a background thread and
+                         holds ``ready=False`` until done (routers
+                         treat not-ready as undispatchable)
     name                 metrics prefix (default "serving" — gives the
                          ``serving.prefill`` / ``serving.decode`` /
                          ``serving.compile`` names the gates assert on)
@@ -788,7 +950,7 @@ class GenerationEngineConfig:
         self.max_tokens_in_flight = max_tokens_in_flight
         self.deadline_ms = deadline_ms
         self.prompt_bucket_min = int(prompt_bucket_min)
-        self.warmup = bool(warmup)
+        self.warmup = warmup if warmup == "async" else bool(warmup)
         self.name = str(name)
         self.block_size = int(block_size)
         self.num_blocks = num_blocks
@@ -963,10 +1125,15 @@ class GenerationEngine:
 
         # warmup BEFORE the slot bank exists: the warmup cache is a
         # local that frees on return, so peak device memory stays at
-        # one KV cache either way
+        # one KV cache either way (async warmup trades that guarantee
+        # for immediate liveness + an honest ready=False window)
+        self.ready = False
         self.warmed_buckets = 0
-        if cfg.warmup:
+        if cfg.warmup and cfg.warmup != "async":
             self._warmup()
+            self.ready = True
+        elif not cfg.warmup:
+            self.ready = True
 
         # slot bank (host-side control state; caches live on device)
         self._init_slot_state()
@@ -978,8 +1145,22 @@ class GenerationEngine:
         self._stop = False
         self._paused = False
         self._closed = False
+        # pending weight swap, applied by the scheduler BETWEEN token
+        # boundaries: (params, buffers, done_event, error_holder)
+        self._swap = None
         self._scheduler = _conc.spawn(
             self._loop, name="generation-scheduler")
+        if cfg.warmup == "async":
+            _conc.spawn(self._warmup_async, name="generation-warmup")
+
+    def _warmup_async(self):
+        try:
+            self._warmup()
+        finally:
+            # an engine closed mid-warmup must stay not-ready: flipping
+            # it back would make routers dispatch into EngineClosed
+            if not self._closed:
+                self.ready = True
 
     # -- construction hooks (PagedGenerationEngine overrides these) ----
     def _make_session(self, model, cfg: GenerationEngineConfig,
@@ -1170,9 +1351,104 @@ class GenerationEngine:
         return {k: v for k, v in snap.items()
                 if k.startswith(self.metrics_prefix + ".")}
 
+    @property
+    def occupancy(self) -> int:
+        """Occupied decode slots — the live-load signal a fleet
+        router's least-loaded dispatch reads from the registry."""
+        return sum(1 for r in self._slot_req if r is not None)
+
+    def swap_weights(self, params, buffers=None, *,
+                     timeout: float = 60.0):
+        """Zero-downtime weight hot-swap: replace the model's weight
+        set BETWEEN engine steps.
+
+        The scheduler applies the swap at the next token boundary (or
+        immediately when idle): running decodes finish their current
+        fused step on the old weights, every subsequent prefill/decode
+        reads the new ones — no stream drops, no slot resets, and no
+        recompile (the session's executables take weights as
+        *arguments*; the tree is validated shape/dtype-exact first).
+        Blocks until the scheduler has applied the swap; raises the
+        application error if it failed (the old weights stay live)."""
+        import jax.numpy as jnp
+        cur_p, cur_b = self.model.functional_state()
+        new_p = {k: jnp.asarray(getattr(v, "_data", v))
+                 for k, v in params.items()}
+        _check_swap_tree(cur_p, new_p, "params")
+        new_b = None
+        if buffers is not None:
+            new_b = {k: jnp.asarray(getattr(v, "_data", v))
+                     for k, v in buffers.items()}
+            _check_swap_tree(cur_b, new_b, "buffers")
+        done = threading.Event()
+        holder: Dict[str, BaseException] = {}
+        with self._cond:
+            if self._closed:
+                raise EngineClosed()
+            if self._swap is not None:
+                raise RuntimeError(
+                    "another weight swap is already pending")
+            self._swap = (new_p, new_b, done, holder)
+            self._cond.notify_all()
+        if not done.wait(timeout):
+            with self._cond:
+                if self._swap is not None and self._swap[2] is done:
+                    # withdrawn before the scheduler claimed it: the
+                    # swap will never apply, the timeout is honest
+                    self._swap = None
+                    raise TimeoutError(
+                        f"weight swap not applied within {timeout}s "
+                        "(scheduler wedged mid-step?)")
+            # the scheduler popped the swap while we timed out — it is
+            # applying RIGHT NOW; raising here would leave the caller
+            # believing the old weights are live while the served set
+            # flips under it.  Wait the application out.
+            if not done.wait(timeout):
+                raise TimeoutError(
+                    f"weight swap claimed by the scheduler but not "
+                    f"applied within another {timeout}s (model rebind "
+                    "wedged?)")
+        err = holder.get("error")
+        if err is not None:
+            raise err
+        from ..profiler import metrics as _metrics
+        with self._mlock:
+            _metrics.counter(
+                f"{self.metrics_prefix}.weight_swaps",
+                "zero-downtime weight hot-swaps applied").inc()
+        if _flight.active:
+            _flight.note("serve", "weights_swap",
+                         engine=self.metrics_prefix)
+
+    def _apply_swap(self):
+        """Scheduler-side swap application — called only between
+        boundaries, on the scheduler thread, so no executable is
+        mid-step while the model's arrays are rebound."""
+        with self._cond:
+            swap, self._swap = self._swap, None
+        if swap is None:
+            return
+        new_p, new_b, done, holder = swap
+        try:
+            self.model.load_functional_state(new_p, new_b)
+        except BaseException as e:     # noqa: BLE001 — surfaced to caller
+            holder["error"] = e
+        finally:
+            done.set()
+
+    def _drain_swap(self, exc: BaseException):
+        """Resolve a swap the scheduler will never apply (engine
+        closing) so the caller's wait can't hang."""
+        with self._cond:
+            swap, self._swap = self._swap, None
+        if swap is not None:
+            swap[3]["error"] = exc
+            swap[2].set()
+
     def close(self, timeout: Optional[float] = 60.0):
         """Reject new work, let queued + running requests finish, stop
         the scheduler."""
+        self.ready = False
         self._admission.close()
         with self._cond:
             if self._closed:
@@ -1182,6 +1458,7 @@ class GenerationEngine:
             self._paused = False
             self._cond.notify_all()
         self._scheduler.join(timeout=timeout)
+        self._drain_swap(EngineClosed())
 
     def __enter__(self):
         return self
@@ -1196,14 +1473,20 @@ class GenerationEngine:
     def _loop(self):
         while True:
             with self._cond:
-                while (not self._stop and not self._pending
-                       and not self._occupied()) or \
-                        (self._paused and not self._occupied()
-                         and not self._stop):
+                while self._swap is None and \
+                        ((not self._stop and not self._pending
+                          and not self._occupied()) or
+                         (self._paused and not self._occupied()
+                          and not self._stop)):
                     self._cond.wait()
                 if self._stop and not self._pending \
                         and not self._occupied():
                     break
+            if self._swap is not None:
+                # between boundaries by construction: the previous
+                # fused step has returned, the next hasn't dispatched
+                self._apply_swap()
+                continue
             try:
                 self._admit()
                 occ = self._occupied()

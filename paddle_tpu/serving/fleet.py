@@ -1,0 +1,1522 @@
+"""Multi-host serving fabric: replica registry, failover router,
+zero-downtime weight hot-swap.
+
+Everything below ``serving/`` so far serves from ONE process — one
+SIGKILL away from dropping every in-flight stream.  This module is the
+scale-out story, built on infrastructure already in-repo:
+
+- **Replica registry** (:class:`ReplicaRegistry`): each engine replica
+  claims a generation-prefixed TTL-lease key through the elastic
+  ``Store`` (PR 9's rendezvous/lease machinery reused as a serving
+  membership plane — ``/paddle/serving/<job>/g<gen>/<replica>``,
+  ``distributed.launch.serving_key``) and republishes a JSON payload of
+  health / readiness / occupancy / weight provenance on a heartbeat
+  cadence.  Store outages degrade: the replica keeps serving (heartbeat
+  failures are counted, never raised) and the router keeps its
+  last-known membership — membership is advisory, serving never blocks
+  on the control plane.
+
+- **Failover router** (:class:`FleetRouter`): an HTTP tier that
+  discovers replicas from the registry, dispatches **least-loaded**
+  (router-local in-flight + the replica's published queue depth and
+  slot occupancy), retries idempotent requests on a *different* replica
+  with exponential backoff, and sheds with typed 429/503 +
+  ``Retry-After`` before queueing unboundedly.  Failure classification
+  rides ``utils/resilience.retry``: connection-refused / reset /
+  timeout (and a replica's own 503 — it is draining) are
+  **failover-able** transport failures; any other application response
+  (400/404/429/500/504) is relayed verbatim — retrying a model error on
+  another replica would just repeat it.  Replicas that fail
+  ``probe_failures`` consecutive ``/healthz`` probes are drained +
+  denylisted (and readmitted when probes recover); replicas whose
+  ``/healthz`` reports ``ready=false`` (still compiling warmup buckets,
+  or draining) are undispatchable.  SSE token streams fail over
+  *mid-stream*: generation is seed-deterministic, so the router
+  re-issues the request on a surviving replica and splices — events the
+  client already received are skipped by index, the stream continues
+  byte-identically.
+
+- **Zero-downtime weight hot-swap** (:class:`WeightWatcher` +
+  router canary flow): replicas watch a manifest-v2 checkpoint
+  directory (``AsyncCheckpointer`` layout, ``<dir>/<step>/``), verify
+  the newest committed step — sha256 manifest + ``_PADDLE_COMMITTED``
+  marker, quarantining corrupt trees exactly like
+  ``AsyncCheckpointer.restore`` (``_quarantine/<step>``,
+  ``ckpt.quarantined``) — and publish it as ``available_step``.
+  Markerless (mid-commit) trees are invisible, never loaded, never
+  quarantined.  The router's canary controller swaps ONE replica to a
+  newly available step (``POST /admin/swap``), watches an error-rate
+  window of real traffic on it, then promotes the rest of the fleet —
+  or rolls the canary back and blacklists the step on failure.  The
+  swap itself is ``engine.swap_weights``: applied *between* engine
+  steps (token boundaries for generation, quiesced batches for
+  inference), so no stream drops and no executable recompiles.
+
+Flight-recorder events (the fleet gate asserts exact counts):
+``replica.join`` / ``replica.leave`` (membership), ``replica.deny`` /
+``replica.readmit`` (probe verdicts), ``swap.canary`` /
+``swap.promote`` / ``swap.rollback`` / ``swap.abort`` (weight
+rollouts).  Chaos sites: ``router.dispatch`` (kills one forward hop as
+a connection reset) and ``fleet.lease`` (drops heartbeat puts so a
+lease expires) — both one predicate read when disarmed.
+
+Quick start (one process per replica, router anywhere)::
+
+    # replica host
+    replica = fleet.FleetReplica(
+        generation_engine=engine, store="tcp://coord:4536",
+        job="chat", watch_dir="/ckpts/chat")
+    replica.run()                      # serves until SIGTERM, drains
+
+    # router host
+    router = fleet.FleetRouter("tcp://coord:4536", job="chat").start()
+    # clients POST /v1/generate to the router exactly as to a replica
+"""
+from __future__ import annotations
+
+import errno
+import http.client
+import json
+import os
+import shutil
+import signal
+import socket
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..distributed.launch import SERVING_PREFIX, serving_key
+from ..profiler import flight as _flight
+from ..utils import chaos as _chaos
+from ..utils import concurrency as _conc
+from ..utils import resilience as _resilience
+
+__all__ = ["ReplicaInfo", "ReplicaRegistry", "list_replicas",
+           "WeightWatcher", "FleetReplica", "FleetRouter",
+           "failover_classify", "NoReplicaAvailable"]
+
+
+def _as_store(store):
+    """Accept a Store instance or a spec string (``tcp://host:port`` /
+    filestore root)."""
+    if isinstance(store, str):
+        from ..distributed.fleet.elastic.manager import store_from_spec
+        return store_from_spec(store)
+    return store
+
+
+# ---------------------------------------------------------------------------
+# membership: TTL-lease replica registry
+# ---------------------------------------------------------------------------
+class ReplicaInfo:
+    """One replica's last-published registry payload, parsed."""
+
+    __slots__ = ("replica_id", "generation", "endpoint", "ready",
+                 "queue_depth", "occupancy", "slots", "weights_step",
+                 "available_step", "t")
+
+    def __init__(self, replica_id: str, generation: int = 0,
+                 endpoint: str = "", ready: bool = False,
+                 queue_depth: int = 0, occupancy: int = 0,
+                 slots: int = 0, weights_step: Optional[int] = None,
+                 available_step: Optional[int] = None,
+                 t: float = 0.0):
+        self.replica_id = replica_id
+        self.generation = int(generation)
+        self.endpoint = endpoint
+        self.ready = bool(ready)
+        self.queue_depth = int(queue_depth)
+        self.occupancy = int(occupancy)
+        self.slots = int(slots)
+        self.weights_step = weights_step
+        self.available_step = available_step
+        self.t = float(t)
+
+    @classmethod
+    def from_payload(cls, replica_id: str, generation: int,
+                     payload: str) -> Optional["ReplicaInfo"]:
+        """Tolerant parse: a malformed payload (version skew, torn
+        write) yields None instead of poisoning the whole listing."""
+        try:
+            d = json.loads(payload)
+            if not isinstance(d, dict):
+                return None
+            return cls(replica_id, generation,
+                       endpoint=str(d.get("endpoint", "")),
+                       ready=bool(d.get("ready", False)),
+                       queue_depth=int(d.get("queue_depth", 0) or 0),
+                       occupancy=int(d.get("occupancy", 0) or 0),
+                       slots=int(d.get("slots", 0) or 0),
+                       weights_step=d.get("weights_step"),
+                       available_step=d.get("available_step"),
+                       t=float(d.get("t", 0.0) or 0.0))
+        except (ValueError, TypeError):
+            # casts included: one version-skewed replica publishing a
+            # non-numeric field must not poison the whole listing
+            return None
+
+    def load(self) -> int:
+        """The least-loaded dispatch key contribution from the
+        replica's own heartbeat (the router adds its local in-flight
+        count on top)."""
+        return self.queue_depth + self.occupancy
+
+    def __repr__(self):
+        return (f"ReplicaInfo({self.replica_id!r}@{self.endpoint}, "
+                f"ready={self.ready}, load={self.load()}, "
+                f"weights={self.weights_step})")
+
+
+def list_replicas(store, job: str) -> Dict[str, ReplicaInfo]:
+    """Read the live membership for ``job`` from the store: every
+    unexpired ``/paddle/serving/<job>/g<gen>/<id>`` lease, newest
+    generation winning when a replica appears under several.  Raises
+    whatever the store raises — the ROUTER owns the degrade-to-last-
+    known policy, a bare reader should see the outage."""
+    pfx = f"{SERVING_PREFIX}{job}/"
+    out: Dict[str, ReplicaInfo] = {}
+    for k, v in _as_store(store).list_prefix(pfx).items():
+        tail = k[len(pfx):] if k.startswith(pfx) else k
+        parts = [p for p in tail.split("/") if p]
+        if len(parts) != 2 or not parts[0].startswith("g"):
+            continue
+        gen = int(parts[0][1:]) if parts[0][1:].isdigit() else 0
+        info = ReplicaInfo.from_payload(parts[1], gen, v)
+        if info is None:
+            continue
+        prev = out.get(info.replica_id)
+        if prev is None or (info.generation, info.t) >= \
+                (prev.generation, prev.t):
+            out[info.replica_id] = info
+    return out
+
+
+class ReplicaRegistry:
+    """A replica's membership claim: a generation-prefixed TTL lease,
+    refreshed with a live status payload on a heartbeat cadence.
+
+    The same fencing pattern as PR 9's rendezvous: the key embeds the
+    restart generation, so a slow-dying replica from a prior generation
+    republishes under a prefix routers scoped to the live fleet never
+    merge wrongly (its TTL also expires it).  Store outages never block
+    serving: a failed publish is counted (``fleet.lease.fail``) and the
+    next beat retries; the worst case is the lease lapsing — membership
+    loss without process loss, which the router's failover absorbs.
+
+    The ``fleet.lease`` chaos site fires inside :meth:`publish`, so a
+    deterministic spec can drop exact heartbeats and force a lease
+    expiry without killing anything.
+    """
+
+    def __init__(self, store, job: str = "serve",
+                 replica_id: Optional[str] = None,
+                 status_fn: Optional[Callable[[], dict]] = None, *,
+                 generation: Optional[int] = None, ttl: float = 6.0,
+                 interval: float = 1.5):
+        self.store = _as_store(store)
+        self.job = str(job)
+        self.replica_id = replica_id or f"r{os.getpid()}"
+        if generation is None:
+            generation = int(os.environ.get(
+                "PADDLE_RESTART_GENERATION", "0"))
+        self.generation = int(generation)
+        self.ttl = float(ttl)
+        self.interval = float(interval)
+        self._status_fn = status_fn or (lambda: {})
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        from ..profiler import metrics as _metrics
+        self._m_pub = _metrics.counter(
+            "fleet.lease.published",
+            "replica-registry lease heartbeats that landed")
+        self._m_fail = _metrics.counter(
+            "fleet.lease.fail",
+            "lease heartbeats lost to store outages or chaos "
+            "(serving continues; the TTL may lapse)")
+
+    @property
+    def key(self) -> str:
+        return serving_key(self.job, self.generation, self.replica_id)
+
+    def publish(self):
+        """One lease refresh carrying the current status payload.
+        Raises on store failure — the heartbeat thread owns the
+        swallow-and-count policy, direct callers see the truth."""
+        payload = dict(self._status_fn())
+        payload.setdefault("t", time.time())
+        if _chaos.active:
+            _chaos.hit("fleet.lease", exc=ConnectionResetError)
+        self.store.put(self.key, json.dumps(payload), ttl=self.ttl)
+
+    def _beat(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.publish()
+                self._m_pub.inc()
+            except Exception as e:  # noqa: BLE001 — serving never blocks
+                self._m_fail.inc()
+                if _flight.active:
+                    _flight.note("fleet", "lease_fail",
+                                 replica=self.replica_id,
+                                 error=f"{type(e).__name__}: {e}")
+
+    def start(self) -> "ReplicaRegistry":
+        try:
+            self.publish()          # join NOW, not one interval later
+            self._m_pub.inc()
+        except Exception as e:      # noqa: BLE001
+            self._m_fail.inc()
+            warnings.warn(f"replica registry: initial lease publish "
+                          f"failed ({e!r}); heartbeat will retry",
+                          RuntimeWarning)
+        self._thread = _conc.spawn(self._beat, name="fleet-lease")
+        return self
+
+    def deregister(self):
+        """Leave cleanly: stop the heartbeat and delete the lease so
+        the router drops this replica immediately instead of waiting
+        out the TTL."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+            self._thread = None
+        try:
+            self.store.delete(self.key)
+        except Exception:   # noqa: BLE001 — the TTL expires it anyway
+            pass
+
+
+# ---------------------------------------------------------------------------
+# weight provenance: manifest-v2 checkpoint watcher + verified swap
+# ---------------------------------------------------------------------------
+class WeightWatcher:
+    """Watches an ``AsyncCheckpointer``-layout checkpoint directory
+    (``<dir>/<step>/`` committed trees) for newly published weights.
+
+    Verification is the PR 3 contract, applied to the serving side:
+    a step is *available* only when it carries the
+    ``_PADDLE_COMMITTED`` marker AND its full sha256 manifest
+    re-verifies (``checkpoint.verify_checkpoint``).  Corrupt-but-marked
+    trees are quarantined exactly like ``AsyncCheckpointer.restore``
+    (moved to ``_quarantine/<step>``, counted ``ckpt.quarantined``) and
+    never considered again; markerless trees are *invisible* — they may
+    be a writer mid-commit, so they are neither loaded nor quarantined.
+
+    ``swap_to(step)`` re-verifies (the poll->swap gap is a rot window),
+    loads the tree (template-less manifest restore) and hands it to
+    ``apply_fn`` — a :class:`FleetReplica` routes that to
+    ``engine.swap_weights``, which applies between engine steps.  The
+    previous step is remembered for the router's rollback path.
+    ``auto_swap=True`` swaps without a router (single-replica
+    deployments); the default only *publishes* availability and waits
+    for the router's canary flow.
+    """
+
+    QUARANTINE = "_quarantine"
+
+    def __init__(self, directory: str,
+                 apply_fn: Callable[[Dict[str, Any]], None], *,
+                 interval: float = 1.0, auto_swap: bool = False):
+        self.directory = os.path.abspath(directory)
+        self.apply_fn = apply_fn
+        self.interval = float(interval)
+        self.auto_swap = bool(auto_swap)
+        self.current_step: Optional[int] = None
+        self.previous_step: Optional[int] = None
+        self.available_step: Optional[int] = None
+        self._verified: set = set()
+        self._quarantined: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._swap_lock = _conc.Lock(name="fleet.watcher.swap")
+
+    # -- discovery -----------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
+    def poll_once(self) -> Optional[int]:
+        """Newest step that is committed AND verifies; updates
+        ``available_step``.  Corrupt committed trees are quarantined
+        and skipped, torn markerless trees are skipped silently."""
+        from ..distributed import checkpoint as _ckpt
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return self.available_step
+        for s in sorted((int(n) for n in names if n.isdigit()),
+                        reverse=True):
+            if s in self._quarantined:
+                continue
+            d = self._step_dir(s)
+            if not os.path.exists(os.path.join(d, _ckpt.COMMITTED_NAME)):
+                continue      # mid-commit or torn: invisible by design
+            if s not in self._verified:
+                try:
+                    _ckpt.verify_checkpoint(d)
+                except _ckpt.CheckpointCorruptError as e:
+                    self._quarantine(s, e)
+                    continue
+                self._verified.add(s)
+            self.available_step = s
+            return s
+        return None
+
+    def _quarantine(self, step: int, err: BaseException):
+        """Move a corrupt committed tree aside — the same policy (and
+        the same ``ckpt.quarantined`` metric) as
+        ``AsyncCheckpointer.restore``, so one corruption matrix covers
+        both read paths."""
+        self._quarantined.add(step)
+        qroot = os.path.join(self.directory, self.QUARANTINE)
+        dst = os.path.join(qroot, str(step))
+        try:
+            os.makedirs(qroot, exist_ok=True)
+            if os.path.exists(dst):
+                shutil.rmtree(dst, ignore_errors=True)
+            os.rename(self._step_dir(step), dst)
+        except OSError:
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)
+        from ..profiler import metrics as _metrics
+        _metrics.counter("ckpt.quarantined",
+                         "corrupt checkpoint steps moved aside by "
+                         "restore").inc()
+        if _flight.active:
+            _flight.note("swap", "quarantine", step=step,
+                         error=f"{err}")
+        warnings.warn(f"weight watcher: checkpoint step {step} failed "
+                      f"verification ({err}); quarantined under "
+                      f"{qroot}", RuntimeWarning)
+
+    # -- swap ----------------------------------------------------------
+    def swap_to(self, step: int) -> int:
+        """Verify + load ``step`` and apply it through ``apply_fn``.
+        Raises ``CheckpointCorruptError`` (after quarantining) when the
+        tree no longer verifies, or whatever the apply raised — in
+        either case the old weights stay live."""
+        from ..distributed import checkpoint as _ckpt
+        step = int(step)
+        with self._swap_lock:
+            d = self._step_dir(step)
+            try:
+                # re-verify at swap time: the poll->swap gap is a rot
+                # window, and the router's word is not provenance
+                _ckpt.verify_checkpoint(d)
+            except _ckpt.CheckpointCorruptError:
+                self._verified.discard(step)
+                if os.path.exists(os.path.join(d,
+                                               _ckpt.COMMITTED_NAME)):
+                    # committed-but-corrupt: move it aside like restore
+                    self._quarantine(step, RuntimeError(
+                        "failed re-verification at swap time"))
+                # markerless: maybe a writer mid-commit — refuse but
+                # never destroy it
+                raise
+            # template-less restore: safe here by construction — the
+            # manifest just re-verified byte-for-byte and the tree is
+            # host-local — so orbax's topology warning is noise;
+            # silence it for this call only
+            import logging
+            absl_logger = logging.getLogger("absl")
+            prev_level = absl_logger.level
+            absl_logger.setLevel(logging.ERROR)
+            try:
+                tree = _ckpt.load_state(d)
+            finally:
+                absl_logger.setLevel(prev_level)
+            self.apply_fn(tree)
+            if self.current_step != step:
+                self.previous_step = self.current_step
+            self.current_step = step
+            if _flight.active:
+                _flight.note("swap", "apply", step=step)
+            return step
+
+    def maybe_swap(self) -> Optional[int]:
+        """auto_swap mode: follow the newest verified step."""
+        s = self.poll_once()
+        if s is not None and s != self.current_step:
+            try:
+                return self.swap_to(s)
+            except Exception as e:  # noqa: BLE001 — keep serving old
+                warnings.warn(f"weight watcher: auto-swap to step {s} "
+                              f"failed ({e!r}); serving previous "
+                              f"weights", RuntimeWarning)
+        return None
+
+    # -- lifecycle -----------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                if self.auto_swap:
+                    self.maybe_swap()
+                else:
+                    self.poll_once()
+            except Exception as e:  # noqa: BLE001 — watcher must survive
+                warnings.warn(f"weight watcher poll failed ({e!r})",
+                              RuntimeWarning)
+
+    def start(self) -> "WeightWatcher":
+        self._thread = _conc.spawn(self._loop, name="fleet-watcher")
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+            self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# one fleet member: engine(s) + HTTP server + lease + watcher
+# ---------------------------------------------------------------------------
+class FleetReplica:
+    """One serving fleet member, assembled: engine(s) + HTTP frontend +
+    TTL-lease registry heartbeat + weight watcher, with the ordered
+    graceful drain the single-process server could not give you —
+    ``shutdown()`` stops accepting, finishes in-flight SSE streams,
+    deregisters the lease, THEN closes the engines."""
+
+    def __init__(self, engine=None, generation_engine=None, *, store,
+                 job: str = "serve", replica_id: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 watch_dir: Optional[str] = None,
+                 watch_interval: float = 1.0, auto_swap: bool = False,
+                 load_on_start: bool = True, lease_ttl: float = 6.0,
+                 heartbeat_interval: float = 1.5,
+                 generation: Optional[int] = None,
+                 verbose: bool = False):
+        from .engine import GenerationEngine
+        from .server import ServingServer
+        if generation_engine is None and isinstance(engine,
+                                                    GenerationEngine):
+            engine, generation_engine = None, engine
+        if engine is None and generation_engine is None:
+            raise ValueError("bind at least one engine")
+        self.engine = engine
+        self.generation_engine = generation_engine
+        self.store = _as_store(store)
+        self.watcher = None
+        if watch_dir:
+            self.watcher = WeightWatcher(
+                watch_dir, self._apply_tree, interval=watch_interval,
+                auto_swap=auto_swap)
+            if load_on_start:
+                s = self.watcher.poll_once()
+                if s is not None:
+                    # boot on the newest verified weights so every
+                    # replica of a fleet serves the same step from
+                    # request one
+                    self.watcher.swap_to(s)
+        self.registry = ReplicaRegistry(
+            self.store, job, replica_id, self._status,
+            generation=generation, ttl=lease_ttl,
+            interval=heartbeat_interval)
+        self.replica_id = self.registry.replica_id
+        self.server = ServingServer(
+            engine, generation_engine=generation_engine, host=host,
+            port=port, registry=self.registry, fleet_admin=self,
+            verbose=verbose)
+        self.endpoint = f"{self.server.host}:{self.server.port}"
+        self._started = False
+
+    # -- status --------------------------------------------------------
+    def _engines(self):
+        return [e for e in (self.engine, self.generation_engine)
+                if e is not None]
+
+    @property
+    def ready(self) -> bool:
+        return all(getattr(e, "ready", True) for e in self._engines())
+
+    def _status(self) -> dict:
+        d = {
+            "endpoint": self.endpoint,
+            "ready": self.ready,
+            "queue_depth": sum(e._admission.depth
+                               for e in self._engines()),
+            "occupancy": sum(getattr(e, "occupancy", 0)
+                             for e in self._engines()),
+            "slots": (self.generation_engine.slots
+                      if self.generation_engine is not None else
+                      self.engine.config.max_batch_size),
+        }
+        if self.watcher is not None:
+            d["weights_step"] = self.watcher.current_step
+            d["available_step"] = self.watcher.available_step
+        return d
+
+    def health_fields(self) -> dict:
+        """Extra ``/healthz`` fields (the router's canary controller
+        reads weight provenance here when it probes)."""
+        d = {"replica_id": self.replica_id}
+        if self.watcher is not None:
+            d["weights_step"] = self.watcher.current_step
+            d["available_step"] = self.watcher.available_step
+        return d
+
+    # -- weight swap plumbing ------------------------------------------
+    def _apply_tree(self, tree: Dict[str, Any]):
+        """Route a restored checkpoint tree into every bound engine's
+        between-steps swap.  Accepts ``save_layer``/``Model.fit`` trees
+        ({"params": ..., "buffers": ...}, extra keys like opt/rng
+        ignored) or a bare flat param dict."""
+        params = tree.get("params", tree) if isinstance(tree, dict) \
+            else tree
+        buffers = tree.get("buffers") if isinstance(tree, dict) else None
+        for e in self._engines():
+            e.swap_weights(params, buffers)
+
+    def admin_request(self, path: str, payload: dict
+                      ) -> Tuple[int, dict]:
+        """Fleet control plane, reached via ``POST /admin/...`` on this
+        replica's HTTP server (the router drives canary / promote /
+        rollback through it)."""
+        from ..distributed.checkpoint import CheckpointCorruptError
+        if path == "/admin/swap":
+            if self.watcher is None:
+                return 409, {"error": "replica has no watch_dir; "
+                             "nothing to swap from"}
+            step = payload.get("step")
+            if step is None:
+                return 400, {"error": "missing 'step'"}
+            prev = self.watcher.current_step
+            try:
+                applied = self.watcher.swap_to(int(step))
+            except CheckpointCorruptError as e:
+                return 409, {"error": f"step {step} failed "
+                             f"verification: {e}", "reason": "corrupt"}
+            except (ValueError, RuntimeError, TimeoutError) as e:
+                return 409, {"error": f"{type(e).__name__}: {e}",
+                             "reason": "swap_failed"}
+            return 200, {"ok": True, "step": applied, "previous": prev,
+                         "replica_id": self.replica_id}
+        if path == "/admin/info":
+            return 200, self._status()
+        return 404, {"error": f"no admin route {path}"}
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "FleetReplica":
+        self.server.start()
+        if self.watcher is not None:
+            self.watcher.start()
+        self.registry.start()
+        self._started = True
+        return self
+
+    def shutdown(self, drain_s: float = 30.0):
+        """The ordered drain: stop accepting, finish in-flight streams,
+        deregister the lease (``ServingServer.stop`` owns those three,
+        in that order), THEN close the engines — an active SSE handler
+        can never race a closing engine."""
+        if self.watcher is not None:
+            self.watcher.stop()
+        self.server.stop(drain_s=drain_s)
+        for e in self._engines():
+            e.close()
+        self._started = False
+
+    def run(self, poll: float = 0.5):
+        """Serve until SIGTERM/SIGINT, then drain (subprocess entry
+        point for launcher-spawned replicas)."""
+        stop = threading.Event()
+
+        def _sig(_s, _f):
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _sig)
+        signal.signal(signal.SIGINT, _sig)
+        if not self._started:
+            self.start()
+        while not stop.wait(poll):
+            pass
+        self.shutdown()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# failover classification (rides utils/resilience.retry)
+# ---------------------------------------------------------------------------
+FAILOVER_ERRNOS = {errno.ECONNREFUSED, errno.ECONNRESET, errno.EPIPE,
+                   errno.ETIMEDOUT, errno.ECONNABORTED,
+                   errno.EHOSTUNREACH, errno.ENETUNREACH}
+
+
+class NoReplicaAvailable(RuntimeError):
+    """The fleet has no dispatchable (ready, non-denylisted) replica;
+    the router answers 503 + Retry-After."""
+
+
+class _ReplicaUnavailable(ConnectionError):
+    """A replica answered 503: it is closing/draining — failover-able
+    by classification (another replica can absorb the request)."""
+
+
+class _ClientGone(RuntimeError):
+    """The ROUTER's client hung up mid-response: abort, never retry."""
+
+
+def failover_classify(exc: BaseException) -> bool:
+    """True when a dispatch failure is a *transport* failure another
+    replica can absorb — connection refused/reset/aborted, broken
+    pipe, timeouts, a dead upstream mid-response, or a replica's own
+    503 (draining).  False for everything application-level: the
+    request reached a live engine and the answer (400/404/429/500/504)
+    is the answer — repeating it on another replica repeats the
+    outcome and doubles the damage of non-idempotent mistakes."""
+    if isinstance(exc, _ReplicaUnavailable):
+        return True
+    if isinstance(exc, (ConnectionRefusedError, ConnectionResetError,
+                        ConnectionAbortedError, BrokenPipeError,
+                        socket.timeout, TimeoutError,
+                        http.client.BadStatusLine,
+                        http.client.IncompleteRead,
+                        http.client.CannotSendRequest)):
+        # IncompleteRead/BadStatusLine: the replica died mid-response
+        # (a SIGKILL lands as either, depending on where the kernel cut
+        # the stream) — same failover as a reset
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in FAILOVER_ERRNOS
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the router frontend
+# ---------------------------------------------------------------------------
+class FleetRouter:
+    """HTTP router over a replica fleet discovered from the registry.
+
+    See the module docstring for the full semantics.  Knobs:
+
+    refresh_interval   registry re-list cadence (s)
+    probe_interval     per-replica /healthz probe cadence (s)
+    probe_failures     consecutive probe failures before a replica is
+                       drained + denylisted (probe success readmits)
+    max_inflight       router-wide concurrent-request bound; beyond it
+                       requests shed with 429 + Retry-After
+    retry_tries        dispatch attempts per request (each on a
+                       different replica while any remain untried)
+    canary_requests    completed canary-replica requests the error-rate
+                       window needs before promoting new weights
+    canary_max_errors  errors tolerated inside that window (beyond ->
+                       rollback + step blacklisted)
+    canary_timeout_s   window wall-clock bound: expiry promotes on a
+                       clean record, rolls back on any error
+    manage_swaps       False turns the canary controller off (the
+                       router only routes; swaps are driven externally)
+    """
+
+    def __init__(self, store, job: str = "serve", *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 refresh_interval: float = 0.5,
+                 probe_interval: float = 0.5,
+                 probe_timeout: float = 2.0, probe_failures: int = 3,
+                 max_inflight: int = 64,
+                 max_body_bytes: int = 64 << 20,
+                 request_timeout: float = 120.0, retry_tries: int = 4,
+                 retry_base_delay: float = 0.05,
+                 canary_requests: int = 4, canary_max_errors: int = 0,
+                 canary_timeout_s: float = 60.0,
+                 admin_timeout: float = 60.0,
+                 manage_swaps: bool = True, verbose: bool = False):
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+        self.store = _as_store(store)
+        self.job = str(job)
+        self.refresh_interval = float(refresh_interval)
+        self.probe_interval = float(probe_interval)
+        self.probe_timeout = float(probe_timeout)
+        self.probe_failures = max(1, int(probe_failures))
+        self.max_inflight = int(max_inflight)
+        self.max_body_bytes = int(max_body_bytes)
+        self.request_timeout = float(request_timeout)
+        self.retry_tries = max(1, int(retry_tries))
+        self.retry_base_delay = float(retry_base_delay)
+        self.canary_requests = max(1, int(canary_requests))
+        self.canary_max_errors = int(canary_max_errors)
+        self.canary_timeout_s = float(canary_timeout_s)
+        self.admin_timeout = float(admin_timeout)
+        self.manage_swaps = bool(manage_swaps)
+        self.verbose = bool(verbose)
+
+        self._lock = _conc.Lock(name="fleet.router")
+        self._replicas: Dict[str, ReplicaInfo] = {}
+        self._deny: Dict[str, float] = {}
+        self._probe_fail: Dict[str, int] = {}
+        self._inflight = 0
+        self._inflight_by: Dict[str, int] = {}
+        self._registry_degraded = False
+        self._canary: Optional[dict] = None
+        self._bad_steps: set = set()
+        self._current_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._control: Optional[threading.Thread] = None
+
+        from ..profiler import metrics as _metrics
+        self._m_dispatched = _metrics.counter(
+            "fleet.router.dispatched",
+            "requests the router relayed to a replica (any status)")
+        self._m_shed = _metrics.counter(
+            "fleet.router.shed",
+            "requests shed with 429 at the router's in-flight bound "
+            "(typed backpressure, never an unbounded queue)")
+        self._m_no_replica = _metrics.counter(
+            "fleet.router.no_replica",
+            "requests answered 503: no dispatchable replica")
+        self._m_gave_up = _metrics.counter(
+            "fleet.router.gave_up",
+            "requests whose transport retries exhausted (502)")
+        self._m_denylisted = _metrics.counter(
+            "fleet.router.denylisted",
+            "replicas drained after consecutive probe failures")
+        self._m_readmitted = _metrics.counter(
+            "fleet.router.readmitted",
+            "denylisted replicas readmitted after a probe recovered")
+        self._m_degraded = _metrics.counter(
+            "fleet.registry.degraded",
+            "registry refreshes that fell back to last-known "
+            "membership (store outage)")
+        self._g_replicas = _metrics.gauge(
+            "fleet.router.replicas", "replicas in the router's view")
+        self._g_inflight = _metrics.gauge(
+            "fleet.router.inflight", "requests the router is relaying")
+        _metrics.counter("fleet.router.retry",
+                         "dispatch attempts retried on another replica "
+                         "after a failover-able transport failure")
+
+        # the per-request failover loop: each attempt picks a replica
+        # not yet tried, transport failures (per failover_classify)
+        # back off exponentially and go again, application responses
+        # return straight through
+        self._with_failover = _resilience.retry(
+            retry_on=(OSError, http.client.HTTPException),
+            classify=failover_classify, max_tries=self.retry_tries,
+            base_delay=self.retry_base_delay, max_delay=0.5,
+            deadline=self.request_timeout,
+            metric="fleet.router.retry")
+
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # pragma: no cover
+                if router.verbose:
+                    super().log_message(fmt, *args)
+
+            def do_POST(self):      # noqa: N802
+                router.handle_post(self)
+
+        Handler.do_GET = _router_do_get(router)
+        self._handler_cls = Handler
+
+        class Srv(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def handle_error(self, request, client_address):
+                import sys as _sys
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (ConnectionResetError,
+                                    BrokenPipeError)):
+                    return  # clients hanging up is traffic, not error
+                super().handle_error(request, client_address)
+
+        self._httpd = Srv((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- membership ----------------------------------------------------
+    def _refresh(self):
+        try:
+            fresh = list_replicas(self.store, self.job)
+        except Exception:   # noqa: BLE001 — degrade, never block routing
+            self._m_degraded.inc()
+            with self._lock:
+                self._registry_degraded = True
+            return
+        with self._lock:
+            known = set(self._replicas)
+            joined = sorted(set(fresh) - known)
+            left = sorted(known - set(fresh))
+            self._replicas = fresh
+            self._registry_degraded = False
+            for rid in joined:
+                # a (re)joining replica starts with a clean slate
+                self._deny.pop(rid, None)
+                self._probe_fail.pop(rid, None)
+            for rid in left:
+                self._deny.pop(rid, None)
+                self._probe_fail.pop(rid, None)
+            self._g_replicas.set(len(fresh))
+        for rid in joined:
+            if _flight.active:
+                _flight.note("replica", "join", replica=rid,
+                             endpoint=fresh[rid].endpoint)
+        for rid in left:
+            if _flight.active:
+                _flight.note("replica", "leave", replica=rid,
+                             reason="lease")
+
+    def _probe(self):
+        with self._lock:
+            targets = [(rid, i.endpoint)
+                       for rid, i in self._replicas.items()]
+        for rid, endpoint in targets:
+            if not endpoint:
+                continue
+            ok = False
+            try:
+                h, p = endpoint.rsplit(":", 1)
+                conn = http.client.HTTPConnection(
+                    h, int(p), timeout=self.probe_timeout)
+                try:
+                    conn.request("GET", "/healthz")
+                    resp = conn.getresponse()
+                    resp.read()
+                    ok = resp.status == 200
+                finally:
+                    conn.close()
+            except Exception:   # noqa: BLE001 — a failed probe is data
+                ok = False
+            with self._lock:
+                if ok:
+                    self._probe_fail[rid] = 0
+                    if rid in self._deny:
+                        del self._deny[rid]
+                        self._m_readmitted.inc()
+                        if _flight.active:
+                            _flight.note("replica", "readmit",
+                                         replica=rid)
+                else:
+                    n = self._probe_fail.get(rid, 0) + 1
+                    self._probe_fail[rid] = n
+                    if n >= self.probe_failures and \
+                            rid not in self._deny:
+                        self._deny[rid] = time.time()
+                        self._m_denylisted.inc()
+                        if _flight.active:
+                            _flight.note("replica", "deny",
+                                         replica=rid, probes=n)
+
+    def _dispatchable(self, exclude=()) -> List[ReplicaInfo]:
+        """Ready, non-denylisted replicas, least-loaded first (router
+        in-flight + the replica's own published queue/occupancy)."""
+        with self._lock:
+            infos = list(self._replicas.values())
+            deny = set(self._deny)
+            mine = dict(self._inflight_by)
+        out = []
+        for i in infos:
+            if i.replica_id in deny or i.replica_id in exclude:
+                continue
+            if not i.ready or not i.endpoint:
+                continue
+            out.append((mine.get(i.replica_id, 0) + i.load(),
+                        i.replica_id, i))
+        out.sort(key=lambda x: (x[0], x[1]))
+        return [x[2] for x in out]
+
+    def _pick(self, tried: set) -> ReplicaInfo:
+        cands = self._dispatchable(exclude=tried)
+        if not cands:
+            # everything tried already: allow another pass (backoff
+            # happened between attempts; a restarted replica may be
+            # back) before giving up entirely
+            cands = self._dispatchable()
+        if not cands:
+            raise NoReplicaAvailable(
+                f"no dispatchable replica for job {self.job!r} "
+                f"({len(self._replicas)} known, "
+                f"{len(self._deny)} denylisted)")
+        return cands[0]
+
+    # -- canary / promote / rollback -----------------------------------
+    def _admin_swap(self, info: ReplicaInfo, step: int) -> dict:
+        h, p = info.endpoint.rsplit(":", 1)
+        conn = http.client.HTTPConnection(h, int(p),
+                                          timeout=self.admin_timeout)
+        try:
+            body = json.dumps({"step": int(step)}).encode()
+            conn.request("POST", "/admin/swap", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+        finally:
+            conn.close()
+        try:
+            doc = json.loads(data.decode() or "{}")
+        except ValueError:
+            doc = {}
+        doc["_status"] = resp.status
+        return doc
+
+    def _canary_note(self, rid: str, ok: bool):
+        """Count one completed request against the open canary window
+        (only traffic that landed on the canary replica counts)."""
+        with self._lock:
+            c = self._canary
+            if c is not None and c["replica"] == rid:
+                c["ok" if ok else "err"] += 1
+
+    def _canary_tick(self):
+        cands = self._dispatchable()
+        if not cands:
+            return
+        with self._lock:
+            c = self._canary
+        if c is None:
+            steps = [i.available_step for i in cands
+                     if i.available_step is not None]
+            if not steps:
+                return
+            target = max(int(s) for s in steps)
+            if target in self._bad_steps:
+                return
+            if all(i.weights_step == target for i in cands):
+                with self._lock:
+                    self._current_step = target   # already converged
+                return
+            if target == self._current_step:
+                # promoted already: heal stragglers/late joiners
+                # directly, no second canary for a proven step
+                for i in cands:
+                    if i.weights_step != target and \
+                            (i.available_step or 0) >= target:
+                        self._swap_or_note(i, target, phase="converge")
+                return
+            canary = next((i for i in cands
+                           if (i.available_step or 0) >= target
+                           and i.weights_step != target), None)
+            if canary is None:
+                return
+            doc = self._swap_or_note(canary, target, phase="canary")
+            if doc is None:
+                return
+            with self._lock:
+                self._canary = {
+                    "step": target, "replica": canary.replica_id,
+                    "prev": doc.get("previous"), "ok": 0, "err": 0,
+                    "t0": time.monotonic()}
+            if _flight.active:
+                _flight.note("swap", "canary", step=target,
+                             replica=canary.replica_id)
+            return
+        # a canary window is open: judge it
+        if c["replica"] not in {i.replica_id for i in cands}:
+            # the canary vanished mid-window (killed, denylisted, lease
+            # expired): no verdict either way — close the window without
+            # blacklisting so a surviving replica can retry the step
+            self._abort_canary(c, reason="canary_lost", rpc=False)
+            return
+        done = (c["ok"] + c["err"]) >= self.canary_requests
+        expired = time.monotonic() - c["t0"] > self.canary_timeout_s
+        if c["err"] > self.canary_max_errors or (expired and c["err"]):
+            self._rollback(c)
+        elif done or (expired and c["ok"]):
+            self._promote(c, cands)
+        elif expired:
+            # window expired with ZERO samples: no evidence to promote
+            # on — return the canary to the previous step and retry the
+            # rollout when there is traffic to judge by
+            self._abort_canary(c, reason="no_traffic")
+
+    def _swap_or_note(self, info: ReplicaInfo, step: int, *,
+                      phase: str) -> Optional[dict]:
+        """One /admin/swap RPC with the failure policy attached: a
+        corrupt-verdict (409) blacklists the step, transport failures
+        leave it retryable next tick."""
+        try:
+            doc = self._admin_swap(info, step)
+        except Exception as e:  # noqa: BLE001 — control plane is retried
+            if _flight.active:
+                _flight.note("swap", "abort", step=step, phase=phase,
+                             replica=info.replica_id,
+                             error=f"{type(e).__name__}: {e}")
+            return None
+        if doc.get("_status") != 200:
+            if doc.get("reason") == "corrupt":
+                self._bad_steps.add(step)
+            if _flight.active:
+                _flight.note("swap", "abort", step=step, phase=phase,
+                             replica=info.replica_id,
+                             error=doc.get("error"))
+            return None
+        return doc
+
+    def _promote(self, c: dict, cands: List[ReplicaInfo]):
+        step = c["step"]
+        promoted = 0
+        for i in cands:
+            if i.replica_id == c["replica"] or i.weights_step == step:
+                continue
+            if self._swap_or_note(i, step, phase="promote") is not None:
+                promoted += 1
+        with self._lock:
+            self._canary = None
+            self._current_step = step
+        from ..profiler import metrics as _metrics
+        _metrics.counter(
+            "fleet.swap.promoted",
+            "fleet-wide weight promotions after a clean canary "
+            "window").inc()
+        if _flight.active:
+            _flight.note("swap", "promote", step=step,
+                         replicas=promoted, canary=c["replica"],
+                         window_ok=c["ok"], window_err=c["err"])
+
+    def _abort_canary(self, c: dict, *, reason: str, rpc: bool = True):
+        """Close a canary window that produced no verdict (no traffic,
+        or the canary itself vanished).  Unlike :meth:`_rollback` the
+        step is NOT blacklisted — nothing proved it bad."""
+        with self._lock:
+            info = next((i for i in self._replicas.values()
+                         if i.replica_id == c["replica"]), None)
+            self._canary = None
+        if rpc and info is not None and c.get("prev") is not None:
+            self._swap_or_note(info, c["prev"], phase="abort")
+        if _flight.active:
+            _flight.note("swap", "abort", step=c["step"],
+                         phase="window", replica=c["replica"],
+                         reason=reason)
+        warnings.warn(
+            f"fleet router: canary window on step {c['step']} closed "
+            f"without a verdict ({reason}); will retry", RuntimeWarning)
+
+    def _rollback(self, c: dict):
+        step, prev = c["step"], c.get("prev")
+        self._bad_steps.add(step)
+        with self._lock:
+            info = next((i for i in self._replicas.values()
+                         if i.replica_id == c["replica"]), None)
+            self._canary = None
+            if prev is None:
+                # a canary that had no step of its own before the swap
+                # (fresh replica) reverts to the fleet's last promoted
+                # step — never strand it alone on a blacklisted tree
+                prev = self._current_step
+        if info is not None and prev is not None:
+            self._swap_or_note(info, prev, phase="rollback")
+        from ..profiler import metrics as _metrics
+        _metrics.counter(
+            "fleet.swap.rolled_back",
+            "canary windows that failed: step blacklisted, canary "
+            "returned to the previous weights").inc()
+        if _flight.active:
+            _flight.note("swap", "rollback", step=step,
+                         replica=c["replica"], to_step=prev,
+                         window_ok=c["ok"], window_err=c["err"])
+        warnings.warn(
+            f"fleet router: canary on step {step} failed "
+            f"({c['err']} error(s) in {c['ok'] + c['err']} requests); "
+            f"rolled back to step {prev} and blacklisted {step}",
+            RuntimeWarning)
+
+    # -- control loop --------------------------------------------------
+    def _control_loop(self):
+        last_probe = 0.0
+        while not self._stop.wait(self.refresh_interval):
+            try:
+                self._refresh()
+                now = time.monotonic()
+                if now - last_probe >= self.probe_interval:
+                    last_probe = now
+                    self._probe()
+                if self.manage_swaps:
+                    self._canary_tick()
+            except Exception as e:  # noqa: BLE001 — router must survive
+                warnings.warn(f"fleet router control loop error "
+                              f"({e!r})", RuntimeWarning)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "FleetRouter":
+        self._refresh()     # first view before the first request
+        self._control = _conc.spawn(self._control_loop,
+                                    name="fleet-router-control")
+        self._thread = _conc.spawn(self._httpd.serve_forever,
+                                   name="fleet-router-http")
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._control is not None:
+            self._control.join(timeout=5)
+            self._control = None
+        if self._thread is not None:
+            # shutdown() blocks on serve_forever's loop — only valid
+            # when the HTTP thread actually runs
+            self._httpd.shutdown()
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- request handling ----------------------------------------------
+    def health(self) -> dict:
+        with self._lock:
+            reps = {rid: {"endpoint": i.endpoint, "ready": i.ready,
+                          "queue_depth": i.queue_depth,
+                          "occupancy": i.occupancy,
+                          "weights_step": i.weights_step,
+                          "available_step": i.available_step,
+                          "denylisted": rid in self._deny,
+                          "inflight": self._inflight_by.get(rid, 0)}
+                    for rid, i in self._replicas.items()}
+            canary = dict(self._canary) if self._canary else None
+            return {"status": "ok", "role": "router", "job": self.job,
+                    "replicas": reps,
+                    "dispatchable": sum(
+                        1 for rid, d in reps.items()
+                        if d["ready"] and not d["denylisted"]),
+                    "inflight": self._inflight,
+                    "registry_degraded": self._registry_degraded,
+                    "current_step": self._current_step,
+                    "canary": canary,
+                    "bad_steps": sorted(self._bad_steps)}
+
+    @staticmethod
+    def _send_json(h, code: int, obj, retry_after: Optional[str] = None,
+                   replica: Optional[str] = None):
+        data = json.dumps(obj).encode()
+        try:
+            h.send_response(code)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Content-Length", str(len(data)))
+            if retry_after is not None:
+                h.send_header("Retry-After", retry_after)
+            if replica is not None:
+                h.send_header("X-Fleet-Replica", replica)
+            rid = h.headers.get("X-Request-Id")
+            if rid:
+                h.send_header("X-Request-Id", rid)
+            h.end_headers()
+            h.wfile.write(data)
+        except OSError:
+            pass            # client gone; nothing to salvage
+
+    _FORWARD_HEADERS = ("Content-Type", "X-Request-Id", "traceparent",
+                        "X-Deadline-Ms")
+
+    def handle_post(self, h):
+        if h.path not in ("/v1/infer", "/infer", "/v1/generate",
+                          "/generate"):
+            self._send_json(h, 404, {"error": f"no route {h.path}"})
+            return
+        length = int(h.headers.get("Content-Length") or 0)
+        if self.max_body_bytes and length > self.max_body_bytes:
+            # the oversized body is deliberately left unread (buffering
+            # it would defeat the cap) — drop the keep-alive connection
+            # so the unread bytes can't be parsed as the next request
+            h.close_connection = True
+            self._send_json(h, 413, {
+                "error": f"request body {length} bytes exceeds the "
+                f"router cap {self.max_body_bytes}",
+                "reason": "body_too_large"})
+            return
+        body = h.rfile.read(length)
+        stream = False
+        if h.path in ("/v1/generate", "/generate"):
+            try:
+                stream = bool(json.loads(body.decode() or "{}")
+                              .get("stream", False))
+            except Exception:   # noqa: BLE001 — replica answers the 400
+                stream = False
+        # router admission: shed with a TYPED 429 + Retry-After before
+        # queueing unboundedly — the same honesty contract as engine
+        # admission, one tier up
+        with self._lock:
+            if self._inflight >= self.max_inflight:
+                self._m_shed.inc()
+                shed = True
+            else:
+                self._inflight += 1
+                self._g_inflight.set(self._inflight)
+                shed = False
+        if shed:
+            self._send_json(h, 429, {
+                "error": f"router at max_inflight="
+                f"{self.max_inflight}; retry with backoff",
+                "reason": "router_overload"}, retry_after="1")
+            return
+        try:
+            self._dispatch(h, h.path, body, stream)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._g_inflight.set(self._inflight)
+
+    def _dispatch(self, h, path: str, body: bytes, stream: bool):
+        headers = {k: h.headers[k] for k in self._FORWARD_HEADERS
+                   if h.headers.get(k) is not None}
+        headers["Content-Length"] = str(len(body))
+        tried: set = set()
+        # SSE splice cursor: token events the client already has; a
+        # failed-over stream re-issues the request (seed-deterministic)
+        # and skips past them
+        state = {"delivered": 0, "headers_sent": False,
+                 "terminal": False}
+        last = {"rid": None}
+
+        def attempt():
+            info = self._pick(tried)
+            rid = info.replica_id
+            tried.add(rid)
+            last["rid"] = rid
+            with self._lock:
+                self._inflight_by[rid] = \
+                    self._inflight_by.get(rid, 0) + 1
+            try:
+                if stream:
+                    return rid, self._forward_stream(h, info, path,
+                                                     body, headers,
+                                                     state)
+                return rid, self._forward_plain(h, info, path, body,
+                                                headers)
+            finally:
+                with self._lock:
+                    n = self._inflight_by.get(rid, 1) - 1
+                    if n <= 0:
+                        self._inflight_by.pop(rid, None)
+                    else:
+                        self._inflight_by[rid] = n
+
+        try:
+            rid, status = self._with_failover(attempt)()
+        except NoReplicaAvailable as e:
+            self._m_no_replica.inc()
+            if stream and state["headers_sent"]:
+                # the 200 + chunked headers already went out: a second
+                # status line would corrupt the stream — the terminal
+                # event is the only honest channel left
+                try:
+                    self._sse_emit(h, json.dumps(
+                        {"error": str(e), "reason": "no_replica"}))
+                    self._sse_end(h)
+                except OSError:
+                    pass
+            else:
+                self._send_json(h, 503, {"error": str(e),
+                                         "reason": "no_replica"},
+                                retry_after="2")
+            return
+        except _ClientGone:
+            return
+        except Exception as e:  # noqa: BLE001 — transport retries spent
+            self._m_gave_up.inc()
+            self._canary_note(last["rid"], ok=False)
+            if stream and state["headers_sent"]:
+                # the 200 already went out: surface as a terminal event
+                try:
+                    self._sse_emit(h, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}",
+                         "reason": "fleet_exhausted"}))
+                    self._sse_end(h)
+                except OSError:
+                    pass
+            else:
+                self._send_json(h, 502, {
+                    "error": f"every dispatch attempt failed "
+                    f"(last: {type(e).__name__}: {e})",
+                    "reason": "fleet_exhausted"}, retry_after="2")
+            return
+        self._m_dispatched.inc()
+        # canary accounting: 2xx is a clean sample, a 5xx on the NEW
+        # weights is exactly what the window exists to catch (4xx is
+        # the client's fault, not the weights')
+        if status is not None:
+            self._canary_note(rid, ok=status < 500)
+
+    # -- plain (non-streaming) forward ---------------------------------
+    def _forward_plain(self, h, info: ReplicaInfo, path: str,
+                       body: bytes, headers: dict) -> int:
+        if _chaos.active:
+            _chaos.hit("router.dispatch", exc=ConnectionResetError)
+        host, port = info.endpoint.rsplit(":", 1)
+        conn = http.client.HTTPConnection(
+            host, int(port), timeout=self.request_timeout)
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            status = resp.status
+            ctype = resp.getheader("Content-Type") or "application/json"
+        finally:
+            conn.close()
+        if status == 503:
+            # the replica is draining/closed — failover-able by
+            # classification; any OTHER application status is final
+            raise _ReplicaUnavailable(
+                f"replica {info.replica_id} answered 503")
+        try:
+            h.send_response(status)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Content-Length", str(len(data)))
+            h.send_header("X-Fleet-Replica", info.replica_id)
+            h.end_headers()
+            h.wfile.write(data)
+        except OSError as e:
+            raise _ClientGone() from e
+        return status
+
+    # -- SSE (streaming) forward with mid-stream failover --------------
+    def _sse_headers(self, h, info: ReplicaInfo):
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.send_header("X-Fleet-Replica", info.replica_id)
+        h.end_headers()
+
+    @staticmethod
+    def _sse_emit(h, payload: str):
+        data = f"data: {payload}\n\n".encode()
+        h.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        h.wfile.flush()
+
+    @staticmethod
+    def _sse_end(h):
+        h.wfile.write(b"0\r\n\r\n")
+
+    def _forward_stream(self, h, info: ReplicaInfo, path: str,
+                        body: bytes, headers: dict,
+                        state: dict) -> Optional[int]:
+        if _chaos.active:
+            _chaos.hit("router.dispatch", exc=ConnectionResetError)
+        host, port = info.endpoint.rsplit(":", 1)
+        conn = http.client.HTTPConnection(
+            host, int(port), timeout=self.request_timeout)
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            if resp.status == 503:
+                resp.read()
+                raise _ReplicaUnavailable(
+                    f"replica {info.replica_id} answered 503")
+            if resp.status != 200:
+                data = resp.read()
+                if not state["headers_sent"]:
+                    # pre-stream rejection: relay verbatim
+                    try:
+                        h.send_response(resp.status)
+                        ctype = resp.getheader("Content-Type") \
+                            or "application/json"
+                        h.send_header("Content-Type", ctype)
+                        h.send_header("Content-Length", str(len(data)))
+                        h.send_header("X-Fleet-Replica",
+                                      info.replica_id)
+                        h.end_headers()
+                        h.wfile.write(data)
+                    except OSError as e:
+                        raise _ClientGone() from e
+                    return resp.status
+                # mid-retry rejection with the 200 long gone: terminal
+                # error event is the only honest channel left
+                try:
+                    self._sse_emit(h, json.dumps(
+                        {"error": f"retry got HTTP {resp.status}",
+                         "status": resp.status}))
+                    self._sse_end(h)
+                except OSError as e:
+                    raise _ClientGone() from e
+                state["terminal"] = True
+                return 500
+            if not state["headers_sent"]:
+                try:
+                    self._sse_headers(h, info)
+                except OSError as e:
+                    raise _ClientGone() from e
+                state["headers_sent"] = True
+            status = self._relay_sse(h, resp, state)
+            if not state["terminal"]:
+                # upstream closed cleanly but never sent done/error:
+                # treat as a dead replica mid-stream
+                raise ConnectionResetError(
+                    f"replica {info.replica_id} ended the stream "
+                    "without a terminal event")
+            try:
+                self._sse_end(h)
+            except OSError:
+                pass
+            return status
+        finally:
+            conn.close()
+
+    def _relay_sse(self, h, resp, state: dict) -> int:
+        """Relay SSE events; skip token events the client already has
+        (the failover splice), stop at the terminal event.  Returns the
+        effective status (200, or 500 when the terminal event was an
+        error)."""
+        status = 200
+        buf: List[bytes] = []
+        while True:
+            line = resp.readline()
+            if not line:
+                return status          # EOF — caller decides
+            if line.strip():
+                buf.append(line)
+                continue
+            event = b"".join(buf)
+            buf = []
+            if not event.strip():
+                continue
+            payload = event.split(b"data:", 1)
+            text = payload[1].strip().decode() if len(payload) == 2 \
+                else ""
+            try:
+                doc = json.loads(text) if text else {}
+            except ValueError:
+                doc = {}
+            if "token" in doc:
+                idx = int(doc.get("index", state["delivered"]))
+                if idx < state["delivered"]:
+                    continue           # splice: client already has it
+                try:
+                    self._sse_emit(h, text)
+                except OSError as e:
+                    raise _ClientGone() from e
+                state["delivered"] += 1
+                continue
+            # terminal: done or error
+            state["terminal"] = True
+            if "error" in doc:
+                status = 500
+            try:
+                self._sse_emit(h, text)
+            except OSError as e:
+                raise _ClientGone() from e
+            return status
+
+
+def _router_do_get(router):
+    """The router's GET handler, bound late so the metrics import is
+    module-correct regardless of how the Handler class was nested."""
+    def do_GET(self):               # noqa: N802
+        if self.path == "/healthz":
+            router._send_json(self, 200, router.health())
+        elif self.path == "/metrics":
+            from ..profiler import metrics as _m
+            data = _m.prometheus_text().encode()
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except OSError:
+                pass
+        else:
+            router._send_json(self, 404, {
+                "error": f"no route {self.path}; the router serves "
+                "/v1/infer, /v1/generate, /healthz, /metrics"})
+    return do_GET

@@ -22,7 +22,14 @@ stream).  Endpoints:
                       after streaming began arrive as a terminal
                       {"error": ...} event (the status line already
                       went out).
-    GET  /healthz     liveness + pool/queue snapshot (JSON)
+    GET  /healthz     liveness + pool/queue snapshot (JSON).  The
+                      ``ready`` field distinguishes *dispatchable*
+                      from merely alive: false while warmup buckets
+                      are still compiling (and after close) — fleet
+                      routers skip not-ready replicas
+    POST /admin/swap  fleet control plane (bound only by
+                      fleet.FleetReplica): verify + hot-swap weights
+                      to a checkpoint step
     GET  /metrics     Prometheus text exposition of the whole profiler
                       metrics registry (PR 1 exporter)
 
@@ -34,6 +41,7 @@ from __future__ import annotations
 import io
 import json
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -69,7 +77,12 @@ class _Handler(BaseHTTPRequestHandler):
         when absent) and build the rtrace TraceContext from the W3C
         ``traceparent`` header.  Both are echoed on every response —
         including SSE terminal events and error payloads — so a client
-        can always join its logs to the server's trace."""
+        can always join its logs to the server's trace.  Also counts
+        the request into the server's in-flight tally, which the
+        graceful-drain path (``ServingServer.stop``) waits on."""
+        srv = self.server
+        with srv._drain_cond:
+            srv._active_requests += 1
         rid = self.headers.get("X-Request-Id") or uuid.uuid4().hex
         self._request_id = rid
         self._obs_headers = {"X-Request-Id": rid}
@@ -90,7 +103,12 @@ class _Handler(BaseHTTPRequestHandler):
     def _end_request(self):
         """Close the request's server-side spans: ``egress`` (first
         response byte -> done) and the ``ingress`` root (header parse
-        -> done, parented to the client's traceparent span)."""
+        -> done, parented to the client's traceparent span) — and
+        release the in-flight drain tally."""
+        srv = self.server
+        with srv._drain_cond:
+            srv._active_requests -= 1
+            srv._drain_cond.notify_all()
         if not getattr(self, "_traced", False):
             return
         t1 = _tracer.now_ns()
@@ -140,8 +158,23 @@ class _Handler(BaseHTTPRequestHandler):
             depth = _metrics.get(
                 getattr(engine, "metrics_prefix", "serving")
                 + ".queue_depth")
-            body = {"status": "ok",
+            # alive != dispatchable: ``ready`` stays false while an
+            # engine is still compiling warmup buckets (or draining at
+            # shutdown) — a router must not dispatch into cold
+            # compiles, so it treats not-ready replicas as
+            # undispatchable while this endpoint keeps answering 200
+            ready = all(
+                getattr(e, "ready", True)
+                for e in (self.server.engine,
+                          self.server.generation_engine)
+                if e is not None)
+            body = {"status": "ok", "ready": ready,
                     "queue_depth": depth.value if depth else 0}
+            admin = getattr(self.server, "fleet_admin", None)
+            if admin is not None:
+                # fleet replica: weight provenance rides /healthz so
+                # the router's canary controller needs no extra RPC
+                body.update(admin.health_fields())
             if self.server.engine is not None:
                 e = self.server.engine
                 body.update(model_inputs=e.input_names,
@@ -197,6 +230,9 @@ class _Handler(BaseHTTPRequestHandler):
     def _do_post(self):
         if self.path in ("/v1/generate", "/generate"):
             self._do_generate()
+            return
+        if self.path.startswith("/admin/"):
+            self._do_admin()
             return
         if self.path not in ("/v1/infer", "/infer"):
             self._send_json(404, {"error": f"no route {self.path}"})
@@ -257,6 +293,30 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(200, {"outputs": dict(zip(names, outs))})
 
+    def _do_admin(self):
+        """Fleet control plane (``/admin/swap``): available only when a
+        fleet admin object (a ``serving.fleet.FleetReplica``) is bound.
+        The router drives the canary/promote/rollback flow through
+        this endpoint; it is NOT exposed by a bare ServingServer."""
+        admin = getattr(self.server, "fleet_admin", None)
+        if admin is None:
+            self._send_json(404, {"error": "no fleet admin bound "
+                                  "(serve via fleet.FleetReplica)"})
+            return
+        try:
+            body = self._read_body()
+            if body is None:
+                return
+            payload = json.loads(body.decode() or "{}")
+        except Exception as e:
+            self._send_json(400, {"error": f"malformed payload: {e}"})
+            return
+        try:
+            code, obj = admin.admin_request(self.path, payload)
+        except Exception as e:   # noqa: BLE001 — control plane must answer
+            code, obj = 500, {"error": f"{type(e).__name__}: {e}"}
+        self._send_json(code, obj)
+
     def _read_body(self):
         """Read the request body under the server byte cap.  Returns
         the bytes, or None after answering 413 — shed, don't OOM: the
@@ -265,6 +325,10 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         cap = getattr(self.server, "max_body_bytes", 0)
         if cap and length > cap:
+            # the body stays unread by design; close the keep-alive
+            # connection so the leftover bytes can't be misparsed as
+            # the next pipelined request
+            self.close_connection = True
             self._send_json(413, {
                 "error": f"request body {length} bytes exceeds the "
                          f"server cap {cap}",
@@ -392,13 +456,18 @@ class ServingServer:
     """Owns a ThreadingHTTPServer bound to ``engine``.
 
     ``start()`` serves on a daemon thread and returns; ``stop()`` shuts
-    the listener down (the engine itself is NOT closed — callers own its
-    lifecycle, so one engine can outlive server restarts)."""
+    the listener down *gracefully* — stop accepting, drain in-flight
+    requests (including active SSE streams), deregister the replica
+    lease when a ``registry`` is attached — but the engine itself is
+    NOT closed (callers own its lifecycle, so one engine can outlive
+    server restarts).  The ordering contract is that once ``stop``
+    returns, closing the engine cannot race a streaming handler."""
 
     def __init__(self, engine=None, host: str = "127.0.0.1",
                  port: int = 0, verbose: bool = False,
                  max_body_bytes: int = 64 << 20,
-                 generation_engine=None):
+                 generation_engine=None, registry=None,
+                 fleet_admin=None):
         from .engine import GenerationEngine
         if generation_engine is None and isinstance(engine,
                                                     GenerationEngine):
@@ -407,12 +476,16 @@ class ServingServer:
             raise ValueError("bind at least one engine")
         self.engine = engine
         self.generation_engine = generation_engine
+        self.registry = registry
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.engine = engine
         self._httpd.generation_engine = generation_engine
         self._httpd.verbose = verbose
         self._httpd.max_body_bytes = int(max_body_bytes)
         self._httpd.daemon_threads = True
+        self._httpd.fleet_admin = fleet_admin
+        self._httpd._active_requests = 0
+        self._httpd._drain_cond = threading.Condition()
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
 
@@ -423,8 +496,44 @@ class ServingServer:
         self._thread.start()
         return self
 
-    def stop(self):
+    @property
+    def active_requests(self) -> int:
+        return self._httpd._active_requests
+
+    def stop(self, drain_s: float = 30.0):
+        """Graceful shutdown: (1) stop accepting new connections,
+        (2) let every in-flight handler — including mid-stream SSE
+        responses — run to completion, bounded by ``drain_s`` seconds,
+        (3) deregister the replica lease so the router stops routing
+        here, (4) release the socket.  Only THEN is it safe for the
+        owner to close the engine: the old ``shutdown-then-close``
+        sequence could yank the engine out from under an active
+        streaming handler mid-token.  ``drain_s=0`` restores the
+        immediate (non-draining) behavior."""
         self._httpd.shutdown()
+        if drain_s and drain_s > 0:
+            deadline = time.monotonic() + drain_s
+            with self._httpd._drain_cond:
+                while self._httpd._active_requests > 0:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        import warnings
+                        warnings.warn(
+                            f"ServingServer.stop: "
+                            f"{self._httpd._active_requests} request(s)"
+                            f" still in flight after the {drain_s}s "
+                            "drain window; shutting down anyway",
+                            RuntimeWarning)
+                        break
+                    self._httpd._drain_cond.wait(timeout=remaining)
+        if self.registry is not None:
+            try:
+                self.registry.deregister()
+            except Exception as e:  # noqa: BLE001 — lease TTL covers it
+                import warnings
+                warnings.warn(f"ServingServer.stop: lease deregister "
+                              f"failed ({e!r}); the TTL will expire it",
+                              RuntimeWarning)
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=10)

@@ -42,6 +42,13 @@ matching the PR-1 instrumentation discipline)::
                      injects pool exhaustion — the engine must shed the
                      request with RequestRejected(reason="kv_blocks"),
                      never corrupt a live batch)
+    router.dispatch  serving fleet router forward hop (``fail`` kills
+                     one proxied dispatch as a connection reset — the
+                     router must fail over to another replica; the
+                     fleet gate kills exact request indices this way)
+    fleet.lease      serving replica-registry lease publish (``fail``
+                     drops heartbeat puts so a replica's TTL lease
+                     expires — membership loss without process loss)
 
 Injections are counted in the metrics registry: ``chaos.injected``
 (total) and ``chaos.injected.<site>``.
@@ -60,7 +67,7 @@ __all__ = ["active", "ChaosError", "SITES", "parse_spec", "configure",
 
 SITES = ("ckpt.write", "store.rpc", "store.partition", "fs.rename",
          "loader.worker", "step.loss", "host.slow", "serve.request",
-         "kv.block_alloc")
+         "kv.block_alloc", "router.dispatch", "fleet.lease")
 
 # module-level fast predicate — the single read hot paths gate on
 active = False

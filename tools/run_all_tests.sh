@@ -91,6 +91,17 @@ python tools/kernel_gate.py
 # compile-bound assertions must still hold with the sanitizer in the
 # lock path.
 python tools/conc_gate.py
+# Fleet gate (ISSUE 13 serving fabric): a 2-replica fleet + failover
+# router must survive a chaos-injected dispatch-hop kill (exactly 1
+# injection, exactly 1 failover retry, 5/5 bit-exact), shed with typed
+# 429+Retry-After at the in-flight bound (exact count), hot-swap a new
+# sha256-verified checkpoint mid-traffic via canary-then-promote with
+# zero dropped streams and served bytes flipped to the new weights,
+# re-spread a SIGKILLed replica's traffic with zero lost requests
+# (SSE splice, bit-exact vs single-engine references), and drain the
+# survivor cleanly — replica.join/leave + swap.* flight events at
+# exact counts.
+python tools/fleet_gate.py
 # Observability gate (request tracing / fleet rollup / flight recorder):
 # a traced HTTP generation request must echo its traceparent trace_id
 # and export a complete ingress->admission->queue->prefill->decode->
