@@ -27,6 +27,10 @@ SERVE = os.environ.get("BENCH_SERVE", "") not in ("", "0")
 # BENCH_INT8=1: serving leg comparing the int8 artifact path against
 # fp32 — latency + top-1 agreement through the same InferenceEngine.
 INT8 = os.environ.get("BENCH_INT8", "") not in ("", "0")
+# BENCH_PS=1: sharded parameter-server leg — lookups/s, pull-latency
+# p50/p99, device-cache hit rate, and recovery-after-host-loss seconds
+# through the replicated failover path.
+PS = os.environ.get("BENCH_PS", "") not in ("", "0")
 
 
 def _metrics_snapshot():
@@ -223,6 +227,11 @@ def main():
             result["serving_int8"] = bench_int8(on_tpu)
         except Exception as e:
             print(f"bench: int8 leg failed: {e!r}", file=sys.stderr)
+    if PS:
+        try:
+            result["ps"] = bench_ps()
+        except Exception as e:
+            print(f"bench: ps leg failed: {e!r}", file=sys.stderr)
     if SERVE:
         try:
             result["serving"] = bench_serving(on_tpu)
@@ -240,6 +249,89 @@ def main():
             # across ALL legs — a warm relaunch shows misses == 0
             result["compile_cache"]["artifact_store"] = store
     print(json.dumps(result))
+
+
+def bench_ps():
+    """BENCH_PS=1: sharded embedding PS under a skewed lookup/update
+    workload — 2 replicated shards, a HeterCache in front (the hot-row
+    tier), a mid-run primary SIGKILL-analog measuring time-to-recovery
+    through the failover path.  Reports lookups/s, pull p50/p99 ms,
+    cache hit rate, and recovery-after-host-loss seconds (ROADMAP item
+    4's bench contract)."""
+    import socket
+    import time
+    import numpy as np
+    from paddle_tpu.distributed.fleet import HeterCache
+    from paddle_tpu.distributed.fleet.ps import PSClient, PSServer
+    from paddle_tpu.profiler import metrics as pm
+
+    def ep():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return f"127.0.0.1:{port}"
+
+    n_shards, dim, batch, n_batches = 2, 16, 256, 60
+    keyspace = 100_000
+    pris, reps = [ep() for _ in range(n_shards)], \
+        [ep() for _ in range(n_shards)]
+    rsrvs = [PSServer(r, shard_id=i, role="replica")
+             for i, r in enumerate(reps)]
+    psrvs = [PSServer(p, shard_id=i, replicate_to=reps[i])
+             for i, p in enumerate(pris)]
+    cli = None
+    try:
+        for s in rsrvs + psrvs:
+            s.add_sparse_table("emb", dim, seed=0)
+            s.start()
+        cli = PSClient(pris, replicas=reps, timeout=5.0, max_tries=2)
+        cache = HeterCache(cli, embedding_dim=dim, cache_rows=4096)
+        rng = np.random.RandomState(0)
+        # zipf-ish skew: hot head + uniform tail, like real id traffic
+        hot = rng.randint(0, 2048, (n_batches, batch // 2))
+        cold = rng.randint(0, keyspace, (n_batches, batch - batch // 2))
+        batches = np.concatenate([hot, cold], axis=1).astype(np.int64)
+        cache.pull_sparse("emb", batches[0])      # warm connections
+        hist0 = pm.histogram("ps.pull.ms").count
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            cache.pull_sparse("emb", batches[i])
+            if i % 4 == 0:
+                cli.push_sparse("emb", batches[i][:64],
+                                np.ones((64, dim), np.float32) * 1e-3)
+        dt = time.perf_counter() - t0
+        hist = pm.histogram("ps.pull.ms")
+        lookups = n_batches * batch
+        # host loss: flush the staleness window, stop primary 0, and
+        # measure time until shard-0 keys serve again via the replica
+        cli.flush_replication(10.0)
+        shard0_keys = np.arange(0, 2 * n_shards, n_shards,
+                                dtype=np.int64)
+        psrvs[0].stop()
+        t0 = time.perf_counter()
+        cli.pull_sparse("emb", shard0_keys)
+        recovery_s = time.perf_counter() - t0
+        return {
+            "shards": n_shards,
+            "replicated": True,
+            "lookups_per_s": round(lookups / dt, 1),
+            "pull_p50_ms": round(hist.percentile(50) or 0.0, 3),
+            "pull_p99_ms": round(hist.percentile(99) or 0.0, 3),
+            "pull_rpcs": hist.count - hist0,
+            "cache_hit_rate": round(
+                cache.hits / (cache.hits + cache.misses), 3)
+            if (cache.hits + cache.misses) else 0.0,
+            "recovery_after_host_loss_s": round(recovery_s, 3),
+            "failovers": pm.counter("ps.failover").value,
+        }
+    finally:
+        # a failed leg must not leak servers/pools into the other
+        # bench legs measured in this same process
+        if cli is not None:
+            cli.close()
+        for s in psrvs + rsrvs:
+            s.stop()
 
 
 def bench_resnet(on_tpu: bool):

@@ -23,7 +23,6 @@ and pushing gradient updates back through the PS.  Here:
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional
 
 import jax
@@ -33,6 +32,8 @@ import numpy as np
 from ...core import autograd
 from ...core.tensor import Tensor, to_tensor
 from ...nn.layer_base import Layer
+from ...profiler import metrics as _metrics
+from ...utils import concurrency as _conc
 
 __all__ = ["HeterEmbeddingTable", "HeterPSEmbedding", "HeterCache"]
 
@@ -63,7 +64,10 @@ class HeterEmbeddingTable:
         self._freq: Dict[int, int] = {}          # admission counter
         self._tick = 0
         self._admit_after = int(admit_after)
-        self._lock = threading.RLock()
+        # sanitizer factory: the prefetch-vs-lookup-vs-apply_grads lock
+        # joins the conc-san order graph / wait-hold histograms like
+        # every other framework lock
+        self._lock = _conc.RLock(name="heter_ps.table")
         self.hits = 0
         self.misses = 0
         self._prefetch_threads: list = []
@@ -106,8 +110,17 @@ class HeterEmbeddingTable:
             slots = np.asarray([self._slot_of.get(int(u), -1)
                                 for u in uniq])
             hit = slots >= 0
-            self.hits += int(hit.sum())
-            self.misses += int((~hit).sum())
+            nh, nm = int(hit.sum()), int((~hit).sum())
+            self.hits += nh
+            self.misses += nm
+            if nh:
+                _metrics.counter(
+                    "ps.cache.hit", "embedding rows served from the "
+                    "device hot-row cache").inc(nh)
+            if nm:
+                _metrics.counter(
+                    "ps.cache.miss", "embedding rows faulted from the "
+                    "host tier / remote PS").inc(nm)
             n, D = uniq.size, self.embedding_dim
             rows = np.empty((n, D), self.host.dtype)
             if (~hit).any():
@@ -135,8 +148,10 @@ class HeterEmbeddingTable:
             with self._lock:
                 self._admit(flat)
 
-        t = threading.Thread(target=work, daemon=True)
-        t.start()
+        # concurrency.spawn registers the creation site, so the
+        # thread-leak canary and SIGUSR1 dumps can attribute this
+        # worker like every other framework thread
+        t = _conc.spawn(work, name="ps-heter-prefetch")
         # prune finished threads so fire-and-forget callers (who rely on
         # the table lock, never calling wait_prefetch) don't accumulate;
         # under _lock so concurrent prefetch() calls can't lose a thread
@@ -268,8 +283,11 @@ class HeterCache:
             else:
                 out[i] = row
                 self.hits += 1
+        if ids.size > len(missing):
+            _metrics.counter("ps.cache.hit").inc(ids.size - len(missing))
         if missing:
             self.misses += len(missing)
+            _metrics.counter("ps.cache.miss").inc(len(missing))
             pulled = np.asarray(self._comm.pull_sparse(table,
                                                        np.asarray(missing)),
                                 np.float32)

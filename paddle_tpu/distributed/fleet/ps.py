@@ -35,9 +35,24 @@ import numpy as np
 
 from collections import OrderedDict
 
+from ...profiler import flight as _flight
+from ...profiler import metrics as _metrics
+from ...utils import chaos as _chaos
+from ...utils import resilience as _resilience
+from .ps_shard import (PSUnavailableError, ReplicationEngine, ShardView,
+                       dense_shard_of, ps_transient_classify)
+
 __all__ = ["SparseSGDRule", "NaiveSGDRule", "AdagradSGDRule", "DenseTable",
            "SparseTable", "SSDSparseTable", "CTRSparseTable", "GraphTable",
-           "PSServer", "PSClient", "Communicator", "role_from_env"]
+           "PSServer", "PSClient", "Communicator", "role_from_env",
+           "PSUnavailableError"]
+
+# ops that change table state — on a replicated primary these are
+# applied and enqueued to the replica under one critical section so the
+# replica's application order matches the primary's exactly
+_MUTATING_OPS = frozenset({
+    "push_dense", "set_dense", "push_sparse", "push_sparse_ctr",
+    "ctr_shrink", "graph_add_edges", "graph_add_nodes"})
 
 
 # ---------------------------------------------------------------------------
@@ -568,12 +583,26 @@ def _recv_exact(sock, n):
 # ---------------------------------------------------------------------------
 class PSServer:
     """One PS shard (reference brpc_ps_server.h:40).  Hosts the tables
-    whose shard index maps to this server."""
+    whose shard index maps to this server.
 
-    def __init__(self, endpoint: str, shard_id: int = 0):
+    Fault-tolerance surface (ps_shard.py): ``replicate_to=<ep>`` ships
+    every mutating op to a standby replica server (``role="replica"``)
+    on a background thread — bounded-staleness replication with
+    anti-entropy full sync on readmit; ``checkpoint_dir`` +
+    ``checkpoint_interval_s`` commit this shard's tables through the
+    manifest-v2 verified-checkpoint machinery on an interval."""
+
+    def __init__(self, endpoint: str, shard_id: int = 0, *,
+                 replicate_to: Optional[str] = None,
+                 role: str = "primary", n_shards: int = 1,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_interval_s: float = 0.0):
         host, port = endpoint.rsplit(":", 1)
         self._host, self._port = host, int(port)
+        self.endpoint = endpoint
         self.shard_id = int(shard_id)
+        self.role = role
+        self.n_shards = int(n_shards)
         self._tables: Dict[str, object] = {}
         self._barrier_count = 0
         self._barrier_gen = 0
@@ -583,6 +612,15 @@ class PSServer:
         self._thread: Optional[threading.Thread] = None
         self._conns: set = set()
         self._conns_lock = threading.Lock()
+        self._replicate_to = replicate_to
+        self._repl: Optional[ReplicationEngine] = None
+        self._ckpt_dir = checkpoint_dir
+        self._ckpt_interval = float(checkpoint_interval_s)
+        self._ckpt_thread: Optional[threading.Thread] = None
+        self._saves = 0
+        self._stop_evt = threading.Event()
+        self._down = False
+        self._stop_lock = threading.Lock()
 
     def add_dense_table(self, name: str, shape, rule=None):
         self._tables[name] = DenseTable(shape, rule=rule)
@@ -606,6 +644,21 @@ class PSServer:
         self._tables[name] = GraphTable(seed=seed)
 
     def _handle(self, msg):
+        op = msg[0]
+        if op in _MUTATING_OPS and self._repl is not None:
+            # apply + enqueue under one lock: the replica replays in
+            # the exact order the primary applied, and the anti-entropy
+            # snapshot (taken under the same lock) stays atomic
+            with self._repl.exclusion:
+                out = self._apply(msg)
+                self._repl.enqueue(msg)
+            return out
+        return self._apply(msg)
+
+    def _state_snapshot(self) -> Dict[str, object]:
+        return {n: t.state() for n, t in self._tables.items()}
+
+    def _apply(self, msg):
         op = msg[0]
         if op == "pull_dense":
             return self._tables[msg[1]].pull()
@@ -679,10 +732,107 @@ class PSServer:
             for n, st in states.items():
                 if n in self._tables:
                     self._tables[n].load_state(st)
+            if self._repl is not None:   # bulk change: full resync
+                self._repl.mark_dirty()
             return True
         if op == "ping":
             return "pong"
+        # -- replication / failover / shard-checkpoint control ------------
+        if op == "replica_apply":
+            # ordered batch from the primary's replication engine;
+            # applied directly (a replica never re-replicates).  A
+            # PROMOTED replica refuses the stream: after a spurious
+            # failover (slow-but-alive primary) the old primary's
+            # engine must not double-apply its queue on top of the
+            # client's direct writes (split-brain fencing)
+            if self.role != "replica":
+                raise RuntimeError(
+                    f"shard {self.shard_id} is {self.role}, not a "
+                    f"replica — refusing replication stream")
+            for m in msg[1]:
+                self._apply(m)
+            return True
+        if op == "replica_load_full":
+            if self.role != "replica":
+                raise RuntimeError(
+                    f"shard {self.shard_id} is {self.role}, not a "
+                    f"replica — refusing anti-entropy sync")
+            for n, st in msg[1].items():
+                if n in self._tables:
+                    self._tables[n].load_state(st)
+            return True
+        if op == "set_replica":
+            if msg[1] == self.endpoint:
+                # a failover-replayed readmit must never make a shard
+                # replicate to ITSELF — the loopback would double-apply
+                # every subsequent mutation
+                return False
+            self._replicate_to = msg[1]
+            if self._repl is None and msg[1]:
+                self._repl = ReplicationEngine(
+                    self._state_snapshot, None,
+                    name=f"ps-repl-s{self.shard_id}").start()
+            if self._repl is not None:
+                self._repl.set_replica(msg[1])   # dirty: anti-entropy
+            return True
+        if op == "promote":
+            was = self.role
+            self.role = "primary"
+            if was != "primary" and _flight.active:
+                _flight.note("ps", "promote", shard=self.shard_id,
+                             endpoint=self.endpoint)
+            return True
+        if op == "role":
+            return self.role
+        if op == "repl_flush":
+            return self._repl.flush(timeout=msg[1]) \
+                if self._repl is not None else True
+        if op == "repl_stats":
+            return self._repl.stats() if self._repl is not None else {}
+        if op == "save_shard":
+            return self.save_shard(msg[1], step=msg[2],
+                                   n_shards=msg[3])
+        if op == "load_shard_state":
+            for n, st in msg[1].items():
+                if n in self._tables:
+                    self._tables[n].load_state(st)
+            if self._repl is not None:
+                self._repl.mark_dirty()
+            return True
         raise ValueError(f"unknown ps op {op!r}")
+
+    def save_shard(self, root: str, *, step: Optional[int] = None,
+                   n_shards: Optional[int] = None) -> str:
+        """Verified atomic commit of this shard's tables under
+        ``root/shard<id>`` (manifest v2 + ``_PADDLE_COMMITTED``)."""
+        from .ps_shard import save_shard_state
+        states = self._state_snapshot()
+        out = save_shard_state(root, self.shard_id, states,
+                               n_shards=n_shards or self.n_shards,
+                               step=step)
+        self._saves += 1
+        return out
+
+    def _begin_shutdown(self, reason: str):
+        """Take this shard down asynchronously (chaos ``ps.shard_down``
+        injection path): sever clients and stop accepting, so the
+        client-side failover machinery sees a dead primary."""
+        with self._stop_lock:
+            if self._down:
+                return
+            self._down = True
+        if _flight.active:
+            _flight.note("ps", "shard_leave", shard=self.shard_id,
+                         endpoint=self.endpoint, reason=reason)
+        from ...utils import concurrency as _conc
+        _conc.spawn(self.stop, name=f"ps-shard-down-{self.shard_id}")
+
+    def _ckpt_loop(self):
+        while not self._stop_evt.wait(self._ckpt_interval):
+            try:
+                self.save_shard(self._ckpt_dir, step=self._saves)
+            except Exception:   # noqa: BLE001 — an interval save must
+                pass            # never kill the serving shard
 
     def start(self):
         outer = self
@@ -696,6 +846,14 @@ class PSServer:
                         msg = _recv_msg(self.request)
                         if msg is None:
                             return
+                        if _chaos.active:
+                            try:
+                                _chaos.hit("ps.shard_down")
+                            except _chaos.ChaosError:
+                                # simulated shard death: sever without
+                                # replying and stop the listener
+                                outer._begin_shutdown("chaos")
+                                return
                         try:
                             out = ("ok", outer._handle(msg))
                         except Exception as e:  # surface to the client
@@ -708,13 +866,19 @@ class PSServer:
                         outer._conns.discard(self.request)
 
         socketserver.ThreadingTCPServer.allow_reuse_address = True
-        self._server = socketserver.ThreadingTCPServer(
+        server = socketserver.ThreadingTCPServer(
             (self._host, self._port), Handler)
         # stop() must not hang on handler threads parked in recv() on
         # live client connections: don't join them on server_close
         # (reference brpc Stop() aborts in-flight RPCs the same way)
-        self._server.daemon_threads = True
-        self._server.block_on_close = False
+        server.daemon_threads = True
+        server.block_on_close = False
+        with self._stop_lock:   # published under the stop() claim lock
+            self._server = server
+        if self._replicate_to:
+            self._repl = ReplicationEngine(
+                self._state_snapshot, self._replicate_to,
+                name=f"ps-repl-s{self.shard_id}")
         if self._pending_load:
             # restore this shard's tables from a fleet.init_server(path)
             shard_file = os.path.join(self._pending_load,
@@ -722,9 +886,20 @@ class PSServer:
             if os.path.exists(shard_file):
                 self._handle(("load", shard_file))
             self._pending_load = None
+        if self._repl is not None:
+            self._repl.start()
+        if self._ckpt_dir and self._ckpt_interval > 0:
+            from ...utils import concurrency as _conc
+            saver = _conc.spawn(
+                self._ckpt_loop, name=f"ps-ckpt-s{self.shard_id}")
+            with self._stop_lock:
+                self._ckpt_thread = saver
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True)
+            target=server.serve_forever, daemon=True)
         self._thread.start()
+        if _flight.active:
+            _flight.note("ps", "shard_join", shard=self.shard_id,
+                         endpoint=self.endpoint, role=self.role)
         return self
 
     def run(self):
@@ -733,11 +908,22 @@ class PSServer:
         self._thread.join()
 
     def stop(self):
+        self._stop_evt.set()
+        with self._stop_lock:
+            # atomically claim the teardown: chaos shard_down spawns
+            # stop() on a background thread while the owner's cleanup
+            # path calls it too — only one of them may touch _server
+            server, self._server = self._server, None
+            ckpt_thread, self._ckpt_thread = self._ckpt_thread, None
+        if ckpt_thread is not None:
+            ckpt_thread.join(timeout=5)
+        if self._repl is not None:
+            self._repl.stop()
         for t in self._tables.values():
             if hasattr(t, "close"):
                 t.close()   # SSD tables unlink their spill files
-        if self._server is not None:
-            self._server.shutdown()
+        if server is not None:
+            server.shutdown()
             # sever in-flight connections so clients observe the death
             # instead of being served by lingering handler threads
             with self._conns_lock:
@@ -749,8 +935,10 @@ class PSServer:
                 except OSError:
                     pass
                 c.close()
-            self._server.server_close()
-            self._server = None
+            server.server_close()
+            if _flight.active:
+                _flight.note("ps", "shard_leave", shard=self.shard_id,
+                             endpoint=self.endpoint, reason="stop")
 
 
 # ---------------------------------------------------------------------------
@@ -760,23 +948,59 @@ class PSClient:
     """Sync + future-returning async pull/push against a server list
     (reference ps_client.h:62, async futures :107,:209).  Sparse keys
     shard across servers by ``key % n_servers``; dense tables live on
-    ``hash(name) % n_servers``."""
+    ``hash(name) % n_servers``.
+
+    Fault tolerance (ps_shard.py): every RPC rides a bounded
+    transient-error retry (``max_tries`` attempts, classified by
+    :func:`ps_transient_classify`); a shard that stays unreachable
+    surfaces a typed :class:`PSUnavailableError` — and when the shard
+    was deployed with a replica (``replicas=[...]``) the client
+    *promotes* the replica and replays the call there instead, so one
+    SIGKILL costs a bounded blip, not the job."""
 
     def __init__(self, endpoints: List[str], timeout: float = 60.0,
-                 seed: int = 0):
+                 seed: int = 0, replicas: Optional[List[Optional[str]]]
+                 = None, max_tries: int = 3):
         self._endpoints = list(endpoints)
         self._timeout = float(timeout)
+        self._max_tries = max(1, int(max_tries))
+        if replicas is not None and len(replicas) != len(endpoints):
+            raise ValueError(
+                f"replicas must align with endpoints: "
+                f"{len(replicas)} vs {len(endpoints)}")
+        self._views = [ShardView(i, ep,
+                                 replicas[i] if replicas else None)
+                       for i, ep in enumerate(self._endpoints)]
+        self._view_lock = threading.Lock()
         self._socks: Dict[str, socket.socket] = {}
         # per-endpoint locks exist up-front so concurrent async pushes
         # can never race the lazy socket creation or interleave frames
         self._locks: Dict[str, threading.Lock] = {
             ep: threading.Lock() for ep in self._endpoints}
+        for v in self._views:
+            if v.replica:
+                self._locks.setdefault(v.replica, threading.Lock())
         self._pool = ThreadPoolExecutor(max_workers=4)
+        # per-shard fan-out runs on its own pool: an async push (queued
+        # on _pool) fans out here, so pool workers never wait on tasks
+        # queued behind themselves
+        self._fan = ThreadPoolExecutor(max_workers=8)
         # seeded so sample_nodes' quota draws reproduce like the seeded
         # per-table samplers they compose with
         self._rng = np.random.default_rng(seed)
+        # bounded transient retry around one endpoint call (the
+        # TCPStore._call pattern): reconnect-and-retry rides a server
+        # restart window; non-transient errors surface immediately
+        self._retrying_call = _resilience.retry(
+            retry_on=(OSError,), classify=ps_transient_classify,
+            max_tries=self._max_tries, base_delay=0.05, max_delay=0.5,
+            jitter=0.25)(self._call_once)
 
-    def _call(self, ep: str, msg):
+    def _call_once(self, ep: str, msg, site: Optional[str] = None):
+        if _chaos.active and site is not None:
+            # inside the retried attempt, so an injected reset rides
+            # the same classification/bounded-retry path a real one does
+            _chaos.hit(site, exc=ConnectionResetError)
         with self._locks[ep]:
             sock = self._socks.get(ep)
             if sock is None:
@@ -799,29 +1023,145 @@ class PSClient:
                 self._socks.pop(ep, None)
                 sock.close()
                 raise
-        if resp is None:
-            self._socks.pop(ep, None)
-            raise ConnectionError(f"ps server {ep} closed the connection")
+            if resp is None:
+                self._socks.pop(ep, None)
+                sock.close()
+                raise ConnectionError(
+                    f"ps server {ep} closed the connection")
         status, payload = resp
         if status != "ok":
             raise RuntimeError(f"ps server {ep}: {payload}")
         return payload
 
-    def _dense_ep(self, table: str) -> str:
-        idx = int.from_bytes(table.encode(), "little") % len(self._endpoints)
-        return self._endpoints[idx]
+    def _call(self, ep: str, msg, site: Optional[str] = None):
+        try:
+            return self._retrying_call(ep, msg, site)
+        except OSError as e:
+            if ps_transient_classify(e):
+                raise PSUnavailableError(
+                    f"ps server {ep} unavailable after "
+                    f"{self._max_tries} attempts: "
+                    f"{type(e).__name__}: {e}") from e
+            raise
+
+    def _failover(self, view: ShardView, cause: BaseException) -> bool:
+        """Promote ``view``'s replica to primary (idempotent across
+        racing callers).  Returns True when a promotion happened or
+        was already done by a sibling thread."""
+        with self._view_lock:
+            if view.replica is None:
+                return view.promoted
+            dead, view.primary = view.primary, view.replica
+            view.replica = None
+            view.promoted = True
+        _metrics.counter(
+            "ps.failover",
+            "PS client failovers: a shard's primary stayed "
+            "unreachable and its replica was promoted").inc()
+        if _flight.active:
+            _flight.note("ps", "failover", shard=view.index, dead=dead,
+                         promoted=view.primary,
+                         cause=type(cause).__name__)
+        try:
+            self._call(view.primary, ("promote",))
+            _metrics.counter("ps.promote",
+                             "replicas promoted to serving primary").inc()
+        except (PSUnavailableError, RuntimeError):
+            pass   # the replayed op will surface replica death itself
+        return True
+
+    def _shard_call(self, shard: int, msg, site: Optional[str] = None):
+        """One RPC to a shard's current primary: bounded retries, then
+        failover to the replica (exactly one replay) when one exists."""
+        view = self._views[shard]
+        t0 = time.perf_counter()
+        try:
+            try:
+                return self._call(view.primary, msg, site)
+            except PSUnavailableError as e:
+                if not self._failover(view, e):
+                    raise
+                return self._call(view.primary, msg, site)
+        finally:
+            if site is not None:
+                _metrics.histogram(
+                    f"{site}.ms",
+                    f"PS client {site.split('.')[-1]} shard-RPC "
+                    f"latency (ms)").observe(
+                        (time.perf_counter() - t0) * 1e3)
+
+    def _dense_shard(self, table: str) -> int:
+        return dense_shard_of(table, len(self._views))
+
+    # -- failover / replication control ------------------------------------
+    @property
+    def shard_views(self) -> List[ShardView]:
+        return list(self._views)
+
+    def flush_replication(self, timeout: float = 30.0) -> bool:
+        """Block until every replicated shard's replica holds every
+        applied op (the bounded-staleness window closed).  Each RPC's
+        server-side wait stays well under the socket timeout (the
+        client loops to the overall deadline), so a long drain can
+        never masquerade as a dead shard and trip a spurious
+        retry/failover."""
+        deadline = time.monotonic() + float(timeout)
+        rpc_wait = max(0.1, min(5.0, self._timeout * 0.5))
+        ok = True
+        for s, v in enumerate(self._views):
+            if v.replica is None and not v.promoted:
+                continue
+            while True:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    ok = False
+                    break
+                if bool(self._shard_call(
+                        s, ("repl_flush", min(rpc_wait, rem)))):
+                    break
+        return ok
+
+    def replication_stats(self) -> List[Dict]:
+        return [self._shard_call(s, ("repl_stats",))
+                for s in range(len(self._views))]
+
+    def readmit_replica(self, shard: int, ep: str):
+        """Attach ``ep`` as ``shard``'s replica (a restarted host
+        rejoining).  The primary performs an anti-entropy full-state
+        sync before incremental replication resumes.
+
+        The view is updated only AFTER the primary accepted the new
+        target: a dead primary surfaces ``PSUnavailableError`` here
+        with nothing installed (so no failover can promote a replica
+        that never caught up), and a primary refusing a self-target
+        (the op replayed onto the candidate itself) raises instead of
+        silently wiring a double-apply loopback."""
+        view = self._views[shard]
+        with self._view_lock:
+            self._locks.setdefault(ep, threading.Lock())
+        if not self._shard_call(shard, ("set_replica", ep)):
+            raise ValueError(
+                f"shard {shard} primary refused replica {ep} "
+                f"(replicating to itself?)")
+        with self._view_lock:
+            view.replica = ep
+        if _flight.active:
+            _flight.note("ps", "readmit", shard=shard, replica=ep)
 
     # -- dense -------------------------------------------------------------
     def pull_dense(self, table: str) -> np.ndarray:
-        return self._call(self._dense_ep(table), ("pull_dense", table))
+        return self._shard_call(self._dense_shard(table),
+                                ("pull_dense", table), "ps.pull")
 
     def push_dense(self, table: str, grad: np.ndarray) -> None:
-        self._call(self._dense_ep(table), ("push_dense", table,
-                                           np.asarray(grad, np.float32)))
+        self._shard_call(self._dense_shard(table),
+                         ("push_dense", table,
+                          np.asarray(grad, np.float32)), "ps.push")
 
     def set_dense(self, table: str, value: np.ndarray) -> None:
-        self._call(self._dense_ep(table), ("set_dense", table,
-                                           np.asarray(value, np.float32)))
+        self._shard_call(self._dense_shard(table),
+                         ("set_dense", table,
+                          np.asarray(value, np.float32)), "ps.push")
 
     def push_dense_async(self, table: str, grad) -> Future:
         return self._pool.submit(self.push_dense, table, grad)
@@ -829,14 +1169,23 @@ class PSClient:
     # -- sparse ------------------------------------------------------------
     def pull_sparse(self, table: str, keys: Sequence[int]) -> np.ndarray:
         keys = np.asarray(keys, np.int64).reshape(-1)
-        n = len(self._endpoints)
-        out = None
+        n = len(self._views)
+        _metrics.counter("ps.lookups",
+                         "embedding rows pulled through the PS "
+                         "client").inc(int(keys.size))
+        futs = []
         for shard in range(n):
             idx = np.nonzero(keys % n == shard)[0]
-            if idx.size == 0:
-                continue
-            rows = self._call(self._endpoints[shard],
-                              ("pull_sparse", table, keys[idx]))
+            if idx.size:
+                # batched async per shard: every shard's RPC is in
+                # flight at once, so pull latency is the slowest shard,
+                # not the sum of shards
+                futs.append((idx, self._fan.submit(
+                    self._shard_call, shard,
+                    ("pull_sparse", table, keys[idx]), "ps.pull")))
+        out = None
+        for idx, fut in futs:
+            rows = fut.result()
             if out is None:
                 out = np.zeros((keys.size, rows.shape[1]), np.float32)
             out[idx] = rows
@@ -845,12 +1194,15 @@ class PSClient:
     def push_sparse(self, table: str, keys, grads) -> None:
         keys = np.asarray(keys, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32)
-        n = len(self._endpoints)
-        for shard in range(n):
-            idx = np.nonzero(keys % n == shard)[0]
-            if idx.size:
-                self._call(self._endpoints[shard],
-                           ("push_sparse", table, keys[idx], grads[idx]))
+        n = len(self._views)
+        futs = [self._fan.submit(
+            self._shard_call, shard,
+            ("push_sparse", table, keys[idx], grads[idx]), "ps.push")
+            for shard in range(n)
+            for idx in (np.nonzero(keys % n == shard)[0],)
+            if idx.size]
+        for f in futs:
+            f.result()
 
     def push_sparse_ctr(self, table: str, keys, grads, shows=None,
                         clicks=None) -> None:
@@ -858,23 +1210,27 @@ class PSClient:
         (reference CtrCommonPushValue)."""
         keys = np.asarray(keys, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32)
-        n = len(self._endpoints)
+        n = len(self._views)
         shows = np.ones(keys.size) if shows is None else np.asarray(shows)
-        clicks = np.zeros(keys.size) if clicks is None             else np.asarray(clicks)
-        for shard in range(n):
-            idx = np.nonzero(keys % n == shard)[0]
-            if idx.size:
-                self._call(self._endpoints[shard],
-                           ("push_sparse_ctr", table, keys[idx],
-                            grads[idx], shows[idx], clicks[idx]))
+        clicks = np.zeros(keys.size) if clicks is None \
+            else np.asarray(clicks)
+        futs = [self._fan.submit(
+            self._shard_call, shard,
+            ("push_sparse_ctr", table, keys[idx], grads[idx],
+             shows[idx], clicks[idx]), "ps.push")
+            for shard in range(n)
+            for idx in (np.nonzero(keys % n == shard)[0],)
+            if idx.size]
+        for f in futs:
+            f.result()
 
     def ctr_shrink(self, table: str, decay_rate: float = 0.98,
                    delete_threshold: float = 0.8,
                    delete_after_unseen_days: float = 30.0) -> int:
-        return sum(self._call(ep, ("ctr_shrink", table, decay_rate,
-                                   delete_threshold,
-                                   delete_after_unseen_days))
-                   for ep in self._endpoints)
+        return sum(self._shard_call(s, ("ctr_shrink", table, decay_rate,
+                                        delete_threshold,
+                                        delete_after_unseen_days))
+                   for s in range(len(self._views)))
 
     # -- graph -------------------------------------------------------------
     # Graph storage shards by node id (``node % n_servers``), the same
@@ -892,7 +1248,7 @@ class PSClient:
             idx = np.nonzero(src % n == shard)[0]
             if idx.size:
                 futs.append(self._pool.submit(
-                    self._call, self._endpoints[shard],
+                    self._shard_call, shard,
                     ("graph_add_edges", table,
                      src[idx].tolist(), dst[idx].tolist(),
                      None if ws is None else ws[idx].tolist(), False)))
@@ -901,7 +1257,7 @@ class PSClient:
             didx = np.nonzero(dst % n == shard)[0]
             if didx.size:
                 futs.append(self._pool.submit(
-                    self._call, self._endpoints[shard],
+                    self._shard_call, shard,
                     ("graph_add_nodes", table,
                      np.unique(dst[didx]).tolist(), None)))
         for f in futs:
@@ -917,7 +1273,7 @@ class PSClient:
             idx = np.nonzero(ids % n == shard)[0]
             if idx.size:
                 futs.append(self._pool.submit(
-                    self._call, self._endpoints[shard],
+                    self._shard_call, shard,
                     ("graph_add_nodes", table, ids[idx].tolist(),
                      None if feats is None else feats[idx])))
         for f in futs:
@@ -932,7 +1288,7 @@ class PSClient:
             idx = np.nonzero(node_ids % n == shard)[0]
             if idx.size:
                 futs.append((idx, self._pool.submit(
-                    self._call, self._endpoints[shard],
+                    self._shard_call, shard,
                     ("graph_sample_neighbors", table,
                      node_ids[idx].tolist(), int(sample_size)))))
         for idx, fut in futs:          # merge in query order
@@ -945,14 +1301,14 @@ class PSClient:
         the sample multivariate-hypergeometrically, then each shard
         draws its quota without replacement."""
         counts = [f.result() for f in [
-            self._pool.submit(self._call, ep, ("graph_len", table))
-            for ep in self._endpoints]]
+            self._pool.submit(self._shard_call, s, ("graph_len", table))
+            for s in range(len(self._views))]]
         total = sum(counts)
         k = min(int(sample_size), total)
         if k == 0:
             return np.zeros((0,), np.int64)
         quota = self._rng.multivariate_hypergeometric(counts, k)
-        futs = [self._pool.submit(self._call, self._endpoints[s],
+        futs = [self._pool.submit(self._shard_call, s,
                                   ("graph_sample_nodes", table, int(q)))
                 for s, q in enumerate(quota) if q]
         parts = [f.result() for f in futs]
@@ -964,9 +1320,9 @@ class PSClient:
         start+size sorted ids, so only that prefix ships per shard
         (never the whole id space) before the merge."""
         need = int(start) + int(size)
-        futs = [self._pool.submit(self._call, ep,
+        futs = [self._pool.submit(self._shard_call, s,
                                   ("graph_pull_list", table, 0, need))
-                for ep in self._endpoints]
+                for s in range(len(self._views))]
         parts = [f.result() for f in futs]
         allids = np.sort(np.concatenate(
             [np.asarray(p, np.int64).reshape(-1) for p in parts]))
@@ -981,7 +1337,7 @@ class PSClient:
             idx = np.nonzero(ids % n == shard)[0]
             if idx.size:
                 futs.append((idx, self._pool.submit(
-                    self._call, self._endpoints[shard],
+                    self._shard_call, shard,
                     ("graph_get_feat", table, ids[idx].tolist()))))
         for idx, fut in futs:
             for pos, f in zip(idx, fut.result()):
@@ -990,27 +1346,76 @@ class PSClient:
 
     def graph_shard_sizes(self, table: str) -> List[int]:
         """Per-server resident-node counts (placement observability)."""
-        return [self._call(ep, ("graph_len", table))
-                for ep in self._endpoints]
+        return [self._shard_call(s, ("graph_len", table))
+                for s in range(len(self._views))]
 
     def push_sparse_async(self, table: str, keys, grads) -> Future:
         return self._pool.submit(self.push_sparse, table, keys, grads)
 
     # -- control -----------------------------------------------------------
     def barrier(self, n_workers: int):
-        self._call(self._endpoints[0], ("barrier", n_workers))
+        # deliberately ONE attempt — no transient retry, no failover: a
+        # re-sent barrier frame double-counts this worker and releases
+        # the gang early; a timeout/death must surface to the caller
+        self._call_once(self._views[0].primary, ("barrier", n_workers))
 
     def save(self, dirname: str):
         os.makedirs(dirname, exist_ok=True)
-        for i, ep in enumerate(self._endpoints):
-            self._call(ep, ("save", os.path.join(dirname, f"shard{i}.pkl")))
+        for i in range(len(self._views)):
+            self._shard_call(i, ("save",
+                                 os.path.join(dirname, f"shard{i}.pkl")))
 
     def load(self, dirname: str):
-        for i, ep in enumerate(self._endpoints):
-            self._call(ep, ("load", os.path.join(dirname, f"shard{i}.pkl")))
+        for i in range(len(self._views)):
+            self._shard_call(i, ("load",
+                                 os.path.join(dirname, f"shard{i}.pkl")))
+
+    # -- verified shard checkpoints + elastic resharding -------------------
+    def save_state(self, dirname: str, step: Optional[int] = None):
+        """Every shard commits its tables under ``dirname/shard<i>``
+        through the manifest-v2 atomic-commit path (sha256 per file +
+        ``_PADDLE_COMMITTED``) — ``load_state(verify=True)`` detects
+        torn or bit-flipped trees instead of serving them."""
+        n = len(self._views)
+        root = os.path.abspath(dirname)
+        futs = [self._fan.submit(self._shard_call, s,
+                                 ("save_shard", root, step, n))
+                for s in range(n)]
+        for f in futs:
+            f.result()
+        from .ps_shard import prune_stale_shards
+        # a root previously saved at a LARGER shard count would keep
+        # stale shard>=n trees whose rows overlap the fresh partition —
+        # drop them so a later load sees exactly this save
+        prune_stale_shards(root, n)
+
+    def load_state(self, dirname: str, *,
+                   reshard_ps: Optional[int] = None,
+                   verify: bool = True):
+        """Load a verified PS checkpoint.  A checkpoint taken at M
+        shards loads onto the current N servers by re-partitioning the
+        row union with ``ps_shard.reshard_states`` (no row dropped or
+        duplicated) — an elastic shrink re-forms the PS tier one
+        smaller instead of dying.  ``reshard_ps`` (optional) asserts
+        the intended target count."""
+        from .ps_shard import load_shard_states, reshard_states
+        n = len(self._views)
+        if reshard_ps is not None and int(reshard_ps) != n:
+            raise ValueError(
+                f"load_state(reshard_ps={reshard_ps}) but the client "
+                f"is connected to {n} shards")
+        m, states = load_shard_states(dirname, verify=verify)
+        if m != n:
+            states = reshard_states(states, n)
+        futs = [self._fan.submit(self._shard_call, s,
+                                 ("load_shard_state", states[s]))
+                for s in range(n)]
+        for f in futs:
+            f.result()
 
     def close(self):
         self._pool.shutdown(wait=True)
+        self._fan.shutdown(wait=True)
         for s in self._socks.values():
             try:
                 s.close()

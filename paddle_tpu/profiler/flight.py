@@ -20,6 +20,10 @@ events fed by the subsystems that make operational decisions —
     swap        weight hot-swaps: canary / promote / rollback / abort
                 (router), apply / quarantine (replica watcher)
     fleet       replica-registry lease publish failures
+    ps          parameter-server shard lifecycle: shard_join /
+                shard_leave (stop or chaos shard-down) / failover +
+                promote (client promotes a replica over a dead
+                primary) / readmit (anti-entropy catch-up) / reshard
 
 — and dumps it as JSON on crash (``sys.excepthook``), on SIGUSR1 (the
 supervisor signals every worker before killing a stalled gang —

@@ -49,6 +49,15 @@ matching the PR-1 instrumentation discipline)::
     fleet.lease      serving replica-registry lease publish (``fail``
                      drops heartbeat puts so a replica's TTL lease
                      expires — membership loss without process loss)
+    ps.pull          parameter-server client pull RPC attempt (``fail``
+                     injects a connection reset that rides the bounded
+                     transient-retry path; a persistent window forces a
+                     failover to the shard's replica)
+    ps.push          same, on the push/update RPC path
+    ps.shard_down    PS server request handler (``fail`` makes the
+                     shard sever every client and stop accepting — a
+                     deterministic in-process SIGKILL; clients must
+                     fail over to the replica)
 
 Injections are counted in the metrics registry: ``chaos.injected``
 (total) and ``chaos.injected.<site>``.
@@ -67,7 +76,8 @@ __all__ = ["active", "ChaosError", "SITES", "parse_spec", "configure",
 
 SITES = ("ckpt.write", "store.rpc", "store.partition", "fs.rename",
          "loader.worker", "step.loss", "host.slow", "serve.request",
-         "kv.block_alloc", "router.dispatch", "fleet.lease")
+         "kv.block_alloc", "router.dispatch", "fleet.lease",
+         "ps.pull", "ps.push", "ps.shard_down")
 
 # module-level fast predicate — the single read hot paths gate on
 active = False

@@ -141,9 +141,11 @@ define_flag("FLAGS_chaos_spec", "",
             "deterministic fault-injection spec, e.g. "
             "'ckpt.write:fail@3;store.rpc:delay=0.5@2-4' — named sites "
             "(ckpt.write, store.rpc, store.partition, fs.rename, "
-            "loader.worker, step.loss, host.slow, serve.request) "
-            "fail/stall/poison on a seeded schedule; empty means every "
-            "site costs one predicate read (utils/chaos.py)")
+            "loader.worker, step.loss, host.slow, serve.request, "
+            "kv.block_alloc, router.dispatch, fleet.lease, ps.pull, "
+            "ps.push, ps.shard_down) fail/stall/poison on a seeded "
+            "schedule; empty means every site costs one predicate read "
+            "(utils/chaos.py)")
 define_flag("FLAGS_chaos_seed", 0,
             "seed for probabilistic chaos selectors (p=...); same seed "
             "+ same call pattern = same injection schedule")

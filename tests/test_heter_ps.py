@@ -160,6 +160,55 @@ def test_heter_cache_eviction_after_invalidation():
     assert len(cache._order["t"]) == len(t_rows) <= 16
 
 
+def test_prefetch_thread_attributable():
+    """ISSUE 15 satellite: the prefetch worker goes through
+    utils/concurrency.spawn, so its creation site is registered for
+    thread dumps / the leak canary like every framework thread."""
+    from paddle_tpu.utils import concurrency as conc
+    t = HeterEmbeddingTable(100, 8, cache_rows=32, admit_after=5, seed=0)
+    th = t.prefetch(np.array([1, 2, 3]))
+    site = conc.thread_site(th)
+    assert site is not None and "heter_ps" in site
+    assert th.daemon
+    t.wait_prefetch()
+
+
+def test_table_lock_routes_through_sanitizer_factory():
+    """Under FLAGS_lock_san the host-tier table lock is a sanitized
+    RLock participating in the order graph (not a bare threading
+    primitive); at level 0 it stays a plain RLock (zero per-acquire
+    cost)."""
+    import threading
+    from paddle_tpu.utils import flags as F
+    t0 = HeterEmbeddingTable(10, 4, cache_rows=4, seed=0)
+    assert isinstance(t0._lock, type(threading.RLock()))
+    old = F.get_flag("FLAGS_lock_san")
+    F.set_flags({"FLAGS_lock_san": 1})
+    try:
+        t1 = HeterEmbeddingTable(10, 4, cache_rows=4, admit_after=1,
+                                 seed=0)
+        assert type(t1._lock).__name__ == "_SanRLock"
+        t1.lookup(np.array([1, 2]))      # acquires through the sanitizer
+        t1.prefetch(np.array([3]))
+        t1.wait_prefetch()
+        t1.apply_grads(np.array([1]), np.ones((1, 4), np.float32), 0.1)
+    finally:
+        F.set_flags({"FLAGS_lock_san": old})
+
+
+def test_cache_hit_metrics_in_registry():
+    """ps.cache.hit/miss land in the PR-1 metrics registry (the
+    fleet-scrapable counters next to hits/misses on the table)."""
+    from paddle_tpu.profiler import metrics
+    t = HeterEmbeddingTable(100, 8, cache_rows=8, admit_after=1, seed=0)
+    h0 = metrics.counter("ps.cache.hit").value
+    m0 = metrics.counter("ps.cache.miss").value
+    t.lookup(np.array([1, 2, 3]))        # 3 misses
+    t.lookup(np.array([1, 2, 3]))        # admitted -> 3 hits
+    assert metrics.counter("ps.cache.miss").value == m0 + 3
+    assert metrics.counter("ps.cache.hit").value == h0 + 3
+
+
 def test_pipe_command_type_validation():
     ds = paddle.distributed.QueueDataset()
     with pytest.raises(ValueError, match="callable or a shell"):

@@ -368,6 +368,159 @@ def test_graph_table_sharded_across_two_servers():
             s.stop()
 
 
+def _ctr_tower_run(client, n_steps=6, kill_at=None, on_kill=None):
+    """One CTR-tower training run (hash -> PS embedding -> cvm ->
+    data_norm -> logistic loss) against ``client``; optionally kills a
+    shard mid-run via ``on_kill`` after step ``kill_at``.  Returns
+    (losses, final rows of every touched key)."""
+    from paddle_tpu.distributed.fleet import DistributedEmbedding
+    from paddle_tpu.ops import ctr
+    from paddle_tpu.distributed.fleet.ps import Communicator
+
+    comm = Communicator(client, mode="sync")
+    emb = DistributedEmbedding("emb", 100, 3, comm)
+    rng = np.random.RandomState(0)
+    raw_ids = rng.randint(0, 1 << 40, (8, 1)).astype(np.int64)
+    buckets = ctr.hash_op(raw_ids, hash_size=100)
+    flat = paddle.reshape(paddle.Tensor(buckets._data), [8])
+    touched = np.unique(np.asarray(flat._data)).astype(np.int64)
+    losses = []
+    for step in range(n_steps):
+        e = emb(paddle.reshape(flat, [8, 1]))
+        e = paddle.reshape(e, [8, 3])
+        show_clk = paddle.to_tensor(
+            np.abs(rng.rand(8, 2)).astype("float32"))
+        x = paddle.concat([show_clk, e], axis=1)
+        x = ctr.continuous_value_model(x, show_clk, True)
+        ones = paddle.to_tensor(np.ones(5, np.float32))
+        x, _, _ = ctr.data_norm(x, ones * 2, ones, ones * 2)
+        logit = paddle.sum(x, axis=1)
+        label = paddle.to_tensor(
+            (np.asarray(flat._data) % 2).astype("float32"))
+        loss = paddle.mean(
+            paddle.nn.functional.binary_cross_entropy_with_logits(
+                logit, label))
+        loss.backward()
+        losses.append(float(loss))
+        if kill_at is not None and step == kill_at:
+            on_kill()
+    rows = client.pull_sparse("emb", touched)
+    comm.stop()
+    return losses, rows
+
+
+def test_ctr_failover_loss_parity():
+    """ISSUE 15 acceptance leg: SIGKILL one primary shard mid-CTR-
+    training — the client fails over to the replica with exactly one
+    promotion, training resumes, and the final loss trajectory AND
+    every embedding row match the uninterrupted 2-shard reference
+    bit-exactly (zero lost updates)."""
+    from paddle_tpu.distributed.fleet.ps import PSClient, PSServer
+    from paddle_tpu.profiler import metrics
+
+    def make_cluster(with_replicas):
+        eps = [f"127.0.0.1:{free_port()}" for _ in range(2)]
+        reps = [f"127.0.0.1:{free_port()}" for _ in range(2)] \
+            if with_replicas else None
+        srvs = []
+        for i, ep in enumerate(eps):
+            srvs.append(PSServer(
+                ep, shard_id=i,
+                replicate_to=reps[i] if reps else None))
+        rsrvs = []
+        if reps:
+            for i, ep in enumerate(reps):
+                rsrvs.append(PSServer(ep, shard_id=i, role="replica"))
+        for s in srvs + rsrvs:
+            s.add_sparse_table("emb", 3)
+            s.start()
+        return eps, reps, srvs, rsrvs
+
+    # uninterrupted reference
+    paddle.seed(0)
+    eps, _, srvs, _ = make_cluster(False)
+    cli = PSClient(eps, timeout=3.0, max_tries=2)
+    try:
+        ref_losses, ref_rows = _ctr_tower_run(cli)
+    finally:
+        cli.close()
+        for s in srvs:
+            s.stop()
+
+    # victim: replicated shards, primary 0 dies after step 2
+    paddle.seed(0)
+    eps, reps, srvs, rsrvs = make_cluster(True)
+    cli = PSClient(eps, replicas=reps, timeout=3.0, max_tries=2)
+    f0 = metrics.counter("ps.failover").value
+
+    def kill():
+        # close the staleness window, then the SIGKILL analog: the
+        # primary severs every client and stops accepting
+        assert cli.flush_replication(10.0)
+        srvs[0].stop()
+
+    try:
+        losses, rows = _ctr_tower_run(cli, kill_at=2, on_kill=kill)
+        assert metrics.counter("ps.failover").value == f0 + 1
+        assert cli.shard_views[0].promoted
+        assert rsrvs[0].role == "primary"
+        assert losses == ref_losses          # bit-exact loss parity
+        np.testing.assert_array_equal(rows, ref_rows)  # no lost updates
+    finally:
+        cli.close()
+        for s in srvs + rsrvs:
+            s.stop()
+
+
+def test_ctr_reshard_4_to_2_resumes_training(tmp_path):
+    """Elastic shrink: a CTR table checkpointed at 4 shards reloads
+    onto 2 servers with row-union parity, and the continued training
+    trajectory is bit-identical to a 4-shard cluster that loaded the
+    same checkpoint — the shard count is invisible to the numerics."""
+    from paddle_tpu.distributed.fleet.ps import PSClient, PSServer
+
+    def cluster(n):
+        eps = [f"127.0.0.1:{free_port()}" for _ in range(n)]
+        srvs = [PSServer(ep, shard_id=i, n_shards=n).start()
+                for i, ep in enumerate(eps)]
+        for s in srvs:
+            s.add_sparse_table("emb", 3)
+        return eps, srvs
+
+    paddle.seed(0)
+    eps4, srvs4 = cluster(4)
+    cli4 = PSClient(eps4, timeout=3.0)
+    root = str(tmp_path / "ps4")
+    try:
+        _ctr_tower_run(cli4, n_steps=4)
+        cli4.save_state(root)
+        total_rows = sum(len(s._tables["emb"]._rows) for s in srvs4)
+    finally:
+        cli4.close()
+        for s in srvs4:
+            s.stop()
+
+    def continue_at(n):
+        paddle.seed(0)
+        eps, srvs = cluster(n)
+        cli = PSClient(eps, timeout=3.0)
+        try:
+            cli.load_state(root, reshard_ps=n)
+            resident = sum(len(s._tables["emb"]._rows) for s in srvs)
+            return (*_ctr_tower_run(cli, n_steps=3), resident)
+        finally:
+            cli.close()
+            for s in srvs:
+                s.stop()
+
+    losses4, rows4, res4 = continue_at(4)
+    losses2, rows2, res2 = continue_at(2)
+    # row union preserved through the reshard: no dup, no drop
+    assert res4 == res2 == total_rows
+    assert losses2 == losses4               # bit-exact trajectory
+    np.testing.assert_array_equal(rows2, rows4)
+
+
 def test_ctr_tower_trains_against_ps(ps_pair):
     """End-to-end CTR tier over the PS stack: hashed ids pull a
     PS-backed sparse embedding, the cvm + data_norm layer ops shape the

@@ -1,0 +1,528 @@
+"""Fault-tolerant sharded PS (ISSUE 15): replication bit-parity,
+classified transient retries, replica failover + promotion, chaos
+sites, verified shard checkpoints, and elastic M->N resharding.
+
+Reference parity: the reference PS fleet survives server loss through
+pslib's saved dense/sparse tables; this stack adds the robustness
+contract the rest of paddle_tpu already has — typed unavailability,
+deterministic chaos, manifest-v2 verified checkpoints, and bounded-
+staleness replication with anti-entropy catch-up.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.ps import (AdagradSGDRule, PSClient,
+                                             PSServer, PSUnavailableError)
+from paddle_tpu.distributed.fleet import ps_shard
+from paddle_tpu.distributed.checkpoint import CheckpointCorruptError
+from paddle_tpu.profiler import flight, metrics
+from paddle_tpu.utils import chaos
+from conftest import free_port
+
+
+def _ep():
+    return f"127.0.0.1:{free_port()}"
+
+
+def _counter(name):
+    m = metrics.get(name)
+    return m.value if m is not None else 0
+
+
+@pytest.fixture
+def replicated_pair():
+    """One shard as a primary+replica pair, client wired for failover."""
+    p_ep, r_ep = _ep(), _ep()
+    rep = PSServer(r_ep, shard_id=0, role="replica")
+    pri = PSServer(p_ep, shard_id=0, replicate_to=r_ep)
+    for s in (pri, rep):
+        s.add_sparse_table("emb", 4, seed=7)
+        s.add_dense_table("w", (3,))
+        s.add_ctr_table("ctr", 2, seed=7)
+    rep.start()
+    pri.start()
+    cli = PSClient([p_ep], replicas=[r_ep], timeout=3.0, max_tries=2)
+    yield pri, rep, cli
+    cli.close()
+    pri.stop()
+    rep.stop()
+
+
+def test_replication_bit_parity_after_flush(replicated_pair):
+    pri, rep, cli = replicated_pair
+    keys = np.arange(20, dtype=np.int64)
+    rng = np.random.RandomState(0)
+    cli.set_dense("w", np.array([1.0, 2.0, 3.0], np.float32))
+    for _ in range(5):
+        cli.push_sparse("emb", keys, rng.randn(20, 4).astype(np.float32))
+        cli.push_sparse_ctr("ctr", keys[:4],
+                            rng.randn(4, 2).astype(np.float32),
+                            shows=[2, 2, 2, 2], clicks=[1, 0, 1, 0])
+        cli.push_dense("w", np.ones(3, np.float32))
+    assert cli.flush_replication(10.0)
+    # the replica holds bit-identical table state (same op order)
+    np.testing.assert_array_equal(pri._tables["emb"].pull(keys),
+                                  rep._tables["emb"].pull(keys))
+    np.testing.assert_array_equal(pri._tables["w"].pull(),
+                                  rep._tables["w"].pull())
+    assert pri._tables["ctr"].show_click_score(1) == \
+        rep._tables["ctr"].show_click_score(1)
+    st = cli.replication_stats()[0]
+    assert st["pending"] == 0 and st["shipped"] > 0 \
+        and st["dropped"] == 0
+
+
+def test_failover_promotes_replica(replicated_pair):
+    pri, rep, cli = replicated_pair
+    flight.clear()
+    keys = np.arange(10, dtype=np.int64)
+    cli.push_sparse("emb", keys, np.ones((10, 4), np.float32))
+    assert cli.flush_replication(10.0)
+    before = cli.pull_sparse("emb", keys)
+    f0 = _counter("ps.failover")
+    pri.stop()                      # kill the primary
+    after = cli.pull_sparse("emb", keys)   # bounded retries -> failover
+    np.testing.assert_array_equal(before, after)   # zero lost updates
+    view = cli.shard_views[0]
+    assert view.promoted and view.primary == rep.endpoint \
+        and view.replica is None
+    assert rep.role == "primary"            # server-side promotion
+    assert _counter("ps.failover") == f0 + 1
+    # promoted primary serves writes
+    cli.push_sparse("emb", keys, np.ones((10, 4), np.float32))
+    np.testing.assert_array_equal(cli.pull_sparse("emb", keys),
+                                  before - 0.05)
+    counts = flight.counts()
+    assert counts.get("ps.failover") == 1
+    assert counts.get("ps.promote") == 1
+
+
+def test_unreplicated_shard_raises_typed_error():
+    """A dead shard with no replica surfaces PSUnavailableError within
+    the bounded retry budget instead of hanging the training step."""
+    cli = PSClient([_ep()], timeout=1.0, max_tries=2)
+    t0 = time.monotonic()
+    with pytest.raises(PSUnavailableError):
+        cli.pull_dense("w")
+    assert time.monotonic() - t0 < 5.0
+    cli.close()
+
+
+def test_chaos_pull_reset_rides_bounded_retry(replicated_pair):
+    """An injected connection reset on the pull path is classified
+    transient and retried with an exactly-counted budget — no failover,
+    no caller-visible error."""
+    pri, rep, cli = replicated_pair
+    keys = np.arange(6, dtype=np.int64)
+    ref = cli.pull_sparse("emb", keys)
+    r0 = _counter("resilience.retry")
+    f0 = _counter("ps.failover")
+    # configure() resets the per-site call counters, so @1 is the next
+    # pull attempt: it fails, the bounded retry's second attempt lands
+    chaos.configure("ps.pull:fail@1")
+    try:
+        out = cli.pull_sparse("emb", keys)
+    finally:
+        chaos.reset()
+    np.testing.assert_array_equal(ref, out)
+    assert _counter("chaos.injected.ps.pull") == 1
+    assert _counter("resilience.retry") == r0 + 1
+    assert _counter("ps.failover") == f0        # retry, not failover
+    assert not cli.shard_views[0].promoted
+
+
+def test_chaos_shard_down_forces_failover(replicated_pair):
+    """ps.shard_down makes the primary sever + stop accepting (an
+    in-process SIGKILL); the client must fail over to the replica."""
+    pri, rep, cli = replicated_pair
+    keys = np.arange(8, dtype=np.int64)
+    cli.push_sparse("emb", keys, np.ones((8, 4), np.float32))
+    assert cli.flush_replication(10.0)
+    ref = cli.pull_sparse("emb", keys)
+    f0 = _counter("ps.failover")
+    # the NEXT message the primary handles tears it down; the replica
+    # keeps serving (its handler counts also visit the site, but the
+    # one-shot selector has already fired)
+    chaos.configure(f"ps.shard_down:fail@{chaos.call_count('ps.shard_down') + 1}")
+    try:
+        out = cli.pull_sparse("emb", keys)
+    finally:
+        chaos.reset()
+    np.testing.assert_array_equal(ref, out)
+    assert _counter("chaos.injected.ps.shard_down") == 1
+    assert _counter("ps.failover") == f0 + 1
+    assert cli.shard_views[0].promoted
+
+
+def test_readmit_replica_anti_entropy():
+    """A shard that lost its replica (or never had one) re-attaches a
+    replica at runtime; the primary full-syncs it before incremental
+    replication resumes — the readmit catch-up path."""
+    p_ep, r_ep = _ep(), _ep()
+    pri = PSServer(p_ep, shard_id=0)
+    pri.add_sparse_table("emb", 4, seed=3)
+    pri.start()
+    cli = PSClient([p_ep], timeout=3.0, max_tries=2)
+    keys = np.arange(12, dtype=np.int64)
+    cli.push_sparse("emb", keys, np.ones((12, 4), np.float32))
+    # replica joins AFTER the primary accumulated state
+    rep = PSServer(r_ep, shard_id=0, role="replica")
+    rep.add_sparse_table("emb", 4, seed=3)
+    rep.start()
+    cli.readmit_replica(0, r_ep)
+    assert cli.flush_replication(10.0)
+    st = cli.replication_stats()[0]
+    assert st["resyncs"] >= 1 and not st["dirty"]
+    np.testing.assert_array_equal(pri._tables["emb"].pull(keys),
+                                  rep._tables["emb"].pull(keys))
+    # incremental replication works after the catch-up
+    cli.push_sparse("emb", keys[:3], np.ones((3, 4), np.float32))
+    assert cli.flush_replication(10.0)
+    np.testing.assert_array_equal(pri._tables["emb"].pull(keys),
+                                  rep._tables["emb"].pull(keys))
+    cli.close()
+    pri.stop()
+    rep.stop()
+
+
+def test_replication_queue_overflow_resyncs():
+    """Engine unit: a replica down past the queue bound costs a full
+    anti-entropy sync, not unbounded memory."""
+    state = {"t": {"rows": {1: "x"}, "states": {}}}
+    eng = ps_shard.ReplicationEngine(lambda: state, None,
+                                     capacity=4, name="test-repl")
+    # no replica: enqueue is a no-op
+    eng.enqueue(("push_sparse", "t", [1], [0.0]))
+    assert eng.stats()["pending"] == 0
+    eng.set_replica("127.0.0.1:1")     # unreachable target
+    for i in range(10):                # overflow the bound
+        eng.enqueue(("push_sparse", "t", [i], [0.0]))
+    st = eng.stats()
+    assert st["dirty"] and st["dropped"] > 0 and st["pending"] <= 4
+    assert eng.flush(timeout=0.2) is False     # replica still down
+    eng.stop()
+
+
+def test_flush_times_out_when_replica_down(replicated_pair):
+    pri, rep, cli = replicated_pair
+    rep.stop()
+    cli.push_sparse("emb", np.arange(4, dtype=np.int64),
+                    np.ones((4, 4), np.float32))
+    assert cli.flush_replication(timeout=0.5) is False
+
+
+# ---------------------------------------------------------------------------
+# verified shard checkpoints
+# ---------------------------------------------------------------------------
+def _make_cluster(n, tmp_path=None, seed=7, interval=0.0, ckpt=None):
+    eps = [_ep() for _ in range(n)]
+    srvs = []
+    for i, ep in enumerate(eps):
+        s = PSServer(ep, shard_id=i, n_shards=n, checkpoint_dir=ckpt,
+                     checkpoint_interval_s=interval)
+        s.add_sparse_table("emb", 4, rule=AdagradSGDRule(0.1), seed=seed)
+        s.add_dense_table("w", (3,))
+        s.add_ctr_table("ctr", 2, seed=seed)
+        s.start()
+        srvs.append(s)
+    return eps, srvs
+
+
+def test_save_state_commits_verified_manifest(tmp_path):
+    eps, srvs = _make_cluster(2)
+    cli = PSClient(eps, timeout=3.0)
+    keys = np.arange(30, dtype=np.int64)
+    cli.push_sparse("emb", keys, np.ones((30, 4), np.float32))
+    root = str(tmp_path / "ps_ckpt")
+    cli.save_state(root, step=5)
+    for i in range(2):
+        d = os.path.join(root, f"shard{i}")
+        assert os.path.exists(os.path.join(d, "_PADDLE_COMMITTED"))
+        assert os.path.exists(os.path.join(d, "_paddle_manifest.json"))
+    m, states = ps_shard.load_shard_states(root)
+    assert m == 2
+    # row union == pushed key set, disjoint across shards (no dup/drop)
+    all_keys = sorted(k for st in states for k in st["emb"]["rows"])
+    assert all_keys == sorted(keys.tolist())
+    cli.close()
+    for s in srvs:
+        s.stop()
+
+
+def test_corrupt_shard_checkpoint_rejected(tmp_path):
+    eps, srvs = _make_cluster(2)
+    cli = PSClient(eps, timeout=3.0)
+    cli.push_sparse("emb", np.arange(10, dtype=np.int64),
+                    np.ones((10, 4), np.float32))
+    root = str(tmp_path / "ps_ckpt")
+    cli.save_state(root)
+    # flip a byte in one shard's data file
+    victim = os.path.join(root, "shard1", "tables.pkl")
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(blob))
+    with pytest.raises(CheckpointCorruptError):
+        cli.load_state(root)
+    # a missing commit marker is an uncommitted (torn) tree
+    open(victim, "wb").write(bytes(blob))   # restore length, still bad
+    os.remove(os.path.join(root, "shard0", "_PADDLE_COMMITTED"))
+    with pytest.raises(CheckpointCorruptError):
+        cli.load_state(root)
+    cli.close()
+    for s in srvs:
+        s.stop()
+
+
+def test_interval_checkpoints_commit(tmp_path):
+    root = str(tmp_path / "auto")
+    eps, srvs = _make_cluster(1, interval=0.05, ckpt=root)
+    cli = PSClient(eps, timeout=3.0)
+    cli.push_sparse("emb", np.arange(5, dtype=np.int64),
+                    np.ones((5, 4), np.float32))
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        try:
+            m, states = ps_shard.load_shard_states(root)
+            if states[0]["emb"]["rows"]:
+                break
+        except (FileNotFoundError, CheckpointCorruptError):
+            pass
+        time.sleep(0.05)
+    m, states = ps_shard.load_shard_states(root)   # verified load
+    assert m == 1 and len(states[0]["emb"]["rows"]) == 5
+    cli.close()
+    for s in srvs:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# elastic resharding
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("m,n", [(1, 2), (2, 1), (2, 4), (4, 2),
+                                 (4, 1), (1, 4), (2, 2)])
+def test_reshard_matrix_row_union_parity(tmp_path, m, n):
+    """A checkpoint taken at M shards reloads onto N servers with the
+    exact row union — no key dropped, none duplicated, every row
+    bit-exact — and the N-shard client serves identical pulls."""
+    eps_m, srvs_m = _make_cluster(m)
+    cli_m = PSClient(eps_m, timeout=3.0)
+    keys = np.arange(64, dtype=np.int64)
+    rng = np.random.RandomState(1)
+    cli_m.set_dense("w", np.array([3.0, 1.0, 4.0], np.float32))
+    for _ in range(4):
+        cli_m.push_sparse("emb", keys, rng.randn(64, 4).astype(np.float32))
+        cli_m.push_sparse_ctr("ctr", keys[:8],
+                              rng.randn(8, 2).astype(np.float32),
+                              shows=np.full(8, 2.0), clicks=np.ones(8))
+    ref_rows = cli_m.pull_sparse("emb", keys)
+    ref_ctr = cli_m.pull_sparse("ctr", keys[:8])
+    ref_w = cli_m.pull_dense("w")
+    root = str(tmp_path / "ckpt")
+    cli_m.save_state(root)
+    cli_m.close()
+    for s in srvs_m:
+        s.stop()
+
+    eps_n, srvs_n = _make_cluster(n)
+    cli_n = PSClient(eps_n, timeout=3.0)
+    cli_n.load_state(root, reshard_ps=n)
+    np.testing.assert_array_equal(cli_n.pull_sparse("emb", keys),
+                                  ref_rows)
+    np.testing.assert_array_equal(cli_n.pull_sparse("ctr", keys[:8]),
+                                  ref_ctr)
+    np.testing.assert_array_equal(cli_n.pull_dense("w"), ref_w)
+    # per-server residency: every touched key on exactly one shard
+    per = [sorted(srvs_n[i]._tables["emb"]._rows) for i in range(n)]
+    union = sorted(k for p in per for k in p)
+    assert union == sorted(keys.tolist())
+    for i, p in enumerate(per):
+        assert all(k % n == i for k in p)
+    # opt state moved with the rows (Adagrad g2sum preserved): one more
+    # identical push advances every row identically to a same-history
+    # M-shard cluster only if g2sum survived — spot-check it exists
+    assert any(srvs_n[i]._tables["emb"]._states for i in range(n))
+    cli_n.close()
+    for s in srvs_n:
+        s.stop()
+
+
+def test_resave_at_smaller_shard_count_prunes_stale_trees(tmp_path):
+    """Review regression: save at 4 shards, shrink, save the SAME root
+    at 2 — the stale shard2/3 trees must not poison a later load
+    (last-wins ps_n_shards + overlapping rows)."""
+    root = str(tmp_path / "root")
+    keys = np.arange(32, dtype=np.int64)
+    eps4, srvs4 = _make_cluster(4)
+    cli4 = PSClient(eps4, timeout=3.0)
+    try:
+        cli4.push_sparse("emb", keys, np.ones((32, 4), np.float32))
+        cli4.save_state(root)
+    finally:
+        cli4.close()
+        for s in srvs4:
+            s.stop()
+    eps2, srvs2 = _make_cluster(2)
+    cli2 = PSClient(eps2, timeout=3.0)
+    try:
+        cli2.load_state(root)
+        cli2.push_sparse("emb", keys, np.ones((32, 4), np.float32))
+        after = cli2.pull_sparse("emb", keys)
+        cli2.save_state(root)          # re-save at the smaller count
+        assert not os.path.isdir(os.path.join(root, "shard2"))
+        assert not os.path.isdir(os.path.join(root, "shard3"))
+        m, states = ps_shard.load_shard_states(root)
+        assert m == 2
+        union = sorted(k for st in states for k in st["emb"]["rows"])
+        assert union == keys.tolist()
+        np.testing.assert_array_equal(
+            np.stack([states[k % 2]["emb"]["rows"][k] for k in
+                      keys.tolist()]), after)
+    finally:
+        cli2.close()
+        for s in srvs2:
+            s.stop()
+
+
+def test_readmit_refuses_self_and_dead_primary():
+    """Review regression: readmitting a replica while the primary is
+    dead must NOT install the target (a failover-replayed set_replica
+    would otherwise wire the shard to replicate to itself)."""
+    p_ep, r_ep = _ep(), _ep()
+    pri = PSServer(p_ep, shard_id=0)
+    pri.add_sparse_table("emb", 4, seed=0)
+    pri.start()
+    cli = PSClient([p_ep], timeout=1.0, max_tries=2)
+    try:
+        # direct self-target refused by the server
+        with pytest.raises(ValueError, match="refused replica"):
+            cli.readmit_replica(0, p_ep)
+        assert cli.shard_views[0].replica is None
+        pri.stop()
+        with pytest.raises(PSUnavailableError):
+            cli.readmit_replica(0, r_ep)
+        assert cli.shard_views[0].replica is None   # nothing installed
+    finally:
+        cli.close()
+        pri.stop()
+
+
+def test_concurrent_stop_is_safe(replicated_pair):
+    """Review regression: chaos shard_down spawns stop() concurrently
+    with the owner's teardown — both must return cleanly."""
+    import threading
+    pri, rep, cli = replicated_pair
+    ts = [threading.Thread(target=pri.stop) for _ in range(3)]
+    for t in ts:
+        t.start()
+    pri.stop()
+    for t in ts:
+        t.join(timeout=10)
+    assert pri._server is None
+
+
+def test_promoted_replica_fences_old_primary_stream(replicated_pair):
+    """Review regression (split-brain fencing): after promotion the
+    replica refuses replica_apply/replica_load_full, so a
+    slow-but-alive old primary's replication engine cannot
+    double-apply its queue on top of the client's direct writes."""
+    pri, rep, cli = replicated_pair
+    keys = np.arange(6, dtype=np.int64)
+    cli.push_sparse("emb", keys, np.ones((6, 4), np.float32))
+    assert cli.flush_replication(10.0)
+    pri.stop()
+    cli.pull_sparse("emb", keys)          # promotes the replica
+    assert rep.role == "primary"
+    rows = cli.pull_sparse("emb", keys)
+    # the old primary's stream is refused, state unchanged
+    with pytest.raises(RuntimeError, match="not a replica"):
+        rep._apply(("replica_apply",
+                    [("push_sparse", "emb", keys,
+                      np.ones((6, 4), np.float32))]))
+    with pytest.raises(RuntimeError, match="not a replica"):
+        rep._apply(("replica_load_full", {"emb": {"rows": {},
+                                                  "states": {}}}))
+    np.testing.assert_array_equal(cli.pull_sparse("emb", keys), rows)
+
+
+def test_stale_torn_tree_does_not_brick_load(tmp_path):
+    """Review regression: a torn shard>=M leftover (interval saver at
+    the old, larger count) is ignored by the newest-manifest rule —
+    the intact live shards still load."""
+    root = str(tmp_path / "root")
+    keys = np.arange(16, dtype=np.int64)
+    eps4, srvs4 = _make_cluster(4)
+    cli4 = PSClient(eps4, timeout=3.0)
+    try:
+        cli4.push_sparse("emb", keys, np.ones((16, 4), np.float32))
+        cli4.save_state(root)
+    finally:
+        cli4.close()
+        for s in srvs4:
+            s.stop()
+    eps2, srvs2 = _make_cluster(2)
+    cli2 = PSClient(eps2, timeout=3.0)
+    try:
+        cli2.load_state(root)
+        ref = cli2.pull_sparse("emb", keys)
+        # simulate: fresh 2-shard saves land (server-side, no client
+        # prune — the interval-saver path) while shard2/3 linger from
+        # the 4-shard era, and shard3 is TORN (marker ripped off)
+        for s in range(2):
+            srvs2[s].save_shard(root, n_shards=2)
+        os.remove(os.path.join(root, "shard3", "_PADDLE_COMMITTED"))
+        # stale shard2 (intact) + shard3 (torn): both beyond the newest
+        # manifest's ps_n_shards=2, both ignored
+        m, states = ps_shard.load_shard_states(root)
+        assert m == 2
+        cli2.load_state(root, reshard_ps=2)
+        np.testing.assert_array_equal(cli2.pull_sparse("emb", keys),
+                                      ref)
+    finally:
+        cli2.close()
+        for s in srvs2:
+            s.stop()
+
+
+def test_reshard_rejects_wrong_target(tmp_path):
+    eps, srvs = _make_cluster(2)
+    cli = PSClient(eps, timeout=3.0)
+    cli.push_sparse("emb", np.arange(4, dtype=np.int64),
+                    np.ones((4, 4), np.float32))
+    root = str(tmp_path / "ckpt")
+    cli.save_state(root)
+    with pytest.raises(ValueError, match="reshard_ps"):
+        cli.load_state(root, reshard_ps=3)
+    cli.close()
+    for s in srvs:
+        s.stop()
+
+
+def test_reshard_states_refuses_duplicate_keys():
+    """Row-union parity guard: a key on two source shards (torn or
+    mixed-up checkpoint) raises instead of silently overwriting."""
+    a = {"emb": {"rows": {1: np.zeros(2)}, "states": {}}}
+    b = {"emb": {"rows": {1: np.ones(2)}, "states": {}}}
+    with pytest.raises(ValueError, match="two source shards"):
+        ps_shard.reshard_states([a, b], 1)
+
+
+def test_reshard_graph_and_dense_placement():
+    g0 = {"g": {"adj": {0: [(1, 1.0)], 2: [(3, 1.0)]}, "feat": {}},
+          "w": {"value": np.arange(3.0), "opt": {}}}
+    g1 = {"g": {"adj": {1: [], 3: []}, "feat": {1: np.ones(2)}},
+          "w": {"value": np.zeros(3), "opt": {}}}
+    out = ps_shard.reshard_states([g0, g1], 4)
+    # nodes land on node % 4; dense lands on its hash-designated shard
+    assert sorted(out[0]["g"]["adj"]) == [0]
+    assert sorted(out[1]["g"]["adj"]) == [1]
+    assert sorted(out[2]["g"]["adj"]) == [2]
+    assert sorted(out[3]["g"]["adj"]) == [3]
+    owner = ps_shard.dense_shard_of("w", 4)
+    src_owner = ps_shard.dense_shard_of("w", 2)
+    for i in range(4):
+        assert ("w" in out[i]) == (i == owner)
+    np.testing.assert_array_equal(out[owner]["w"]["value"],
+                                  [g0, g1][src_owner]["w"]["value"])

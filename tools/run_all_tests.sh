@@ -112,4 +112,15 @@ python tools/fleet_gate.py
 # SIGKILLed rank must leave flight-recorder dumps (survivor + supervisor)
 # whose tails carry the chaos/rendezvous events at exact counts.
 python tools/obs_gate.py
+# PS gate (ISSUE 15 fault-tolerant sharded embedding PS): a 2-shard
+# CTR-tower training run over primary+replica pairs must survive a
+# chaos-injected pull reset (exactly 1 injection riding the bounded
+# transient retry) AND a real SIGKILL of a primary-shard subprocess
+# (exactly 1 failover + 1 promotion, 2 counted retries) with the loss
+# trajectory and final embedding rows bit-exact vs an uninterrupted
+# reference (zero lost updates), winding down leak-free; and a table
+# checkpointed at 4 shards (verified manifest-v2 commits) must reload
+# onto 2 servers with row-union parity (no dup/drop, per-row
+# bit-exact).
+python tools/ps_gate.py
 exec python -m pytest tests/ -q --runslow "$@"
