@@ -378,13 +378,19 @@ def bench_resnet(on_tpu: bool):
     from paddle_tpu.io import DevicePrefetcher
     from paddle_tpu.profiler import metrics as pm
     from paddle_tpu.profiler import tracer as ptracer
+    from paddle_tpu.profiler import memscope as pmem
     dev_ns = pm.counter("train.step.device_ns")
     was_tracing = ptracer.active
     ptracer.enable()
+    was_mem = pmem.active
+    pmem.enable()
+    pmem.set_tag_bytes("params",
+                       pmem.tree_nbytes(list(net.parameters())))
     reps = 4 if on_tpu else 1
     best = None
     best_wait = 0.0
     best_dev_ns = 0
+    best_goodput = None
     try:
         for _ in range(reps):
             feed = DevicePrefetcher(iter([(x, y)] * steps), depth=2)
@@ -392,6 +398,7 @@ def bench_resnet(on_tpu: bool):
             wait_s = 0.0
             dev0 = dev_ns.value
             logs = None
+            gp = pmem.GoodputMeter("bench").start()
             t0 = time.perf_counter()
             for _ in range(steps):
                 # loss comes back lazy (hapi _LazyScalar), so
@@ -415,13 +422,19 @@ def bench_resnet(on_tpu: bool):
             t_end = time.perf_counter()
             dt = t_end - t0
             feed.close()
+            gp.add_s("data_wait", wait_s)
+            gp.step_ns(int((dt - wait_s) * 1e9))
+            gdoc = gp.finish(export=False)
             if best is None or dt < best:
                 best, best_wait = dt, wait_s
                 best_dev_ns = dev_ns.value - dev0 + \
                     int((t_end - t_sync) * 1e9)
+                best_goodput = gdoc
     finally:
         if not was_tracing:
             ptracer.disable()
+        if not was_mem:
+            pmem.disable()
     imgs = B * steps / best
     # ResNet50 fwd ~4.1 GFLOP/img at 224^2; fwd+bwd ~3x (no remat on
     # the conv path), against one v5e chip's 197 bf16 TFLOP/s peak —
@@ -440,7 +453,13 @@ def bench_resnet(on_tpu: bool):
            # dispatch/backpressure vs everything-else-on-host split for
            # the best rep — the "where did the step go" attribution
            "device_frac": round(dev_frac, 4),
-           "host_frac": round(max(0.0, 1.0 - wait_frac - dev_frac), 4)}
+           "host_frac": round(max(0.0, 1.0 - wait_frac - dev_frac), 4),
+           # memscope leg: HBM ceiling + where it went + best-rep
+           # goodput (productive fraction of the timed wall)
+           "peak_hbm_bytes": pmem.peak_bytes(),
+           "mem_bytes_by_tag": pmem.tag_bytes(),
+           "goodput_frac": best_goodput["fractions"]["productive"]
+           if best_goodput else None}
     try:
         # per-phase share of the step (conv/norm/elementwise/optimizer)
         # off the PR 1 tracer op table — same summary path as
@@ -786,6 +805,10 @@ def bench_serving(on_tpu: bool):
                 pass                       # shed under overload: not lost
         done.append(n)
 
+    from paddle_tpu.profiler import memscope as pmem
+    was_mem = pmem.active
+    pmem.enable()
+    c0 = pmem.compile_count()
     threads = [threading.Thread(target=client, args=(i,))
                for i in range(clients)]
     t0 = time.perf_counter()
@@ -794,7 +817,11 @@ def bench_serving(on_tpu: bool):
     for t in threads:
         t.join()
     dt = time.perf_counter() - t0
+    pmem.on_phase("bench")              # one census point at peak load
+    compile_s = pmem.compile_seconds(c0)
     engine.close()
+    if not was_mem:
+        pmem.disable()
     served = sum(done)
     snap = lat.snapshot()
     occ_snap = occ.snapshot()
@@ -808,6 +835,11 @@ def bench_serving(on_tpu: bool):
         "batch_occupancy_avg": round(occ_snap.get("avg") or 0.0, 2),
         "compiles": compiles.value if compiles else 0,
         "max_batch_size": max_batch,
+        "peak_hbm_bytes": pmem.peak_bytes(),
+        "mem_bytes_by_tag": pmem.tag_bytes(),
+        # wall not burned compiling: serving's goodput analog (the
+        # warmup should have left this at 1.0)
+        "goodput_frac": round(max(0.0, 1.0 - compile_s / dt), 4),
     }
 
 
@@ -874,6 +906,11 @@ def bench_decode(on_tpu: bool):
                 pass                       # shed under overload
         done_tokens.append(n)
 
+    from paddle_tpu.profiler import memscope as pmem
+    was_mem = pmem.active
+    pmem.enable()
+    engine._note_memory_tags()
+    c0 = pmem.compile_count()
     threads = [threading.Thread(target=client, args=(i,))
                for i in range(clients)]
     t0 = time.perf_counter()
@@ -882,7 +919,13 @@ def bench_decode(on_tpu: bool):
     for t in threads:
         t.join()
     dt = time.perf_counter() - t0
+    pmem.on_phase("bench")              # one census point at peak load
+    compile_s = pmem.compile_seconds(c0)
+    mem_peak = pmem.peak_bytes()
+    mem_tags = pmem.tag_bytes()
     engine.close()
+    if not was_mem:
+        pmem.disable()
     generated = sum(done_tokens)
     ttft = pm.get("serving.ttft_ms").snapshot()
     itl = pm.get("serving.inter_token_ms").snapshot()
@@ -901,6 +944,9 @@ def bench_decode(on_tpu: bool):
         "slots": slots,
         "clients": clients,
         "compiles": compiles.value if compiles else 0,
+        "peak_hbm_bytes": mem_peak,
+        "mem_bytes_by_tag": mem_tags,
+        "goodput_frac": round(max(0.0, 1.0 - compile_s / dt), 4),
     }
     try:
         result["paged"] = bench_paged_decode(net, cfg, on_tpu)
@@ -985,6 +1031,11 @@ def bench_paged_decode(net, cfg, on_tpu: bool):
                 sheds.append(tid)        # pool exhausted: typed shed
         done_tokens.append(n)
 
+    from paddle_tpu.profiler import memscope as pmem
+    was_mem = pmem.active
+    pmem.enable()
+    engine._note_memory_tags()
+    c0 = pmem.compile_count()
     threads = [threading.Thread(target=client, args=(i,))
                for i in range(clients)]
     t0 = time.perf_counter()
@@ -993,7 +1044,14 @@ def bench_paged_decode(net, cfg, on_tpu: bool):
     for t in threads:
         t.join()
     dt = time.perf_counter() - t0
+    pmem.on_phase("bench")              # one census point at peak load
+    compile_s = pmem.compile_seconds(c0)
+    mem_peak = pmem.peak_bytes()
+    mem_tags = pmem.tag_bytes()
+    mem_breakdown = engine.memory_breakdown()
     engine.close()
+    if not was_mem:
+        pmem.disable()
     generated = sum(done_tokens)
     ttft = pm.get("paged.ttft_ms").snapshot()
     occ = pm.get("paged.decode.occupancy").snapshot()
@@ -1036,6 +1094,10 @@ def bench_paged_decode(net, cfg, on_tpu: bool):
         "clients": clients,
         "compiles": pm.get("paged.compile").value
         if pm.get("paged.compile") else 0,
+        "peak_hbm_bytes": mem_peak,
+        "mem_bytes_by_tag": mem_tags,
+        "mem_breakdown": mem_breakdown,
+        "goodput_frac": round(max(0.0, 1.0 - compile_s / dt), 4),
     }
 
 

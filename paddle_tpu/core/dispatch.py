@@ -324,6 +324,9 @@ def dispatch(op_name: str, fn: Callable, tensor_args: Sequence, kwargs: dict):
                 else closed(*arrays)
             node = None
     except Exception as e:  # enforce-style op context (enforce.h:422)
+        from ..profiler import memscope as _memscope
+        if _memscope.active and _memscope.is_oom(e):
+            _memscope.oom_dump(e, context=f"dispatch:{op_name}")
         from .errors import tag_op_error
         tag_op_error(op_name, e)
 

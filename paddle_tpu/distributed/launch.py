@@ -661,6 +661,8 @@ def _supervised_loop(args, tail, pod_ref):
     shrinks = 0         # world-shrinking relaunches: NOT failures
     generation = 0
     rdzv_rounds = 0
+    downtime_s = 0.0    # failure-detected -> next gang up (restart
+    down_t0 = None      # badput the workers can't see themselves)
     # stable host-slot labels: rank numbering is contiguous per
     # generation, but eviction identity must survive renumbering.
     # Host-qualified so a multi-host job's shared deny prefix can't
@@ -700,6 +702,9 @@ def _supervised_loop(args, tail, pod_ref):
             })
             pod_ref["pod"] = pod
             pod.launch()
+            if down_t0 is not None:
+                downtime_s += time.time() - down_t0
+                down_t0 = None
             kind, detail, victim = pod.supervise(
                 store, job, watchdog, generation=generation,
                 straggler=tracker,
@@ -709,6 +714,7 @@ def _supervised_loop(args, tail, pod_ref):
             if kind == "done":
                 outcome = {"kind": "done", "code": 0}
                 return 0
+            down_t0 = time.time()
             # host-loss attribution: a signal death, a stall, or an
             # evicted straggler means the HOST is gone/useless; a plain
             # nonzero exit is a software crash on a healthy host
@@ -780,6 +786,9 @@ def _supervised_loop(args, tail, pod_ref):
                            "flight_dir": flight_dir,
                            "flight_dumps": _collect_flight_dumps(
                                flight_dir, min_mtime=flight_t0),
+                           "downtime_s": round(downtime_s, 3),
+                           "goodput": _collect_goodput(
+                               flight_dir, min_mtime=flight_t0),
                            **outcome}, f)
         if metrics_server is not None:
             metrics_server.stop()
@@ -818,6 +827,31 @@ def _collect_flight_dumps(flight_dir: str, tail: int = 10,
                      "counts": doc.get("counts") or {},
                      "tail": [f"{e.get('cat')}.{e.get('event')}"
                               for e in evs[-tail:]]}
+    return out
+
+
+def _collect_goodput(flight_dir: str, min_mtime: float = 0.0):
+    """Fold the workers' ``goodput.r<rank>.g<gen>.json`` docs (written
+    by ``profiler.memscope.GoodputMeter.finish``) into the supervise
+    report, so one file answers "how much of the run's wall-clock was
+    productive step time" across restarts.  Same mtime fence as the
+    flight dumps."""
+    out = {}
+    try:
+        names = sorted(os.listdir(flight_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("goodput.") and name.endswith(".json")):
+            continue
+        path = os.path.join(flight_dir, name)
+        try:
+            if os.path.getmtime(path) < min_mtime:
+                continue
+            with open(path) as f:
+                out[name] = json.load(f)
+        except (OSError, ValueError):
+            continue
     return out
 
 

@@ -26,6 +26,7 @@ from ..core import autograd
 from ..core.random import default_generator, rng_scope
 from ..core.tensor import Tensor, to_tensor
 from ..metric import Metric
+from ..profiler import memscope as _memscope
 from ..profiler import metrics as _metrics
 from ..profiler import tracer as _obs
 from ..utils import chaos as _chaos
@@ -316,6 +317,8 @@ class Model:
         else:
             split_chain = False
         lr = self._lr_device()
+        fresh_step = step is None
+        aot_hit = False
         if step is None:
             step = self._build_jit_train_step(len(inputs), len(labels))
             from ..utils import artifact_store as _aot
@@ -334,6 +337,7 @@ class Model:
                         step.lower(params, buffers, opt._fn_state,
                                    key_base, rng_ctr, *([lr] + arrays)),
                         label="hapi.train_step")
+                    aot_hit = True   # ledger entry recorded by the store
                 except Exception:   # noqa: BLE001 — jit fallback
                     step = self._build_jit_train_step(len(inputs),
                                                       len(labels))
@@ -343,13 +347,26 @@ class Model:
         # in-flight queue), so its duration is the per-step "device"
         # phase; fit subtracts it from the body time to get "host"
         _d0 = _obs.now_ns() if _obs.active else 0
+        # compile ledger: a fresh jit step compiles inside its first
+        # dispatch; time that call so the ledger carries the wall cost
+        # (the AOT path records its own entry through the store)
+        _m0 = _obs.now_ns() if (_memscope.active and fresh_step
+                                and not aot_hit) else 0
         try:
             loss, outs, new_buffers, new_params, new_state, new_ctr = \
                 step(params, buffers, opt._fn_state, key_base, rng_ctr,
                      *([lr] + arrays))
-        except Exception:
+        except Exception as e:
             net.load_functional_state(params, buffers)  # drop leaked tracers
+            if _memscope.active and _memscope.is_oom(e):
+                # OOM forensics: census + flight ring land in
+                # PADDLE_FLIGHT_DIR before the error re-raises
+                _memscope.oom_dump(e, context="hapi.train_step")
             raise
+        if _m0:
+            _memscope.compile_record(
+                "hapi.train_step", sig,
+                (_obs.now_ns() - _m0) / 1e9, provenance="jit")
         if _d0:
             self._last_dispatch_ns = _obs.on_step_phase("device", _d0)
         if not split_chain:
@@ -837,6 +854,18 @@ class Model:
                         0, info["label"] - info["step"])
 
         cbks.on_train_begin()
+        # memscope goodput/attribution layer: one predicate read per
+        # hook when FLAGS_mem_accounting is off (`_gp is None` below)
+        _gp = None
+        _tagged_opt = False
+        if _memscope.active:
+            try:
+                _memscope.set_tag_bytes(
+                    "params",
+                    _memscope.tree_nbytes(self.network.functional_state()))
+            except Exception:   # noqa: BLE001 — accounting never throws
+                pass
+            _gp = _memscope.GoodputMeter("train").start()
         step_count = 0
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
@@ -855,13 +884,17 @@ class Model:
                     # is the time this loop blocked on the input
                     # pipeline; with prefetch warm it is ~queue-pop
                     trace = _obs.active
-                    _tw0 = _obs.now_ns() if trace else 0
+                    _tw0 = _obs.now_ns() if (trace or _gp is not None) \
+                        else 0
                     try:
                         batch = next(it)
                     except StopIteration:
                         break
-                    if trace:
-                        _obs.on_step_phase("data_wait", _tw0)
+                    if _tw0:
+                        _dw = _obs.on_step_phase("data_wait", _tw0) \
+                            if trace else _obs.now_ns() - _tw0
+                        if _gp is not None:
+                            _gp.add_ns("data_wait", _dw)
                     step += 1
                     if resume_samples is not None:
                         # cross-world resume: replay the data order,
@@ -921,6 +954,7 @@ class Model:
                         # pre-step copies (the jit step donates its
                         # inputs); this is the guard's per-step cost
                         snap = self._state_refs()
+                    _s0 = _obs.now_ns() if _gp is not None else 0
                     if accumulate_grad_batches > 1:
                         # grad accumulation rides the eager tape:
                         # backward accumulates into .grad, step fires on
@@ -931,6 +965,20 @@ class Model:
                                                        update=update)
                     else:
                         logs = self.train_batch(ins, lbls)
+                    if _s0:
+                        _gp.step_ns(_obs.now_ns() - _s0)
+                        if not trace:
+                            # tracer off: the phase hooks don't run, so
+                            # sample the step watermark here
+                            _memscope.on_phase("step")
+                        if not _tagged_opt:
+                            _tagged_opt = True
+                            st = getattr(self._optimizer, "_fn_state",
+                                         None)
+                            if st is not None:
+                                _memscope.set_tag_bytes(
+                                    "opt_state",
+                                    _memscope.tree_nbytes(st))
                     if _t0:
                         _obs.on_hapi_step(_t0, num_samples=_batch_len(ins),
                                           mode="train")
@@ -943,8 +991,12 @@ class Model:
                         # trade against the lazy-loss pipeline)
                         v = float(logs["loss"])
                         if not np.isfinite(v):
+                            _a0 = _obs.now_ns() if _gp is not None else 0
                             self._handle_anomaly(anomaly, v, step_count,
                                                  snap, checkpointer)
+                            if _a0:
+                                _gp.add_ns("anomaly",
+                                           _obs.now_ns() - _a0)
                             logs["loss"] = v
                     if _chaos.active:
                         # host.slow: deterministic per-rank slowdown of
@@ -965,8 +1017,12 @@ class Model:
                         # steps stay sync-free.  The directory label
                         # carries the elastic offset; the tree's meta
                         # records the true new-grid step count
+                        _c0 = _obs.now_ns() if _gp is not None else 0
                         checkpointer.save(save_label,
                                           self._ckpt_tree(step_count))
+                        if _c0:
+                            _gp.add_ns("checkpoint",
+                                       _obs.now_ns() - _c0)
                     # reference hapi: callbacks see the ACTUAL batch
                     # size so ips stays honest on the final partial
                     # batch
@@ -1000,8 +1056,18 @@ class Model:
         if checkpointer is not None:
             # the final step's async write must land before fit returns
             # (a supervisor relaunch right after fit would otherwise
-            # resume one step short)
+            # resume one step short) — goodput charges the drain to the
+            # checkpoint bucket: a chaos-delayed ckpt.write stalls HERE
+            _c0 = _obs.now_ns() if _gp is not None else 0
             checkpointer.wait_until_finished()
+            if _c0:
+                _gp.add_ns("checkpoint", _obs.now_ns() - _c0)
+        if _gp is not None:
+            # train.goodput.* gauges + the goodput.r<rank>.g<gen>.json
+            # doc the PR 9 supervise report folds in
+            self._last_goodput = _gp.finish(
+                extra={"steps": step_count,
+                       "samples": self._fit_samples_seen})
 
     def _split_batch(self, batch):
         if isinstance(batch, (list, tuple)):

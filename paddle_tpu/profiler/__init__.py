@@ -24,6 +24,7 @@ import jax
 
 from ..utils import flags as _flags
 from . import flight  # noqa: F401  (always-on flight recorder)
+from . import memscope  # noqa: F401 (device-memory accounting / goodput)
 from . import metrics  # noqa: F401  (public submodule: paddle.profiler.metrics)
 from . import rtrace  # noqa: F401   (per-request distributed tracing)
 from . import tracer  # noqa: F401   (public submodule: paddle.profiler.tracer)
@@ -31,7 +32,8 @@ from . import tracer  # noqa: F401   (public submodule: paddle.profiler.tracer)
 __all__ = ["Profiler", "ProfilerState", "make_scheduler", "RecordEvent",
            "enable_host_tracer", "disable_host_tracer",
            "export_chrome_tracing", "profiler", "start_profiler",
-           "stop_profiler", "metrics", "tracer", "rtrace", "flight"]
+           "stop_profiler", "metrics", "tracer", "rtrace", "flight",
+           "memscope"]
 
 _active = {"dir": None}
 _hint = {"device_trace": False}   # one-shot behavior-change notices

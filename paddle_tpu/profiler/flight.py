@@ -63,6 +63,11 @@ __all__ = ["active", "note", "events", "counts", "clear", "dump",
 # module-level fast predicate — the single read every site gates on
 active = True
 
+# the rtrace module, late-bound by rtrace itself at import (flight
+# sits below it in the import order); lets note() stamp events with
+# the ambient request identity when request tracing is live
+_rtrace = None
+
 # ring of (t_unix, category, event, fields-or-None); deque.append and
 # the maxlen-driven eviction are single bytecode ops under the GIL, so
 # concurrent writers (scheduler, workers, signal handlers, the lock
@@ -86,7 +91,17 @@ def note(cat: str, event: str, **fields):
     """Record one structured event.  Callers gate on the module
     predicate (``if flight.active:``) so a disabled recorder costs one
     read; the fields dict should hold only small scalars/strings —
-    this is a black box, not a log stream."""
+    this is a black box, not a log stream.
+
+    When request tracing is live and the calling thread is inside a
+    request hop (rtrace ambient context), the event is stamped with
+    that request's id so ``tools/trace_summary.py --request`` can fold
+    flight tails into the rtrace waterfall."""
+    rt = _rtrace
+    if rt is not None and rt.active and "request_id" not in fields:
+        ctx = rt.current()
+        if ctx is not None and ctx.request_id:
+            fields["request_id"] = ctx.request_id
     _ring.append((time.time(), cat, event, fields or None))
 
 

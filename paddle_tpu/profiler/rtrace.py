@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import os
 import re
+import sys
+import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..utils import flags as _flags
@@ -40,7 +42,8 @@ from . import tracer as _tracer
 
 __all__ = ["active", "enable", "disable", "configure", "TraceContext",
            "new_trace_id", "new_span_id", "parse_traceparent",
-           "record_span", "batch_span", "request_spans"]
+           "record_span", "batch_span", "request_spans",
+           "set_current", "current"]
 
 # module-level fast predicate — the single read every hop gates on
 active = False
@@ -69,6 +72,22 @@ def configure():
     ``set_flags({"FLAGS_request_trace": 1})`` takes effect live)."""
     global active
     active = bool(_flags.get_flag("FLAGS_request_trace"))
+
+
+# ambient per-thread context: while a hop is processing one request,
+# its TraceContext is bound here so layers with no request in hand
+# (the block pool, the flight recorder) can stamp events with the
+# request identity.  Engine hops set/clear it gated on `active`.
+_tls = threading.local()
+
+
+def set_current(ctx: Optional["TraceContext"]):
+    """Bind (or clear, with None) the thread's live request context."""
+    _tls.ctx = ctx
+
+
+def current() -> Optional["TraceContext"]:
+    return getattr(_tls, "ctx", None)
 
 
 def new_trace_id() -> str:
@@ -209,3 +228,10 @@ def request_spans(events: Optional[List[tuple]] = None,
 
 _flags.on_change(configure)
 configure()
+
+# register with the flight recorder so flight.note can stamp events
+# with the ambient request identity (late-bound attribute rather than
+# an import: flight sits below rtrace in the import order)
+from . import flight as _flight  # noqa: E402
+
+_flight._rtrace = sys.modules[__name__]

@@ -160,6 +160,11 @@ def on_step_phase(phase: str, start_ns: int, end_ns: Optional[int] = None,
     dt = end_ns - start_ns
     _metrics.histogram(f"{mode}.step.{phase}_ms").observe(dt / 1e6)
     _metrics.counter(f"{mode}.step.{phase}_ns").inc(dt)
+    # memscope peak watermark rides the phase boundary (one predicate
+    # read when memory accounting is off)
+    from . import memscope as _memscope
+    if _memscope.active:
+        _memscope.on_phase(phase)
     return dt
 
 
@@ -184,6 +189,9 @@ def on_serving_phase(name: str, start_ns: int,
     if end_ns is None:
         end_ns = time.perf_counter_ns()
     record(f"serve::{name}", start_ns, end_ns, cat="serving")
+    from . import memscope as _memscope
+    if _memscope.active:
+        _memscope.on_phase(name)
     return end_ns - start_ns
 
 

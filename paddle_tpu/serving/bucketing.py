@@ -145,6 +145,7 @@ class ExecutableCache:
     """
 
     def __init__(self, name: str = "serving"):
+        self._name = name
         self._lock = _conc.Lock(name=f"{name}.executable_cache")
         self._entries: Dict[Tuple, object] = {}
         self._inflight: Dict[Tuple, threading.Event] = {}
@@ -181,7 +182,16 @@ class ExecutableCache:
                 latch.wait()
                 continue  # re-read: owner published (or failed)
             try:
-                exe = compile_fn()
+                from ..profiler import memscope as _memscope
+                if _memscope.active:
+                    import time as _time
+                    _c0 = _time.perf_counter()
+                    exe = compile_fn()
+                    _memscope.compile_record(
+                        self._name, key, _time.perf_counter() - _c0,
+                        provenance="jit")
+                else:
+                    exe = compile_fn()
                 with self._lock:
                     self._entries[key] = exe
                     # under the lock: the registry's inc is lock-free

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from ..profiler import flight as _flight
+from ..profiler import memscope as _memscope
 from ..profiler import rtrace as _rtrace
 from ..profiler import tracer as _tracer
 from ..utils import concurrency as _conc
@@ -374,6 +375,20 @@ class InferenceEngine:
             f"{self.metrics_prefix}.warmed_buckets",
             "bucket executables pre-populated at engine construction"
         ).set(self.warmed_buckets)
+
+    def memory_breakdown(self) -> Dict[str, int]:
+        """The ``/healthz`` memory fields for the batch engine:
+        resident parameter bytes plus the process peak census."""
+        try:
+            pb = _memscope.tree_nbytes(
+                getattr(self._base, "_params", None) or {})
+        except Exception:   # noqa: BLE001 — health must never raise
+            pb = 0
+        return {
+            "mem_params_bytes": int(pb),
+            "mem_peak_step_bytes":
+                int(_memscope.peak_bytes()) if _memscope.active else 0,
+        }
 
     # -- client surface ------------------------------------------------
     def submit(self, inputs, deadline_ms: Optional[float] = "default",
@@ -1192,6 +1207,40 @@ class GenerationEngine:
     def _init_slot_state(self):
         self._caches = self.session.init_caches()
         self._init_slot_arrays()
+        if _memscope.active:
+            self._note_memory_tags()
+
+    # -- memory accounting --------------------------------------------
+    def _kv_arena_bytes(self) -> int:
+        """Device bytes held by the KV store (the contiguous engine's
+        per-slot cache bank; the paged engine overrides with the
+        block-pool arena)."""
+        return _memscope.tree_nbytes(getattr(self, "_caches", None))
+
+    def _params_bytes(self) -> int:
+        try:
+            return _memscope.tree_nbytes(self.model.functional_state())
+        except Exception:       # noqa: BLE001 — accounting never throws
+            return 0
+
+    def _note_memory_tags(self):
+        """Attribute this engine's exactly-known footprints to the
+        memscope tags (callers gate on the predicate)."""
+        _memscope.set_tag_bytes("params", self._params_bytes())
+        _memscope.set_tag_bytes("kv_arena", self._kv_arena_bytes())
+
+    def memory_breakdown(self) -> Dict[str, int]:
+        """The ``/healthz`` memory fields: where this engine's HBM
+        goes, next to the ``kv_blocks_*`` capacity signals.  The peak
+        field samples the census only when accounting is armed, so
+        unflagged health probes stay attribute-math cheap."""
+        return {
+            "mem_params_bytes": self._params_bytes(),
+            "mem_kv_arena_bytes": self._kv_arena_bytes(),
+            "mem_prefix_cache_bytes": 0,
+            "mem_peak_step_bytes":
+                _memscope.peak_bytes() if _memscope.active else 0,
+        }
 
     def _token_reservation(self, prompt, max_new: int) -> int:
         """Tokens to reserve against the admission budget at submit —
@@ -1677,6 +1726,14 @@ class GenerationEngine:
             self._pending.clear()
         victims = pending + [r for r in self._slot_req if r is not None]
         self._slot_req = [None] * self.slots
+        if _memscope.active and _memscope.is_oom(exc):
+            # OOM forensics before the generic failure dump: census +
+            # pool occupancy + the flight ring, then victims fail with
+            # the original error exactly as before
+            _memscope.oom_dump(
+                exc, context=f"engine:{self.metrics_prefix}",
+                pool=getattr(self, "pool", None),
+                prefix_cache=getattr(self, "prefix_cache", None))
         if _flight.active:
             _flight.note("serve", "engine_failure",
                          engine=self.metrics_prefix,
@@ -1804,6 +1861,20 @@ class PagedGenerationEngine(GenerationEngine):
         self._g_spec_rate = _metrics.gauge(
             f"{p}.spec.accept_rate", "accepted/proposed draft ratio "
             "(engine lifetime)")
+        if _memscope.active:
+            self._note_memory_tags()
+
+    def _kv_arena_bytes(self) -> int:
+        # paged: the pre-allocated arena, not the live-array walk
+        return int(self.pool.num_blocks) * \
+            int(getattr(self.pool, "block_bytes", 0))
+
+    def memory_breakdown(self) -> Dict[str, int]:
+        out = super().memory_breakdown()
+        out["mem_prefix_cache_bytes"] = \
+            len(self.prefix_cache) * \
+            int(getattr(self.pool, "block_bytes", 0))
+        return out
 
     def _warmup(self):
         """Every chunk-width executable (one per pow2 suffix bucket +
@@ -1877,12 +1948,19 @@ class PagedGenerationEngine(GenerationEngine):
         cached = min(cached_len, plen - 1)
         fb = cached // bs               # first block this row writes
         total = blocks_for_tokens(plen, bs)
+        # bind the ambient request identity across the allocation so
+        # the pool's kv.exhausted flight event carries request_id
+        if _rtrace.active and req.ctx is not None:
+            _rtrace.set_current(req.ctx)
         try:
             fresh = self.pool.alloc(total - fb)
         except BlockPoolExhausted:
             if chain:
                 self.pool.decref(chain)
             raise
+        finally:
+            if _rtrace.active:
+                _rtrace.set_current(None)
         row = chain[:fb] + fresh
         cow = None
         if fb < len(chain):
@@ -1915,7 +1993,13 @@ class PagedGenerationEngine(GenerationEngine):
         have = len(req.blocks)
         if need <= have:
             return
-        fresh = self.pool.alloc(need - have)
+        if _rtrace.active and req.ctx is not None:
+            _rtrace.set_current(req.ctx)
+        try:
+            fresh = self.pool.alloc(need - have)
+        finally:
+            if _rtrace.active:
+                _rtrace.set_current(None)
         req.blocks.extend(fresh)
         self._table[slot, have:have + len(fresh)] = fresh
 
@@ -1928,6 +2012,16 @@ class PagedGenerationEngine(GenerationEngine):
         if slot is not None:
             self._slot_req[slot] = None
             self._table[slot, :] = -1
+        if _memscope.active:
+            # exhaustion forensics even though the shed is graceful:
+            # the dump says WHAT filled the pool when capacity planning
+            # asks later (one artifact per process; the flight event
+            # fires every time)
+            _memscope.oom_dump(
+                cause if isinstance(cause, BaseException)
+                else RuntimeError(str(cause)),
+                context=f"kv_shed:{self.metrics_prefix}",
+                pool=self.pool, prefix_cache=self.prefix_cache)
         if _flight.active:
             _flight.note("serve", "kv_shed",
                          engine=self.metrics_prefix, slot=slot,

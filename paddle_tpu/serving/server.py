@@ -210,6 +210,16 @@ class _Handler(BaseHTTPRequestHandler):
                         prefix_cache_hit_rate=round(
                             hits / (hits + misses), 6)
                         if (hits + misses) else 0.0)
+            # memory breakdown (whichever engine answers): where HBM
+            # went — params / kv arena / prefix cache / step peak —
+            # so capacity planning reads the ceiling off /healthz
+            mb = getattr(g if g is not None else engine,
+                         "memory_breakdown", None)
+            if mb is not None:
+                try:
+                    body.update(mb())
+                except Exception:   # noqa: BLE001 — health never 500s
+                    pass
             self._send_json(200, body)
         elif self.path == "/metrics":
             from ..profiler import metrics as _metrics

@@ -294,13 +294,23 @@ class ArtifactStore:
         """THE entry point: return a ready executable for ``lowered``,
         from the store when possible, compiling (and persisting) when
         not.  Always returns a callable executable."""
+        from ..profiler import memscope as _memscope
         fp = fingerprint_lowered(lowered, extra)
+        t0 = time.perf_counter() if _memscope.active else 0.0
         exe = self.get(fp)
         if exe is not None:
             _m("hit").inc()
+            if _memscope.active:
+                _memscope.compile_record(
+                    label or "aot", fp, time.perf_counter() - t0,
+                    provenance="store-hit", cause="cached")
             return exe
         _m("miss").inc()
         compiled = lowered.compile()
+        if _memscope.active:
+            _memscope.compile_record(
+                label or "aot", fp, time.perf_counter() - t0,
+                provenance="store-miss")
         self.put(fp, compiled, label=label)
         return compiled
 
@@ -344,6 +354,14 @@ def aot_compile(lowered, label: str = "", extra=()):
     here so a single flag warms them all."""
     store = active()
     if store is None:
+        from ..profiler import memscope as _memscope
+        if _memscope.active:
+            t0 = time.perf_counter()
+            exe = lowered.compile()
+            _memscope.compile_record(
+                label or "aot", fingerprint_lowered(lowered, extra),
+                time.perf_counter() - t0, provenance="no-store")
+            return exe
         return lowered.compile()
     return store.load_or_compile(lowered, label=label, extra=extra)
 

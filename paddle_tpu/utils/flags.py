@@ -266,6 +266,18 @@ define_flag("FLAGS_request_trace", False,
             "causality).  Off (the default) costs one predicate read "
             "per hop; tools/trace_summary.py --request <id> renders "
             "the per-request waterfall")
+define_flag("FLAGS_mem_accounting", False,
+            "device-memory accounting + goodput telemetry "
+            "(profiler/memscope.py): tagged live-byte attribution "
+            "(params / opt_state / kv_arena / prefix_cache / "
+            "activations / prefetch) via a live-array census, "
+            "per-step-phase peak watermarks, a compile/retrace ledger "
+            "with cause + artifact-store provenance, Model.fit "
+            "goodput fractions (train.goodput.* gauges, folded into "
+            "PADDLE_SUPERVISE_REPORT), and RESOURCE_EXHAUSTED "
+            "forensics dumps (census + pool occupancy + flight ring "
+            "into PADDLE_FLIGHT_DIR, then the error re-raises).  Off "
+            "(the default) costs one predicate read per hook")
 define_flag("FLAGS_flight_recorder", True,
             "always-on flight recorder (profiler/flight.py): a "
             "lock-free bounded ring of structured events (admission "

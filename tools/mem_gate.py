@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""CI memory-accounting gate: goodput decomposition, checkpoint-badput
+attribution, and OOM forensics — the ISSUE-16 memscope layer, end to
+end in fresh subprocesses.
+
+Phase 1 (goodput): a supervised-style ``Model.fit`` with an
+AsyncCheckpointer under a fixed ``ckpt.write:delay`` chaos spec.  The
+delayed background writes must surface as checkpoint-bucket badput
+(the fit-end drain), the chaos injections must land at EXACT flight
+counts, the goodput fractions must sum to 1 +- 0.01, and the
+``goodput.r0.g0.json`` doc must land in PADDLE_FLIGHT_DIR.  The same
+subprocess first pins the zero-cost contract: with
+``FLAGS_mem_accounting=0`` a full fit leaves no ``mem.*`` /
+``*.goodput.*`` gauges and no compile-ledger entries — the hooks are
+one module-predicate read.
+
+Phase 2 (OOM forensics): a PagedGenerationEngine built on a
+deliberately tiny block pool is asked for a prompt that cannot fit.
+The typed ``BlockPoolExhausted`` shed must write the forensics
+artifact ``oom.r0.g0.json`` — census (what was resident, by tag),
+block-pool occupancy, prefix-cache occupancy, and the flight-ring
+tail — record a ``mem.oom`` flight event, and still answer the client
+with the typed ``RequestRejected(reason="kv_blocks")``.
+
+Wired into tools/run_all_tests.sh.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+GOODPUT = """
+import json, os, sys
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.profiler import flight, memscope, metrics
+
+out_path, ckpt_dir = sys.argv[1], sys.argv[2]
+STEPS = 6
+
+
+class Ds(paddle.io.Dataset):
+    def __init__(self):
+        r = np.random.RandomState(0)
+        self.x = r.rand(STEPS * 4, 8).astype("float32")
+        self.y = r.rand(STEPS * 4, 2).astype("float32")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def build():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                               paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 2))
+    m = paddle.Model(net)
+    m.prepare(paddle.optimizer.Adam(1e-2,
+                                    parameters=net.parameters()),
+              paddle.nn.MSELoss())
+    return m
+
+
+# -- zero-cost pin: accounting off => zero memscope artifacts ---------
+assert not memscope.active, "FLAGS_mem_accounting should default off"
+memscope.reset()
+build().fit(Ds(), batch_size=4, epochs=1, verbose=0, shuffle=False)
+leftovers = [n for n in metrics.snapshot()
+             if n.startswith("mem.") or ".goodput." in n]
+assert leftovers == [], f"memscope gauges with accounting off: {leftovers}"
+assert memscope.compile_count() == 0, \\
+    "compile ledger recorded entries with accounting off"
+
+# -- armed fit under delayed checkpoint writes ------------------------
+paddle.set_flags({"FLAGS_mem_accounting": 1,
+                  "FLAGS_flight_recorder": 1,
+                  "FLAGS_chaos_spec": "ckpt.write:delay=0.25@1-2"})
+flight.clear()
+m = build()
+ck = ckpt.AsyncCheckpointer(ckpt_dir, save_interval_steps=1)
+m.fit(Ds(), batch_size=4, epochs=1, verbose=0, shuffle=False,
+      checkpointer=ck)
+ck.close()
+doc = m._last_goodput
+assert doc is not None, "fit with accounting on left no goodput doc"
+
+fr = doc["fractions"]
+total = sum(fr.values())
+assert abs(total - 1.0) <= 0.01, f"fractions sum {total} != 1"
+assert doc["buckets_s"]["checkpoint"] >= 0.25, \\
+    f"delayed ckpt writes not charged to the checkpoint bucket: {doc}"
+assert fr["productive"] > 0, f"no productive time recorded: {doc}"
+assert doc["compiles"] >= 1, "first-step jit compile missing from ledger"
+
+counts = flight.counts()
+assert counts.get("chaos.ckpt.write") == 2, \\
+    f"expected exactly 2 ckpt.write injections, got {counts}"
+assert counts.get("mem.compile", 0) >= 1, \\
+    f"compile-ledger flight event missing: {counts}"
+
+gp_path = os.path.join(os.environ["PADDLE_FLIGHT_DIR"],
+                       "goodput.r0.g0.json")
+assert os.path.exists(gp_path), f"goodput doc not written to {gp_path}"
+with open(gp_path) as f:
+    exported = json.load(f)
+assert exported["fractions"] == doc["fractions"], \\
+    "exported goodput doc disagrees with the in-process one"
+g = metrics.get("train.goodput.productive")
+assert g is not None and g.value == fr["productive"], \\
+    "train.goodput.productive gauge missing or stale"
+
+ledger = memscope.compile_entries()
+assert any(e["site"] == "hapi.train_step" and e["cause"] == "new-site"
+           for e in ledger), f"train-step compile not in ledger: {ledger}"
+
+with open(out_path, "w") as f:
+    json.dump({"fractions": fr, "counts": counts}, f)
+print("goodput leg ok:", {k: round(v, 4) for k, v in fr.items()})
+"""
+
+OOM = """
+import json, os, sys
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.models import GPT, GPTConfig
+from paddle_tpu.profiler import flight, memscope
+
+paddle.seed(0)
+paddle.set_flags({"FLAGS_mem_accounting": 1,
+                  "FLAGS_flight_recorder": 1})
+flight.clear()
+
+net = GPT(GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=128, ffn_mult=2))
+# a pool of 2 blocks (32 tokens) serving a 40-token prompt: the
+# admission-time allocation MUST exhaust -> typed shed + forensics
+engine = serving.PagedGenerationEngine(
+    net, serving.GenerationEngineConfig(
+        max_slots=2, max_length=128, max_new_tokens=4, block_size=16,
+        num_blocks=2, prefix_cache_blocks=2, name="memgate"))
+try:
+    prompt = np.arange(1, 41, dtype=np.int32) % 90 + 1
+    try:
+        engine.generate(prompt, timeout=300)
+        raise AssertionError("40-token prompt fit in a 2-block pool?")
+    except serving.RequestRejected as e:
+        assert e.reason == "kv_blocks", \\
+            f"expected the typed kv_blocks shed, got {e.reason!r}"
+finally:
+    engine.close()
+
+counts = flight.counts()
+assert counts.get("mem.oom", 0) >= 1, \\
+    f"shed left no mem.oom flight event: {counts}"
+
+path = os.path.join(os.environ["PADDLE_FLIGHT_DIR"], "oom.r0.g0.json")
+assert os.path.exists(path), f"forensics dump not written to {path}"
+with open(path) as f:
+    doc = json.load(f)
+assert doc["context"] == "kv_shed:memgate", doc["context"]
+census = doc["census"]
+assert census["live_bytes_total"] > 0, "census empty in forensics dump"
+assert "params" in census["tags"] and census["tags"]["params"] > 0, \\
+    f"params tag missing from the dump census: {census['tags']}"
+pool = doc["pool"]
+assert pool["num_blocks"] == 2, pool
+assert pool["used"] == 0, f"shed leaked block refs: {pool}"
+assert "prefix_cache" in doc, "prefix-cache occupancy missing"
+evs = doc["flight"]["events"]
+assert any(e["cat"] == "mem" and e["event"] == "oom" for e in evs), \\
+    "flight tail in the dump lacks the mem.oom event itself"
+print("oom leg ok:", {"tags": census["tags"], "pool": pool})
+"""
+
+
+def run_leg(name, code, *argv):
+    with tempfile.TemporaryDirectory(prefix=f"memgate_{name}_") as d:
+        env = dict(os.environ)
+        env["PADDLE_FLIGHT_DIR"] = os.path.join(d, "flight")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        args = [a.replace("@TMP@", d) for a in argv]
+        p = subprocess.run(
+            [sys.executable, "-c", textwrap.dedent(code), *args],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=600)
+        sys.stdout.write(p.stdout)
+        if p.returncode != 0:
+            sys.stderr.write(p.stderr)
+            print(f"mem_gate: {name} leg FAILED", file=sys.stderr)
+            return False
+        return True
+
+
+def main():
+    ok = run_leg("goodput", GOODPUT, "@TMP@/out.json", "@TMP@/ckpt")
+    ok = run_leg("oom", OOM) and ok
+    if not ok:
+        return 1
+    print("mem_gate: all legs passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

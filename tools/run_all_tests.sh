@@ -123,4 +123,15 @@ python tools/obs_gate.py
 # onto 2 servers with row-union parity (no dup/drop, per-row
 # bit-exact).
 python tools/ps_gate.py
+# Memory-accounting gate (ISSUE 16 memscope layer): a Model.fit with
+# chaos-delayed checkpoint writes must decompose its wall-clock into
+# goodput fractions summing to 1 (the delays charged to the checkpoint
+# bucket, the first-step compile in the ledger, exact chaos counts in
+# the flight ring, the goodput doc exported to PADDLE_FLIGHT_DIR) with
+# zero-cost pinned when FLAGS_mem_accounting is off; a paged engine on
+# a deliberately tiny block pool must turn the typed kv_blocks shed
+# into an oom.r0.g0.json forensics artifact carrying the tagged
+# census, pool/prefix-cache occupancy, and the flight tail with its
+# mem.oom event.
+python tools/mem_gate.py
 exec python -m pytest tests/ -q --runslow "$@"

@@ -9,6 +9,9 @@ call count, total/avg/max duration and share of the traced wall time.
     python tools/trace_summary.py trace.json
     python tools/trace_summary.py trace.json -n 20 --sort avg --cat dispatch
     python tools/trace_summary.py trace.json --request <trace-or-request-id>
+    python tools/trace_summary.py trace.json --compiles
+    python tools/trace_summary.py trace.json --request <id> \\
+        --flight /tmp/flight/flight.r0.g0.json
 
 ``--request`` selects the per-request spans recorded by the rtrace
 layer (``cat="rtrace"``, matched on ``args.trace_id`` or
@@ -16,6 +19,13 @@ layer (``cat="rtrace"``, matched on ``args.trace_id`` or
 request's first span, duration, name, and the outcome/link fields —
 the single-request story (ingress -> admission -> queue -> prefill ->
 decode... -> egress) that the aggregate table averages away.
+``--flight`` (repeatable) folds flight-recorder dump events stamped
+with the same request id into that waterfall, so the operational
+verdicts (kv_shed, exhaustion, retire reason) line up with the spans.
+
+``--compiles`` prints the memscope compile-ledger view off the
+``cat="compile"`` spans: per site x cause x provenance, how many
+compiles and how much wall they burned.
 
 Pure stdlib so it runs anywhere the trace file lands (CI artifact
 viewers, dev laptops without the framework installed).
@@ -121,6 +131,80 @@ def format_waterfall(spans, ident):
     return "\n".join(lines)
 
 
+def compile_table(events):
+    """Per (site, cause, provenance) compile accounting off the
+    ``cat="compile"`` spans memscope mirrors into the trace ring."""
+    rows = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("cat") != "compile":
+            continue
+        a = e.get("args") or {}
+        site = e.get("name", "?").replace("compile::", "", 1)
+        k = (site, a.get("cause", "?"), a.get("provenance", "?"))
+        r = rows.setdefault(k, {"count": 0, "total_us": 0.0})
+        r["count"] += 1
+        r["total_us"] += float(e.get("dur", 0.0))
+    if not rows:
+        return "(no compile spans in trace — was FLAGS_mem_accounting " \
+               "on with the tracer live?)"
+    site_w = max([len(k[0]) for k in rows] + [8])
+    head = (f"{'site':<{site_w}} {'cause':<12} {'provenance':<11} "
+            f"{'count':>6} {'total_ms':>10}")
+    lines = [head, "-" * len(head)]
+    for (site, cause, prov), r in sorted(
+            rows.items(), key=lambda kv: kv[1]["total_us"],
+            reverse=True):
+        lines.append(f"{site:<{site_w}} {cause:<12} {prov:<11} "
+                     f"{r['count']:>6} {r['total_us'] / 1e3:>10.3f}")
+    return "\n".join(lines)
+
+
+def flight_events_for(paths, ident):
+    """Events from flight-recorder dump files whose ``request_id``
+    field matches ``ident`` (or a >=8-char prefix), as synthetic
+    zero-duration rtrace spans the waterfall can interleave.  Flight
+    timestamps are unix seconds; rtrace spans are perf_counter_ns —
+    different clocks — so folded events sort by their own time among
+    themselves and render with an ``[flight]`` marker instead of an
+    offset."""
+    out = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"trace_summary: skipping flight dump {path}: {e}",
+                  file=sys.stderr)
+            continue
+        for ev in doc.get("events") or []:
+            fields = ev.get("fields") or {}
+            rid = str(fields.get("request_id")
+                      or fields.get("request") or "")
+            if not rid:
+                continue
+            if rid == ident or (len(ident) >= 8
+                                and rid.startswith(ident)):
+                out.append({"t": float(ev.get("t", 0.0)),
+                            "name": f"{ev.get('cat')}.{ev.get('event')}",
+                            "fields": {k: v for k, v in fields.items()
+                                       if k not in ("request_id",
+                                                    "request")},
+                            "source": path})
+    out.sort(key=lambda e: e["t"])
+    return out
+
+
+def format_flight_tail(flight_evs):
+    if not flight_evs:
+        return ""
+    lines = ["", "flight events (same request, flight-recorder clock):"]
+    for ev in flight_evs:
+        extra = " ".join(f"{k}={v}" for k, v in ev["fields"].items())
+        lines.append(f"  [flight] {ev['t']:.3f} {ev['name']}"
+                     + (f"  ({extra})" if extra else ""))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", help="chrome-trace JSON file")
@@ -134,13 +218,27 @@ def main(argv=None):
     ap.add_argument("--request", default=None, metavar="ID",
                     help="print the span waterfall of one request "
                          "(trace_id / X-Request-Id, or a prefix)")
+    ap.add_argument("--flight", action="append", default=[],
+                    metavar="PATH",
+                    help="flight-recorder dump(s) to fold into the "
+                         "--request waterfall (repeatable)")
+    ap.add_argument("--compiles", action="store_true",
+                    help="print the compile-ledger table "
+                         "(cat='compile' spans: site/cause/provenance)")
     args = ap.parse_args(argv)
     with open(args.trace) as f:
         doc = json.load(f)
     events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    if args.compiles:
+        print(compile_table(events))
+        return 0
     if args.request:
         print(format_waterfall(request_spans(events, args.request),
                                args.request))
+        tail = format_flight_tail(
+            flight_events_for(args.flight, args.request))
+        if tail:
+            print(tail)
         return 0
     print(format_table(aggregate(events, cat=args.cat),
                        sort=args.sort, top=args.top))
