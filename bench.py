@@ -461,6 +461,22 @@ def bench_resnet(on_tpu: bool):
            "goodput_frac": best_goodput["fractions"]["productive"]
            if best_goodput else None}
     try:
+        # static planner estimate next to the measured ceiling: the
+        # plan covers fwd+bwd (no optimizer slots), so est/measured
+        # under Momentum runs a bit low by construction
+        from paddle_tpu.jit import InputSpec
+        plan = model.static_memory_plan(
+            mode="train",
+            input_spec=[InputSpec([B, 3, hw, hw], "float32", name="img")],
+            label_spec=[InputSpec([B, 1], "int32", name="label")])
+        out["static_peak_bytes_est"] = int(plan.peak_bytes)
+        if out["peak_hbm_bytes"]:
+            out["static_est_over_measured"] = round(
+                plan.peak_bytes / out["peak_hbm_bytes"], 3)
+    except Exception as e:
+        print(f"bench: resnet static memory plan failed: {e!r}",
+              file=sys.stderr)
+    try:
         # per-phase share of the step (conv/norm/elementwise/optimizer)
         # off the PR 1 tracer op table — same summary path as
         # tools/profile_resnet.py.  MFU-by-phase: phase share x leg MFU.
@@ -696,8 +712,29 @@ def bench_program_opt():
             raise AssertionError(
                 f"{name}: FLAGS_program_opt=1 output differs from "
                 "FLAGS_program_opt=0")
+        # static planner estimate vs memscope-measured replay peak on
+        # the same program — the golden-program calibration the memplan
+        # gate enforces in CI
+        mem = {}
+        try:
+            from paddle_tpu.static.passes.memory_plan import (
+                build_memory_plan, measured_replay)
+            plan = build_memory_plan(
+                prog,
+                feed_shapes={k: tuple(v.shape) for k, v in feed.items()},
+                feed_dtypes={k: str(v.dtype) for k, v in feed.items()},
+                fetch_names=[getattr(f, "name", f) for f in fetch])
+            replay = measured_replay(prog, feed, fetch)
+            mem = {"static_peak_bytes_est": int(plan.peak_bytes),
+                   "peak_hbm_bytes": int(replay["peak_bytes"]),
+                   "static_est_over_measured": round(
+                       plan.peak_bytes / max(1, replay["peak_bytes"]), 3)}
+        except Exception as e:
+            print(f"bench: {name} static memory plan failed: {e!r}",
+                  file=sys.stderr)
         return {
             "ops": len(prog.ops), "ops_after": len(optp.ops),
+            **mem,
             "const_folded": pm.counter(COUNTERS[0]).value,
             "cse_merged": pm.counter(COUNTERS[1]).value,
             "ops_fused": pm.counter(COUNTERS[2]).value,

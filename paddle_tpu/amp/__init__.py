@@ -18,7 +18,7 @@ from ..core.tensor import Tensor, to_tensor
 from ..ops.amp_ops import check_finite_and_unscale, update_loss_scaling
 
 __all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate",
-           "WHITE_LIST", "BLACK_LIST"]
+           "WHITE_LIST", "BLACK_LIST", "classify_op"]
 
 # ops that benefit from low precision (MXU ops)
 WHITE_LIST = {
@@ -34,6 +34,27 @@ BLACK_LIST = {
     "norm", "cumsum", "logsumexp", "softmax", "log_softmax", "erfinv",
     "rsqrt", "mse_loss",
 }
+
+def classify_op(op_type, custom_white_list=None, custom_black_list=None):
+    """``"white"`` / ``"black"`` / ``"grey"`` for one op type — the single
+    classification shared by eager ``auto_cast`` input casting and the
+    static ``amp_lint`` pass (static/passes/amp_lint.py), applying the
+    same custom-list precedence ``auto_cast.__init__`` does (a custom
+    entry moves the op out of the opposite default list)."""
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    if op_type in white:
+        return "white"
+    if op_type in black:
+        return "black"
+    return "grey"
+
 
 _state = threading.local()
 

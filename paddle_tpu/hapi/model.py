@@ -1165,6 +1165,82 @@ class Model:
                 os.path.exists(opt_path):
             self._optimizer.set_state_dict(framework_io.load(opt_path))
 
+    def static_memory_plan(self, mode="train", input_spec=None,
+                           label_spec=None, batch_size=1):
+        """Capture this model as a static Program and return its
+        :class:`~paddle_tpu.static.passes.memory_plan.MemoryPlan` — a
+        byte-accurate peak-HBM estimate with a per-op liveness timeline,
+        without allocating a single device buffer.
+
+        ``mode="eval"`` plans the forward pass only; ``mode="train"``
+        additionally runs :func:`static.append_backward` on the captured
+        loss, so activations pinned as vjp residuals and parameter
+        gradients are counted.  The train view covers forward+backward;
+        optimizer update state (momentum/variance slots) is not part of
+        the captured program, so the estimate undershoots a measured
+        training peak by roughly one extra parameter-sized buffer per
+        optimizer slot.
+
+        Specs default to the ``inputs=``/``labels=`` the Model was
+        constructed with; ``None``/``-1`` spec dims resolve to
+        ``batch_size``.
+        """
+        from ..jit.dy2static.program_translator import ProgramTranslator
+        from ..static import program as _prog_mod
+        from ..static.passes.memory_plan import build_memory_plan
+
+        specs = (_to_list(input_spec) if input_spec is not None
+                 else list(self._inputs or []))
+        if not specs:
+            raise ValueError(
+                "static_memory_plan needs input specs: pass input_spec= "
+                "or construct Model(net, inputs=[InputSpec(...)])")
+        lspecs = []
+        if mode == "train":
+            if self._loss is None:
+                raise ValueError(
+                    "static_memory_plan(mode='train') requires "
+                    "prepare(loss=...) first; use mode='eval' for a "
+                    "forward-only plan")
+            lspecs = (_to_list(label_spec) if label_spec is not None
+                      else list(self._labels or []))
+            if not lspecs:
+                raise ValueError(
+                    "static_memory_plan(mode='train') needs label specs: "
+                    "pass label_spec= or construct "
+                    "Model(net, inputs=..., labels=[InputSpec(...)])")
+        elif mode != "eval":
+            raise ValueError(f"mode must be 'train' or 'eval', got {mode!r}")
+
+        net, loss_fn, n_in = self.network, self._loss, len(specs)
+        if mode == "train":
+            def _capture(*args):
+                outs = _to_list(net.forward(*args[:n_in]))
+                return loss_fn(*(outs + list(args[n_in:])))
+        else:
+            def _capture(*args):
+                return net.forward(*args)
+
+        prog, feeds, fetch = ProgramTranslator.get_instance().get_program(
+            _capture, specs + lspecs)
+        fetch_names = [v.name for v in fetch]
+        if mode == "train":
+            # fetch the grads too: with only the loss fetched, liveness
+            # would mark every backward op dead and the plan would
+            # degenerate to the forward view
+            pairs = _prog_mod.append_backward(fetch[0])
+            fetch_names = [fetch[0].name] + [g.name for _, g in pairs]
+
+        feed_shapes, feed_dtypes = {}, {}
+        for v, spec in zip(feeds, specs + lspecs):
+            feed_shapes[v.name] = tuple(
+                batch_size if d in (None, -1) else int(d)
+                for d in spec.shape)
+            feed_dtypes[v.name] = str(getattr(spec, "dtype", "float32"))
+        return build_memory_plan(prog, feed_shapes=feed_shapes,
+                                 feed_dtypes=feed_dtypes,
+                                 fetch_names=fetch_names)
+
     def parameters(self, *args, **kwargs):
         return self.network.parameters()
 

@@ -76,7 +76,7 @@ __all__ = ["active", "enable", "disable", "configure",
 active = False
 
 KNOWN_TAGS = ("params", "opt_state", "kv_arena", "prefix_cache",
-              "activations", "prefetch")
+              "activations", "prefetch", "grads")
 
 _lock = threading.RLock()
 _tag_bytes: Dict[str, int] = {}
@@ -199,6 +199,29 @@ def add_tag_bytes(name: str, delta) -> int:
         _tag_bytes[name] = cur
     _tag_gauge(name).set(cur)
     return cur
+
+
+def record_plan(plan_doc: Dict) -> None:
+    """Export a static memory plan (``MemoryPlan.to_doc()`` from
+    static/passes/memory_plan.py) as ``mem.plan.*`` gauges, so the
+    planner's *estimate* sits next to the census's *measurement* on the
+    same ``/metrics`` surface: ``mem.plan.peak_bytes_est`` against
+    ``mem.peak_bytes.*`` watermarks, ``mem.plan.<tag>_bytes_est``
+    against ``mem.live_bytes.<tag>``."""
+    _metrics.gauge(
+        "mem.plan.peak_bytes_est",
+        "static memory planner peak-HBM estimate for the most recently "
+        "planned Program (bytes)").set(int(plan_doc.get("peak_bytes", 0)))
+    _metrics.gauge(
+        "mem.plan.static_bytes_est",
+        "static memory planner always-resident bytes (params + "
+        "constants + optimizer state + feeds)").set(
+        int(plan_doc.get("static_bytes", 0)))
+    for tag, v in (plan_doc.get("by_tag_at_peak") or {}).items():
+        _metrics.gauge(
+            f"mem.plan.{tag}_bytes_est",
+            f"static memory planner '{tag}' bytes at the estimated "
+            "peak op").set(int(v))
 
 
 @contextlib.contextmanager

@@ -13,6 +13,9 @@ Built-in passes (all registered in ``PassRegistry``):
 - ``cse``                  merge identical pure ops (transform pass)
 - ``fusion_group``         collapse elementwise chains into one region
 - ``spmd_collective_lint`` Megatron placement / collective ordering
+- ``memory_plan``          byte-accurate live-set timeline + peak-HBM
+- ``amp_lint``             dtype-flow precision lint (AMP01-AMP04)
+- ``program_remat``        recompute-in-backward rewrite (transform)
 
 Entry points: ``run_passes(program, names, ctx)`` for composition,
 ``analyze(program, ...)`` for the all-analysis bundle Executor-side
@@ -34,6 +37,10 @@ from .optimize import (ConstantFoldPass, CsePass, FusionGroupPass,
                        OPT_PASS_PIPELINE, ELEMENTWISE_OPS)
 from .spmd_lint import (SpmdCollectiveLintPass, lint_hlo_collectives,
                         lint_spmd_train_step, HloCollective)
+from .memory_plan import (MemoryPlan, MemoryPlanPass, build_memory_plan,
+                          measured_replay, PLAN_TAGS)
+from .amp_lint import AmpLintPass, CastPlan
+from .remat import RematPass, find_remat_chains, apply_remat_chain
 
 __all__ = ["Diagnostic", "Pass", "PassContext", "PassRegistry",
            "PassResult", "ProgramVerificationError", "register_pass",
@@ -42,12 +49,15 @@ __all__ = ["Diagnostic", "Pass", "PassContext", "PassRegistry",
            "DeadOpEliminationPass", "ConstantFoldPass", "CsePass",
            "FusionGroupPass", "OPT_PASS_PIPELINE", "ELEMENTWISE_OPS",
            "SpmdCollectiveLintPass",
+           "MemoryPlan", "MemoryPlanPass", "build_memory_plan",
+           "measured_replay", "PLAN_TAGS", "AmpLintPass", "CastPlan",
+           "RematPass", "find_remat_chains", "apply_remat_chain",
            "find_dead_ops", "lint_hlo_collectives",
            "lint_spmd_train_step", "HloCollective", "analyze",
            "AnalysisReport", "ERROR", "WARNING", "INFO"]
 
 _ANALYSIS_PASSES = ("verify", "shape_inference", "liveness_report",
-                    "spmd_collective_lint")
+                    "spmd_collective_lint", "memory_plan", "amp_lint")
 
 
 class AnalysisReport:
@@ -82,6 +92,20 @@ class AnalysisReport:
             if r.pass_name in ("liveness_report", "dead_op_eliminate"):
                 return r.dead_ops
         return []
+
+    @property
+    def memory_plan(self):
+        for r in self.results:
+            if r.memory_plan is not None:
+                return r.memory_plan
+        return None
+
+    @property
+    def cast_plan(self):
+        for r in self.results:
+            if r.cast_plan is not None:
+                return r.cast_plan
+        return None
 
     def ok(self) -> bool:
         return not self.errors

@@ -1,0 +1,211 @@
+"""Dtype-flow precision lint over captured Programs.
+
+Forward dtype propagation comes straight from the shape-inference pass
+(``jax.eval_shape`` runs every captured impl, so the inferred aval
+dtypes ARE the dtype flow — including promotion the impls perform), and
+op precision classes come from the eager AMP lists
+(``amp.classify_op`` over ``WHITE_LIST`` / ``BLACK_LIST``), so the
+static lint and ``auto_cast`` can never disagree about what is safe in
+low precision.
+
+Rules (each a :class:`Diagnostic` code):
+
+- **AMP01** — numerically sensitive reduction/normalization op
+  (black-list class) consuming 16-bit float inputs: reductions
+  accumulate error in bf16/fp16 and auto_cast would have kept them
+  fp32.
+- **AMP02** — float16 gradients flow through a program with no loss
+  scaling op (``check_finite_and_unscale`` / ``update_loss_scaling``):
+  fp16 grads underflow without a GradScaler.  bfloat16 grads don't
+  trip this (same exponent range as fp32).
+- **AMP03** — double-cast round trip: ``cast`` whose producer is
+  another ``cast`` and whose output dtype equals the original input
+  dtype — the pair is a bandwidth-only no-op (and a precision
+  truncation when the intermediate is narrower).
+- **AMP04** — ``cast`` applied to a parameter or constant: the same
+  static tensor is re-cast every step; hoist the cast out of the
+  program (pre-cast the parameter, or run under ``auto_cast`` O2).
+
+The pass also emits a :class:`CastPlan` (``PassResult.cast_plan`` /
+``AnalysisReport.cast_plan``): a per-op precision decision table whose
+``to_auto_cast_lists()`` output plugs directly into
+``auto_cast(custom_white_list=..., custom_black_list=...)``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ...amp import classify_op
+from .pass_base import Pass, PassContext, PassResult, register_pass
+from .shape_inference import ShapeInferencePass
+
+__all__ = ["AmpLintPass", "CastPlan"]
+
+_LOW = (jnp.float16, jnp.bfloat16)
+_SCALER_OPS = frozenset({"check_finite_and_unscale", "update_loss_scaling"})
+# dtype/data plumbing: never worth naming in auto_cast custom lists
+_PLUMBING = frozenset({"cast", "assign", "fill_constant", "reshape",
+                       "squeeze", "unsqueeze", "flatten", "transpose"})
+
+
+class CastPlan:
+    """Per-op precision decisions derived from the shared AMP classes
+    plus the observed dtype flow."""
+
+    __slots__ = ("decisions", "low_dtype")
+
+    def __init__(self, low_dtype: str = "bfloat16"):
+        self.low_dtype = low_dtype
+        # rows: {"idx", "type", "class", "target", "in_dtypes"}
+        self.decisions: List[Dict] = []
+
+    def to_auto_cast_lists(self) -> Dict[str, List[str]]:
+        """Custom lists for ``amp.auto_cast``: white = op types planned
+        low-precision, black = op types pinned fp32.  Grey ops already
+        observed running on 16-bit inputs are promoted to the white
+        list — the program demonstrates they tolerate it."""
+        white = set()
+        black = set()
+        for d in self.decisions:
+            if d["target"] == self.low_dtype:
+                white.add(d["type"])
+            elif d["target"] == "float32":
+                black.add(d["type"])
+        return {"custom_white_list": sorted(white - black),
+                "custom_black_list": sorted(black)}
+
+    def to_doc(self) -> Dict:
+        return {"kind": "cast_plan", "low_dtype": self.low_dtype,
+                "decisions": list(self.decisions),
+                "auto_cast_lists": self.to_auto_cast_lists()}
+
+    def __repr__(self):
+        lists = self.to_auto_cast_lists()
+        return (f"CastPlan({len(self.decisions)} ops, "
+                f"white={lists['custom_white_list']}, "
+                f"black={lists['custom_black_list']})")
+
+
+def _dtype_of(inferred, name) -> Optional[object]:
+    a = inferred.get(name)
+    return getattr(a, "dtype", None) if a is not None else None
+
+
+@register_pass("amp_lint")
+class AmpLintPass(Pass):
+    """AMP01-AMP04 over the inferred dtype flow + CastPlan emission."""
+
+    def run(self, program, context: PassContext, result: PassResult):
+        scratch = PassResult("shape_inference")
+        ShapeInferencePass().run(
+            program,
+            PassContext(feed_shapes=context.feed_shapes,
+                        feed_dtypes=context.feed_dtypes,
+                        fetch_names=context.fetch_names),
+            scratch)
+        inferred = scratch.inferred
+        if not inferred:
+            result.warning(
+                "amp-lint-skipped",
+                "shape inference produced no avals; dtype flow unknown")
+            return
+
+        statics = set(program.parameters) | set(program.constants)
+        producer: Dict[str, object] = {}
+        for op in program.ops:
+            for n in op.output_names:
+                producer.setdefault(n, op)
+
+        plan = CastPlan()
+        n_findings = 0
+        for op in program.ops:
+            if op.kind != "compute":
+                continue
+            in_dts = [_dtype_of(inferred, n) for n in op.input_names]
+            cls = classify_op(op.type)
+
+            # -- AMP01: black-list op fed 16-bit floats -------------------
+            low_ins = [n for n, d in zip(op.input_names, in_dts)
+                       if d in _LOW]
+            if cls == "black" and low_ins:
+                n_findings += 1
+                result.warning(
+                    "AMP01",
+                    f"numerically sensitive op '{op.type}' consumes "
+                    f"16-bit inputs {low_ins}: reductions/normalizations "
+                    "accumulate error in low precision — auto_cast keeps "
+                    "this op class fp32",
+                    op_idx=op.idx, op_type=op.type, var=low_ins[0])
+
+            if op.type == "cast":
+                src = op.input_names[0] if op.input_names else None
+                out = op.output_names[0] if op.output_names else None
+                out_dt = _dtype_of(inferred, out)
+                # -- AMP03: cast-of-cast round trip -----------------------
+                prev = producer.get(src)
+                if prev is not None and prev.type == "cast" and \
+                        prev.input_names:
+                    orig_dt = _dtype_of(inferred, prev.input_names[0])
+                    mid_dt = _dtype_of(inferred, src)
+                    if out_dt is not None and out_dt == orig_dt:
+                        n_findings += 1
+                        result.warning(
+                            "AMP03",
+                            f"cast round trip {orig_dt}->{mid_dt}->"
+                            f"{out_dt} via '{src}': the pair is a "
+                            "bandwidth-only no-op"
+                            + (" that silently truncates precision"
+                               if mid_dt in _LOW else ""),
+                            op_idx=op.idx, op_type=op.type, var=src)
+                # -- AMP04: per-step cast of a static tensor --------------
+                if src in statics:
+                    n_findings += 1
+                    result.warning(
+                        "AMP04",
+                        f"'{src}' is a "
+                        f"{'parameter' if src in program.parameters else 'constant'}"
+                        f" re-cast to {out_dt} every step: hoist the cast "
+                        "(pre-cast the tensor once, or decorate the model "
+                        "for O2)",
+                        op_idx=op.idx, op_type=op.type, var=src)
+
+            # -- cast plan row -------------------------------------------
+            if cls == "white":
+                target = plan.low_dtype
+            elif cls == "black":
+                target = "float32"
+            elif op.type not in _PLUMBING and \
+                    any(d in _LOW for d in in_dts):
+                # grey op already running on 16-bit inputs: plan it low
+                target = plan.low_dtype
+            else:
+                target = "follow"
+            plan.decisions.append({
+                "idx": op.idx, "type": op.type, "class": cls,
+                "target": target,
+                "in_dtypes": [str(d) if d is not None else None
+                              for d in in_dts]})
+
+        # -- AMP02: fp16 grads without a loss scaler ----------------------
+        has_scaler = any(op.type in _SCALER_OPS for op in program.ops)
+        fp16_grads = sorted(
+            n for n in inferred
+            if n.endswith("@GRAD")
+            and _dtype_of(inferred, n) == jnp.float16)
+        if fp16_grads and not has_scaler:
+            n_findings += 1
+            result.warning(
+                "AMP02",
+                f"float16 gradients {fp16_grads[:4]}"
+                f"{'...' if len(fp16_grads) > 4 else ''} flow through a "
+                "program with no loss-scaling op: fp16 grads underflow "
+                "without a GradScaler (bfloat16 would not)",
+                var=fp16_grads[0])
+
+        result.cast_plan = plan
+        result.info(
+            "amp-lint",
+            f"{n_findings} finding(s) over {len(program.ops)} ops; cast "
+            f"plan: {plan.to_auto_cast_lists()}")

@@ -7,29 +7,60 @@ state var, or is the forward op a live grad op replays.  Everything else
 is dead weight: it still costs capture, trace, and XLA compile time on
 every new feed signature.
 
+Liveness is **positional**, not just name-based: ``@GRAD`` vars
+accumulate in the runner (``env[g] = env[g] + contribution``), so a
+gradient contribution written *after* the last live reader of that name
+can never reach a fetch — a second ``gradients()`` call whose chain
+merges into an already-consumed ``@GRAD`` var is dead code, and must not
+pin its forward ops alive through the vjp-replay link.  ``liveness()``
+exposes the shared (live set, read horizon, grad pins) triple the memory
+planner builds its intervals from, so DCE and the planner agree on what
+actually executes.
+
 ``liveness_report`` only reports; ``dead_op_eliminate`` returns a new
 Program with dead ops stripped and grad ``fwd_idx`` links remapped.
 Removal counts are exported through the PR-1 metrics registry
-(``static.pass.dead_ops_eliminated``).
+(``static.pass.dead_ops_eliminated``; positionally-dead gradient
+contributions additionally count under
+``static.pass.stale_grad_writes_dropped``).
 """
 from __future__ import annotations
 
-from typing import List, Set
+from typing import Dict, List, Set, Tuple
 
 from ..program import OpDesc, Program
 from .pass_base import Pass, PassContext, PassResult, register_pass
 
-__all__ = ["LivenessReportPass", "DeadOpEliminationPass", "find_dead_ops"]
+__all__ = ["LivenessReportPass", "DeadOpEliminationPass", "find_dead_ops",
+           "liveness"]
 
 
-def find_dead_ops(program, fetch_names) -> List[int]:
-    """Indices of ops that neither reach a fetch nor mutate state."""
+def liveness(program, fetch_names) -> Tuple[Set[int], Dict[str, int],
+                                            Dict[int, int]]:
+    """Positional liveness over one runner replay.
+
+    Returns ``(live_ops, horizon, pins)``:
+
+    - ``live_ops``: indices of ops that can influence a fetch or mutate
+      parameter/optimizer state.
+    - ``horizon``: name -> largest op index at which a *live* op reads
+      that name (``len(program.ops)`` for fetched names — the fetch
+      reads the final env).  A write at index ``i`` is observable iff
+      some live read happens at ``j > i``; because ``@GRAD`` vars
+      accumulate positionally, a contribution merged after the last
+      live reader is unreachable.
+    - ``pins``: grad op idx -> forward op idx for every *live* grad op
+      (the vjp-closure pin: residuals captured at the forward stay
+      resident until the grad op replays them — the lifetime extension
+      the memory planner models).
+    """
     fetch = set(fetch_names or ())
     mutable = set(program.parameters) | set(program.state_vars)
-    live_names: Set[str] = set(fetch)
-    live_ops: Set[int] = set()
-    forced_fwd: Set[int] = set()
     n_ops = len(program.ops)
+    horizon: Dict[str, int] = {n: n_ops for n in fetch}
+    live_ops: Set[int] = set()
+    pins: Dict[int, int] = {}
+    forced_fwd: Set[int] = set()
     # fixpoint sweep: one reversed pass suffices for well-formed programs,
     # but a grad op whose fwd_idx points *later* (the grad-pairing defect
     # the verifier reports) would otherwise force a forward op after it
@@ -44,18 +75,28 @@ def find_dead_ops(program, fetch_names) -> List[int]:
             essential = op.kind == "optimize" or any(
                 n in mutable for n in op.output_names)
             live = (essential or op.idx in forced_fwd or
-                    any(n in live_names for n in op.output_names))
+                    any(horizon.get(n, -1) > op.idx
+                        for n in op.output_names))
             if not live:
                 continue
             live_ops.add(op.idx)
             changed = True
-            live_names.update(op.input_names)
+            for n in op.input_names:
+                if horizon.get(n, -1) < op.idx:
+                    horizon[n] = op.idx
             if op.kind == "grad" and op.fwd_idx is not None and \
                     0 <= op.fwd_idx < n_ops:
                 # the replayed vjp closure is captured at the forward op:
                 # a live grad keeps its forward alive even if the
                 # forward's outputs are otherwise unused
                 forced_fwd.add(op.fwd_idx)
+                pins[op.idx] = op.fwd_idx
+    return live_ops, horizon, pins
+
+
+def find_dead_ops(program, fetch_names) -> List[int]:
+    """Indices of ops that neither reach a fetch nor mutate state."""
+    live_ops, _, _ = liveness(program, fetch_names)
     return [op.idx for op in program.ops if op.idx not in live_ops]
 
 
@@ -95,19 +136,36 @@ class _LivenessBase(Pass):
 
     def _analyze(self, program, context: PassContext,
                  result: PassResult) -> List[int]:
-        dead = find_dead_ops(program, context.fetch_names)
+        live_ops, horizon, _ = liveness(program, context.fetch_names)
+        dead = [op.idx for op in program.ops if op.idx not in live_ops]
+        stale: List[int] = []
         for idx in dead:
             op = program.ops[idx]
+            if op.kind == "grad" and any(
+                    -1 < horizon.get(n, -1) <= op.idx
+                    for n in op.output_names):
+                # the @GRAD name IS read by a live op — but only at an
+                # earlier position, before this contribution merges
+                stale.append(idx)
             result.warning(
                 "dead-op",
                 f"op#{op.idx} '{op.type}' outputs {op.output_names} are "
                 "neither consumed by a live op nor fetched"
+                + (" (gradient contribution merges after the last live "
+                   "reader of its @GRAD var)" if idx in stale else "")
                 + ("" if context.fetch_names else
                    " (no fetch list given: only state-updating ops count "
                    "as roots)"),
                 op_idx=op.idx, op_type=op.type,
                 var=op.output_names[0] if op.output_names else None)
+        if stale:
+            result.info(
+                "stale-grad-writes",
+                f"{len(stale)} grad op(s) {stale} write @GRAD vars whose "
+                "last live read happens earlier in the program — "
+                "positionally dead accumulation")
         result.dead_ops = dead
+        self._stale = stale
         return dead
 
 
@@ -134,6 +192,12 @@ class DeadOpEliminationPass(_LivenessBase):
             "static.pass.dead_ops_eliminated",
             "ops stripped from Programs by dead_op_eliminate").inc(
             len(dead))
+        if self._stale:
+            _metrics.counter(
+                "static.pass.stale_grad_writes_dropped",
+                "positionally-dead @GRAD accumulations (write after the "
+                "last live read) stripped by dead_op_eliminate").inc(
+                len(self._stale))
         result.info(
             "dce-summary",
             f"eliminated {len(dead)} dead op(s) of {len(program.ops)} "

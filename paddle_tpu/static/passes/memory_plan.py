@@ -1,0 +1,438 @@
+"""Static memory planner over captured Programs.
+
+Interval liveness extended from the dead-op pass into a byte-accurate
+HBM planner: every program variable gets a live interval
+``[first live def, last live read]`` from the shared positional
+liveness (``liveness.liveness``), its byte size from the pass-inferred
+avals (``shape_inference``), and a tag mirroring memscope's vocabulary
+(``params`` / ``opt_state`` / ``activations`` / ``grads``).  Summing
+the intervals per op index yields the per-op live-set timeline and the
+peak-HBM estimate the remat policy pass optimizes against.
+
+Two lifetime rules beyond plain def-use intervals make the estimate
+match what the runner actually holds:
+
+- **vjp residual pins**: a forward op replayed by a live grad op keeps
+  its inputs AND outputs resident until the grad op runs (``jax.vjp``
+  closes over them); a ``__remat__`` fused op keeps only its *inputs*
+  (``jax.checkpoint`` recomputes the rest) plus a transient recompute
+  window at the forward and grad positions.
+- **positional @GRAD accumulation**: gradient buffers exist from their
+  first live contribution to their last live read (optimizer update or
+  fetch) — one buffer per name, contributions merge in place.
+
+``measured_replay`` is the calibration half: an instrumented *eager*
+op-by-op replay mirroring ``Executor._build_runner`` semantics exactly
+(vjp for pinned forwards, env-or-zeros cotangents, masked scatter with
+accumulation) that frees env entries at their positional last use,
+drops vjp closures once their grad op has replayed, and samples
+``memscope.live_bytes()`` after every op.  Unlike the jitted executor
+path — whose intra-XLA temporaries are invisible to
+``jax.live_arrays()`` — the replay observes every buffer the program
+semantics require, giving the measured peak the planner's estimate is
+validated against (the ±15%% golden-program gate).
+"""
+from __future__ import annotations
+
+import gc
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..program import _LR_NAME
+from .liveness import liveness
+from .pass_base import Pass, PassContext, PassResult, register_pass
+from .shape_inference import ShapeInferencePass
+
+__all__ = ["MemoryPlan", "MemoryPlanPass", "build_memory_plan",
+           "measured_replay", "PLAN_TAGS"]
+
+# tags mirror profiler.memscope.KNOWN_TAGS (the census vocabulary)
+PLAN_TAGS = ("params", "opt_state", "activations", "grads")
+
+
+def _nbytes(aval) -> int:
+    shape = tuple(aval.shape)
+    n = 1
+    for s in shape:
+        n *= int(s) if s and s > 0 else 1
+    return n * jnp.dtype(aval.dtype).itemsize
+
+
+def _source_names(program):
+    return (set(program.parameters) | set(program.constants)
+            | set(program.state_vars) | set(program._placeholders)
+            | {_LR_NAME})
+
+
+def _tag_of(program, name: str) -> str:
+    if name in program.parameters or name in program.constants:
+        return "params"
+    if name in program.state_vars or name == _LR_NAME:
+        return "opt_state"
+    if name.endswith("@GRAD"):
+        return "grads"
+    return "activations"    # feeds + intermediates
+
+
+class MemoryPlan:
+    """Per-op live-byte timeline + peak estimate for one Program."""
+
+    __slots__ = ("peak_bytes", "peak_op_idx", "peak_op_type",
+                 "static_bytes", "static_by_tag", "by_tag_at_peak",
+                 "timeline", "n_ops", "live_op_count", "dead_op_count",
+                 "fetch_names")
+
+    def __init__(self):
+        self.peak_bytes = 0
+        self.peak_op_idx = -1
+        self.peak_op_type = ""
+        self.static_bytes = 0
+        self.static_by_tag: Dict[str, int] = {}
+        self.by_tag_at_peak: Dict[str, int] = {}
+        self.timeline: List[Dict] = []
+        self.n_ops = 0
+        self.live_op_count = 0
+        self.dead_op_count = 0
+        self.fetch_names: List[str] = []
+
+    def to_doc(self) -> Dict:
+        return {
+            "kind": "memory_plan",
+            "peak_bytes": int(self.peak_bytes),
+            "peak_op": {"idx": self.peak_op_idx,
+                        "type": self.peak_op_type},
+            "static_bytes": int(self.static_bytes),
+            "static_by_tag": {k: int(v)
+                              for k, v in self.static_by_tag.items()},
+            "by_tag_at_peak": {k: int(v)
+                               for k, v in self.by_tag_at_peak.items()},
+            "n_ops": self.n_ops,
+            "live_ops": self.live_op_count,
+            "dead_ops": self.dead_op_count,
+            "fetch_names": list(self.fetch_names),
+            "timeline": self.timeline,
+        }
+
+    def render(self, top: Optional[int] = None) -> str:
+        mb = 1024.0 * 1024.0
+        head = (f"{'op':>4} {'type':<24} {'kind':<8} {'live_mb':>9} "
+                f"{'params':>8} {'acts':>8} {'grads':>8} {'opt':>8}")
+        lines = [
+            f"memory plan: peak {self.peak_bytes / mb:.3f} MB at "
+            f"op#{self.peak_op_idx} '{self.peak_op_type}' "
+            f"({self.live_op_count} live / {self.n_ops} ops, static "
+            f"{self.static_bytes / mb:.3f} MB)",
+            head, "-" * len(head)]
+        rows = self.timeline
+        if top and len(rows) > top:
+            # keep the top-N rows by live bytes, in program order
+            keep = {r["idx"] for r in sorted(
+                rows, key=lambda r: r["live_bytes"], reverse=True)[:top]}
+            rows = [r for r in rows if r["idx"] in keep]
+        for r in rows:
+            t = r["by_tag"]
+            lines.append(
+                f"{r['idx']:>4} {r['type']:<24.24} {r['kind']:<8} "
+                f"{r['live_bytes'] / mb:>9.3f} "
+                f"{t.get('params', 0) / mb:>8.3f} "
+                f"{t.get('activations', 0) / mb:>8.3f} "
+                f"{t.get('grads', 0) / mb:>8.3f} "
+                f"{t.get('opt_state', 0) / mb:>8.3f}")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return (f"MemoryPlan(peak={self.peak_bytes}B at "
+                f"op#{self.peak_op_idx} '{self.peak_op_type}', "
+                f"static={self.static_bytes}B, ops={self.n_ops})")
+
+
+def build_memory_plan(program, feed_shapes=None, feed_dtypes=None,
+                      fetch_names: Optional[Sequence[str]] = None,
+                      inferred: Optional[Dict] = None) -> MemoryPlan:
+    """Build a :class:`MemoryPlan` for ``program``.
+
+    ``inferred`` (name -> ShapeDtypeStruct) may be passed to reuse an
+    existing shape-inference run; otherwise the pass runs here with
+    ``feed_shapes``/``feed_dtypes``."""
+    if inferred is None:
+        ctx = PassContext(feed_shapes=feed_shapes,
+                          feed_dtypes=feed_dtypes,
+                          fetch_names=fetch_names)
+        scratch = PassResult("shape_inference")
+        ShapeInferencePass().run(program, ctx, scratch)
+        inferred = scratch.inferred
+    if not inferred:
+        raise ValueError(
+            "memory_plan: shape inference produced no avals for this "
+            "program; cannot size the live set")
+
+    ops = program.ops
+    n_ops = len(ops)
+    live_ops, horizon, pins = liveness(program, fetch_names)
+    sources = _source_names(program)
+
+    plan = MemoryPlan()
+    plan.n_ops = n_ops
+    plan.live_op_count = len(live_ops)
+    plan.dead_op_count = n_ops - len(live_ops)
+    plan.fetch_names = list(fetch_names or ())
+
+    # -- static set: sources resident for the whole call ------------------
+    static_by_tag: Dict[str, int] = {t: 0 for t in PLAN_TAGS}
+    for n in sources:
+        a = inferred.get(n)
+        if a is None:
+            continue
+        static_by_tag[_tag_of(program, n)] += _nbytes(a)
+    plan.static_by_tag = static_by_tag
+    plan.static_bytes = sum(static_by_tag.values())
+
+    # -- residual pins: vjp closures extend lifetimes to the grad op ------
+    res_horizon = dict(horizon)
+    transient_at: Dict[int, int] = {}
+    for g_idx, f_idx in pins.items():
+        fwd = ops[f_idx]
+        if fwd.idx not in live_ops:
+            continue
+        held = list(fwd.input_names)
+        if fwd.attrs.get("__remat__"):
+            # jax.checkpoint saves only the inputs; the internal chain
+            # rematerializes transiently at the forward and the grad
+            internal = int(fwd.attrs.get("__remat_internal_bytes__", 0))
+            transient_at[f_idx] = transient_at.get(f_idx, 0) + internal
+            transient_at[g_idx] = transient_at.get(g_idx, 0) + internal
+        else:
+            held += list(fwd.output_names)
+        for n in held:
+            if n in sources:
+                continue
+            if res_horizon.get(n, -1) < g_idx:
+                res_horizon[n] = g_idx
+
+    # -- intervals for intermediates --------------------------------------
+    def_pos: Dict[str, int] = {}
+    rebind_pos: Dict[str, int] = {}
+    mutable = set(program.parameters) | set(program.state_vars)
+    for op in ops:
+        if op.idx not in live_ops:
+            continue
+        for n in op.output_names:
+            if n in mutable:
+                # parameter/state rebind: the op allocates a NEW buffer
+                # while the old one stays resident until write-back (the
+                # runner does not donate its inputs) — double-buffered
+                # from here to program end
+                if n not in rebind_pos:
+                    rebind_pos[n] = op.idx
+                continue
+            if n in sources or n in def_pos:
+                continue
+            def_pos[n] = op.idx
+
+    add_at: Dict[int, List] = {}
+    del_after: Dict[int, List] = {}
+    for n, start in def_pos.items():
+        a = inferred.get(n)
+        if a is None:
+            continue
+        end = res_horizon.get(n, -1)
+        end = start if end < start else min(end, n_ops - 1)
+        item = (_tag_of(program, n), _nbytes(a))
+        add_at.setdefault(start, []).append(item)
+        del_after.setdefault(end, []).append(item)
+    for n, start in rebind_pos.items():
+        a = inferred.get(n)
+        if a is None:
+            continue
+        item = (_tag_of(program, n), _nbytes(a))
+        add_at.setdefault(start, []).append(item)
+        del_after.setdefault(n_ops - 1, []).append(item)
+
+    # -- walk the op list -------------------------------------------------
+    cur: Dict[str, int] = {t: 0 for t in PLAN_TAGS}
+    for t in range(n_ops):
+        for tag, b in add_at.get(t, ()):
+            cur[tag] += b
+        op = ops[t]
+        if op.idx in live_ops:
+            transient = transient_at.get(t, 0)
+            total = plan.static_bytes + sum(cur.values()) + transient
+            by_tag = {tag: static_by_tag.get(tag, 0) + cur.get(tag, 0)
+                      for tag in PLAN_TAGS}
+            if transient:
+                by_tag["activations"] += transient
+            plan.timeline.append({
+                "idx": op.idx, "type": op.type, "kind": op.kind,
+                "live_bytes": int(total), "by_tag": by_tag})
+            if total > plan.peak_bytes:
+                plan.peak_bytes = int(total)
+                plan.peak_op_idx = op.idx
+                plan.peak_op_type = op.type
+                plan.by_tag_at_peak = dict(by_tag)
+        for tag, b in del_after.get(t, ()):
+            cur[tag] -= b
+    if plan.peak_bytes == 0:
+        plan.peak_bytes = plan.static_bytes
+    return plan
+
+
+@register_pass("memory_plan")
+class MemoryPlanPass(Pass):
+
+    def run(self, program, context: PassContext, result: PassResult):
+        try:
+            plan = build_memory_plan(
+                program, feed_shapes=context.feed_shapes,
+                feed_dtypes=context.feed_dtypes,
+                fetch_names=context.fetch_names)
+        except ValueError as e:
+            result.warning("memory-plan-skipped", str(e))
+            return
+        result.memory_plan = plan
+        from ...profiler import memscope
+        if memscope.active:
+            memscope.record_plan(plan.to_doc())
+        mb = 1024.0 * 1024.0
+        result.info(
+            "memory-plan",
+            f"estimated peak {plan.peak_bytes / mb:.3f} MB at op#"
+            f"{plan.peak_op_idx} '{plan.peak_op_type}' "
+            f"(static {plan.static_bytes / mb:.3f} MB, "
+            f"{plan.live_op_count} live ops)")
+
+
+# ---------------------------------------------------------------------------
+# measured replay: the memscope-instrumented ground truth
+# ---------------------------------------------------------------------------
+def measured_replay(program, feed=None, fetch_list=None):
+    """Eager op-by-op replay of ``program`` sampling
+    ``memscope.live_bytes()`` after every op.
+
+    Mirrors ``Executor._build_runner`` semantics exactly — ``jax.vjp``
+    for grad-pinned forwards, env-or-zeros cotangents, masked scatter
+    with in-place accumulation, optimize ops last — while freeing env
+    entries at their positional last use and dropping each vjp closure
+    once its grad op has replayed.  Run it on a DCE'd (or clean)
+    program: every op in the list executes.
+
+    Returns ``{"peak_bytes", "resident_bytes", "per_op", "fetches"}``
+    where ``peak_bytes`` includes the already-resident parameter /
+    constant / state arrays, so it is directly comparable to
+    ``MemoryPlan.peak_bytes``.
+    """
+    from ...profiler import memscope
+
+    feed = feed or {}
+    fetch_names = [f if isinstance(f, str) else f.name
+                   for f in (fetch_list or [])]
+    ops = list(program.ops)
+    n_ops = len(ops)
+    _, horizon, pins = liveness(program, fetch_names)
+    pinned_fwds = frozenset(pins.values())
+    sources = _source_names(program)
+    # grad ops read their forward's residuals through the vjp closure;
+    # map fwd idx -> last grad idx replaying it so closures drop exactly
+    # when the runner's would go out of scope
+    last_grad_for: Dict[int, int] = {}
+    for g_idx, f_idx in pins.items():
+        last_grad_for[f_idx] = max(last_grad_for.get(f_idx, -1), g_idx)
+
+    float0 = jax.dtypes.float0
+    gc.collect()
+    base = memscope.live_bytes()
+    resident = 0
+    for p in program.parameters.values():
+        resident += int(np.prod(p._data.shape) or 1) * \
+            jnp.dtype(p._data.dtype).itemsize
+    for a in program.constants.values():
+        resident += int(np.prod(a.shape) or 1) * jnp.dtype(a.dtype).itemsize
+    for a in program.state_vars.values():
+        resident += int(np.prod(a.shape) or 1) * jnp.dtype(a.dtype).itemsize
+
+    env: Dict[str, jax.Array] = dict(program.constants)
+    env.update({n: p._data for n, p in program.parameters.items()})
+    env.update(program.state_vars)
+    env[_LR_NAME] = jnp.asarray(
+        program._lr_provider() if program._lr_provider else 0.0,
+        jnp.float32)
+    for n, v in feed.items():
+        ph = program._placeholders.get(n)
+        env[n] = jnp.asarray(v, dtype=ph._dtype if ph is not None else None)
+
+    vjps: Dict[int, object] = {}
+    out_meta: Dict[int, tuple] = {}
+    peak = 0
+    per_op: List[Dict] = []
+
+    def _free_dead(t):
+        for n in list(env):
+            if n in sources or n in fetch_names:
+                continue
+            if horizon.get(n, -1) <= t:
+                del env[n]
+
+    # op execution lives in helpers so the per-op temporaries (input
+    # lists, cotangents, scatter loop variables) go out of scope before
+    # live_bytes() samples — otherwise the instrumentation itself pins
+    # buffers the runner would have dropped
+    def _run_compute(op):
+        ins = [env[n] for n in op.input_names]
+        if op.idx in pinned_fwds:
+            out, vjp_fn = jax.vjp(op.impl, *ins)
+            vjps[op.idx] = vjp_fn
+        else:
+            out = op.impl(*ins)
+        tup = isinstance(out, tuple)
+        outs = out if tup else (out,)
+        out_meta[op.idx] = ([(o.shape, o.dtype) for o in outs], tup)
+        for n, o in zip(op.output_names, outs):
+            env[n] = o
+
+    def _run_grad(op):
+        metas, tup = out_meta[op.fwd_idx]
+        cots = [env[n] if n in env else jnp.zeros(s, d)
+                for n, (s, d) in zip(op.input_names, metas)]
+        cot = tuple(cots) if tup else cots[0]
+        in_grads = vjps[op.fwd_idx](cot)
+        it = iter(op.output_names)
+        for g, m in zip(in_grads, op.grad_input_mask):
+            if not m:
+                continue
+            gname = next(it)
+            if g is None or (hasattr(g, "dtype") and g.dtype == float0):
+                continue
+            env[gname] = env[gname] + g if gname in env else g
+        if last_grad_for.get(op.fwd_idx) == op.idx:
+            del vjps[op.fwd_idx]   # residuals freed with the closure
+
+    def _run_opt(op):
+        ins = [env[n] for n in op.input_names]
+        outs = op.impl(*ins)
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        for n, o in zip(op.output_names, outs):
+            env[n] = o
+
+    for t, op in enumerate(ops):
+        if op.kind == "compute":
+            _run_compute(op)
+        elif op.kind == "grad":
+            _run_grad(op)
+        else:
+            _run_opt(op)
+        # sample BEFORE freeing op t's dead inputs: the planner's row for
+        # op t counts everything live *during* the op (its inputs must
+        # exist while it runs), so the measurement uses the same cut
+        live = memscope.live_bytes() - base + resident
+        per_op.append({"idx": op.idx, "type": op.type,
+                       "live_bytes": int(live)})
+        _free_dead(t)
+        if live > peak:
+            peak = live
+
+    fetches = [env[n] for n in fetch_names]
+    return {"peak_bytes": int(peak), "resident_bytes": int(resident),
+            "per_op": per_op, "fetches": fetches}

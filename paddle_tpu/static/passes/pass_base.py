@@ -64,6 +64,8 @@ class PassResult:
         self.program = None          # set by transform passes
         self.inferred: Dict = {}     # set by shape inference: name -> aval
         self.dead_ops: List[int] = []   # set by liveness: dead op idxs
+        self.memory_plan = None      # set by memory_plan: MemoryPlan
+        self.cast_plan = None        # set by amp_lint: CastPlan
 
     def add(self, level: str, code: str, message: str, **loc):
         self.diagnostics.append(Diagnostic(level, code, message, **loc))

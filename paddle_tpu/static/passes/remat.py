@@ -1,0 +1,350 @@
+"""Rematerialization policy pass over captured train Programs.
+
+The static memory planner (``memory_plan``) prices every activation a
+vjp residual pins across the forward→backward gap.  This pass spends
+compute to un-pin the expensive ones: it picks contiguous chains of
+grad-pinned forward ops, fuses each chain into one ``remat_group`` op
+whose impl is the members replayed in order under ``jax.checkpoint``,
+and collapses the member grad ops into one ``remat_group_grad`` —
+so only the chain's *inputs* stay resident across the backward and the
+internal activations recompute transiently at grad time.
+
+Bit-exactness (the contract every default-on transform in this repo
+holds, and this opt-in one too): ``jax.checkpoint`` replays the exact
+member impls in the exact program order during the backward, producing
+bitwise-identical primals and cotangents on the compiled Executor path
+(XLA lowers the rematerialized jaxpr to the same primitive sequence).
+The *eager* calibration replay (``memory_plan.measured_replay``) may
+see ulp-level cotangent differences inside a checkpointed composite —
+eager remat evaluation stages through its own call primitive — so
+parity tests assert bitwise on the Executor and tolerance on the
+replay.  The
+structural hazards that could reorder floating-point accumulation are
+refused instead of handled:
+
+- every internal name has at most one consumer, and only the last
+  member's outputs may be consumed outside the chain (linear dataflow:
+  the composite vjp never sums fan-out contributions);
+- every external input is consumed by exactly one member (its gradient
+  is a single contribution, just written at a later position — a write
+  move, not a re-association);
+- no foreign op reads or writes a moved ``@GRAD`` name inside the
+  window the write moves across (accumulation order outside the window
+  is preserved; two-term sums commute bitwise but we do not rely on
+  associativity).
+
+Selection is greedy under ``FLAGS_remat_budget_mb``: while the
+planner's peak estimate exceeds the budget, rematerialize the eligible
+chain with the largest pinned-activation saving, re-plan, repeat.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+
+from ..program import OpDesc
+from .liveness import liveness
+from .memory_plan import _nbytes, build_memory_plan
+from .optimize import _STATEFUL_OPS, _make_fused_impl, _multi_def, _rebuild
+from .pass_base import Pass, PassContext, PassResult, register_pass
+from .shape_inference import ShapeInferencePass
+
+__all__ = ["RematPass", "find_remat_chains", "apply_remat_chain"]
+
+_GRAD = "@GRAD"
+
+# greedy iterations: each applies one chain; programs needing more than
+# this many boundaries are beyond what one pass invocation should chew
+_MAX_ROUNDS = 16
+# candidate chains re-planned per round before giving up (each trial
+# costs a shape-inference + plan build over the rewritten program)
+_MAX_TRIALS = 8
+
+
+class _Chain:
+    """One validated remat candidate."""
+
+    __slots__ = ("members", "grads", "ext_in", "out_names", "gmask",
+                 "internal", "saving", "max_gidx")
+
+    def __init__(self, members, grads, ext_in, out_names, gmask,
+                 internal, saving, max_gidx):
+        self.members = members        # forward OpDescs, program order
+        self.grads = grads            # their grad OpDescs
+        self.ext_in = ext_in          # fused op inputs, first-seen order
+        self.out_names = out_names    # last member's outputs
+        self.gmask = gmask            # grad mask over ext_in
+        self.internal = internal      # internal names (rematerialized)
+        self.saving = saving          # pinned bytes the rewrite frees
+        self.max_gidx = max_gidx      # grad op position the fused grad takes
+
+    def __repr__(self):
+        types = [m.type for m in self.members]
+        return (f"_Chain({types}, saving={self.saving}B, "
+                f"ext_in={self.ext_in})")
+
+
+def _validate(program, members, grad_of, fetch, inferred,
+              mutable, multi) -> Optional[_Chain]:
+    """Check one contiguous member window against the refusal rules;
+    returns a scored :class:`_Chain` or None."""
+    if len(members) < 2:
+        return None
+    member_idx = {m.idx for m in members}
+    grads = [grad_of[m.idx] for m in members]
+    grad_idx = {g.idx for g in grads}
+    gpos = sorted(g.idx for g in grads)
+    min_gidx, max_gidx = gpos[0], gpos[-1]
+
+    defs: Dict[str, int] = {}
+    for i, m in enumerate(members):
+        for n in m.output_names:
+            defs[n] = i
+    last = members[-1]
+    out_names = list(last.output_names)
+    internal = [n for n in defs if n not in out_names]
+
+    # -- linear dataflow ---------------------------------------------------
+    consumers: Dict[str, List[OpDesc]] = {}
+    for op in program.ops:
+        if op.idx in member_idx or op.idx in grad_idx:
+            continue
+        for n in op.input_names:
+            consumers.setdefault(n, []).append(op)
+    member_uses: Dict[str, int] = {}
+    for m in members:
+        for n in set(m.input_names):
+            member_uses[n] = member_uses.get(n, 0) + 1
+    for n in internal:
+        if n in fetch or consumers.get(n):
+            return None          # internal name escapes the chain
+        if member_uses.get(n, 0) > 1:
+            return None          # fan-out: vjp would re-associate sums
+
+    ext_in: List[str] = []
+    for m in members:
+        for n in m.input_names:
+            if n not in defs and n not in ext_in:
+                ext_in.append(n)
+    for n in ext_in:
+        if member_uses.get(n, 0) != 1:
+            return None          # multi-member use: grad contributions merge
+
+    # -- gradient name hazards --------------------------------------------
+    moved: Dict[str, int] = {}   # ext grad name -> original write position
+    for g in grads:
+        for gn in g.output_names:
+            bare = gn[:-len(_GRAD)]
+            if bare in defs:
+                # internal @GRAD: vanishes entirely — nobody else may
+                # touch it
+                if gn in fetch:
+                    return None
+                for op in program.ops:
+                    if op.idx in grad_idx:
+                        continue
+                    if gn in op.input_names or gn in op.output_names:
+                        return None
+            else:
+                moved[gn] = g.idx
+    for gn, pos in moved.items():
+        # the write moves from ``pos`` to ``max_gidx``: any foreign
+        # read/write inside [pos, max_gidx) would observe different
+        # accumulation state
+        for op in program.ops:
+            if op.idx in grad_idx or not (pos <= op.idx < max_gidx):
+                continue
+            if gn in op.input_names or gn in op.output_names:
+                return None
+    for o in out_names:
+        # the fused grad reads its cotangents at max_gidx instead of at
+        # the original last-member grad (min_gidx): a foreign write in
+        # between would inject a contribution the original never saw
+        gn = o + _GRAD
+        for op in program.ops:
+            if op.idx in grad_idx or not (min_gidx <= op.idx < max_gidx):
+                continue
+            if gn in op.output_names:
+                return None
+
+    gmask = [(n + _GRAD) in moved for n in ext_in]
+    if not any(gmask):
+        return None
+
+    internal_bytes = 0
+    for n in internal:
+        a = inferred.get(n)
+        if a is not None:
+            internal_bytes += _nbytes(a)
+    if internal_bytes <= 0:
+        return None
+    return _Chain(list(members), grads, ext_in, out_names, gmask,
+                  internal, internal_bytes, max_gidx)
+
+
+def find_remat_chains(program, fetch_names, inferred) -> List[_Chain]:
+    """All validated chains over maximal contiguous runs of eligible
+    grad-pinned compute ops (every window of each run is tried)."""
+    fetch = set(fetch_names or ())
+    mutable = set(program.parameters) | set(program.state_vars)
+    multi = _multi_def(program)
+    grad_of: Dict[int, OpDesc] = {}
+    grad_count: Dict[int, int] = {}
+    for op in program.ops:
+        if op.kind == "grad" and op.fwd_idx is not None:
+            grad_of[op.fwd_idx] = op
+            grad_count[op.fwd_idx] = grad_count.get(op.fwd_idx, 0) + 1
+
+    def member_ok(op: OpDesc) -> bool:
+        return (op.kind == "compute"
+                and grad_count.get(op.idx) == 1
+                and op.type not in _STATEFUL_OPS
+                and not op.attrs.get("__shape_probed__")
+                and not op.attrs.get("__remat__")
+                and not any(n in mutable or n in multi
+                            for n in op.output_names))
+
+    runs: List[List[OpDesc]] = []
+    cur: List[OpDesc] = []
+    for op in program.ops:
+        if member_ok(op):
+            cur.append(op)
+        elif cur:
+            runs.append(cur)
+            cur = []
+    if cur:
+        runs.append(cur)
+
+    chains: List[_Chain] = []
+    for run in runs:
+        k = len(run)
+        for size in range(k, 1, -1):
+            for start in range(k - size + 1):
+                c = _validate(program, run[start:start + size], grad_of,
+                              fetch, inferred, mutable, multi)
+                if c is not None:
+                    chains.append(c)
+            if chains and chains[-1].members[0].idx == run[0].idx \
+                    and size == k:
+                break    # the full run validated: sub-windows are subsumed
+    return chains
+
+
+def apply_remat_chain(program, chain: _Chain):
+    """Rewrite ``program``: members collapse into one checkpointed
+    ``remat_group`` op at the first member's position, member grads into
+    one ``remat_group_grad`` at the last member-grad position."""
+    members = chain.members
+    m0 = members[0]
+    specs = tuple((m.impl, m.eval_impl, tuple(m.input_names),
+                   tuple(m.output_names)) for m in members)
+    ext_in = tuple(chain.ext_in)
+    out_names = tuple(chain.out_names)
+    composite = _make_fused_impl(specs, ext_in, out_names)
+    eval_impl = _make_fused_impl(specs, ext_in, out_names, use_eval=True)
+    fwd = OpDesc(
+        "remat_group", "compute", jax.checkpoint(composite),
+        list(ext_in), list(out_names),
+        {"__remat__": True,
+         "__remat_internal_bytes__": int(chain.saving),
+         "__remat_ops__": [m.type for m in members]},
+        None, None, eval_impl)
+    grad = OpDesc(
+        "remat_group_grad", "grad", None,
+        [o + _GRAD for o in out_names],
+        [n + _GRAD for n, m in zip(ext_in, chain.gmask) if m],
+        {}, m0.idx, list(chain.gmask), None)
+    drop: Set[int] = {m.idx for m in members[1:]}
+    drop |= {g.idx for g in chain.grads if g.idx != chain.max_gidx}
+    replace = {m0.idx: fwd, chain.max_gidx: grad}
+    return _rebuild(program, drop, replace=replace)
+
+
+@register_pass("program_remat")
+class RematPass(Pass):
+    """Budget-driven remat: greedy largest-saving chain until the
+    planner's peak estimate fits ``FLAGS_remat_budget_mb``."""
+
+    is_transform = True
+
+    def run(self, program, context: PassContext, result: PassResult):
+        from ...utils import flags as _flags
+        budget = int(_flags.get_flag("FLAGS_remat_budget_mb")) << 20
+        if budget <= 0:
+            result.program = program
+            result.info(
+                "remat-skipped",
+                "FLAGS_remat_budget_mb is 0 — program_remat is a no-op "
+                "without a byte budget to rewrite toward")
+            return
+        prog = program
+        applied = 0
+        mb = 1024.0 * 1024.0
+        for _ in range(_MAX_ROUNDS):
+            ctx = PassContext(feed_shapes=context.feed_shapes,
+                              feed_dtypes=context.feed_dtypes,
+                              fetch_names=context.fetch_names)
+            scratch = PassResult("shape_inference")
+            ShapeInferencePass().run(prog, ctx, scratch)
+            inferred = scratch.inferred
+            if not inferred:
+                result.warning(
+                    "remat-no-plan",
+                    "shape inference produced no avals; cannot price "
+                    "the live set — program left unchanged")
+                break
+            plan = build_memory_plan(prog, fetch_names=context.fetch_names,
+                                     inferred=inferred)
+            if plan.peak_bytes <= budget:
+                if applied == 0:
+                    result.info(
+                        "remat-under-budget",
+                        f"estimated peak {plan.peak_bytes / mb:.3f} MB "
+                        f"already fits the {budget / mb:.0f} MB budget")
+                break
+            chains = find_remat_chains(prog, context.fetch_names, inferred)
+            chains.sort(key=lambda c: c.saving, reverse=True)
+            picked = None
+            for c in chains[:_MAX_TRIALS]:
+                # the saving heuristic prices pinned activations, but a
+                # chain can still RAISE the peak (e.g. collapsing all
+                # grad writes into one op makes every @GRAD buffer
+                # simultaneous) — accept only on a re-planned
+                # improvement
+                cand = apply_remat_chain(prog, c)
+                try:
+                    cand_plan = build_memory_plan(
+                        cand, feed_shapes=context.feed_shapes,
+                        feed_dtypes=context.feed_dtypes,
+                        fetch_names=context.fetch_names)
+                except ValueError:
+                    continue
+                if cand_plan.peak_bytes < plan.peak_bytes:
+                    picked = (c, cand, cand_plan)
+                    break
+            if picked is None:
+                result.warning(
+                    "remat-budget-miss",
+                    f"estimated peak {plan.peak_bytes / mb:.3f} MB still "
+                    f"above the {budget / mb:.0f} MB budget and no "
+                    "eligible chain lowers it (stateful ops, fan-out, "
+                    "grad-accumulation hazards, or a grad/optimizer-"
+                    "dominated peak refuse the rest)")
+                break
+            c, prog, new_plan = picked
+            applied += 1
+            result.info(
+                "remat-chain",
+                f"rematerialized {[m.type for m in c.members]} "
+                f"(est peak {plan.peak_bytes / mb:.3f} -> "
+                f"{new_plan.peak_bytes / mb:.3f} MB; pinned saving "
+                f"~{c.saving / mb:.3f} MB; inputs {c.ext_in})",
+                op_idx=c.members[0].idx, op_type="remat_group")
+        result.program = prog
+        if applied:
+            from ...profiler import metrics as _metrics
+            _metrics.counter(
+                "static.pass.remat_chains",
+                "forward chains rewritten to recompute-in-backward by "
+                "program_remat").inc(applied)

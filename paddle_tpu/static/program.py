@@ -707,6 +707,16 @@ class CompiledProgram:
         names = []
         if _flags.get_flag("FLAGS_program_dce"):
             names.append("dead_op_eliminate")
+        if _flags.get_flag("FLAGS_program_remat") and \
+                int(_flags.get_flag("FLAGS_remat_budget_mb")) > 0:
+            # remat rewrites grad-pinned forward chains, so it must see
+            # the program before fusion_group folds members into
+            # composites; after DCE so dead chains are not priced.
+            # NOTE: the cache key is (version, fetches, pass names) —
+            # changing FLAGS_remat_budget_mb alone reuses a cached
+            # rewrite until the program version moves (documented in
+            # MIGRATION.md)
+            names.append("program_remat")
         if _flags.get_flag("FLAGS_program_opt"):
             from .passes import OPT_PASS_PIPELINE
             skip = {s.strip() for s in str(_flags.get_flag(

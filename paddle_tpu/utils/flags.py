@@ -291,6 +291,21 @@ define_flag("FLAGS_flight_recorder_capacity", 2048,
             "events held by the flight-recorder ring; the oldest drop "
             "beyond this, so the recorder can stay armed for the whole "
             "life of a serving process")
+define_flag("FLAGS_program_remat", False,
+            "run the rematerialization policy pass (program_remat, "
+            "static/passes/remat.py) when running a CompiledProgram: "
+            "the static memory planner's liveness timeline picks "
+            "forward subchains whose activations are recomputed in the "
+            "backward pass (jax.checkpoint) instead of held across it. "
+            "Bit-exact (same primitives replayed in the same order); "
+            "only active when FLAGS_remat_budget_mb > 0")
+define_flag("FLAGS_remat_budget_mb", 0,
+            "peak-HBM byte budget (MiB) the program_remat pass "
+            "rewrites toward: chains are rematerialized greedily by "
+            "estimated saving until the planner's peak estimate fits "
+            "the budget or no eligible chain remains.  0 (the default) "
+            "makes program_remat a no-op even when FLAGS_program_remat "
+            "is set")
 define_flag("FLAGS_prefetch_to_device", 2,
             "default device-prefetch depth used by Model.fit's train "
             "loop (batches kept resident on device by the io "

@@ -1,0 +1,172 @@
+"""Dtype-flow precision lint (amp_lint pass) tests.
+
+Each AMP rule gets a program seeded with exactly that defect; a clean
+fp32 program must produce zero AMP findings.  The cast plan must emit
+``auto_cast``-compatible custom lists that agree with the eager
+WHITE_LIST/BLACK_LIST classes (shared via ``amp.classify_op``).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.amp import BLACK_LIST, WHITE_LIST, classify_op
+from paddle_tpu.static.passes import pass_base
+from paddle_tpu.static.passes.amp_lint import AmpLintPass, CastPlan
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _run_lint(program, fetch, feed_shapes=None):
+    res = pass_base.PassResult("amp_lint")
+    AmpLintPass().run(
+        program,
+        pass_base.PassContext(
+            feed_shapes=feed_shapes,
+            fetch_names=[getattr(f, "name", f) for f in fetch]),
+        res)
+    return res
+
+
+def _codes(res):
+    return {d.code for d in res.diagnostics}
+
+
+class TestClassifyOp:
+    def test_shared_with_eager_lists(self):
+        for op in WHITE_LIST:
+            assert classify_op(op) == "white"
+        for op in BLACK_LIST:
+            assert classify_op(op) == "black"
+        assert classify_op("tanh") == "grey"
+
+    def test_custom_lists_take_precedence(self):
+        assert classify_op("softmax",
+                           custom_white_list={"softmax"}) == "white"
+        assert classify_op("matmul",
+                           custom_black_list={"matmul"}) == "black"
+
+
+class TestAmpRules:
+    def test_amp01_black_op_on_bf16(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            lo = paddle.cast(x, "bfloat16")
+            out = paddle.nn.functional.softmax(lo)   # black class, bf16 in
+        res = _run_lint(main, [out])
+        assert "AMP01" in _codes(res)
+        d = [d for d in res.diagnostics if d.code == "AMP01"][0]
+        assert d.op_type == "softmax"
+
+    def test_amp02_fp16_grads_without_scaler(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float16")
+            x.stop_gradient = False
+            h = paddle.tanh(x)
+            loss = paddle.sum(h)
+            (gx,) = static.gradients(loss, [x])
+        res = _run_lint(main, [loss, gx])
+        assert "AMP02" in _codes(res)
+
+    def test_amp02_bf16_grads_do_not_trip(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "bfloat16")
+            x.stop_gradient = False
+            h = paddle.tanh(x)
+            loss = paddle.sum(h)
+            (gx,) = static.gradients(loss, [x])
+        res = _run_lint(main, [loss, gx])
+        assert "AMP02" not in _codes(res)
+
+    def test_amp03_double_cast_round_trip(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            lo = paddle.cast(x, "bfloat16")
+            back = paddle.cast(lo, "float32")       # f32->bf16->f32
+            out = paddle.tanh(back)
+        res = _run_lint(main, [out])
+        assert "AMP03" in _codes(res)
+        d = [d for d in res.diagnostics if d.code == "AMP03"][0]
+        assert "truncates" in d.message
+
+    def test_amp04_cast_of_parameter(self):
+        from paddle_tpu.static.compat import create_parameter
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            w = create_parameter([8, 8], "float32", name="w_amp04")
+            wlo = paddle.cast(w, "bfloat16")
+            out = paddle.matmul(paddle.cast(x, "bfloat16"), wlo)
+        res = _run_lint(main, [out])
+        assert "AMP04" in _codes(res)
+        d = [d for d in res.diagnostics if d.code == "AMP04"][0]
+        assert d.var == "w_amp04"
+        assert "parameter" in d.message
+
+    def test_clean_fp32_program_has_zero_findings(self):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [16, 8], "float32")
+            y = static.data("y", [16, 1], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+            pred = static.nn.fc(h, 1)
+            loss = paddle.mean(paddle.square(pred - y))
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        res = _run_lint(main, [loss])
+        amp = {c for c in _codes(res) if c.startswith("AMP")}
+        assert amp == set(), amp
+
+
+class TestCastPlan:
+    def _plan(self, program, fetch):
+        res = _run_lint(program, fetch)
+        assert res.cast_plan is not None
+        return res.cast_plan
+
+    def test_plan_targets_follow_classes(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            w = static.data("w", [8, 8], "float32")
+            h = paddle.matmul(x, w)                 # white
+            out = paddle.nn.functional.softmax(h)   # black
+        plan = self._plan(main, [out])
+        by_type = {d["type"]: d for d in plan.decisions}
+        assert by_type["matmul"]["target"] == plan.low_dtype
+        assert by_type["softmax"]["target"] == "float32"
+        lists = plan.to_auto_cast_lists()
+        assert "matmul" in lists["custom_white_list"]
+        assert "softmax" in lists["custom_black_list"]
+
+    def test_grey_op_on_low_inputs_promoted(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "bfloat16")
+            out = paddle.tanh(x)                    # grey, bf16 input
+        plan = self._plan(main, [out])
+        lists = plan.to_auto_cast_lists()
+        assert "tanh" in lists["custom_white_list"]
+        # plumbing ops (cast & co) never land in the custom lists
+        assert "cast" not in lists["custom_white_list"]
+
+    def test_plan_doc_and_report_surface(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            out = paddle.tanh(x)
+        report = main.analysis_report(fetch_list=[out])
+        plan = report.cast_plan
+        assert isinstance(plan, CastPlan)
+        doc = plan.to_doc()
+        assert doc["kind"] == "cast_plan"
+        assert doc["auto_cast_lists"] == plan.to_auto_cast_lists()
+        assert len(doc["decisions"]) >= 1

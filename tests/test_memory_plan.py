@@ -1,0 +1,371 @@
+"""Static memory planner + remat policy pass tests.
+
+Calibration strategy (mirrors tools/memplan_gate.py):
+
+- golden *eval* captures (GPT, resnet18 through dy2static) must plan
+  within +/-15% of the memscope-measured replay peak — forward
+  programs are where the byte model is exact;
+- *train* programs get a wider band ([0.6, 1.4]): some vjp closures
+  hold derivative buffers beyond the inputs+outputs residual model;
+- remat acceptance is NOT an estimate check: loss/grad parity must be
+  bit-exact through the Executor and the *measured* peak must strictly
+  drop.  (Eager replay of a jax.checkpoint vjp can differ from the
+  per-op chain at the ulp level, so replay-side grads use allclose.)
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static.passes import pass_base
+from paddle_tpu.static.passes.memory_plan import (MemoryPlan, PLAN_TAGS,
+                                                  build_memory_plan,
+                                                  measured_replay)
+from paddle_tpu.static.passes.remat import RematPass, find_remat_chains
+from paddle_tpu.utils import flags as flags_mod
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+@pytest.fixture
+def _flags_guard():
+    saved = {k: flags_mod.get_flag(k)
+             for k in ("FLAGS_program_remat", "FLAGS_remat_budget_mb",
+                       "FLAGS_program_opt", "FLAGS_program_dce")}
+    yield
+    flags_mod.set_flags(saved)
+
+
+def _fc_train():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [64, 256], "float32")
+        y = static.data("y", [64, 1], "float32")
+        h = static.nn.fc(x, 512, activation="relu")
+        h2 = static.nn.fc(h, 256, activation="relu")
+        pred = static.nn.fc(h2, 1)
+        loss = paddle.mean(paddle.square(pred - y))
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _tanh_chain(n=6, side=256):
+    """Remat-friendly: a long elementwise chain whose residuals dominate."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [side, side], "float32")
+        x.stop_gradient = False
+        h = x
+        for _ in range(n):
+            h = paddle.tanh(h)
+        loss = paddle.mean(paddle.square(h))
+        (gx,) = static.gradients(loss, [x])
+    return main, startup, loss, gx
+
+
+def _feed_for(program, shapes, seed=0):
+    r = np.random.RandomState(seed)
+    return {n: r.rand(*s).astype("float32") for n, s in shapes.items()}
+
+
+class TestMemoryPlanModel:
+    def test_plan_doc_shape_and_tags(self):
+        main, _, loss = _fc_train()
+        plan = build_memory_plan(
+            main, feed_shapes={"x": (64, 256), "y": (64, 1)},
+            fetch_names=[loss.name])
+        assert isinstance(plan, MemoryPlan)
+        doc = plan.to_doc()
+        assert doc["kind"] == "memory_plan"
+        assert doc["peak_bytes"] > 0
+        assert doc["n_ops"] == len(main.ops)
+        assert len(doc["timeline"]) == doc["live_ops"]
+        for tag in PLAN_TAGS:
+            assert tag in doc["by_tag_at_peak"]
+        # params are live the whole call: every row carries at least the
+        # resident bytes (rebinding ops double-buffer, so >= not ==)
+        pbytes = doc["static_by_tag"]["params"]
+        assert pbytes > 0
+        assert all(row["by_tag"]["params"] >= pbytes
+                   for row in doc["timeline"])
+
+    def test_peak_row_is_max_of_timeline(self):
+        main, _, loss = _fc_train()
+        plan = build_memory_plan(
+            main, feed_shapes={"x": (64, 256), "y": (64, 1)},
+            fetch_names=[loss.name])
+        assert plan.peak_bytes == max(r["live_bytes"]
+                                      for r in plan.timeline)
+        assert plan.render(top=5).count("\n") >= 5
+
+    def test_grad_bytes_appear_only_in_backward(self):
+        main, _, loss = _fc_train()
+        plan = build_memory_plan(
+            main, feed_shapes={"x": (64, 256), "y": (64, 1)},
+            fetch_names=[loss.name])
+        kinds = {op.idx: op.kind for op in main.ops}
+        # backward starts at the d(loss)/d(loss) seed (a compute-kind
+        # fill_constant writing loss@GRAD), not at the first grad op
+        bwd_start = min(op.idx for op in main.ops
+                        if any(o.endswith("@GRAD")
+                               for o in op.output_names))
+        fwd_rows = [r for r in plan.timeline if r["idx"] < bwd_start]
+        assert fwd_rows
+        assert all(r["by_tag"]["grads"] == 0 for r in fwd_rows)
+        grad_rows = [r for r in plan.timeline if kinds[r["idx"]] == "grad"]
+        assert any(r["by_tag"]["grads"] > 0 for r in grad_rows)
+
+    def test_dead_ops_not_planned(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            live = paddle.tanh(x)
+            paddle.exp(x)                     # never fetched: dead
+        plan = build_memory_plan(main, feed_shapes={"x": (4, 8)},
+                                 fetch_names=[live.name])
+        assert plan.dead_op_count == 1
+        assert len(plan.timeline) == 1
+
+
+class TestPlannerCalibration:
+    """est/measured peak ratio against the eager memscope replay."""
+
+    def _ratio(self, program, feed, fetch_names):
+        plan = build_memory_plan(
+            program,
+            feed_shapes={n: v.shape for n, v in feed.items()},
+            fetch_names=fetch_names)
+        meas = measured_replay(program, feed, fetch_names)
+        assert meas["peak_bytes"] > 0
+        return plan.peak_bytes / meas["peak_bytes"], meas
+
+    def test_fc_train_calibration(self):
+        main, startup, loss = _fc_train()
+        static.Executor().run(startup)
+        feed = _feed_for(main, {"x": (64, 256), "y": (64, 1)})
+        ratio, meas = self._ratio(main, feed, [loss.name])
+        # train band: vjp-residual model is inputs+outputs
+        assert 0.6 <= ratio <= 1.4, ratio
+        # the replayed fetch is the real computation — but the replay
+        # is eager and the Executor is jitted, so XLA fusion (FMA,
+        # reassociation) may shift the last ulp; tight tolerance, not
+        # bitwise
+        ex = static.Executor().run(main, feed=feed,
+                                   fetch_list=[loss.name])[0]
+        np.testing.assert_allclose(np.asarray(meas["fetches"][0]),
+                                   np.asarray(ex), rtol=1e-6, atol=0)
+
+    def test_golden_gpt_eval_within_15pct(self):
+        paddle.disable_static()
+        try:
+            from paddle_tpu.jit import InputSpec
+            from paddle_tpu.jit.dy2static.program_translator import \
+                ProgramTranslator
+            from paddle_tpu.models import GPT, GPTConfig
+            paddle.seed(0)
+            gpt = GPT(GPTConfig(vocab_size=128, hidden_size=32,
+                                num_layers=2, num_heads=2,
+                                max_seq_len=32, ffn_mult=2))
+            gpt.eval()
+            prog, _, fetch = ProgramTranslator().get_program(
+                lambda ids: gpt.forward(ids),
+                [InputSpec([2, 16], "int32", name="ids")])
+        finally:
+            paddle.enable_static()
+        feed = {"ids": np.random.RandomState(0).randint(
+            0, 128, (2, 16)).astype("int32")}
+        names = [f.name for f in fetch]
+        ratio, _ = self._ratio(prog, feed, names)
+        assert 0.85 <= ratio <= 1.15, ratio
+
+    def test_golden_resnet_eval_within_15pct(self):
+        paddle.disable_static()
+        try:
+            from paddle_tpu.jit import InputSpec
+            from paddle_tpu.jit.dy2static.program_translator import \
+                ProgramTranslator
+            paddle.seed(0)
+            net = paddle.vision.models.resnet18(num_classes=10)
+            net.eval()
+            prog, _, fetch = ProgramTranslator().get_program(
+                lambda img: net.forward(img),
+                [InputSpec([2, 3, 32, 32], "float32", name="img")])
+        finally:
+            paddle.enable_static()
+        feed = {"img": np.random.RandomState(0).rand(
+            2, 3, 32, 32).astype("float32")}
+        names = [f.name for f in fetch]
+        ratio, _ = self._ratio(prog, feed, names)
+        assert 0.85 <= ratio <= 1.15, ratio
+
+    def test_memscope_gauges_exported(self):
+        from paddle_tpu.profiler import memscope
+        from paddle_tpu.profiler import metrics
+        main, _, loss = _fc_train()
+        was = memscope.active
+        memscope.enable()
+        try:
+            report = main.analysis_report(
+                feed_shapes={"x": (64, 256), "y": (64, 1)},
+                fetch_list=[loss])
+        finally:
+            if not was:
+                memscope.disable()
+        plan = report.memory_plan
+        assert plan is not None
+        g = metrics.gauge("mem.plan.peak_bytes_est")
+        assert g.value == plan.peak_bytes
+
+
+class TestRematPass:
+    def test_chains_found_on_tanh_chain(self):
+        from paddle_tpu.static.passes.shape_inference import \
+            ShapeInferencePass
+        main, _, loss, gx = _tanh_chain()
+        scratch = pass_base.PassResult("shape_inference")
+        ShapeInferencePass().run(
+            main, pass_base.PassContext(
+                fetch_names=[loss.name, gx.name]), scratch)
+        chains = find_remat_chains(main, [loss.name, gx.name],
+                                   scratch.inferred)
+        assert chains, "no remat chains on a 6-op tanh chain"
+        assert max(c.saving for c in chains) > 0
+
+    def test_remat_parity_and_peak_reduction(self, _flags_guard):
+        main, startup, loss, gx = _tanh_chain(n=6, side=256)
+        exe = static.Executor()
+        exe.run(startup)
+        feed = _feed_for(main, {"x": (256, 256)})
+        fetch = [loss.name, gx.name]
+        shapes = {n: v.shape for n, v in feed.items()}
+
+        plan0 = build_memory_plan(main, feed_shapes=shapes,
+                                  fetch_names=fetch)
+        meas0 = measured_replay(main, feed, fetch)
+        ref = [np.asarray(a) for a in
+               exe.run(main, feed=feed, fetch_list=fetch)]
+
+        flags_mod.set_flags({"FLAGS_remat_budget_mb": 1})
+        ctx = pass_base.PassContext(feed_shapes=shapes, fetch_names=fetch)
+        res = pass_base.PassResult("program_remat")
+        RematPass().run(main, ctx, res)
+        rw = res.program
+        assert rw is not None and rw is not main
+        assert any(op.attrs.get("__remat__") for op in rw.ops)
+
+        plan1 = build_memory_plan(rw, feed_shapes=shapes,
+                                  fetch_names=fetch)
+        assert plan1.peak_bytes < plan0.peak_bytes
+        meas1 = measured_replay(rw, feed, fetch)
+        assert meas1["peak_bytes"] < meas0["peak_bytes"]
+
+        # Executor path: loss AND grad bit-exact after the rewrite
+        out = [np.asarray(a) for a in
+               exe.run(rw, feed=feed, fetch_list=fetch)]
+        assert (out[0] == ref[0]).all()
+        assert (out[1] == ref[1]).all()
+        # eager replay of the checkpointed vjp may differ by ulps
+        np.testing.assert_allclose(np.asarray(meas1["fetches"][1]),
+                                   ref[1], rtol=1e-6, atol=1e-8)
+
+    def test_remat_noop_without_budget(self, _flags_guard):
+        main, _, loss, gx = _tanh_chain()
+        flags_mod.set_flags({"FLAGS_remat_budget_mb": 0})
+        res = pass_base.PassResult("program_remat")
+        RematPass().run(main, pass_base.PassContext(
+            feed_shapes={"x": (256, 256)},
+            fetch_names=[loss.name, gx.name]), res)
+        # transform-pass convention: unchanged == the same object back
+        assert res.program is main
+
+    def test_remat_never_raises_peak(self, _flags_guard):
+        """Grad/optimizer-dominated peak: the pass must refuse rather
+        than fuse a chain that makes things worse."""
+        main, _, loss = _fc_train()
+        shapes = {"x": (64, 256), "y": (64, 1)}
+        plan0 = build_memory_plan(main, feed_shapes=shapes,
+                                  fetch_names=[loss.name])
+        flags_mod.set_flags({"FLAGS_remat_budget_mb": 1})
+        res = pass_base.PassResult("program_remat")
+        RematPass().run(main, pass_base.PassContext(
+            feed_shapes=shapes, fetch_names=[loss.name]), res)
+        if res.program is not None and res.program is not main:
+            plan1 = build_memory_plan(res.program, feed_shapes=shapes,
+                                      fetch_names=[loss.name])
+            assert plan1.peak_bytes < plan0.peak_bytes
+
+    def test_compiled_program_wires_remat(self, _flags_guard):
+        # side=256 so the pre-remat peak clears the 1 MiB budget floor
+        main, startup, loss, gx = _tanh_chain(n=6, side=256)
+        exe = static.Executor()
+        exe.run(startup)
+        feed = _feed_for(main, {"x": (256, 256)})
+        ref = [np.asarray(a) for a in
+               exe.run(main, feed=feed, fetch_list=[loss.name, gx.name],
+                       use_program_cache=False)]
+        flags_mod.set_flags({"FLAGS_program_opt": True,
+                             "FLAGS_program_remat": True,
+                             "FLAGS_remat_budget_mb": 1})
+        comp = static.CompiledProgram(main)
+        optp = comp._optimized_program((loss.name, gx.name))
+        assert any(op.attrs.get("__remat__") for op in optp.ops), \
+            "program_remat did not run inside CompiledProgram"
+        out = [np.asarray(a) for a in
+               exe.run(comp, feed=feed, fetch_list=[loss.name, gx.name],
+                       use_program_cache=False)]
+        assert (out[0] == ref[0]).all() and (out[1] == ref[1]).all()
+
+
+class TestModelStaticMemoryPlan:
+    def test_train_and_eval_views(self):
+        paddle.disable_static()
+        try:
+            from paddle_tpu import nn
+            from paddle_tpu.jit import InputSpec
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                                nn.Linear(32, 4))
+            m = paddle.Model(net,
+                             inputs=[InputSpec([None, 16], "float32",
+                                               name="x")],
+                             labels=[InputSpec([None], "int64",
+                                               name="y")])
+            m.prepare(loss=nn.CrossEntropyLoss())
+            p_eval = m.static_memory_plan(mode="eval", batch_size=4)
+            p_train = m.static_memory_plan(mode="train", batch_size=4)
+        finally:
+            paddle.enable_static()
+        assert p_train.peak_bytes > p_eval.peak_bytes
+        kinds = {r["idx"] for r in p_train.timeline}
+        assert len(kinds) > len(p_eval.timeline)
+
+    def test_train_requires_loss(self):
+        paddle.disable_static()
+        try:
+            from paddle_tpu import nn
+            from paddle_tpu.jit import InputSpec
+            m = paddle.Model(nn.Linear(4, 2),
+                             inputs=[InputSpec([None, 4], "float32",
+                                               name="x")])
+            with pytest.raises(ValueError, match="prepare"):
+                m.static_memory_plan(mode="train")
+            with pytest.raises(ValueError, match="label"):
+                m.prepare(loss=nn.CrossEntropyLoss())
+                m.static_memory_plan(mode="train")
+        finally:
+            paddle.enable_static()
+
+    def test_needs_input_spec(self):
+        paddle.disable_static()
+        try:
+            from paddle_tpu import nn
+            m = paddle.Model(nn.Linear(4, 2))
+            with pytest.raises(ValueError, match="input"):
+                m.static_memory_plan(mode="eval")
+        finally:
+            paddle.enable_static()

@@ -882,6 +882,87 @@ class TestFrameworkLint:
         assert proc.returncode == 1
         assert "MD01" in proc.stdout and "NEW" in proc.stdout
 
+    def test_dt01_float64_in_impl(self, lint):
+        src = ("import numpy as np\n"
+               "from paddle_tpu.core.dispatch import dispatch\n"
+               "def _impl(a):\n"
+               "    return np.array([1.0, 2.0]) * np.float64(0.5)\n"
+               "def caller(t):\n"
+               "    return dispatch('op', _impl, [t], {})\n")
+        codes = [f.code for f in lint.lint_source(src, "x.py")]
+        assert codes.count("DT01") == 2
+
+    def test_dt01_scans_whole_pass_files(self, lint):
+        """Outside static/passes/ only impl functions are scanned; pass
+        files get every function (their byte math must stay exact)."""
+        src = ("import numpy as np\n"
+               "def _nbytes(shape):\n"
+               "    return np.full(shape, 0.5)\n")
+        assert lint.lint_source(src, "x.py") == []
+        fs = lint.lint_source(
+            src, "paddle_tpu/static/passes/memory_plan.py")
+        assert [f.code for f in fs] == ["DT01"]
+
+    def test_dt01_dtype_kwarg_and_int_literals_clean(self, lint):
+        src = ("import numpy as np\n"
+               "from paddle_tpu.core.dispatch import dispatch\n"
+               "def _impl(a):\n"
+               "    x = np.array([1.0], dtype=np.float32)\n"
+               "    return x + np.arange(4)\n"
+               "def caller(t):\n"
+               "    return dispatch('op', _impl, [t], {})\n")
+        assert lint.lint_source(src, "x.py") == []
+
+
+class TestPositionalLiveness:
+    """Stale-@GRAD-write regression: gradients() called twice can leave
+    a second accumulation op writing a grad name AFTER its last read.
+    Positional liveness must keep DCE from treating that dead write as
+    a live contribution (or worse, resurrecting its chain)."""
+
+    def _two_backward_program(self):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8], "float32")
+            x.stop_gradient = False
+            a = paddle.tanh(x)
+            b = paddle.square(a)
+            loss1 = paddle.mean(b)
+            d = paddle.exp(a)
+            loss2 = paddle.mean(d)
+            (gx,) = static.gradients(loss1, [x])
+            # second backward writes a@GRAD after tanh_grad already
+            # consumed it: positionally dead
+            static.gradients(loss2, [a], no_grad_set=[x])
+        return main, startup, gx
+
+    def test_stale_grad_write_is_dead_and_bit_exact(self, _flags_guard):
+        from paddle_tpu.static.passes.liveness import find_dead_ops
+        main, startup, gx = self._two_backward_program()
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(0).rand(4, 8)
+                .astype("float32")}
+        dead = find_dead_ops(main, [gx.name])
+        assert dead, "second-backward chain should be positionally dead"
+        # DCE'd execution matches the un-DCE'd one bitwise, and the
+        # eliminate pass accounts the stale writes it strips
+        flags_mod.set_flags({"FLAGS_program_dce": False})
+        ref = exe.run(main, feed=feed, fetch_list=[gx.name],
+                      use_program_cache=False)[0]
+        stale = metrics.counter("static.pass.stale_grad_writes_dropped")
+        before = stale.value
+        flags_mod.set_flags({"FLAGS_program_dce": True})
+        out = exe.run(static.CompiledProgram(main), feed=feed,
+                      fetch_list=[gx.name], use_program_cache=False)[0]
+        assert stale.value > before
+        assert (np.asarray(ref) == np.asarray(out)).all()
+        # and the value is the loss1-only gradient (the stale write
+        # never fed tanh_grad)
+        av = np.tanh(feed["x"])
+        ref1 = (2.0 * av / av.size) * (1.0 - av ** 2)
+        np.testing.assert_allclose(np.asarray(out), ref1, rtol=1e-5)
+
 
 class TestConvChainFusion:
     """r10 fusion_group extension (conv/batch_norm chains) and the

@@ -21,6 +21,12 @@ repeatedly cost debugging time on the TPU path:
 - ``FL01 import-time-flag-read``: module-top-level ``get_flag(...)``
   freezes the flag's value at import, so ``set_flags`` after import is
   silently ignored for that code path.
+- ``DT01 float64-promotion``: op impls (and, because pass rewrites run
+  inside jit too, every function in ``static/passes``) referencing
+  ``np.float64``/``np.double``, or calling a numpy array constructor
+  on float literals without a ``dtype=`` — NumPy defaults to float64,
+  which silently widens the op's output (or the whole fused region)
+  off the TPU-native f32/bf16 path.
 
 Usage::
 
@@ -48,6 +54,9 @@ DEFAULT_BASELINE = os.path.join(
 
 _HOST_SYNC_BUILTINS = {"float", "int", "bool"}
 _NP_SYNC_FUNCS = {"asarray", "array"}
+# numpy constructors that default to float64 when fed python floats
+_NP_FLOAT_CTORS = {"array", "asarray", "full", "full_like", "ones",
+                   "zeros", "arange", "linspace", "eye"}
 
 
 class Finding:
@@ -204,6 +213,52 @@ def _lint_host_sync(path, scope, fn, out: List[Finding]):
                     "raises ConcretizationTypeError under jit"))
 
 
+def _float_literal_in(node) -> bool:
+    return any(isinstance(c, ast.Constant) and isinstance(c.value, float)
+               for c in ast.walk(node))
+
+
+def _lint_dtype_flow(path, scope, fn, out: List[Finding]):
+    """DT01 over one function body (nested defs get their own run)."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in _walk_skipping_defs(stmt):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in ("float64", "double"):
+                tail = _call_name(node).split(".")
+                if len(tail) >= 2 and tail[-2] in ("np", "numpy"):
+                    out.append(Finding(
+                        path, node.lineno, "DT01", scope, node.attr,
+                        f"np.{node.attr} widens to f64 off the "
+                        "TPU-native f32/bf16 path — use an explicit "
+                        "32-bit (or jnp) dtype"))
+            elif isinstance(node, ast.Call):
+                tail = _call_name(node.func).split(".")
+                if len(tail) >= 2 and tail[-2] in ("np", "numpy") and \
+                        tail[-1] in _NP_FLOAT_CTORS and \
+                        not any(kw.arg == "dtype" for kw in node.keywords) \
+                        and any(_float_literal_in(a) for a in node.args):
+                    out.append(Finding(
+                        path, node.lineno, "DT01", scope,
+                        f"np.{tail[-1]}",
+                        f"`np.{tail[-1]}` on a float literal without "
+                        "dtype= produces float64 — pass dtype=np.float32 "
+                        "(or use jnp, which stays weak-typed)"))
+
+
+def _all_functions(tree) -> Dict[str, ast.AST]:
+    """Every named function in the module (pass files: rewrite helpers
+    and fused impls run under jit even though they are not dispatch
+    targets)."""
+    funcs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+    return funcs
+
+
 def _module_level_nodes(tree):
     """Every AST node whose code runs at import time: module/class-body
     statements and their sub-expressions, plus function decorators and
@@ -231,6 +286,15 @@ def lint_source(src: str, path: str) -> List[Finding]:
     # HS01 — host syncs inside jit-traceable impls
     for name, fn in _collect_impl_functions(tree).items():
         _lint_host_sync(path, name, fn, findings)
+
+    # DT01 — float64 promotion in impls; pass files are linted whole
+    # (their rewrite helpers and fused composites execute under jit)
+    dt_scopes = dict(_collect_impl_functions(tree))
+    if "static/passes/" in path.replace(os.sep, "/"):
+        for name, fn in _all_functions(tree).items():
+            dt_scopes.setdefault(name, fn)
+    for name, fn in dt_scopes.items():
+        _lint_dtype_flow(path, name, fn, findings)
 
     # MD01 — mutable default args (whole file)
     for node in ast.walk(tree):

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""CI static-analysis gate for the memory planner, remat policy pass
+and amp lint — the ISSUE-17 layer, end to end in fresh subprocesses.
+
+Phase 1 (planner calibration): capture the golden GPT and resnet18
+serving forwards through dy2static, build the static memory plan, and
+replay each program eagerly under memscope.  The planner's peak
+estimate must land within +-15% of the measured peak on both eval
+programs, the plan doc must round-trip through ``trace_summary.py
+--memplan``, and ``amp_lint`` must report ZERO AMP findings on these
+all-fp32 programs.
+
+Phase 2 (remat): a remat-friendly tanh-chain train program is
+rewritten under ``FLAGS_remat_budget_mb``.  Acceptance is behavioral,
+not estimated: loss AND input-gradient stay bit-exact through the
+Executor, and the memscope-MEASURED replay peak strictly drops.  The
+estimate check stays on the pre-remat program only (the planner's
+``__remat_internal_bytes__`` transient models the in-op recompute
+window, which boundary sampling cannot observe).
+
+Wired into tools/run_all_tests.sh.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+GOLDEN = """
+import json, os, sys
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.jit.dy2static.program_translator import ProgramTranslator
+from paddle_tpu.models import GPT, GPTConfig
+from paddle_tpu.static.passes import pass_base
+from paddle_tpu.static.passes.amp_lint import AmpLintPass
+from paddle_tpu.static.passes.memory_plan import (build_memory_plan,
+                                                  measured_replay)
+
+plan_path = sys.argv[1]
+paddle.seed(0)
+pt = ProgramTranslator()
+rng = np.random.RandomState(0)
+
+gpt = GPT(GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=2, max_seq_len=64, ffn_mult=2))
+gpt.eval()
+
+
+def gpt_serve(ids):
+    return F.softmax(gpt.forward(ids), axis=-1)
+
+
+resnet = paddle.vision.models.resnet18(num_classes=10)
+resnet.eval()
+
+
+def resnet_serve(img):
+    return F.softmax(resnet.forward(img), axis=-1)
+
+
+legs = [
+    ("gpt", gpt_serve, [InputSpec([2, 32], "int32", name="ids")],
+     {"ids": rng.randint(0, 256, (2, 32)).astype("int32")}),
+    ("resnet18", resnet_serve,
+     [InputSpec([2, 3, 32, 32], "float32", name="img")],
+     {"img": rng.rand(2, 3, 32, 32).astype("float32")}),
+]
+
+docs = {}
+for name, fn, spec, feed in legs:
+    prog, _, fetch = pt.get_program(fn, spec)
+    fetch_names = [v.name for v in fetch]
+    shapes = {k: tuple(v.shape) for k, v in feed.items()}
+    dtypes = {k: str(v.dtype) for k, v in feed.items()}
+
+    plan = build_memory_plan(prog, feed_shapes=shapes,
+                             feed_dtypes=dtypes, fetch_names=fetch_names)
+    meas = measured_replay(prog, feed, fetch_names)
+    ratio = plan.peak_bytes / meas["peak_bytes"]
+    assert 0.85 <= ratio <= 1.15, (
+        f"{name}: planner est {plan.peak_bytes}B vs measured "
+        f"{meas['peak_bytes']}B — est/measured {ratio:.3f} outside "
+        "the +-15% golden-eval band")
+
+    res = pass_base.PassResult("amp_lint")
+    AmpLintPass().run(prog, pass_base.PassContext(
+        feed_shapes=shapes, feed_dtypes=dtypes,
+        fetch_names=fetch_names), res)
+    amp = [d.code for d in res.diagnostics if d.code.startswith("AMP")]
+    assert amp == [], f"{name}: fp32 golden program lints dirty: {amp}"
+    assert res.cast_plan is not None, f"{name}: no cast plan emitted"
+
+    docs[name] = {"ratio": round(ratio, 3),
+                  "est": int(plan.peak_bytes),
+                  "measured": int(meas["peak_bytes"])}
+    if name == "gpt":
+        with open(plan_path, "w") as f:
+            json.dump(plan.to_doc(), f)
+
+print("golden leg ok:", docs)
+"""
+
+REMAT = """
+import sys
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static.passes import pass_base
+from paddle_tpu.static.passes.memory_plan import (build_memory_plan,
+                                                  measured_replay)
+from paddle_tpu.static.passes.remat import RematPass
+
+paddle.enable_static()
+main, startup = static.Program(), static.Program()
+with static.program_guard(main, startup):
+    x = static.data("x", [512, 512], "float32")
+    x.stop_gradient = False
+    h = x
+    for _ in range(6):
+        h = paddle.tanh(h)
+    loss = paddle.mean(paddle.square(h))
+    (gx,) = static.gradients(loss, [x])
+
+exe = static.Executor()
+exe.run(startup)
+feed = {"x": np.random.RandomState(0).rand(512, 512).astype("float32")}
+fetch = [loss.name, gx.name]
+shapes = {n: v.shape for n, v in feed.items()}
+
+plan0 = build_memory_plan(main, feed_shapes=shapes, fetch_names=fetch)
+meas0 = measured_replay(main, feed, fetch)
+ratio0 = plan0.peak_bytes / meas0["peak_bytes"]
+assert 0.6 <= ratio0 <= 1.4, (
+    f"pre-remat train est/measured {ratio0:.3f} outside the train band")
+ref = [np.asarray(a) for a in exe.run(main, feed=feed, fetch_list=fetch)]
+
+paddle.set_flags({"FLAGS_remat_budget_mb": 4})
+res = pass_base.PassResult("program_remat")
+RematPass().run(main, pass_base.PassContext(
+    feed_shapes=shapes, fetch_names=fetch), res)
+rw = res.program
+assert rw is not None and rw is not main, "remat refused a tanh chain"
+assert any(op.attrs.get("__remat__") for op in rw.ops)
+
+plan1 = build_memory_plan(rw, feed_shapes=shapes, fetch_names=fetch)
+assert plan1.peak_bytes < plan0.peak_bytes, (
+    f"estimated peak did not drop: {plan0.peak_bytes} -> "
+    f"{plan1.peak_bytes}")
+meas1 = measured_replay(rw, feed, fetch)
+assert meas1["peak_bytes"] < meas0["peak_bytes"], (
+    f"MEASURED peak did not drop: {meas0['peak_bytes']} -> "
+    f"{meas1['peak_bytes']}")
+
+out = [np.asarray(a) for a in exe.run(rw, feed=feed, fetch_list=fetch)]
+assert (out[0] == ref[0]).all(), "remat changed the loss bits"
+assert (out[1] == ref[1]).all(), "remat changed the gradient bits"
+print("remat leg ok:",
+      {"est": [int(plan0.peak_bytes), int(plan1.peak_bytes)],
+       "measured": [int(meas0["peak_bytes"]), int(meas1["peak_bytes"])]})
+"""
+
+
+def run_leg(name, code, *argv):
+    with tempfile.TemporaryDirectory(prefix=f"memplan_{name}_") as d:
+        env = dict(os.environ)
+        env["PADDLE_FLIGHT_DIR"] = os.path.join(d, "flight")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        args = [a.replace("@TMP@", d) for a in argv]
+        p = subprocess.run(
+            [sys.executable, "-c", textwrap.dedent(code), *args],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=600)
+        sys.stdout.write(p.stdout)
+        if p.returncode != 0:
+            sys.stderr.write(p.stderr)
+            print(f"memplan_gate: {name} leg FAILED", file=sys.stderr)
+            return False, None
+        return True, d
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="memplan_doc_") as d:
+        plan_json = os.path.join(d, "plan.json")
+        ok, _ = run_leg("golden", GOLDEN, plan_json)
+        if ok:
+            # the dumped plan doc must render through trace_summary
+            p = subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools",
+                                              "trace_summary.py"),
+                 "--memplan", plan_json],
+                capture_output=True, text=True, cwd=REPO, timeout=120)
+            sys.stdout.write(p.stdout)
+            if p.returncode != 0 or "memory plan: peak" not in p.stdout:
+                sys.stderr.write(p.stderr)
+                print("memplan_gate: trace_summary --memplan FAILED",
+                      file=sys.stderr)
+                ok = False
+    ok = run_leg("remat", REMAT)[0] and ok
+    if not ok:
+        return 1
+    print("memplan_gate: all legs passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

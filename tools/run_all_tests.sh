@@ -134,4 +134,12 @@ python tools/ps_gate.py
 # census, pool/prefix-cache occupancy, and the flight tail with its
 # mem.oom event.
 python tools/mem_gate.py
+# Static-analysis gate (ISSUE 17 planner/remat/amp-lint layer): the
+# golden GPT + resnet18 eval captures must plan within +-15% of the
+# memscope-measured replay peak, render through trace_summary
+# --memplan, and lint AMP-clean; a remat-friendly tanh-chain train
+# program rewritten under FLAGS_remat_budget_mb must keep loss and
+# input-gradient bit-exact through the Executor while the MEASURED
+# replay peak strictly drops.
+python tools/memplan_gate.py
 exec python -m pytest tests/ -q --runslow "$@"

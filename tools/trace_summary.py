@@ -27,6 +27,13 @@ verdicts (kv_shed, exhaustion, retire reason) line up with the spans.
 ``cat="compile"`` spans: per site x cause x provenance, how many
 compiles and how much wall they burned.
 
+``--memplan`` treats the positional argument as a static memory plan
+JSON (``MemoryPlan.to_doc()`` from static/passes/memory_plan.py, e.g.
+dumped by tools/memplan_gate.py) instead of a chrome trace, and renders
+the per-op live-byte timeline with tag columns and the peak marker:
+
+    python tools/trace_summary.py plan.json --memplan -n 20
+
 Pure stdlib so it runs anywhere the trace file lands (CI artifact
 viewers, dev laptops without the framework installed).
 """
@@ -205,6 +212,49 @@ def format_flight_tail(flight_evs):
     return "\n".join(lines)
 
 
+def format_memplan(doc, top=None):
+    """Render a ``MemoryPlan.to_doc()`` JSON: header with the peak, then
+    the per-op timeline (optionally only the top-N rows by live bytes,
+    kept in program order) with per-tag byte columns."""
+    if doc.get("kind") != "memory_plan":
+        return "(not a memory plan: expected a JSON object with " \
+               "kind='memory_plan' — is this a MemoryPlan.to_doc() dump?)"
+    mb = 1024.0 * 1024.0
+    peak_op = doc.get("peak_op") or {}
+    lines = [
+        f"memory plan: peak {doc.get('peak_bytes', 0) / mb:.3f} MB at "
+        f"op#{peak_op.get('idx', '?')} '{peak_op.get('type', '?')}' "
+        f"({doc.get('live_ops', '?')} live / {doc.get('n_ops', '?')} ops, "
+        f"static {doc.get('static_bytes', 0) / mb:.3f} MB)"]
+    by_tag = doc.get("static_by_tag") or {}
+    if by_tag:
+        lines.append("static: " + "  ".join(
+            f"{k}={v / mb:.3f}MB" for k, v in sorted(by_tag.items()) if v))
+    head = (f"{'op':>4} {'type':<24} {'kind':<8} {'live_mb':>9} "
+            f"{'params':>8} {'acts':>8} {'grads':>8} {'opt':>8}")
+    lines += [head, "-" * len(head)]
+    rows = doc.get("timeline") or []
+    if top and len(rows) > top:
+        keep = {r["idx"] for r in sorted(
+            rows, key=lambda r: r.get("live_bytes", 0), reverse=True)[:top]}
+        rows = [r for r in rows if r["idx"] in keep]
+    peak_idx = peak_op.get("idx")
+    for r in rows:
+        t = r.get("by_tag") or {}
+        mark = "  <- peak" if r.get("idx") == peak_idx else ""
+        lines.append(
+            f"{r.get('idx', '?'):>4} {r.get('type', '?'):<24.24} "
+            f"{r.get('kind', '?'):<8} "
+            f"{r.get('live_bytes', 0) / mb:>9.3f} "
+            f"{t.get('params', 0) / mb:>8.3f} "
+            f"{t.get('activations', 0) / mb:>8.3f} "
+            f"{t.get('grads', 0) / mb:>8.3f} "
+            f"{t.get('opt_state', 0) / mb:>8.3f}{mark}")
+    if not rows:
+        lines.append("(empty timeline)")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", help="chrome-trace JSON file")
@@ -225,9 +275,16 @@ def main(argv=None):
     ap.add_argument("--compiles", action="store_true",
                     help="print the compile-ledger table "
                          "(cat='compile' spans: site/cause/provenance)")
+    ap.add_argument("--memplan", action="store_true",
+                    help="treat the positional arg as a static memory "
+                         "plan JSON (MemoryPlan.to_doc()) and render "
+                         "its per-op live-byte timeline")
     args = ap.parse_args(argv)
     with open(args.trace) as f:
         doc = json.load(f)
+    if args.memplan:
+        print(format_memplan(doc, top=args.top))
+        return 0
     events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
     if args.compiles:
         print(compile_table(events))
