@@ -197,6 +197,31 @@ def paged_view(cache: PagedKV) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return k, v
 
 
+_HOST_SHARDING_PROBED = False
+_HOST_SHARDING = None
+
+
+def _host_sharding():
+    """Sharding that places an array in **pinned host memory** when
+    the backend exposes the ``pinned_host`` memory kind (TPU offload —
+    the same probe seam as the zero-offload optimizer's
+    ``_supported_memory_kind``); None on backends where host memory IS
+    the default (CPU CI), where the caller falls back to plain numpy
+    arrays.  Probed once per process."""
+    global _HOST_SHARDING_PROBED, _HOST_SHARDING
+    if not _HOST_SHARDING_PROBED:
+        _HOST_SHARDING_PROBED = True
+        try:
+            dev = jax.devices()[0]
+            if any(m.kind == "pinned_host"
+                   for m in dev.addressable_memories()):
+                _HOST_SHARDING = jax.sharding.SingleDeviceSharding(
+                    dev, memory_kind="pinned_host")
+        except Exception:   # noqa: BLE001 — older jax: numpy fallback
+            _HOST_SHARDING = None
+    return _HOST_SHARDING
+
+
 class BlockPoolExhausted(RuntimeError):
     """The pool cannot satisfy an allocation (or the ``kv.block_alloc``
     chaos site injected exhaustion).  Engines convert this into a typed
@@ -601,6 +626,48 @@ class PagedGenerationSession(GenerationSession):
                                  self._copy_fn, args)
             arenas = exe(*args)
         return arenas
+
+    # -- preemption swap (engine-driven) ------------------------------
+    def swap_out_blocks(self, arenas, blocks: Sequence[int]):
+        """Gather ``blocks``' contents (every layer, every arena
+        field — k/v and, when quantized, the scale planes) to HOST
+        memory so the engine can free the device blocks for
+        higher-priority work.  Pinned host memory (``pinned_host``
+        memory kind) when the backend exposes it; plain numpy arrays
+        on CPU CI.  Blocked until the copies land — the caller decrefs
+        the blocks immediately after, so the gather must not race
+        their reuse.  Returns an opaque per-layer payload for
+        :meth:`swap_in_blocks`."""
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        host = _host_sharding()
+        out = []
+        for a in arenas:
+            fields = []
+            for f in a:
+                g = f[idx]                       # (n, bs, ...) gather
+                if host is not None:
+                    g = jax.device_put(g, host)
+                    g.block_until_ready()
+                else:
+                    g = np.asarray(g)            # sync host copy
+                fields.append(g)
+            out.append(tuple(fields))
+        return out
+
+    def swap_in_blocks(self, arenas, blocks: Sequence[int], payload):
+        """Restore a :meth:`swap_out_blocks` payload into freshly
+        allocated ``blocks``: ``device_put`` + scatter per layer/field.
+        Contents are bit-identical to what was swapped out (pure
+        copies, no recompute), which is what makes a resumed stream
+        bit-exact — the block *ids* may differ, the block-table
+        rewrite absorbs that."""
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        new = []
+        for a, fields in zip(arenas, payload):
+            new.append(type(a)(*[
+                f.at[idx].set(jnp.asarray(h))
+                for f, h in zip(a, fields)]))
+        return tuple(new)
 
     # -- high-level generate ------------------------------------------
     def generate(self, ids, prompt_lens=None, max_new_tokens: int = 32,

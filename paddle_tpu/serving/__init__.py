@@ -15,7 +15,12 @@ with Orca/Clipper-style dynamic batching):
   total XLA compiles bounded by the bucket count, not by observed
   shapes;
 - admission control (``admission.py``): queue-depth bound, per-request
-  deadlines, explicit overload rejection with SLO metrics;
+  deadlines, explicit overload rejection with SLO metrics, priority
+  classes (``interactive``/``standard``/``batch``) with bounded-aging
+  dequeue, per-tenant token-bucket quotas
+  (:class:`TenantQuotaTable`, hot-reloadable via
+  :class:`QuotaWatcher`), drain-rate-derived Retry-After
+  (:class:`DrainRateEstimator`);
 - HTTP frontend (``server.py``): ``/v1/infer`` (JSON or .npz),
   ``/v1/generate`` (JSON; SSE token streaming with ``stream=true``),
   ``/healthz``, Prometheus ``/metrics``;
@@ -45,8 +50,10 @@ Quick start::
     for tok in gen.submit(prompt_ids, do_sample=True, seed=7):
         ...                               # tokens as they decode
 """
-from .admission import (AdmissionController, DeadlineExceeded,
-                        EngineClosed, RequestRejected)
+from .admission import (PRIORITIES, AdmissionController,
+                        DeadlineExceeded, DrainRateEstimator,
+                        EngineClosed, QuotaWatcher, RequestRejected,
+                        TenantQuotaTable, priority_rank)
 from .bucketing import BucketPolicy, ExecutableCache, next_bucket, \
     pad_batch, seq_buckets
 from .engine import (EngineConfig, GenerationEngine,
@@ -64,4 +71,6 @@ __all__ = ["InferenceEngine", "EngineConfig", "ServingServer", "serve",
            "EngineClosed", "AdmissionController", "BucketPolicy",
            "ExecutableCache", "next_bucket", "pad_batch",
            "seq_buckets", "validate_artifact", "FleetReplica",
-           "FleetRouter", "ReplicaRegistry", "WeightWatcher"]
+           "FleetRouter", "ReplicaRegistry", "WeightWatcher",
+           "PRIORITIES", "priority_rank", "TenantQuotaTable",
+           "DrainRateEstimator", "QuotaWatcher"]

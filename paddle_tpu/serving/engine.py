@@ -40,8 +40,9 @@ from ..profiler import memscope as _memscope
 from ..profiler import rtrace as _rtrace
 from ..profiler import tracer as _tracer
 from ..utils import concurrency as _conc
-from .admission import (AdmissionController, DeadlineExceeded,
-                        EngineClosed, RequestRejected, deadline_from_ms)
+from .admission import (PRIORITIES, AdmissionController, DeadlineExceeded,
+                        EngineClosed, RequestRejected, TenantQuotaTable,
+                        deadline_from_ms, priority_rank)
 from .bucketing import BucketPolicy, ExecutableCache
 
 __all__ = ["EngineConfig", "InferenceEngine", "RequestRejected",
@@ -900,6 +901,18 @@ class GenerationEngineConfig:
                          can physically hold")
     deadline_ms          default per-request deadline (sheds while
                          queued, like the batch engine); None = none
+    aging_s              priority-aging interval for the queue: a
+                         waiting request's effective priority improves
+                         one class per ``aging_s`` seconds, so batch
+                         traffic is delayed under interactive bursts
+                         but can never starve (0 disables aging —
+                         strict priority order)
+    tenant_quotas        per-tenant token-bucket table
+                         ``{tenant: {"rate": tokens/s, "burst": max}}``
+                         (``"*"`` = default for unlisted tenants);
+                         exhaustion sheds typed ``tenant_quota``.
+                         Hot-reloadable at runtime via
+                         ``engine.set_quotas`` / admission.QuotaWatcher
     prompt_bucket_min    smallest prompt-length bucket (prefill
                          executables are one-per-bucket)
     warmup               pre-populate the decode executable and every
@@ -944,6 +957,8 @@ class GenerationEngineConfig:
                  max_queue: Optional[int] = None,
                  max_tokens_in_flight: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
+                 aging_s: float = 2.0,
+                 tenant_quotas: Optional[Dict[str, dict]] = None,
                  prompt_bucket_min: int = 8,
                  warmup: bool = False,
                  name: str = "serving",
@@ -964,6 +979,8 @@ class GenerationEngineConfig:
         self.max_queue = int(max_queue)
         self.max_tokens_in_flight = max_tokens_in_flight
         self.deadline_ms = deadline_ms
+        self.aging_s = float(aging_s)
+        self.tenant_quotas = tenant_quotas
         self.prompt_bucket_min = int(prompt_bucket_min)
         self.warmup = warmup if warmup == "async" else bool(warmup)
         self.name = str(name)
@@ -987,10 +1004,11 @@ class _GenRequest:
                  "seed", "eos", "deadline", "budget", "future", "queue",
                  "tokens", "t_submit", "t_first", "t_last", "cancelled",
                  "blocks", "cached_len", "ctx", "t_submit_ns",
-                 "finish_reason")
+                 "finish_reason", "tenant", "priority", "parked")
 
     def __init__(self, prompt, max_new, temperature, top_k, top_p,
-                 seed, eos, deadline, budget, ctx=None):
+                 seed, eos, deadline, budget, ctx=None, tenant=None,
+                 priority=1):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
@@ -1014,10 +1032,20 @@ class _GenRequest:
         self.ctx = ctx
         self.t_submit_ns = _tracer.now_ns() if ctx is not None else 0
         self.finish_reason: Optional[str] = None
+        self.tenant: Optional[str] = tenant
+        self.priority = int(priority)   # rank into admission.PRIORITIES
+        # preemption park state: {"host": payload, "nblocks": n,
+        # "pos": absolute position, "last": last sampled token} while
+        # the request sits swapped out in host memory; None otherwise
+        self.parked: Optional[dict] = None
 
     @property
     def request_id(self) -> Optional[str]:
         return self.ctx.request_id if self.ctx is not None else None
+
+    @property
+    def priority_name(self) -> str:
+        return PRIORITIES[self.priority]
 
     def expired(self, now: Optional[float] = None) -> bool:
         return self.deadline is not None and \
@@ -1154,6 +1182,10 @@ class GenerationEngine:
         self._init_slot_state()
 
         self._pending: deque = deque()
+        # requests preempted out of their decode slots to host memory
+        # (paged engine only; the base engine never parks anything)
+        self._parked: List[_GenRequest] = []
+        self._aging_s = float(cfg.aging_s)
         self._cond = _conc.Condition(name=f"{cfg.name}"
                                      ".genengine.cond")
         self._mlock = _conc.Lock(name=f"{cfg.name}.genengine.metrics")
@@ -1192,7 +1224,24 @@ class GenerationEngine:
             budget = self.slots * self.max_length
         return AdmissionController(
             cfg.max_queue, max_rows=None, name=cfg.name,
-            max_tokens=int(budget))
+            max_tokens=int(budget),
+            quotas=self._make_quotas(cfg))
+
+    @staticmethod
+    def _make_quotas(cfg: GenerationEngineConfig):
+        if not cfg.tenant_quotas:
+            return None
+        return TenantQuotaTable(cfg.tenant_quotas)
+
+    def set_quotas(self, quotas) -> int:
+        """Hot-swap the per-tenant quota table (dict of tenant ->
+        ``{"rate": tokens/s, "burst": tokens}``, a built
+        :class:`TenantQuotaTable`, or None to drop quota enforcement).
+        Validated before publication; in-flight bucket levels carry
+        over clamped to the new burst.  Returns the table generation.
+        This is the :class:`QuotaWatcher` apply hook — throttle a
+        tenant without a restart."""
+        return self._admission.set_quotas(quotas)
 
     def _init_slot_arrays(self):
         S = self.slots
@@ -1309,15 +1358,23 @@ class GenerationEngine:
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                eos_token_id: Optional[int] = None,
                deadline_ms: Optional[float] = "default",
-               trace_ctx=None) -> GenerationStream:
+               trace_ctx=None, tenant: Optional[str] = None,
+               priority: Optional[str] = None) -> GenerationStream:
         """Enqueue one prompt; returns a :class:`GenerationStream`.
         Raises :class:`RequestRejected` at admission (``queue_full`` /
-        ``token_budget`` / ``too_large`` / ``closed``); the
-        ``serve.request`` chaos site can fail or delay here.
-        ``trace_ctx`` (an rtrace TraceContext, usually built by the
-        HTTP layer from ``traceparent``/``X-Request-Id``) makes every
-        hop of this request — admission verdict, queue wait, prefill,
-        each decode boundary — emit request-scoped spans."""
+        ``token_budget`` / ``too_large`` / ``tenant_quota`` /
+        ``closed``); the ``serve.request`` chaos site can fail or
+        delay here.  ``tenant`` charges the request against that
+        tenant's token bucket (when quotas are configured) and labels
+        its per-tenant metrics; ``priority`` is one of
+        ``admission.PRIORITIES`` ("interactive" < "standard" <
+        "batch") and orders dequeue — lower classes only run when no
+        higher class is waiting, subject to bounded aging
+        (``aging_s``).  ``trace_ctx`` (an rtrace TraceContext, usually
+        built by the HTTP layer from ``traceparent``/``X-Request-Id``)
+        makes every hop of this request — admission verdict, queue
+        wait, prefill, each decode boundary — emit request-scoped
+        spans."""
         prompt = np.asarray(getattr(prompt, "_data", prompt))
         prompt = prompt.reshape(-1).astype(np.int32)
         if prompt.size < 1:
@@ -1326,9 +1383,15 @@ class GenerationEngine:
                       else self.config.max_new_tokens)
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        rank = priority_rank(priority)   # ValueError on unknown class
         traced = trace_ctx is not None and _rtrace.active
         t_adm = _tracer.now_ns() if traced else 0
         budget = self._token_reservation(prompt, max_new)
+        # the quota charge is the request's true worst-case token cost
+        # regardless of engine flavor (the paged engine's admission
+        # budget is 0 — occupancy-driven — but a tenant's bucket must
+        # still drain by what the request can consume)
+        quota_cost = int(prompt.size) + max_new
         try:
             if prompt.size >= self.max_length:
                 # route through the controller so the per-reason counter
@@ -1341,7 +1404,9 @@ class GenerationEngine:
             from ..utils import chaos as _chaos
             if _chaos.active:
                 _chaos.hit("serve.request")
-            self._admission.acquire(tokens=budget)
+            self._admission.acquire(
+                tokens=budget, tenant=tenant,
+                priority=PRIORITIES[rank], quota_tokens=quota_cost)
         except RequestRejected as e:
             if traced:
                 # a rejected request still leaves a terminated span
@@ -1363,7 +1428,8 @@ class GenerationEngine:
             prompt, max_new,
             float(temperature) if do_sample else 0.0, int(top_k),
             float(top_p), int(seed), eos_token_id,
-            deadline_from_ms(deadline_ms), budget, ctx=trace_ctx)
+            deadline_from_ms(deadline_ms), budget, ctx=trace_ctx,
+            tenant=tenant, priority=rank)
         with self._cond:
             if self._closed:
                 self._admission.release()
@@ -1405,6 +1471,12 @@ class GenerationEngine:
         """Occupied decode slots — the live-load signal a fleet
         router's least-loaded dispatch reads from the registry."""
         return sum(1 for r in self._slot_req if r is not None)
+
+    @property
+    def parked(self) -> int:
+        """Requests currently preempted to host memory (paged engine
+        only; always 0 on the contiguous engine)."""
+        return len(self._parked)
 
     def swap_weights(self, params, buffers=None, *,
                      timeout: float = 60.0):
@@ -1524,12 +1596,13 @@ class GenerationEngine:
             with self._cond:
                 while self._swap is None and \
                         ((not self._stop and not self._pending
+                          and not self._parked
                           and not self._occupied()) or
                          (self._paused and not self._occupied()
                           and not self._stop)):
                     self._cond.wait()
                 if self._stop and not self._pending \
-                        and not self._occupied():
+                        and not self._parked and not self._occupied():
                     break
             if self._swap is not None:
                 # between boundaries by construction: the previous
@@ -1586,6 +1659,28 @@ class GenerationEngine:
         for s in occ:
             self._emit(s, int(tok[s]))
 
+    def _pop_pending(self) -> _GenRequest:
+        """Priority-ordered dequeue with bounded aging (caller holds
+        ``_cond``, ``_pending`` non-empty).  Effective rank =
+        ``max(0, rank - waited // aging_s)`` — a batch request climbs
+        one priority class per ``aging_s`` seconds queued, so it
+        cannot starve forever behind a sustained interactive stream;
+        ties break FIFO by queue position.  ``aging_s=0`` disables
+        aging (strict priority)."""
+        aging = self._aging_s
+        now = time.monotonic() if aging > 0 else 0.0
+        best_i, best_key = 0, None
+        for i, r in enumerate(self._pending):
+            eff = r.priority
+            if aging > 0:
+                eff = max(0, eff - int((now - r.t_submit) / aging))
+            key = (eff, i)
+            if best_key is None or key < best_key:
+                best_i, best_key = i, key
+        req = self._pending[best_i]
+        del self._pending[best_i]
+        return req
+
     def _admit(self):
         """Token-boundary admission: move queued requests into free
         slots, grouped per prompt-length bucket, one masked prefill per
@@ -1597,7 +1692,7 @@ class GenerationEngine:
             free = [i for i, r in enumerate(self._slot_req)
                     if r is None]
             while self._pending and free:
-                req = self._pending.popleft()
+                req = self._pop_pending()
                 self._admission.release()
                 if req.expired():
                     self._shed(req)
@@ -1686,6 +1781,26 @@ class GenerationEngine:
         place to audit means no path can leak."""
         self._admission.release_tokens(req.budget)
 
+    def _note_tenant(self, req: _GenRequest, what: str, n: int = 1,
+                     latency_ms: Optional[float] = None):
+        """One bump on a ``<prefix>.tenant.<t>.*`` accounting series —
+        the tenant-labeled counters a fleet ``/metrics`` aggregation
+        sums across replicas.  No-op for untenanted requests.  Callers
+        must NOT hold ``_mlock``."""
+        if req.tenant is None:
+            return
+        from ..profiler import metrics as _metrics
+        base = f"{self.metrics_prefix}.tenant.{req.tenant}"
+        with self._mlock:
+            _metrics.counter(
+                f"{base}.{what}",
+                f"per-tenant {what} (requests or tokens)").inc(n)
+            if latency_ms is not None:
+                _metrics.histogram(
+                    f"{base}.latency_ms",
+                    "per-tenant end-to-end request latency"
+                    ).observe(latency_ms)
+
     def _retire(self, req: _GenRequest, slot: Optional[int]):
         if slot is not None:
             self._slot_req[slot] = None
@@ -1704,11 +1819,17 @@ class GenerationEngine:
                     self._m_cancelled.inc()
                 else:
                     self._m_done.inc()
+            if not req.cancelled:
+                self._note_tenant(
+                    req, "completed",
+                    latency_ms=(time.monotonic() - req.t_submit) * 1e3)
+                self._note_tenant(req, "tokens_out", len(req.tokens))
         req.queue.put(None)
 
     def _shed(self, req: _GenRequest):
         with self._mlock:
             self._admission.shed_deadline()
+        self._note_tenant(req, "shed")
         self._release_resources(req)
         if req.ctx is not None and _rtrace.active:
             req.ctx.record("queue_wait", req.t_submit_ns,
@@ -1724,7 +1845,11 @@ class GenerationEngine:
         with self._cond:
             pending = list(self._pending)
             self._pending.clear()
-        victims = pending + [r for r in self._slot_req if r is not None]
+            parked, self._parked = list(self._parked), []
+        for r in parked:
+            r.parked = None   # drop the host-side swap payload
+        victims = pending + parked + \
+            [r for r in self._slot_req if r is not None]
         self._slot_req = [None] * self.slots
         if _memscope.active and _memscope.is_oom(exc):
             # OOM forensics before the generic failure dump: census +
@@ -1831,7 +1956,7 @@ class PagedGenerationEngine(GenerationEngine):
         # plus live block-pool occupancy at allocation time
         return AdmissionController(
             cfg.max_queue, max_rows=None, name=cfg.name,
-            max_tokens=None)
+            max_tokens=None, quotas=self._make_quotas(cfg))
 
     def _token_reservation(self, prompt, max_new: int) -> int:
         return 0
@@ -1861,6 +1986,15 @@ class PagedGenerationEngine(GenerationEngine):
         self._g_spec_rate = _metrics.gauge(
             f"{p}.spec.accept_rate", "accepted/proposed draft ratio "
             "(engine lifetime)")
+        self._m_preempted = _metrics.counter(
+            f"{p}.request.preempted", "decode slots preempted to host "
+            "memory under block-pool pressure")
+        self._m_resumed = _metrics.counter(
+            f"{p}.request.resumed", "preempted requests swapped back "
+            "into a decode slot")
+        self._g_parked = _metrics.gauge(
+            f"{p}.requests_parked", "requests currently swapped out "
+            "to host memory awaiting blocks")
         if _memscope.active:
             self._note_memory_tags()
 
@@ -2038,6 +2172,205 @@ class PagedGenerationEngine(GenerationEngine):
             req.future.set_exception(exc)
         req.queue.put(exc)
 
+    # -- preemption to host memory ------------------------------------
+    def _pick_victim(self, max_rank: int,
+                     exclude: Optional[int] = None) -> Optional[int]:
+        """Choose the live slot to preempt for a rank-``max_rank``
+        requester: strictly lower priority only (batch never bumps
+        batch), preferring the lowest class first, then the slot
+        holding the most blocks (one swap frees the most memory), then
+        the lowest slot index (determinism — the gates assert exact
+        preempt counts)."""
+        best, best_key = None, None
+        for s, r in enumerate(self._slot_req):
+            if r is None or s == exclude or r.priority <= max_rank:
+                continue
+            if not r.tokens:
+                # placed this round but not yet prefilled: the slot's
+                # position and KV bytes are not valid swap state
+                continue
+            key = (-r.priority, -len(r.blocks), s)
+            if best_key is None or key < best_key:
+                best, best_key = s, key
+        return best
+
+    def _preempt_for(self, req: _GenRequest,
+                     exclude: Optional[int] = None) -> bool:
+        """Free blocks for ``req`` by preempting one strictly
+        lower-priority live slot to host memory.  Returns False when
+        no eligible victim exists (the caller sheds the requester
+        typed instead — preemption never bumps an equal-or-higher
+        class)."""
+        victim = self._pick_victim(req.priority, exclude=exclude)
+        if victim is None:
+            return False
+        self._preempt_slot(victim)
+        return True
+
+    def _preempt_slot(self, slot: int):
+        """Swap one live decode slot out to host memory: gather its
+        blocks' contents (pinned host memory when the backend has the
+        ``pinned_host`` kind; plain numpy on CPU CI), park the request
+        with its sampling state, and free the blocks through
+        ``_release_resources``.  The parked stream resumes bit-exact:
+        the per-step sample key is ``fold_in(base_key, position)`` and
+        both position and KV bytes are restored verbatim, so the
+        continuation is the very token sequence an unpreempted run
+        would have produced."""
+        req = self._slot_req[slot]
+        from ..utils import chaos as _chaos
+        if _chaos.active:
+            try:
+                _chaos.hit("serve.preempt")
+            except Exception as e:  # noqa: BLE001 — injected swap fail
+                # a failed swap-out must not corrupt the batch: shed
+                # the victim typed (blocks still freed) instead of
+                # parking state we could not capture
+                self._shed_kv(req, slot, e)
+                return
+        nblocks = len(req.blocks)
+        pos = int(self._positions[slot])
+        host = self.session.swap_out_blocks(self._arenas, req.blocks)
+        req.parked = {"host": host, "nblocks": nblocks, "pos": pos,
+                      "last": int(self._last_tok[slot])}
+        self._slot_req[slot] = None
+        self._table[slot, :] = -1
+        self._release_resources(req)     # returns the device blocks
+        with self._cond:
+            self._parked.append(req)
+        with self._mlock:
+            self._m_preempted.inc()
+            self._g_parked.set(len(self._parked))
+        self._note_tenant(req, "preempted")
+        if _flight.active:
+            _flight.note("serve", "preempt",
+                         engine=self.metrics_prefix, slot=slot,
+                         request=req.request_id, tenant=req.tenant,
+                         priority=req.priority_name, blocks=nblocks,
+                         position=pos)
+        if req.ctx is not None and _rtrace.active:
+            req.ctx.record("preempt", _tracer.now_ns(), slot=slot,
+                           blocks=nblocks)
+
+    def _sweep_parked(self):
+        """Deadline pass over the parked set: a stream whose deadline
+        expired (or was cancelled) while swapped out sheds typed
+        ``deadline_preempted`` — resuming it would burn blocks on a
+        stream nobody is waiting for."""
+        with self._cond:
+            dead = [r for r in self._parked
+                    if r.expired() or r.cancelled]
+            for r in dead:
+                self._parked.remove(r)
+        for req in dead:
+            req.parked = None            # release the host-side state
+            if req.cancelled:
+                self._retire(req, slot=None)
+                continue
+            with self._mlock:
+                self._admission.shed_deadline(preempted=True)
+                self._g_parked.set(len(self._parked))
+            self._note_tenant(req, "shed")
+            self._release_resources(req)
+            if req.ctx is not None and _rtrace.active:
+                req.ctx.record("shed", req.t_submit_ns,
+                               outcome="deadline_preempted",
+                               terminated=True)
+            exc = DeadlineExceeded(
+                "request deadline expired while preempted to host "
+                "memory", reason="deadline_preempted")
+            if not req.future.done():
+                req.future.set_exception(exc)
+            req.queue.put(exc)
+
+    def _try_resume(self):
+        """Admission-tail resume pass: swap parked requests back into
+        free slots as the pool refills, highest aged priority first.
+        A pool that cannot cover a parked stream while other slots are
+        live simply waits (their retirements will free blocks); with
+        nothing live it drops the prefix cache's holds and, if the
+        stream still cannot fit, sheds it typed rather than wedging
+        the scheduler."""
+        from ..generation import BlockPoolExhausted
+        cleared_cache = False
+        while True:
+            with self._cond:
+                if not self._parked or self._paused:
+                    return
+                free = [i for i, r in enumerate(self._slot_req)
+                        if r is None]
+                if not free:
+                    return
+                aging = self._aging_s
+                now = time.monotonic()
+
+                def _eff(r):
+                    e = r.priority
+                    if aging > 0:
+                        e = max(0, e - int((now - r.t_submit) / aging))
+                    return e
+
+                req = min(self._parked,
+                          key=lambda r: (_eff(r), r.t_submit))
+                slot = free[0]
+            try:
+                blocks = self.pool.alloc(req.parked["nblocks"])
+            except BlockPoolExhausted as e:
+                if self._occupied():
+                    return       # retirements will free blocks
+                if not cleared_cache:
+                    self.prefix_cache.clear()
+                    cleared_cache = True
+                    continue
+                # the pool physically cannot hold this stream even
+                # empty: shed typed rather than park forever
+                with self._cond:
+                    self._parked.remove(req)
+                with self._mlock:
+                    self._g_parked.set(len(self._parked))
+                req.parked = None
+                self._shed_kv(req, None, e)
+                continue
+            self._resume_into(slot, req, blocks)
+
+    def _resume_into(self, slot: int, req: _GenRequest,
+                     blocks: List[int]):
+        """Swap a parked request back in: ``device_put`` the host
+        payload into the fresh ``blocks``, rewrite the slot's table
+        row, restore position/last-token/sampling params.  Block ids
+        may differ from the preempted set — the table rewrite absorbs
+        that; contents are bit-identical."""
+        park = req.parked
+        self._arenas = self.session.swap_in_blocks(
+            self._arenas, blocks, park["host"])
+        req.blocks = blocks
+        req.parked = None
+        with self._cond:
+            self._parked.remove(req)
+        self._table[slot, :] = -1
+        self._table[slot, :len(blocks)] = blocks
+        self._slot_req[slot] = req
+        self._positions[slot] = park["pos"]
+        self._last_tok[slot] = park["last"]
+        self._keys[slot] = np.asarray(jax_random_key(req.seed),
+                                      np.uint32)
+        self._temps[slot] = req.temperature
+        self._tks[slot] = req.top_k
+        self._tps[slot] = req.top_p
+        with self._mlock:
+            self._m_resumed.inc()
+            self._g_parked.set(len(self._parked))
+        self._note_tenant(req, "resumed")
+        if _flight.active:
+            _flight.note("serve", "resume",
+                         engine=self.metrics_prefix, slot=slot,
+                         request=req.request_id, tenant=req.tenant,
+                         priority=req.priority_name,
+                         blocks=len(blocks), position=park["pos"])
+        if req.ctx is not None and _rtrace.active:
+            req.ctx.record("resume", _tracer.now_ns(), slot=slot,
+                           blocks=len(blocks))
+
     def _retire(self, req: _GenRequest, slot: Optional[int]):
         if slot is not None:
             self._table[slot, :] = -1
@@ -2046,6 +2379,8 @@ class PagedGenerationEngine(GenerationEngine):
     def _fail_all(self, exc: BaseException):
         super()._fail_all(exc)
         self._table[:, :] = -1
+        with self._mlock:
+            self._g_parked.set(0)
 
     def close(self, timeout: Optional[float] = 60.0):
         super().close(timeout=timeout)
@@ -2055,10 +2390,21 @@ class PagedGenerationEngine(GenerationEngine):
 
     # -- scheduler overrides ------------------------------------------
     def _admit(self):
-        """Token-boundary admission, paged edition: prefix-cache
-        lookup + block allocation per request, then ONE chunked
-        prefill per suffix-length bucket feeding each row's uncached
-        suffix at its true offset."""
+        """Token-boundary admission, paged edition: deadline-sweep the
+        parked set, admit queued requests (preempting lower-priority
+        slots under pool pressure), then resume parked streams into
+        whatever slots and blocks remain."""
+        self._sweep_parked()
+        self._admit_pending()
+        self._try_resume()
+
+    def _admit_pending(self):
+        """Queued-request admission: prefix-cache lookup + block
+        allocation per request, then ONE chunked prefill per
+        suffix-length bucket feeding each row's uncached suffix at its
+        true offset.  Pool exhaustion preempts the lowest strictly
+        lower-priority live slot and retries; with no eligible victim
+        the *incoming* request sheds typed."""
         from ..generation import BlockPoolExhausted, blocks_for_tokens
         took: List[Tuple[int, _GenRequest]] = []
         with self._cond:
@@ -2067,7 +2413,7 @@ class PagedGenerationEngine(GenerationEngine):
             free = [i for i, r in enumerate(self._slot_req)
                     if r is None]
             while self._pending and free:
-                req = self._pending.popleft()
+                req = self._pop_pending()
                 self._admission.release()
                 if req.expired():
                     self._shed(req)
@@ -2081,10 +2427,18 @@ class PagedGenerationEngine(GenerationEngine):
         placed: List[Tuple[int, _GenRequest]] = []
         cows: List[Tuple[int, int]] = []
         for slot, req in took:
-            try:
-                cow = self._prepare_slot(slot, req)
-            except BlockPoolExhausted as e:
-                self._shed_kv(req, None, e)
+            shed = False
+            while True:
+                try:
+                    cow = self._prepare_slot(slot, req)
+                    break
+                except BlockPoolExhausted as e:
+                    if self._preempt_for(req, exclude=slot):
+                        continue     # blocks freed — retry the alloc
+                    self._shed_kv(req, None, e)
+                    shed = True
+                    break
+            if shed:
                 continue
             if cow is not None:
                 cows.append(cow)
@@ -2150,14 +2504,23 @@ class PagedGenerationEngine(GenerationEngine):
                 self._verify_round(occ, drafts, k)
                 return
         # plain paged decode: each live row writes one token at its
-        # position — grow its table lazily first
+        # position — grow its table lazily first; pool pressure
+        # preempts a strictly lower-priority neighbour before shedding
         victims = []
         for s in occ:
             req = self._slot_req[s]
-            try:
-                self._ensure_blocks(s, req, int(self._positions[s]))
-            except BlockPoolExhausted as e:
-                victims.append((s, req, e))
+            if req is None:
+                continue     # preempted for an earlier row this pass
+            while True:
+                try:
+                    self._ensure_blocks(s, req,
+                                        int(self._positions[s]))
+                    break
+                except BlockPoolExhausted as e:
+                    if self._preempt_for(req, exclude=s):
+                        continue
+                    victims.append((s, req, e))
+                    break
         for s, req, e in victims:
             self._shed_kv(req, s, e)
         occ = self._occupied()
@@ -2194,18 +2557,37 @@ class PagedGenerationEngine(GenerationEngine):
         victims, live = [], []
         for s in occ:
             req = self._slot_req[s]
+            if req is None:
+                continue     # preempted for an earlier row this pass
             d = drafts.get(s) or []
             fill_verify_row(ids, feed, s, int(self._last_tok[s]), d)
-            try:
-                self._ensure_blocks(s, req,
-                                    int(self._positions[s]) + len(d))
-            except BlockPoolExhausted as e:
-                feed[s] = 0              # shed row stays inert
-                victims.append((s, req, e))
-                continue
-            live.append(s)
+            shed = False
+            while True:
+                try:
+                    self._ensure_blocks(
+                        s, req, int(self._positions[s]) + len(d))
+                    break
+                except BlockPoolExhausted as e:
+                    if self._preempt_for(req, exclude=s):
+                        continue
+                    feed[s] = 0          # shed row stays inert
+                    victims.append((s, req, e))
+                    shed = True
+                    break
+            if not shed:
+                live.append(s)
         for s, req, e in victims:
             self._shed_kv(req, s, e)
+        # a later row's preemption may have parked an earlier live row:
+        # its writes drop through the -1 table, but keep it out of the
+        # feed and the live count
+        live2 = []
+        for s in live:
+            if self._slot_req[s] is None:
+                feed[s] = 0
+            else:
+                live2.append(s)
+        live = live2
         if not live:
             return
         t0 = _tracer.now_ns() if _rtrace.active else 0

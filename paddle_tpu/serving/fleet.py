@@ -746,6 +746,10 @@ class FleetRouter:
         self.verbose = bool(verbose)
 
         self._lock = _conc.Lock(name="fleet.router")
+        from .admission import DrainRateEstimator
+        # Retry-After on router sheds comes from the observed dispatch
+        # drain rate (clamped [1, 30]s), not a constant
+        self._drain = DrainRateEstimator()
         self._replicas: Dict[str, ReplicaInfo] = {}
         self._deny: Dict[str, float] = {}
         self._probe_fail: Dict[str, int] = {}
@@ -1204,8 +1208,12 @@ class FleetRouter:
         except OSError:
             pass            # client gone; nothing to salvage
 
+    # X-Tenant / X-Priority ride every attempt of a dispatch — a
+    # failover re-issue carries the same tenant identity and priority
+    # class as the original, so the replacement replica admits it into
+    # the same queue position class and charges the same bucket
     _FORWARD_HEADERS = ("Content-Type", "X-Request-Id", "traceparent",
-                        "X-Deadline-Ms")
+                        "X-Deadline-Ms", "X-Tenant", "X-Priority")
 
     def handle_post(self, h):
         if h.path not in ("/v1/infer", "/infer", "/v1/generate",
@@ -1246,7 +1254,9 @@ class FleetRouter:
             self._send_json(h, 429, {
                 "error": f"router at max_inflight="
                 f"{self.max_inflight}; retry with backoff",
-                "reason": "router_overload"}, retry_after="1")
+                "reason": "router_overload"},
+                retry_after=str(self._drain.retry_after_s(
+                    self._inflight)))
             return
         try:
             self._dispatch(h, h.path, body, stream)
@@ -1307,7 +1317,9 @@ class FleetRouter:
             else:
                 self._send_json(h, 503, {"error": str(e),
                                          "reason": "no_replica"},
-                                retry_after="2")
+                                retry_after=str(
+                                    self._drain.retry_after_s(
+                                        self._inflight)))
             return
         except _ClientGone:
             return
@@ -1327,9 +1339,12 @@ class FleetRouter:
                 self._send_json(h, 502, {
                     "error": f"every dispatch attempt failed "
                     f"(last: {type(e).__name__}: {e})",
-                    "reason": "fleet_exhausted"}, retry_after="2")
+                    "reason": "fleet_exhausted"},
+                    retry_after=str(self._drain.retry_after_s(
+                        self._inflight)))
             return
         self._m_dispatched.inc()
+        self._drain.note()
         # canary accounting: 2xx is a clean sample, a 5xx on the NEW
         # weights is exactly what the window exists to catch (4xx is
         # the client's fault, not the weights')

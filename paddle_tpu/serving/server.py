@@ -35,6 +35,15 @@ stream).  Endpoints:
 
 Status mapping: 200 ok, 400 malformed payload, 429 admission rejection
 (overload — shed, don't OOM), 503 engine closed, 504 deadline exceeded.
+429/503 responses carry a ``Retry-After`` header derived from the
+observed queue drain rate (clamped to [1, 30] s), not a constant.
+
+Multi-tenant SLO headers (``/v1/generate``): ``X-Tenant`` charges the
+request against that tenant's token bucket and labels its
+``serving.tenant.*`` metric series; ``X-Priority`` is one of
+``interactive`` / ``standard`` / ``batch`` and orders dequeue (payload
+keys ``tenant`` / ``priority`` work too; the headers win).  Quota
+exhaustion answers 429 with ``reason="tenant_quota"``.
 """
 from __future__ import annotations
 
@@ -120,7 +129,8 @@ class _Handler(BaseHTTPRequestHandler):
                          status=self._last_status)
 
     # -- helpers -------------------------------------------------------
-    def _send(self, code: int, body: bytes, ctype: str):
+    def _send(self, code: int, body: bytes, ctype: str,
+              retry_after=None):
         if self._t_first_write is None:
             self._t_first_write = _tracer.now_ns() \
                 if getattr(self, "_traced", False) else 0
@@ -128,12 +138,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            self.send_header("Retry-After", str(int(retry_after)))
         for k, v in getattr(self, "_obs_headers", {}).items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, code: int, obj):
+    def _send_json(self, code: int, obj, retry_after=None):
         if code >= 400 and isinstance(obj, dict) \
                 and "request_id" not in obj \
                 and getattr(self, "_request_id", None):
@@ -141,7 +153,8 @@ class _Handler(BaseHTTPRequestHandler):
             # only logs bodies can still quote it at the operator
             obj = dict(obj, request_id=self._request_id)
         self._send(code, json.dumps(obj, default=_json_default)
-                   .encode(), "application/json")
+                   .encode(), "application/json",
+                   retry_after=retry_after)
 
     # -- GET -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 - stdlib handler naming
@@ -189,7 +202,8 @@ class _Handler(BaseHTTPRequestHandler):
                 body.update(decode_slots=g.slots,
                             max_length=g.max_length,
                             decode_warmed_buckets=getattr(
-                                g, "warmed_buckets", 0))
+                                g, "warmed_buckets", 0),
+                            requests_parked=getattr(g, "parked", 0))
                 pool = getattr(g, "pool", None)
                 if pool is not None:
                     # paged engine: block-pool occupancy + prefix-cache
@@ -278,14 +292,17 @@ class _Handler(BaseHTTPRequestHandler):
                 kwargs["deadline_ms"] = float(deadline_ms)
             outs = engine.infer(inputs, **kwargs)
         except EngineClosed as e:
-            self._send_json(503, {"error": str(e), "reason": e.reason})
+            self._send_json(503, {"error": str(e), "reason": e.reason},
+                            retry_after=getattr(e, "retry_after", None))
             return
         except RequestRejected as e:
-            self._send_json(429, {"error": str(e), "reason": e.reason})
+            self._send_json(429, {"error": str(e), "reason": e.reason},
+                            retry_after=getattr(e, "retry_after", None))
             return
         except DeadlineExceeded as e:
             self._send_json(504, {"error": str(e),
-                                  "reason": "deadline"})
+                                  "reason": getattr(e, "reason",
+                                                    "deadline")})
             return
         except ValueError as e:
             self._send_json(400, {"error": str(e)})
@@ -373,6 +390,17 @@ class _Handler(BaseHTTPRequestHandler):
             if self.headers.get("X-Deadline-Ms") is not None:
                 kw["deadline_ms"] = float(self.headers["X-Deadline-Ms"])
             kw["do_sample"] = bool(payload.get("do_sample", False))
+            # multi-tenant SLO identity: headers win over payload keys
+            # (a gateway stamping X-Tenant must not be overridable by
+            # the request body)
+            tenant = self.headers.get("X-Tenant") \
+                or payload.get("tenant")
+            if tenant is not None:
+                kw["tenant"] = str(tenant)
+            prio = self.headers.get("X-Priority") \
+                or payload.get("priority")
+            if prio is not None:
+                kw["priority"] = str(prio)
             stream = bool(payload.get("stream", False))
         except Exception as e:
             self._send_json(400, {"error": f"malformed payload: {e}"})
@@ -380,10 +408,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             handle = gen.submit(prompt, trace_ctx=self._ctx, **kw)
         except EngineClosed as e:
-            self._send_json(503, {"error": str(e), "reason": e.reason})
+            self._send_json(503, {"error": str(e), "reason": e.reason},
+                            retry_after=getattr(e, "retry_after", None))
             return
         except RequestRejected as e:
-            self._send_json(429, {"error": str(e), "reason": e.reason})
+            self._send_json(429, {"error": str(e), "reason": e.reason},
+                            retry_after=getattr(e, "retry_after", None))
             return
         except ValueError as e:
             self._send_json(400, {"error": str(e)})
@@ -396,7 +426,8 @@ class _Handler(BaseHTTPRequestHandler):
                 toks = handle.result()
             except DeadlineExceeded as e:
                 self._send_json(504, {"error": str(e),
-                                      "reason": "deadline"})
+                                      "reason": getattr(e, "reason",
+                                                        "deadline")})
                 return
             except Exception as e:
                 self._send_json(500,

@@ -77,7 +77,7 @@ __all__ = ["active", "ChaosError", "SITES", "parse_spec", "configure",
 SITES = ("ckpt.write", "store.rpc", "store.partition", "fs.rename",
          "loader.worker", "step.loss", "host.slow", "serve.request",
          "kv.block_alloc", "router.dispatch", "fleet.lease",
-         "ps.pull", "ps.push", "ps.shard_down")
+         "ps.pull", "ps.push", "ps.shard_down", "serve.preempt")
 
 # module-level fast predicate — the single read hot paths gate on
 active = False

@@ -142,4 +142,12 @@ python tools/mem_gate.py
 # input-gradient bit-exact through the Executor while the MEASURED
 # replay peak strictly drops.
 python tools/memplan_gate.py
+# Multi-tenant SLO gate (ISSUE 18 admission/preemption layer): with a
+# 3-block pool saturated by batch-priority streams, every interactive
+# burst must preempt the batch victim to pinned host memory and hand
+# the pool back — exact serve.preempt/serve.resume flight counts, the
+# preempted streams (greedy AND sampled) resuming bit-identical to
+# their unpreempted references, interactive p99 bounded, zero lost
+# requests, and the pool drained to all-free after close.
+python tools/slo_gate.py
 exec python -m pytest tests/ -q --runslow "$@"
