@@ -990,6 +990,10 @@ def bench_decode(on_tpu: bool):
     except Exception as e:  # noqa: BLE001 — additive leg, stay loud
         print(f"bench: paged decode leg failed: {e!r}",
               file=sys.stderr)
+    try:
+        result["disagg"] = bench_disagg(net, cfg, on_tpu)
+    except Exception as e:  # noqa: BLE001 — additive leg, stay loud
+        print(f"bench: disagg leg failed: {e!r}", file=sys.stderr)
     return result
 
 
@@ -1135,6 +1139,123 @@ def bench_paged_decode(net, cfg, on_tpu: bool):
         "mem_bytes_by_tag": mem_tags,
         "mem_breakdown": mem_breakdown,
         "goodput_frac": round(max(0.0, 1.0 - compile_s / dt), 4),
+    }
+
+
+def bench_disagg(net, cfg, on_tpu: bool):
+    """Disaggregated prefill/decode leg (PR 19): the same shared-head
+    workload served two ways — a prefill PagedGenerationEngine that
+    exports each prompt's KV chain over the ``kv_wire`` blob format
+    into a decode engine (the 2-chip disaggregated split), vs one
+    monolithic engine (1 chip).  Reports TTFT p50/p99 and tokens/s
+    per chip side by side, plus the wire cost the split pays for its
+    role specialization: bytes per transferred chain and the share of
+    wall-clock spent in transfer+adopt."""
+    from paddle_tpu import serving
+
+    block_size = 16
+    if on_tpu:
+        max_new, n_req = 48, 15
+        tail_lo, tail_hi = 4, 17
+    else:
+        max_new, n_req = 16, 9
+        tail_lo, tail_hi = 4, 9
+    max_len = int(net.cfg.max_seq_len)
+    num_blocks = 4 * (max_len // block_size)
+
+    def mk(name):
+        return serving.PagedGenerationEngine(
+            net, serving.GenerationEngineConfig(
+                max_slots=4, max_new_tokens=max_new,
+                block_size=block_size, num_blocks=num_blocks,
+                prefix_cache_blocks=max(2, num_blocks // 2),
+                warmup="off", name=name))
+    pre, dec, mono = mk("dgpre"), mk("dgdec"), mk("dgmono")
+    # 3 distinct 2-block shared heads so the decode engine pulls 3
+    # cold chains over the wire; tails vary per request
+    heads = [(np.arange(2 * block_size, dtype=np.int32) + 1 + 7 * h)
+             % (cfg.vocab_size - 1) + 1 for h in range(3)]
+    rng = np.random.RandomState(97)
+    prompts = [np.concatenate([heads[i % 3], rng.randint(
+        1, cfg.vocab_size,
+        (int(rng.randint(tail_lo, tail_hi)),)).astype(np.int32)])
+        for i in range(n_req)]
+    kws = [dict(do_sample=True, temperature=0.8, top_p=0.95,
+                seed=500 + i) for i in range(n_req)]
+    for e in (pre, dec, mono):
+        # compiles land outside the clock; drop the warmup chain so
+        # the decode side starts cold and actually pulls over the wire
+        e.generate(prompts[0], max_new_tokens=2, timeout=600)
+        e.prefix_cache.clear()
+
+    transfer = {"bytes": 0, "chains": 0, "s": 0.0}
+
+    def disagg_flow(p):
+        # what DisaggClient.ensure_chain does, minus the HTTP hop:
+        # probe locally, prefill remotely, ship the chain, adopt it
+        chain, covered = dec.prefix_cache.lookup(p)
+        if chain:
+            dec.pool.decref(chain)
+        if len(p) - covered <= block_size:
+            return
+        blob = pre.export_prefix_chain(p)
+        if blob is None:
+            pre.generate(p, max_new_tokens=1, do_sample=False,
+                         timeout=600)
+            blob = pre.export_prefix_chain(p)
+        t = time.perf_counter()
+        dec.import_prefix_chain(blob)
+        transfer["s"] += time.perf_counter() - t
+        transfer["bytes"] += len(blob)
+        transfer["chains"] += 1
+
+    def run(engine, flow):
+        ttfts, n = [], 0
+        t0 = time.perf_counter()
+        for p, kw in zip(prompts, kws):
+            r0 = time.perf_counter()
+            flow(p)
+            first = None
+            toks = 0
+            for _tok in engine.submit(p, max_new_tokens=max_new,
+                                      **kw):
+                if first is None:
+                    first = time.perf_counter() - r0
+                toks += 1
+            ttfts.append(first)
+            n += toks
+        return time.perf_counter() - t0, ttfts, n
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    try:
+        d_dt, d_ttft, d_n = run(dec, disagg_flow)
+        m_dt, m_ttft, m_n = run(mono, lambda p: None)
+    finally:
+        for e in (pre, dec, mono):
+            e.close()
+
+    def side(dt, ttft, n, chips):
+        return {
+            "chips": chips,
+            "ttft_p50_ms": round(pct(ttft, 0.50) * 1e3, 3),
+            "ttft_p99_ms": round(pct(ttft, 0.99) * 1e3, 3),
+            "tokens_per_s": round(n / dt, 1),
+            "tokens_per_s_per_chip": round(n / dt / chips, 1),
+            "tokens_generated": n,
+        }
+    return {
+        "requests": n_req,
+        "block_size": block_size,
+        "disagg": dict(side(d_dt, d_ttft, d_n, 2), **{
+            "chains_transferred": transfer["chains"],
+            "transfer_bytes_per_chain": transfer["bytes"]
+            // max(1, transfer["chains"]),
+            "transfer_time_share": round(transfer["s"] / d_dt, 4),
+        }),
+        "monolithic": side(m_dt, m_ttft, m_n, 1),
     }
 
 

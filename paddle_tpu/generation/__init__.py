@@ -35,6 +35,8 @@ batching serving path is ``serving.GenerationEngine``.
 from .kv_cache import (KVCache, attention_mask, init_caches,
                        init_layer_cache, kv_view, legacy_view, write,
                        write_kv)
+from .kv_wire import (KVTransferCorrupt, chain_digests,
+                      deserialize_chain, serialize_chain)
 from .paged_kv import (BlockPool, BlockPoolExhausted, KVArena,
                        KVArenaQ, PagedGenerationSession, PagedKV,
                        blocks_for_tokens, init_arenas, paged_view,
@@ -52,4 +54,6 @@ __all__ = ["KVCache", "GenerationSession", "init_caches",
            "BlockPoolExhausted", "PagedGenerationSession",
            "init_arenas", "write_paged", "paged_view",
            "blocks_for_tokens", "PrefixCache", "propose_drafts",
-           "accept_span", "draft_row", "fill_verify_row"]
+           "accept_span", "draft_row", "fill_verify_row",
+           "KVTransferCorrupt", "serialize_chain", "deserialize_chain",
+           "chain_digests"]

@@ -424,6 +424,26 @@ class PagedGenerationSession(GenerationSession):
             self._abpb_probe = bpb * cfg.num_layers
         return self._abpb_probe
 
+    def block_spec(self, arenas=None) -> List[List[Tuple[str, Tuple]]]:
+        """Per-layer per-field ``(dtype, per-block shape)`` of this
+        session's arenas — the geometry contract a ``kv_wire`` chain
+        blob must match before its bytes may enter the pool.  Derives
+        from live ``arenas`` when given (covers models with a custom
+        ``gen_arenas`` hook); otherwise from the model config."""
+        if arenas is not None:
+            return [[(str(f.dtype), tuple(int(d) for d in f.shape[1:]))
+                     for f in layer] for layer in arenas]
+        cfg = self.model.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        kv = (self.block_size, cfg.num_heads, hd)
+        if self.quantized:
+            sc = (self.block_size, cfg.num_heads)
+            layer = [("int8", kv), ("int8", kv),
+                     ("float32", sc), ("float32", sc)]
+        else:
+            layer = [("float32", kv), ("float32", kv)]
+        return [list(layer) for _ in range(cfg.num_layers)]
+
     def identity_table(self, rows: Optional[int] = None) -> np.ndarray:
         """Block table mapping row i to its own contiguous run of
         blocks — the standalone :meth:`generate` layout (needs

@@ -235,6 +235,21 @@ class PrefixCache:
             self.pool.decref([victim.block])
             self._m_evict.inc()
 
+    def hot_heads(self, k: int, hexlen: int = 16) -> List[str]:
+        """The ``k`` most-recently-used entry keys as ``hexlen``-char
+        hex digests, MRU first — the bounded advertisement a fleet
+        replica publishes in its registry heartbeat so the router can
+        score dispatch by prefix locality.  Truncation is safe: a
+        collision only misroutes one dispatch, correctness never
+        depends on the hint (``kv_wire.chain_digests`` produces the
+        matching digests on the router side)."""
+        k = int(k)
+        if k <= 0:
+            return []
+        with self._lock:
+            keys = list(self._entries.keys())[-k:]
+        return [key.hex()[:hexlen] for key in reversed(keys)]
+
     def clear(self):
         """Release every cached block (engine close / tests)."""
         with self._lock:

@@ -56,6 +56,7 @@ from .admission import (PRIORITIES, AdmissionController,
                         TenantQuotaTable, priority_rank)
 from .bucketing import BucketPolicy, ExecutableCache, next_bucket, \
     pad_batch, seq_buckets
+from .disagg import DisaggClient
 from .engine import (EngineConfig, GenerationEngine,
                      GenerationEngineConfig, GenerationStream,
                      InferenceEngine, PagedGenerationEngine,
@@ -73,4 +74,4 @@ __all__ = ["InferenceEngine", "EngineConfig", "ServingServer", "serve",
            "seq_buckets", "validate_artifact", "FleetReplica",
            "FleetRouter", "ReplicaRegistry", "WeightWatcher",
            "PRIORITIES", "priority_rank", "TenantQuotaTable",
-           "DrainRateEstimator", "QuotaWatcher"]
+           "DrainRateEstimator", "QuotaWatcher", "DisaggClient"]

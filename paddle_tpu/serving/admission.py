@@ -454,6 +454,17 @@ class AdmissionController:
         raise RequestRejected(msg, reason=reason,
                               retry_after=retry_after)
 
+    def reject(self, reason: str, msg: str,
+               tenant: Optional[str] = None,
+               priority: Optional[str] = None):
+        """Shed a request through the standard typed-rejection path
+        (counted ``request.rejected`` + ``request.rejected.<reason>``,
+        flight-noted, Retry-After attached) for policy reasons the
+        controller cannot see itself — e.g. a disaggregated
+        prefill-role replica refusing a full-decode request
+        (``reason="wrong_role"``).  Always raises."""
+        self._reject(reason, msg, tenant=tenant, priority=priority)
+
     def acquire(self, rows: int = 1, tokens: int = 0,
                 tenant: Optional[str] = None,
                 priority: Optional[str] = None,

@@ -1,0 +1,161 @@
+"""Disaggregated prefill/decode serving — the transfer client.
+
+Prefill is compute-bound (one big chunked-attention pass over the
+prompt), decode is memory-bound (one token per step against a growing
+KV cache); a monolithic replica sizes both against the same chip.
+Disaggregation splits the fleet by role (``FleetReplica(role=...)``):
+
+- ``prefill`` replicas run chunked prefill and ship the filled KV
+  block chain to peers (``/admin/kv/prefill``).  The router never
+  sends them client traffic, and their frontend sheds full-decode
+  requests typed (``reason="wrong_role"``).
+- ``decode`` replicas take the client traffic.  Before each local
+  prefill, :class:`DisaggClient` pulls the prompt's chain from the
+  least-loaded prefill peer and adopts it through
+  ``PagedGenerationEngine.import_prefix_chain`` — after which the
+  normal prefix-cache admission path sees a hit and prefills only the
+  uncovered suffix.
+- ``both`` (default) is the monolith, bit-for-bit the pre-disagg
+  behavior.
+
+Every transfer is best-effort and fail-closed: the blob is
+sha256-verified on receive (``generation/kv_wire.py``), and ANY
+failure — connection loss (the ``kv.transfer`` chaos site injects
+exactly this), a corrupt shipment, pool exhaustion on the receiver —
+counts ``kv.transfer.fail`` and falls back to a local re-prefill.  A
+transfer can cost latency; it can never lose a request or decode over
+wrong KV.  Decode output is bit-exact vs the monolith because the
+adopted chain is bit-identical KV and sampling keys are per-absolute-
+position (``fold_in(key, position)``) — where the prefill ran is
+invisible to the stream.
+
+Metrics: ``kv.transfer.fetch`` / ``.bytes`` / ``.ms`` (pull path),
+``kv.transfer.fail`` (any fallback), ``kv.transfer.corrupt``
+(verification rejections, counted in ``kv_wire``).
+"""
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..profiler import flight as _flight
+from ..utils import chaos as _chaos
+from .fleet import list_replicas
+
+__all__ = ["DisaggClient"]
+
+
+class DisaggClient:
+    """Pulls prefilled KV chains from prefill-role peers into a local
+    :class:`~.engine.PagedGenerationEngine` (the decode side of the
+    disaggregated fleet)."""
+
+    def __init__(self, store, job: str, engine, *,
+                 replica_id: Optional[str] = None,
+                 timeout: float = 30.0):
+        self.store = store
+        self.job = str(job)
+        self.engine = engine
+        self.replica_id = replica_id
+        self.timeout = float(timeout)
+        from ..profiler import metrics as _metrics
+        self._m_fetch = _metrics.counter(
+            "kv.transfer.fetch", "KV chains pulled from a prefill "
+            "peer and adopted into the local pool")
+        self._m_bytes = _metrics.counter(
+            "kv.transfer.bytes", "wire bytes of adopted KV chain "
+            "blobs (header + verified payload)")
+        self._m_fail = _metrics.counter(
+            "kv.transfer.fail", "KV chain pulls abandoned (peer "
+            "unreachable, chaos, corrupt blob, pool exhaustion) — "
+            "each one a clean local re-prefill, zero lost requests")
+        self._h_ms = _metrics.histogram(
+            "kv.transfer.ms", "end-to-end chain pull latency "
+            "(HTTP round trip + verify + adopt), ms")
+
+    # -- peer choice ---------------------------------------------------
+    def _pick_peer(self):
+        """Least-loaded ready prefill-capable peer, or None."""
+        try:
+            infos = list_replicas(self.store, self.job)
+        except Exception:   # noqa: BLE001 — registry outage = no peer
+            return None
+        cands = [i for i in infos.values()
+                 if i.role in ("prefill", "both") and i.ready
+                 and i.endpoint and i.replica_id != self.replica_id]
+        if not cands:
+            return None
+        return min(cands, key=lambda i: (i.load(), i.replica_id))
+
+    # -- the pull ------------------------------------------------------
+    def ensure_chain(self, prompt_ids) -> bool:
+        """Make the prompt's prefix chain locally cached, pulling it
+        from a prefill peer when worthwhile.  Returns True when a
+        chain was adopted; False means the caller's own prefill does
+        the work (already cached, no peer, or any transfer failure —
+        never raises)."""
+        toks = np.ascontiguousarray(prompt_ids,
+                                    dtype=np.int32).reshape(-1)
+        plen = int(toks.size)
+        eng = self.engine
+        bs = eng.session.block_size
+        if plen < 1:
+            return False
+        # local probe: skip the wire when the cache already covers the
+        # prompt to within one block (the transfer would save nothing
+        # the suffix prefill doesn't redo anyway)
+        chain, covered = eng.prefix_cache.lookup(toks)
+        if chain:
+            eng.pool.decref(chain)
+        if plen - covered <= bs:
+            return False
+        peer = self._pick_peer()
+        if peer is None:
+            return False
+        t0 = time.monotonic()
+        try:
+            if _chaos.active:
+                _chaos.hit("kv.transfer", exc=ConnectionResetError)
+            blob = self._fetch(peer, toks)
+            covered = eng.import_prefix_chain(blob)
+        except Exception as e:  # noqa: BLE001 — fall back to local prefill
+            self._m_fail.inc()
+            if _flight.active:
+                _flight.note("kv", "transfer_fail", peer=peer.replica_id,
+                             error=f"{type(e).__name__}: {e}")
+            return False
+        ms = (time.monotonic() - t0) * 1e3
+        self._m_fetch.inc()
+        self._m_bytes.inc(len(blob))
+        self._h_ms.observe(ms)
+        if _flight.active:
+            _flight.note("kv", "transfer", peer=peer.replica_id,
+                         covered=int(covered), bytes=len(blob),
+                         ms=round(ms, 3))
+        return True
+
+    def _fetch(self, peer, toks: np.ndarray) -> bytes:
+        """One ``/admin/kv/prefill`` round trip; raises on anything
+        short of a verified 200 + blob."""
+        host, port = peer.endpoint.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port),
+                                          timeout=self.timeout)
+        try:
+            body = json.dumps({"prompt_ids": toks.tolist()}).encode()
+            conn.request("POST", "/admin/kv/prefill", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+        finally:
+            conn.close()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"peer {peer.replica_id} answered {resp.status}: "
+                f"{data[:200]!r}")
+        doc = json.loads(data.decode())
+        return base64.b64decode(doc["blob"])

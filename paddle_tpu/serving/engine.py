@@ -1185,6 +1185,11 @@ class GenerationEngine:
         # requests preempted out of their decode slots to host memory
         # (paged engine only; the base engine never parks anything)
         self._parked: List[_GenRequest] = []
+        # KV chains shipped in from a prefill replica, queued for the
+        # scheduler thread to swap into the arenas at a token boundary
+        # (paged engine only — the arenas are loop-thread state, so an
+        # HTTP thread may never write them directly)
+        self._imports: List[dict] = []
         self._aging_s = float(cfg.aging_s)
         self._cond = _conc.Condition(name=f"{cfg.name}"
                                      ".genengine.cond")
@@ -1594,7 +1599,7 @@ class GenerationEngine:
     def _loop(self):
         while True:
             with self._cond:
-                while self._swap is None and \
+                while self._swap is None and not self._imports and \
                         ((not self._stop and not self._pending
                           and not self._parked
                           and not self._occupied()) or
@@ -2060,6 +2065,107 @@ class PagedGenerationEngine(GenerationEngine):
             self.pool.decref(req.blocks)
             req.blocks = []
 
+    # -- disaggregated KV transfer (serving/disagg.py drives these) ----
+    def export_prefix_chain(self, tokens) -> Optional[bytes]:
+        """Serialize this engine's longest cached prefix chain for
+        ``tokens`` into a ``kv_wire`` blob (``None`` on cache miss) —
+        the prefill side of disaggregated serving.
+
+        Thread-safe from any thread: ``lookup`` transfers pool
+        references that pin the chain for the duration, the cached
+        blocks are immutable by the copy-on-write discipline (a writer
+        always copies a shared block first), and the arena gather is
+        pure — a concurrent decode round can replace ``self._arenas``
+        without invalidating the snapshot this reads."""
+        from ..generation import kv_wire
+        toks = np.ascontiguousarray(tokens, dtype=np.int32).reshape(-1)
+        chain, covered = self.prefix_cache.lookup(toks)
+        if not chain:
+            return None
+        try:
+            payload = self.session.swap_out_blocks(self._arenas, chain)
+            return kv_wire.serialize_chain(
+                toks[:covered], covered, self.session.block_size,
+                payload)
+        finally:
+            self.pool.decref(chain)
+
+    def import_prefix_chain(self, blob: bytes,
+                            timeout: Optional[float] = 300.0) -> int:
+        """Verify a ``kv_wire`` blob, allocate blocks for it, and hand
+        it to the scheduler thread to swap into the arenas and insert
+        into the prefix cache at the next token boundary — the decode
+        side of disaggregated serving.  Returns the covered token
+        count; subsequent submits of a prompt sharing the prefix hit
+        the cache exactly as if this engine had prefilled it.
+
+        Raises :class:`~..generation.kv_wire.KVTransferCorrupt`
+        (counted, zero unverified bytes adopted) on a bad blob,
+        ``BlockPoolExhausted`` when the pool cannot hold the chain,
+        and :class:`EngineClosed` on a closed/stopping engine —
+        in every case the caller simply decodes without the shipment
+        (a local re-prefill), never over suspect KV."""
+        from ..generation import blocks_for_tokens, kv_wire
+        doc = kv_wire.deserialize_chain(
+            blob, expect_block_size=self.session.block_size,
+            expect_spec=self.session.block_spec(self._arenas))
+        blocks = self.pool.alloc(blocks_for_tokens(
+            doc["covered"], self.session.block_size))
+        imp = {"tokens": doc["tokens"], "covered": doc["covered"],
+               "blocks": blocks, "payload": doc["payload"],
+               "done": threading.Event(), "error": None}
+        with self._cond:
+            if self._closed or self._stop:
+                self.pool.decref(blocks)
+                raise EngineClosed("generation engine is closed")
+            self._imports.append(imp)
+            self._cond.notify_all()
+        if not imp["done"].wait(timeout):
+            # leave the entry queued: the scheduler still owns applying
+            # it and the decref that balances the alloc above
+            raise TimeoutError("KV chain import timed out")
+        if imp["error"] is not None:
+            raise imp["error"]
+        return imp["covered"]
+
+    def _apply_imports(self):
+        """Adopt queued shipped-in chains (scheduler thread, token
+        boundary): ``device_put`` each payload into its pre-allocated
+        blocks, then offer the chain to the prefix cache (which takes
+        its own references).  The import's alloc-time hold is released
+        either way, so retained blocks end cache-owned at refcount 1
+        and already-cached duplicates free immediately.  A failing
+        import faults only its caller, never the engine."""
+        while True:
+            with self._cond:
+                if not self._imports:
+                    return
+                imp = self._imports.pop(0)
+            try:
+                self._arenas = self.session.swap_in_blocks(
+                    self._arenas, imp["blocks"], imp["payload"])
+                self.prefix_cache.insert(imp["tokens"], imp["blocks"])
+                if _flight.active:
+                    _flight.note("kv", "chain_import",
+                                 engine=self.metrics_prefix,
+                                 covered=int(imp["covered"]),
+                                 blocks=len(imp["blocks"]))
+            except BaseException as e:  # noqa: BLE001 — fault the importer only
+                imp["error"] = e
+            finally:
+                self.pool.decref(imp["blocks"])
+                imp["done"].set()
+
+    def _drain_imports(self, exc: BaseException):
+        """Fail every queued import (engine close / loop death):
+        release the alloc-time holds and wake the waiting callers."""
+        with self._cond:
+            imps, self._imports = list(self._imports), []
+        for imp in imps:
+            self.pool.decref(imp["blocks"])
+            imp["error"] = exc
+            imp["done"].set()
+
     def _prepare_slot(self, slot: int, req: _GenRequest):
         """Prefix-cache lookup + block allocation + copy-on-write for
         one admitted request; fills the slot's table row.  Returns the
@@ -2378,22 +2484,27 @@ class PagedGenerationEngine(GenerationEngine):
 
     def _fail_all(self, exc: BaseException):
         super()._fail_all(exc)
+        self._drain_imports(exc)
         self._table[:, :] = -1
         with self._mlock:
             self._g_parked.set(0)
 
     def close(self, timeout: Optional[float] = 60.0):
         super().close(timeout=timeout)
-        # drop the cache's holds so the pool drains to all-free once
-        # every live request is done (the leak canary in the tests)
+        # imports stranded by the loop's exit fail typed (their alloc
+        # holds release here), THEN the cache lets go — so the pool
+        # drains to all-free (the leak canary in the tests)
+        self._drain_imports(EngineClosed("generation engine is closed"))
         self.prefix_cache.clear()
 
     # -- scheduler overrides ------------------------------------------
     def _admit(self):
-        """Token-boundary admission, paged edition: deadline-sweep the
-        parked set, admit queued requests (preempting lower-priority
-        slots under pool pressure), then resume parked streams into
-        whatever slots and blocks remain."""
+        """Token-boundary admission, paged edition: adopt shipped-in
+        KV chains, deadline-sweep the parked set, admit queued
+        requests (preempting lower-priority slots under pool
+        pressure), then resume parked streams into whatever slots and
+        blocks remain."""
+        self._apply_imports()
         self._sweep_parked()
         self._admit_pending()
         self._try_resume()

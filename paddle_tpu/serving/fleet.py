@@ -114,14 +114,16 @@ class ReplicaInfo:
 
     __slots__ = ("replica_id", "generation", "endpoint", "ready",
                  "queue_depth", "occupancy", "slots", "weights_step",
-                 "available_step", "t")
+                 "available_step", "role", "prefix_heads",
+                 "block_size", "t")
 
     def __init__(self, replica_id: str, generation: int = 0,
                  endpoint: str = "", ready: bool = False,
                  queue_depth: int = 0, occupancy: int = 0,
                  slots: int = 0, weights_step: Optional[int] = None,
                  available_step: Optional[int] = None,
-                 t: float = 0.0):
+                 role: str = "both", prefix_heads: Tuple[str, ...] = (),
+                 block_size: int = 0, t: float = 0.0):
         self.replica_id = replica_id
         self.generation = int(generation)
         self.endpoint = endpoint
@@ -131,17 +133,29 @@ class ReplicaInfo:
         self.slots = int(slots)
         self.weights_step = weights_step
         self.available_step = available_step
+        self.role = str(role) if role else "both"
+        self.prefix_heads = tuple(prefix_heads)
+        self.block_size = int(block_size)
         self.t = float(t)
 
     @classmethod
     def from_payload(cls, replica_id: str, generation: int,
                      payload: str) -> Optional["ReplicaInfo"]:
         """Tolerant parse: a malformed payload (version skew, torn
-        write) yields None instead of poisoning the whole listing."""
+        write) yields None instead of poisoning the whole listing.
+        Unknown fields are ignored and missing ones default (a
+        pre-disagg replica parses as ``role="both"`` with no
+        ``prefix_heads``), so version-skewed fleets stay routable
+        through a rollout."""
         try:
             d = json.loads(payload)
             if not isinstance(d, dict):
                 return None
+            heads = d.get("prefix_heads")
+            if isinstance(heads, (list, tuple)):
+                heads = tuple(str(h) for h in heads)
+            else:
+                heads = ()      # junk-typed advertisement: no hints
             return cls(replica_id, generation,
                        endpoint=str(d.get("endpoint", "")),
                        ready=bool(d.get("ready", False)),
@@ -150,6 +164,9 @@ class ReplicaInfo:
                        slots=int(d.get("slots", 0) or 0),
                        weights_step=d.get("weights_step"),
                        available_step=d.get("available_step"),
+                       role=str(d.get("role", "both") or "both"),
+                       prefix_heads=heads,
+                       block_size=int(d.get("block_size", 0) or 0),
                        t=float(d.get("t", 0.0) or 0.0))
         except (ValueError, TypeError):
             # casts included: one version-skewed replica publishing a
@@ -213,7 +230,8 @@ class ReplicaRegistry:
                  replica_id: Optional[str] = None,
                  status_fn: Optional[Callable[[], dict]] = None, *,
                  generation: Optional[int] = None, ttl: float = 6.0,
-                 interval: float = 1.5):
+                 interval: float = 1.5,
+                 payload_warn_bytes: int = 4096):
         self.store = _as_store(store)
         self.job = str(job)
         self.replica_id = replica_id or f"r{os.getpid()}"
@@ -223,6 +241,8 @@ class ReplicaRegistry:
         self.generation = int(generation)
         self.ttl = float(ttl)
         self.interval = float(interval)
+        self.payload_warn_bytes = int(payload_warn_bytes)
+        self._payload_warned = False
         self._status_fn = status_fn or (lambda: {})
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -234,6 +254,11 @@ class ReplicaRegistry:
             "fleet.lease.fail",
             "lease heartbeats lost to store outages or chaos "
             "(serving continues; the TTL may lapse)")
+        self._g_payload = _metrics.gauge(
+            "fleet.registry.payload_bytes",
+            "size of the last serialized lease payload (bounded: "
+            "prefix_heads is capped and hash-truncated; a runaway "
+            "status_fn warns once past payload_warn_bytes)")
 
     @property
     def key(self) -> str:
@@ -245,9 +270,19 @@ class ReplicaRegistry:
         swallow-and-count policy, direct callers see the truth."""
         payload = dict(self._status_fn())
         payload.setdefault("t", time.time())
+        data = json.dumps(payload)
+        self._g_payload.set(len(data))
+        if len(data) > self.payload_warn_bytes \
+                and not self._payload_warned:
+            self._payload_warned = True
+            warnings.warn(
+                f"replica registry payload is {len(data)} bytes "
+                f"(> {self.payload_warn_bytes}); every router rereads "
+                "every lease each refresh — trim the status payload",
+                RuntimeWarning)
         if _chaos.active:
             _chaos.hit("fleet.lease", exc=ConnectionResetError)
-        self.store.put(self.key, json.dumps(payload), ttl=self.ttl)
+        self.store.put(self.key, data, ttl=self.ttl)
 
     def _beat(self):
         while not self._stop.wait(self.interval):
@@ -485,6 +520,7 @@ class FleetReplica:
                  load_on_start: bool = True, lease_ttl: float = 6.0,
                  heartbeat_interval: float = 1.5,
                  generation: Optional[int] = None,
+                 role: str = "both", prefix_heads_k: int = 8,
                  verbose: bool = False):
         from .engine import GenerationEngine
         from .server import ServingServer
@@ -493,6 +529,18 @@ class FleetReplica:
             engine, generation_engine = None, engine
         if engine is None and generation_engine is None:
             raise ValueError("bind at least one engine")
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"role must be 'prefill', 'decode' or "
+                             f"'both', got {role!r}")
+        if role != "both" and (
+                generation_engine is None
+                or not hasattr(generation_engine,
+                               "export_prefix_chain")):
+            raise ValueError(
+                f"role={role!r} needs a PagedGenerationEngine (the KV "
+                "transfer layer lives on the paged block pool)")
+        self.role = role
+        self.prefix_heads_k = int(prefix_heads_k)
         self.engine = engine
         self.generation_engine = generation_engine
         self.store = _as_store(store)
@@ -513,9 +561,21 @@ class FleetReplica:
             generation=generation, ttl=lease_ttl,
             interval=heartbeat_interval)
         self.replica_id = self.registry.replica_id
+        # decode-role replicas pull hot prefix chains from a prefill
+        # peer before each local prefill (best-effort: any transfer
+        # failure simply re-prefills locally)
+        self._disagg = None
+        if role == "decode":
+            from .disagg import DisaggClient
+            self._disagg = DisaggClient(
+                self.store, job, generation_engine,
+                replica_id=self.replica_id)
         self.server = ServingServer(
             engine, generation_engine=generation_engine, host=host,
             port=port, registry=self.registry, fleet_admin=self,
+            role=role,
+            kv_prefetch=(self._disagg.ensure_chain
+                         if self._disagg is not None else None),
             verbose=verbose)
         self.endpoint = f"{self.server.host}:{self.server.port}"
         self._started = False
@@ -533,6 +593,7 @@ class FleetReplica:
         d = {
             "endpoint": self.endpoint,
             "ready": self.ready,
+            "role": self.role,
             "queue_depth": sum(e._admission.depth
                                for e in self._engines()),
             "occupancy": sum(getattr(e, "occupancy", 0)
@@ -541,6 +602,12 @@ class FleetReplica:
                       if self.generation_engine is not None else
                       self.engine.config.max_batch_size),
         }
+        pc = getattr(self.generation_engine, "prefix_cache", None)
+        if pc is not None and self.prefix_heads_k > 0:
+            # bounded advertisement: K MRU chain heads, 16-hex each —
+            # the router's prefix-locality dispatch signal
+            d["prefix_heads"] = pc.hot_heads(self.prefix_heads_k)
+            d["block_size"] = self.generation_engine.pool.block_size
         if self.watcher is not None:
             d["weights_step"] = self.watcher.current_step
             d["available_step"] = self.watcher.available_step
@@ -593,7 +660,89 @@ class FleetReplica:
                          "replica_id": self.replica_id}
         if path == "/admin/info":
             return 200, self._status()
+        if path == "/admin/kv/prefill":
+            return self._admin_kv_prefill(payload)
+        if path == "/admin/kv/import":
+            return self._admin_kv_import(payload)
         return 404, {"error": f"no admin route {path}"}
+
+    def _admin_kv_prefill(self, payload: dict) -> Tuple[int, dict]:
+        """Pull side of the KV transfer: prefill (or reuse the cached
+        chain for) ``prompt_ids`` and return the serialized chain blob
+        plus the first sampled token.  Decode-role replicas refuse —
+        their pool is decode inventory, not prefill scratch."""
+        import base64
+        from .admission import EngineClosed, RequestRejected
+        if self.role == "decode":
+            return 409, {"error": "decode-role replica does not "
+                         "prefill for peers", "reason": "wrong_role"}
+        eng = self.generation_engine
+        if eng is None or not hasattr(eng, "export_prefix_chain"):
+            return 409, {"error": "no paged generation engine bound",
+                         "reason": "no_paged_engine"}
+        prompt = payload.get("prompt_ids")
+        if not isinstance(prompt, (list, tuple)) or not prompt:
+            return 400, {"error": "missing 'prompt_ids'"}
+        first = None
+        try:
+            blob = eng.export_prefix_chain(prompt)
+            if blob is None:
+                # cold chain: one 1-token generate runs the chunked
+                # prefill and inserts the chain into the prefix cache
+                out = eng.generate(
+                    prompt, max_new_tokens=1,
+                    timeout=float(payload.get("timeout", 120.0)))
+                first = int(out[0]) if len(out) else None
+                blob = eng.export_prefix_chain(prompt)
+        except EngineClosed as e:
+            return 503, {"error": str(e), "reason": "closed"}
+        except RequestRejected as e:
+            return 429, {"error": str(e),
+                         "reason": getattr(e, "reason", "rejected")}
+        if blob is None:
+            # prefix cache disabled or the chain was evicted under cap
+            # pressure between prefill and export: nothing to ship
+            return 409, {"error": "no cached chain to export "
+                         "(prefix cache disabled or evicted)",
+                         "reason": "no_chain"}
+        out = {"ok": True, "bytes": len(blob),
+               "blob": base64.b64encode(blob).decode("ascii"),
+               "replica_id": self.replica_id}
+        if first is not None:
+            out["first_token"] = first
+        return 200, out
+
+    def _admin_kv_import(self, payload: dict) -> Tuple[int, dict]:
+        """Push side of the KV transfer: verify a shipped chain blob
+        and adopt it into this replica's block pool + prefix cache."""
+        import base64
+        import binascii
+        from ..generation import BlockPoolExhausted, KVTransferCorrupt
+        from .admission import EngineClosed
+        eng = self.generation_engine
+        if self.role == "prefill":
+            return 409, {"error": "prefill-role replica does not "
+                         "adopt chains", "reason": "wrong_role"}
+        if eng is None or not hasattr(eng, "import_prefix_chain"):
+            return 409, {"error": "no paged generation engine bound",
+                         "reason": "no_paged_engine"}
+        b64 = payload.get("blob")
+        if not isinstance(b64, str) or not b64:
+            return 400, {"error": "missing 'blob'"}
+        try:
+            blob = base64.b64decode(b64, validate=True)
+        except (binascii.Error, ValueError):
+            return 400, {"error": "blob is not valid base64"}
+        try:
+            covered = eng.import_prefix_chain(blob)
+        except KVTransferCorrupt as e:
+            return 409, {"error": str(e), "reason": "corrupt"}
+        except BlockPoolExhausted as e:
+            return 429, {"error": str(e), "reason": "kv_blocks"}
+        except EngineClosed as e:
+            return 503, {"error": str(e), "reason": "closed"}
+        return 200, {"ok": True, "covered": covered,
+                     "replica_id": self.replica_id}
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "FleetReplica":
@@ -910,7 +1059,9 @@ class FleetRouter:
 
     def _dispatchable(self, exclude=()) -> List[ReplicaInfo]:
         """Ready, non-denylisted replicas, least-loaded first (router
-        in-flight + the replica's own published queue/occupancy)."""
+        in-flight + the replica's own published queue/occupancy).
+        Prefill-role replicas never take client traffic — decode-role
+        peers reach them through ``/admin/kv/prefill``."""
         with self._lock:
             infos = list(self._replicas.values())
             deny = set(self._deny)
@@ -921,12 +1072,39 @@ class FleetRouter:
                 continue
             if not i.ready or not i.endpoint:
                 continue
+            if i.role == "prefill":
+                continue
             out.append((mine.get(i.replica_id, 0) + i.load(),
                         i.replica_id, i))
         out.sort(key=lambda x: (x[0], x[1]))
         return [x[2] for x in out]
 
-    def _pick(self, tried: set) -> ReplicaInfo:
+    def _prefix_score(self, info: ReplicaInfo, prompt,
+                      dcache: dict) -> int:
+        """Longest prefix of ``prompt`` (in tokens) whose chain digest
+        the replica advertises in its heartbeat ``prefix_heads`` — the
+        dispatch signal that lands shared-prompt traffic where the KV
+        blocks already live.  Purely a hint: truncated-hash collisions
+        or stale advertisements cost one prefix-cache miss, never
+        correctness.  ``dcache`` memoizes the prompt's digest walk per
+        block size across the candidates of one dispatch."""
+        if prompt is None or not info.prefix_heads \
+                or info.block_size < 1:
+            return 0
+        digs = dcache.get(info.block_size)
+        if digs is None:
+            from ..generation.kv_wire import chain_digests
+            digs = chain_digests(prompt, info.block_size)
+            dcache[info.block_size] = digs
+        heads = set(info.prefix_heads)
+        best = 0
+        for n, d in digs:
+            if n > best and d in heads:
+                best = n
+        return best
+
+    def _pick(self, tried: set, prompt=None,
+              dcache: Optional[dict] = None) -> ReplicaInfo:
         cands = self._dispatchable(exclude=tried)
         if not cands:
             # everything tried already: allow another pass (backoff
@@ -938,6 +1116,24 @@ class FleetRouter:
                 f"no dispatchable replica for job {self.job!r} "
                 f"({len(self._replicas)} known, "
                 f"{len(self._deny)} denylisted)")
+        if prompt is not None and dcache is not None \
+                and len(cands) > 1:
+            # longest-published-prefix first; the least-loaded order
+            # of _dispatchable breaks ties (score 0 everywhere
+            # degrades to exactly the pre-disagg least-loaded pick)
+            best_i, best_s = 0, self._prefix_score(cands[0], prompt,
+                                                   dcache)
+            for i in range(1, len(cands)):
+                s = self._prefix_score(cands[i], prompt, dcache)
+                if s > best_s:
+                    best_i, best_s = i, s
+            if best_s > 0:
+                from ..profiler import metrics as _metrics
+                _metrics.counter(
+                    "fleet.router.prefix_routed",
+                    "dispatches steered by a published prefix-chain "
+                    "head match (vs pure least-loaded)").inc()
+                return cands[best_i]
         return cands[0]
 
     # -- canary / promote / rollback -----------------------------------
@@ -1173,6 +1369,7 @@ class FleetRouter:
                           "occupancy": i.occupancy,
                           "weights_step": i.weights_step,
                           "available_step": i.available_step,
+                          "replica_role": i.role,
                           "denylisted": rid in self._deny,
                           "inflight": self._inflight_by.get(rid, 0)}
                     for rid, i in self._replicas.items()}
@@ -1233,10 +1430,14 @@ class FleetRouter:
             return
         body = h.rfile.read(length)
         stream = False
+        prompt = None
         if h.path in ("/v1/generate", "/generate"):
             try:
-                stream = bool(json.loads(body.decode() or "{}")
-                              .get("stream", False))
+                doc = json.loads(body.decode() or "{}")
+                stream = bool(doc.get("stream", False))
+                p = doc.get("prompt_ids", doc.get("prompt"))
+                if isinstance(p, list) and p:
+                    prompt = p      # the prefix-routing signal
             except Exception:   # noqa: BLE001 — replica answers the 400
                 stream = False
         # router admission: shed with a TYPED 429 + Retry-After before
@@ -1259,17 +1460,19 @@ class FleetRouter:
                     self._inflight)))
             return
         try:
-            self._dispatch(h, h.path, body, stream)
+            self._dispatch(h, h.path, body, stream, prompt)
         finally:
             with self._lock:
                 self._inflight -= 1
                 self._g_inflight.set(self._inflight)
 
-    def _dispatch(self, h, path: str, body: bytes, stream: bool):
+    def _dispatch(self, h, path: str, body: bytes, stream: bool,
+                  prompt=None):
         headers = {k: h.headers[k] for k in self._FORWARD_HEADERS
                    if h.headers.get(k) is not None}
         headers["Content-Length"] = str(len(body))
         tried: set = set()
+        dcache: dict = {}   # prompt digest walk, shared per dispatch
         # SSE splice cursor: token events the client already has; a
         # failed-over stream re-issues the request (seed-deterministic)
         # and skips past them
@@ -1278,7 +1481,7 @@ class FleetRouter:
         last = {"rid": None}
 
         def attempt():
-            info = self._pick(tried)
+            info = self._pick(tried, prompt, dcache)
             rid = info.replica_id
             tried.add(rid)
             last["rid"] = rid

@@ -405,6 +405,32 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:
             self._send_json(400, {"error": f"malformed payload: {e}"})
             return
+        if getattr(self.server, "role", "both") == "prefill":
+            # a prefill-role pool never runs full decodes: shed typed
+            # through the standard admission path (counted
+            # request.rejected.wrong_role + Retry-After) — the router
+            # already filters prefill replicas out, so landing here
+            # means a stale client or a misconfigured fleet
+            try:
+                gen._admission.reject(
+                    "wrong_role",
+                    "replica runs role=prefill; decode requests "
+                    "belong on a decode or both replica",
+                    tenant=kw.get("tenant"), priority=kw.get("priority"))
+            except RequestRejected as e:
+                self._send_json(429, {"error": str(e),
+                                      "reason": e.reason},
+                                retry_after=getattr(e, "retry_after",
+                                                    None))
+                return
+        pf = getattr(self.server, "kv_prefetch", None)
+        if pf is not None:
+            # best-effort: a failed pull (counted kv.transfer.fail)
+            # just means the local prefill does the work
+            try:
+                pf(prompt)
+            except Exception:   # noqa: BLE001 — never blocks serving
+                pass
         try:
             handle = gen.submit(prompt, trace_ctx=self._ctx, **kw)
         except EngineClosed as e:
@@ -508,7 +534,8 @@ class ServingServer:
                  port: int = 0, verbose: bool = False,
                  max_body_bytes: int = 64 << 20,
                  generation_engine=None, registry=None,
-                 fleet_admin=None):
+                 fleet_admin=None, role: str = "both",
+                 kv_prefetch=None):
         from .engine import GenerationEngine
         if generation_engine is None and isinstance(engine,
                                                     GenerationEngine):
@@ -525,6 +552,12 @@ class ServingServer:
         self._httpd.max_body_bytes = int(max_body_bytes)
         self._httpd.daemon_threads = True
         self._httpd.fleet_admin = fleet_admin
+        # disaggregated serving (fleet.py wires these): a prefill-role
+        # server sheds full-decode traffic typed; a decode-role server
+        # runs kv_prefetch(prompt_ids) — best-effort chain pull from a
+        # prefill peer — before every submit
+        self._httpd.role = str(role)
+        self._httpd.kv_prefetch = kv_prefetch
         self._httpd._active_requests = 0
         self._httpd._drain_cond = threading.Condition()
         self.host, self.port = self._httpd.server_address[:2]

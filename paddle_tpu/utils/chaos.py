@@ -58,6 +58,11 @@ matching the PR-1 instrumentation discipline)::
                      shard sever every client and stop accepting — a
                      deterministic in-process SIGKILL; clients must
                      fail over to the replica)
+    kv.transfer      serving disagg KV-chain fetch (``fail`` kills one
+                     prefill-replica pull as a connection reset — the
+                     decode replica must count ``kv.transfer.fail`` and
+                     re-prefill locally; never a lost request, never a
+                     wrong-KV token)
 
 Injections are counted in the metrics registry: ``chaos.injected``
 (total) and ``chaos.injected.<site>``.
@@ -77,7 +82,8 @@ __all__ = ["active", "ChaosError", "SITES", "parse_spec", "configure",
 SITES = ("ckpt.write", "store.rpc", "store.partition", "fs.rename",
          "loader.worker", "step.loss", "host.slow", "serve.request",
          "kv.block_alloc", "router.dispatch", "fleet.lease",
-         "ps.pull", "ps.push", "ps.shard_down", "serve.preempt")
+         "ps.pull", "ps.push", "ps.shard_down", "serve.preempt",
+         "kv.transfer")
 
 # module-level fast predicate — the single read hot paths gate on
 active = False

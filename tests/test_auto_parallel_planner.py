@@ -66,6 +66,7 @@ def test_gpt_plan_matches_hand_shardings(gpt):
         int(np.prod(p.shape)) * 4 for _, p in gpt.named_parameters())
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 def test_plan_applies_and_trains(gpt):
     """shard() places params on the mesh and a jitted loss step still
     runs under GSPMD with the planned shardings."""

@@ -1,0 +1,418 @@
+"""Disaggregated prefill/decode serving (ISSUE 19): KV-chain wire
+format, pool roles, prefix-aware routing.
+
+Covers: serialize→deserialize bit-exactness for f32 and int8 (+scale)
+chains; the corruption matrix (truncated / bit-flipped / magicless /
+torn-header blobs) rejected typed and counted ``kv.transfer.corrupt``;
+``chain_digests`` parity with the prefix cache's sha256 stream;
+``hot_heads`` K-cap + 16-hex truncation; registry heartbeat
+forward-compat (old-schema payloads parse with role/heads defaults,
+junk-typed fields never raise) and the bounded-payload gauge +
+warn-once; router prefill-role filtering and longest-published-prefix
+dispatch scoring; engine ``export_prefix_chain`` /
+``import_prefix_chain`` end-to-end — decode on the receiving pool
+bit-exact vs the monolith for greedy AND sampled streams, partial-tail
+copy-on-write intact on the receiver, pools drained to all-free; and
+the replica role plumbing (prefill frontend sheds decodes typed,
+``/admin/kv/prefill`` / ``/admin/kv/import`` role guards + corrupt
+rejection over HTTP).
+"""
+import base64
+import json
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.distributed.fleet.elastic.manager import MemoryStore
+from paddle_tpu.generation import (KVTransferCorrupt, PrefixCache,
+                                   chain_digests, deserialize_chain,
+                                   serialize_chain)
+from paddle_tpu.generation import kv_wire
+from paddle_tpu.models import GPT, GPTConfig
+from paddle_tpu.profiler import metrics
+from paddle_tpu.serving import fleet
+
+
+def _val(name):
+    m = metrics.get(name)
+    return m.value if m is not None else 0
+
+
+def _gpt(seed=0):
+    paddle.seed(seed)
+    return GPT(GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                         num_heads=2, max_seq_len=64, ffn_mult=2))
+
+
+def _paged(net, name, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_length", 64)
+    kw.setdefault("max_new_tokens", 8)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("prefix_cache_blocks", 8)
+    kw.setdefault("warmup", "off")
+    return serving.PagedGenerationEngine(
+        net, serving.GenerationEngineConfig(name=name, **kw))
+
+
+def _post(url, obj):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+TOKS = np.arange(1, 21, dtype=np.int32)      # 2 full blocks + 4 tail
+
+
+def _payload_f32(nblocks=3, layers=2):
+    rng = np.random.default_rng(0)
+    return [tuple(rng.standard_normal((nblocks, 8, 2, 4),
+                                      dtype=np.float32)
+                  for _ in range(2)) for _ in range(layers)]
+
+
+def _payload_int8(nblocks=3, layers=2):
+    rng = np.random.default_rng(1)
+    out = []
+    for _ in range(layers):
+        k = rng.integers(-128, 127, (nblocks, 8, 2, 4), dtype=np.int8)
+        v = rng.integers(-128, 127, (nblocks, 8, 2, 4), dtype=np.int8)
+        ks = rng.random((nblocks, 8, 2), dtype=np.float32)
+        vs = rng.random((nblocks, 8, 2), dtype=np.float32)
+        out.append((k, v, ks, vs))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+class TestKVWire:
+    @pytest.mark.parametrize("payload", [_payload_f32(),
+                                         _payload_int8()],
+                             ids=["f32", "int8+scales"])
+    def test_roundtrip_bit_exact(self, payload):
+        blob = serialize_chain(TOKS, 20, 8, payload)
+        doc = deserialize_chain(blob)
+        assert doc["covered"] == 20 and doc["block_size"] == 8
+        assert np.array_equal(doc["tokens"], TOKS)
+        assert len(doc["payload"]) == len(payload)
+        for la, lb in zip(payload, doc["payload"]):
+            assert len(la) == len(lb)
+            for a, b in zip(la, lb):
+                assert a.dtype == b.dtype and a.shape == b.shape
+                assert np.array_equal(a, b)
+
+    def test_key_is_prefix_cache_identity(self):
+        blob = serialize_chain(TOKS, 20, 8, _payload_f32())
+        doc = deserialize_chain(blob)
+        assert doc["key"] == PrefixCache._key(TOKS, 20).hex()
+
+    def test_corruption_matrix_typed_and_counted(self):
+        blob = serialize_chain(TOKS, 20, 8, _payload_f32())
+        flipped = bytearray(blob)
+        flipped[-7] ^= 0x20                  # payload bit flip
+        torn = bytearray(blob)
+        torn[len(kv_wire.MAGIC) + 6] ^= 0xFF  # header byte
+        cases = {
+            "truncated": blob[:len(blob) // 3],
+            "magicless": b"NOTMAGIC" + blob[8:],
+            "flipped": bytes(flipped),
+            "torn_header": bytes(torn),
+            "empty": b"",
+            "not_bytes": 123,
+        }
+        before = _val("kv.transfer.corrupt")
+        for name, bad in cases.items():
+            with pytest.raises(KVTransferCorrupt):
+                deserialize_chain(bad)
+        assert _val("kv.transfer.corrupt") == before + len(cases)
+
+    def test_geometry_mismatch_rejected(self):
+        blob = serialize_chain(TOKS, 20, 8, _payload_f32())
+        with pytest.raises(KVTransferCorrupt):
+            deserialize_chain(blob, expect_block_size=16)
+        spec = [[("float32", (8, 2, 4))] * 2] * 3   # wrong layer count
+        with pytest.raises(KVTransferCorrupt):
+            deserialize_chain(blob, expect_spec=spec)
+        ok = deserialize_chain(
+            blob, expect_block_size=8,
+            expect_spec=[[("float32", (8, 2, 4))] * 2] * 2)
+        assert ok["covered"] == 20
+
+    def test_block_count_vs_covered_pinned(self):
+        # chain claims 20 tokens (3 blocks of 8) but ships only 2
+        with pytest.raises(KVTransferCorrupt):
+            deserialize_chain(
+                serialize_chain(TOKS, 20, 8, _payload_f32(nblocks=2)))
+
+    def test_chain_digests_parity(self):
+        digs = chain_digests(TOKS, 8)
+        assert [n for n, _ in digs] == [8, 16, 20]
+        for n, d in digs:
+            assert d == PrefixCache._key(TOKS, n).hex()[:16]
+        assert chain_digests(TOKS[:16], 8) == digs[:2]  # aligned: no tail
+
+
+# ---------------------------------------------------------------------------
+# heartbeat schema: forward compat + bounding
+# ---------------------------------------------------------------------------
+class TestHeartbeatSchema:
+    OLD = {"endpoint": "127.0.0.1:1", "ready": True, "queue_depth": 1,
+           "occupancy": 2, "slots": 4}
+
+    def test_old_schema_payload_parses_with_defaults(self):
+        info = fleet.ReplicaInfo.from_payload("r1", 0,
+                                              json.dumps(self.OLD))
+        assert info is not None
+        assert info.role == "both" and info.prefix_heads == ()
+        assert info.block_size == 0
+
+    def test_new_fields_parse(self):
+        d = dict(self.OLD, role="decode",
+                 prefix_heads=["aa" * 8, "bb" * 8], block_size=8)
+        info = fleet.ReplicaInfo.from_payload("r1", 0, json.dumps(d))
+        assert info.role == "decode"
+        assert info.prefix_heads == ("aa" * 8, "bb" * 8)
+        assert info.block_size == 8
+
+    def test_unknown_and_junk_fields_never_raise(self):
+        d = dict(self.OLD, prefix_heads={"not": "a list"},
+                 block_size="junk", role=None, future_field=[1, 2])
+        # block_size junk trips the tolerant-parse None, not an error
+        assert fleet.ReplicaInfo.from_payload(
+            "r1", 0, json.dumps(d)) is None
+        d = dict(self.OLD, prefix_heads=7, future_field="x")
+        info = fleet.ReplicaInfo.from_payload("r1", 0, json.dumps(d))
+        assert info is not None and info.prefix_heads == ()
+
+    def test_payload_bytes_gauge_and_warn_once(self):
+        store = MemoryStore()
+        reg = fleet.ReplicaRegistry(
+            store, "jobD", "r1",
+            lambda: {"endpoint": "e", "blob": "x" * 256},
+            payload_warn_bytes=64)
+        with pytest.warns(RuntimeWarning, match="payload"):
+            reg.publish()
+        assert _val("fleet.registry.payload_bytes") > 64
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")   # second publish: silent
+            reg.publish()
+
+    def test_hot_heads_cap_and_truncation(self):
+        from paddle_tpu.generation import BlockPool
+        pool = BlockPool(32, 8, name="hh")
+        cache = PrefixCache(pool, 16, name="hh")
+        prompts = [np.arange(1 + i, 18 + i, dtype=np.int32)
+                   for i in range(4)]
+        for p in prompts:               # 17 tokens -> 2 full + 1 tail
+            blocks = pool.alloc(3)
+            cache.insert(p, blocks)
+            pool.decref(blocks)         # cache now sole owner
+        heads = cache.hot_heads(3)
+        assert len(heads) == 3
+        assert all(len(h) == 16 for h in heads)
+        assert all(c in "0123456789abcdef" for h in heads for c in h)
+        # MRU first: the freshest prompt's deepest entry leads
+        assert heads[0] == PrefixCache._key(prompts[-1], 17).hex()[:16]
+        assert cache.hot_heads(0) == []
+        cache.clear()
+        assert pool.available == pool.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# router: role filter + prefix-aware pick
+# ---------------------------------------------------------------------------
+class TestPrefixRouting:
+    def _router(self):
+        return fleet.FleetRouter(MemoryStore(), "core",
+                                 manage_swaps=False)
+
+    def _info(self, rid, load=0, role="both", heads=(), bs=8):
+        return fleet.ReplicaInfo(
+            rid, endpoint=f"127.0.0.1:{9000 + load}", ready=True,
+            queue_depth=load, role=role, prefix_heads=heads,
+            block_size=bs)
+
+    def test_prefill_role_never_dispatchable(self):
+        r = self._router()
+        r._replicas = {"p": self._info("p", role="prefill"),
+                       "d": self._info("d", load=5, role="decode")}
+        out = r._dispatchable()
+        assert [i.replica_id for i in out] == ["d"]
+
+    def test_longest_prefix_wins_over_load(self):
+        prompt = TOKS.tolist()
+        digs = dict(chain_digests(TOKS, 8))
+        r = self._router()
+        r._replicas = {
+            "cold": self._info("cold", load=0),
+            "warm": self._info("warm", load=3, heads=(digs[8],)),
+            "hot": self._info("hot", load=5, heads=(digs[8],
+                                                    digs[16])),
+        }
+        picked = r._pick(set(), prompt, {})
+        assert picked.replica_id == "hot"
+        # no prompt: pure least-loaded (pre-disagg behavior)
+        assert r._pick(set()).replica_id == "cold"
+        # no match anywhere: least-loaded tiebreak
+        r._replicas["warm"].prefix_heads = ()
+        r._replicas["hot"].prefix_heads = ("f" * 16,)
+        assert r._pick(set(), prompt, {}).replica_id == "cold"
+
+    def test_stale_or_skewed_heads_are_harmless(self):
+        prompt = TOKS.tolist()
+        r = self._router()
+        r._replicas = {
+            "a": self._info("a", load=0, heads=("zz", ""), bs=0),
+            "b": self._info("b", load=2, heads=("zz",), bs=-3),
+        }
+        assert r._pick(set(), prompt, {}).replica_id == "a"
+
+
+# ---------------------------------------------------------------------------
+# engine: export → import, bit-exact decode on the receiving pool
+# ---------------------------------------------------------------------------
+class TestChainTransfer:
+    SAMPLING = [dict(do_sample=False, seed=7),
+                dict(do_sample=True, temperature=0.9, top_k=0,
+                     top_p=1.0, seed=11)]
+
+    def test_export_import_bit_exact_and_cow(self):
+        net = _gpt()
+        # sender doubles as the monolithic reference: its outputs ARE
+        # what a single-engine deployment would have produced
+        pre = _paged(net, "xi_pre")
+        assert pre.export_prefix_chain(TOKS) is None     # cold: miss
+        refs = [pre.generate(TOKS, timeout=300, **kw)
+                for kw in self.SAMPLING]
+        p2 = np.concatenate([TOKS[:16],
+                             np.asarray([55, 56, 57], np.int32)])
+        ref2 = pre.generate(p2, timeout=300, **self.SAMPLING[0])
+        blob = pre.export_prefix_chain(TOKS)
+        assert blob is not None
+        pre.close()
+        assert pre.pool.available == pre.pool.num_blocks
+
+        dec = _paged(net, "xi_dec")
+        try:
+            # a shipment claiming a different block geometry is
+            # refused typed + counted before any bytes are adopted
+            wrong = serialize_chain(
+                TOKS[:16], 16, 16,
+                [tuple(np.zeros((1, 16, 2, 4), np.float32)
+                       for _ in range(2)) for _ in range(2)])
+            before = _val("kv.transfer.corrupt")
+            with pytest.raises(KVTransferCorrupt):
+                dec.import_prefix_chain(wrong)
+            assert _val("kv.transfer.corrupt") == before + 1
+
+            assert dec.import_prefix_chain(blob) == len(TOKS)
+            hits0 = _val("xi_dec.prefix_cache.hit")
+            for kw, ref in zip(self.SAMPLING, refs):
+                got = dec.generate(TOKS, timeout=300, **kw)
+                assert np.array_equal(got, ref), kw
+            assert _val("xi_dec.prefix_cache.hit") >= hits0 + 2
+            # partial-tail CoW on the RECEIVING pool: a diverging
+            # suffix must copy the shared tail, decode bit-exact, and
+            # leave the adopted chain intact for the original prompt
+            got2 = dec.generate(p2, timeout=300, **self.SAMPLING[0])
+            assert np.array_equal(got2, ref2)
+            got = dec.generate(TOKS, timeout=300, **self.SAMPLING[0])
+            assert np.array_equal(got, refs[0])
+        finally:
+            dec.close()
+        assert dec.pool.available == dec.pool.num_blocks
+        # a closed engine refuses imports typed, with the alloc undone
+        with pytest.raises(serving.EngineClosed):
+            dec.import_prefix_chain(blob)
+        assert dec.pool.available == dec.pool.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# replica roles over HTTP
+# ---------------------------------------------------------------------------
+class TestReplicaRoles:
+    def test_prefill_and_decode_role_plumbing(self):
+        net = _gpt()
+        pre_eng = _paged(net, "rp_pre")
+        dec_eng = _paged(net, "rp_dec")
+        # the monolithic reference: what either engine produces solo
+        ref = pre_eng.generate(TOKS, timeout=300,
+                               do_sample=False, seed=7)
+        with pytest.raises(ValueError, match="role"):
+            fleet.FleetReplica(generation_engine=pre_eng,
+                               store=MemoryStore(), role="weird")
+        store = MemoryStore()
+        pre = fleet.FleetReplica(generation_engine=pre_eng,
+                                 store=store, job="roles",
+                                 replica_id="pre", role="prefill")
+        dec = fleet.FleetReplica(generation_engine=dec_eng,
+                                 store=store, job="roles",
+                                 replica_id="dec", role="decode")
+        try:
+            pre.start()
+            dec.start()
+            pre_url = f"http://{pre.endpoint}"
+            dec_url = f"http://{dec.endpoint}"
+            # the heartbeat payload advertises the role
+            infos = fleet.list_replicas(store, "roles")
+            assert infos["pre"].role == "prefill"
+            assert infos["dec"].role == "decode"
+
+            # a prefill frontend sheds decode traffic typed
+            code, doc = _post(f"{pre_url}/v1/generate",
+                              {"prompt_ids": TOKS.tolist(),
+                               "max_new_tokens": 4})
+            assert code == 429 and doc["reason"] == "wrong_role"
+            assert _val("rp_pre.request.rejected.wrong_role") == 1
+
+            # decode replicas refuse to prefill for peers
+            code, doc = _post(f"{dec_url}/admin/kv/prefill",
+                              {"prompt_ids": TOKS.tolist()})
+            assert code == 409 and doc["reason"] == "wrong_role"
+
+            # pull a chain from the prefill replica, push into decode
+            code, doc = _post(f"{pre_url}/admin/kv/prefill",
+                              {"prompt_ids": TOKS.tolist()})
+            assert code == 200 and doc["ok"]
+            assert doc["bytes"] == len(base64.b64decode(doc["blob"]))
+            code, idoc = _post(f"{dec_url}/admin/kv/import",
+                               {"blob": doc["blob"]})
+            assert code == 200 and idoc["covered"] == len(TOKS)
+
+            # prefill replicas refuse to adopt chains
+            code, rdoc = _post(f"{pre_url}/admin/kv/import",
+                               {"blob": doc["blob"]})
+            assert code == 409 and rdoc["reason"] == "wrong_role"
+
+            # a corrupted shipment is rejected typed, never adopted
+            bad = bytearray(base64.b64decode(doc["blob"]))
+            bad[-3] ^= 0x10
+            code, cdoc = _post(
+                f"{dec_url}/admin/kv/import",
+                {"blob": base64.b64encode(bytes(bad)).decode()})
+            assert code == 409 and cdoc["reason"] == "corrupt"
+
+            # the adopted chain decodes bit-exact on the decode replica
+            code, gdoc = _post(f"{dec_url}/v1/generate",
+                               {"prompt_ids": TOKS.tolist(),
+                                "do_sample": False, "seed": 7})
+            assert code == 200
+            assert np.array_equal(np.asarray(gdoc["tokens"],
+                                             np.int32), ref)
+        finally:
+            pre.shutdown()
+            dec.shutdown()
+        assert pre_eng.pool.available == pre_eng.pool.num_blocks
+        assert dec_eng.pool.available == dec_eng.pool.num_blocks

@@ -140,6 +140,7 @@ print(f"rank {rank} done", flush=True)
 """
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 def test_scale_in_resume_from_checkpoint(kv, tmp_path):
     """Member loss -> relaunch at smaller world -> checkpoint resume with
     the loss curve continuing exactly."""

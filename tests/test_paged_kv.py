@@ -325,6 +325,7 @@ def test_engine_pool_all_free_after_mixed_retirement(net, prompts):
     assert len(eng.prefix_cache) == 0
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 def test_engine_paged_matches_contiguous_engine(net, prompts):
     with serving.GenerationEngine(
             net, serving.GenerationEngineConfig(
@@ -444,6 +445,7 @@ def test_engine_speculative_accepts_with_oracle_drafter(
     assert eng.pool.available == eng.pool.num_blocks
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 def test_engine_concurrent_streams_leak_free(net, prompts):
     """Staggered concurrent traffic over a provisioned-for-live-tokens
     pool (smaller than worst case): everything completes or sheds

@@ -195,6 +195,7 @@ def test_int8_artifact_axis_meta_and_parity():
     assert rel < 0.05
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 def test_int8_quantize_then_serve_roundtrip():
     """quantize -> artifact -> InferenceEngine (bucketing +
     ExecutableCache) -> bit-stable service with top-1 agreement."""

@@ -264,6 +264,7 @@ def preempt_scenario(net, name, do_sample):
         eng.close()
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 def test_preempt_resume_bit_exact_greedy(net):
     ref, outA, eng = preempt_scenario(net, "tsp_pre_g", do_sample=False)
     c = flight.counts()
@@ -276,6 +277,7 @@ def test_preempt_resume_bit_exact_greedy(net):
     assert eng.pool.available == eng.pool.num_blocks   # drained free
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 def test_preempt_resume_bit_exact_sampled(net):
     ref, outA, _eng = preempt_scenario(net, "tsp_pre_s", do_sample=True)
     c = flight.counts()
@@ -284,6 +286,7 @@ def test_preempt_resume_bit_exact_sampled(net):
     assert np.array_equal(ref, outA)
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 def test_preempt_flight_event_fields(net):
     preempt_scenario(net, "tsp_pre_f", do_sample=False)
     evs = [f for _t, cat, ev, f in flight.events()

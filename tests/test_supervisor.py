@@ -80,6 +80,7 @@ def test_supervise_watchdog_kills_hung_step(tmp_path):
     assert "watchdog" in r.stderr
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 def test_supervise_done_worker_does_not_trip_watchdog(tmp_path):
     """A worker that heartbeats and then EXITS 0 stops advancing its
     heartbeat by definition — the watchdog must not read that as a hang
@@ -204,6 +205,7 @@ if gen == 0:
     assert rep["world"] == 1
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 def test_generation_scoped_heartbeats_ignore_stale_keys(tmp_path):
     """Satellite: heartbeat keys are generation-prefixed.  A key left
     behind by generation 0 (stuck at its last step forever) must NOT

@@ -33,6 +33,7 @@ def dp8_result():
     return float(loss), jax.tree.leaves(jax.device_get(pn))
 
 
+@pytest.mark.slow    # tier-1 runtime budget: full e2e, run via --runslow
 @pytest.mark.parametrize("stage,offload", [(1, False), (2, False),
                                            (2, True), (3, False)])
 def test_zero_stage_parity(dp8_result, stage, offload):

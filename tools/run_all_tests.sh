@@ -150,4 +150,13 @@ python tools/memplan_gate.py
 # their unpreempted references, interactive p99 bounded, zero lost
 # requests, and the pool drained to all-free after close.
 python tools/slo_gate.py
+# Disaggregated prefill/decode gate (ISSUE 19 disagg layer): a
+# 1-prefill + 2-decode fleet behind the prefix-aware router serves a
+# shared-prompt workload with every stream (greedy AND sampled, JSON
+# and SSE) bit-exact vs a monolithic reference engine, KV chains
+# actually adopted over the wire, the fleet-wide prefix hit rate at
+# the single-replica level, one injected kv.transfer failure ridden
+# out by local re-prefill with zero lost requests, and every replica
+# pool drained to all-free on SIGTERM.
+python tools/disagg_gate.py
 exec python -m pytest tests/ -q --runslow "$@"
