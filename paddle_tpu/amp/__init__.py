@@ -11,6 +11,7 @@ machine is kept for fp16 parity and for parity of semantics).
 from __future__ import annotations
 
 import threading
+import warnings
 
 import jax.numpy as jnp
 
@@ -169,7 +170,14 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
 
 class GradScaler:
     """Dynamic loss scaling (reference fluid/dygraph/amp/loss_scaler.py:40
-    AmpScaler; kernels operators/amp/*)."""
+    AmpScaler; kernels operators/amp/*).
+
+    bfloat16 has the same exponent range as float32, so loss scaling
+    buys nothing and costs a per-step finite-check: under an active
+    bf16 autocast (or a bf16 loss), :meth:`scale` skips scaling — warns
+    once — and the step/unscale/update machinery no-ops for that step.
+    fp16 keeps the full dynamic state machine.
+    """
 
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
@@ -185,6 +193,8 @@ class GradScaler:
         self._bad = jnp.zeros((), jnp.int32)
         self._found_inf = False
         self._already_unscaled = False
+        self._skip_scaling = False      # latched by a bf16 scale()
+        self._bf16_warned = False
 
     def is_enable(self):
         return self._enable
@@ -195,15 +205,33 @@ class GradScaler:
     def get_init_loss_scaling(self):
         return float(self._scale)
 
+    def _bf16_active(self, var) -> bool:
+        st = _amp_state()
+        if st is not None and st.dtype == jnp.bfloat16:
+            return True
+        dt = getattr(getattr(var, "_data", var), "dtype", None)
+        return dt == jnp.bfloat16
+
     def scale(self, var):
         var = to_tensor(var)
         if not self._enable:
             return var
+        if self._bf16_active(var):
+            if not self._bf16_warned:
+                self._bf16_warned = True
+                warnings.warn(
+                    "GradScaler: bfloat16 has the float32 exponent "
+                    "range — loss scaling is skipped (the scaler is a "
+                    "pass-through for bf16; it stays armed for fp16)")
+            self._skip_scaling = True
+            return var
+        self._skip_scaling = False
         from ..ops import math as m
         return m.multiply(var, Tensor(self._scale.astype(var.dtype)))
 
     def unscale_(self, optimizer):
-        if not self._enable or self._already_unscaled:
+        if not self._enable or self._already_unscaled or \
+                self._skip_scaling:
             return
         params = [p for p in (optimizer._parameter_list or [])
                   if p.grad is not None]
@@ -228,7 +256,7 @@ class GradScaler:
 
     def update(self):
         self._already_unscaled = False
-        if not (self._enable and self._dynamic):
+        if not (self._enable and self._dynamic) or self._skip_scaling:
             return
         new_scale, good, bad = update_loss_scaling(
             Tensor(jnp.asarray(self._found_inf)), Tensor(self._scale),
@@ -251,6 +279,12 @@ class GradScaler:
         self._scale = jnp.asarray(state["scale"], jnp.float32)
         self._good = jnp.asarray(state.get("good_steps", 0), jnp.int32)
         self._bad = jnp.asarray(state.get("bad_steps", 0), jnp.int32)
+        self._incr_ratio = state.get("incr_ratio", self._incr_ratio)
+        self._decr_ratio = state.get("decr_ratio", self._decr_ratio)
+        self._incr_every_n_steps = state.get(
+            "incr_every_n_steps", self._incr_every_n_steps)
+        self._decr_every_n = state.get(
+            "decr_every_n_nan_or_inf", self._decr_every_n)
 
 
 AmpScaler = GradScaler

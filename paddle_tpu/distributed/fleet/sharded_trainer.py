@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...core import autograd
 from ...core.random import rng_scope, default_generator
 from ...core.tensor import Tensor
+from ...profiler import memscope as _memscope
 from .meta_optimizers.zero import add_sharding_axis
 
 __all__ = ["ShardedTrainer", "build_sharded_trainer"]
@@ -112,6 +113,20 @@ class ShardedTrainer:
             lambda a, s: jax.device_put(a, s), opt_state, self._state_sh,
             is_leaf=lambda x: isinstance(x, (jnp.ndarray, np.ndarray)))
         self._compiled = {}
+        self._account_offload()
+
+    def _account_offload(self):
+        """Tag the pinned-host-resident opt state under memscope's
+        ``host_offload`` gauge (same vocabulary as the hapi
+        ``prepare(offload=True)`` knob) — metadata-only, free when
+        accounting is off."""
+        if not (self.offload and _memscope.active):
+            return
+        try:
+            _memscope.set_tag_bytes(
+                "host_offload", _memscope.tree_nbytes(self.opt_state))
+        except Exception:   # noqa: BLE001 — accounting never throws
+            pass
 
     # -- the step ---------------------------------------------------------
     def _build(self, n_batch):
@@ -164,6 +179,7 @@ class ShardedTrainer:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         loss, self.params, self._buffers, self.opt_state = fn(
             self.params, self._buffers, self.opt_state, key, lr, *arrays)
+        self._account_offload()
         # drop leaked tracers from the live layer (eager use between
         # steps must see real arrays; full values need sync_to_layer())
         self.layer.load_functional_state(

@@ -172,7 +172,7 @@ class Model:
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None, jit=True):
+                amp_configs=None, jit=True, offload=False):
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
@@ -181,22 +181,63 @@ class Model:
                 raise TypeError(f"metric {m} is not a paddle Metric")
         self._use_jit = jit
         self._amp_level = None
+        self._amp_dtype = "bfloat16"
+        self._amp_custom_white = None
+        self._amp_custom_black = None
+        self._amp_scaler_cfg = None
+        self._amp_scaler_state = None
         if amp_configs:
-            if isinstance(amp_configs, str):
-                self._amp_level = amp_configs
-            else:
-                self._amp_level = amp_configs.get("level", "O1")
+            cfg = {"level": amp_configs} if isinstance(amp_configs, str) \
+                else dict(amp_configs)
+            self._amp_level = cfg.get("level", "O1")
+            if self._amp_level not in ("O1", "O2"):
+                raise ValueError(
+                    f"amp_configs level must be 'O1' or 'O2', got "
+                    f"{self._amp_level!r}")
+            self._amp_dtype = cfg.get("dtype", "bfloat16")
+            self._amp_custom_white = cfg.get("custom_white_list")
+            self._amp_custom_black = cfg.get("custom_black_list")
+            if self._amp_dtype in ("float16", "fp16"):
+                # fp16's 5-bit exponent needs dynamic loss scaling; the
+                # whole state machine rides INSIDE the jitted step (no
+                # per-step host sync on found_inf).  bf16 shares fp32's
+                # exponent range, so it never engages the scaler.
+                self._amp_scaler_cfg = {
+                    "init_loss_scaling": float(
+                        cfg.get("init_loss_scaling", 2.0 ** 15)),
+                    "incr_ratio": float(cfg.get("incr_ratio", 2.0)),
+                    "decr_ratio": float(cfg.get("decr_ratio", 0.5)),
+                    "incr_every_n_steps": int(
+                        cfg.get("incr_every_n_steps", 1000)),
+                    "decr_every_n_nan_or_inf": int(
+                        cfg.get("decr_every_n_nan_or_inf", 2)),
+                    "use_dynamic_loss_scaling": bool(
+                        cfg.get("use_dynamic_loss_scaling", True)),
+                }
+        # opt-in optimizer-state offload to pinned host memory (the
+        # single-device sibling of the ZeRO offload knob in
+        # distributed/fleet/sharded_trainer.py) — trades one opt-state
+        # round-trip of PCIe/host bandwidth per step for its HBM
+        self._offload = bool(offload)
         return self
 
     # ------------------------------------------------------------------
     # compiled train step
     # ------------------------------------------------------------------
-    def _build_jit_train_step(self, n_inputs, n_labels):
+    def _build_jit_train_step(self, n_inputs, n_labels, remat=False):
         net, opt, loss_fn = self.network, self._optimizer, self._loss
         amp_level = self._amp_level
+        amp_dtype = getattr(self, "_amp_dtype", "bfloat16")
+        amp_white = getattr(self, "_amp_custom_white", None)
+        amp_black = getattr(self, "_amp_custom_black", None)
+        scaler_cfg = getattr(self, "_amp_scaler_cfg", None)
+        low = None
+        if amp_level:
+            from ..core.dtype import dtype_to_jnp
+            low = dtype_to_jnp(amp_dtype)
 
-        def step(params, buffers, opt_state, key_base, rng_ctr, lr,
-                 *data):
+        def step(params, buffers, opt_state, scaler_state, key_base,
+                 rng_ctr, lr, *data):
             # rng key derived IN-JIT from a device-resident counter
             # (same (seed, counter) stream as Generator.next_key): a
             # host-built key per step is a tiny host->device transfer
@@ -209,10 +250,23 @@ class Model:
 
             def loss_of(params):
                 with rng_scope(key), autograd.no_grad():
-                    net.load_functional_state(params, buffers)
+                    if low is not None and amp_level == "O2":
+                        # O2 master-weight contract: the fp32 params in
+                        # `params` ARE the masters (the grad/update
+                        # domain); the network sees a low-dtype view.
+                        # The cast is inside the differentiated function,
+                        # so grads land back on the fp32 leaves.
+                        net_params = {
+                            n: (p.astype(low) if p.dtype == jnp.float32
+                                else p) for n, p in params.items()}
+                    else:
+                        net_params = params
+                    net.load_functional_state(net_params, buffers)
                     if amp_level:
                         from ..amp import auto_cast
-                        with auto_cast(level=amp_level):
+                        with auto_cast(level=amp_level, dtype=amp_dtype,
+                                       custom_white_list=amp_white,
+                                       custom_black_list=amp_black):
                             outs = net.forward(*inputs)
                     else:
                         outs = net.forward(*inputs)
@@ -220,17 +274,140 @@ class Model:
                     loss = loss_fn(*(outs_l + labels))
                     new_buffers = {n: b._data for n, b in net.named_buffers()}
                 loss_arr = loss._data if isinstance(loss, Tensor) else loss
-                return loss_arr.astype(jnp.float32), \
+                loss_arr = loss_arr.astype(jnp.float32)
+                if scaler_state is not None:
+                    loss_arr = loss_arr * scaler_state["scale"]
+                return loss_arr, \
                     ([o._data for o in outs_l], new_buffers)
+
+            if remat:
+                # budget-driven rematerialization (FLAGS_remat_budget_mb
+                # below the planner's peak estimate): keep matmul
+                # outputs, recompute the cheap elementwise tail in the
+                # backward — the same save-dots selection RematPass
+                # makes over captured Programs
+                loss_of = jax.checkpoint(
+                    loss_of, policy=jax.checkpoint_policies.dots_saveable)
 
             (loss, (outs, new_buffers)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
-            new_params, new_opt_state = opt.functional_apply(
-                params, grads, opt_state, lr)
+
+            new_scaler = None
+            if scaler_state is not None:
+                # fp16 path: unscale, detect non-finite grads, and make
+                # the whole update a per-leaf no-op on overflow — the
+                # check_finite_and_unscale / update_loss_scaling state
+                # machine fused into the step (zero host syncs)
+                inv = jnp.float32(1.0) / scaler_state["scale"]
+                loss = loss * inv
+                found_inf = jnp.zeros((), jnp.bool_)
+                for g in jax.tree_util.tree_leaves(grads):
+                    found_inf = jnp.logical_or(
+                        found_inf,
+                        jnp.logical_not(jnp.all(jnp.isfinite(g))))
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g * inv.astype(g.dtype)), grads)
+                new_params, new_opt_state = opt.functional_apply(
+                    params, grads, opt_state, lr)
+                skip = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(found_inf, old, new),
+                    (new_params, new_opt_state), (params, opt_state))
+                new_params, new_opt_state = skip
+                if scaler_cfg["use_dynamic_loss_scaling"]:
+                    from ..ops.amp_ops import update_loss_scaling
+                    ns, ng, nb = update_loss_scaling(
+                        Tensor(found_inf), Tensor(scaler_state["scale"]),
+                        Tensor(scaler_state["good"]),
+                        Tensor(scaler_state["bad"]),
+                        scaler_cfg["incr_every_n_steps"],
+                        scaler_cfg["decr_every_n_nan_or_inf"],
+                        scaler_cfg["incr_ratio"], scaler_cfg["decr_ratio"])
+                    new_scaler = {"scale": ns._data, "good": ng._data,
+                                  "bad": nb._data, "found_inf": found_inf}
+                else:
+                    new_scaler = {"scale": scaler_state["scale"],
+                                  "good": scaler_state["good"],
+                                  "bad": scaler_state["bad"],
+                                  "found_inf": found_inf}
+            else:
+                new_params, new_opt_state = opt.functional_apply(
+                    params, grads, opt_state, lr)
             return loss, outs, new_buffers, new_params, new_opt_state, \
-                rng_ctr
+                new_scaler, rng_ctr
 
         return jax.jit(step, donate_argnums=(0, 2))
+
+    def _remat_decision(self, batch_size: int = 1) -> bool:
+        """True when ``FLAGS_program_remat`` + ``FLAGS_remat_budget_mb``
+        are set and the static memory planner's train-peak estimate for
+        this model exceeds the budget — the same flag pair that rewrites
+        captured Programs (static/program.py pass pipeline), here
+        deciding whether the jitted hapi step wraps its loss in
+        :func:`jax.checkpoint`.  An un-plannable model (no input/label
+        specs, capture failure) under an explicit budget remats
+        conservatively.  The verdict is cached per budget value."""
+        from ..utils import flags as _flags
+        if not _flags.get_flag("FLAGS_program_remat"):
+            return False
+        budget_mb = int(_flags.get_flag("FLAGS_remat_budget_mb") or 0)
+        if budget_mb <= 0:
+            return False
+        cached = getattr(self, "_remat_cache", None)
+        if cached is not None and cached[0] == (budget_mb, batch_size):
+            return cached[1]
+        peak = None
+        try:
+            if self._inputs and self._labels:
+                peak = int(self.static_memory_plan(
+                    "train", batch_size=max(1, batch_size)).peak_bytes)
+        except Exception:   # noqa: BLE001 — unplannable nets still remat
+            peak = None
+        on = peak is None or peak > budget_mb * (1 << 20)
+        if on:
+            warnings.warn(
+                f"fit: rematerialization engaged — planner peak "
+                f"{'unknown' if peak is None else f'{peak}B'} vs budget "
+                f"{budget_mb}MB (FLAGS_remat_budget_mb); the train step "
+                f"recomputes non-matmul activations in the backward")
+        self._remat_active = on
+        self._remat_planned_peak = peak
+        self._remat_cache = ((budget_mb, batch_size), on)
+        return on
+
+    def _offload_shardings(self):
+        """(host, device) shardings for the ``prepare(offload=True)``
+        opt-state knob, or None when it cannot apply: data-parallel
+        wrappers keep their ZeRO offload (sharded_trainer), and a
+        backend without a ``pinned_host`` memory space (CPU) warns once
+        and trains un-offloaded."""
+        cached = getattr(self, "_offload_sh_cache", "unset")
+        if cached != "unset":
+            return cached
+        result = None
+        if not hasattr(self.network, "shard_inputs"):
+            dev = jax.devices()[0]
+            try:
+                kinds = {m.kind for m in dev.addressable_memories()}
+            except Exception:   # noqa: BLE001 — old backend API
+                kinds = set()
+            if "pinned_host" in kinds:
+                from jax.sharding import SingleDeviceSharding
+                result = (SingleDeviceSharding(
+                              dev, memory_kind="pinned_host"),
+                          SingleDeviceSharding(
+                              dev, memory_kind="device"))
+            else:
+                warnings.warn(
+                    "prepare(offload=True): this backend exposes no "
+                    "pinned_host memory space — optimizer-state offload "
+                    "is a no-op here (training proceeds un-offloaded)")
+        else:
+            warnings.warn(
+                "prepare(offload=True): data-parallel models offload "
+                "through the fleet ZeRO path (sharded_trainer offload=) "
+                "— the hapi knob is a no-op under shard_inputs")
+        self._offload_sh_cache = result
+        return result
 
     def _device_rng_state(self):
         """(key_base, rng_ctr) device scalars for the jitted step,
@@ -298,12 +475,30 @@ class Model:
             # then emits the cross-replica grad all-reduce (reducer.cc's
             # job in the reference) during compilation.
             arrays = self.network.shard_inputs(arrays)
-        sig = ("train", tuple((a.shape, str(a.dtype)) for a in arrays))
+        remat_on = self._remat_decision(batch_size=_batch_len(inputs))
+        sig = ("train", remat_on,
+               tuple((a.shape, str(a.dtype)) for a in arrays))
         step = self._jit_cache.get(sig)
         net, opt = self.network, self._optimizer
         params, buffers = net.functional_state()
         if not hasattr(opt, "_fn_state") or opt._fn_state is None:
             opt._fn_state = opt.functional_init(params)
+        offload_sh = self._offload_shardings() \
+            if getattr(self, "_offload", False) else None
+        if offload_sh is not None and getattr(self, "_opt_on_host", False):
+            # opt state parked in pinned host memory since last step:
+            # stage it back into HBM for the (donating) jit step
+            opt._fn_state = jax.device_put(opt._fn_state, offload_sh[1])
+        scaler_state = None
+        if getattr(self, "_amp_scaler_cfg", None) is not None:
+            scaler_state = self._amp_scaler_state
+            if scaler_state is None:
+                c = self._amp_scaler_cfg
+                scaler_state = {
+                    "scale": jnp.asarray(c["init_loss_scaling"],
+                                         jnp.float32),
+                    "good": jnp.zeros((), jnp.int32),
+                    "bad": jnp.zeros((), jnp.int32)}
         key_base, rng_ctr = self._device_rng_state()
         if key_base is None:
             # split-chain mode: a per-step host-built key (transfer) —
@@ -320,7 +515,8 @@ class Model:
         fresh_step = step is None
         aot_hit = False
         if step is None:
-            step = self._build_jit_train_step(len(inputs), len(labels))
+            step = self._build_jit_train_step(len(inputs), len(labels),
+                                              remat=remat_on)
             from ..utils import artifact_store as _aot
             if _aot.active() is not None and \
                     not hasattr(self.network, "shard_inputs"):
@@ -335,12 +531,14 @@ class Model:
                 try:
                     step = _aot.aot_compile(
                         step.lower(params, buffers, opt._fn_state,
-                                   key_base, rng_ctr, *([lr] + arrays)),
+                                   scaler_state, key_base, rng_ctr,
+                                   *([lr] + arrays)),
                         label="hapi.train_step")
                     aot_hit = True   # ledger entry recorded by the store
                 except Exception:   # noqa: BLE001 — jit fallback
                     step = self._build_jit_train_step(len(inputs),
-                                                      len(labels))
+                                                      len(labels),
+                                                      remat=remat_on)
             self._jit_cache[sig] = step
         # step-phase attribution: the dispatch call is where device
         # backpressure surfaces in a sync-free loop (XLA bounds the
@@ -353,9 +551,9 @@ class Model:
         _m0 = _obs.now_ns() if (_memscope.active and fresh_step
                                 and not aot_hit) else 0
         try:
-            loss, outs, new_buffers, new_params, new_state, new_ctr = \
-                step(params, buffers, opt._fn_state, key_base, rng_ctr,
-                     *([lr] + arrays))
+            (loss, outs, new_buffers, new_params, new_state, new_scaler,
+             new_ctr) = step(params, buffers, opt._fn_state, scaler_state,
+                             key_base, rng_ctr, *([lr] + arrays))
         except Exception as e:
             net.load_functional_state(params, buffers)  # drop leaked tracers
             if _memscope.active and _memscope.is_oom(e):
@@ -377,6 +575,21 @@ class Model:
             self._rng_dev_cache = ((default_generator._seed,
                                     default_generator._counter),
                                    key_base, new_ctr)
+        if new_scaler is not None:
+            # device arrays, never synced here: found_inf is only
+            # materialized if someone (tests, the nan guard) reads it
+            self._amp_found_inf = new_scaler["found_inf"]
+            self._amp_scaler_state = {k: new_scaler[k]
+                                      for k in ("scale", "good", "bad")}
+        if offload_sh is not None:
+            new_state = jax.device_put(new_state, offload_sh[0])
+            self._opt_on_host = True
+            if _memscope.active:
+                try:
+                    _memscope.set_tag_bytes(
+                        "host_offload", _memscope.tree_nbytes(new_state))
+                except Exception:   # noqa: BLE001 — accounting never throws
+                    pass
         opt._fn_state = new_state
         net.load_functional_state(new_params, new_buffers)
         if opt._lr_scheduler is None and hasattr(opt, "_global_step"):
@@ -404,7 +617,12 @@ class Model:
         net, opt = self.network, self._optimizer
         if self._amp_level:
             from ..amp import auto_cast
-            with auto_cast(level=self._amp_level):
+            with auto_cast(level=self._amp_level,
+                           dtype=getattr(self, "_amp_dtype", "bfloat16"),
+                           custom_white_list=getattr(
+                               self, "_amp_custom_white", None),
+                           custom_black_list=getattr(
+                               self, "_amp_custom_black", None)):
                 outs = _to_list(net(*[to_tensor(i) for i in inputs]))
         else:
             outs = _to_list(net(*[to_tensor(i) for i in inputs]))

@@ -31,7 +31,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .gpt import GPTConfig
 
 __all__ = ["init_gpt_params", "gpt_param_shardings",
-           "build_spmd_train_step"]
+           "build_spmd_train_step", "HAS_MANUAL_PIPELINE"]
+
+# The pp/sp schedules need partial-manual shard_map (manual pipeline
+# axis, dp/mp left to GSPMD).  ``jax.shard_map`` with ``axis_names=``
+# landed post-0.4.x; the 0.4.x experimental ``auto=`` spelling exists
+# but this XLA hard-CHECKs partitioning the resulting mixed-manual
+# HLO, so old-jax builds take a GSPMD scan fallback instead (same
+# numerics, no microbatch overlap).
+HAS_MANUAL_PIPELINE = hasattr(jax, "shard_map")
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+               check_vma=False):
+    """``jax.shard_map`` with the modern ``axis_names``/``check_vma``
+    spelling, falling back to ``jax.experimental.shard_map`` (0.4.x:
+    ``auto``/``check_rep``) — same partial-manual semantics: axes not
+    in ``axis_names`` stay with GSPMD."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names=axis_names,
+                             check_vma=check_vma)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+        manual = frozenset(axis_names) if axis_names is not None \
+            else frozenset(mesh.axis_names)
+        auto = frozenset(mesh.axis_names) - manual
+        return _sm(f, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=bool(check_vma),
+                   auto=auto)
 
 
 def _barrier_with_grad():
@@ -174,6 +202,13 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
     sp = mesh.shape.get("sp", 1)
     sharding_n = mesh.shape.get("sharding", 1)
     use_pp, use_sp = pp > 1, sp > 1
+    if (use_pp or use_sp) and not HAS_MANUAL_PIPELINE:
+        import warnings
+        warnings.warn(
+            "build_spmd_train_step: this jax has no partial-manual "
+            "jax.shard_map — pp/sp run the GSPMD scan fallback "
+            "(identical numerics, no pipeline/ring overlap)")
+        use_pp = use_sp = False
     use_zero = sharding_n > 1
     # only axes actually present in the mesh shard the batch (a pp-only
     # mesh has no dp axis at all; size-1 axes are no-ops)
@@ -276,7 +311,7 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
                                      axis="pp", num_stages=pp,
                                      num_microbatches=M)
 
-            xm = jax.shard_map(
+            xm = _shard_map(
                 piped, mesh=mesh, in_specs=(P("pp"), x_spec),
                 out_specs=x_spec, axis_names={"pp"} | ({"sp"} if use_sp
                                                        else set()),
@@ -290,7 +325,7 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
                     return maybe_remat(block_fn)(p, h), None
                 h, _ = lax.scan(body, xi, bp)
                 return h
-            x = jax.shard_map(
+            x = _shard_map(
                 seq_par, mesh=mesh, in_specs=(P(None), P(None, "sp")),
                 out_specs=P(None, "sp"), axis_names={"sp"},
                 check_vma=False)(params["blocks"], x)
@@ -440,7 +475,7 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh,
             return loss, dbp, dxi, dhp
 
         lab_spec = P(None, None, "sp") if use_sp else P(None)
-        loss, dblocks, dx, dhead = jax.shard_map(
+        loss, dblocks, dx, dhead = _shard_map(
             run, mesh=mesh,
             in_specs=(P("pp"), x_spec, lab_spec, P()),
             out_specs=(P(), P("pp"), x_spec, P()),

@@ -29,6 +29,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 ships the params class as TPUCompilerParams (same fields);
+# the modern name is CompilerParams — resolve whichever this jax has
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 __all__ = ["flash_attention", "flash_attention_qkv"]
 
 NEG_INF = -1e30
@@ -183,7 +188,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -253,7 +258,7 @@ def _small_flash_fwd(q, k, v, scale: float, causal: bool,
         ],
         out_specs=pl.BlockSpec((G, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -388,7 +393,7 @@ def _qkv_small_fwd(qkv, num_heads: int, scale: float, causal: bool,
         out_specs=pl.BlockSpec((G, block_q, 128),
                                lambda b, hp, i: (b, i, hp)),
         out_shape=jax.ShapeDtypeStruct((B, T, F), qkv.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qkv, qkv, qkv)
@@ -428,7 +433,7 @@ def _qkv_small_bwd(qkv, do, num_heads: int, scale: float, causal: bool,
                   pl.BlockSpec((G, T, 128), lambda b, hp: (b, 0, hp))],
         out_specs=pl.BlockSpec((G, T, F3), lambda b, hp: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, T, F3), qkv.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(qkv, qkv, qkv, do)
@@ -544,7 +549,7 @@ def _qkv_mid_bwd(qkv, do, num_heads: int, scale: float, causal: bool,
         out_shape=[jax.ShapeDtypeStruct((B, T, F), qkv.dtype)] * 3,
         scratch_shapes=[pltpu.VMEM((T, 128), jnp.float32),
                         pltpu.VMEM((T, 128), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qkv, qkv, qkv, do)
@@ -719,7 +724,7 @@ def _tiled_flash_bwd(q, k, v, do, scale: float, causal: bool,
                    jax.ShapeDtypeStruct((BH, Tk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((Tk, d), jnp.float32),
                         pltpu.VMEM((Tk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do)
@@ -791,7 +796,7 @@ def _small_flash_bwd(q, k, v, do, scale: float, causal: bool,
         out_shape=[jax.ShapeDtypeStruct((BH, T, d), q.dtype),
                    jax.ShapeDtypeStruct((BH, Tk, d), k.dtype),
                    jax.ShapeDtypeStruct((BH, Tk, d), v.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(q, k, v, do)
@@ -924,7 +929,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -944,7 +949,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool,
                    jax.ShapeDtypeStruct((BH, Tk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)

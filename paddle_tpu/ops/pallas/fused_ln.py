@@ -22,6 +22,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 ships the params class as TPUCompilerParams (same fields);
+# the modern name is CompilerParams — resolve whichever this jax has
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 __all__ = ["fused_ln_pallas", "hash_uniform"]
 
 
@@ -81,7 +86,7 @@ def fused_ln_pallas(x, residual, bias, gamma, beta, seed, *, p: float,
         in_specs=[row_spec, row_spec, vec_spec, vec_spec, vec_spec, one_spec],
         out_specs=row_spec,
         out_shape=jax.ShapeDtypeStruct((N, D), x.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x, residual, bias.reshape(1, D), gamma.reshape(1, D),

@@ -76,7 +76,7 @@ __all__ = ["active", "enable", "disable", "configure",
 active = False
 
 KNOWN_TAGS = ("params", "opt_state", "kv_arena", "prefix_cache",
-              "activations", "prefetch", "grads")
+              "activations", "prefetch", "grads", "host_offload")
 
 _lock = threading.RLock()
 _tag_bytes: Dict[str, int] = {}

@@ -1,0 +1,182 @@
+"""AMP train-step tests (ISSUE 20): GradScaler state roundtrip + bf16
+skip semantics, O2 master-weight dtype contract through the jitted
+``Model`` step, fp16 in-jit loss-scaling state threading, and
+checkpoint-resume under AMP with the fp32 masters bit-exact through
+``AsyncCheckpointer``."""
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.amp import GradScaler, auto_cast  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# GradScaler state + bf16 skip semantics
+# ---------------------------------------------------------------------------
+
+def test_gradscaler_state_dict_roundtrip():
+    src = GradScaler(init_loss_scaling=4096.0, incr_ratio=3.0,
+                     decr_ratio=0.25, incr_every_n_steps=7,
+                     decr_every_n_nan_or_inf=5)
+    src._good = jnp.asarray(3, jnp.int32)
+    src._bad = jnp.asarray(1, jnp.int32)
+    state = src.state_dict()
+
+    dst = GradScaler()   # all defaults — every field must come from state
+    dst.load_state_dict(state)
+    assert float(dst._scale) == 4096.0
+    assert dst._incr_ratio == 3.0 and dst._decr_ratio == 0.25
+    assert dst._incr_every_n_steps == 7 and dst._decr_every_n == 5
+    assert int(dst._good) == 3 and int(dst._bad) == 1
+    assert dst.state_dict() == state
+
+
+def test_gradscaler_legacy_state_keeps_own_ratios():
+    # pre-ISSUE-20 checkpoints carry only scale/good/bad: the ratios and
+    # intervals configured at construction must survive the load
+    sc = GradScaler(incr_ratio=8.0, decr_every_n_nan_or_inf=9)
+    sc.load_state_dict({"scale": 64.0})
+    assert float(sc._scale) == 64.0
+    assert sc._incr_ratio == 8.0 and sc._decr_every_n == 9
+
+
+def test_gradscaler_bf16_skips_scaling_and_warns_once():
+    sc = GradScaler(init_loss_scaling=1024.0)
+    loss = paddle.to_tensor(np.float32(2.0)).astype("bfloat16")
+    with pytest.warns(UserWarning, match="loss scaling is skipped"):
+        out = sc.scale(loss)
+    assert float(out) == 2.0, "bf16 loss must pass through unscaled"
+    assert sc._skip_scaling
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")   # second call must NOT warn
+        out2 = sc.scale(loss)
+    assert float(out2) == 2.0
+    # update() is a no-op under the latch: the dynamic state holds
+    sc._found_inf = True
+    sc.update()
+    assert float(sc._scale) == 1024.0 and int(sc._bad) == 0
+
+
+def test_gradscaler_bf16_autocast_context_triggers_skip():
+    sc = GradScaler()
+    loss = paddle.to_tensor(np.float32(3.0))   # fp32 loss, bf16 context
+    with auto_cast(level="O1", dtype="bfloat16"):
+        with pytest.warns(UserWarning, match="loss scaling is skipped"):
+            out = sc.scale(loss)
+    assert float(out) == 3.0
+    # fp16 context re-arms the scaler
+    sc2 = GradScaler(init_loss_scaling=8.0)
+    out = sc2.scale(paddle.to_tensor(np.float32(1.0)))
+    assert float(out) == 8.0 and not sc2._skip_scaling
+
+
+# ---------------------------------------------------------------------------
+# jitted Model step under AMP
+# ---------------------------------------------------------------------------
+
+def _mlp_model(amp_configs=None, seed=0):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.LayerNorm(32),
+                        nn.Linear(32, 4))
+    m = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    m.prepare(opt, nn.CrossEntropyLoss(), amp_configs=amp_configs)
+    return m, net
+
+
+def _batches(n, batch=8):
+    rng = np.random.RandomState(7)
+    return [(rng.rand(batch, 16).astype("float32"),
+             rng.randint(0, 4, (batch,)).astype("int64"))
+            for _ in range(n)]
+
+
+def test_o2_bf16_step_keeps_fp32_masters():
+    m, net = _mlp_model({"level": "O2", "dtype": "bfloat16"})
+    for x, y in _batches(3):
+        logs = m.train_batch([x], [y])
+        assert np.isfinite(float(logs["loss"]))
+    params, _ = net.functional_state()
+    for name, p in params.items():
+        assert p.dtype == jnp.float32, (
+            f"O2 master weight {name} left fp32: {p.dtype}")
+    assert m._amp_scaler_state is None, "bf16 must not engage the scaler"
+
+
+def test_bf16_loss_tracks_fp32():
+    ref_m, _ = _mlp_model(None)
+    amp_m, _ = _mlp_model({"level": "O1", "dtype": "bfloat16"})
+    for x, y in _batches(4):
+        a = float(ref_m.train_batch([x], [y])["loss"])
+        b = float(amp_m.train_batch([x], [y])["loss"])
+        assert abs(a - b) <= 5e-2 * max(1.0, abs(a)), (
+            f"bf16 loss {b} vs fp32 {a} outside tolerance")
+
+
+def test_fp16_scaler_state_threads_through_step():
+    m, _ = _mlp_model({"level": "O1", "dtype": "float16",
+                       "init_loss_scaling": 256.0,
+                       "incr_every_n_steps": 2,
+                       "use_dynamic_loss_scaling": True})
+    (x, y), (x2, y2) = _batches(2)
+    m.train_batch([x], [y])
+    assert not bool(m._amp_found_inf)
+    assert float(m._amp_scaler_state["scale"]) == 256.0
+    assert int(m._amp_scaler_state["good"]) == 1
+    m.train_batch([x2], [y2])
+    # two clean steps with incr_every_n_steps=2: the scale doubles and
+    # the good-step counter rolls over — all inside the jitted step
+    assert float(m._amp_scaler_state["scale"]) == 512.0
+    assert int(m._amp_scaler_state["good"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-resume under AMP: fp32 masters bit-exact
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_resume_under_amp_bit_exact(tmp_path):
+    from paddle_tpu.distributed.checkpoint import AsyncCheckpointer
+
+    amp = {"level": "O2", "dtype": "bfloat16"}
+    data = _batches(4)
+
+    m_a, net_a = _mlp_model(amp, seed=11)
+    for x, y in data[:2]:
+        m_a.train_batch([x], [y])
+    ck = AsyncCheckpointer(str(tmp_path / "ck"))
+    ck.save(2, m_a._ckpt_tree(2))
+    ck.wait_until_finished()
+    masters = {n: np.asarray(p)
+               for n, p in net_a.functional_state()[0].items()}
+    tail_a = [float(m_a.train_batch([x], [y])["loss"])
+              for x, y in data[2:]]
+
+    # fresh process-analog: new model, same arch/prepare, restore
+    m_b, net_b = _mlp_model(amp, seed=99)   # different init — must be
+    # overwritten wholesale by the restored tree
+    with pytest.warns(UserWarning, match="resumed from checkpoint"):
+        info = m_b._fit_resume(ck)
+    assert info is not None and info["step"] == 2
+    restored = {n: np.asarray(p)
+                for n, p in net_b.functional_state()[0].items()}
+    assert set(restored) == set(masters)
+    for n in masters:
+        assert masters[n].dtype == np.float32, (
+            f"master {n} not checkpointed in fp32")
+        assert (restored[n] == masters[n]).all(), (
+            f"fp32 master {n} not bit-exact through the checkpointer")
+    tail_b = [float(m_b.train_batch([x], [y])["loss"])
+              for x, y in data[2:]]
+    # restored rng + params + opt state: the continuation replays the
+    # uninterrupted run's losses
+    assert tail_a == pytest.approx(tail_b, rel=0, abs=1e-6)

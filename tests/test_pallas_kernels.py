@@ -36,8 +36,14 @@ def _ref_attention(q, k, v, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("tq,tk", [(256, 256), (128, 256), (512, 512),
-                                   (1024, 1024), (1152, 1152), (640, 1280)])
+@pytest.mark.parametrize("tq,tk", [
+    (256, 256), (128, 256),
+    # tq >= 512 interpret-mode runs cost seconds each on one CPU core;
+    # they gate in the slow tier (run_all_tests.sh --runslow)
+    pytest.param(512, 512, marks=pytest.mark.slow),
+    pytest.param(1024, 1024, marks=pytest.mark.slow),
+    pytest.param(1152, 1152, marks=pytest.mark.slow),
+    pytest.param(640, 1280, marks=pytest.mark.slow)])
 def test_flash_fwd_bwd_vs_xla(force_pallas, causal, tq, tk):
     rs = np.random.RandomState(0)
     B, H, D = 2, 2, 64
@@ -103,7 +109,41 @@ def test_causal_cross_attention_gated_off(monkeypatch):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("H,D", [(4, 64), (8, 32), (2, 128)])
+def test_flash_bf16_no_fp32_fallback(force_pallas, causal):
+    # the AMP train step feeds the kernel bf16 q/k/v: operands must
+    # STAY bf16 through forward and backward (fp32 lives only in the
+    # kernel's softmax/accumulator scratch), tracking the fp32
+    # reference at bf16 tolerance
+    rs = np.random.RandomState(5)
+    B, T, H, D = 2, 256, 2, 64
+    mk = lambda: jnp.asarray(rs.rand(B, T, H, D), jnp.float32)  # noqa: E731
+    q32, k32, v32, g32 = mk(), mk(), mk(), mk()
+    q, k, v, g = (a.astype(jnp.bfloat16) for a in (q32, k32, v32, g32))
+
+    out = fa.flash_attention(q, k, v, causal=causal)
+    assert out.dtype == jnp.bfloat16
+    ref = _ref_attention(q32, k32, v32, causal)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), atol=3e-2)
+
+    grads = jax.vjp(
+        lambda a, b, c: fa.flash_attention(a, b, c, causal=causal),
+        q, k, v)[1](g)
+    refs = jax.vjp(
+        lambda a, b, c: _ref_attention(a, b, c, causal),
+        q32, k32, v32)[1](g32)
+    for d, r in zip(grads, refs):
+        assert d.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(d, np.float32),
+                                   np.asarray(r), atol=5e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("H,D", [
+    (4, 64), (2, 128),
+    # the P=4 packing regime (8 heads of d=32) is the slowest interpret
+    # run of the three — slow tier keeps it gating without the tier-1 cost
+    pytest.param(8, 32, marks=pytest.mark.slow)])
 def test_flash_attention_qkv_packed(force_pallas, causal, H, D):
     # packed projection-output entry: same numbers as split + generic,
     # across the head-packing regimes (P = 128//d heads per column

@@ -1,0 +1,165 @@
+"""Executing-remat tests (ISSUE 20): the budget-driven
+``FLAGS_remat_budget_mb`` decision against the PR-16 static memory
+planner, loss parity of the jax.checkpoint-wrapped step vs the plain
+one, jit-signature/compile-count stability across remat'd steps, and
+the ``prepare(offload=True)`` opt-state knob's audited CPU no-op."""
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.jit import InputSpec  # noqa: E402
+from paddle_tpu.profiler import memscope  # noqa: E402
+
+B = 64
+
+
+@pytest.fixture
+def remat_flags():
+    yield
+    paddle.set_flags({"FLAGS_program_remat": False,
+                      "FLAGS_remat_budget_mb": 0})
+
+
+def _deep_model(offload=False, seed=0):
+    paddle.seed(seed)
+    layers = [nn.Linear(32, 128)]
+    for _ in range(3):
+        layers += [nn.Tanh(), nn.Linear(128, 128)]
+    layers += [nn.Tanh(), nn.Linear(128, 8)]
+    net = nn.Sequential(*layers)
+    m = paddle.Model(net,
+                     inputs=[InputSpec([None, 32], "float32", name="x")],
+                     labels=[InputSpec([None], "int64", name="y")])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # CPU offload no-op warns
+        m.prepare(paddle.optimizer.Adam(
+                      1e-3, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), offload=offload)
+    return m, net
+
+
+def _batches(n):
+    rng = np.random.RandomState(3)
+    return [(rng.rand(B, 32).astype("float32"),
+             rng.randint(0, 8, (B,)).astype("int64"))
+            for _ in range(n)]
+
+
+def test_remat_decision_tracks_planner_budget(remat_flags):
+    m, _ = _deep_model()
+    peak = int(m.static_memory_plan("train", batch_size=B).peak_bytes)
+
+    assert not m._remat_decision(batch_size=B), \
+        "remat must stay off with no flags set"
+
+    over = max(1, (peak // (1 << 20)) + 1)   # budget ABOVE planner peak
+    paddle.set_flags({"FLAGS_program_remat": True,
+                      "FLAGS_remat_budget_mb": over})
+    assert not m._remat_decision(batch_size=B)
+    assert m._remat_planned_peak == peak
+
+    paddle.set_flags({"FLAGS_remat_budget_mb": 1})   # peak >> 1MB? no —
+    # this tiny net plans under 1MB, so force the comparison the other
+    # way by checking against the recorded peak directly
+    m._remat_cache = None
+    on = m._remat_decision(batch_size=B)
+    assert on == (peak > 1 << 20)
+
+
+def test_remat_engages_and_matches_plain_losses(remat_flags):
+    data = _batches(3)
+    ref_m, _ = _deep_model(seed=5)
+    ref = [float(ref_m.train_batch([x], [y])["loss"]) for x, y in data]
+
+    m, _ = _deep_model(seed=5)
+    peak = int(m.static_memory_plan("train", batch_size=B).peak_bytes)
+    budget_mb = max(1, peak // (1 << 20))   # at-or-below peak
+    if peak <= budget_mb * (1 << 20):
+        budget_mb = 0   # plan smaller than 1MB: engage via the
+        # unplannable-conservative path instead
+    if budget_mb == 0:
+        # make the budget comparison meaningful at tiny scale: 1MB
+        # budget + a forced planner overshoot via a fake cache
+        paddle.set_flags({"FLAGS_program_remat": True,
+                          "FLAGS_remat_budget_mb": 1})
+        m._remat_cache = ((1, B), True)
+        m._remat_active = True
+        m._remat_planned_peak = peak
+    else:
+        paddle.set_flags({"FLAGS_program_remat": True,
+                          "FLAGS_remat_budget_mb": budget_mb})
+    got = [float(m.train_batch([x], [y])["loss"]) for x, y in data]
+    # jax.checkpoint recomputes the same fp32 graph: losses match the
+    # un-remat'd run to float tolerance
+    assert got == pytest.approx(ref, rel=0, abs=1e-6)
+    # the remat'd step is ONE jit entry, keyed by the remat bit — warm
+    # steps must not recompile
+    assert len(m._jit_cache) == 1
+    (sig, _), = m._jit_cache.items()
+    assert sig[1] is True, f"jit signature lost the remat bit: {sig}"
+
+
+def test_remat_over_budget_engages_with_warning(remat_flags):
+    # a batch large enough that the planner peak clears a 1MB budget
+    big = 4096
+    m, _ = _deep_model()
+    peak = int(m.static_memory_plan("train", batch_size=big).peak_bytes)
+    assert peak > 1 << 20, "test config no longer overshoots 1MB"
+    paddle.set_flags({"FLAGS_program_remat": True,
+                      "FLAGS_remat_budget_mb": 1})
+    with pytest.warns(UserWarning, match="rematerialization engaged"):
+        assert m._remat_decision(batch_size=big)
+    assert m._remat_active and m._remat_planned_peak == peak
+    # verdict cached: same budget+batch re-query costs no replan and
+    # does not re-warn
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert m._remat_decision(batch_size=big)
+
+
+def test_remat_steps_add_no_warm_compiles(remat_flags):
+    data = _batches(4)
+    m, _ = _deep_model()
+    paddle.set_flags({"FLAGS_program_remat": True,
+                      "FLAGS_remat_budget_mb": 1})
+    m._remat_cache = ((1, B), True)   # force-engage at tiny scale
+    m._remat_active = True
+    x, y = data[0]
+    m.train_batch([x], [y])   # compile-bearing first step
+    memscope.enable()
+    try:
+        c0 = memscope.compile_count()
+        for x, y in data[1:]:
+            m.train_batch([x], [y])
+        assert memscope.compile_count() == c0, (
+            "warm remat'd steps recompiled — signature unstable")
+    finally:
+        memscope.disable()
+    assert len(m._jit_cache) == 1
+
+
+def test_offload_knob_is_audited_noop_on_cpu(remat_flags):
+    import jax
+    kinds = set()
+    try:
+        kinds = {mem.kind for mem in jax.devices()[0].addressable_memories()}
+    except Exception:   # noqa: BLE001 — old backend API
+        pass
+    if "pinned_host" in kinds:
+        pytest.skip("backend has pinned_host — the no-op path is moot")
+    m, _ = _deep_model(offload=True)
+    x, y = _batches(1)[0]
+    logs = m.train_batch([x], [y])
+    assert np.isfinite(float(logs["loss"]))
+    # the knob resolved to None (cached) and never parked state on host
+    assert m._offload_sh_cache is None
+    assert not getattr(m, "_opt_on_host", False)
+    assert "host_offload" not in memscope.tag_bytes() or \
+        memscope.tag_bytes()["host_offload"] == 0

@@ -142,6 +142,16 @@ python tools/mem_gate.py
 # input-gradient bit-exact through the Executor while the MEASURED
 # replay peak strictly drops.
 python tools/memplan_gate.py
+# AMP train-step gate (ISSUE 20 bf16/fp16 layer): a seeded MLP trains
+# 10 steps fp32 vs O1/O2-bf16 through the jitted Model step with
+# per-step loss parity inside the documented bf16 tolerance, zero new
+# compiles on a warm rerun and the scaler never engaged for bf16; an
+# fp16 run with dynamic loss scaling must skip the update bit-exactly
+# on an inf-poisoned batch (scale halved, params untouched) and
+# recover on the next clean batch; the auto_cast-captured train
+# program must lint AMP-clean while a bf16-narrowed black-list op
+# trips AMP01.
+python tools/amp_gate.py
 # Multi-tenant SLO gate (ISSUE 18 admission/preemption layer): with a
 # 3-block pool saturated by batch-priority streams, every interactive
 # burst must preempt the batch victim to pinned host memory and hand
